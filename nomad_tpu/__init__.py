@@ -1,0 +1,28 @@
+"""nomad_tpu: a TPU-native distributed workload orchestrator.
+
+A ground-up rebuild of the capabilities of HashiCorp Nomad (reference:
+/root/reference, pure Go) with the per-evaluation scheduler ranking
+pipeline re-expressed as batched JAX/XLA tensor kernels and the control
+plane designed for a TPU-resident node/alloc table.
+
+Package layout:
+  models/    -- the domain model (Job/TaskGroup/Task/Node/Alloc/Eval/Plan),
+                mirroring the semantics of nomad/structs/structs.go
+  state/     -- MVCC in-memory state store with snapshots and watches
+                (go-memdb equivalent, persistent HAMT based)
+  ops/       -- the JAX kernels: feasibility masks, bin-pack scoring,
+                spread/affinity/anti-affinity, preemption, argmax select
+  scheduler/ -- host-side schedulers (generic/system/core), reconciler,
+                device-backed placement stack, factory registry, harness
+  server/    -- eval broker, blocked evals, plan queue, plan applier,
+                worker, leader duties
+  client/    -- node agent: fingerprint, heartbeat, alloc/task runners,
+                drivers (mock, exec)
+  parallel/  -- mesh/sharding for the node axis (pjit/shard_map), ICI/DCN
+                collective layout
+  api/, cli/ -- north-bound HTTP API + command line
+  jobspec/   -- jobspec parsing (JSON + HCL-subset)
+  mock/      -- test fixtures (nomad/mock equivalent)
+"""
+
+__version__ = "0.1.0"

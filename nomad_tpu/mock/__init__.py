@@ -1,0 +1,12 @@
+from .fixtures import (
+    node,
+    nvidia_node,
+    job,
+    batch_job,
+    system_job,
+    alloc,
+    batch_alloc,
+    system_alloc,
+    evaluation,
+    deployment,
+)

@@ -1,0 +1,328 @@
+"""Test fixtures mirroring nomad/mock/mock.go — Node():13, Job():175,
+BatchJob():741, SystemJob():807, Alloc():911, Eval():882,
+NvidiaNode():114, Deployment():1287. The resource values match the
+reference so golden scoring tests line up.
+"""
+
+from __future__ import annotations
+
+from ..models import (
+    Allocation, AllocatedResources, AllocatedTaskResources,
+    AllocatedSharedResources, AllocMetric, Constraint, Deployment,
+    DriverInfo, EphemeralDisk, Evaluation, Job, MigrateStrategy,
+    NetworkResource, Node, NodeReservedResources, NodeResources, Port,
+    ReschedulePolicy, Resources, RestartPolicy, Task, TaskGroup,
+    LogConfig, Service, ServiceCheck, NodeDeviceResource, NodeDevice,
+    JOB_TYPE_BATCH, JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM,
+    NODE_STATUS_READY, NODE_SCHED_ELIGIBLE,
+    EVAL_STATUS_PENDING, TRIGGER_JOB_REGISTER,
+    ALLOC_DESIRED_RUN, ALLOC_CLIENT_PENDING,
+)
+from ..models.resources import (NodeCpuResources, NodeMemoryResources,
+                                NodeDiskResources)
+from ..utils.ids import generate_uuid
+
+
+def node() -> Node:
+    n = Node(
+        id=generate_uuid(),
+        secret_id=generate_uuid(),
+        datacenter="dc1",
+        name="foobar",
+        drivers={
+            "exec": DriverInfo(detected=True, healthy=True),
+            "mock_driver": DriverInfo(detected=True, healthy=True),
+        },
+        attributes={
+            "kernel.name": "linux",
+            "arch": "x86",
+            "nomad.version": "0.5.0",
+            "driver.exec": "1",
+            "driver.mock_driver": "1",
+        },
+        node_resources=NodeResources(
+            cpu=NodeCpuResources(cpu_shares=4000),
+            memory=NodeMemoryResources(memory_mb=8192),
+            disk=NodeDiskResources(disk_mb=100 * 1024),
+            networks=[NetworkResource(
+                mode="host", device="eth0", cidr="192.168.0.100/32",
+                ip="192.168.0.100", mbits=1000,
+            )],
+        ),
+        reserved_resources=NodeReservedResources(
+            cpu_shares=100, memory_mb=256, disk_mb=4 * 1024,
+            reserved_host_ports="22",
+        ),
+        links={"consul": "foobar.dc1"},
+        meta={"pci-dss": "true", "database": "mysql", "version": "5.6"},
+        node_class="linux-medium-pci",
+        status=NODE_STATUS_READY,
+        scheduling_eligibility=NODE_SCHED_ELIGIBLE,
+    )
+    n.compute_class()
+    return n
+
+
+def nvidia_node() -> Node:
+    """mock.go NvidiaNode():114 — node with 4 Nvidia 1080ti GPUs."""
+    n = node()
+    n.node_resources.devices = [
+        NodeDeviceResource(
+            vendor="nvidia", type="gpu", name="1080ti",
+            attributes={
+                "memory": 11 * 1024,
+                "cuda_cores": 3584,
+                "graphics_clock": 1480,
+                "memory_bandwidth": 11,
+            },
+            instances=[
+                NodeDevice(id=generate_uuid(), healthy=True)
+                for _ in range(4)
+            ],
+        )
+    ]
+    n.compute_class()
+    return n
+
+
+def _web_task() -> Task:
+    return Task(
+        name="web",
+        driver="exec",
+        config={"command": "/bin/date"},
+        env={"FOO": "bar"},
+        services=[
+            Service(
+                name="${TASK}-frontend", port_label="http",
+                tags=["pci:${meta.pci-dss}", "datacenter:${node.datacenter}"],
+                checks=[ServiceCheck(name="check-table", type="script",
+                                     interval_s=30.0, timeout_s=5.0)],
+            ),
+            Service(name="${TASK}-admin", port_label="admin"),
+        ],
+        log_config=LogConfig(),
+        resources=Resources(
+            cpu=500, memory_mb=256,
+            networks=[NetworkResource(
+                mbits=50,
+                dynamic_ports=[Port(label="http"), Port(label="admin")],
+            )],
+        ),
+        meta={"foo": "bar"},
+    )
+
+
+def job() -> Job:
+    j = Job(
+        region="global",
+        id=f"mock-service-{generate_uuid()}",
+        name="my-job",
+        namespace="default",
+        type=JOB_TYPE_SERVICE,
+        priority=50,
+        all_at_once=False,
+        datacenters=["dc1"],
+        constraints=[Constraint(ltarget="${attr.kernel.name}",
+                                rtarget="linux", operand="=")],
+        task_groups=[TaskGroup(
+            name="web",
+            count=10,
+            ephemeral_disk=EphemeralDisk(size_mb=150),
+            restart_policy=RestartPolicy(attempts=3, interval_s=600.0,
+                                         delay_s=60.0, mode="delay"),
+            reschedule_policy=ReschedulePolicy(
+                attempts=2, interval_s=600.0, delay_s=5.0,
+                delay_function="constant", unlimited=False),
+            migrate=MigrateStrategy(),
+            tasks=[_web_task()],
+            meta={"elb_check_type": "http", "elb_check_interval": "30s",
+                  "elb_check_min": "3"},
+        )],
+        meta={"owner": "armon"},
+        status="pending",
+        version=0,
+        create_index=42,
+        modify_index=99,
+        job_modify_index=99,
+    )
+    j.canonicalize()
+    return j
+
+
+def batch_job() -> Job:
+    """mock.go BatchJob():741."""
+    j = Job(
+        region="global",
+        id=f"mock-batch-{generate_uuid()}",
+        name="batch-job",
+        namespace="default",
+        type=JOB_TYPE_BATCH,
+        priority=50,
+        all_at_once=False,
+        datacenters=["dc1"],
+        task_groups=[TaskGroup(
+            name="worker",
+            count=10,
+            ephemeral_disk=EphemeralDisk(size_mb=150),
+            restart_policy=RestartPolicy(attempts=3, interval_s=600.0,
+                                         delay_s=60.0, mode="delay"),
+            reschedule_policy=ReschedulePolicy(
+                attempts=2, interval_s=600.0, delay_s=5.0,
+                delay_function="constant", unlimited=False),
+            tasks=[Task(
+                name="worker", driver="mock_driver",
+                config={"run_for": "500ms"},
+                env={"FOO": "bar"},
+                log_config=LogConfig(),
+                resources=Resources(
+                    cpu=100, memory_mb=100,
+                    networks=[NetworkResource(mbits=50)],
+                ),
+                meta={"foo": "bar"},
+            )],
+        )],
+        status="pending",
+        create_index=43,
+        modify_index=99,
+        job_modify_index=99,
+    )
+    j.canonicalize()
+    return j
+
+
+def system_job() -> Job:
+    """mock.go SystemJob():807."""
+    j = Job(
+        region="global",
+        namespace="default",
+        id=f"mock-system-{generate_uuid()}",
+        name="my-job",
+        type=JOB_TYPE_SYSTEM,
+        priority=100,
+        all_at_once=False,
+        datacenters=["dc1"],
+        constraints=[Constraint(ltarget="${attr.kernel.name}",
+                                rtarget="linux", operand="=")],
+        task_groups=[TaskGroup(
+            name="web",
+            count=1,
+            restart_policy=RestartPolicy(attempts=3, interval_s=600.0,
+                                         delay_s=60.0, mode="delay"),
+            ephemeral_disk=EphemeralDisk(size_mb=150),
+            tasks=[Task(
+                name="web", driver="exec",
+                config={"command": "/bin/date"},
+                env={},
+                resources=Resources(
+                    cpu=500, memory_mb=256,
+                    networks=[NetworkResource(
+                        mbits=50, dynamic_ports=[Port(label="http")])],
+                ),
+                log_config=LogConfig(),
+            )],
+        )],
+        meta={"owner": "armon"},
+        status="pending",
+        create_index=42,
+        modify_index=99,
+        job_modify_index=99,
+    )
+    j.canonicalize()
+    return j
+
+
+def evaluation() -> Evaluation:
+    """mock.go Eval():882."""
+    return Evaluation(
+        id=generate_uuid(),
+        namespace="default",
+        priority=50,
+        type=JOB_TYPE_SERVICE,
+        job_id=generate_uuid(),
+        status=EVAL_STATUS_PENDING,
+        triggered_by=TRIGGER_JOB_REGISTER,
+    )
+
+
+def _web_alloc_resources() -> AllocatedResources:
+    return AllocatedResources(
+        tasks={"web": AllocatedTaskResources()},
+        shared=AllocatedSharedResources(disk_mb=150),
+    )
+
+
+def alloc() -> Allocation:
+    """mock.go Alloc():911."""
+    j = job()
+    a = Allocation(
+        id=generate_uuid(),
+        eval_id=generate_uuid(),
+        namespace="default",
+        node_id="12345678-abcd-efab-cdef-123456789abc",
+        task_group="web",
+        job_id=j.id,
+        job=j,
+        desired_status=ALLOC_DESIRED_RUN,
+        client_status=ALLOC_CLIENT_PENDING,
+    )
+    res = _web_alloc_resources()
+    res.tasks["web"].cpu.cpu_shares = 500
+    res.tasks["web"].memory.memory_mb = 256
+    res.tasks["web"].networks = [NetworkResource(
+        device="eth0", ip="192.168.0.100", mbits=50,
+        reserved_ports=[Port(label="admin", value=5000)],
+        dynamic_ports=[Port(label="http", value=9876)],
+    )]
+    a.allocated_resources = res
+    a.name = f"{a.job_id}.web[0]"
+    return a
+
+
+def batch_alloc() -> Allocation:
+    j = batch_job()
+    a = Allocation(
+        id=generate_uuid(),
+        eval_id=generate_uuid(),
+        namespace="default",
+        node_id="12345678-abcd-efab-cdef-123456789abc",
+        task_group="worker",
+        job_id=j.id,
+        job=j,
+        desired_status=ALLOC_DESIRED_RUN,
+        client_status=ALLOC_CLIENT_PENDING,
+    )
+    res = AllocatedResources(
+        tasks={"worker": AllocatedTaskResources()},
+        shared=AllocatedSharedResources(disk_mb=150),
+    )
+    res.tasks["worker"].cpu.cpu_shares = 100
+    res.tasks["worker"].memory.memory_mb = 100
+    a.allocated_resources = res
+    a.name = f"{a.job_id}.worker[0]"
+    return a
+
+
+def system_alloc() -> Allocation:
+    j = system_job()
+    a = Allocation(
+        id=generate_uuid(),
+        eval_id=generate_uuid(),
+        namespace="default",
+        node_id="12345678-abcd-efab-cdef-123456789abc",
+        task_group="web",
+        job_id=j.id,
+        job=j,
+        desired_status=ALLOC_DESIRED_RUN,
+        client_status=ALLOC_CLIENT_PENDING,
+    )
+    res = _web_alloc_resources()
+    res.tasks["web"].cpu.cpu_shares = 500
+    res.tasks["web"].memory.memory_mb = 256
+    a.allocated_resources = res
+    a.name = f"{a.job_id}.web[0]"
+    return a
+
+
+def deployment() -> Deployment:
+    j = job()
+    return Deployment.from_job(j)

@@ -1,0 +1,121 @@
+"""The domain model: the single source of truth for all orchestrator
+types (reference: nomad/structs/structs.go, 18.8k LoC).
+
+Everything here is host-side Python; the tensorized projections of
+nodes/allocs used by the scheduler kernels live in nomad_tpu/ops/tables.py.
+"""
+
+from .resources import (
+    Resources,
+    NodeResources,
+    NodeReservedResources,
+    AllocatedResources,
+    AllocatedTaskResources,
+    AllocatedSharedResources,
+    ComparableResources,
+    NodeDeviceResource,
+    NodeDevice,
+    AllocatedDeviceResource,
+    RequestedDevice,
+)
+from .networks import NetworkResource, Port, NetworkIndex
+from .job import (
+    Job,
+    TaskGroup,
+    Task,
+    Constraint,
+    Affinity,
+    Spread,
+    SpreadTarget,
+    RestartPolicy,
+    ReschedulePolicy,
+    EphemeralDisk,
+    UpdateStrategy,
+    MigrateStrategy,
+    PeriodicConfig,
+    ParameterizedJobConfig,
+    DispatchPayloadConfig,
+    TaskLifecycleConfig,
+    LogConfig,
+    Service,
+    ServiceCheck,
+    JOB_TYPE_SERVICE,
+    JOB_TYPE_BATCH,
+    JOB_TYPE_SYSTEM,
+    JOB_TYPE_CORE,
+    JOB_STATUS_PENDING,
+    JOB_STATUS_RUNNING,
+    JOB_STATUS_DEAD,
+)
+from .node import (
+    Node,
+    DriverInfo,
+    NODE_STATUS_INIT,
+    NODE_STATUS_READY,
+    NODE_STATUS_DOWN,
+    NODE_SCHED_ELIGIBLE,
+    NODE_SCHED_INELIGIBLE,
+    DrainStrategy,
+    DrainSpec,
+)
+from .alloc import (
+    Allocation,
+    AllocMetric,
+    NodeScoreMeta,
+    TaskState,
+    TaskEvent,
+    RescheduleTracker,
+    RescheduleEvent,
+    AllocDeploymentStatus,
+    DesiredTransition,
+    ALLOC_DESIRED_RUN,
+    ALLOC_DESIRED_STOP,
+    ALLOC_DESIRED_EVICT,
+    ALLOC_CLIENT_PENDING,
+    ALLOC_CLIENT_RUNNING,
+    ALLOC_CLIENT_COMPLETE,
+    ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_LOST,
+)
+from .evaluation import (
+    Evaluation,
+    EVAL_STATUS_PENDING,
+    EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_COMPLETE,
+    EVAL_STATUS_FAILED,
+    EVAL_STATUS_CANCELED,
+    TRIGGER_JOB_REGISTER,
+    TRIGGER_JOB_DEREGISTER,
+    TRIGGER_PERIODIC_JOB,
+    TRIGGER_NODE_DRAIN,
+    TRIGGER_NODE_UPDATE,
+    TRIGGER_ALLOC_STOP,
+    TRIGGER_SCHEDULED,
+    TRIGGER_ROLLING_UPDATE,
+    TRIGGER_DEPLOYMENT_WATCHER,
+    TRIGGER_FAILED_FOLLOW_UP,
+    TRIGGER_MAX_PLANS,
+    TRIGGER_ALLOC_FAILURE,
+    TRIGGER_RETRY_FAILED_ALLOC,
+    TRIGGER_QUEUED_ALLOCS,
+    TRIGGER_PREEMPTION,
+    TRIGGER_JOB_SCALE,
+)
+from .plan import Plan, PlanResult, PlanAnnotations, DesiredUpdates
+from .deployment import (
+    Deployment,
+    DeploymentState,
+    DeploymentStatusUpdate,
+    DEPLOYMENT_STATUS_RUNNING,
+    DEPLOYMENT_STATUS_PAUSED,
+    DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+    DEPLOYMENT_STATUS_CANCELLED,
+)
+from .funcs import (
+    AllocsFit,
+    ScoreFitBinPack,
+    ScoreFitSpread,
+    FilterTerminalAllocs,
+)
+from .scheduler_config import SchedulerConfiguration, PreemptionConfig

@@ -1,0 +1,307 @@
+"""Allocation — a job task group placed on a node — plus the per-eval
+scoring metadata (AllocMetric) that the TPU kernel emits as debug output.
+
+Reference semantics: nomad/structs/structs.go Allocation:8873,
+AllocMetric:9580.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .resources import AllocatedResources
+from .job import Job, ReschedulePolicy
+
+ALLOC_DESIRED_RUN = "run"
+ALLOC_DESIRED_STOP = "stop"
+ALLOC_DESIRED_EVICT = "evict"
+
+ALLOC_CLIENT_PENDING = "pending"
+ALLOC_CLIENT_RUNNING = "running"
+ALLOC_CLIENT_COMPLETE = "complete"
+ALLOC_CLIENT_FAILED = "failed"
+ALLOC_CLIENT_LOST = "lost"
+
+TASK_STATE_PENDING = "pending"
+TASK_STATE_RUNNING = "running"
+TASK_STATE_DEAD = "dead"
+
+
+@dataclass
+class TaskEvent:
+    type: str = ""
+    time: int = 0
+    message: str = ""
+    display_message: str = ""
+    details: Dict[str, str] = field(default_factory=dict)
+    exit_code: int = 0
+    signal: int = 0
+    failed: bool = False
+    restart_reason: str = ""
+
+
+@dataclass
+class TaskState:
+    state: str = TASK_STATE_PENDING
+    failed: bool = False
+    restarts: int = 0
+    last_restart: float = 0.0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    events: List[TaskEvent] = field(default_factory=list)
+
+    def successful(self) -> bool:
+        return self.state == TASK_STATE_DEAD and not self.failed
+
+
+@dataclass
+class NodeScoreMeta:
+    """Per-node scoring breakdown kept for observability
+    (structs.go NodeScoreMeta; populated from the kernel's score vectors)."""
+    node_id: str = ""
+    scores: Dict[str, float] = field(default_factory=dict)
+    norm_score: float = 0.0
+
+
+@dataclass
+class AllocMetric:
+    """Scheduling metrics for one placement attempt (structs.go:9580)."""
+    nodes_evaluated: int = 0
+    nodes_filtered: int = 0
+    nodes_available: Dict[str, int] = field(default_factory=dict)      # dc -> count
+    class_filtered: Dict[str, int] = field(default_factory=dict)
+    constraint_filtered: Dict[str, int] = field(default_factory=dict)
+    nodes_exhausted: int = 0
+    class_exhausted: Dict[str, int] = field(default_factory=dict)
+    dimension_exhausted: Dict[str, int] = field(default_factory=dict)
+    quota_exhausted: List[str] = field(default_factory=list)
+    score_meta_data: List[NodeScoreMeta] = field(default_factory=list)
+    allocation_time_ns: int = 0
+    coalesced_failures: int = 0
+
+    def evaluate_node(self):
+        self.nodes_evaluated += 1
+
+    def filter_node(self, node, constraint: str):
+        self.nodes_filtered += 1
+        if node is not None and node.node_class:
+            self.class_filtered[node.node_class] = self.class_filtered.get(node.node_class, 0) + 1
+        if constraint:
+            self.constraint_filtered[constraint] = self.constraint_filtered.get(constraint, 0) + 1
+
+    def exhausted_node(self, node, dimension: str):
+        self.nodes_exhausted += 1
+        if node is not None and node.node_class:
+            self.class_exhausted[node.node_class] = self.class_exhausted.get(node.node_class, 0) + 1
+        if dimension:
+            self.dimension_exhausted[dimension] = self.dimension_exhausted.get(dimension, 0) + 1
+
+    def copy(self) -> "AllocMetric":
+        from ..utils.codec import to_wire, from_wire
+        return from_wire(AllocMetric, to_wire(self))
+
+    def max_normalized_score(self) -> float:
+        if not self.score_meta_data:
+            return 0.0
+        return max(s.norm_score for s in self.score_meta_data)
+
+
+@dataclass
+class DesiredTransition:
+    """Server-desired alloc transitions (structs.go DesiredTransition)."""
+    migrate: Optional[bool] = None
+    reschedule: Optional[bool] = None
+    force_reschedule: Optional[bool] = None
+
+    def should_migrate(self) -> bool:
+        return bool(self.migrate)
+
+    def should_force_reschedule(self) -> bool:
+        return bool(self.force_reschedule)
+
+
+@dataclass
+class RescheduleEvent:
+    reschedule_time: float = 0.0    # unix seconds
+    prev_alloc_id: str = ""
+    prev_node_id: str = ""
+    delay_s: float = 0.0
+
+
+@dataclass
+class RescheduleTracker:
+    events: List[RescheduleEvent] = field(default_factory=list)
+
+
+@dataclass
+class AllocDeploymentStatus:
+    healthy: Optional[bool] = None
+    timestamp: float = 0.0
+    canary: bool = False
+    modify_index: int = 0
+
+    def is_healthy(self) -> bool:
+        return self.healthy is True
+
+    def is_unhealthy(self) -> bool:
+        return self.healthy is False
+
+
+@dataclass
+class Allocation:
+    id: str = ""
+    namespace: str = "default"
+    eval_id: str = ""
+    name: str = ""              # "<job>.<group>[<index>]"
+    node_id: str = ""
+    node_name: str = ""
+    job_id: str = ""
+    job: Optional[Job] = None   # job snapshot at placement time
+    task_group: str = ""
+    allocated_resources: Optional[AllocatedResources] = None
+    metrics: Optional[AllocMetric] = None
+    desired_status: str = ALLOC_DESIRED_RUN
+    desired_description: str = ""
+    desired_transition: DesiredTransition = field(default_factory=DesiredTransition)
+    client_status: str = ALLOC_CLIENT_PENDING
+    client_description: str = ""
+    task_states: Dict[str, TaskState] = field(default_factory=dict)
+    deployment_id: str = ""
+    deployment_status: Optional[AllocDeploymentStatus] = None
+    reschedule_tracker: Optional[RescheduleTracker] = None
+    follow_up_eval_id: str = ""
+    previous_allocation: str = ""
+    next_allocation: str = ""
+    preempted_allocations: List[str] = field(default_factory=list)
+    preempted_by_allocation: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+    alloc_modify_index: int = 0
+    create_time: int = 0
+    modify_time: int = 0
+
+    # -- status predicates (structs.go Allocation.TerminalStatus) ------
+    def terminal_status(self) -> bool:
+        """Desired or actual status is terminal: the alloc no longer
+        consumes resources."""
+        if self.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT):
+            return True
+        return self.client_terminal_status()
+
+    def client_terminal_status(self) -> bool:
+        return self.client_status in (
+            ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST)
+
+    def server_terminal_status(self) -> bool:
+        return self.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT)
+
+    def ran_successfully(self) -> bool:
+        if not self.task_states:
+            return False
+        return all(ts.successful() for ts in self.task_states.values())
+
+    def comparable_resources(self):
+        if self.allocated_resources is None:
+            return None
+        return self.allocated_resources.comparable()
+
+    def index(self) -> int:
+        """Parse the bracketed index out of the alloc name."""
+        l, r = self.name.rfind("["), self.name.rfind("]")
+        if l == -1 or r == -1 or r < l:
+            return -1
+        try:
+            return int(self.name[l + 1:r])
+        except ValueError:
+            return -1
+
+    def job_namespaced_id(self):
+        return (self.namespace, self.job_id)
+
+    def reschedule_policy(self) -> Optional[ReschedulePolicy]:
+        if self.job is None:
+            return None
+        tg = self.job.lookup_task_group(self.task_group)
+        return tg.reschedule_policy if tg else None
+
+    def last_event_time(self) -> float:
+        """Latest task finished_at across task states (unix seconds)."""
+        last = 0.0
+        for ts in self.task_states.values():
+            if ts.finished_at and ts.finished_at > last:
+                last = ts.finished_at
+        return last
+
+    def next_reschedule_time(self):
+        """(eligible_time_unix_s, policy_has_delay) for delayed reschedule
+        (structs.go Allocation.NextRescheduleTime)."""
+        fail_time = self.last_event_time()
+        policy = self.reschedule_policy()
+        if policy is None or fail_time == 0.0:
+            return 0.0, False
+        if self.client_status != ALLOC_CLIENT_FAILED and self.client_status != ALLOC_CLIENT_LOST:
+            return 0.0, False
+        if not policy.enabled():
+            return 0.0, False
+        delay = self._next_delay(policy)
+        if policy.unlimited or (policy.attempts > 0 and self.reschedule_tracker is None):
+            return fail_time + delay, True
+        attempted = 0
+        if self.reschedule_tracker:
+            window_start = fail_time - policy.interval_s
+            for ev in self.reschedule_tracker.events:
+                if ev.reschedule_time > window_start:
+                    attempted += 1
+        # Once the backoff delay outgrows the sliding interval the policy can
+        # never legitimately fire again (structs.go:9226 nextDelay < Interval).
+        eligible = attempted < policy.attempts and delay < policy.interval_s
+        return fail_time + delay, eligible
+
+    def _next_delay(self, policy: ReschedulePolicy) -> float:
+        """Delay for the next reschedule attempt given the delay function."""
+        n_prev = len(self.reschedule_tracker.events) if self.reschedule_tracker else 0
+        base = policy.delay_s
+        if policy.delay_function == "constant":
+            return base
+        if policy.delay_function == "exponential":
+            d = base * (2 ** n_prev)
+        elif policy.delay_function == "fibonacci":
+            a, b = base, base
+            for _ in range(n_prev):
+                a, b = b, a + b
+            d = a
+        else:
+            d = base
+        if policy.max_delay_s > 0:
+            d = min(d, policy.max_delay_s)
+        return d
+
+    def should_reschedule(self, now: float) -> bool:
+        t, ok = self.next_reschedule_time()
+        return ok and t <= now
+
+    def copy(self) -> "Allocation":
+        from ..utils.codec import to_wire, from_wire
+        return from_wire(Allocation, to_wire(self))
+
+    def copy_skip_job(self) -> "Allocation":
+        job = self.job
+        self.job = None
+        try:
+            c = self.copy()
+        finally:
+            self.job = job
+        c.job = job
+        return c
+
+    def stub(self) -> dict:
+        return {
+            "id": self.id, "name": self.name, "node_id": self.node_id,
+            "job_id": self.job_id, "task_group": self.task_group,
+            "desired_status": self.desired_status,
+            "client_status": self.client_status,
+            "deployment_id": self.deployment_id,
+            "follow_up_eval_id": self.follow_up_eval_id,
+            "create_index": self.create_index, "modify_index": self.modify_index,
+        }

@@ -1,0 +1,109 @@
+"""Deployment — tracks a rolling update of a job version.
+
+Reference semantics: nomad/structs/structs.go Deployment:8532.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils.ids import generate_uuid
+
+DEPLOYMENT_STATUS_RUNNING = "running"
+DEPLOYMENT_STATUS_PAUSED = "paused"
+DEPLOYMENT_STATUS_FAILED = "failed"
+DEPLOYMENT_STATUS_SUCCESSFUL = "successful"
+DEPLOYMENT_STATUS_CANCELLED = "cancelled"
+
+TERMINAL_STATUSES = (DEPLOYMENT_STATUS_FAILED, DEPLOYMENT_STATUS_SUCCESSFUL,
+                     DEPLOYMENT_STATUS_CANCELLED)
+
+DESC_PROGRESS_DEADLINE = "Failed due to progress deadline"
+DESC_NEW_JOB_VERSION = "Cancelled because job is stopped or a newer version was posted"
+DESC_SUCCESSFUL = "Deployment completed successfully"
+DESC_RUNNING = "Deployment is running"
+DESC_RUNNING_NEEDS_PROMOTION = "Deployment is running but requires manual promotion"
+DESC_RUNNING_AUTO_PROMOTION = "Deployment is running pending automatic promotion"
+DESC_FAILED_ALLOCATIONS = "Failed due to unhealthy allocations"
+DESC_FAILED_BY_USER = "Deployment marked as failed"
+
+
+@dataclass
+class DeploymentState:
+    """Per-task-group deployment progress (structs.go DeploymentState)."""
+    auto_revert: bool = False
+    auto_promote: bool = False
+    promoted: bool = False
+    placed_canaries: List[str] = field(default_factory=list)
+    desired_canaries: int = 0
+    desired_total: int = 0
+    placed_allocs: int = 0
+    healthy_allocs: int = 0
+    unhealthy_allocs: int = 0
+    progress_deadline_s: float = 0.0
+    require_progress_by: float = 0.0   # unix seconds
+
+
+@dataclass
+class DeploymentStatusUpdate:
+    deployment_id: str = ""
+    status: str = ""
+    status_description: str = ""
+
+
+@dataclass
+class Deployment:
+    id: str = field(default_factory=generate_uuid)
+    namespace: str = "default"
+    job_id: str = ""
+    job_version: int = 0
+    job_modify_index: int = 0
+    job_spec_modify_index: int = 0
+    job_create_index: int = 0
+    is_multiregion: bool = False
+    task_groups: Dict[str, DeploymentState] = field(default_factory=dict)
+    status: str = DEPLOYMENT_STATUS_RUNNING
+    status_description: str = DESC_RUNNING
+    create_index: int = 0
+    modify_index: int = 0
+    create_time: int = 0
+    modify_time: int = 0
+
+    @classmethod
+    def from_job(cls, job) -> "Deployment":
+        d = cls(
+            namespace=job.namespace,
+            job_id=job.id,
+            job_version=job.version,
+            job_modify_index=job.modify_index,
+            job_spec_modify_index=job.job_modify_index,
+            job_create_index=job.create_index,
+        )
+        for tg in job.task_groups:
+            u = tg.update
+            if u is None:
+                continue
+            d.task_groups[tg.name] = DeploymentState(
+                auto_revert=u.auto_revert,
+                auto_promote=u.auto_promote,
+                desired_total=tg.count,
+                desired_canaries=u.canary,
+                progress_deadline_s=u.progress_deadline_s,
+            )
+        return d
+
+    def active(self) -> bool:
+        return self.status in (DEPLOYMENT_STATUS_RUNNING, DEPLOYMENT_STATUS_PAUSED)
+
+    def requires_promotion(self) -> bool:
+        return any(s.desired_canaries > 0 and not s.promoted
+                   for s in self.task_groups.values())
+
+    def has_auto_promote(self) -> bool:
+        states = self.task_groups.values()
+        return bool(states) and all(s.auto_promote for s in states)
+
+    def copy(self) -> "Deployment":
+        from ..utils.codec import to_wire, from_wire
+        return from_wire(Deployment, to_wire(self))

@@ -1,0 +1,60 @@
+"""Device instance accounting (reference: nomad/structs/devices.go
+DeviceAccounter) — tracks per-device-instance usage on a node for the
+oversubscription check in AllocsFit and the device allocator."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+
+class DeviceAccounter:
+    def __init__(self, node):
+        # (vendor, type, name) -> {instance_id: count}
+        self.devices: Dict[Tuple[str, str, str], Dict[str, int]] = {}
+        for group in node.node_resources.devices:
+            insts = {}
+            for inst in group.instances:
+                if inst.healthy:
+                    insts[inst.id] = 0
+            self.devices[group.id_tuple()] = insts
+
+    def add_allocs(self, allocs: List) -> bool:
+        """Account the allocs' device usage; True on oversubscription or
+        use of an unknown/collided instance."""
+        collision = False
+        for alloc in allocs:
+            if alloc.terminal_status():
+                continue
+            res = alloc.allocated_resources
+            if res is None:
+                continue
+            for task in res.tasks.values():
+                for dev in task.devices:
+                    insts = self.devices.get(dev.id_tuple())
+                    if insts is None:
+                        continue
+                    for inst_id in dev.device_ids:
+                        if inst_id not in insts:
+                            continue
+                        insts[inst_id] += 1
+                        if insts[inst_id] > 1:
+                            collision = True
+        return collision
+
+    def add_reserved(self, dev) -> bool:
+        """Mark an AllocatedDeviceResource as used; True on collision."""
+        collision = False
+        insts = self.devices.get(dev.id_tuple())
+        if insts is None:
+            return False
+        for inst_id in dev.device_ids:
+            if inst_id not in insts:
+                continue
+            insts[inst_id] += 1
+            if insts[inst_id] > 1:
+                collision = True
+        return collision
+
+    def free_instances(self, id_tuple) -> List[str]:
+        insts = self.devices.get(id_tuple, {})
+        return [i for i, c in insts.items() if c == 0]

@@ -1,0 +1,154 @@
+"""Evaluation — the unit of scheduling work.
+
+Reference semantics: nomad/structs/structs.go Evaluation:9928.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils.ids import generate_uuid
+from .alloc import AllocMetric
+
+EVAL_STATUS_PENDING = "pending"
+EVAL_STATUS_BLOCKED = "blocked"
+EVAL_STATUS_COMPLETE = "complete"
+EVAL_STATUS_FAILED = "failed"
+EVAL_STATUS_CANCELED = "canceled"
+
+TRIGGER_JOB_REGISTER = "job-register"
+TRIGGER_JOB_DEREGISTER = "job-deregister"
+TRIGGER_PERIODIC_JOB = "periodic-job"
+TRIGGER_NODE_DRAIN = "node-drain"
+TRIGGER_NODE_UPDATE = "node-update"
+TRIGGER_ALLOC_STOP = "alloc-stop"
+TRIGGER_SCHEDULED = "scheduled"
+TRIGGER_ROLLING_UPDATE = "rolling-update"
+TRIGGER_DEPLOYMENT_WATCHER = "deployment-watcher"
+TRIGGER_FAILED_FOLLOW_UP = "failed-follow-up"
+TRIGGER_MAX_PLANS = "max-plan-attempts"
+TRIGGER_ALLOC_FAILURE = "alloc-failure"
+TRIGGER_RETRY_FAILED_ALLOC = "alloc-failure"
+TRIGGER_QUEUED_ALLOCS = "queued-allocs"
+TRIGGER_PREEMPTION = "preemption"
+TRIGGER_JOB_SCALE = "job-scaling"
+
+CORE_JOB_EVAL_GC = "eval-gc"
+CORE_JOB_NODE_GC = "node-gc"
+CORE_JOB_JOB_GC = "job-gc"
+CORE_JOB_DEPLOYMENT_GC = "deployment-gc"
+CORE_JOB_CSI_VOLUME_CLAIM_GC = "csi-volume-claim-gc"
+
+
+@dataclass
+class Evaluation:
+    id: str = field(default_factory=generate_uuid)
+    namespace: str = "default"
+    priority: int = 50
+    type: str = "service"            # job type / scheduler type
+    triggered_by: str = ""
+    job_id: str = ""
+    job_modify_index: int = 0
+    node_id: str = ""
+    node_modify_index: int = 0
+    deployment_id: str = ""
+    status: str = EVAL_STATUS_PENDING
+    status_description: str = ""
+    wait_s: float = 0.0              # delay before processing (failed follow-up)
+    wait_until: float = 0.0          # unix seconds; delayed reschedule
+    next_eval: str = ""
+    previous_eval: str = ""
+    blocked_eval: str = ""
+    related_evals: List[str] = field(default_factory=list)
+    failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
+    class_eligibility: Dict[str, bool] = field(default_factory=dict)
+    escaped_computed_class: bool = False
+    quota_limit_reached: str = ""
+    annotate_plan: bool = False
+    queued_allocations: Dict[str, int] = field(default_factory=dict)
+    leader_acl: str = ""
+    snapshot_index: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+    create_time: int = 0
+    modify_time: int = 0
+
+    def terminal_status(self) -> bool:
+        return self.status in (EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED,
+                               EVAL_STATUS_CANCELED)
+
+    def should_enqueue(self) -> bool:
+        return self.status == EVAL_STATUS_PENDING
+
+    def should_block(self) -> bool:
+        return self.status == EVAL_STATUS_BLOCKED
+
+    def copy(self) -> "Evaluation":
+        from ..utils.codec import to_wire, from_wire
+        return from_wire(Evaluation, to_wire(self))
+
+    def make_plan(self, job):
+        from .plan import Plan
+        return Plan(
+            eval_id=self.id,
+            priority=self.priority if job is None else job.priority,
+            job=job,
+            all_at_once=False if job is None else job.all_at_once,
+        )
+
+    def next_rolling_eval(self, wait_s: float) -> "Evaluation":
+        """Create the eval for the next rolling-update batch
+        (structs.go Evaluation.NextRollingEval)."""
+        return Evaluation(
+            namespace=self.namespace,
+            priority=self.priority,
+            type=self.type,
+            triggered_by=TRIGGER_ROLLING_UPDATE,
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=EVAL_STATUS_PENDING,
+            wait_s=wait_s,
+            previous_eval=self.id,
+        )
+
+    def create_blocked_eval(self, class_eligibility: Dict[str, bool],
+                            escaped: bool, quota_reached: str) -> "Evaluation":
+        """structs.go Evaluation.CreateBlockedEval."""
+        return Evaluation(
+            namespace=self.namespace,
+            priority=self.priority,
+            type=self.type,
+            triggered_by=TRIGGER_QUEUED_ALLOCS,
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=EVAL_STATUS_BLOCKED,
+            previous_eval=self.id,
+            class_eligibility=class_eligibility,
+            escaped_computed_class=escaped,
+            quota_limit_reached=quota_reached,
+        )
+
+    def create_failed_follow_up_eval(self, wait_s: float) -> "Evaluation":
+        """structs.go Evaluation.CreateFailedFollowUpEval."""
+        return Evaluation(
+            namespace=self.namespace,
+            priority=self.priority,
+            type=self.type,
+            triggered_by=TRIGGER_FAILED_FOLLOW_UP,
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=EVAL_STATUS_PENDING,
+            wait_s=wait_s,
+            previous_eval=self.id,
+        )
+
+    def stub(self) -> dict:
+        return {
+            "id": self.id, "priority": self.priority, "type": self.type,
+            "triggered_by": self.triggered_by, "job_id": self.job_id,
+            "node_id": self.node_id, "deployment_id": self.deployment_id,
+            "status": self.status, "previous_eval": self.previous_eval,
+            "next_eval": self.next_eval, "blocked_eval": self.blocked_eval,
+            "create_index": self.create_index, "modify_index": self.modify_index,
+        }

@@ -1,0 +1,89 @@
+"""Resource fit and scoring functions — the scalar golden reference for
+the TPU kernels in nomad_tpu/ops/.
+
+Reference semantics: nomad/structs/funcs.go — AllocsFit:102,
+ScoreFitBinPack:174 (BestFit v3: score = 20 - 10^freeCpuPct - 10^freeMemPct,
+clamped to [0,18]), ScoreFitSpread:201 (worst fit: 10^fc + 10^fm - 2).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Tuple
+
+from .networks import NetworkIndex
+from .resources import ComparableResources
+
+
+def FilterTerminalAllocs(allocs: List) -> Tuple[List, dict]:
+    """Remove terminal allocs; also return latest terminal alloc by name
+    (structs.go FilterTerminalAllocs)."""
+    terminal = {}
+    live = []
+    for alloc in allocs:
+        if alloc.terminal_status():
+            prev = terminal.get(alloc.name)
+            if prev is None or alloc.create_index > prev.create_index:
+                terminal[alloc.name] = alloc
+        else:
+            live.append(alloc)
+    return live, terminal
+
+
+def AllocsFit(node, allocs: List, net_idx: Optional[NetworkIndex] = None,
+              check_devices: bool = False) -> Tuple[bool, str, ComparableResources]:
+    """Do these allocs (live only) fit on the node? Returns
+    (fit, failing_dimension, used)."""
+    used = ComparableResources()
+    for alloc in allocs:
+        if alloc.terminal_status():
+            continue
+        used.add(alloc.comparable_resources())
+
+    available = node.comparable_resources()
+    available.subtract(node.comparable_reserved_resources())
+    ok, dim = available.superset(used)
+    if not ok:
+        return False, dim, used
+
+    if net_idx is None:
+        net_idx = NetworkIndex()
+        if net_idx.set_node(node) or net_idx.add_allocs(allocs):
+            return False, "reserved port collision", used
+
+    if net_idx.overcommitted():
+        return False, "bandwidth exceeded", used
+
+    if check_devices:
+        from .device_accounting import DeviceAccounter
+        acct = DeviceAccounter(node)
+        if acct.add_allocs(allocs):
+            return False, "device oversubscribed", used
+
+    return True, "", used
+
+
+def _free_percentages(node, util: ComparableResources) -> Tuple[float, float]:
+    res = node.comparable_resources()
+    reserved = node.comparable_reserved_resources()
+    node_cpu = float(res.cpu_shares) - float(reserved.cpu_shares)
+    node_mem = float(res.memory_mb) - float(reserved.memory_mb)
+    free_cpu = 1.0 - (float(util.cpu_shares) / node_cpu) if node_cpu else 0.0
+    free_mem = 1.0 - (float(util.memory_mb) / node_mem) if node_mem else 0.0
+    return free_cpu, free_mem
+
+
+def ScoreFitBinPack(node, util: ComparableResources) -> float:
+    """BestFit v3: prefer nodes that end up fuller. Score in [0, 18]."""
+    free_cpu, free_mem = _free_percentages(node, util)
+    total = math.pow(10, free_cpu) + math.pow(10, free_mem)
+    score = 20.0 - total
+    return max(0.0, min(18.0, score))
+
+
+def ScoreFitSpread(node, util: ComparableResources) -> float:
+    """Worst fit: prefer nodes that end up emptier. Score in [0, 18]."""
+    free_cpu, free_mem = _free_percentages(node, util)
+    total = math.pow(10, free_cpu) + math.pow(10, free_mem)
+    score = total - 2.0
+    return max(0.0, min(18.0, score))

@@ -1,0 +1,542 @@
+"""Job / TaskGroup / Task and the placement-shaping stanzas.
+
+Reference semantics: nomad/structs/structs.go — Job:3805, TaskGroup:5780,
+Task:6491, Constraint:8023, Affinity:8145, Spread:8233 — plus the
+canonicalize/validate behaviors the schedulers depend on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils.ids import generate_uuid
+from .resources import Resources
+from .networks import NetworkResource
+
+# Job types (structs.go JobTypeService etc.)
+JOB_TYPE_SERVICE = "service"
+JOB_TYPE_BATCH = "batch"
+JOB_TYPE_SYSTEM = "system"
+JOB_TYPE_CORE = "_core"
+
+JOB_STATUS_PENDING = "pending"
+JOB_STATUS_RUNNING = "running"
+JOB_STATUS_DEAD = "dead"
+
+JOB_MIN_PRIORITY = 1
+JOB_DEFAULT_PRIORITY = 50
+JOB_MAX_PRIORITY = 100
+
+DEFAULT_NAMESPACE = "default"
+
+# Constraint operands (structs.go:8010-8019)
+CONSTRAINT_DISTINCT_PROPERTY = "distinct_property"
+CONSTRAINT_DISTINCT_HOSTS = "distinct_hosts"
+CONSTRAINT_REGEX = "regexp"
+CONSTRAINT_VERSION = "version"
+CONSTRAINT_SEMVER = "semver"
+CONSTRAINT_SET_CONTAINS = "set_contains"
+CONSTRAINT_SET_CONTAINS_ALL = "set_contains_all"
+CONSTRAINT_SET_CONTAINS_ANY = "set_contains_any"
+CONSTRAINT_IS_SET = "is_set"
+CONSTRAINT_IS_NOT_SET = "is_not_set"
+
+COMPARISON_OPERANDS = ("=", "==", "is", "!=", "not", "<", "<=", ">", ">=")
+
+
+@dataclass
+class Constraint:
+    ltarget: str = ""    # left-hand target, e.g. "${attr.kernel.name}"
+    rtarget: str = ""
+    operand: str = "="
+
+    def validate(self) -> List[str]:
+        errs = []
+        if not self.operand:
+            errs.append("missing constraint operand")
+        req_rtarget = self.operand not in (
+            CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_IS_SET, CONSTRAINT_IS_NOT_SET)
+        if req_rtarget and self.rtarget == "":
+            errs.append(f"operand {self.operand} requires an RTarget")
+        req_ltarget = self.operand != CONSTRAINT_DISTINCT_HOSTS
+        if req_ltarget and self.ltarget == "":
+            errs.append(f"no LTarget provided but is required by constraint")
+        return errs
+
+    def key(self):
+        return (self.ltarget, self.rtarget, self.operand)
+
+    def __str__(self):
+        return f"{self.ltarget} {self.operand} {self.rtarget}"
+
+
+@dataclass
+class Affinity:
+    ltarget: str = ""
+    rtarget: str = ""
+    operand: str = "="
+    weight: int = 50     # [-100, 100], negative == anti-affinity
+
+    def validate(self) -> List[str]:
+        errs = []
+        if not self.operand:
+            errs.append("missing affinity operand")
+        if self.weight > 100 or self.weight < -100:
+            errs.append("affinity weight must be within the range [-100,100]")
+        if self.weight == 0:
+            errs.append("affinity weight cannot be zero")
+        return errs
+
+    def key(self):
+        return (self.ltarget, self.rtarget, self.operand, self.weight)
+
+
+@dataclass
+class SpreadTarget:
+    value: str = ""
+    percent: int = 0
+
+
+@dataclass
+class Spread:
+    attribute: str = ""
+    weight: int = 50     # (0, 100]
+    spread_target: List[SpreadTarget] = field(default_factory=list)
+
+    def validate(self) -> List[str]:
+        errs = []
+        if not self.attribute:
+            errs.append("missing spread attribute")
+        if self.weight <= 0 or self.weight > 100:
+            errs.append("spread stanza must have a positive weight from 0 to 100")
+        seen = set()
+        total = 0
+        for t in self.spread_target:
+            if t.value in seen:
+                errs.append(f"spread target value {t.value} already defined")
+            seen.add(t.value)
+            if t.percent < 0 or t.percent > 100:
+                errs.append(f"spread target percentage for value {t.value} must be between 0 and 100")
+            total += t.percent
+        if total > 100:
+            errs.append(f"sum of spread target percentages must not be greater than 100, got {total}")
+        return errs
+
+
+@dataclass
+class RestartPolicy:
+    """Client-local restart policy (structs.go RestartPolicy)."""
+    attempts: int = 2
+    interval_s: float = 30 * 60.0
+    delay_s: float = 15.0
+    mode: str = "fail"   # "delay" | "fail"
+
+
+@dataclass
+class ReschedulePolicy:
+    """Server-side rescheduling policy (structs.go ReschedulePolicy)."""
+    attempts: int = 0
+    interval_s: float = 0.0
+    delay_s: float = 30.0
+    delay_function: str = "exponential"   # "constant" | "exponential" | "fibonacci"
+    max_delay_s: float = 3600.0
+    unlimited: bool = True
+
+    def enabled(self) -> bool:
+        return self.unlimited or (self.attempts > 0 and self.interval_s > 0)
+
+    def validate(self) -> List[str]:
+        errs = []
+        if self.delay_function not in ("constant", "exponential", "fibonacci"):
+            errs.append(f"invalid delay function {self.delay_function}")
+        if not self.unlimited:
+            if self.attempts < 0:
+                errs.append("attempts must be >= 0")
+        return errs
+
+
+def default_service_reschedule_policy() -> ReschedulePolicy:
+    return ReschedulePolicy(delay_s=30.0, delay_function="exponential",
+                            max_delay_s=3600.0, unlimited=True)
+
+
+def default_batch_reschedule_policy() -> ReschedulePolicy:
+    return ReschedulePolicy(attempts=1, interval_s=24 * 3600.0, delay_s=5.0,
+                            delay_function="constant", unlimited=False)
+
+
+@dataclass
+class EphemeralDisk:
+    sticky: bool = False
+    size_mb: int = 300
+    migrate: bool = False
+
+
+@dataclass
+class UpdateStrategy:
+    """Rolling update strategy (structs.go UpdateStrategy)."""
+    stagger_s: float = 30.0
+    max_parallel: int = 1
+    health_check: str = "checks"   # "checks" | "task_states" | "manual"
+    min_healthy_time_s: float = 10.0
+    healthy_deadline_s: float = 300.0
+    progress_deadline_s: float = 600.0
+    auto_revert: bool = False
+    auto_promote: bool = False
+    canary: int = 0
+
+    def rolling(self) -> bool:
+        return self.stagger_s > 0 and self.max_parallel > 0
+
+
+@dataclass
+class MigrateStrategy:
+    max_parallel: int = 1
+    health_check: str = "checks"
+    min_healthy_time_s: float = 10.0
+    healthy_deadline_s: float = 300.0
+
+
+@dataclass
+class PeriodicConfig:
+    enabled: bool = False
+    spec: str = ""             # cron expression
+    spec_type: str = "cron"
+    prohibit_overlap: bool = False
+    timezone: str = "UTC"
+
+
+@dataclass
+class ParameterizedJobConfig:
+    payload: str = "optional"  # "optional" | "required" | "forbidden"
+    meta_required: List[str] = field(default_factory=list)
+    meta_optional: List[str] = field(default_factory=list)
+
+
+@dataclass
+class DispatchPayloadConfig:
+    file: str = ""
+
+
+@dataclass
+class TaskLifecycleConfig:
+    hook: str = ""         # "prestart" | "poststart" | "poststop"
+    sidecar: bool = False
+
+
+@dataclass
+class LogConfig:
+    max_files: int = 10
+    max_file_size_mb: int = 10
+
+
+@dataclass
+class ServiceCheck:
+    name: str = ""
+    type: str = ""          # http | tcp | script | grpc
+    path: str = ""
+    interval_s: float = 10.0
+    timeout_s: float = 2.0
+    port_label: str = ""
+
+
+@dataclass
+class Service:
+    name: str = ""
+    port_label: str = ""
+    tags: List[str] = field(default_factory=list)
+    checks: List[ServiceCheck] = field(default_factory=list)
+    address_mode: str = "auto"
+
+
+@dataclass
+class Template:
+    source_path: str = ""
+    dest_path: str = ""
+    embedded_tmpl: str = ""
+    change_mode: str = "restart"
+    change_signal: str = ""
+
+
+@dataclass
+class TaskArtifact:
+    getter_source: str = ""
+    getter_options: Dict[str, str] = field(default_factory=dict)
+    relative_dest: str = ""
+
+
+@dataclass
+class VaultConfig:
+    policies: List[str] = field(default_factory=list)
+    change_mode: str = "restart"
+    change_signal: str = ""
+    env: bool = True
+
+
+@dataclass
+class VolumeRequest:
+    name: str = ""
+    type: str = ""          # "host" | "csi"
+    source: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class VolumeMount:
+    volume: str = ""
+    destination: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class Task:
+    """One process to run (structs.go Task:6491)."""
+    name: str = ""
+    driver: str = ""
+    user: str = ""
+    config: Dict[str, object] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    services: List[Service] = field(default_factory=list)
+    vault: Optional[VaultConfig] = None
+    templates: List[Template] = field(default_factory=list)
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    resources: Resources = field(default_factory=Resources)
+    meta: Dict[str, str] = field(default_factory=dict)
+    kill_timeout_s: float = 5.0
+    log_config: LogConfig = field(default_factory=LogConfig)
+    artifacts: List[TaskArtifact] = field(default_factory=list)
+    leader: bool = False
+    shutdown_delay_s: float = 0.0
+    volume_mounts: List[VolumeMount] = field(default_factory=list)
+    kill_signal: str = ""
+    lifecycle: Optional[TaskLifecycleConfig] = None
+    dispatch_payload: Optional[DispatchPayloadConfig] = None
+
+    def canonicalize(self, job: "Job", tg: "TaskGroup") -> None:
+        if self.resources is None:
+            self.resources = Resources()
+        self.resources.canonicalize()
+
+    def validate(self) -> List[str]:
+        errs = []
+        if not self.name:
+            errs.append("missing task name")
+        elif any(c in self.name for c in "/\\"):
+            errs.append(f"task name {self.name} cannot include slashes")
+        if not self.driver:
+            errs.append("missing task driver")
+        if self.kill_timeout_s < 0:
+            errs.append("kill timeout cannot be negative")
+        errs.extend(self.resources.validate())
+        for c in self.constraints:
+            if c.operand in (CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY):
+                errs.append(f"task level: {c.operand} constraint not allowed")
+            errs.extend(c.validate())
+        for a in self.affinities:
+            errs.extend(a.validate())
+        return errs
+
+    def is_prestart(self) -> bool:
+        return self.lifecycle is not None and self.lifecycle.hook == "prestart"
+
+
+@dataclass
+class Scaling:
+    enabled: bool = True
+    min: int = 0
+    max: int = 0
+    policy: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class TaskGroup:
+    """A co-scheduled set of tasks (structs.go TaskGroup:5780)."""
+    name: str = ""
+    count: int = 1
+    update: Optional[UpdateStrategy] = None
+    migrate: Optional[MigrateStrategy] = None
+    constraints: List[Constraint] = field(default_factory=list)
+    scaling: Optional[Scaling] = None
+    restart_policy: Optional[RestartPolicy] = None
+    reschedule_policy: Optional[ReschedulePolicy] = None
+    affinities: List[Affinity] = field(default_factory=list)
+    spreads: List[Spread] = field(default_factory=list)
+    networks: List[NetworkResource] = field(default_factory=list)
+    tasks: List[Task] = field(default_factory=list)
+    ephemeral_disk: EphemeralDisk = field(default_factory=EphemeralDisk)
+    meta: Dict[str, str] = field(default_factory=dict)
+    stop_after_client_disconnect_s: Optional[float] = None
+    volumes: Dict[str, VolumeRequest] = field(default_factory=dict)
+    shutdown_delay_s: float = 0.0
+    services: List[Service] = field(default_factory=list)
+
+    def canonicalize(self, job: "Job") -> None:
+        if self.restart_policy is None:
+            self.restart_policy = RestartPolicy()
+        if self.reschedule_policy is None:
+            if job.type == JOB_TYPE_BATCH:
+                self.reschedule_policy = default_batch_reschedule_policy()
+            elif job.type == JOB_TYPE_SERVICE:
+                self.reschedule_policy = default_service_reschedule_policy()
+            else:
+                self.reschedule_policy = ReschedulePolicy(
+                    attempts=0, interval_s=0, unlimited=False)
+        if self.ephemeral_disk is None:
+            self.ephemeral_disk = EphemeralDisk()
+        if self.update is None and job.type in (JOB_TYPE_SERVICE,):
+            self.update = UpdateStrategy()
+        for t in self.tasks:
+            t.canonicalize(job, self)
+
+    def validate(self, job: "Job") -> List[str]:
+        errs = []
+        if not self.name:
+            errs.append("missing task group name")
+        if self.count < 0:
+            errs.append("task group count can't be negative")
+        if not self.tasks:
+            errs.append(f"task group {self.name} missing tasks")
+        names = set()
+        for t in self.tasks:
+            if t.name in names:
+                errs.append(f"task {t.name} defined multiple times")
+            names.add(t.name)
+            errs.extend(f"task {t.name}: {e}" for e in t.validate())
+        for c in self.constraints:
+            errs.extend(c.validate())
+        for s in self.spreads:
+            errs.extend(s.validate())
+        for a in self.affinities:
+            errs.extend(a.validate())
+        return errs
+
+    def lookup_task(self, name: str) -> Optional[Task]:
+        for t in self.tasks:
+            if t.name == name:
+                return t
+        return None
+
+
+@dataclass
+class Multiregion:
+    strategy: Optional[dict] = None
+    regions: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class Job:
+    """The unit of submission (structs.go Job:3805)."""
+    id: str = ""
+    name: str = ""
+    namespace: str = DEFAULT_NAMESPACE
+    region: str = "global"
+    type: str = JOB_TYPE_SERVICE
+    priority: int = JOB_DEFAULT_PRIORITY
+    all_at_once: bool = False
+    datacenters: List[str] = field(default_factory=list)
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    spreads: List[Spread] = field(default_factory=list)
+    task_groups: List[TaskGroup] = field(default_factory=list)
+    update: Optional[UpdateStrategy] = None
+    multiregion: Optional[Multiregion] = None
+    periodic: Optional[PeriodicConfig] = None
+    parameterized_job: Optional[ParameterizedJobConfig] = None
+    dispatched: bool = False
+    payload: bytes = b""
+    meta: Dict[str, str] = field(default_factory=dict)
+    consul_token: str = ""
+    vault_token: str = ""
+    stop: bool = False
+    parent_id: str = ""
+    stable: bool = False
+    version: int = 0
+    status: str = JOB_STATUS_PENDING
+    status_description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+    job_modify_index: int = 0
+    submit_time: int = 0
+
+    # -- lifecycle -----------------------------------------------------
+    def canonicalize(self) -> None:
+        if not self.id:
+            self.id = generate_uuid()
+        if not self.name:
+            self.name = self.id
+        if not self.namespace:
+            self.namespace = DEFAULT_NAMESPACE
+        if self.priority == 0:
+            self.priority = JOB_DEFAULT_PRIORITY
+        for tg in self.task_groups:
+            tg.canonicalize(self)
+
+    def validate(self) -> List[str]:
+        errs = []
+        if not self.id:
+            errs.append("missing job ID")
+        elif " " in self.id:
+            errs.append("job ID contains a space")
+        if not self.name:
+            errs.append("missing job name")
+        if self.type not in (JOB_TYPE_SERVICE, JOB_TYPE_BATCH, JOB_TYPE_SYSTEM, JOB_TYPE_CORE):
+            errs.append(f"invalid job type: {self.type}")
+        if self.priority < JOB_MIN_PRIORITY or self.priority > JOB_MAX_PRIORITY:
+            errs.append(f"job priority must be between [{JOB_MIN_PRIORITY}, {JOB_MAX_PRIORITY}]")
+        if not self.datacenters:
+            errs.append("missing job datacenters")
+        if not self.task_groups:
+            errs.append("missing job task groups")
+        names = set()
+        for tg in self.task_groups:
+            if tg.name in names:
+                errs.append(f"job task group {tg.name} defined multiple times")
+            names.add(tg.name)
+            errs.extend(tg.validate(self))
+        for c in self.constraints:
+            errs.extend(c.validate())
+        for s in self.spreads:
+            errs.extend(s.validate())
+        if self.type == JOB_TYPE_SYSTEM:
+            if self.affinities:
+                errs.append("system jobs may not have an affinity stanza")
+            if self.spreads:
+                errs.append("system jobs may not have a spread stanza")
+        return errs
+
+    # -- queries -------------------------------------------------------
+    def namespaced_id(self):
+        return (self.namespace, self.id)
+
+    def lookup_task_group(self, name: str) -> Optional[TaskGroup]:
+        for tg in self.task_groups:
+            if tg.name == name:
+                return tg
+        return None
+
+    def stopped(self) -> bool:
+        return self.stop
+
+    def is_periodic(self) -> bool:
+        return self.periodic is not None and self.periodic.enabled
+
+    def is_parameterized(self) -> bool:
+        return self.parameterized_job is not None and not self.dispatched
+
+    def copy(self) -> "Job":
+        # deep copy via the wire codec: cheap and always in sync with fields
+        from ..utils.codec import to_wire, from_wire
+        return from_wire(Job, to_wire(self))
+
+    def specchanged(self, other: "Job") -> bool:
+        """Whether non-bookkeeping spec fields differ (structs.go Job.SpecChanged)."""
+        from ..utils.codec import to_wire
+        a, b = to_wire(self), to_wire(other)
+        for skip in ("status", "status_description", "stable", "version",
+                     "create_index", "modify_index", "job_modify_index",
+                     "submit_time"):
+            a.pop(skip, None)
+            b.pop(skip, None)
+        return a != b

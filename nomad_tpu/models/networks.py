@@ -1,0 +1,278 @@
+"""Network resources and the port-accounting index.
+
+Reference semantics: nomad/structs/network.go (NetworkIndex:35,
+AssignPorts:316, AssignNetwork:406). Port bitmaps here are Python
+arbitrary-precision ints (bit i set == port i used), which gives the
+same set/check/popcount semantics as the reference's Bitmap with far
+less code. Dynamic port selection probes randomly up to 20 attempts
+then falls back to a linear scan, matching the reference's
+stochastic-then-precise strategy.
+
+TPU note: on-device feasibility only needs per-node *free dynamic port
+counts* and reserved-port conflict bits (precomputed host-side into the
+NodeTable); actual port number assignment runs host-side for the single
+chosen node after the kernel's argmax (SURVEY.md §7.3 item 1).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+MIN_DYNAMIC_PORT = 20000
+MAX_DYNAMIC_PORT = 32000
+MAX_VALID_PORT = 65536
+_MAX_RAND_ATTEMPTS = 20
+
+
+@dataclass
+class Port:
+    label: str = ""
+    value: int = 0          # host port (0 == dynamic, to be assigned)
+    to: int = 0             # container-side mapped port (-1 == same as value)
+    host_network: str = "default"
+
+
+@dataclass
+class DNSConfig:
+    servers: List[str] = field(default_factory=list)
+    searches: List[str] = field(default_factory=list)
+    options: List[str] = field(default_factory=list)
+
+
+@dataclass
+class NetworkResource:
+    """One network ask/grant (structs.go NetworkResource)."""
+    mode: str = ""          # "", "host", "bridge", "none"
+    device: str = ""
+    cidr: str = ""
+    ip: str = ""
+    mbits: int = 0
+    dns: Optional[DNSConfig] = None
+    reserved_ports: List[Port] = field(default_factory=list)
+    dynamic_ports: List[Port] = field(default_factory=list)
+
+    def canonicalize(self) -> None:
+        if not self.mode:
+            self.mode = "host"
+
+    def copy(self) -> "NetworkResource":
+        return NetworkResource(
+            mode=self.mode, device=self.device, cidr=self.cidr, ip=self.ip,
+            mbits=self.mbits, dns=self.dns,
+            reserved_ports=[Port(p.label, p.value, p.to, p.host_network)
+                            for p in self.reserved_ports],
+            dynamic_ports=[Port(p.label, p.value, p.to, p.host_network)
+                           for p in self.dynamic_ports],
+        )
+
+    def port_labels(self) -> Dict[str, int]:
+        return {p.label: p.value
+                for p in list(self.reserved_ports) + list(self.dynamic_ports)}
+
+
+@dataclass
+class AllocatedPortMapping:
+    label: str = ""
+    value: int = 0
+    to: int = 0
+    host_ip: str = ""
+
+
+def parse_port_ranges(spec: str) -> List[int]:
+    """Parse "80,100-200,205" -> sorted port list (helper/parse_port_ranges)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            lo_i, hi_i = int(lo), int(hi)
+            if lo_i > hi_i:
+                raise ValueError(f"invalid port range {part}")
+            out.extend(range(lo_i, hi_i + 1))
+        else:
+            out.append(int(part))
+    for p in out:
+        if p < 0 or p >= MAX_VALID_PORT:
+            raise ValueError(f"port must be < {MAX_VALID_PORT} but found {p}")
+    return sorted(set(out))
+
+
+class NetworkIndex:
+    """Indexes available networks + used ports on one node.
+
+    Mirrors structs.NetworkIndex behavior: SetNode/AddAllocs return True
+    on collision; AssignNetwork satisfies an ask with an offer.
+    """
+
+    def __init__(self, rng: Optional[random.Random] = None):
+        self.avail_networks: List[NetworkResource] = []
+        self.avail_bandwidth: Dict[str, int] = {}
+        self.used_ports: Dict[str, int] = {}   # ip -> int bitset
+        self.used_bandwidth: Dict[str, int] = {}
+        self._rng = rng or random
+
+    # -- building ------------------------------------------------------
+    def set_node(self, node) -> bool:
+        collide = False
+        networks = node.node_resources.networks if node.node_resources else []
+        for n in networks:
+            if n.device:
+                self.avail_networks.append(n)
+                self.avail_bandwidth[n.device] = n.mbits
+        reserved = node.reserved_resources
+        if reserved and reserved.reserved_host_ports:
+            if self._add_reserved_port_range(reserved.reserved_host_ports):
+                collide = True
+        return collide
+
+    def add_allocs(self, allocs) -> bool:
+        collide = False
+        for alloc in allocs:
+            if alloc.terminal_status():
+                continue
+            res = alloc.allocated_resources
+            if res is None:
+                continue
+            for network in res.shared.networks:
+                if self.add_reserved(network):
+                    collide = True
+            for task in res.tasks.values():
+                if task.networks:
+                    if self.add_reserved(task.networks[0]):
+                        collide = True
+        return collide
+
+    def add_reserved(self, n: NetworkResource) -> bool:
+        collide = False
+        for ports in (n.reserved_ports, n.dynamic_ports):
+            for port in ports:
+                if port.value < 0 or port.value >= MAX_VALID_PORT:
+                    return True
+                bit = 1 << port.value
+                if self.used_ports.get(n.ip, 0) & bit:
+                    collide = True
+                else:
+                    # write through immediately so valid marks survive an
+                    # early return on a later invalid port (the reference
+                    # mutates the shared bitmap in place)
+                    self.used_ports[n.ip] = self.used_ports.get(n.ip, 0) | bit
+        self.used_bandwidth[n.device] = self.used_bandwidth.get(n.device, 0) + n.mbits
+        return collide
+
+    def _add_reserved_port_range(self, ports: str) -> bool:
+        try:
+            res_ports = parse_port_ranges(ports)
+        except ValueError:
+            return False
+        collide = False
+        for n in self.avail_networks:
+            self.used_ports.setdefault(n.ip, 0)
+        for ip in list(self.used_ports):
+            used = self.used_ports[ip]
+            for port in res_ports:
+                bit = 1 << port
+                if used & bit:
+                    collide = True
+                else:
+                    used |= bit
+            self.used_ports[ip] = used
+        return collide
+
+    def overcommitted(self) -> bool:
+        return False  # bandwidth deprecated in reference too
+
+    # -- assignment ----------------------------------------------------
+    def assign_network(self, ask: NetworkResource) -> Tuple[Optional[NetworkResource], str]:
+        """Satisfy an ask; returns (offer, "") or (None, reason)."""
+        err = "no networks available"
+        for n in self.avail_networks:
+            ip = n.ip or (n.cidr.split("/")[0] if n.cidr else "")
+            if not ip:
+                continue
+            avail_bw = self.avail_bandwidth.get(n.device, 0)
+            used_bw = self.used_bandwidth.get(n.device, 0)
+            if used_bw + ask.mbits > avail_bw:
+                err = "bandwidth exceeded"
+                continue
+            used = self.used_ports.get(ip, 0)
+            collision = False
+            for port in ask.reserved_ports:
+                if port.value < 0 or port.value >= MAX_VALID_PORT:
+                    return None, f"invalid port {port.value} (out of range)"
+                if used & (1 << port.value):
+                    err = f"reserved port collision {port.label}={port.value}"
+                    collision = True
+                    break
+            if collision:
+                continue
+            dyn_ports, dyn_err = self._pick_dynamic_ports(
+                used, ask.reserved_ports, len(ask.dynamic_ports))
+            if dyn_err:
+                err = dyn_err
+                continue
+            offer = NetworkResource(
+                mode=ask.mode, device=n.device, ip=ip, mbits=ask.mbits,
+                dns=ask.dns,
+                reserved_ports=[Port(p.label, p.value, p.to, p.host_network)
+                                for p in ask.reserved_ports],
+                dynamic_ports=[Port(p.label, p.value, p.to, p.host_network)
+                               for p in ask.dynamic_ports],
+            )
+            for i, port in enumerate(dyn_ports):
+                offer.dynamic_ports[i].value = port
+                if offer.dynamic_ports[i].to == -1:
+                    offer.dynamic_ports[i].to = port
+            return offer, ""
+        return None, err
+
+    def _pick_dynamic_ports(self, used: int, reserved: List[Port],
+                            count: int) -> Tuple[List[int], str]:
+        if count == 0:
+            return [], ""
+        res_bits = 0
+        for p in reserved:
+            res_bits |= 1 << p.value
+        blocked = used | res_bits
+        # stochastic probe (reference getDynamicPortsStochastic)
+        picked: List[int] = []
+        picked_bits = 0
+        ok = True
+        for _ in range(count):
+            found = False
+            for _ in range(_MAX_RAND_ATTEMPTS):
+                port = self._rng.randint(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT)
+                bit = 1 << port
+                if not ((blocked | picked_bits) & bit):
+                    picked.append(port)
+                    picked_bits |= bit
+                    found = True
+                    break
+            if not found:
+                ok = False
+                break
+        if ok:
+            return picked, ""
+        # precise linear scan (reference getDynamicPortsPrecise)
+        picked = []
+        for port in range(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT + 1):
+            if not (blocked & (1 << port)):
+                picked.append(port)
+                if len(picked) == count:
+                    return picked, ""
+        return [], "dynamic port selection failed"
+
+    # -- tensorization support ----------------------------------------
+    def free_dynamic_port_count(self, ip: str = "") -> int:
+        """Free ports in the dynamic range for the NodeTable column."""
+        if not ip:
+            if not self.avail_networks:
+                return MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+            ip = self.avail_networks[0].ip
+        used = self.used_ports.get(ip, 0)
+        span = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+        mask = ((1 << span) - 1) << MIN_DYNAMIC_PORT
+        return span - (used & mask).bit_count()

@@ -1,0 +1,132 @@
+"""Node (client machine) model.
+
+Reference semantics: nomad/structs/structs.go Node:1761 and
+nomad/structs/node_class.go (ComputedClass — the feasibility
+memoization key).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .resources import NodeResources, NodeReservedResources
+
+NODE_STATUS_INIT = "initializing"
+NODE_STATUS_READY = "ready"
+NODE_STATUS_DOWN = "down"
+
+NODE_SCHED_ELIGIBLE = "eligible"
+NODE_SCHED_INELIGIBLE = "ineligible"
+
+
+@dataclass
+class DrainSpec:
+    deadline_s: float = 0.0
+    ignore_system_jobs: bool = False
+
+
+@dataclass
+class DrainStrategy:
+    drain_spec: DrainSpec = field(default_factory=DrainSpec)
+    force_deadline: float = 0.0   # unix seconds; 0 == no deadline
+
+
+@dataclass
+class DriverInfo:
+    """Fingerprinted driver state on a node (structs.go DriverInfo)."""
+    attributes: Dict[str, str] = field(default_factory=dict)
+    detected: bool = False
+    healthy: bool = False
+    health_description: str = ""
+    update_time: int = 0
+
+
+@dataclass
+class NodeEvent:
+    message: str = ""
+    subsystem: str = ""
+    details: Dict[str, str] = field(default_factory=dict)
+    timestamp: int = 0
+
+
+# Attributes that are node-unique and therefore excluded from the
+# computed class hash (node_class.go EscapedConstraints analog).
+_UNIQUE_ATTR_PREFIX = "unique."
+
+
+@dataclass
+class Node:
+    id: str = ""
+    secret_id: str = ""
+    datacenter: str = "dc1"
+    name: str = ""
+    http_addr: str = ""
+    attributes: Dict[str, str] = field(default_factory=dict)
+    node_resources: NodeResources = field(default_factory=NodeResources)
+    reserved_resources: NodeReservedResources = field(default_factory=NodeReservedResources)
+    links: Dict[str, str] = field(default_factory=dict)
+    meta: Dict[str, str] = field(default_factory=dict)
+    node_class: str = ""
+    computed_class: str = ""
+    drain: bool = False
+    drain_strategy: Optional[DrainStrategy] = None
+    scheduling_eligibility: str = NODE_SCHED_ELIGIBLE
+    status: str = NODE_STATUS_INIT
+    status_description: str = ""
+    status_updated_at: int = 0
+    events: List[NodeEvent] = field(default_factory=list)
+    drivers: Dict[str, DriverInfo] = field(default_factory=dict)
+    host_volumes: Dict[str, dict] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+    def ready(self) -> bool:
+        return (self.status == NODE_STATUS_READY
+                and self.scheduling_eligibility == NODE_SCHED_ELIGIBLE)
+
+    def canonicalize(self) -> None:
+        if self.scheduling_eligibility == "":
+            self.scheduling_eligibility = (
+                NODE_SCHED_INELIGIBLE if self.drain else NODE_SCHED_ELIGIBLE)
+
+    def compute_class(self) -> None:
+        """Hash of non-unique attributes -> memoization key for feasibility
+        (node_class.go ComputeClass). Unique attrs (node id, name, ips,
+        "unique."-prefixed attributes/meta) are excluded so identical
+        machines share a class."""
+        h = hashlib.sha256()
+        payload = {
+            "datacenter": self.datacenter,
+            "node_class": self.node_class,
+            "attributes": {k: v for k, v in sorted(self.attributes.items())
+                           if not k.startswith(_UNIQUE_ATTR_PREFIX)},
+            "meta": {k: v for k, v in sorted(self.meta.items())
+                     if not k.startswith(_UNIQUE_ATTR_PREFIX)},
+            "drivers": sorted(d for d, info in self.drivers.items() if info.detected),
+        }
+        h.update(json.dumps(payload, sort_keys=True).encode())
+        self.computed_class = "v1:" + h.hexdigest()[:16]
+
+    def comparable_resources(self):
+        return self.node_resources.comparable()
+
+    def comparable_reserved_resources(self):
+        return self.reserved_resources.comparable()
+
+    def terminal_status(self) -> bool:
+        return self.status == NODE_STATUS_DOWN
+
+    def copy(self) -> "Node":
+        from ..utils.codec import to_wire, from_wire
+        return from_wire(Node, to_wire(self))
+
+    def stub(self) -> dict:
+        return {
+            "id": self.id, "datacenter": self.datacenter, "name": self.name,
+            "node_class": self.node_class, "drain": self.drain,
+            "scheduling_eligibility": self.scheduling_eligibility,
+            "status": self.status, "modify_index": self.modify_index,
+        }

@@ -1,0 +1,129 @@
+"""Plan — the output of a scheduler run, applied by the plan applier.
+
+Reference semantics: nomad/structs/structs.go Plan:10221, PlanResult:10404.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .alloc import (Allocation, ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT,
+                    ALLOC_CLIENT_LOST, ALLOC_CLIENT_FAILED)
+from .job import Job
+
+
+@dataclass
+class DesiredUpdates:
+    """Per-task-group counts of what the plan intends (structs.go DesiredUpdates)."""
+    ignore: int = 0
+    place: int = 0
+    migrate: int = 0
+    stop: int = 0
+    in_place_update: int = 0
+    destructive_update: int = 0
+    canary: int = 0
+    preemptions: int = 0
+
+
+@dataclass
+class PlanAnnotations:
+    desired_tg_updates: Dict[str, DesiredUpdates] = field(default_factory=dict)
+    preempted_allocs: List[dict] = field(default_factory=list)   # alloc stubs
+
+
+@dataclass
+class Plan:
+    eval_id: str = ""
+    eval_token: str = ""
+    priority: int = 50
+    all_at_once: bool = False
+    job: Optional[Job] = None
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    annotations: Optional[PlanAnnotations] = None
+    deployment: Optional[object] = None        # Deployment
+    deployment_updates: List[object] = field(default_factory=list)
+    snapshot_index: int = 0
+
+    # -- construction (structs.go Plan.AppendStoppedAlloc etc.) --------
+    def append_stopped_alloc(self, alloc: Allocation, desired_desc: str,
+                             client_status: str = "",
+                             followup_eval_id: str = "") -> None:
+        new_alloc = alloc.copy_skip_job()
+        # Deregistration plans carry no job: lift it off the alloc so the
+        # applier knows which job is being stopped (structs.go:10288-10291).
+        if self.job is None and alloc.job is not None:
+            self.job = alloc.job
+        new_alloc.job = None
+        new_alloc.desired_status = ALLOC_DESIRED_STOP
+        new_alloc.desired_description = desired_desc
+        if client_status:
+            new_alloc.client_status = client_status
+        if followup_eval_id:
+            new_alloc.follow_up_eval_id = followup_eval_id
+        self.node_update.setdefault(alloc.node_id, []).append(new_alloc)
+
+    def append_preempted_alloc(self, alloc: Allocation,
+                               preempting_alloc_id: str) -> None:
+        new_alloc = Allocation(
+            id=alloc.id, namespace=alloc.namespace, node_id=alloc.node_id,
+            desired_status=ALLOC_DESIRED_EVICT,
+            preempted_by_allocation=preempting_alloc_id,
+            desired_description=(
+                f"Preempted by alloc ID {preempting_alloc_id}"),
+        )
+        self.node_preemptions.setdefault(alloc.node_id, []).append(new_alloc)
+
+    def append_alloc(self, alloc: Allocation) -> None:
+        # strip the job snapshot: the plan carries the job once
+        alloc.job = None
+        self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
+
+    def pop_update(self, alloc: Allocation) -> None:
+        """Remove the last stopped-alloc entry if it is this alloc
+        (used when an updated alloc is placed back on the same node)."""
+        existing = self.node_update.get(alloc.node_id, [])
+        if existing and existing[-1].id == alloc.id:
+            existing.pop()
+            if not existing:
+                del self.node_update[alloc.node_id]
+
+    def is_no_op(self) -> bool:
+        return (not self.node_update and not self.node_allocation
+                and self.deployment is None and not self.deployment_updates)
+
+    def normalize_allocations(self) -> None:
+        """Strip stopped/preempted allocs to id-only stubs for the wire
+        (structs.go Plan.NormalizeAllocations)."""
+        for node_id, allocs in self.node_update.items():
+            self.node_update[node_id] = [
+                Allocation(id=a.id,
+                           desired_description=a.desired_description,
+                           client_status=a.client_status,
+                           desired_status=a.desired_status,
+                           follow_up_eval_id=a.follow_up_eval_id)
+                for a in allocs
+            ]
+
+
+@dataclass
+class PlanResult:
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    deployment: Optional[object] = None
+    deployment_updates: List[object] = field(default_factory=list)
+    refresh_index: int = 0
+    alloc_index: int = 0
+
+    def full_commit(self, plan: Plan):
+        """(bool fully_committed, expected, actual) — structs.go PlanResult.FullCommit."""
+        expected = sum(len(v) for v in plan.node_allocation.values())
+        actual = sum(len(v) for v in self.node_allocation.values())
+        return expected == actual, expected, actual
+
+    def is_no_op(self) -> bool:
+        return (not self.node_update and not self.node_allocation
+                and self.deployment is None and not self.deployment_updates)

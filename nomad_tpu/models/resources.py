@@ -1,0 +1,296 @@
+"""Resource types and algebra.
+
+Reference semantics: nomad/structs/structs.go — Resources:2129,
+NodeResources:2727, AllocatedResources:3302, ComparableResources:3709 —
+and the Add/Subtract/Superset algebra consumed by AllocsFit
+(nomad/structs/funcs.go:102).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .networks import NetworkResource
+
+# Default resources for a task when unspecified (structs.go DefaultResources)
+DEFAULT_CPU_SHARES = 100
+DEFAULT_MEMORY_MB = 300
+
+# Minimums (structs.go MinResources)
+MIN_CPU_SHARES = 1
+MIN_MEMORY_MB = 10
+
+
+@dataclass
+class RequestedDevice:
+    """A task's device ask (structs.go RequestedDevice:2xxx).
+    name is "<vendor>/<type>/<model>", "<type>/<model>", or "<type>"."""
+    name: str = ""
+    count: int = 1
+    constraints: list = field(default_factory=list)   # List[Constraint]
+    affinities: list = field(default_factory=list)    # List[Affinity]
+
+    def id_tuple(self):
+        parts = self.name.split("/")
+        # (vendor, type, model) with empty wildcards
+        if len(parts) >= 3:
+            return (parts[0], parts[1], "/".join(parts[2:]))
+        if len(parts) == 2:
+            return ("", parts[0], parts[1])
+        return ("", self.name, "")
+
+
+@dataclass
+class Resources:
+    """Per-task resource ask (structs.go Resources:2129)."""
+    cpu: int = DEFAULT_CPU_SHARES          # MHz shares
+    memory_mb: int = DEFAULT_MEMORY_MB
+    disk_mb: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+    devices: List[RequestedDevice] = field(default_factory=list)
+
+    def canonicalize(self) -> None:
+        for n in self.networks:
+            n.canonicalize()
+
+    def validate(self) -> List[str]:
+        errs = []
+        if self.cpu < MIN_CPU_SHARES:
+            errs.append(f"minimum CPU value is {MIN_CPU_SHARES}; got {self.cpu}")
+        if self.memory_mb < MIN_MEMORY_MB:
+            errs.append(f"minimum MemoryMB value is {MIN_MEMORY_MB}; got {self.memory_mb}")
+        return errs
+
+    def merge(self, other: "Resources") -> None:
+        if other.cpu:
+            self.cpu = other.cpu
+        if other.memory_mb:
+            self.memory_mb = other.memory_mb
+        if other.disk_mb:
+            self.disk_mb = other.disk_mb
+        if other.networks:
+            self.networks = list(other.networks)
+        if other.devices:
+            self.devices = list(other.devices)
+
+    def copy(self) -> "Resources":
+        return Resources(
+            cpu=self.cpu,
+            memory_mb=self.memory_mb,
+            disk_mb=self.disk_mb,
+            networks=[n.copy() for n in self.networks],
+            devices=list(self.devices),
+        )
+
+
+@dataclass
+class NodeCpuResources:
+    cpu_shares: int = 0
+
+
+@dataclass
+class NodeMemoryResources:
+    memory_mb: int = 0
+
+
+@dataclass
+class NodeDiskResources:
+    disk_mb: int = 0
+
+
+@dataclass
+class NodeDevice:
+    """One device instance on a node (structs.go NodeDevice)."""
+    id: str = ""
+    healthy: bool = True
+    health_description: str = ""
+    locality: Optional[dict] = None
+
+
+@dataclass
+class NodeDeviceResource:
+    """A homogeneous device group on a node (structs.go NodeDeviceResource)."""
+    vendor: str = ""
+    type: str = ""
+    name: str = ""
+    instances: List[NodeDevice] = field(default_factory=list)
+    attributes: Dict[str, object] = field(default_factory=dict)
+
+    def id_tuple(self):
+        return (self.vendor, self.type, self.name)
+
+    def matches_request(self, req: RequestedDevice) -> bool:
+        """Does this group satisfy the request name? (device.go nodeDeviceMatches)"""
+        rv, rt, rm = req.id_tuple()
+        if rt and rt != self.type:
+            return False
+        if rv and rv != self.vendor:
+            return False
+        if rm and rm != self.name:
+            return False
+        return True
+
+
+@dataclass
+class NodeResources:
+    """Total resources on a node (structs.go NodeResources:2727)."""
+    cpu: NodeCpuResources = field(default_factory=NodeCpuResources)
+    memory: NodeMemoryResources = field(default_factory=NodeMemoryResources)
+    disk: NodeDiskResources = field(default_factory=NodeDiskResources)
+    networks: List[NetworkResource] = field(default_factory=list)
+    devices: List[NodeDeviceResource] = field(default_factory=list)
+
+    def comparable(self) -> "ComparableResources":
+        return ComparableResources(
+            cpu_shares=self.cpu.cpu_shares,
+            memory_mb=self.memory.memory_mb,
+            disk_mb=self.disk.disk_mb,
+        )
+
+
+@dataclass
+class NodeReservedResources:
+    """Resources reserved for the OS/agent (structs.go NodeReservedResources)."""
+    cpu_shares: int = 0
+    memory_mb: int = 0
+    disk_mb: int = 0
+    reserved_host_ports: str = ""   # e.g. "22,80,8000-9000"
+
+    def comparable(self) -> "ComparableResources":
+        return ComparableResources(
+            cpu_shares=self.cpu_shares,
+            memory_mb=self.memory_mb,
+            disk_mb=self.disk_mb,
+        )
+
+
+@dataclass
+class AllocatedCpuResources:
+    cpu_shares: int = 0
+
+    def add(self, o): self.cpu_shares += o.cpu_shares
+    def subtract(self, o): self.cpu_shares -= o.cpu_shares
+
+
+@dataclass
+class AllocatedMemoryResources:
+    memory_mb: int = 0
+
+    def add(self, o): self.memory_mb += o.memory_mb
+    def subtract(self, o): self.memory_mb -= o.memory_mb
+
+
+@dataclass
+class AllocatedDeviceResource:
+    """Devices granted to a task (structs.go AllocatedDeviceResource)."""
+    vendor: str = ""
+    type: str = ""
+    name: str = ""
+    device_ids: List[str] = field(default_factory=list)
+
+    def id_tuple(self):
+        return (self.vendor, self.type, self.name)
+
+
+@dataclass
+class AllocatedTaskResources:
+    """Resources granted to a single task (structs.go AllocatedTaskResources)."""
+    cpu: AllocatedCpuResources = field(default_factory=AllocatedCpuResources)
+    memory: AllocatedMemoryResources = field(default_factory=AllocatedMemoryResources)
+    networks: List[NetworkResource] = field(default_factory=list)
+    devices: List[AllocatedDeviceResource] = field(default_factory=list)
+
+    def copy(self) -> "AllocatedTaskResources":
+        return AllocatedTaskResources(
+            cpu=AllocatedCpuResources(self.cpu.cpu_shares),
+            memory=AllocatedMemoryResources(self.memory.memory_mb),
+            networks=[n.copy() for n in self.networks],
+            devices=[AllocatedDeviceResource(d.vendor, d.type, d.name, list(d.device_ids))
+                     for d in self.devices],
+        )
+
+
+@dataclass
+class AllocatedSharedResources:
+    """Task-group-shared resources (structs.go AllocatedSharedResources)."""
+    disk_mb: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+
+    def copy(self) -> "AllocatedSharedResources":
+        return AllocatedSharedResources(
+            disk_mb=self.disk_mb,
+            networks=[n.copy() for n in self.networks],
+        )
+
+
+@dataclass
+class AllocatedResources:
+    """All resources granted to an allocation (structs.go AllocatedResources:3302)."""
+    tasks: Dict[str, AllocatedTaskResources] = field(default_factory=dict)
+    shared: AllocatedSharedResources = field(default_factory=AllocatedSharedResources)
+
+    def comparable(self) -> "ComparableResources":
+        c = ComparableResources(disk_mb=self.shared.disk_mb)
+        networks: List[NetworkResource] = list(self.shared.networks)
+        for tr in self.tasks.values():
+            c.cpu_shares += tr.cpu.cpu_shares
+            c.memory_mb += tr.memory.memory_mb
+            networks.extend(tr.networks)
+        c.networks = networks
+        return c
+
+    def copy(self) -> "AllocatedResources":
+        return AllocatedResources(
+            tasks={k: v.copy() for k, v in self.tasks.items()},
+            shared=self.shared.copy(),
+        )
+
+
+@dataclass
+class ComparableResources:
+    """Flattened, comparable resource vector (structs.go ComparableResources:3709).
+    The algebra behind AllocsFit / bin-pack scoring."""
+    cpu_shares: int = 0
+    memory_mb: int = 0
+    disk_mb: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+
+    def add(self, other: Optional["ComparableResources"]) -> None:
+        if other is None:
+            return
+        self.cpu_shares += other.cpu_shares
+        self.memory_mb += other.memory_mb
+        self.disk_mb += other.disk_mb
+        self.networks = self.networks + other.networks
+
+    def subtract(self, other: Optional["ComparableResources"]) -> None:
+        if other is None:
+            return
+        self.cpu_shares -= other.cpu_shares
+        self.memory_mb -= other.memory_mb
+        self.disk_mb -= other.disk_mb
+
+    def superset(self, other: "ComparableResources"):
+        """Is self >= other on every dimension? Returns (bool, failing_dim)."""
+        if self.cpu_shares < other.cpu_shares:
+            return False, "cpu"
+        if self.memory_mb < other.memory_mb:
+            return False, "memory"
+        if self.disk_mb < other.disk_mb:
+            return False, "disk"
+        return True, ""
+
+    def net_index(self, n: NetworkResource) -> int:
+        for i, nw in enumerate(self.networks):
+            if nw.device == n.device:
+                return i
+        return -1
+
+    def copy(self) -> "ComparableResources":
+        return ComparableResources(
+            cpu_shares=self.cpu_shares,
+            memory_mb=self.memory_mb,
+            disk_mb=self.disk_mb,
+            networks=[n.copy() for n in self.networks],
+        )

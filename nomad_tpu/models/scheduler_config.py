@@ -1,0 +1,35 @@
+"""Runtime-mutable scheduler configuration.
+
+Reference semantics: nomad/structs/operator.go:128-166
+(SchedulerConfiguration, PreemptionConfig) — stored in Raft, read
+per-eval by the placement stack; this is also the switch that selects
+the TPU-batch pipeline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+SCHED_ALG_BINPACK = "binpack"
+SCHED_ALG_SPREAD = "spread"
+
+
+@dataclass
+class PreemptionConfig:
+    system_scheduler_enabled: bool = True
+    batch_scheduler_enabled: bool = False
+    service_scheduler_enabled: bool = False
+
+
+@dataclass
+class SchedulerConfiguration:
+    scheduler_algorithm: str = SCHED_ALG_BINPACK
+    preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
+    # TPU rebuild extension: run placement through the batched device
+    # kernel (ops/select.py) instead of the scalar host pipeline.
+    tpu_batch_enabled: bool = True
+    create_index: int = 0
+    modify_index: int = 0
+
+    def effective_algorithm(self) -> str:
+        return self.scheduler_algorithm or SCHED_ALG_BINPACK

@@ -1,0 +1,4 @@
+from .ids import generate_uuid, short_id
+from .hamt import Hamt
+
+__all__ = ["generate_uuid", "short_id", "Hamt"]

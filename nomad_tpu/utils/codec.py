@@ -1,0 +1,73 @@
+"""Generic dataclass <-> plain-dict codec used for JSON/msgpack wire
+formats and state persistence (reference: nomad/structs/structs.generated.go
+msgpack codegen; we derive codecs from dataclass type hints instead)."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import types
+import typing
+from typing import Any, get_args, get_origin, get_type_hints
+
+_HINTS_CACHE: dict = {}
+
+
+def to_wire(obj: Any) -> Any:
+    """Recursively convert dataclasses/enums/containers to plain data."""
+    if obj is None or isinstance(obj, (str, int, float, bool, bytes)):
+        return obj
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            out[f.name] = to_wire(v)
+        return out
+    if isinstance(obj, dict):
+        return {k: to_wire(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [to_wire(v) for v in obj]
+    return obj
+
+
+def from_wire(cls: Any, data: Any) -> Any:
+    """Recursively build an instance of `cls` from plain data."""
+    if data is None:
+        return None
+    origin = get_origin(cls)
+    if origin in (typing.Union, types.UnionType):
+        args = [a for a in get_args(cls) if a is not type(None)]
+        if not args:
+            return data
+        return from_wire(args[0], data)
+    if cls is Any or cls is None:
+        return data
+    if isinstance(cls, type) and issubclass(cls, enum.Enum):
+        return cls(data)
+    if dataclasses.is_dataclass(cls):
+        hints = _HINTS_CACHE.get(cls)
+        if hints is None:
+            hints = get_type_hints(cls)
+            _HINTS_CACHE[cls] = hints
+        kwargs = {}
+        names = {f.name for f in dataclasses.fields(cls)}
+        for k, v in data.items():
+            if k in names:
+                kwargs[k] = from_wire(hints.get(k, Any), v)
+        return cls(**kwargs)
+    if origin in (list, tuple, set, frozenset):
+        args = get_args(cls)
+        elem = args[0] if args else Any
+        seq = [from_wire(elem, v) for v in data]
+        if origin is list:
+            return seq
+        return origin(seq)
+    if origin is dict:
+        args = get_args(cls)
+        vt = args[1] if len(args) == 2 else Any
+        return {k: from_wire(vt, v) for k, v in data.items()}
+    if cls in (int, float, str, bool, bytes):
+        return cls(data) if data is not None else None
+    return data

@@ -1,0 +1,230 @@
+"""A persistent (immutable, structurally shared) hash map.
+
+This is the substrate for the MVCC state store (state/store.py): every
+write transaction produces a new root while old snapshots keep reading
+their own roots — the equivalent of go-memdb's immutable radix trees
+(reference: nomad/state/state_store.go uses github.com/hashicorp/go-memdb).
+
+Implementation: 32-way hash array mapped trie with path copying.
+O(log32 n) per get/set/delete; snapshots are O(1) (root pointer copy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional, Tuple
+
+_BITS = 5
+_WIDTH = 1 << _BITS  # 32
+_MASK = _WIDTH - 1
+
+
+class _Node:
+    __slots__ = ("bitmap", "entries")
+
+    def __init__(self, bitmap: int, entries: tuple):
+        self.bitmap = bitmap
+        # entries[i] is either (key, value) leaf, a _Node, or a _Collision
+        self.entries = entries
+
+
+class _Collision:
+    __slots__ = ("hash", "pairs")
+
+    def __init__(self, h: int, pairs: tuple):
+        self.hash = h
+        self.pairs = pairs  # tuple of (key, value)
+
+
+_EMPTY = _Node(0, ())
+_SENTINEL = object()
+
+
+def _index(bitmap: int, bit: int) -> int:
+    return bin(bitmap & (bit - 1)).count("1")
+
+
+class Hamt:
+    """Immutable hash map. set/delete return new maps sharing structure."""
+
+    __slots__ = ("_root", "_size")
+
+    def __init__(self, _root: _Node = _EMPTY, _size: int = 0):
+        self._root = _root
+        self._size = _size
+
+    # -- reads ---------------------------------------------------------
+    def __len__(self) -> int:
+        return self._size
+
+    def __contains__(self, key) -> bool:
+        return self.get(key, _SENTINEL) is not _SENTINEL
+
+    def __getitem__(self, key):
+        v = self.get(key, _SENTINEL)
+        if v is _SENTINEL:
+            raise KeyError(key)
+        return v
+
+    def get(self, key, default=None):
+        h = hash(key)
+        node = self._root
+        shift = 0
+        while True:
+            if isinstance(node, _Collision):
+                if node.hash == h:
+                    for k, v in node.pairs:
+                        if k == key:
+                            return v
+                return default
+            bit = 1 << ((h >> shift) & _MASK)
+            if not (node.bitmap & bit):
+                return default
+            entry = node.entries[_index(node.bitmap, bit)]
+            if isinstance(entry, (_Node, _Collision)):
+                node = entry
+                shift += _BITS
+            else:
+                k, v = entry
+                return v if k == key else default
+
+    def items(self) -> Iterator[Tuple[Any, Any]]:
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _Collision):
+                yield from node.pairs
+            else:
+                for entry in node.entries:
+                    if isinstance(entry, (_Node, _Collision)):
+                        stack.append(entry)
+                    else:
+                        yield entry
+
+    def keys(self) -> Iterator[Any]:
+        for k, _ in self.items():
+            yield k
+
+    def values(self) -> Iterator[Any]:
+        for _, v in self.items():
+            yield v
+
+    def __iter__(self) -> Iterator[Any]:
+        return self.keys()
+
+    # -- writes (persistent) ------------------------------------------
+    def set(self, key, value) -> "Hamt":
+        h = hash(key)
+        new_root, added = _set(self._root, 0, h, key, value)
+        return Hamt(new_root, self._size + (1 if added else 0))
+
+    def delete(self, key) -> "Hamt":
+        h = hash(key)
+        result = _delete(self._root, 0, h, key)
+        if result is _SENTINEL:
+            return self  # key absent
+        new_root = result if result is not None else _EMPTY
+        if isinstance(new_root, tuple):  # collapsed to single leaf
+            node = _Node(1 << ((h := hash(new_root[0])) & _MASK), (new_root,))
+            new_root = node
+        return Hamt(new_root, self._size - 1)
+
+    def update(self, pairs) -> "Hamt":
+        m = self
+        for k, v in (pairs.items() if isinstance(pairs, dict) else pairs):
+            m = m.set(k, v)
+        return m
+
+
+def _set(node, shift: int, h: int, key, value):
+    """Returns (new_node, added_bool)."""
+    if isinstance(node, _Collision):
+        if node.hash == h:
+            for i, (k, _) in enumerate(node.pairs):
+                if k == key:
+                    pairs = node.pairs[:i] + ((key, value),) + node.pairs[i + 1:]
+                    return _Collision(h, pairs), False
+            return _Collision(h, node.pairs + ((key, value),)), True
+        # different hash: push collision node down a level
+        bit = 1 << ((node.hash >> shift) & _MASK)
+        wrapped = _Node(bit, (node,))
+        return _set(wrapped, shift, h, key, value)
+
+    bit = 1 << ((h >> shift) & _MASK)
+    idx = _index(node.bitmap, bit)
+    if not (node.bitmap & bit):
+        entries = node.entries[:idx] + ((key, value),) + node.entries[idx:]
+        return _Node(node.bitmap | bit, entries), True
+
+    entry = node.entries[idx]
+    if isinstance(entry, (_Node, _Collision)):
+        child, added = _set(entry, shift + _BITS, h, key, value)
+        return _Node(node.bitmap, node.entries[:idx] + (child,) + node.entries[idx + 1:]), added
+
+    k, v = entry
+    if k == key:
+        return _Node(node.bitmap, node.entries[:idx] + ((key, value),) + node.entries[idx + 1:]), False
+
+    # split: both leaves descend
+    kh = hash(k)
+    if kh == h:
+        child = _Collision(h, ((k, v), (key, value)))
+    else:
+        child = _merge_leaves(shift + _BITS, kh, (k, v), h, (key, value))
+    return _Node(node.bitmap, node.entries[:idx] + (child,) + node.entries[idx + 1:]), True
+
+
+def _merge_leaves(shift: int, h1: int, leaf1, h2: int, leaf2) -> _Node:
+    i1 = (h1 >> shift) & _MASK
+    i2 = (h2 >> shift) & _MASK
+    if i1 == i2:
+        child = _merge_leaves(shift + _BITS, h1, leaf1, h2, leaf2)
+        return _Node(1 << i1, (child,))
+    if i1 < i2:
+        return _Node((1 << i1) | (1 << i2), (leaf1, leaf2))
+    return _Node((1 << i1) | (1 << i2), (leaf2, leaf1))
+
+
+def _delete(node, shift: int, h: int, key):
+    """Returns _SENTINEL if absent; None if node becomes empty; a (k,v)
+    tuple if node collapses to a single leaf; else a new node."""
+    if isinstance(node, _Collision):
+        for i, (k, _) in enumerate(node.pairs):
+            if k == key:
+                pairs = node.pairs[:i] + node.pairs[i + 1:]
+                if len(pairs) == 1:
+                    return pairs[0]
+                return _Collision(node.hash, pairs)
+        return _SENTINEL
+
+    bit = 1 << ((h >> shift) & _MASK)
+    if not (node.bitmap & bit):
+        return _SENTINEL
+    idx = _index(node.bitmap, bit)
+    entry = node.entries[idx]
+
+    if isinstance(entry, (_Node, _Collision)):
+        result = _delete(entry, shift + _BITS, h, key)
+        if result is _SENTINEL:
+            return _SENTINEL
+        if result is None:
+            entries = node.entries[:idx] + node.entries[idx + 1:]
+            if not entries:
+                return None
+            if len(entries) == 1 and not isinstance(entries[0], (_Node, _Collision)):
+                return entries[0]
+            return _Node(node.bitmap & ~bit, entries)
+        if isinstance(result, tuple):  # child collapsed to leaf
+            if len(node.entries) == 1:
+                return result
+            return _Node(node.bitmap, node.entries[:idx] + (result,) + node.entries[idx + 1:])
+        return _Node(node.bitmap, node.entries[:idx] + (result,) + node.entries[idx + 1:])
+
+    k, _ = entry
+    if k != key:
+        return _SENTINEL
+    entries = node.entries[:idx] + node.entries[idx + 1:]
+    if not entries:
+        return None
+    if len(entries) == 1 and not isinstance(entries[0], (_Node, _Collision)):
+        return entries[0]
+    return _Node(node.bitmap & ~bit, entries)

@@ -1,0 +1,11 @@
+"""ID helpers (reference: helper/uuid/uuid.go)."""
+
+import uuid
+
+
+def generate_uuid() -> str:
+    return str(uuid.uuid4())
+
+
+def short_id(full: str) -> str:
+    return full[:8]
