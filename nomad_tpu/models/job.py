@@ -13,6 +13,15 @@ from typing import Dict, List, Optional
 from ..utils.ids import generate_uuid
 from .resources import Resources
 from .networks import NetworkResource
+from .constraints import (  # noqa: F401 -- re-exported
+    Affinity, Constraint, Spread, SpreadTarget,
+    COMPARISON_OPERANDS,
+    CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY,
+    CONSTRAINT_IS_NOT_SET, CONSTRAINT_IS_SET, CONSTRAINT_REGEX,
+    CONSTRAINT_SEMVER, CONSTRAINT_SET_CONTAINS,
+    CONSTRAINT_SET_CONTAINS_ALL, CONSTRAINT_SET_CONTAINS_ANY,
+    CONSTRAINT_VERSION,
+)
 
 # Job types (structs.go JobTypeService etc.)
 JOB_TYPE_SERVICE = "service"
@@ -30,98 +39,6 @@ JOB_MAX_PRIORITY = 100
 
 DEFAULT_NAMESPACE = "default"
 
-# Constraint operands (structs.go:8010-8019)
-CONSTRAINT_DISTINCT_PROPERTY = "distinct_property"
-CONSTRAINT_DISTINCT_HOSTS = "distinct_hosts"
-CONSTRAINT_REGEX = "regexp"
-CONSTRAINT_VERSION = "version"
-CONSTRAINT_SEMVER = "semver"
-CONSTRAINT_SET_CONTAINS = "set_contains"
-CONSTRAINT_SET_CONTAINS_ALL = "set_contains_all"
-CONSTRAINT_SET_CONTAINS_ANY = "set_contains_any"
-CONSTRAINT_IS_SET = "is_set"
-CONSTRAINT_IS_NOT_SET = "is_not_set"
-
-COMPARISON_OPERANDS = ("=", "==", "is", "!=", "not", "<", "<=", ">", ">=")
-
-
-@dataclass
-class Constraint:
-    ltarget: str = ""    # left-hand target, e.g. "${attr.kernel.name}"
-    rtarget: str = ""
-    operand: str = "="
-
-    def validate(self) -> List[str]:
-        errs = []
-        if not self.operand:
-            errs.append("missing constraint operand")
-        req_rtarget = self.operand not in (
-            CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_IS_SET, CONSTRAINT_IS_NOT_SET)
-        if req_rtarget and self.rtarget == "":
-            errs.append(f"operand {self.operand} requires an RTarget")
-        req_ltarget = self.operand != CONSTRAINT_DISTINCT_HOSTS
-        if req_ltarget and self.ltarget == "":
-            errs.append(f"no LTarget provided but is required by constraint")
-        return errs
-
-    def key(self):
-        return (self.ltarget, self.rtarget, self.operand)
-
-    def __str__(self):
-        return f"{self.ltarget} {self.operand} {self.rtarget}"
-
-
-@dataclass
-class Affinity:
-    ltarget: str = ""
-    rtarget: str = ""
-    operand: str = "="
-    weight: int = 50     # [-100, 100], negative == anti-affinity
-
-    def validate(self) -> List[str]:
-        errs = []
-        if not self.operand:
-            errs.append("missing affinity operand")
-        if self.weight > 100 or self.weight < -100:
-            errs.append("affinity weight must be within the range [-100,100]")
-        if self.weight == 0:
-            errs.append("affinity weight cannot be zero")
-        return errs
-
-    def key(self):
-        return (self.ltarget, self.rtarget, self.operand, self.weight)
-
-
-@dataclass
-class SpreadTarget:
-    value: str = ""
-    percent: int = 0
-
-
-@dataclass
-class Spread:
-    attribute: str = ""
-    weight: int = 50     # (0, 100]
-    spread_target: List[SpreadTarget] = field(default_factory=list)
-
-    def validate(self) -> List[str]:
-        errs = []
-        if not self.attribute:
-            errs.append("missing spread attribute")
-        if self.weight <= 0 or self.weight > 100:
-            errs.append("spread stanza must have a positive weight from 0 to 100")
-        seen = set()
-        total = 0
-        for t in self.spread_target:
-            if t.value in seen:
-                errs.append(f"spread target value {t.value} already defined")
-            seen.add(t.value)
-            if t.percent < 0 or t.percent > 100:
-                errs.append(f"spread target percentage for value {t.value} must be between 0 and 100")
-            total += t.percent
-        if total > 100:
-            errs.append(f"sum of spread target percentages must not be greater than 100, got {total}")
-        return errs
 
 
 @dataclass

@@ -116,6 +116,15 @@ class NetworkIndex:
         self._rng = rng or random
 
     # -- building ------------------------------------------------------
+    @staticmethod
+    def ip_of(n: NetworkResource) -> str:
+        """Canonical IP key for a network (falls back to the CIDR host)."""
+        if n.ip:
+            return n.ip
+        if n.cidr:
+            return n.cidr.split("/")[0]
+        return ""
+
     def set_node(self, node) -> bool:
         collide = False
         networks = node.node_resources.networks if node.node_resources else []
@@ -148,18 +157,19 @@ class NetworkIndex:
 
     def add_reserved(self, n: NetworkResource) -> bool:
         collide = False
+        ip = self.ip_of(n)
         for ports in (n.reserved_ports, n.dynamic_ports):
             for port in ports:
                 if port.value < 0 or port.value >= MAX_VALID_PORT:
                     return True
                 bit = 1 << port.value
-                if self.used_ports.get(n.ip, 0) & bit:
+                if self.used_ports.get(ip, 0) & bit:
                     collide = True
                 else:
                     # write through immediately so valid marks survive an
                     # early return on a later invalid port (the reference
                     # mutates the shared bitmap in place)
-                    self.used_ports[n.ip] = self.used_ports.get(n.ip, 0) | bit
+                    self.used_ports[ip] = self.used_ports.get(ip, 0) | bit
         self.used_bandwidth[n.device] = self.used_bandwidth.get(n.device, 0) + n.mbits
         return collide
 
@@ -170,7 +180,7 @@ class NetworkIndex:
             return False
         collide = False
         for n in self.avail_networks:
-            self.used_ports.setdefault(n.ip, 0)
+            self.used_ports.setdefault(self.ip_of(n), 0)
         for ip in list(self.used_ports):
             used = self.used_ports[ip]
             for port in res_ports:
@@ -190,7 +200,7 @@ class NetworkIndex:
         """Satisfy an ask; returns (offer, "") or (None, reason)."""
         err = "no networks available"
         for n in self.avail_networks:
-            ip = n.ip or (n.cidr.split("/")[0] if n.cidr else "")
+            ip = self.ip_of(n)
             if not ip:
                 continue
             avail_bw = self.avail_bandwidth.get(n.device, 0)

@@ -106,6 +106,12 @@ class Plan:
                            follow_up_eval_id=a.follow_up_eval_id)
                 for a in allocs
             ]
+        for node_id, allocs in self.node_preemptions.items():
+            self.node_preemptions[node_id] = [
+                Allocation(id=a.id,
+                           preempted_by_allocation=a.preempted_by_allocation)
+                for a in allocs
+            ]
 
 
 @dataclass

@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from .networks import NetworkResource
+from .constraints import Affinity, Constraint
 
 # Default resources for a task when unspecified (structs.go DefaultResources)
 DEFAULT_CPU_SHARES = 100
@@ -28,8 +29,8 @@ class RequestedDevice:
     name is "<vendor>/<type>/<model>", "<type>/<model>", or "<type>"."""
     name: str = ""
     count: int = 1
-    constraints: list = field(default_factory=list)   # List[Constraint]
-    affinities: list = field(default_factory=list)    # List[Affinity]
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
 
     def id_tuple(self):
         parts = self.name.split("/")
@@ -80,7 +81,9 @@ class Resources:
             memory_mb=self.memory_mb,
             disk_mb=self.disk_mb,
             networks=[n.copy() for n in self.networks],
-            devices=list(self.devices),
+            devices=[RequestedDevice(d.name, d.count, list(d.constraints),
+                                     list(d.affinities))
+                     for d in self.devices],
         )
 
 

@@ -1,0 +1,16 @@
+"""The TPU compute path: columnar node/alloc tables and the batched
+placement kernels that replace the reference's per-node iterator chain
+(scheduler/{stack,rank,feasible,spread,select}.go).
+
+Split of labor (SURVEY.md §7.1):
+  - targets.py  host-side vectorized target resolution + constraint ->
+                bool[N] mask evaluation (regex/version/semver evaluated
+                once per *distinct value*, not per node)
+  - tables.py   NodeTable / proposed-allocation index builders
+  - versions.py go-version/semver constraint parsing
+  - select.py   the fused jitted kernel: feasibility -> fit -> score ->
+                masked argmax, multi-placement via lax.scan
+"""
+
+from .tables import NodeTable, ProposedIndex
+from .select import SelectKernel, SelectRequest, SelectResult

@@ -1,0 +1,368 @@
+"""The fused placement kernel.
+
+One device dispatch replaces the reference's entire per-placement
+iterator chain (stack.go Select -> feasible -> BinPack -> scorers ->
+Limit -> MaxScore) AND the outer per-alloc loop: a `lax.scan` places all
+`count` instances of a task group sequentially *on device*, with each
+step seeing the previous steps' placements (usage, anti-affinity
+collisions, spread histograms, distinct-hosts/-property counts carried
+through the scan). Score semantics mirror:
+
+  - bin-pack / spread fit    structs/funcs.go ScoreFitBinPack:174 (/18)
+  - job anti-affinity        rank.go:502  (-(collisions+1)/desired_count)
+  - reschedule penalty       rank.go:564  (-1 on penalty nodes)
+  - node affinity            rank.go:637  (sum(w*match)/sum|w|)
+  - spread                   spread.go:110 (targeted + even-spread boost)
+  - normalization            rank.go:696  (mean over *fired* scorers)
+  - selection                select.go MaxScoreIterator -> full argmax
+                             (no log2(n) sampling: the whole node axis
+                             is scored at once, SURVEY.md §2.6)
+
+Shapes are padded to buckets to bound recompilation:
+  N -> next power of two; steps K -> bucket; spreads S, distinct-property
+  P, codes C -> fixed maxima. Padded lanes carry zero weight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+S_MAX = 4       # max spread stanzas per task group
+P_MAX = 4       # max distinct_property constraints
+C_MAX = 64      # max distinct attribute values per spread/property axis
+NEG_INF = -1e30
+TOP_K = 5       # ScoreMetaData entries kept (reference kheap topK)
+
+
+def _pad_n(n: int) -> int:
+    p = 8
+    while p < n:
+        p *= 2
+    return p
+
+
+def _bucket_k(k: int) -> int:
+    b = 1
+    while b < k:
+        b *= 2
+    return min(b, 4096)
+
+
+@dataclasses.dataclass
+class SelectRequest:
+    """Host-side inputs for placing `count` instances of one task group."""
+    ask: np.ndarray                  # f32[3] cpu/mem/disk per instance
+    count: int
+    feasible: np.ndarray             # bool[N] all static checks combined
+    capacity: np.ndarray             # f32[N,3]
+    used: np.ndarray                 # f32[N,3] live + plan overlay
+    desired_count: float             # anti-affinity denominator (tg count)
+    tg_collisions: np.ndarray        # i32[N] proposed allocs of job+tg
+    job_count: np.ndarray            # i32[N] proposed allocs of job
+    distinct_hosts: bool = False
+    penalty: Optional[np.ndarray] = None        # bool[N]
+    affinity: Optional[np.ndarray] = None       # f32[N] weighted sum
+    affinity_sum_weights: float = 0.0
+    algorithm: str = "binpack"       # "binpack" | "spread"
+    port_need: float = 0.0
+    free_ports: Optional[np.ndarray] = None     # f32[N]
+    port_ok: Optional[np.ndarray] = None        # bool[N]
+    # spreads: list of dicts with codes i32[N], counts f32[C+1],
+    #          present bool[C+1], desired f32[C+1] (-1 == none),
+    #          has_implicit, implicit_desired, weight, has_targets
+    spreads: List[Dict] = dataclasses.field(default_factory=list)
+    sum_spread_weights: float = 0.0
+    # distinct_property: list of dicts with codes i32[N], counts f32[C+1],
+    #          limit f32
+    distinct_props: List[Dict] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class SelectResult:
+    """Result of one multi-placement kernel dispatch."""
+    node_idx: np.ndarray             # i32[K] chosen node per step (-1 none)
+    final_score: np.ndarray          # f32[K]
+    scores: Dict[str, np.ndarray]    # component -> f32[K]
+    top_idx: np.ndarray              # i32[K, TOP_K]
+    top_scores: np.ndarray           # f32[K, TOP_K]
+    nodes_evaluated: int
+    nodes_filtered: int
+    exhausted_dim: np.ndarray        # i32[K, 3] counts per cpu/mem/disk
+    placed: int
+
+
+@partial(jax.jit, static_argnames=("k_steps", "spread_alg", "s_live", "p_live"))
+def _select_scan(capacity, used0, feasible, ask, k_valid,
+                 tg_coll0, job_count0, distinct_hosts_flag,
+                 penalty, affinity_norm, desired_count,
+                 port_need, free_ports, port_ok,
+                 sp_codes, sp_counts0, sp_present0, sp_desired,
+                 sp_weight, sp_has_targets, sp_valid, sum_spread_w,
+                 dp_codes, dp_counts0, dp_limit, dp_valid,
+                 *, k_steps: int, spread_alg: bool, s_live: int, p_live: int):
+    """The fused kernel. Shapes:
+    capacity/used0 f32[N,3]; feasible bool[N]; ask f32[3];
+    sp_* [S, ...] with code axis C+1; dp_* [P, ...].
+    Returns per-step choices, scores, metrics, and the final usage state.
+    """
+    n = capacity.shape[0]
+    cap_cpu = jnp.maximum(capacity[:, 0], 1e-9)
+    cap_mem = jnp.maximum(capacity[:, 1], 1e-9)
+
+    def step(carry, step_i):
+        used, tg_coll, job_cnt, sp_counts, sp_present, dp_counts = carry
+
+        # ---- feasibility beyond the static mask -----------------------
+        feas = feasible
+        feas &= jnp.where(distinct_hosts_flag > 0, job_cnt == 0, True)
+        feas &= free_ports >= port_need
+        feas &= port_ok
+        # distinct_property: count(value)+1 <= limit, missing attr fails
+        for p in range(p_live):
+            codes = dp_codes[p]
+            cnt = dp_counts[p][codes]
+            missing = codes == dp_counts.shape[-1] - 1
+            ok = (cnt + 1.0 <= dp_limit[p]) & ~missing
+            feas &= jnp.where(dp_valid[p], ok, True)
+
+        # ---- fit (AllocsFit over the node axis) -----------------------
+        after = used + ask[None, :]
+        fit_dims = after <= capacity + 1e-6
+        fit = jnp.all(fit_dims, axis=1)
+        # first failing dimension counts (metrics): cpu > mem > disk
+        fail_cpu = feas & ~fit_dims[:, 0]
+        fail_mem = feas & fit_dims[:, 0] & ~fit_dims[:, 1]
+        fail_disk = feas & fit_dims[:, 0] & fit_dims[:, 1] & ~fit_dims[:, 2]
+        exhausted = jnp.stack([fail_cpu.sum(), fail_mem.sum(),
+                               fail_disk.sum()]).astype(jnp.int32)
+
+        # ---- bin-pack / spread fit score ------------------------------
+        free_cpu = 1.0 - after[:, 0] / cap_cpu
+        free_mem = 1.0 - after[:, 1] / cap_mem
+        total = jnp.power(10.0, free_cpu) + jnp.power(10.0, free_mem)
+        if spread_alg:
+            fit_score = jnp.clip(total - 2.0, 0.0, 18.0)
+        else:
+            fit_score = jnp.clip(20.0 - total, 0.0, 18.0)
+        binpack = fit_score / 18.0
+
+        # ---- job anti-affinity ---------------------------------------
+        coll = tg_coll.astype(jnp.float32)
+        anti_fires = coll > 0
+        anti = jnp.where(anti_fires,
+                         -(coll + 1.0) / jnp.maximum(desired_count, 1.0),
+                         0.0)
+
+        # ---- reschedule penalty --------------------------------------
+        pen_fires = penalty
+        pen = jnp.where(pen_fires, -1.0, 0.0)
+
+        # ---- node affinity -------------------------------------------
+        aff_fires = affinity_norm != 0.0
+        aff = affinity_norm
+
+        # ---- spread ---------------------------------------------------
+        spread_total = jnp.zeros(n, dtype=jnp.float32)
+        for s in range(s_live):
+            codes = sp_codes[s]
+            c_axis = sp_counts.shape[-1]
+            missing = codes == c_axis - 1
+            used_cnt = sp_counts[s][codes] + 1.0
+            desired = sp_desired[s][codes]
+            has_desired = desired >= 0.0
+            w = sp_weight[s] / jnp.maximum(sum_spread_w, 1e-9)
+            targeted = jnp.where(
+                has_desired,
+                (desired - used_cnt) / jnp.maximum(desired, 1e-9) * w,
+                -1.0)
+            # even-spread scoring (spread.go evenSpreadScoreBoost)
+            pres = sp_present[s]
+            cnts = sp_counts[s]
+            big = 1e30
+            min_cnt = jnp.min(jnp.where(pres, cnts, big))
+            max_cnt = jnp.max(jnp.where(pres, cnts, -big))
+            any_present = jnp.any(pres)
+            cur = sp_counts[s][codes]
+            even = jnp.where(
+                min_cnt == 0.0,
+                -1.0,
+                (min_cnt - cur) / jnp.maximum(min_cnt, 1e-9))
+            at_min = cur == min_cnt
+            even = jnp.where(
+                at_min,
+                jnp.where(min_cnt == max_cnt, -1.0,
+                          jnp.where(min_cnt == 0.0, 1.0,
+                                    (max_cnt - min_cnt) /
+                                    jnp.maximum(min_cnt, 1e-9))),
+                even)
+            even = jnp.where(any_present, even, 0.0)
+            even = jnp.where(missing, -1.0, even)
+            contrib = jnp.where(sp_has_targets[s],
+                                jnp.where(missing, -1.0, targeted), even)
+            spread_total += jnp.where(sp_valid[s], contrib, 0.0)
+        spread_fires = spread_total != 0.0
+
+        # ---- normalization (mean over fired scorers) ------------------
+        fired = (1.0 + anti_fires.astype(jnp.float32)
+                 + pen_fires.astype(jnp.float32)
+                 + aff_fires.astype(jnp.float32)
+                 + spread_fires.astype(jnp.float32))
+        final = (binpack + anti + pen + aff + spread_total) / fired
+
+        # ---- masked argmax -------------------------------------------
+        ok = feas & fit
+        masked = jnp.where(ok, final, NEG_INF)
+        choice = jnp.argmax(masked)
+        valid = (masked[choice] > NEG_INF / 2) & (step_i < k_valid)
+        choice_out = jnp.where(valid, choice, -1)
+
+        top_scores, top_idx = jax.lax.top_k(masked, TOP_K)
+
+        # ---- carry updates (the placement happens here) ---------------
+        onehot = (jnp.arange(n) == choice) & valid
+        used = used + jnp.where(onehot[:, None], ask[None, :], 0.0)
+        tg_coll = tg_coll + onehot.astype(jnp.int32)
+        job_cnt = job_cnt + onehot.astype(jnp.int32)
+        c_axis = sp_counts.shape[-1]
+        chosen_sp_codes = sp_codes[:, choice]           # [S]
+        sp_upd = (jax.nn.one_hot(chosen_sp_codes, c_axis,
+                                 dtype=sp_counts.dtype) *
+                  jnp.where(valid, 1.0, 0.0))
+        sp_counts = sp_counts + sp_upd
+        sp_present = sp_present | (sp_upd > 0)
+        chosen_dp_codes = dp_codes[:, choice]
+        dp_upd = (jax.nn.one_hot(chosen_dp_codes, dp_counts.shape[-1],
+                                 dtype=dp_counts.dtype) *
+                  jnp.where(valid, 1.0, 0.0))
+        dp_counts = dp_counts + dp_upd
+
+        out = (choice_out.astype(jnp.int32),
+               jnp.where(valid, masked[jnp.maximum(choice, 0)], 0.0),
+               jnp.where(valid, binpack[jnp.maximum(choice, 0)], 0.0),
+               jnp.where(valid, anti[jnp.maximum(choice, 0)], 0.0),
+               jnp.where(valid, pen[jnp.maximum(choice, 0)], 0.0),
+               jnp.where(valid, aff[jnp.maximum(choice, 0)], 0.0),
+               jnp.where(valid, spread_total[jnp.maximum(choice, 0)], 0.0),
+               top_idx.astype(jnp.int32), top_scores,
+               exhausted, ok.sum().astype(jnp.int32))
+        return (used, tg_coll, job_cnt, sp_counts, sp_present, dp_counts), out
+
+    carry0 = (used0, tg_coll0, job_count0, sp_counts0, sp_present0, dp_counts0)
+    carry, outs = jax.lax.scan(step, carry0, jnp.arange(k_steps))
+    return carry, outs
+
+
+class SelectKernel:
+    """Host wrapper: pads request arrays, dispatches the scan kernel, and
+    unpacks results."""
+
+    def select(self, req: SelectRequest) -> SelectResult:
+        n = len(req.feasible)
+        n_pad = _pad_n(n)
+        k = _bucket_k(max(req.count, 1))
+
+        def pad1(a, fill=0.0, dtype=np.float32):
+            out = np.full(n_pad, fill, dtype=dtype)
+            out[:n] = a
+            return out
+
+        def pad2(a, fill=0.0):
+            out = np.full((n_pad, a.shape[1]), fill, dtype=np.float32)
+            out[:n] = a
+            return out
+
+        feasible = pad1(req.feasible, False, bool)
+        capacity = pad2(req.capacity)
+        used = pad2(req.used)
+        penalty = pad1(req.penalty if req.penalty is not None
+                       else np.zeros(n, bool), False, bool)
+        if req.affinity is not None and req.affinity_sum_weights > 0:
+            affinity_norm = pad1(req.affinity / req.affinity_sum_weights)
+        else:
+            affinity_norm = np.zeros(n_pad, dtype=np.float32)
+        tg_coll = pad1(req.tg_collisions, 0, np.int32)
+        job_cnt = pad1(req.job_count, 0, np.int32)
+        free_ports = pad1(req.free_ports if req.free_ports is not None
+                          else np.full(n, 1e9, np.float32))
+        port_ok = pad1(req.port_ok if req.port_ok is not None
+                       else np.ones(n, bool), False, bool)
+
+        s_live = min(len(req.spreads), S_MAX)
+        c_axis = C_MAX + 1
+        sp_codes = np.full((S_MAX, n_pad), C_MAX, dtype=np.int32)
+        sp_counts = np.zeros((S_MAX, c_axis), dtype=np.float32)
+        sp_present = np.zeros((S_MAX, c_axis), dtype=bool)
+        sp_desired = np.full((S_MAX, c_axis), -1.0, dtype=np.float32)
+        sp_weight = np.zeros(S_MAX, dtype=np.float32)
+        sp_has_targets = np.zeros(S_MAX, dtype=bool)
+        sp_valid = np.zeros(S_MAX, dtype=bool)
+        for s, sp in enumerate(req.spreads[:S_MAX]):
+            m = len(sp["codes"])
+            sp_codes[s, :m] = np.minimum(sp["codes"], C_MAX)
+            c = min(len(sp["counts"]), c_axis)
+            sp_counts[s, :c] = sp["counts"][:c]
+            sp_present[s, :c] = sp["present"][:c]
+            sp_desired[s, :c] = sp["desired"][:c]
+            sp_weight[s] = sp["weight"]
+            sp_has_targets[s] = sp["has_targets"]
+            sp_valid[s] = True
+
+        p_live = min(len(req.distinct_props), P_MAX)
+        dp_codes = np.full((P_MAX, n_pad), C_MAX, dtype=np.int32)
+        dp_counts = np.zeros((P_MAX, c_axis), dtype=np.float32)
+        dp_limit = np.zeros(P_MAX, dtype=np.float32)
+        dp_valid = np.zeros(P_MAX, dtype=bool)
+        for p, dp in enumerate(req.distinct_props[:P_MAX]):
+            m = len(dp["codes"])
+            dp_codes[p, :m] = np.minimum(dp["codes"], C_MAX)
+            c = min(len(dp["counts"]), c_axis)
+            dp_counts[p, :c] = dp["counts"][:c]
+            dp_limit[p] = dp["limit"]
+            dp_valid[p] = True
+
+        carry, outs = _select_scan(
+            jnp.asarray(capacity), jnp.asarray(used), jnp.asarray(feasible),
+            jnp.asarray(req.ask, dtype=jnp.float32), jnp.int32(req.count),
+            jnp.asarray(tg_coll), jnp.asarray(job_cnt),
+            jnp.float32(1.0 if req.distinct_hosts else 0.0),
+            jnp.asarray(penalty), jnp.asarray(affinity_norm),
+            jnp.float32(req.desired_count),
+            jnp.float32(req.port_need), jnp.asarray(free_ports),
+            jnp.asarray(port_ok),
+            jnp.asarray(sp_codes), jnp.asarray(sp_counts),
+            jnp.asarray(sp_present), jnp.asarray(sp_desired),
+            jnp.asarray(sp_weight), jnp.asarray(sp_has_targets),
+            jnp.asarray(sp_valid), jnp.float32(req.sum_spread_weights),
+            jnp.asarray(dp_codes), jnp.asarray(dp_counts),
+            jnp.asarray(dp_limit), jnp.asarray(dp_valid),
+            k_steps=k, spread_alg=(req.algorithm == "spread"),
+            s_live=s_live, p_live=p_live,
+        )
+        (choices, finals, s_bin, s_anti, s_pen, s_aff, s_spread,
+         top_idx, top_scores, exhausted, ok_counts) = [
+            np.asarray(o) for o in outs]
+
+        kk = req.count
+        choices = choices[:kk]
+        placed = int((choices >= 0).sum())
+        # nodes beyond the real table are padding; clamp top-k indices
+        top_idx = np.where(top_idx >= n, -1, top_idx)
+        return SelectResult(
+            node_idx=choices,
+            final_score=finals[:kk],
+            scores={"binpack": s_bin[:kk], "job-anti-affinity": s_anti[:kk],
+                    "node-reschedule-penalty": s_pen[:kk],
+                    "node-affinity": s_aff[:kk],
+                    "allocation-spread": s_spread[:kk]},
+            top_idx=top_idx[:kk], top_scores=top_scores[:kk],
+            nodes_evaluated=n,
+            nodes_filtered=int(n - np.count_nonzero(req.feasible)),
+            exhausted_dim=exhausted[:kk],
+            placed=placed,
+        )

@@ -1,0 +1,323 @@
+"""Columnar device state: the NodeTable and per-eval proposed-allocation
+index.
+
+This is the data layout that replaces the reference's one-node-at-a-time
+iterator state (SURVEY.md §7.1): node capacities/usages are (N, 3)
+float32 arrays [cpu_shares, memory_mb, disk_mb]; attributes resolve to
+columns through ops/targets.py; allocation accounting becomes
+segment-sums over node indices.
+
+Build is O(nodes + allocs) from a state snapshot and cached per state
+index epoch; the scheduler calls `NodeTable.build` once per eval at most
+(and usually hits the cache across evals of the same snapshot).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models import NetworkIndex
+from ..models.job import (CONSTRAINT_DISTINCT_HOSTS,
+                          CONSTRAINT_DISTINCT_PROPERTY)
+from .targets import TargetColumns, constraint_mask
+
+RES_DIMS = 3  # cpu_shares, memory_mb, disk_mb
+DIM_NAMES = ("cpu", "memory", "disk")
+
+
+def _alloc_usage(alloc) -> Tuple[float, float, float]:
+    c = alloc.comparable_resources()
+    if c is None:
+        return (0.0, 0.0, 0.0)
+    return (float(c.cpu_shares), float(c.memory_mb), float(c.disk_mb))
+
+
+class NodeTable:
+    """Columnar view of the ready node set + live allocation usage."""
+
+    def __init__(self, nodes: List):
+        self.nodes = nodes
+        self.n = len(nodes)
+        self.ids = [n.id for n in nodes]
+        self.id_to_idx = {nid: i for i, nid in enumerate(self.ids)}
+        self.cols = TargetColumns(nodes)
+
+        self.capacity = np.zeros((self.n, RES_DIMS), dtype=np.float32)
+        self.ready = np.zeros(self.n, dtype=bool)
+        self.datacenters = np.empty(self.n, dtype=object)
+        for i, node in enumerate(nodes):
+            res = node.comparable_resources()
+            reserved = node.comparable_reserved_resources()
+            self.capacity[i, 0] = res.cpu_shares - reserved.cpu_shares
+            self.capacity[i, 1] = res.memory_mb - reserved.memory_mb
+            self.capacity[i, 2] = res.disk_mb - reserved.disk_mb
+            self.ready[i] = node.ready()
+            self.datacenters[i] = node.datacenter
+
+        # live (non-terminal) alloc usage per node + the live alloc lists
+        self.base_used = np.zeros((self.n, RES_DIMS), dtype=np.float32)
+        self.live_allocs: List[List] = [[] for _ in range(self.n)]
+        # per-node port bitsets (python bigints) for precise conflict checks
+        self._net_bits: List[int] = [0] * self.n
+        self.free_ports = np.zeros(self.n, dtype=np.float32)
+        self._port_col_cache: Dict[int, np.ndarray] = {}
+
+        for i, node in enumerate(nodes):
+            idx = NetworkIndex()
+            idx.set_node(node)
+            self._net_bits[i] = self._merge_bits(idx)
+
+        self._free_ports_dirty = True
+
+    @staticmethod
+    def _merge_bits(idx: NetworkIndex) -> int:
+        bits = 0
+        for b in idx.used_ports.values():
+            bits |= b
+        return bits
+
+    @classmethod
+    def build(cls, snapshot, datacenters: Optional[List[str]] = None,
+              include_all: bool = False) -> "NodeTable":
+        """Build from a state snapshot; restrict to ready nodes in the
+        given datacenters (readyNodesInDCs, scheduler/util.go:233)."""
+        nodes = []
+        for node in snapshot.nodes():
+            if not include_all and not node.ready():
+                continue
+            if datacenters is not None and node.datacenter not in datacenters:
+                continue
+            nodes.append(node)
+        nodes.sort(key=lambda n: n.id)
+        t = cls(nodes)
+        for alloc in snapshot.allocs():
+            if alloc.terminal_status():
+                continue
+            i = t.id_to_idx.get(alloc.node_id)
+            if i is None:
+                continue
+            t.add_alloc_usage(i, alloc)
+        t.finalize()
+        return t
+
+    def add_alloc_usage(self, i: int, alloc) -> None:
+        u = _alloc_usage(alloc)
+        self.base_used[i, 0] += u[0]
+        self.base_used[i, 1] += u[1]
+        self.base_used[i, 2] += u[2]
+        self.live_allocs[i].append(alloc)
+        res = alloc.allocated_resources
+        if res is not None:
+            bits = self._net_bits[i]
+            for nw in res.shared.networks:
+                for ports in (nw.reserved_ports, nw.dynamic_ports):
+                    for p in ports:
+                        if p.value > 0:
+                            bits |= 1 << p.value
+            for task in res.tasks.values():
+                for nw in task.networks:
+                    for ports in (nw.reserved_ports, nw.dynamic_ports):
+                        for p in ports:
+                            if p.value > 0:
+                                bits |= 1 << p.value
+            self._net_bits[i] = bits
+        self._free_ports_dirty = True
+
+    def finalize(self) -> None:
+        """Recompute derived columns after usage changes."""
+        if self._free_ports_dirty:
+            from ..models.networks import MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT
+            span = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+            mask = ((1 << span) - 1) << MIN_DYNAMIC_PORT
+            for i in range(self.n):
+                self.free_ports[i] = span - (self._net_bits[i] & mask).bit_count()
+            self._free_ports_dirty = False
+            self._port_col_cache.clear()
+
+    # -- feasibility columns ------------------------------------------
+    def port_used_col(self, port: int) -> np.ndarray:
+        """bool[N]: is this host port already used on each node?"""
+        col = self._port_col_cache.get(port)
+        if col is None:
+            bit = 1 << port
+            col = np.fromiter(((b & bit) != 0 for b in self._net_bits),
+                              dtype=bool, count=self.n)
+            self._port_col_cache[port] = col
+        return col
+
+    def reserved_ports_ok(self, ports: List[int]) -> np.ndarray:
+        """bool[N]: all requested reserved host ports free on the node."""
+        ok = np.ones(self.n, dtype=bool)
+        for p in ports:
+            ok &= ~self.port_used_col(p)
+        return ok
+
+    def driver_mask(self, driver: str) -> np.ndarray:
+        """DriverChecker (feasible.go:398): driver detected AND healthy.
+        Falls back to the attribute form driver.<name>=1."""
+        out = np.zeros(self.n, dtype=bool)
+        for i, node in enumerate(self.nodes):
+            info = node.drivers.get(driver)
+            if info is not None:
+                out[i] = info.detected and info.healthy
+            else:
+                out[i] = node.attributes.get(f"driver.{driver}", "") not in ("", "0", "false")
+        return out
+
+    def dc_mask(self, datacenters: List[str]) -> np.ndarray:
+        dcs = set(datacenters)
+        return np.fromiter((d in dcs for d in self.datacenters),
+                           dtype=bool, count=self.n)
+
+    def host_volume_mask(self, volumes: Dict[str, object]) -> np.ndarray:
+        """HostVolumeChecker (feasible.go:117)."""
+        out = np.ones(self.n, dtype=bool)
+        wanted = [(name, req) for name, req in volumes.items()
+                  if getattr(req, "type", "host") == "host"]
+        if not wanted:
+            return out
+        for i, node in enumerate(self.nodes):
+            for _, req in wanted:
+                vol = node.host_volumes.get(req.source)
+                if vol is None:
+                    out[i] = False
+                    break
+                if getattr(req, "read_only", False) is False and vol.get("read_only", False):
+                    out[i] = False
+                    break
+        return out
+
+    def attr_codes(self, attribute: str) -> Tuple[np.ndarray, List[str]]:
+        """Dictionary-encode one attribute over nodes.
+        Returns (codes i32[N] with code==len(values) meaning missing,
+        values list)."""
+        vals, found = self.cols.resolve(attribute)
+        mapping: Dict[str, int] = {}
+        codes = np.zeros(self.n, dtype=np.int32)
+        for i in range(self.n):
+            if not found[i]:
+                codes[i] = -1
+                continue
+            v = vals[i]
+            c = mapping.get(v)
+            if c is None:
+                c = len(mapping)
+                mapping[v] = c
+            codes[i] = c
+        values = list(mapping.keys())
+        missing = len(values)
+        codes[codes == -1] = missing
+        return codes, values
+
+
+class ProposedIndex:
+    """Per-eval view of the job's proposed allocations: existing live
+    allocs of this job plus the in-flight plan, minus stops/preemptions
+    (context.go:120-157 ProposedAllocs), projected onto node indices."""
+
+    def __init__(self, table: NodeTable, job, existing_allocs: List,
+                 plan=None):
+        self.table = table
+        self.job = job
+        n = table.n
+        # per-node usage delta from the plan (stops/preemptions free
+        # resources; in-flight placements consume them)
+        self.plan_delta = np.zeros((n, RES_DIMS), dtype=np.float32)
+        # counts of this job's proposed allocs per node / per task group
+        self.job_count = np.zeros(n, dtype=np.int32)
+        self.tg_count: Dict[str, np.ndarray] = {}
+        # job's proposed allocs grouped by node idx (for property counts)
+        self.job_allocs_by_node: Dict[int, List] = {}
+
+        stopped_ids = set()
+        if plan is not None:
+            for allocs in plan.node_update.values():
+                for a in allocs:
+                    stopped_ids.add(a.id)
+            for allocs in plan.node_preemptions.values():
+                for a in allocs:
+                    stopped_ids.add(a.id)
+
+        for a in existing_allocs:
+            if a.terminal_status() or a.id in stopped_ids:
+                continue
+            i = table.id_to_idx.get(a.node_id)
+            if i is None:
+                continue
+            self._count(i, a)
+
+        if plan is not None:
+            # stops/preemptions of *any* job free resources on the node
+            all_stopped = {}
+            for allocs in plan.node_update.values():
+                for a in allocs:
+                    all_stopped[a.id] = a
+            for allocs in plan.node_preemptions.values():
+                for a in allocs:
+                    all_stopped.setdefault(a.id, a)
+            for a in all_stopped.values():
+                i = table.id_to_idx.get(a.node_id)
+                if i is None:
+                    continue
+                # the stub may lack resources; look it up in live allocs
+                usage = _alloc_usage(a)
+                if usage == (0.0, 0.0, 0.0):
+                    for live in table.live_allocs[i]:
+                        if live.id == a.id:
+                            usage = _alloc_usage(live)
+                            break
+                self.plan_delta[i] -= usage
+            for node_id, allocs in plan.node_allocation.items():
+                i = table.id_to_idx.get(node_id)
+                if i is None:
+                    continue
+                for a in allocs:
+                    self.plan_delta[i] += _alloc_usage(a)
+                    if a.job_id == job.id and a.namespace == job.namespace:
+                        self._count(i, a)
+
+    def _count(self, i: int, alloc) -> None:
+        self.job_count[i] += 1
+        tg = alloc.task_group
+        arr = self.tg_count.get(tg)
+        if arr is None:
+            arr = np.zeros(self.table.n, dtype=np.int32)
+            self.tg_count[tg] = arr
+        arr[i] += 1
+        self.job_allocs_by_node.setdefault(i, []).append(alloc)
+
+    def used(self) -> np.ndarray:
+        """f32[N,3] effective usage: live + plan overlay."""
+        return self.table.base_used + self.plan_delta
+
+    def tg_counts(self, tg_name: str) -> np.ndarray:
+        arr = self.tg_count.get(tg_name)
+        if arr is None:
+            return np.zeros(self.table.n, dtype=np.int32)
+        return arr
+
+    def property_counts(self, attribute: str, values: List[str],
+                        tg_name: Optional[str] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """(counts f32[C+1], present bool[C+1]) of this job's proposed
+        allocs per attribute value (propertyset.go UsedCount semantics;
+        tg_name restricts to one task group). Index C is the
+        missing-attribute bucket."""
+        c = len(values)
+        counts = np.zeros(c + 1, dtype=np.float32)
+        present = np.zeros(c + 1, dtype=bool)
+        code_of = {v: i for i, v in enumerate(values)}
+        vals, found = self.table.cols.resolve(attribute)
+        for i, allocs in self.job_allocs_by_node.items():
+            if not found[i]:
+                continue
+            code = code_of.get(vals[i])
+            if code is None:
+                continue
+            for a in allocs:
+                if tg_name is not None and a.task_group != tg_name:
+                    continue
+                counts[code] += 1
+                present[code] = True
+        return counts, present

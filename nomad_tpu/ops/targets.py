@@ -1,0 +1,199 @@
+"""Vectorized constraint/affinity evaluation over the node axis.
+
+This is the columnar rewrite of scheduler/feasible.go's per-node
+checkConstraint (:750-785) and resolveTarget (:713): a constraint
+becomes one bool[N] mask over the whole node table. Non-tensorizable
+operands (regexp, version, semver, set_contains) are evaluated once per
+*distinct attribute value* and broadcast through an inverse index —
+nodes overwhelmingly share attribute values (that's why the reference's
+computed-class memoization works, feasible.go:1026-1118), so this does
+O(distinct) expensive checks instead of O(N).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.job import (
+    CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY,
+    CONSTRAINT_IS_NOT_SET, CONSTRAINT_IS_SET, CONSTRAINT_REGEX,
+    CONSTRAINT_SEMVER, CONSTRAINT_SET_CONTAINS, CONSTRAINT_SET_CONTAINS_ALL,
+    CONSTRAINT_SET_CONTAINS_ANY, CONSTRAINT_VERSION,
+)
+from .versions import version_matches
+
+
+class TargetColumns:
+    """Resolves constraint targets to (values, found) columns over nodes,
+    with caching. Values are numpy object arrays of str (or None)."""
+
+    def __init__(self, nodes: List):
+        self.nodes = nodes
+        self.n = len(nodes)
+        self._cache: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+
+    def resolve(self, target: str) -> Tuple[np.ndarray, np.ndarray]:
+        """(values: object[N], found: bool[N]) for one target expression."""
+        hit = self._cache.get(target)
+        if hit is not None:
+            return hit
+        n = self.n
+        values = np.empty(n, dtype=object)
+        found = np.zeros(n, dtype=bool)
+        if not target.startswith("${"):
+            values[:] = target
+            found[:] = True
+        elif target == "${node.unique.id}":
+            for i, node in enumerate(self.nodes):
+                values[i] = node.id
+            found[:] = True
+        elif target == "${node.datacenter}":
+            for i, node in enumerate(self.nodes):
+                values[i] = node.datacenter
+            found[:] = True
+        elif target == "${node.unique.name}":
+            for i, node in enumerate(self.nodes):
+                values[i] = node.name
+            found[:] = True
+        elif target == "${node.class}":
+            for i, node in enumerate(self.nodes):
+                values[i] = node.node_class
+            found[:] = True
+        elif target.startswith("${attr."):
+            attr = target[len("${attr."):].removesuffix("}")
+            for i, node in enumerate(self.nodes):
+                v = node.attributes.get(attr)
+                if v is not None:
+                    values[i] = v
+                    found[i] = True
+        elif target.startswith("${meta."):
+            meta = target[len("${meta."):].removesuffix("}")
+            for i, node in enumerate(self.nodes):
+                v = node.meta.get(meta)
+                if v is not None:
+                    values[i] = v
+                    found[i] = True
+        # unknown interpolation: nothing found (reference returns nil, false)
+        self._cache[target] = (values, found)
+        return values, found
+
+
+def _per_distinct(values: np.ndarray, found: np.ndarray, fn) -> np.ndarray:
+    """Apply fn(value_str)->bool once per distinct found value, broadcast."""
+    out = np.zeros(len(values), dtype=bool)
+    if not found.any():
+        return out
+    idx = np.nonzero(found)[0]
+    strs = values[idx]
+    distinct: Dict[str, bool] = {}
+    res = np.zeros(len(idx), dtype=bool)
+    for j, s in enumerate(strs):
+        r = distinct.get(s)
+        if r is None:
+            r = fn(s)
+            distinct[s] = r
+        res[j] = r
+    out[idx] = res
+    return out
+
+
+def _check_set_contains_all(lval: str, rval: str) -> bool:
+    have = {p.strip() for p in lval.split(",")}
+    return all(p.strip() in have for p in rval.split(","))
+
+
+def _check_set_contains_any(lval: str, rval: str) -> bool:
+    have = {p.strip() for p in lval.split(",")}
+    return any(p.strip() in have for p in rval.split(","))
+
+
+_REGEX_CACHE: Dict[str, Optional[re.Pattern]] = {}
+
+
+def _regex(pattern: str) -> Optional[re.Pattern]:
+    p = _REGEX_CACHE.get(pattern)
+    if p is None and pattern not in _REGEX_CACHE:
+        try:
+            p = re.compile(pattern)
+        except re.error:
+            p = None
+        _REGEX_CACHE[pattern] = p
+    return p
+
+
+def constraint_mask(cols: TargetColumns, ltarget: str, rtarget: str,
+                    operand: str) -> np.ndarray:
+    """bool[N]: does each node satisfy the constraint?
+    Mirrors checkConstraint (feasible.go:750-785)."""
+    n = cols.n
+    # handled by dedicated stateful checkers, pass-through here
+    if operand in (CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY):
+        return np.ones(n, dtype=bool)
+
+    lvals, lfound = cols.resolve(ltarget)
+    rvals, rfound = cols.resolve(rtarget) if rtarget else (
+        np.empty(n, dtype=object), np.zeros(n, dtype=bool))
+
+    if operand in ("=", "==", "is"):
+        return lfound & rfound & np.asarray(lvals == rvals, dtype=bool)
+    if operand in ("!=", "not"):
+        # reference: !reflect.DeepEqual(lVal, rVal) — unfound sides are nil
+        l = np.where(lfound, lvals, None)
+        r = np.where(rfound, rvals, None)
+        return np.asarray(l != r, dtype=bool)
+    if operand in ("<", "<=", ">", ">="):
+        ok = lfound & rfound
+        out = np.zeros(n, dtype=bool)
+        idx = np.nonzero(ok)[0]
+        for i in idx:
+            l, r = lvals[i], rvals[i]
+            if not isinstance(l, str) or not isinstance(r, str):
+                continue
+            out[i] = ((operand == "<" and l < r) or
+                      (operand == "<=" and l <= r) or
+                      (operand == ">" and l > r) or
+                      (operand == ">=" and l >= r))
+        return out
+    if operand == CONSTRAINT_IS_SET:
+        return lfound.copy()
+    if operand == CONSTRAINT_IS_NOT_SET:
+        return ~lfound
+    if operand == CONSTRAINT_VERSION:
+        rv = rtarget
+        return lfound & rfound & _per_distinct(
+            lvals, lfound, lambda s: version_matches(s, rv))
+    if operand == CONSTRAINT_SEMVER:
+        rv = rtarget
+        return lfound & rfound & _per_distinct(
+            lvals, lfound, lambda s: version_matches(s, rv, strict_semver=True))
+    if operand == CONSTRAINT_REGEX:
+        pat = _regex(rtarget)
+        if pat is None:
+            return np.zeros(n, dtype=bool)
+        return lfound & rfound & _per_distinct(
+            lvals, lfound, lambda s: pat.search(s) is not None)
+    if operand in (CONSTRAINT_SET_CONTAINS, CONSTRAINT_SET_CONTAINS_ALL):
+        rv = rtarget
+        return lfound & rfound & _per_distinct(
+            lvals, lfound, lambda s: _check_set_contains_all(s, rv))
+    if operand == CONSTRAINT_SET_CONTAINS_ANY:
+        rv = rtarget
+        return lfound & rfound & _per_distinct(
+            lvals, lfound, lambda s: _check_set_contains_any(s, rv))
+    return np.zeros(n, dtype=bool)
+
+
+def affinity_columns(cols: TargetColumns, affinities: List) -> Tuple[np.ndarray, float]:
+    """(weighted_match_sum: f32[N], sum_abs_weights) for NodeAffinityIterator
+    (rank.go:637-668): score = sum(weight * matches) / sum(|weight|)."""
+    n = cols.n
+    total = np.zeros(n, dtype=np.float32)
+    sum_weight = 0.0
+    for aff in affinities:
+        sum_weight += abs(float(aff.weight))
+        mask = constraint_mask(cols, aff.ltarget, aff.rtarget, aff.operand)
+        total += mask.astype(np.float32) * float(aff.weight)
+    return total, sum_weight

@@ -1,0 +1,109 @@
+"""Version parsing and constraint matching.
+
+Reference behavior: scheduler/feasible.go checkVersionMatch uses
+hashicorp/go-version (lenient) for the "version" operand and a strict
+semver mode for "semver" (feasible.go newVersionConstraintParser /
+newSemverConstraintParser). We implement the subset of both actually
+used by constraints: comparison operators =, !=, >, >=, <, <=, ~>
+(pessimistic), comma-separated conjunctions.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+_VERSION_RE = re.compile(
+    r"^v?(\d+(?:\.\d+)*)(?:-([0-9A-Za-z.-]+))?(?:\+([0-9A-Za-z.-]+))?$")
+
+
+class Version:
+    __slots__ = ("segments", "prerelease")
+
+    def __init__(self, segments: Tuple[int, ...], prerelease: str):
+        self.segments = segments
+        self.prerelease = prerelease
+
+    @classmethod
+    def parse(cls, s: str) -> Optional["Version"]:
+        m = _VERSION_RE.match(s.strip())
+        if not m:
+            return None
+        segs = tuple(int(p) for p in m.group(1).split("."))
+        # normalize to 3 segments for comparison (go-version pads)
+        segs = segs + (0,) * (3 - len(segs)) if len(segs) < 3 else segs
+        return cls(segs, m.group(2) or "")
+
+    def _cmp_key(self):
+        # a prerelease sorts before the release itself
+        return (self.segments, 0 if self.prerelease == "" else -1,
+                self.prerelease)
+
+    def compare(self, other: "Version") -> int:
+        a, b = self.segments, other.segments
+        if a != b:
+            return -1 if a < b else 1
+        if self.prerelease == other.prerelease:
+            return 0
+        if self.prerelease == "":
+            return 1
+        if other.prerelease == "":
+            return -1
+        return -1 if self.prerelease < other.prerelease else 1
+
+
+_CONSTRAINT_RE = re.compile(r"^\s*(~>|>=|<=|!=|=|>|<)?\s*(.+?)\s*$")
+
+
+def parse_constraints(spec: str) -> Optional[List[Tuple[str, Version]]]:
+    out = []
+    for part in spec.split(","):
+        m = _CONSTRAINT_RE.match(part)
+        if not m or not m.group(2):
+            return None
+        op = m.group(1) or "="
+        v = Version.parse(m.group(2))
+        if v is None:
+            return None
+        out.append((op, v))
+    return out
+
+
+def _check_one(op: str, have: Version, want: Version) -> bool:
+    c = have.compare(want)
+    if op == "=":
+        return c == 0
+    if op == "!=":
+        return c != 0
+    if op == ">":
+        return c > 0
+    if op == ">=":
+        return c >= 0
+    if op == "<":
+        return c < 0
+    if op == "<=":
+        return c <= 0
+    if op == "~>":
+        # pessimistic: >= want and < next significant release
+        if c < 0:
+            return False
+        want_segs = want.segments
+        if len(want_segs) <= 1:
+            return have.segments[0] == want_segs[0]
+        upper = want_segs[:-2] + (want_segs[-2] + 1,)
+        return have.segments[:len(upper) - 1] == upper[:-1] and \
+            have.segments[len(upper) - 1] < upper[-1]
+    return False
+
+
+def version_matches(version_str: str, constraint_str: str,
+                    strict_semver: bool = False) -> bool:
+    v = Version.parse(version_str)
+    if v is None:
+        return False
+    if strict_semver and not re.match(r"^\d+\.\d+\.\d+(-|\+|$)", version_str.strip()):
+        return False
+    constraints = parse_constraints(constraint_str)
+    if constraints is None:
+        return False
+    return all(_check_one(op, v, want) for op, want in constraints)

@@ -1,0 +1,3 @@
+from .store import StateStore, StateSnapshot
+
+__all__ = ["StateStore", "StateSnapshot"]

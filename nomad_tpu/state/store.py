@@ -1,0 +1,690 @@
+"""MVCC in-memory state store with O(1) immutable snapshots and blocking
+watches — the go-memdb equivalent.
+
+Reference semantics: nomad/state/state_store.go (StateStore:64, 21-table
+schema at nomad/state/schema.go:36-62, SnapshotMinIndex:186) and the FSM
+mutations in nomad/fsm.go. Tables are persistent HAMTs: a write
+transaction path-copies the touched tables and atomically publishes a new
+root; readers (schedulers) hold their root forever at O(1) cost — this is
+what makes optimistic concurrent scheduling cheap.
+
+Secondary indexes (allocs by node/job/eval, evals by job) are nested
+HAMTs maintained in the same transaction.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from ..models import (
+    Allocation, Deployment, Evaluation, Job, Node, SchedulerConfiguration,
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
+    ALLOC_CLIENT_RUNNING, ALLOC_CLIENT_PENDING,
+    ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT,
+    EVAL_STATUS_BLOCKED,
+    JOB_STATUS_DEAD, JOB_STATUS_PENDING, JOB_STATUS_RUNNING,
+    NODE_SCHED_ELIGIBLE, NODE_SCHED_INELIGIBLE,
+)
+from ..models.deployment import DeploymentStatusUpdate
+from ..utils.hamt import Hamt
+
+
+@dataclass
+class JobSummary:
+    """Per-TG alloc status counts (structs.go JobSummary)."""
+    job_id: str = ""
+    namespace: str = "default"
+    summary: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    children_pending: int = 0
+    children_running: int = 0
+    children_dead: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+
+
+class _Root:
+    """One immutable version of the whole database."""
+
+    __slots__ = ("tables", "indexes")
+
+    def __init__(self, tables: Hamt, indexes: Hamt):
+        self.tables = tables      # name -> Hamt(primary key -> object)
+        self.indexes = indexes    # table name -> last modify index
+
+    def table(self, name: str) -> Hamt:
+        return self.tables.get(name) or Hamt()
+
+    def with_table(self, name: str, t: Hamt) -> "_Root":
+        return _Root(self.tables.set(name, t), self.indexes)
+
+    def with_index(self, name: str, idx: int) -> "_Root":
+        return _Root(self.tables, self.indexes.set(name, idx))
+
+
+TABLES = (
+    "nodes", "jobs", "job_versions", "evals", "allocs", "deployments",
+    "job_summaries", "scheduler_config", "periodic_launches",
+    # secondary indexes
+    "allocs_by_node", "allocs_by_job", "allocs_by_eval", "evals_by_job",
+    "deployments_by_job",
+)
+
+JOB_TRACKED_VERSIONS = 6  # structs.go JobTrackedVersions
+
+
+class StateSnapshot:
+    """A read-only view at one index. Safe to hold across scheduler runs."""
+
+    def __init__(self, root: _Root):
+        self._root = root
+
+    # -- index bookkeeping --------------------------------------------
+    def index(self, table: str) -> int:
+        return self._root.indexes.get(table, 0)
+
+    def latest_index(self) -> int:
+        return max([0] + list(self._root.indexes.values()))
+
+    # -- nodes ---------------------------------------------------------
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._root.table("nodes").get(node_id)
+
+    def nodes(self) -> List[Node]:
+        return list(self._root.table("nodes").values())
+
+    def node_by_prefix(self, prefix: str) -> List[Node]:
+        return [n for n in self.nodes() if n.id.startswith(prefix)]
+
+    # -- jobs ----------------------------------------------------------
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self._root.table("jobs").get((namespace, job_id))
+
+    def jobs(self, namespace: Optional[str] = None) -> List[Job]:
+        out = self._root.table("jobs").values()
+        if namespace is None:
+            return list(out)
+        return [j for j in out if j.namespace == namespace]
+
+    def job_versions(self, namespace: str, job_id: str) -> List[Job]:
+        versions = self._root.table("job_versions").get((namespace, job_id))
+        if not versions:
+            return []
+        return sorted(versions.values(), key=lambda j: -j.version)
+
+    def job_by_id_and_version(self, namespace: str, job_id: str,
+                              version: int) -> Optional[Job]:
+        versions = self._root.table("job_versions").get((namespace, job_id))
+        if not versions:
+            return None
+        return versions.get(version)
+
+    def job_summary(self, namespace: str, job_id: str) -> Optional[JobSummary]:
+        return self._root.table("job_summaries").get((namespace, job_id))
+
+    # -- evals ---------------------------------------------------------
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._root.table("evals").get(eval_id)
+
+    def evals(self) -> List[Evaluation]:
+        return list(self._root.table("evals").values())
+
+    def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
+        ids = self._root.table("evals_by_job").get((namespace, job_id))
+        if not ids:
+            return []
+        table = self._root.table("evals")
+        return [table[i] for i in ids.keys()]
+
+    # -- allocs --------------------------------------------------------
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._root.table("allocs").get(alloc_id)
+
+    def allocs(self) -> List[Allocation]:
+        return list(self._root.table("allocs").values())
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        return self._by_index("allocs_by_node", node_id, "allocs")
+
+    def allocs_by_node_terminal(self, node_id: str,
+                                terminal: bool) -> List[Allocation]:
+        return [a for a in self.allocs_by_node(node_id)
+                if a.terminal_status() == terminal]
+
+    def allocs_by_job(self, namespace: str, job_id: str,
+                      anyCreateIndex: bool = True) -> List[Allocation]:
+        return self._by_index("allocs_by_job", (namespace, job_id), "allocs")
+
+    def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
+        return self._by_index("allocs_by_eval", eval_id, "allocs")
+
+    def allocs_by_deployment(self, deployment_id: str) -> List[Allocation]:
+        return [a for a in self.allocs() if a.deployment_id == deployment_id]
+
+    def _by_index(self, index_table: str, key, target: str) -> List:
+        ids = self._root.table(index_table).get(key)
+        if not ids:
+            return []
+        table = self._root.table(target)
+        return [table[i] for i in ids.keys()]
+
+    # -- deployments ---------------------------------------------------
+    def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        return self._root.table("deployments").get(deployment_id)
+
+    def deployments(self) -> List[Deployment]:
+        return list(self._root.table("deployments").values())
+
+    def deployments_by_job(self, namespace: str, job_id: str) -> List[Deployment]:
+        return self._by_index("deployments_by_job", (namespace, job_id),
+                              "deployments")
+
+    def latest_deployment_by_job(self, namespace: str,
+                                 job_id: str) -> Optional[Deployment]:
+        ds = self.deployments_by_job(namespace, job_id)
+        if not ds:
+            return None
+        return max(ds, key=lambda d: d.create_index)
+
+    # -- config --------------------------------------------------------
+    def scheduler_config(self) -> SchedulerConfiguration:
+        return (self._root.table("scheduler_config").get("config")
+                or SchedulerConfiguration())
+
+
+class StateStore(StateSnapshot):
+    """The mutable handle: all writes go through FSM-style apply methods
+    that stamp a raft-like index and notify blocked watchers."""
+
+    def __init__(self):
+        root = _Root(Hamt(), Hamt())
+        super().__init__(root)
+        self._lock = threading.Lock()
+        self._watch = threading.Condition()
+
+    # -- snapshot / blocking ------------------------------------------
+    def snapshot(self) -> StateSnapshot:
+        return StateSnapshot(self._root)
+
+    def snapshot_min_index(self, index: int, timeout_s: float = 5.0) -> StateSnapshot:
+        """Wait until the store has caught up to `index`, then snapshot
+        (state_store.go:186 SnapshotMinIndex — the scheduler's raft fence)."""
+        deadline = time.monotonic() + timeout_s
+        with self._watch:
+            while self.latest_index() < index:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"timeout waiting for state at index {index} "
+                        f"(have {self.latest_index()})")
+                self._watch.wait(remaining)
+        return self.snapshot()
+
+    def block_min_index(self, index: int, timeout_s: float) -> bool:
+        """Blocking-query support: wait for any write past `index`."""
+        deadline = time.monotonic() + timeout_s
+        with self._watch:
+            while self.latest_index() <= index:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._watch.wait(remaining)
+            return True
+
+    def _publish(self, root: _Root) -> None:
+        self._root = root
+        with self._watch:
+            self._watch.notify_all()
+
+    # -- helpers -------------------------------------------------------
+    @staticmethod
+    def _index_add(root: _Root, table: str, key, member) -> _Root:
+        t = root.table(table)
+        members = t.get(key) or Hamt()
+        return root.with_table(table, t.set(key, members.set(member, True)))
+
+    @staticmethod
+    def _index_del(root: _Root, table: str, key, member) -> _Root:
+        t = root.table(table)
+        members = t.get(key)
+        if members is None:
+            return root
+        members = members.delete(member)
+        if len(members) == 0:
+            return root.with_table(table, t.delete(key))
+        return root.with_table(table, t.set(key, members))
+
+    # -- nodes ---------------------------------------------------------
+    def upsert_node(self, index: int, node: Node) -> None:
+        with self._lock:
+            root = self._root
+            existing = root.table("nodes").get(node.id)
+            if existing is not None:
+                node.create_index = existing.create_index
+                # preserve operator-set fields across re-registration
+                node.drain = existing.drain
+                node.drain_strategy = existing.drain_strategy
+                node.scheduling_eligibility = existing.scheduling_eligibility
+            else:
+                node.create_index = index
+            node.modify_index = index
+            node.canonicalize()
+            if not node.computed_class:
+                node.compute_class()
+            root = root.with_table("nodes", root.table("nodes").set(node.id, node))
+            root = root.with_index("nodes", index)
+            self._publish(root)
+
+    def delete_node(self, index: int, node_ids: List[str]) -> None:
+        with self._lock:
+            root = self._root
+            t = root.table("nodes")
+            for nid in node_ids:
+                t = t.delete(nid)
+            root = root.with_table("nodes", t).with_index("nodes", index)
+            self._publish(root)
+
+    def update_node_status(self, index: int, node_id: str, status: str,
+                           updated_at: int = 0) -> None:
+        with self._lock:
+            self._update_node(index, node_id,
+                              status=status, status_updated_at=updated_at)
+
+    def update_node_eligibility(self, index: int, node_id: str,
+                                eligibility: str) -> None:
+        with self._lock:
+            self._update_node(index, node_id, scheduling_eligibility=eligibility)
+
+    def update_node_drain(self, index: int, node_id: str, drain_strategy,
+                          mark_eligible: bool = False) -> None:
+        with self._lock:
+            node = self._root.table("nodes").get(node_id)
+            if node is None:
+                raise KeyError(f"node {node_id} not found")
+            eligibility = node.scheduling_eligibility
+            if drain_strategy is not None:
+                eligibility = NODE_SCHED_INELIGIBLE
+            elif mark_eligible:
+                eligibility = NODE_SCHED_ELIGIBLE
+            self._update_node(index, node_id,
+                              drain=drain_strategy is not None,
+                              drain_strategy=drain_strategy,
+                              scheduling_eligibility=eligibility)
+
+    def _update_node(self, index: int, node_id: str, **changes) -> None:
+        root = self._root
+        node = root.table("nodes").get(node_id)
+        if node is None:
+            raise KeyError(f"node {node_id} not found")
+        node = replace(node, modify_index=index, **changes)
+        root = root.with_table("nodes", root.table("nodes").set(node_id, node))
+        root = root.with_index("nodes", index)
+        self._publish(root)
+
+    # -- jobs ----------------------------------------------------------
+    def upsert_job(self, index: int, job: Job) -> None:
+        with self._lock:
+            root = self._root
+            key = job.namespaced_id()
+            existing = root.table("jobs").get(key)
+            if existing is not None:
+                job.create_index = existing.create_index
+                job.job_modify_index = index
+                if existing.specchanged(job):
+                    job.version = existing.version + 1
+                else:
+                    job.version = existing.version
+            else:
+                job.create_index = index
+                job.job_modify_index = index
+                job.version = 0
+            job.modify_index = index
+            if job.status == "":
+                job.status = JOB_STATUS_PENDING
+            root = root.with_table("jobs", root.table("jobs").set(key, job))
+            # version history (pruned to JOB_TRACKED_VERSIONS)
+            versions = root.table("job_versions").get(key) or Hamt()
+            versions = versions.set(job.version, job)
+            if len(versions) > JOB_TRACKED_VERSIONS:
+                oldest = min(versions.keys())
+                versions = versions.delete(oldest)
+            root = root.with_table("job_versions",
+                                   root.table("job_versions").set(key, versions))
+            root = self._ensure_job_summary(root, index, job)
+            root = root.with_index("jobs", index)
+            self._publish(root)
+
+    def delete_job(self, index: int, namespace: str, job_id: str) -> None:
+        with self._lock:
+            root = self._root
+            key = (namespace, job_id)
+            root = root.with_table("jobs", root.table("jobs").delete(key))
+            root = root.with_table("job_versions",
+                                   root.table("job_versions").delete(key))
+            root = root.with_table("job_summaries",
+                                   root.table("job_summaries").delete(key))
+            root = root.with_index("jobs", index).with_index("job_summaries", index)
+            self._publish(root)
+
+    def _ensure_job_summary(self, root: _Root, index: int, job: Job) -> _Root:
+        key = job.namespaced_id()
+        summaries = root.table("job_summaries")
+        existing = summaries.get(key)
+        if existing is None:
+            s = JobSummary(job_id=job.id, namespace=job.namespace,
+                           create_index=index, modify_index=index)
+            for tg in job.task_groups:
+                s.summary[tg.name] = {}
+        else:
+            s = existing
+            for tg in job.task_groups:
+                s.summary.setdefault(tg.name, {})
+            s.modify_index = index
+        return root.with_table("job_summaries", summaries.set(key, s)) \
+                   .with_index("job_summaries", index)
+
+    # -- evals ---------------------------------------------------------
+    def upsert_evals(self, index: int, evals: List[Evaluation]) -> None:
+        with self._lock:
+            root = self._root
+            for e in evals:
+                root = self._upsert_eval_impl(root, index, e)
+            root = root.with_index("evals", index)
+            self._publish(root)
+
+    def _upsert_eval_impl(self, root: _Root, index: int, e: Evaluation) -> _Root:
+        existing = root.table("evals").get(e.id)
+        if existing is not None:
+            e.create_index = existing.create_index
+        else:
+            e.create_index = index
+        e.modify_index = index
+        root = root.with_table("evals", root.table("evals").set(e.id, e))
+        root = self._index_add(root, "evals_by_job", (e.namespace, e.job_id), e.id)
+        # cancel older blocked evals for the same job (fsm.go applyUpsertEvals
+        # -> state_store nested blocked-eval dedup happens broker-side; the
+        # store just records)
+        return root
+
+    def delete_evals(self, index: int, eval_ids: List[str],
+                     alloc_ids: Optional[List[str]] = None) -> None:
+        with self._lock:
+            root = self._root
+            for eid in eval_ids:
+                e = root.table("evals").get(eid)
+                if e is None:
+                    continue
+                root = root.with_table("evals", root.table("evals").delete(eid))
+                root = self._index_del(root, "evals_by_job",
+                                       (e.namespace, e.job_id), eid)
+            for aid in (alloc_ids or []):
+                root = self._delete_alloc_impl(root, aid)
+            root = root.with_index("evals", index).with_index("allocs", index)
+            self._publish(root)
+
+    # -- allocs --------------------------------------------------------
+    def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
+        with self._lock:
+            root = self._root
+            for a in allocs:
+                root = self._upsert_alloc_impl(root, index, a)
+            root = root.with_index("allocs", index)
+            self._publish(root)
+
+    def _upsert_alloc_impl(self, root: _Root, index: int, a: Allocation) -> _Root:
+        existing: Optional[Allocation] = root.table("allocs").get(a.id)
+        if existing is not None:
+            a.create_index = existing.create_index
+            # A plan's stop/evict stub carries no job/resources: inherit
+            # (fsm.go UpsertAllocs keeps existing fields on update)
+            if a.job is None:
+                a.job = existing.job
+            if a.allocated_resources is None:
+                a.allocated_resources = existing.allocated_resources
+            if not a.name:
+                a.name = existing.name
+            if not a.node_id:
+                a.node_id = existing.node_id
+            if not a.job_id:
+                a.job_id = existing.job_id
+            if not a.task_group:
+                a.task_group = existing.task_group
+            if not a.eval_id:
+                a.eval_id = existing.eval_id
+            if a.client_status == ALLOC_CLIENT_PENDING and existing.client_status:
+                # server-side updates don't regress client status
+                a.client_status = existing.client_status
+                a.task_states = existing.task_states or a.task_states
+        else:
+            a.create_index = index
+        a.modify_index = index
+        a.alloc_modify_index = index
+        root = root.with_table("allocs", root.table("allocs").set(a.id, a))
+        if existing is None:
+            root = self._index_add(root, "allocs_by_node", a.node_id, a.id)
+            root = self._index_add(root, "allocs_by_job",
+                                   (a.namespace, a.job_id), a.id)
+            root = self._index_add(root, "allocs_by_eval", a.eval_id, a.id)
+        elif existing.node_id != a.node_id:
+            root = self._index_del(root, "allocs_by_node", existing.node_id, a.id)
+            root = self._index_add(root, "allocs_by_node", a.node_id, a.id)
+        root = self._update_summary_for_alloc(root, index, existing, a)
+        return root
+
+    def _delete_alloc_impl(self, root: _Root, alloc_id: str) -> _Root:
+        a = root.table("allocs").get(alloc_id)
+        if a is None:
+            return root
+        root = root.with_table("allocs", root.table("allocs").delete(alloc_id))
+        root = self._index_del(root, "allocs_by_node", a.node_id, alloc_id)
+        root = self._index_del(root, "allocs_by_job",
+                               (a.namespace, a.job_id), alloc_id)
+        root = self._index_del(root, "allocs_by_eval", a.eval_id, alloc_id)
+        return root
+
+    def update_allocs_from_client(self, index: int,
+                                  allocs: List[Allocation]) -> None:
+        """Client pushes task states / client status (node_endpoint.go:1065)."""
+        with self._lock:
+            root = self._root
+            for update in allocs:
+                existing = root.table("allocs").get(update.id)
+                if existing is None:
+                    continue
+                merged = replace(
+                    existing,
+                    client_status=update.client_status,
+                    client_description=update.client_description,
+                    task_states=update.task_states or existing.task_states,
+                    deployment_status=(update.deployment_status
+                                       or existing.deployment_status),
+                    modify_index=index,
+                    modify_time=update.modify_time or existing.modify_time,
+                )
+                root = root.with_table("allocs",
+                                       root.table("allocs").set(merged.id, merged))
+                root = self._update_summary_for_alloc(root, index, existing, merged)
+                root = self._maybe_update_deployment_health(root, index, merged)
+            root = root.with_index("allocs", index)
+            self._publish(root)
+
+    def _maybe_update_deployment_health(self, root: _Root, index: int,
+                                        alloc: Allocation) -> _Root:
+        if not alloc.deployment_id or alloc.deployment_status is None:
+            return root
+        d: Optional[Deployment] = root.table("deployments").get(alloc.deployment_id)
+        if d is None or not d.active():
+            return root
+        state = d.task_groups.get(alloc.task_group)
+        if state is None:
+            return root
+        # recount healthy/unhealthy from allocs of this deployment
+        healthy = unhealthy = 0
+        for a in root.table("allocs").values():
+            if a.deployment_id != d.id or a.task_group != alloc.task_group:
+                continue
+            ds = a.deployment_status if a.id != alloc.id else alloc.deployment_status
+            if ds is None or ds.healthy is None:
+                continue
+            if ds.healthy:
+                healthy += 1
+            else:
+                unhealthy += 1
+        new_state = replace(state, healthy_allocs=healthy,
+                            unhealthy_allocs=unhealthy)
+        d = replace(d, task_groups={**d.task_groups,
+                                    alloc.task_group: new_state},
+                    modify_index=index)
+        return root.with_table("deployments",
+                               root.table("deployments").set(d.id, d)) \
+                   .with_index("deployments", index)
+
+    # -- job summary maintenance --------------------------------------
+    def _update_summary_for_alloc(self, root: _Root, index: int,
+                                  old: Optional[Allocation],
+                                  new: Allocation) -> _Root:
+        key = (new.namespace, new.job_id)
+        summaries = root.table("job_summaries")
+        s: Optional[JobSummary] = summaries.get(key)
+        if s is None:
+            return root
+        tg = new.task_group
+        counts = dict(s.summary.get(tg, {}))
+
+        def bucket(a: Optional[Allocation]) -> Optional[str]:
+            if a is None:
+                return None
+            cs = a.client_status
+            if cs == ALLOC_CLIENT_PENDING:
+                return "starting"
+            if cs == ALLOC_CLIENT_RUNNING:
+                return "running"
+            if cs == ALLOC_CLIENT_COMPLETE:
+                return "complete"
+            if cs == ALLOC_CLIENT_FAILED:
+                return "failed"
+            if cs == ALLOC_CLIENT_LOST:
+                return "lost"
+            return None
+
+        ob, nb = bucket(old), bucket(new)
+        if ob == nb:
+            if old is not None:
+                return root
+        if ob is not None:
+            counts[ob] = max(0, counts.get(ob, 0) - 1)
+        if nb is not None:
+            counts[nb] = counts.get(nb, 0) + 1
+        new_summary = replace(s, summary={**s.summary, tg: counts},
+                              modify_index=index)
+        return root.with_table("job_summaries", summaries.set(key, new_summary)) \
+                   .with_index("job_summaries", index)
+
+    # -- deployments ---------------------------------------------------
+    def upsert_deployment(self, index: int, deployment: Deployment) -> None:
+        with self._lock:
+            root = self._upsert_deployment_impl(self._root, index, deployment)
+            self._publish(root)
+
+    def _upsert_deployment_impl(self, root: _Root, index: int,
+                                d: Deployment) -> _Root:
+        existing = root.table("deployments").get(d.id)
+        if existing is not None:
+            d.create_index = existing.create_index
+        else:
+            d.create_index = index
+        d.modify_index = index
+        root = root.with_table("deployments",
+                               root.table("deployments").set(d.id, d))
+        if existing is None:
+            root = self._index_add(root, "deployments_by_job",
+                                   (d.namespace, d.job_id), d.id)
+        return root.with_index("deployments", index)
+
+    def update_deployment_status(self, index: int,
+                                 update: DeploymentStatusUpdate,
+                                 job: Optional[Job] = None,
+                                 evals: Optional[List[Evaluation]] = None) -> None:
+        with self._lock:
+            root = self._root
+            d = root.table("deployments").get(update.deployment_id)
+            if d is None:
+                raise KeyError(f"deployment {update.deployment_id} not found")
+            d = replace(d, status=update.status,
+                        status_description=update.status_description,
+                        modify_index=index)
+            root = root.with_table("deployments",
+                                   root.table("deployments").set(d.id, d))
+            root = root.with_index("deployments", index)
+            if job is not None:
+                self._publish(root)
+                self.upsert_job(index, job)
+                root = self._root
+            for e in (evals or []):
+                root = self._upsert_eval_impl(root, index, e)
+            if evals:
+                root = root.with_index("evals", index)
+            self._publish(root)
+
+    # -- plan apply (the commit point) --------------------------------
+    def upsert_plan_results(self, index: int, *,
+                            allocs_stopped: List[Allocation],
+                            allocs_placed: List[Allocation],
+                            allocs_preempted: List[Allocation],
+                            deployment: Optional[Deployment] = None,
+                            deployment_updates: Optional[List[DeploymentStatusUpdate]] = None,
+                            evals: Optional[List[Evaluation]] = None) -> None:
+        """Apply a verified plan atomically (fsm.go ApplyPlanResults /
+        state_store.go UpsertPlanResults)."""
+        with self._lock:
+            root = self._root
+            for a in allocs_stopped:
+                root = self._upsert_alloc_impl(root, index, a)
+            for a in allocs_placed:
+                root = self._upsert_alloc_impl(root, index, a)
+            for a in allocs_preempted:
+                root = self._upsert_alloc_impl(root, index, a)
+            if deployment is not None:
+                root = self._upsert_deployment_impl(root, index, deployment)
+            for du in (deployment_updates or []):
+                d = root.table("deployments").get(du.deployment_id)
+                if d is not None:
+                    d = replace(d, status=du.status,
+                                status_description=du.status_description,
+                                modify_index=index)
+                    root = root.with_table(
+                        "deployments", root.table("deployments").set(d.id, d))
+            for e in (evals or []):
+                root = self._upsert_eval_impl(root, index, e)
+            root = (root.with_index("allocs", index)
+                        .with_index("deployments", index)
+                        .with_index("evals", index))
+            self._publish(root)
+
+    # -- scheduler config ---------------------------------------------
+    def set_scheduler_config(self, index: int,
+                             config: SchedulerConfiguration) -> None:
+        with self._lock:
+            config.modify_index = index
+            root = self._root.with_table(
+                "scheduler_config",
+                self._root.table("scheduler_config").set("config", config))
+            root = root.with_index("scheduler_config", index)
+            self._publish(root)
+
+    # -- job status reconciliation (fsm setJobStatus analog) ----------
+    def set_job_status(self, index: int, namespace: str, job_id: str,
+                       status: str, description: str = "") -> None:
+        with self._lock:
+            root = self._root
+            key = (namespace, job_id)
+            job = root.table("jobs").get(key)
+            if job is None:
+                return
+            job = replace(job, status=status, status_description=description,
+                          modify_index=index)
+            root = root.with_table("jobs", root.table("jobs").set(key, job))
+            root = root.with_index("jobs", index)
+            self._publish(root)

@@ -57,6 +57,10 @@ def from_wire(cls: Any, data: Any) -> Any:
             if k in names:
                 kwargs[k] = from_wire(hints.get(k, Any), v)
         return cls(**kwargs)
+    if cls in (list, tuple, set, frozenset):
+        return cls(data)
+    if cls is dict:
+        return dict(data)
     if origin in (list, tuple, set, frozenset):
         args = get_args(cls)
         elem = args[0] if args else Any

@@ -40,7 +40,7 @@ _SENTINEL = object()
 
 
 def _index(bitmap: int, bit: int) -> int:
-    return bin(bitmap & (bit - 1)).count("1")
+    return (bitmap & (bit - 1)).bit_count()
 
 
 class Hamt:
