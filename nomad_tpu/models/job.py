@@ -302,8 +302,10 @@ class TaskGroup:
                     attempts=0, interval_s=0, unlimited=False)
         if self.ephemeral_disk is None:
             self.ephemeral_disk = EphemeralDisk()
-        if self.update is None and job.type in (JOB_TYPE_SERVICE,):
-            self.update = UpdateStrategy()
+        # NOTE: the update stanza is NOT defaulted here — that is API-layer
+        # behavior in the reference (api/tasks.go), not structs canonicalize;
+        # defaulting it at this layer would create deployments for every
+        # bare service job.
         for t in self.tasks:
             t.canonicalize(job, self)
 

@@ -90,6 +90,18 @@ class Plan:
             if not existing:
                 del self.node_update[alloc.node_id]
 
+    def remove_update(self, alloc: Allocation) -> None:
+        """Remove a staged stop for this alloc wherever it sits in the
+        node's update list (batched placement failure back-out)."""
+        existing = self.node_update.get(alloc.node_id)
+        if not existing:
+            return
+        remaining = [a for a in existing if a.id != alloc.id]
+        if remaining:
+            self.node_update[alloc.node_id] = remaining
+        else:
+            del self.node_update[alloc.node_id]
+
     def is_no_op(self) -> bool:
         return (not self.node_update and not self.node_allocation
                 and self.deployment is None and not self.deployment_updates)

@@ -70,6 +70,7 @@ class SelectRequest:
     affinity: Optional[np.ndarray] = None       # f32[N] weighted sum
     affinity_sum_weights: float = 0.0
     algorithm: str = "binpack"       # "binpack" | "spread"
+    scan_exclusive: bool = False     # reserved-port ask: one instance/node/scan
     port_need: float = 0.0
     free_ports: Optional[np.ndarray] = None     # f32[N]
     port_ok: Optional[np.ndarray] = None        # bool[N]
@@ -99,7 +100,7 @@ class SelectResult:
 
 @partial(jax.jit, static_argnames=("k_steps", "spread_alg", "s_live", "p_live"))
 def _select_scan(capacity, used0, feasible, ask, k_valid,
-                 tg_coll0, job_count0, distinct_hosts_flag,
+                 tg_coll0, job_count0, distinct_hosts_flag, scan_exclusive,
                  penalty, affinity_norm, desired_count,
                  port_need, free_ports, port_ok,
                  sp_codes, sp_counts0, sp_present0, sp_desired,
@@ -116,12 +117,16 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
     cap_mem = jnp.maximum(capacity[:, 1], 1e-9)
 
     def step(carry, step_i):
-        used, tg_coll, job_cnt, sp_counts, sp_present, dp_counts = carry
+        (used, tg_coll, job_cnt, scan_placed, free_p,
+         sp_counts, sp_present, dp_counts) = carry
 
         # ---- feasibility beyond the static mask -----------------------
         feas = feasible
         feas &= jnp.where(distinct_hosts_flag > 0, job_cnt == 0, True)
-        feas &= free_ports >= port_need
+        # reserved-port asks make instances mutually exclusive per node
+        # within this scan (the same host port would collide)
+        feas &= jnp.where(scan_exclusive > 0, scan_placed == 0, True)
+        feas &= free_p >= port_need
         feas &= port_ok
         # distinct_property: count(value)+1 <= limit, missing attr fails
         for p in range(p_live):
@@ -229,6 +234,8 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
         used = used + jnp.where(onehot[:, None], ask[None, :], 0.0)
         tg_coll = tg_coll + onehot.astype(jnp.int32)
         job_cnt = job_cnt + onehot.astype(jnp.int32)
+        scan_placed = scan_placed + onehot.astype(jnp.int32)
+        free_p = free_p - onehot.astype(jnp.float32) * port_need
         c_axis = sp_counts.shape[-1]
         chosen_sp_codes = sp_codes[:, choice]           # [S]
         sp_upd = (jax.nn.one_hot(chosen_sp_codes, c_axis,
@@ -251,9 +258,12 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
                jnp.where(valid, spread_total[jnp.maximum(choice, 0)], 0.0),
                top_idx.astype(jnp.int32), top_scores,
                exhausted, ok.sum().astype(jnp.int32))
-        return (used, tg_coll, job_cnt, sp_counts, sp_present, dp_counts), out
+        return (used, tg_coll, job_cnt, scan_placed, free_p,
+                sp_counts, sp_present, dp_counts), out
 
-    carry0 = (used0, tg_coll0, job_count0, sp_counts0, sp_present0, dp_counts0)
+    carry0 = (used0, tg_coll0, job_count0,
+              jnp.zeros(n, dtype=jnp.int32), free_ports,
+              sp_counts0, sp_present0, dp_counts0)
     carry, outs = jax.lax.scan(step, carry0, jnp.arange(k_steps))
     return carry, outs
 
@@ -331,6 +341,7 @@ class SelectKernel:
             jnp.asarray(req.ask, dtype=jnp.float32), jnp.int32(req.count),
             jnp.asarray(tg_coll), jnp.asarray(job_cnt),
             jnp.float32(1.0 if req.distinct_hosts else 0.0),
+            jnp.float32(1.0 if req.scan_exclusive else 0.0),
             jnp.asarray(penalty), jnp.asarray(affinity_norm),
             jnp.float32(req.desired_count),
             jnp.float32(req.port_need), jnp.asarray(free_ports),

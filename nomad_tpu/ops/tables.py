@@ -221,6 +221,7 @@ class ProposedIndex:
                  plan=None):
         self.table = table
         self.job = job
+        self.plan = plan
         n = table.n
         # per-node usage delta from the plan (stops/preemptions free
         # resources; in-flight placements consume them)

@@ -1,0 +1,17 @@
+"""Host-side schedulers.
+
+The control flow (reconciliation, deployments, retries) mirrors the
+reference's scheduler/ package; placement decisions are delegated to the
+batched device kernels in nomad_tpu/ops.
+
+Factory registry mirrors scheduler/scheduler.go:23-44; the TPU pipeline
+is the default execution backend for every scheduler type (the
+"tpu-batch" scheduler of BASELINE.json is the native mode here, not a
+bolt-on).
+"""
+
+from .scheduler import (Scheduler, SchedulerState, Planner, new_scheduler,
+                        BUILTIN_SCHEDULERS)
+from .generic import GenericScheduler
+from .system import SystemScheduler
+from .harness import Harness

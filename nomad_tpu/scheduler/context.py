@@ -1,0 +1,58 @@
+"""Per-evaluation context: the plan under construction plus eligibility
+bookkeeping carried into blocked evals.
+
+Reference semantics: scheduler/context.go (EvalContext:76,
+EvalEligibility:190). ProposedAllocs overlays live in
+ops/tables.ProposedIndex.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..models import Evaluation, Job, Plan
+
+
+class EvalEligibility:
+    """Tracks class eligibility for blocked evals (context.go:190-356).
+    With full-matrix feasibility we don't memoize per class at eval time
+    (the masks are vectorized), but the blocked-evals subsystem still
+    needs per-class eligibility and the escaped flag."""
+
+    def __init__(self):
+        self.job_escaped = False
+        self.tg_escaped: Dict[str, bool] = {}
+        self.class_eligibility: Dict[str, bool] = {}
+
+    def has_escaped(self) -> bool:
+        return self.job_escaped or any(self.tg_escaped.values())
+
+    def set_job(self, job: Job) -> None:
+        self.job_escaped = _constraints_escaped(job.constraints)
+        for tg in job.task_groups:
+            esc = _constraints_escaped(tg.constraints)
+            for t in tg.tasks:
+                esc = esc or _constraints_escaped(t.constraints)
+            self.tg_escaped[tg.name] = esc
+
+    def set_class_eligibility(self, computed_class: str, eligible: bool) -> None:
+        self.class_eligibility[computed_class] = eligible
+
+
+def _constraints_escaped(constraints) -> bool:
+    """A constraint "escapes" class memoization when it references
+    node-unique properties (structs.go EscapedConstraints)."""
+    for c in constraints:
+        for target in (c.ltarget, c.rtarget):
+            if "${node.unique." in target or "${unique." in target:
+                return True
+    return False
+
+
+class EvalContext:
+    def __init__(self, snapshot, evaluation: Evaluation,
+                 plan: Optional[Plan] = None):
+        self.snapshot = snapshot
+        self.eval = evaluation
+        self.plan = plan or Plan(eval_id=evaluation.id)
+        self.eligibility = EvalEligibility()

@@ -1,0 +1,490 @@
+"""GenericScheduler: service and batch evaluation processing.
+
+Reference semantics: scheduler/generic_sched.go — Process:125 (retry
+loop, 5 service / 2 batch attempts), process:216, computeJobAllocs:332,
+computePlacements:468, blocked-eval creation:193.
+
+The placement inner loop differs by design: instead of one stack.Select
+per missing alloc, placements are grouped per task group and dispatched
+to the batched device kernel (PlacementEngine.select_batch) — the
+north-star rewrite (SURVEY.md preamble).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..models import (
+    AllocatedResources, AllocatedSharedResources, Allocation, AllocMetric,
+    Evaluation, Job, Plan,
+    ALLOC_CLIENT_FAILED, ALLOC_CLIENT_PENDING, ALLOC_DESIRED_RUN,
+    EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED,
+    TRIGGER_MAX_PLANS,
+)
+from ..models.alloc import RescheduleEvent, RescheduleTracker, AllocDeploymentStatus
+from ..ops import ProposedIndex
+from ..utils.ids import generate_uuid
+from .context import EvalContext
+from .reconcile import AllocReconciler
+from .stack import PlacementEngine, SelectOptions
+from .util import (adjust_queued_allocations, tainted_nodes, tasks_updated,
+                   update_non_terminal_allocs_to_lost)
+
+MAX_SERVICE_ATTEMPTS = 5
+MAX_BATCH_ATTEMPTS = 2
+
+BLOCKED_EVAL_MAX_PLAN_DESC = "created due to placement conflicts"
+BLOCKED_EVAL_FAILED_PLACEMENTS = "created to place remaining allocations"
+ALLOC_IN_PLACE = "alloc updating in-place"
+
+
+class SetStatusError(Exception):
+    def __init__(self, eval_status: str, msg: str):
+        super().__init__(msg)
+        self.eval_status = eval_status
+
+
+class GenericScheduler:
+    def __init__(self, state, planner, batch: bool):
+        self.state = state
+        self.planner = planner
+        self.batch = batch
+
+        self.eval: Optional[Evaluation] = None
+        self.job: Optional[Job] = None
+        self.plan: Optional[Plan] = None
+        self.plan_result = None
+        self.ctx: Optional[EvalContext] = None
+        self.engine: Optional[PlacementEngine] = None
+        self.deployment = None
+
+        self.blocked: Optional[Evaluation] = None
+        self.failed_tg_allocs: Dict[str, AllocMetric] = {}
+        self.queued_allocs: Dict[str, int] = {}
+        self.followup_evals: List[Evaluation] = []
+
+    # -- entry ---------------------------------------------------------
+    def process(self, evaluation: Evaluation) -> None:
+        self.eval = evaluation
+        limit = MAX_BATCH_ATTEMPTS if self.batch else MAX_SERVICE_ATTEMPTS
+
+        progress = [False]
+        for _ in range(limit):
+            progress[0] = False
+            try:
+                done = self._process_once(progress)
+            except SetStatusError as e:
+                self._set_status(e.eval_status, str(e))
+                return
+            if done:
+                self._set_status(EVAL_STATUS_COMPLETE, "")
+                return
+            if not progress[0]:
+                break
+        # retries exhausted on placement conflicts: block so the remaining
+        # work is retried when capacity frees (generic_sched.go:150-160)
+        if self.blocked is None and self.ctx is not None:
+            blocked = self.eval.create_blocked_eval(
+                dict(self.ctx.eligibility.class_eligibility),
+                self.ctx.eligibility.has_escaped(), "")
+            blocked.triggered_by = TRIGGER_MAX_PLANS
+            blocked.status_description = BLOCKED_EVAL_MAX_PLAN_DESC
+            self.planner.create_eval(blocked)
+            self.blocked = blocked
+        self._set_status(
+            EVAL_STATUS_FAILED,
+            f"maximum attempts reached ({limit})")
+
+    # -- one attempt ---------------------------------------------------
+    def _process_once(self, progress) -> bool:
+        ev = self.eval
+        snapshot = self.state
+        self.job = snapshot.job_by_id(ev.namespace, ev.job_id)
+
+        self.queued_allocs = {tg.name: 0
+                              for tg in (self.job.task_groups if self.job else [])}
+        self.failed_tg_allocs = {}
+        self.followup_evals = []
+
+        self.plan = ev.make_plan(self.job)
+        self.blocked = None
+        self.ctx = EvalContext(snapshot, ev, self.plan)
+        self.engine = PlacementEngine(snapshot)
+        if self.job is not None:
+            self.engine.set_job(self.job)
+            self.ctx.eligibility.set_job(self.job)
+
+        self.deployment = None
+        if self.job is not None:
+            self.deployment = snapshot.latest_deployment_by_job(
+                ev.namespace, ev.job_id)
+
+        # compute the changes
+        self._compute_job_allocs()
+
+        # if the plan is a no-op, we're done
+        if self.plan.is_no_op() and not self.followup_evals \
+                and not self.failed_tg_allocs:
+            return True
+
+        # create follow-up evals for delayed reschedules
+        for fev in self.followup_evals:
+            self.planner.create_eval(fev)
+
+        # if there were failures, create/adjust a blocked eval
+        if self.failed_tg_allocs and self.blocked is None:
+            self.blocked = self.eval.create_blocked_eval(
+                dict(self.ctx.eligibility.class_eligibility),
+                self.ctx.eligibility.has_escaped(), "")
+            self.blocked.status_description = BLOCKED_EVAL_FAILED_PLACEMENTS
+            self.planner.create_eval(self.blocked)
+
+        if self.plan.is_no_op():
+            return True
+
+        # submit the plan
+        result = self.planner.submit_plan(self.plan)
+        self.plan_result = result
+        adjust_queued_allocations(result, self.queued_allocs)
+
+        if result is None:
+            return True
+        full, expected, actual = result.full_commit(self.plan)
+        if not full:
+            # partial commit: refresh state and retry
+            if result.refresh_index:
+                self.state = self.planner.refreshed_state(
+                    result.refresh_index) if hasattr(
+                        self.planner, "refreshed_state") else self.state
+            progress[0] = actual > 0
+            return False
+        return True
+
+    # -- reconcile + place --------------------------------------------
+    def _compute_job_allocs(self) -> None:
+        ev = self.eval
+        allocs = self.state.allocs_by_job(ev.namespace, ev.job_id)
+        tainted = tainted_nodes(self.state, allocs)
+
+        update_non_terminal_allocs_to_lost(self.plan, tainted, allocs)
+
+        if self.job is None or self.job.stopped():
+            job = self.job if self.job is not None else Job(
+                id=ev.job_id, namespace=ev.namespace, stop=True,
+                task_groups=[])
+            reconciler = AllocReconciler(
+                self._alloc_update_fn, self.batch, ev.job_id, job,
+                self.deployment, allocs, tainted, ev.id)
+        else:
+            reconciler = AllocReconciler(
+                self._alloc_update_fn, self.batch, ev.job_id, self.job,
+                self.deployment, allocs, tainted, ev.id)
+        results = reconciler.compute()
+
+        if self.eval.annotate_plan:
+            from ..models.plan import PlanAnnotations
+            self.plan.annotations = PlanAnnotations(
+                desired_tg_updates=results.desired_tg_updates)
+
+        # Add the deployment changes to the plan
+        self.plan.deployment = results.deployment
+        self.plan.deployment_updates = results.deployment_updates
+
+        # Followup evals (delayed reschedules)
+        for evals in results.desired_followup_evals.values():
+            self.followup_evals.extend(evals)
+
+        # Update the stored deployment
+        if results.deployment is not None:
+            self.deployment = results.deployment
+
+        # Handle stops
+        for stop in results.stop:
+            self.plan.append_stopped_alloc(
+                stop.alloc, stop.status_description, stop.client_status,
+                stop.followup_eval_id)
+
+        # Handle attribute updates (followup eval ids on allocs)
+        for alloc in results.attribute_updates.values():
+            self.plan.append_alloc(alloc)
+
+        # Handle in-place updates
+        for alloc in results.inplace_update:
+            self.plan.append_alloc(alloc)
+
+        # Queued allocations = requested placements per tg
+        for place in results.place:
+            tg = place.task_group
+            if tg is not None:
+                self.queued_allocs[tg.name] = \
+                    self.queued_allocs.get(tg.name, 0) + 1
+        for du in results.destructive_update:
+            tg = du.place_task_group
+            if tg is not None:
+                self.queued_allocs[tg.name] = \
+                    self.queued_allocs.get(tg.name, 0) + 1
+
+        # Compute placements (destructive first to discount resources)
+        self._compute_placements(results.destructive_update, results.place)
+
+    # genericAllocUpdateFn (util.go:926)
+    def _alloc_update_fn(self, existing: Allocation, new_job: Job, new_tg):
+        if existing.job is not None and \
+                existing.job.job_modify_index == new_job.job_modify_index:
+            return True, False, None
+        if existing.job is None:
+            return False, True, None
+        if tasks_updated(new_job, existing.job, new_tg.name):
+            return False, True, None
+        if existing.terminal_status():
+            return True, False, None
+        node = self.state.node_by_id(existing.node_id)
+        if node is None:
+            return False, True, None
+
+        # Host-side single-node feasibility + fit check: the in-place path
+        # touches exactly one node, so a device dispatch per candidate
+        # alloc would be pure overhead (genericAllocUpdateFn util.go:926
+        # runs the stack on a one-node set for the same reason).
+        if not self._node_feasible_for(node, new_tg):
+            return False, True, None
+        ask = PlacementEngine.group_ask(new_tg)
+        cap = node.comparable_resources()
+        cap.subtract(node.comparable_reserved_resources())
+        used = [0.0, 0.0, 0.0]
+        stopped = {a.id for allocs in self.plan.node_update.values()
+                   for a in allocs} | {existing.id}
+        for a in self.state.allocs_by_node(node.id):
+            if a.terminal_status() or a.id in stopped:
+                continue
+            c = a.comparable_resources()
+            if c is not None:
+                used[0] += c.cpu_shares
+                used[1] += c.memory_mb
+                used[2] += c.disk_mb
+        for a in self.plan.node_allocation.get(node.id, []):
+            c = a.comparable_resources()
+            if c is not None:
+                used[0] += c.cpu_shares
+                used[1] += c.memory_mb
+                used[2] += c.disk_mb
+        if (used[0] + ask[0] > cap.cpu_shares
+                or used[1] + ask[1] > cap.memory_mb
+                or used[2] + ask[2] > cap.disk_mb):
+            return False, True, None
+
+        # build task resources, restoring network/device offers from the
+        # existing allocation (in-place updates keep their ports)
+        from ..models.resources import (AllocatedCpuResources,
+                                        AllocatedMemoryResources,
+                                        AllocatedTaskResources)
+        task_resources = {}
+        for task in new_tg.tasks:
+            tr = AllocatedTaskResources(
+                cpu=AllocatedCpuResources(task.resources.cpu),
+                memory=AllocatedMemoryResources(task.resources.memory_mb))
+            if existing.allocated_resources is not None:
+                old = existing.allocated_resources.tasks.get(task.name)
+                if old is not None:
+                    tr.networks = old.networks
+                    tr.devices = old.devices
+            task_resources[task.name] = tr
+        option = type("_Opt", (), {})()
+        option.task_resources = task_resources
+
+        new_alloc = existing.copy_skip_job()
+        new_alloc.eval_id = self.eval.id
+        new_alloc.job = None
+        new_alloc.allocated_resources = AllocatedResources(
+            tasks=option.task_resources,
+            shared=AllocatedSharedResources(
+                disk_mb=new_tg.ephemeral_disk.size_mb
+                if new_tg.ephemeral_disk else 0,
+                networks=(existing.allocated_resources.shared.networks
+                          if existing.allocated_resources else []),
+            ))
+        new_alloc.metrics = existing.metrics.copy() if existing.metrics \
+            else AllocMetric()
+        return False, False, new_alloc
+
+    def _node_feasible_for(self, node, tg) -> bool:
+        """Static feasibility of one node for a task group (host-side,
+        no device dispatch)."""
+        from ..ops.tables import NodeTable
+        t = NodeTable([node])
+        engine = PlacementEngine.__new__(PlacementEngine)
+        engine.snapshot = self.state
+        engine.config = self.state.scheduler_config()
+        engine.job = self.job
+        engine.table = t
+        engine.by_dc = {node.datacenter: 1}
+        engine._mask_cache = {}
+        engine._net_cache = {}
+        mask, _counts = engine.feasibility(tg)
+        return bool(mask[0])
+
+    # computePlacements (generic_sched.go:468), batched per task group
+    def _compute_placements(self, destructive: List, place: List) -> None:
+        if self.job is None:
+            return
+        n = self.engine.set_nodes(self.job.datacenters)
+
+        deployment_id = ""
+        if self.deployment is not None and self.deployment.active():
+            deployment_id = self.deployment.id
+
+        now = time.time()
+
+        for results in (destructive, place):
+            # group placements by (tg, penalty/preferred signature)
+            groups: Dict[Tuple, List] = {}
+            order: List[Tuple] = []
+            for missing in results:
+                tg = missing.task_group if not hasattr(missing, "place_task_group") \
+                    else missing.place_task_group
+                if tg is None:
+                    continue
+                options = self._get_select_options(missing)
+                sig = (tg.name, options.penalty_node_ids,
+                       tuple(nd.id for nd in options.preferred_nodes))
+                if sig not in groups:
+                    groups[sig] = []
+                    order.append(sig)
+                groups[sig].append((missing, options))
+
+            for sig in order:
+                batch = groups[sig]
+                tg_name = sig[0]
+                tg = self.job.lookup_task_group(tg_name)
+                if tg is None:
+                    continue
+                if tg.name in self.failed_tg_allocs:
+                    self.failed_tg_allocs[tg.name].coalesced_failures += len(batch)
+                    continue
+
+                # stage stops for destructive updates first (frees resources)
+                for missing, _opts in batch:
+                    stop_prev, stop_desc = missing.stop_previous()
+                    if stop_prev and missing.previous_alloc is not None:
+                        self.plan.append_stopped_alloc(
+                            missing.previous_alloc, stop_desc, "", "")
+
+                proposed = ProposedIndex(
+                    self.engine.table, self.job,
+                    self.state.allocs_by_job(self.job.namespace, self.job.id),
+                    self.plan)
+                options_list = self.engine.select_batch(
+                    tg, len(batch), proposed, batch[0][1])
+
+                for (missing, _opts), (option, metrics) in zip(batch, options_list):
+                    # preferred-node miss falls back to the full node set
+                    if option is None and batch[0][1].preferred_nodes:
+                        fallback = self.engine.select_batch(
+                            tg, 1, ProposedIndex(
+                                self.engine.table, self.job,
+                                self.state.allocs_by_job(
+                                    self.job.namespace, self.job.id),
+                                self.plan),
+                            SelectOptions(
+                                penalty_node_ids=batch[0][1].penalty_node_ids))
+                        option, metrics = fallback[0] if fallback else (None, metrics)
+                    if option is not None:
+                        self._append_placement(missing, tg, option,
+                                               deployment_id, now)
+                        continue
+                    if tg.name in self.failed_tg_allocs:
+                        # coalesce later failures of the same group
+                        self.failed_tg_allocs[tg.name].coalesced_failures += 1
+                    else:
+                        self.failed_tg_allocs[tg.name] = metrics
+                    # back out the staged stop: a failed placement must not
+                    # leave its previous alloc stopping with no replacement
+                    stop_prev, _ = missing.stop_previous()
+                    if stop_prev and missing.previous_alloc is not None:
+                        self.plan.remove_update(missing.previous_alloc)
+
+        # record class eligibility for the blocked eval
+        if self.failed_tg_allocs and self.engine.table is not None:
+            for tg_name in self.failed_tg_allocs:
+                tg = self.job.lookup_task_group(tg_name)
+                if tg is None:
+                    continue
+                mask, _counts = self.engine.feasibility(tg)
+                for i, node in enumerate(self.engine.table.nodes):
+                    if node.computed_class:
+                        prev = self.ctx.eligibility.class_eligibility.get(
+                            node.computed_class, False)
+                        self.ctx.eligibility.set_class_eligibility(
+                            node.computed_class, prev or bool(mask[i]))
+
+    @staticmethod
+    def _get_select_options(missing) -> SelectOptions:
+        prev = missing.previous_alloc
+        penalty = set()
+        if prev is not None:
+            if prev.client_status == ALLOC_CLIENT_FAILED:
+                penalty.add(prev.node_id)
+            if prev.reschedule_tracker is not None:
+                for ev in prev.reschedule_tracker.events:
+                    if ev.prev_node_id:
+                        penalty.add(ev.prev_node_id)
+        return SelectOptions(penalty_node_ids=frozenset(penalty))
+
+    def _append_placement(self, missing, tg, option, deployment_id: str,
+                          now: float) -> None:
+        resources = AllocatedResources(
+            tasks=option.task_resources,
+            shared=option.alloc_resources or AllocatedSharedResources(
+                disk_mb=tg.ephemeral_disk.size_mb if tg.ephemeral_disk else 0))
+        alloc = Allocation(
+            id=generate_uuid(),
+            namespace=self.job.namespace,
+            eval_id=self.eval.id,
+            name=missing.name,
+            job_id=self.job.id,
+            task_group=tg.name,
+            metrics=option.metrics,
+            node_id=option.node.id,
+            node_name=option.node.name,
+            deployment_id=deployment_id,
+            allocated_resources=resources,
+            desired_status=ALLOC_DESIRED_RUN,
+            client_status=ALLOC_CLIENT_PENDING,
+        )
+        prev = missing.previous_alloc
+        if prev is not None:
+            alloc.previous_allocation = prev.id
+            if missing.reschedule:
+                self._update_reschedule_tracker(alloc, prev, now)
+        if missing.canary and self.deployment is not None:
+            alloc.deployment_status = AllocDeploymentStatus(canary=True)
+        self.plan.append_alloc(alloc)
+
+    @staticmethod
+    def _update_reschedule_tracker(alloc: Allocation, prev: Allocation,
+                                   now: float) -> None:
+        events: List[RescheduleEvent] = []
+        if prev.reschedule_tracker is not None:
+            events.extend(prev.reschedule_tracker.events)
+        events.append(RescheduleEvent(
+            reschedule_time=now, prev_alloc_id=prev.id,
+            prev_node_id=prev.node_id,
+            delay_s=prev._next_delay(prev.reschedule_policy())
+            if prev.reschedule_policy() else 0.0))
+        alloc.reschedule_tracker = RescheduleTracker(events=events)
+
+    # -- status --------------------------------------------------------
+    def _set_status(self, status: str, desc: str) -> None:
+        new_eval = self.eval.copy()
+        new_eval.status = status
+        new_eval.status_description = desc
+        if self.blocked is not None:
+            new_eval.blocked_eval = self.blocked.id
+        if self.failed_tg_allocs:
+            new_eval.failed_tg_allocs = dict(self.failed_tg_allocs)
+        if self.queued_allocs is not None:
+            new_eval.queued_allocations = dict(self.queued_allocs)
+        if self.deployment is not None and self.deployment.active():
+            new_eval.deployment_id = self.deployment.id
+        self.planner.update_eval(new_eval)

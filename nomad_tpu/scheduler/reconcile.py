@@ -1,0 +1,594 @@
+"""The allocation reconciler: declarative diff of desired (job) vs
+actual (allocations), producing place/stop/inplace/destructive/migrate
+decisions plus deployment lifecycle.
+
+Reference semantics: scheduler/reconcile.go (Compute:184-254,
+computeGroup:341, computeStop:753, computeUpdates:864,
+handleDelayedReschedules:887). Host-side control flow by design —
+SURVEY.md §7.2 step 4.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import Callable, Dict, List, Optional
+
+from ..models import (
+    Allocation, AllocMetric, Deployment, Evaluation, Job, Node, TaskGroup,
+    ALLOC_CLIENT_LOST,
+    EVAL_STATUS_PENDING,
+)
+from ..models.deployment import (
+    DeploymentState, DeploymentStatusUpdate,
+    DEPLOYMENT_STATUS_CANCELLED, DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_PAUSED, DEPLOYMENT_STATUS_SUCCESSFUL,
+    DESC_NEW_JOB_VERSION, DESC_RUNNING_AUTO_PROMOTION,
+    DESC_RUNNING_NEEDS_PROMOTION, DESC_SUCCESSFUL,
+)
+from ..models.evaluation import TRIGGER_RETRY_FAILED_ALLOC
+from ..models.plan import DesiredUpdates
+from . import reconcile_util as ru
+from .reconcile_util import (AllocNameIndex, AllocSet, DelayedRescheduleInfo,
+                             difference, filter_by_deployment,
+                             filter_by_rescheduleable, filter_by_tainted,
+                             filter_by_terminal, from_keys, name_order,
+                             name_set, new_alloc_matrix, union)
+
+# status descriptions (scheduler/generic_sched.go:a few consts)
+ALLOC_NOT_NEEDED = "alloc not needed due to job update"
+ALLOC_RECONNECTED = "alloc reconnected"
+ALLOC_MIGRATING = "alloc is being migrated"
+ALLOC_UPDATING = "alloc is being updated due to job update"
+ALLOC_LOST = "alloc is lost since its node is down"
+ALLOC_UNNEEDED = "alloc is not needed since job count was reduced"
+ALLOC_RESCHEDULED = "alloc was rescheduled because it failed"
+RESCHEDULING_FOLLOWUP_EVAL_DESC = "created for delayed rescheduling"
+
+
+@dataclasses.dataclass
+class AllocStopResult:
+    alloc: Allocation
+    client_status: str = ""
+    status_description: str = ""
+    followup_eval_id: str = ""
+
+
+@dataclasses.dataclass
+class AllocPlaceResult:
+    name: str = ""
+    canary: bool = False
+    task_group: Optional[TaskGroup] = None
+    previous_alloc: Optional[Allocation] = None
+    reschedule: bool = False
+    downgrade_non_canary: bool = False
+    min_job_version: int = 0
+
+    def stop_previous(self):
+        return False, ""
+
+
+@dataclasses.dataclass
+class AllocDestructiveResult:
+    place_name: str = ""
+    place_task_group: Optional[TaskGroup] = None
+    stop_alloc: Optional[Allocation] = None
+    stop_status_description: str = ""
+
+    # placementResult interface parity
+    @property
+    def name(self):
+        return self.place_name
+
+    @property
+    def task_group(self):
+        return self.place_task_group
+
+    @property
+    def previous_alloc(self):
+        return self.stop_alloc
+
+    canary = False
+    reschedule = False
+    downgrade_non_canary = False
+    min_job_version = 0
+
+    def stop_previous(self):
+        return True, self.stop_status_description
+
+
+@dataclasses.dataclass
+class ReconcileResults:
+    """reconcile.go reconcileResults."""
+    deployment: Optional[Deployment] = None
+    deployment_updates: List[DeploymentStatusUpdate] = dataclasses.field(default_factory=list)
+    place: List[AllocPlaceResult] = dataclasses.field(default_factory=list)
+    destructive_update: List[AllocDestructiveResult] = dataclasses.field(default_factory=list)
+    inplace_update: List[Allocation] = dataclasses.field(default_factory=list)
+    stop: List[AllocStopResult] = dataclasses.field(default_factory=list)
+    attribute_updates: Dict[str, Allocation] = dataclasses.field(default_factory=dict)
+    desired_tg_updates: Dict[str, DesiredUpdates] = dataclasses.field(default_factory=dict)
+    desired_followup_evals: Dict[str, List[Evaluation]] = dataclasses.field(default_factory=dict)
+
+
+# allocUpdateFn(alloc, new_job, new_tg) -> (ignore, destructive, updated_alloc)
+AllocUpdateFn = Callable
+
+
+class AllocReconciler:
+    def __init__(self, alloc_update_fn: AllocUpdateFn, batch: bool,
+                 job_id: str, job: Job, deployment: Optional[Deployment],
+                 existing_allocs: List[Allocation],
+                 tainted_nodes: Dict[str, Optional[Node]],
+                 eval_id: str, now: Optional[float] = None):
+        self.alloc_update_fn = alloc_update_fn
+        self.batch = batch
+        self.job_id = job_id
+        self.job = job
+        self.deployment = deployment.copy() if deployment else None
+        self.old_deployment: Optional[Deployment] = None
+        self.existing_allocs = existing_allocs
+        self.tainted_nodes = tainted_nodes
+        self.eval_id = eval_id
+        self.now = now if now is not None else _time.time()
+        self.deployment_paused = False
+        self.deployment_failed = False
+        self.result = ReconcileResults()
+
+    # -- top level -----------------------------------------------------
+    def compute(self) -> ReconcileResults:
+        m = new_alloc_matrix(self.job, self.existing_allocs)
+        self._cancel_deployments()
+
+        if self.job.stopped():
+            self._handle_stop(m)
+            return self.result
+
+        if self.deployment is not None:
+            self.deployment_paused = self.deployment.status == DEPLOYMENT_STATUS_PAUSED
+            self.deployment_failed = self.deployment.status == DEPLOYMENT_STATUS_FAILED
+
+        complete = True
+        for group, allocs in m.items():
+            complete &= self._compute_group(group, allocs)
+
+        if self.deployment is not None and complete:
+            self.result.deployment_updates.append(DeploymentStatusUpdate(
+                deployment_id=self.deployment.id,
+                status=DEPLOYMENT_STATUS_SUCCESSFUL,
+                status_description=DESC_SUCCESSFUL,
+            ))
+
+        d = self.result.deployment
+        if d is not None and d.requires_promotion():
+            d.status_description = (DESC_RUNNING_AUTO_PROMOTION
+                                    if d.has_auto_promote()
+                                    else DESC_RUNNING_NEEDS_PROMOTION)
+        return self.result
+
+    def _cancel_deployments(self) -> None:
+        if self.job.stopped():
+            if self.deployment is not None and self.deployment.active():
+                self.result.deployment_updates.append(DeploymentStatusUpdate(
+                    deployment_id=self.deployment.id,
+                    status=DEPLOYMENT_STATUS_CANCELLED,
+                    status_description="Cancelled because job is stopped",
+                ))
+            self.old_deployment = self.deployment
+            self.deployment = None
+            return
+        d = self.deployment
+        if d is None:
+            return
+        if (d.job_create_index != self.job.create_index
+                or d.job_version != self.job.version):
+            if d.active():
+                self.result.deployment_updates.append(DeploymentStatusUpdate(
+                    deployment_id=d.id,
+                    status=DEPLOYMENT_STATUS_CANCELLED,
+                    status_description=DESC_NEW_JOB_VERSION,
+                ))
+            self.old_deployment = d
+            self.deployment = None
+        elif d.status == DEPLOYMENT_STATUS_SUCCESSFUL:
+            self.old_deployment = d
+            self.deployment = None
+
+    def _handle_stop(self, m: Dict[str, AllocSet]) -> None:
+        for group, allocs in m.items():
+            allocs = filter_by_terminal(allocs)
+            untainted, migrate, lost = filter_by_tainted(allocs, self.tainted_nodes)
+            self._mark_stop(untainted, "", ALLOC_NOT_NEEDED)
+            self._mark_stop(migrate, "", ALLOC_NOT_NEEDED)
+            self._mark_stop(lost, ALLOC_CLIENT_LOST, ALLOC_LOST)
+            du = DesiredUpdates(stop=len(allocs))
+            self.result.desired_tg_updates[group] = du
+
+    def _mark_stop(self, allocs: AllocSet, client_status: str,
+                   desc: str, followup: Optional[Dict[str, str]] = None) -> None:
+        for alloc in allocs.values():
+            self.result.stop.append(AllocStopResult(
+                alloc=alloc, client_status=client_status,
+                status_description=desc,
+                followup_eval_id=(followup or {}).get(alloc.id, "")))
+
+    # -- per group -----------------------------------------------------
+    def _compute_group(self, group: str, all_set: AllocSet) -> bool:
+        desired = DesiredUpdates()
+        self.result.desired_tg_updates[group] = desired
+        tg = self.job.lookup_task_group(group)
+
+        if tg is None:
+            untainted, migrate, lost = filter_by_tainted(all_set, self.tainted_nodes)
+            self._mark_stop(untainted, "", ALLOC_NOT_NEEDED)
+            self._mark_stop(migrate, "", ALLOC_NOT_NEEDED)
+            self._mark_stop(lost, ALLOC_CLIENT_LOST, ALLOC_LOST)
+            desired.stop = len(untainted) + len(migrate) + len(lost)
+            return True
+
+        dstate: Optional[DeploymentState] = None
+        existing_deployment = False
+        if self.deployment is not None:
+            dstate = self.deployment.task_groups.get(group)
+            existing_deployment = dstate is not None
+        if not existing_deployment:
+            dstate = DeploymentState()
+            if tg.update is not None:
+                dstate.auto_revert = tg.update.auto_revert
+                dstate.auto_promote = tg.update.auto_promote
+                dstate.progress_deadline_s = tg.update.progress_deadline_s
+
+        all_set, ignore = self._filter_old_terminal_allocs(all_set)
+        desired.ignore += len(ignore)
+
+        canaries, all_set = self._handle_group_canaries(all_set, desired)
+
+        untainted, migrate, lost = filter_by_tainted(all_set, self.tainted_nodes)
+        untainted, reschedule_now, reschedule_later = filter_by_rescheduleable(
+            untainted, self.batch, self.now, self.eval_id, self.deployment)
+
+        lost_later = ru.delay_by_stop_after_client_disconnect(lost, self.now)
+        lost_later_evals = self._handle_delayed_lost(lost_later, all_set,
+                                                     tg.name)
+        self._handle_delayed_reschedules(reschedule_later, all_set, tg.name)
+
+        name_index = AllocNameIndex(
+            self.job_id, group, tg.count,
+            union(untainted, migrate, reschedule_now))
+
+        canary_state = (dstate is not None and dstate.desired_canaries != 0
+                        and not dstate.promoted)
+        stop = self._compute_stop(tg, name_index, untainted, migrate, lost,
+                                  canaries, canary_state, lost_later_evals)
+        desired.stop += len(stop)
+        untainted = difference(untainted, stop)
+
+        ignore2, inplace, destructive = self._compute_updates(tg, untainted)
+        desired.ignore += len(ignore2)
+        desired.in_place_update += len(inplace)
+        if not existing_deployment:
+            dstate.desired_total += len(destructive) + len(inplace)
+
+        if canary_state:
+            untainted = difference(untainted, canaries)
+
+        strategy = tg.update
+        canaries_promoted = dstate is not None and dstate.promoted
+        require_canary = (len(destructive) != 0 and strategy is not None
+                          and len(canaries) < strategy.canary
+                          and not canaries_promoted)
+        if require_canary:
+            dstate.desired_canaries = strategy.canary
+        if require_canary and not self.deployment_paused and not self.deployment_failed:
+            number = strategy.canary - len(canaries)
+            desired.canary += number
+            for name in name_index.next_canaries(number, canaries, destructive):
+                self.result.place.append(AllocPlaceResult(
+                    name=name, canary=True, task_group=tg))
+
+        canary_state = (dstate is not None and dstate.desired_canaries != 0
+                        and not dstate.promoted)
+        limit = self._compute_limit(tg, untainted, destructive, migrate,
+                                    canary_state)
+
+        # a delayed stop_after_client_disconnect alloc delays scheduling
+        # for the whole group (reconcile.go:462-467)
+        place: List[AllocPlaceResult] = []
+        if len(lost_later) == 0:
+            place = self._compute_placements(tg, name_index, untainted,
+                                             migrate, reschedule_now,
+                                             canary_state)
+            if not existing_deployment:
+                dstate.desired_total += len(place)
+
+        deployment_place_ready = (not self.deployment_paused
+                                  and not self.deployment_failed
+                                  and not canary_state)
+        if deployment_place_ready:
+            desired.place += len(place)
+            self.result.place.extend(place)
+            self._mark_stop(reschedule_now, "", ALLOC_RESCHEDULED)
+            desired.stop += len(reschedule_now)
+            limit -= min(len(place), limit)
+        else:
+            if len(lost) != 0:
+                allowed = min(len(lost), len(place))
+                desired.place += allowed
+                self.result.place.extend(place[:allowed])
+            if len(reschedule_now) != 0:
+                for p in place:
+                    prev = p.previous_alloc
+                    if p.reschedule and not (
+                            self.deployment_failed and prev is not None
+                            and self.deployment is not None
+                            and self.deployment.id == prev.deployment_id):
+                        self.result.place.append(p)
+                        desired.place += 1
+                        self.result.stop.append(AllocStopResult(
+                            alloc=prev, status_description=ALLOC_RESCHEDULED))
+                        desired.stop += 1
+
+        if deployment_place_ready:
+            n = min(len(destructive), limit)
+            desired.destructive_update += n
+            desired.ignore += len(destructive) - n
+            for alloc in name_order(destructive)[:n]:
+                self.result.destructive_update.append(AllocDestructiveResult(
+                    place_name=alloc.name, place_task_group=tg,
+                    stop_alloc=alloc,
+                    stop_status_description=ALLOC_UPDATING))
+        else:
+            desired.ignore += len(destructive)
+
+        desired.migrate += len(migrate)
+        for alloc in name_order(migrate):
+            is_canary = (alloc.deployment_status is not None
+                         and alloc.deployment_status.canary)
+            self.result.stop.append(AllocStopResult(
+                alloc=alloc, status_description=ALLOC_MIGRATING))
+            self.result.place.append(AllocPlaceResult(
+                name=alloc.name, canary=is_canary, task_group=tg,
+                previous_alloc=alloc,
+                downgrade_non_canary=canary_state and not is_canary,
+                min_job_version=alloc.job.version if alloc.job else 0))
+
+        # Create a deployment if the spec is updating or first run
+        updating_spec = len(destructive) != 0 or len(self.result.inplace_update) != 0
+        had_running = any(
+            a.job is not None and a.job.version == self.job.version
+            and a.job.create_index == self.job.create_index
+            for a in all_set.values())
+        if (not existing_deployment and strategy is not None
+                and dstate.desired_total != 0
+                and (not had_running or updating_spec)):
+            if self.deployment is None:
+                self.deployment = Deployment.from_job(self.job)
+                self.result.deployment = self.deployment
+            self.deployment.task_groups[group] = dstate
+
+        deployment_complete = (
+            len(destructive) + len(inplace) + len(place) + len(migrate)
+            + len(reschedule_now) + len(reschedule_later) == 0
+            and not require_canary)
+        if deployment_complete and self.deployment is not None:
+            ds = self.deployment.task_groups.get(group)
+            if ds is not None:
+                if (ds.healthy_allocs < max(ds.desired_total, ds.desired_canaries)
+                        or (ds.desired_canaries > 0 and not ds.promoted)):
+                    deployment_complete = False
+        return deployment_complete
+
+    # -- helpers -------------------------------------------------------
+    def _filter_old_terminal_allocs(self, all_set: AllocSet):
+        if not self.batch:
+            return all_set, {}
+        filtered = dict(all_set)
+        ignored: AllocSet = {}
+        for aid, alloc in list(filtered.items()):
+            older = (alloc.job is not None
+                     and (alloc.job.version < self.job.version
+                          or alloc.job.create_index < self.job.create_index))
+            if older and alloc.terminal_status():
+                del filtered[aid]
+                ignored[aid] = alloc
+        return filtered, ignored
+
+    def _handle_group_canaries(self, all_set: AllocSet,
+                               desired: DesiredUpdates):
+        stop_ids: List[str] = []
+        if self.old_deployment is not None:
+            for ds in self.old_deployment.task_groups.values():
+                if not ds.promoted:
+                    stop_ids.extend(ds.placed_canaries)
+        if (self.deployment is not None
+                and self.deployment.status == DEPLOYMENT_STATUS_FAILED):
+            for ds in self.deployment.task_groups.values():
+                if not ds.promoted:
+                    stop_ids.extend(ds.placed_canaries)
+        stop_set = from_keys(all_set, stop_ids)
+        self._mark_stop(stop_set, "", ALLOC_NOT_NEEDED)
+        desired.stop += len(stop_set)
+        all_set = difference(all_set, stop_set)
+
+        canaries: AllocSet = {}
+        if self.deployment is not None:
+            canary_ids: List[str] = []
+            for ds in self.deployment.task_groups.values():
+                canary_ids.extend(ds.placed_canaries)
+            canaries = from_keys(all_set, canary_ids)
+            untainted, migrate, lost = filter_by_tainted(canaries,
+                                                         self.tainted_nodes)
+            self._mark_stop(migrate, "", ALLOC_MIGRATING)
+            self._mark_stop(lost, ALLOC_CLIENT_LOST, ALLOC_LOST)
+            canaries = untainted
+            all_set = difference(all_set, migrate, lost)
+        return canaries, all_set
+
+    def _compute_limit(self, tg: TaskGroup, untainted: AllocSet,
+                       destructive: AllocSet, migrate: AllocSet,
+                       canary_state: bool) -> int:
+        if tg.update is None or len(destructive) + len(migrate) == 0:
+            return tg.count
+        if self.deployment_paused or self.deployment_failed:
+            return 0
+        if canary_state:
+            return 0
+        limit = tg.update.max_parallel
+        if self.deployment is not None:
+            part_of, _ = filter_by_deployment(untainted, self.deployment.id)
+            for alloc in part_of.values():
+                ds = alloc.deployment_status
+                if ds is not None and ds.is_unhealthy():
+                    return 0
+                if ds is None or not ds.is_healthy():
+                    limit -= 1
+        return max(limit, 0)
+
+    def _compute_placements(self, tg: TaskGroup, name_index: AllocNameIndex,
+                            untainted: AllocSet, migrate: AllocSet,
+                            reschedule: AllocSet,
+                            canary_state: bool) -> List[AllocPlaceResult]:
+        place: List[AllocPlaceResult] = []
+        for alloc in reschedule.values():
+            is_canary = (alloc.deployment_status is not None
+                         and alloc.deployment_status.canary)
+            place.append(AllocPlaceResult(
+                name=alloc.name, task_group=tg, previous_alloc=alloc,
+                reschedule=True, canary=is_canary,
+                downgrade_non_canary=canary_state and not is_canary,
+                min_job_version=alloc.job.version if alloc.job else 0))
+        existing = len(untainted) + len(migrate) + len(reschedule)
+        if existing < tg.count:
+            for name in name_index.next(tg.count - existing):
+                place.append(AllocPlaceResult(
+                    name=name, task_group=tg,
+                    downgrade_non_canary=canary_state))
+        return place
+
+    def _compute_stop(self, tg: TaskGroup, name_index: AllocNameIndex,
+                      untainted: AllocSet, migrate: AllocSet, lost: AllocSet,
+                      canaries: AllocSet, canary_state: bool,
+                      followup_evals: Dict[str, str]) -> AllocSet:
+        stop: AllocSet = dict(lost)
+        self._mark_stop(lost, ALLOC_CLIENT_LOST, ALLOC_LOST, followup_evals)
+
+        if canary_state:
+            untainted = difference(untainted, canaries)
+
+        remove = len(untainted) + len(migrate) - tg.count
+        if remove <= 0:
+            return stop
+
+        untainted = filter_by_terminal(untainted)
+
+        if not canary_state and len(canaries) != 0:
+            canary_names = name_set(canaries)
+            for aid, alloc in list(difference(untainted, canaries).items()):
+                if alloc.name in canary_names:
+                    stop[aid] = alloc
+                    self.result.stop.append(AllocStopResult(
+                        alloc=alloc, status_description=ALLOC_NOT_NEEDED))
+                    untainted.pop(aid, None)
+                    remove -= 1
+                    if remove == 0:
+                        return stop
+
+        if len(migrate) != 0:
+            m_names = AllocNameIndex(self.job_id, tg.name, tg.count, migrate)
+            remove_names = m_names.highest(remove)
+            for aid, alloc in list(migrate.items()):
+                if alloc.name not in remove_names:
+                    continue
+                self.result.stop.append(AllocStopResult(
+                    alloc=alloc, status_description=ALLOC_NOT_NEEDED))
+                del migrate[aid]
+                stop[aid] = alloc
+                name_index.unset_index(alloc.index())
+                remove -= 1
+                if remove == 0:
+                    return stop
+
+        remove_names = name_index.highest(remove)
+        for aid, alloc in list(untainted.items()):
+            if alloc.name in remove_names:
+                stop[aid] = alloc
+                self.result.stop.append(AllocStopResult(
+                    alloc=alloc, status_description=ALLOC_NOT_NEEDED))
+                del untainted[aid]
+                remove -= 1
+                if remove == 0:
+                    return stop
+
+        for aid, alloc in list(untainted.items()):
+            stop[aid] = alloc
+            self.result.stop.append(AllocStopResult(
+                alloc=alloc, status_description=ALLOC_NOT_NEEDED))
+            del untainted[aid]
+            remove -= 1
+            if remove == 0:
+                return stop
+        return stop
+
+    def _compute_updates(self, tg: TaskGroup, untainted: AllocSet):
+        ignore: AllocSet = {}
+        inplace: AllocSet = {}
+        destructive: AllocSet = {}
+        for alloc in untainted.values():
+            ignore_change, destructive_change, updated = self.alloc_update_fn(
+                alloc, self.job, tg)
+            if ignore_change:
+                ignore[alloc.id] = alloc
+            elif destructive_change:
+                destructive[alloc.id] = alloc
+            else:
+                inplace[alloc.id] = alloc
+                if updated is not None:
+                    self.result.inplace_update.append(updated)
+        return ignore, inplace, destructive
+
+    def _handle_delayed_reschedules(self, later: List[DelayedRescheduleInfo],
+                                    all_set: AllocSet,
+                                    tg_name: str) -> Dict[str, str]:
+        mapping = self._handle_delayed_lost(later, all_set, tg_name)
+        for alloc_id, eval_id in mapping.items():
+            existing = all_set.get(alloc_id)
+            if existing is None:
+                continue
+            updated = existing.copy()
+            updated.follow_up_eval_id = eval_id
+            self.result.attribute_updates[alloc_id] = updated
+        return mapping
+
+    def _handle_delayed_lost(self, later: List[DelayedRescheduleInfo],
+                             all_set: AllocSet,
+                             tg_name: str) -> Dict[str, str]:
+        if not later:
+            return {}
+        later = sorted(later, key=lambda i: i.reschedule_time)
+        evals: List[Evaluation] = []
+        next_time = later[0].reschedule_time
+        mapping: Dict[str, str] = {}
+        ev = Evaluation(
+            namespace=self.job.namespace, priority=self.job.priority,
+            type=self.job.type, triggered_by=TRIGGER_RETRY_FAILED_ALLOC,
+            job_id=self.job.id, job_modify_index=self.job.modify_index,
+            status=EVAL_STATUS_PENDING,
+            status_description=RESCHEDULING_FOLLOWUP_EVAL_DESC,
+            wait_until=next_time)
+        evals.append(ev)
+        for info in later:
+            if info.reschedule_time - next_time < ru.BATCHED_FAILED_ALLOC_WINDOW_S:
+                mapping[info.alloc_id] = ev.id
+            else:
+                next_time = info.reschedule_time
+                ev = Evaluation(
+                    namespace=self.job.namespace, priority=self.job.priority,
+                    type=self.job.type,
+                    triggered_by=TRIGGER_RETRY_FAILED_ALLOC,
+                    job_id=self.job.id,
+                    job_modify_index=self.job.modify_index,
+                    status=EVAL_STATUS_PENDING, wait_until=next_time)
+                evals.append(ev)
+                mapping[info.alloc_id] = ev.id
+        self.result.desired_followup_evals.setdefault(tg_name, []).extend(evals)
+        return mapping

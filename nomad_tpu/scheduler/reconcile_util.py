@@ -1,0 +1,264 @@
+"""Allocation set algebra and name indexing for the reconciler.
+
+Reference semantics: scheduler/reconcile_util.go — allocSet/allocMatrix
+:97-208, filterByTainted:211, filterByRescheduleable:251,
+allocNameIndex:413-575.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..models import (
+    Allocation, Node,
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
+    ALLOC_DESIRED_EVICT, ALLOC_DESIRED_STOP,
+)
+
+# reconciler window within which a delayed reschedule counts as "now"
+RESCHEDULE_WINDOW_S = 5.0
+# batching window for delayed-reschedule follow-up evals (reconcile.go)
+BATCHED_FAILED_ALLOC_WINDOW_S = 5.0
+
+AllocSet = Dict[str, Allocation]
+
+
+def alloc_name(job_id: str, group: str, idx: int) -> str:
+    return f"{job_id}.{group}[{idx}]"
+
+
+def new_alloc_matrix(job, allocs: List[Allocation]) -> Dict[str, AllocSet]:
+    m: Dict[str, AllocSet] = {}
+    for a in allocs:
+        m.setdefault(a.task_group, {})[a.id] = a
+    if job is not None:
+        for tg in job.task_groups:
+            m.setdefault(tg.name, {})
+    return m
+
+
+def difference(a: AllocSet, *others: AllocSet) -> AllocSet:
+    out = dict(a)
+    for o in others:
+        for k in o:
+            out.pop(k, None)
+    return out
+
+
+def union(a: AllocSet, *others: AllocSet) -> AllocSet:
+    out = dict(a)
+    for o in others:
+        out.update(o)
+    return out
+
+
+def from_keys(a: AllocSet, keys: List[str]) -> AllocSet:
+    return {k: a[k] for k in keys if k in a}
+
+
+def name_set(a: AllocSet) -> Set[str]:
+    return {alloc.name for alloc in a.values()}
+
+
+def name_order(a: AllocSet) -> List[Allocation]:
+    """Allocs sorted by their name index (reconcile_util.go nameOrder)."""
+    return sorted(a.values(), key=lambda x: x.index())
+
+
+def filter_by_terminal(a: AllocSet) -> AllocSet:
+    return {k: v for k, v in a.items() if not v.terminal_status()}
+
+
+def filter_by_tainted(a: AllocSet, tainted: Dict[str, Optional[Node]]
+                      ) -> Tuple[AllocSet, AllocSet, AllocSet]:
+    """(untainted, migrate, lost) — reconcile_util.go:211."""
+    untainted: AllocSet = {}
+    migrate: AllocSet = {}
+    lost: AllocSet = {}
+    for alloc in a.values():
+        if alloc.terminal_status():
+            untainted[alloc.id] = alloc
+            continue
+        if alloc.desired_transition.should_migrate():
+            migrate[alloc.id] = alloc
+            continue
+        if alloc.node_id not in tainted:
+            untainted[alloc.id] = alloc
+            continue
+        node = tainted[alloc.node_id]
+        if node is None or node.terminal_status():
+            lost[alloc.id] = alloc
+            continue
+        untainted[alloc.id] = alloc
+    return untainted, migrate, lost
+
+
+def should_filter(alloc: Allocation, is_batch: bool) -> Tuple[bool, bool]:
+    """(untainted, ignore) — reconcile_util.go shouldFilter:299."""
+    if is_batch:
+        if alloc.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT):
+            if alloc.ran_successfully():
+                return True, False
+            return False, True
+        if alloc.client_status != ALLOC_CLIENT_FAILED:
+            return True, False
+        return False, False
+    # service jobs
+    if alloc.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT):
+        return False, True
+    if alloc.client_status in (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_LOST):
+        return False, True
+    return False, False
+
+
+def update_by_reschedulable(alloc: Allocation, now: float, eval_id: str,
+                            deployment) -> Tuple[bool, bool, float]:
+    """(reschedule_now, reschedule_later, time) — reconcile_util.go:339."""
+    if (deployment is not None and alloc.deployment_id == deployment.id
+            and deployment.active()
+            and not bool(alloc.desired_transition.reschedule)):
+        return False, False, 0.0
+    reschedule_now = alloc.desired_transition.should_force_reschedule()
+    t, eligible = alloc.next_reschedule_time()
+    if eligible and (alloc.follow_up_eval_id == eval_id
+                     or t - now <= RESCHEDULE_WINDOW_S):
+        return True, False, t
+    if eligible and alloc.follow_up_eval_id == "":
+        return reschedule_now, True, t
+    return reschedule_now, False, t
+
+
+@dataclasses.dataclass
+class DelayedRescheduleInfo:
+    alloc_id: str
+    alloc: Allocation
+    reschedule_time: float
+
+
+def filter_by_rescheduleable(a: AllocSet, is_batch: bool, now: float,
+                             eval_id: str, deployment
+                             ) -> Tuple[AllocSet, AllocSet,
+                                        List[DelayedRescheduleInfo]]:
+    """(untainted, reschedule_now, reschedule_later) — :251."""
+    untainted: AllocSet = {}
+    reschedule_now: AllocSet = {}
+    reschedule_later: List[DelayedRescheduleInfo] = []
+    for alloc in a.values():
+        if alloc.next_allocation != "" and alloc.terminal_status():
+            continue
+        is_untainted, ignore = should_filter(alloc, is_batch)
+        if is_untainted:
+            untainted[alloc.id] = alloc
+        if is_untainted or ignore:
+            continue
+        now_ok, later_ok, t = update_by_reschedulable(alloc, now, eval_id,
+                                                      deployment)
+        if not now_ok:
+            untainted[alloc.id] = alloc
+            if later_ok:
+                reschedule_later.append(
+                    DelayedRescheduleInfo(alloc.id, alloc, t))
+        else:
+            reschedule_now[alloc.id] = alloc
+    return untainted, reschedule_now, reschedule_later
+
+
+def filter_by_deployment(a: AllocSet, deployment_id: str
+                         ) -> Tuple[AllocSet, AllocSet]:
+    match: AllocSet = {}
+    nonmatch: AllocSet = {}
+    for alloc in a.values():
+        if alloc.deployment_id == deployment_id:
+            match[alloc.id] = alloc
+        else:
+            nonmatch[alloc.id] = alloc
+    return match, nonmatch
+
+
+def delay_by_stop_after_client_disconnect(lost: AllocSet, now: float
+                                          ) -> List[DelayedRescheduleInfo]:
+    """Lost allocs whose group sets stop_after_client_disconnect get a
+    delayed stop instead of an immediate one
+    (reconcile_util.go delayByStopAfterClientDisconnect:391)."""
+    later: List[DelayedRescheduleInfo] = []
+    for a in lost.values():
+        tg = a.job.lookup_task_group(a.task_group) if a.job else None
+        if tg is None or tg.stop_after_client_disconnect_s is None:
+            continue
+        later.append(DelayedRescheduleInfo(
+            a.id, a, now + tg.stop_after_client_disconnect_s))
+    return later
+
+
+class AllocNameIndex:
+    """Bitmap-based alloc name chooser (reconcile_util.go:413-575)."""
+
+    def __init__(self, job_id: str, task_group: str, count: int,
+                 in_use: AllocSet):
+        self.job_id = job_id
+        self.task_group = task_group
+        self.count = count
+        self.b: Set[int] = set()
+        for a in in_use.values():
+            idx = a.index()
+            if idx >= 0:
+                self.b.add(idx)
+
+    def highest(self, n: int) -> Set[str]:
+        """Remove and return the highest n used names."""
+        out: Set[str] = set()
+        for idx in sorted(self.b, reverse=True):
+            if len(out) >= n:
+                break
+            self.b.discard(idx)
+            out.add(alloc_name(self.job_id, self.task_group, idx))
+        return out
+
+    def unset_index(self, idx: int) -> None:
+        self.b.discard(idx)
+
+    def next(self, n: int) -> List[str]:
+        out: List[str] = []
+        for idx in range(self.count):
+            if len(out) == n:
+                return out
+            if idx not in self.b:
+                out.append(alloc_name(self.job_id, self.task_group, idx))
+                self.b.add(idx)
+        # exhausted the free set; pick overlapping indexes
+        i = 0
+        while len(out) < n:
+            out.append(alloc_name(self.job_id, self.task_group, i))
+            self.b.add(i)
+            i += 1
+        return out
+
+    def next_canaries(self, n: int, existing: AllocSet,
+                      destructive: AllocSet) -> List[str]:
+        next_names: List[str] = []
+        existing_names = name_set(existing)
+        # prefer indexes of destructive updates (they'll be replaced)
+        dest_idx = sorted(a.index() for a in destructive.values()
+                          if 0 <= a.index() < self.count)
+        for idx in dest_idx:
+            name = alloc_name(self.job_id, self.task_group, idx)
+            if name not in existing_names and name not in next_names:
+                next_names.append(name)
+                self.b.add(idx)
+                if len(next_names) == n:
+                    return next_names
+        for idx in range(self.count):
+            if idx in self.b:
+                continue
+            name = alloc_name(self.job_id, self.task_group, idx)
+            if name not in existing_names and name not in next_names:
+                next_names.append(name)
+                self.b.add(idx)
+                if len(next_names) == n:
+                    return next_names
+        i = self.count
+        while len(next_names) < n:
+            next_names.append(alloc_name(self.job_id, self.task_group, i))
+            i += 1
+        return next_names

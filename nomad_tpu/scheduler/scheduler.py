@@ -1,0 +1,74 @@
+"""Scheduler interfaces and factory registry.
+
+Reference semantics: scheduler/scheduler.go:23-131 — BuiltinSchedulers
+factory map, Scheduler/State/Planner interfaces, SchedulerVersion gate.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Protocol
+
+from ..models import Allocation, Evaluation, Node, Plan, PlanResult
+
+SCHEDULER_VERSION = 1
+
+
+class SchedulerState(Protocol):
+    """Immutable snapshot view the scheduler reads (scheduler.go State)."""
+
+    def nodes(self) -> List[Node]: ...
+    def node_by_id(self, node_id: str) -> Optional[Node]: ...
+    def job_by_id(self, namespace: str, job_id: str): ...
+    def allocs_by_job(self, namespace: str, job_id: str) -> List[Allocation]: ...
+    def allocs_by_node(self, node_id: str) -> List[Allocation]: ...
+    def latest_deployment_by_job(self, namespace: str, job_id: str): ...
+    def scheduler_config(self): ...
+
+
+class Planner(Protocol):
+    """How the scheduler effects change (scheduler.go Planner)."""
+
+    def submit_plan(self, plan: Plan): ...
+    def update_eval(self, evaluation: Evaluation) -> None: ...
+    def create_eval(self, evaluation: Evaluation) -> None: ...
+    def reblock_eval(self, evaluation: Evaluation) -> None: ...
+
+
+class Scheduler(Protocol):
+    def process(self, evaluation: Evaluation) -> None: ...
+
+
+SchedulerFactory = Callable[[SchedulerState, Planner], Scheduler]
+
+
+def _service(state, planner):
+    from .generic import GenericScheduler
+    return GenericScheduler(state, planner, batch=False)
+
+
+def _batch(state, planner):
+    from .generic import GenericScheduler
+    return GenericScheduler(state, planner, batch=True)
+
+
+def _system(state, planner):
+    from .system import SystemScheduler
+    return SystemScheduler(state, planner)
+
+
+BUILTIN_SCHEDULERS: Dict[str, SchedulerFactory] = {
+    "service": _service,
+    "batch": _batch,
+    "system": _system,
+    # the device-batched pipeline IS the default execution backend; the
+    # explicit name is kept for the reference's registration parity
+    # (BASELINE.json north star: a `tpu-batch` Factory entry)
+    "tpu-batch": _batch,
+}
+
+
+def new_scheduler(name: str, state: SchedulerState, planner: Planner) -> Scheduler:
+    factory = BUILTIN_SCHEDULERS.get(name)
+    if factory is None:
+        raise ValueError(f"unknown scheduler '{name}'")
+    return factory(state, planner)

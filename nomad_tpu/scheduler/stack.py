@@ -1,0 +1,391 @@
+"""The device-backed placement engine — the columnar rewrite of
+scheduler/stack.go's GenericStack.
+
+Where the reference chains 15 pull-based iterators per node per
+placement (stack.go:321-411), this engine:
+  1. resolves all static feasibility (constraints, drivers, volumes,
+     datacenters, eligibility) into one bool[N] mask via numpy columns
+     (ops/targets.py), memoized per (job version, task group);
+  2. dispatches ONE fused device kernel (ops/select.py) that places all
+     requested instances of the task group, scoring every node each
+     step and carrying usage/collision/histogram state in-scan;
+  3. assigns concrete ports host-side for just the chosen nodes
+     (SURVEY.md §7.3 item 1: only winners need port numbers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models import (
+    AllocatedResources, AllocatedSharedResources, AllocatedTaskResources,
+    AllocMetric, Job, NetworkIndex, Node, NodeScoreMeta, TaskGroup,
+)
+from ..models.constraints import (CONSTRAINT_DISTINCT_HOSTS,
+                                  CONSTRAINT_DISTINCT_PROPERTY)
+from ..models.resources import (AllocatedCpuResources,
+                                AllocatedMemoryResources)
+from ..ops import NodeTable, ProposedIndex, SelectKernel, SelectRequest
+from ..ops.select import TOP_K
+from ..ops.tables import DIM_NAMES
+from ..ops.targets import affinity_columns, constraint_mask
+
+
+@dataclasses.dataclass
+class SelectOptions:
+    """stack.go SelectOptions."""
+    penalty_node_ids: frozenset = frozenset()
+    preferred_nodes: Tuple[Node, ...] = ()
+
+
+@dataclasses.dataclass
+class RankedNode:
+    """One successful placement option (rank.go RankedNode)."""
+    node: Node
+    final_score: float
+    task_resources: Dict[str, AllocatedTaskResources]
+    alloc_resources: Optional[AllocatedSharedResources]
+    metrics: AllocMetric
+
+
+class PlacementEngine:
+    def __init__(self, snapshot, sched_config=None):
+        self.snapshot = snapshot
+        self.config = sched_config or snapshot.scheduler_config()
+        self.job: Optional[Job] = None
+        self.table: Optional[NodeTable] = None
+        self.by_dc: Dict[str, int] = {}
+        self.kernel = SelectKernel()
+        self._mask_cache: Dict[Tuple, np.ndarray] = {}
+        # per-eval NetworkIndex cache: shared across select_batch calls so
+        # port offers stay consistent between task groups of one plan
+        self._net_cache: Dict[str, NetworkIndex] = {}
+
+    # -- setup ---------------------------------------------------------
+    def set_job(self, job: Job) -> None:
+        self.job = job
+        self._mask_cache.clear()
+
+    def set_nodes(self, datacenters: List[str]) -> int:
+        """Build the node table for ready nodes in the datacenters
+        (readyNodesInDCs, scheduler/util.go:233). Returns node count."""
+        self.table = NodeTable.build(self.snapshot, datacenters=datacenters)
+        self.by_dc = {}
+        for node in self.table.nodes:
+            self.by_dc[node.datacenter] = self.by_dc.get(node.datacenter, 0) + 1
+        return self.table.n
+
+    def set_node_list(self, nodes: List[Node]) -> None:
+        """Restrict to an explicit node list (in-place update checks)."""
+        self.table = NodeTable(nodes)
+        for node in nodes:
+            for alloc in self.snapshot.allocs_by_node(node.id):
+                if not alloc.terminal_status():
+                    self.table.add_alloc_usage(self.table.id_to_idx[node.id],
+                                               alloc)
+        self.table.finalize()
+        self.by_dc = {}
+        for node in nodes:
+            self.by_dc[node.datacenter] = self.by_dc.get(node.datacenter, 0) + 1
+
+    # -- static feasibility -------------------------------------------
+    def _combined_constraints(self, tg: TaskGroup) -> List:
+        assert self.job is not None
+        out = list(self.job.constraints) + list(tg.constraints)
+        for t in tg.tasks:
+            out.extend(t.constraints)
+        return out
+
+    def feasibility(self, tg: TaskGroup) -> Tuple[np.ndarray, Dict[str, int]]:
+        """(mask bool[N], filtered_counts per constraint string).
+        Vectorized FeasibilityWrapper (feasible.go:994-1134)."""
+        t = self.table
+        key = (id(self.job), self.job.version, tg.name)
+        cached = self._mask_cache.get(key)
+        if cached is not None:
+            return cached
+        mask = t.ready.copy()
+        counts: Dict[str, int] = {}
+
+        def apply(m: np.ndarray, reason: str):
+            nonlocal mask
+            newly = mask & ~m
+            n = int(newly.sum())
+            if n:
+                counts[reason] = counts.get(reason, 0) + n
+            mask &= m
+
+        # drivers (DriverChecker)
+        for task in tg.tasks:
+            if task.driver:
+                apply(t.driver_mask(task.driver),
+                      f"missing drivers \"{task.driver}\"")
+        # constraints (job + group + tasks)
+        for c in self._combined_constraints(tg):
+            if c.operand in (CONSTRAINT_DISTINCT_HOSTS,
+                             CONSTRAINT_DISTINCT_PROPERTY):
+                continue
+            apply(constraint_mask(t.cols, c.ltarget, c.rtarget, c.operand),
+                  str(c))
+        # host volumes
+        if tg.volumes:
+            apply(t.host_volume_mask(tg.volumes), "missing compatible host volumes")
+        self._mask_cache[key] = (mask, counts)
+        return mask, counts
+
+    # -- ask construction ---------------------------------------------
+    @staticmethod
+    def group_ask(tg: TaskGroup) -> np.ndarray:
+        cpu = sum(t.resources.cpu for t in tg.tasks)
+        mem = sum(t.resources.memory_mb for t in tg.tasks)
+        disk = tg.ephemeral_disk.size_mb if tg.ephemeral_disk else 0
+        return np.array([cpu, mem, disk], dtype=np.float32)
+
+    @staticmethod
+    def _port_asks(tg: TaskGroup) -> Tuple[int, List[int]]:
+        """(dynamic_count, reserved_values) over group + task networks."""
+        dyn = 0
+        reserved: List[int] = []
+        for nw in tg.networks:
+            dyn += len(nw.dynamic_ports)
+            reserved.extend(p.value for p in nw.reserved_ports)
+        for t in tg.tasks:
+            for nw in t.resources.networks:
+                dyn += len(nw.dynamic_ports)
+                reserved.extend(p.value for p in nw.reserved_ports)
+        return dyn, reserved
+
+    def _spread_inputs(self, tg: TaskGroup, proposed: ProposedIndex):
+        """Build kernel spread state (spread.go computeSpreadInfo:232)."""
+        assert self.job is not None
+        spreads = list(tg.spreads) + list(self.job.spreads)
+        if not spreads:
+            return [], 0.0
+        out = []
+        sum_w = float(sum(s.weight for s in spreads))
+        total_count = tg.count
+        for s in spreads:
+            codes, values = self.table.attr_codes(s.attribute)
+            counts, present = proposed.property_counts(s.attribute, values)
+            c = len(values)
+            desired = np.full(c + 1, -1.0, dtype=np.float32)
+            has_targets = bool(s.spread_target)
+            if has_targets:
+                explicit = {st.value: st.percent for st in s.spread_target}
+                sum_desired = 0.0
+                for v, pct in explicit.items():
+                    if v in values:
+                        d = pct / 100.0 * total_count
+                        desired[values.index(v)] = d
+                    sum_desired += pct / 100.0 * total_count
+                # implicit target for remaining values
+                if 0 < sum_desired < total_count:
+                    implicit = total_count - sum_desired
+                    for i, v in enumerate(values):
+                        if v not in explicit:
+                            desired[i] = implicit
+            out.append(dict(codes=codes, counts=counts, present=present,
+                            desired=desired, weight=float(s.weight),
+                            has_targets=has_targets))
+        return out, sum_w
+
+    def _distinct_prop_inputs(self, tg: TaskGroup, proposed: ProposedIndex):
+        """distinct_property constraints -> kernel state
+        (propertyset.go SatisfiesDistinctProperties)."""
+        out = []
+        assert self.job is not None
+        for c, scope_tg in (
+                [(c, None) for c in self.job.constraints
+                 if c.operand == CONSTRAINT_DISTINCT_PROPERTY]
+                + [(c, tg.name) for c in tg.constraints
+                   if c.operand == CONSTRAINT_DISTINCT_PROPERTY]):
+            codes, values = self.table.attr_codes(c.ltarget)
+            counts, _present = proposed.property_counts(
+                c.ltarget, values, tg_name=scope_tg)
+            try:
+                limit = int(c.rtarget) if c.rtarget else 1
+            except ValueError:
+                limit = 1
+            out.append(dict(codes=codes, counts=counts, limit=float(limit)))
+        return out
+
+    def _has_distinct_hosts(self, tg: TaskGroup) -> bool:
+        assert self.job is not None
+        for c in self.job.constraints:
+            if c.operand == CONSTRAINT_DISTINCT_HOSTS:
+                return True
+        for c in tg.constraints:
+            if c.operand == CONSTRAINT_DISTINCT_HOSTS:
+                return True
+        return False
+
+    # -- the main entry ------------------------------------------------
+    def select_batch(self, tg: TaskGroup, count: int, proposed: ProposedIndex,
+                     options: Optional[SelectOptions] = None,
+                     ) -> List[Tuple[Optional[RankedNode], AllocMetric]]:
+        """Place `count` instances of tg in one kernel dispatch. Returns
+        one (RankedNode-or-None, metrics) pair per requested instance."""
+        assert self.table is not None and self.job is not None
+        t = self.table
+        start = time.monotonic_ns()
+        mask, filtered_counts = self.feasibility(tg)
+        mask = mask.copy()
+
+        options = options or SelectOptions()
+        if options.preferred_nodes:
+            preferred_ids = {n.id for n in options.preferred_nodes}
+            pref_mask = np.fromiter((nid in preferred_ids for nid in t.ids),
+                                    dtype=bool, count=t.n)
+            mask &= pref_mask
+
+        penalty = None
+        if options.penalty_node_ids:
+            penalty = np.fromiter(
+                (nid in options.penalty_node_ids for nid in t.ids),
+                dtype=bool, count=t.n)
+
+        # affinities: job + group + tasks (rank.go NodeAffinityIterator)
+        affinities = list(self.job.affinities) + list(tg.affinities)
+        for task in tg.tasks:
+            affinities.extend(task.affinities)
+        aff_col, aff_sum = (None, 0.0)
+        if affinities:
+            aff_col, aff_sum = affinity_columns(t.cols, affinities)
+
+        dyn_ports, reserved_ports = self._port_asks(tg)
+        port_ok = t.reserved_ports_ok(reserved_ports) if reserved_ports else None
+
+        spreads, sum_spread_w = self._spread_inputs(tg, proposed)
+        distinct_props = self._distinct_prop_inputs(tg, proposed)
+
+        req = SelectRequest(
+            ask=self.group_ask(tg),
+            count=count,
+            feasible=mask,
+            capacity=t.capacity,
+            used=proposed.used(),
+            desired_count=float(max(tg.count, 1)),
+            tg_collisions=proposed.tg_counts(tg.name),
+            job_count=proposed.job_count,
+            distinct_hosts=self._has_distinct_hosts(tg),
+            scan_exclusive=bool(reserved_ports),
+            penalty=penalty,
+            affinity=aff_col,
+            affinity_sum_weights=aff_sum,
+            algorithm=self.config.effective_algorithm(),
+            port_need=float(dyn_ports),
+            free_ports=t.free_ports,
+            port_ok=port_ok,
+            spreads=spreads,
+            sum_spread_weights=sum_spread_w,
+            distinct_props=distinct_props,
+        )
+        res = self.kernel.select(req)
+        elapsed = time.monotonic_ns() - start
+
+        # host-side port assignment for winners, plan-consistent
+        out: List[Tuple[Optional[RankedNode], AllocMetric]] = []
+        for step in range(count):
+            idx = int(res.node_idx[step])
+            metrics = self._metrics_for_step(res, step, filtered_counts,
+                                             elapsed // max(count, 1))
+            if idx < 0:
+                out.append((None, metrics))
+                continue
+            node = t.nodes[idx]
+            task_resources, shared, ok = self._assign_resources(
+                node, tg, proposed.plan)
+            if not ok:
+                metrics.exhausted_node(node, "network: port assignment failed")
+                out.append((None, metrics))
+                continue
+            out.append((RankedNode(
+                node=node,
+                final_score=float(res.final_score[step]),
+                task_resources=task_resources,
+                alloc_resources=shared,
+                metrics=metrics,
+            ), metrics))
+        return out
+
+    def _metrics_for_step(self, res, step: int,
+                          filtered_counts: Dict[str, int],
+                          elapsed_ns: int) -> AllocMetric:
+        m = AllocMetric()
+        m.nodes_evaluated = res.nodes_evaluated
+        m.nodes_filtered = res.nodes_filtered
+        m.nodes_available = dict(self.by_dc)
+        m.constraint_filtered = dict(filtered_counts)
+        ex = res.exhausted_dim[step]
+        m.nodes_exhausted = int(ex.sum())
+        for d, name in enumerate(DIM_NAMES):
+            if int(ex[d]):
+                m.dimension_exhausted[name] = int(ex[d])
+        m.allocation_time_ns = int(elapsed_ns)
+        for k in range(TOP_K):
+            ni = int(res.top_idx[step][k])
+            sc = float(res.top_scores[step][k])
+            if ni < 0 or sc < -1e29:
+                continue
+            m.score_meta_data.append(NodeScoreMeta(
+                node_id=self.table.ids[ni],
+                scores={"final": sc}, norm_score=sc))
+        return m
+
+    def _net_index_for(self, node: Node, plan) -> NetworkIndex:
+        """NetworkIndex over the node's *proposed* allocations: snapshot
+        allocs minus plan stops/preemptions plus plan placements (the
+        reference feeds ProposedAllocs into the index, rank.go:204-206).
+        Cached per engine (= per eval) so offers accumulate consistently."""
+        idx = self._net_cache.get(node.id)
+        if idx is None:
+            idx = NetworkIndex()
+            idx.set_node(node)
+            stopped = set()
+            if plan is not None:
+                for a in plan.node_update.get(node.id, []):
+                    stopped.add(a.id)
+                for a in plan.node_preemptions.get(node.id, []):
+                    stopped.add(a.id)
+            idx.add_allocs([a for a in self.snapshot.allocs_by_node(node.id)
+                            if a.id not in stopped])
+            if plan is not None:
+                idx.add_allocs(plan.node_allocation.get(node.id, []))
+            self._net_cache[node.id] = idx
+        return idx
+
+    def _assign_resources(self, node: Node, tg: TaskGroup, plan=None):
+        """Build AllocatedTaskResources + shared network offer for a
+        chosen node (the tail of BinPackIterator rank.go:244-410, done
+        host-side for winners only)."""
+        idx = self._net_index_for(node, plan)
+
+        shared = None
+        if tg.networks:
+            ask = tg.networks[0].copy()
+            offer, err = idx.assign_network(ask)
+            if offer is None:
+                return {}, None, False
+            idx.add_reserved(offer)
+            shared = AllocatedSharedResources(
+                disk_mb=tg.ephemeral_disk.size_mb if tg.ephemeral_disk else 0,
+                networks=[offer])
+
+        task_resources: Dict[str, AllocatedTaskResources] = {}
+        for task in tg.tasks:
+            tr = AllocatedTaskResources(
+                cpu=AllocatedCpuResources(task.resources.cpu),
+                memory=AllocatedMemoryResources(task.resources.memory_mb))
+            if task.resources.networks:
+                ask = task.resources.networks[0].copy()
+                offer, err = idx.assign_network(ask)
+                if offer is None:
+                    return {}, None, False
+                idx.add_reserved(offer)
+                tr.networks = [offer]
+            task_resources[task.name] = tr
+        return task_resources, shared, True

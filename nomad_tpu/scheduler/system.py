@@ -1,0 +1,204 @@
+"""SystemScheduler: one alloc per feasible node per task group.
+
+Reference semantics: scheduler/system_sched.go (Process:54,
+computeJobAllocs:183, computePlacements:268) and diffSystemAllocs
+(util.go:70,201). The per-node diff is host-side; feasibility and fit
+run as columnar masks over the whole node table at once.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..models import (
+    AllocatedResources, AllocatedSharedResources, Allocation, AllocMetric,
+    Evaluation, Job, Plan,
+    ALLOC_CLIENT_LOST, ALLOC_CLIENT_PENDING, ALLOC_DESIRED_RUN,
+    EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED,
+)
+from ..ops import ProposedIndex
+from ..utils.ids import generate_uuid
+from .context import EvalContext
+from .reconcile import ALLOC_NOT_NEEDED, ALLOC_LOST
+from .stack import PlacementEngine
+from .util import tainted_nodes, tasks_updated, update_non_terminal_allocs_to_lost
+
+MAX_SYSTEM_ATTEMPTS = 5
+
+ALLOC_NODE_TAINTED = "alloc not needed as node is tainted"
+
+
+class SetStatusError(Exception):
+    def __init__(self, eval_status: str, msg: str):
+        super().__init__(msg)
+        self.eval_status = eval_status
+
+
+class SystemScheduler:
+    def __init__(self, state, planner):
+        self.state = state
+        self.planner = planner
+        self.eval: Optional[Evaluation] = None
+        self.job: Optional[Job] = None
+        self.plan: Optional[Plan] = None
+        self.failed_tg_allocs: Dict[str, AllocMetric] = {}
+        self.queued_allocs: Dict[str, int] = {}
+
+    def process(self, evaluation: Evaluation) -> None:
+        self.eval = evaluation
+        for _ in range(MAX_SYSTEM_ATTEMPTS):
+            done, progress = self._process_once()
+            if done:
+                self._set_status(EVAL_STATUS_COMPLETE, "")
+                return
+            if not progress:
+                break
+        self._set_status(EVAL_STATUS_FAILED,
+                         f"maximum attempts reached ({MAX_SYSTEM_ATTEMPTS})")
+
+    def _process_once(self):
+        ev = self.eval
+        self.job = self.state.job_by_id(ev.namespace, ev.job_id)
+        self.failed_tg_allocs = {}
+        self.queued_allocs = {}
+        self.plan = ev.make_plan(self.job)
+
+        allocs = self.state.allocs_by_job(ev.namespace, ev.job_id)
+        tainted = tainted_nodes(self.state, allocs)
+        update_non_terminal_allocs_to_lost(self.plan, tainted, allocs)
+
+        engine = PlacementEngine(self.state)
+        if self.job is None or self.job.stopped():
+            # stop everything
+            for alloc in allocs:
+                if not alloc.terminal_status():
+                    self.plan.append_stopped_alloc(alloc, ALLOC_NOT_NEEDED)
+            return self._finish()
+
+        engine.set_job(self.job)
+        n = engine.set_nodes(self.job.datacenters)
+        table = engine.table
+        live_by_node_tg = {}
+        for alloc in allocs:
+            if alloc.terminal_status():
+                continue
+            live_by_node_tg.setdefault((alloc.node_id, alloc.task_group),
+                                       []).append(alloc)
+
+        # stop allocs on nodes that are no longer ready / in the node set
+        valid_nodes = set(table.ids)
+        for alloc in allocs:
+            if alloc.terminal_status():
+                continue
+            if alloc.node_id not in valid_nodes:
+                node = tainted.get(alloc.node_id, "absent")
+                if node != "absent" and (node is None or
+                                         node.terminal_status()):
+                    self.plan.append_stopped_alloc(
+                        alloc, ALLOC_LOST, ALLOC_CLIENT_LOST)
+                else:
+                    self.plan.append_stopped_alloc(alloc, ALLOC_NODE_TAINTED)
+
+        # stop allocs whose task group was removed from the job
+        tg_names = {tg.name for tg in self.job.task_groups}
+        for alloc in allocs:
+            if alloc.terminal_status() or alloc.node_id not in valid_nodes:
+                continue
+            if alloc.task_group not in tg_names:
+                self.plan.append_stopped_alloc(alloc, ALLOC_NOT_NEEDED)
+
+        # in-place vs destructive updates for existing allocs
+        for alloc in allocs:
+            if alloc.terminal_status() or alloc.node_id not in valid_nodes:
+                continue
+            tg = self.job.lookup_task_group(alloc.task_group)
+            if tg is None:
+                continue
+            if alloc.job is not None and \
+                    alloc.job.job_modify_index != self.job.job_modify_index:
+                if tasks_updated(self.job, alloc.job, tg.name):
+                    # destructive: stop; replacement placed below
+                    self.plan.append_stopped_alloc(
+                        alloc, "alloc is being updated due to job update")
+                    entry = live_by_node_tg.get((alloc.node_id, alloc.task_group))
+                    if entry and alloc in entry:
+                        entry.remove(alloc)
+
+        # place each task group on every feasible node lacking an alloc
+        for tg in self.job.task_groups:
+            mask, filtered_counts = engine.feasibility(tg)
+            missing_idx = [i for i, nid in enumerate(table.ids)
+                           if mask[i] and not live_by_node_tg.get((nid, tg.name))]
+            if not missing_idx:
+                continue
+            proposed = ProposedIndex(table, self.job, allocs, self.plan)
+            used = proposed.used()
+            ask = engine.group_ask(tg)
+            fits = np.all(used + ask[None, :] <= table.capacity + 1e-6, axis=1)
+
+            placed = 0
+            exhausted = 0
+            for i in missing_idx:
+                node = table.nodes[i]
+                if not fits[i]:
+                    exhausted += 1
+                    continue
+                task_resources, shared, ok = engine._assign_resources(
+                    node, tg, self.plan)
+                if not ok:
+                    exhausted += 1
+                    continue
+                alloc = Allocation(
+                    id=generate_uuid(),
+                    namespace=self.job.namespace,
+                    eval_id=ev.id,
+                    name=f"{self.job.id}.{tg.name}[0]",
+                    job_id=self.job.id,
+                    task_group=tg.name,
+                    node_id=node.id,
+                    node_name=node.name,
+                    allocated_resources=AllocatedResources(
+                        tasks=task_resources,
+                        shared=shared or AllocatedSharedResources(
+                            disk_mb=tg.ephemeral_disk.size_mb
+                            if tg.ephemeral_disk else 0)),
+                    desired_status=ALLOC_DESIRED_RUN,
+                    client_status=ALLOC_CLIENT_PENDING,
+                    metrics=AllocMetric(nodes_evaluated=n,
+                                        nodes_available=dict(engine.by_dc)),
+                )
+                self.plan.append_alloc(alloc)
+                placed += 1
+            if exhausted:
+                m = AllocMetric()
+                m.nodes_evaluated = n
+                m.nodes_filtered = int(n - mask.sum())
+                m.constraint_filtered = dict(filtered_counts)
+                m.nodes_exhausted = exhausted
+                m.nodes_available = dict(engine.by_dc)
+                self.failed_tg_allocs[tg.name] = m
+                self.queued_allocs[tg.name] = exhausted
+
+        return self._finish()
+
+    def _finish(self):
+        if self.plan.is_no_op():
+            return True, False
+        result = self.planner.submit_plan(self.plan)
+        if result is None:
+            return True, False
+        full, expected, actual = result.full_commit(self.plan)
+        if not full:
+            return False, actual > 0
+        return True, False
+
+    def _set_status(self, status: str, desc: str) -> None:
+        new_eval = self.eval.copy()
+        new_eval.status = status
+        new_eval.status_description = desc
+        if self.failed_tg_allocs:
+            new_eval.failed_tg_allocs = dict(self.failed_tg_allocs)
+        new_eval.queued_allocations = dict(self.queued_allocs)
+        self.planner.update_eval(new_eval)

@@ -1,0 +1,126 @@
+"""Scheduler utilities (reference: scheduler/util.go).
+
+tasksUpdated:351, taintedNodes:312, readyNodesInDCs:233,
+updateNonTerminalAllocsToLost:898, adjustQueuedAllocations:869.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..models import (
+    Allocation, Job, Node, PlanResult, TaskGroup,
+    ALLOC_CLIENT_LOST, ALLOC_DESIRED_EVICT, ALLOC_DESIRED_STOP,
+    NODE_STATUS_DOWN,
+)
+from ..utils.codec import to_wire
+
+
+def tainted_nodes(snapshot, allocs: List[Allocation]) -> Dict[str, Optional[Node]]:
+    """Map of nodes that are tainted for the allocs (util.go:312):
+    down/draining/ineligible nodes, or missing (GC'd -> None)."""
+    out: Dict[str, Optional[Node]] = {}
+    for alloc in allocs:
+        if alloc.node_id in out:
+            continue
+        node = snapshot.node_by_id(alloc.node_id)
+        if node is None:
+            out[alloc.node_id] = None
+            continue
+        if node.drain or node.status == NODE_STATUS_DOWN:
+            out[alloc.node_id] = node
+    return out
+
+
+def _networks_wire(networks) -> list:
+    out = []
+    for nw in networks:
+        out.append({
+            "mode": nw.mode, "mbits": nw.mbits,
+            "reserved": sorted((p.label, p.value, p.to) for p in nw.reserved_ports),
+            "dynamic": sorted((p.label, p.to) for p in nw.dynamic_ports),
+        })
+    return out
+
+
+def tasks_updated(job_a: Job, job_b: Job, group: str) -> bool:
+    """Whether the group requires a destructive update (util.go:351)."""
+    a = job_a.lookup_task_group(group)
+    b = job_b.lookup_task_group(group)
+    if a is None or b is None:
+        return True
+    if len(a.tasks) != len(b.tasks):
+        return True
+    if to_wire(a.ephemeral_disk) != to_wire(b.ephemeral_disk):
+        return True
+    if _networks_wire(a.networks) != _networks_wire(b.networks):
+        return True
+    # affinities/spreads at job+tg+task level
+    aff_a = [x.key() for x in
+             list(job_a.affinities) + list(a.affinities)
+             + [af for t in a.tasks for af in t.affinities]]
+    aff_b = [x.key() for x in
+             list(job_b.affinities) + list(b.affinities)
+             + [af for t in b.tasks for af in t.affinities]]
+    if aff_a != aff_b:
+        return True
+    spread_a = [to_wire(s) for s in list(job_a.spreads) + list(a.spreads)]
+    spread_b = [to_wire(s) for s in list(job_b.spreads) + list(b.spreads)]
+    if spread_a != spread_b:
+        return True
+    for at in a.tasks:
+        bt = b.lookup_task(at.name)
+        if bt is None:
+            return True
+        if at.driver != bt.driver or at.user != bt.user:
+            return True
+        if at.config != bt.config or at.env != bt.env:
+            return True
+        if to_wire(at.artifacts) != to_wire(bt.artifacts):
+            return True
+        if to_wire(at.vault) != to_wire(bt.vault):
+            return True
+        if to_wire(at.templates) != to_wire(bt.templates):
+            return True
+        meta_a = {**job_a.meta, **a.meta, **at.meta}
+        meta_b = {**job_b.meta, **b.meta, **bt.meta}
+        if meta_a != meta_b:
+            return True
+        if _networks_wire(at.resources.networks) != _networks_wire(bt.resources.networks):
+            return True
+        if (at.resources.cpu != bt.resources.cpu
+                or at.resources.memory_mb != bt.resources.memory_mb):
+            return True
+        if to_wire(at.resources.devices) != to_wire(bt.resources.devices):
+            return True
+    return False
+
+
+def update_non_terminal_allocs_to_lost(plan, tainted: Dict[str, Optional[Node]],
+                                       allocs: List[Allocation]) -> None:
+    """On down nodes, mark non-terminal allocs lost (util.go:898)."""
+    for alloc in allocs:
+        node = tainted.get(alloc.node_id, "absent")
+        if node == "absent":
+            continue
+        if node is not None and node.status != NODE_STATUS_DOWN:
+            continue
+        if alloc.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT) and \
+                alloc.client_status in ("running", "pending"):
+            plan.append_stopped_alloc(alloc, "alloc is lost since its node is down",
+                                      ALLOC_CLIENT_LOST)
+
+
+def adjust_queued_allocations(result: Optional[PlanResult],
+                              queued: Dict[str, int]) -> None:
+    """Subtract actually-placed allocs from the queued counts (util.go:869)."""
+    if result is None:
+        return
+    for allocs in result.node_allocation.values():
+        for alloc in allocs:
+            if alloc.create_index != result.alloc_index:
+                continue
+            if alloc.task_group in queued:
+                queued[alloc.task_group] -= 1
+                if queued[alloc.task_group] <= 0:
+                    del queued[alloc.task_group]

@@ -1,0 +1,261 @@
+"""GenericScheduler behavior tests via the Harness.
+
+Reference test patterns: scheduler/generic_sched_test.go
+(TestServiceSched_JobRegister and friends).
+"""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.models import (
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING,
+    ALLOC_DESIRED_STOP, EVAL_STATUS_COMPLETE,
+    Constraint, NODE_STATUS_DOWN,
+    TRIGGER_JOB_REGISTER, TRIGGER_NODE_UPDATE,
+)
+from nomad_tpu.models.evaluation import Evaluation
+from nomad_tpu.scheduler import Harness
+
+
+def _register_eval(job, trigger=TRIGGER_JOB_REGISTER):
+    return Evaluation(
+        namespace=job.namespace, priority=job.priority, type=job.type,
+        triggered_by=trigger, job_id=job.id,
+        job_modify_index=job.modify_index)
+
+
+def test_job_register_places_all():
+    h = Harness()
+    for _ in range(10):
+        h.store.upsert_node(h.next_index(), mock.node())
+    job = mock.job()
+    h.store.upsert_job(h.next_index(), job)
+    ev = _register_eval(job)
+    h.store.upsert_evals(h.next_index(), [ev])
+
+    h.process("service", ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 10
+    # eval marked complete, no failures
+    assert h.evals[-1].status == EVAL_STATUS_COMPLETE
+    assert h.evals[-1].failed_tg_allocs == {}
+    # allocs are in the store now
+    out = h.store.allocs_by_job("default", job.id)
+    assert len(out) == 10
+    names = sorted(a.name for a in out)
+    assert names == sorted(f"{job.id}.web[{i}]" for i in range(10))
+    # each alloc got resources + dynamic ports assigned
+    for a in out:
+        tr = a.allocated_resources.tasks["web"]
+        assert tr.cpu.cpu_shares == 500
+        assert tr.networks, "expected network offer"
+        ports = tr.networks[0].dynamic_ports
+        assert len(ports) == 2
+        assert all(20000 <= p.value <= 32000 for p in ports)
+    # scoring metadata captured
+    assert out[0].metrics.nodes_evaluated == 10
+    assert out[0].metrics.score_meta_data
+
+
+def test_job_register_infeasible_creates_blocked_eval():
+    h = Harness()
+    for _ in range(3):
+        h.store.upsert_node(h.next_index(), mock.node())
+    job = mock.job()
+    job.constraints = [Constraint("${attr.kernel.name}", "windows", "=")]
+    h.store.upsert_job(h.next_index(), job)
+    ev = _register_eval(job)
+
+    h.process("service", ev)
+
+    assert h.evals[-1].status == EVAL_STATUS_COMPLETE
+    failed = h.evals[-1].failed_tg_allocs
+    assert "web" in failed
+    assert failed["web"].nodes_filtered == 3
+    assert any("kernel.name" in k for k in failed["web"].constraint_filtered)
+    # blocked eval spawned
+    assert len(h.create_evals) == 1
+    assert h.create_evals[0].status == "blocked"
+    assert h.evals[-1].blocked_eval == h.create_evals[0].id
+    # queued allocations recorded
+    assert h.evals[-1].queued_allocations.get("web") == 10
+
+
+def test_job_register_partial_capacity():
+    # only one node with room for 4 instances (500cpu each, 3900 avail)
+    h = Harness()
+    h.store.upsert_node(h.next_index(), mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 10
+    # strip ports so placement is only capacity-bound
+    job.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), job)
+    ev = _register_eval(job)
+    h.process("service", ev)
+    placed = h.store.allocs_by_job("default", job.id)
+    assert len(placed) == 7   # floor(3900/500)
+    failed = h.evals[-1].failed_tg_allocs
+    assert failed["web"].coalesced_failures == 2  # 3 failed total, 1 + 2 coalesced
+
+
+def test_job_deregister_stops_allocs():
+    h = Harness()
+    n = mock.node()
+    h.store.upsert_node(h.next_index(), n)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.store.upsert_job(h.next_index(), job)
+    ev = _register_eval(job)
+    h.process("service", ev)
+    assert len(h.store.allocs_by_job("default", job.id)) == 2
+
+    # stop the job
+    job2 = job.copy()
+    job2.stop = True
+    h.store.upsert_job(h.next_index(), job2)
+    ev2 = _register_eval(job2)
+    h.process("service", ev2)
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert all(a.desired_status == ALLOC_DESIRED_STOP for a in allocs)
+
+
+def test_scale_down_stops_highest_indexes():
+    h = Harness()
+    for _ in range(3):
+        h.store.upsert_node(h.next_index(), mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 5
+    h.store.upsert_job(h.next_index(), job)
+    h.process("service", _register_eval(job))
+    assert len([a for a in h.store.allocs_by_job("default", job.id)
+                if not a.terminal_status()]) == 5
+
+    job2 = job.copy()
+    job2.task_groups[0].count = 2
+    h.store.upsert_job(h.next_index(), job2)
+    h.process("service", _register_eval(h.store.job_by_id("default", job.id)))
+    live = [a for a in h.store.allocs_by_job("default", job.id)
+            if not a.terminal_status()]
+    assert len(live) == 2
+    assert sorted(a.index() for a in live) == [0, 1]
+
+
+def test_node_down_reschedules():
+    h = Harness()
+    n1, n2 = mock.node(), mock.node()
+    h.store.upsert_node(h.next_index(), n1)
+    h.store.upsert_node(h.next_index(), n2)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.store.upsert_job(h.next_index(), job)
+    h.process("service", _register_eval(job))
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert len(allocs) == 2
+    # mark them running
+    from nomad_tpu.models import Allocation
+    h.store.update_allocs_from_client(h.next_index(), [
+        Allocation(id=a.id, client_status=ALLOC_CLIENT_RUNNING)
+        for a in allocs])
+
+    # take node 1 down
+    h.store.update_node_status(h.next_index(), n1.id, NODE_STATUS_DOWN)
+    ev = _register_eval(job, trigger=TRIGGER_NODE_UPDATE)
+    h.process("service", ev)
+    allocs = h.store.allocs_by_job("default", job.id)
+    lost = [a for a in allocs if a.client_status == "lost"]
+    live = [a for a in allocs if not a.terminal_status()]
+    on_n1 = [a for a in allocs if a.node_id == n1.id and not a.terminal_status()]
+    assert len(lost) >= 1
+    assert len(live) == 2
+    assert not on_n1              # replacements landed on n2
+
+
+def test_batch_ignores_complete_allocs():
+    h = Harness()
+    h.store.upsert_node(h.next_index(), mock.node())
+    job = mock.batch_job()
+    job.task_groups[0].count = 2
+    h.store.upsert_job(h.next_index(), job)
+    h.process("batch", _register_eval(job))
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert len(allocs) == 2
+    # complete them successfully
+    from nomad_tpu.models import Allocation, TaskState
+    from nomad_tpu.models.alloc import TASK_STATE_DEAD
+    updates = []
+    for a in allocs:
+        updates.append(Allocation(
+            id=a.id, client_status=ALLOC_CLIENT_COMPLETE,
+            task_states={"worker": TaskState(state=TASK_STATE_DEAD,
+                                             failed=False)}))
+    h.store.update_allocs_from_client(h.next_index(), updates)
+
+    # re-eval: nothing should be placed again
+    n_plans = len(h.plans)
+    h.process("batch", _register_eval(h.store.job_by_id("default", job.id)))
+    assert h.evals[-1].status == EVAL_STATUS_COMPLETE
+    assert len(h.plans) == n_plans  # no-op, no new plan
+
+
+def test_inplace_update_on_count_change_keeps_nodes():
+    h = Harness()
+    for _ in range(3):
+        h.store.upsert_node(h.next_index(), mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 3
+    h.store.upsert_job(h.next_index(), job)
+    h.process("service", _register_eval(job))
+    before = {a.id: a.node_id
+              for a in h.store.allocs_by_job("default", job.id)}
+
+    # bump a meta key only: in-place update eligible? meta change is
+    # destructive per tasksUpdated (combined meta). Use count-neutral
+    # non-task change instead: job priority.
+    job2 = h.store.job_by_id("default", job.id).copy()
+    job2.priority = 70
+    h.store.upsert_job(h.next_index(), job2)
+    h.process("service", _register_eval(h.store.job_by_id("default", job.id)))
+    after = [a for a in h.store.allocs_by_job("default", job.id)
+             if not a.terminal_status()]
+    assert len(after) == 3
+    # same nodes kept (in-place, not destructive)
+    assert {a.node_id for a in after} == set(before.values())
+
+
+def test_failed_alloc_rescheduled_with_penalty():
+    h = Harness()
+    n1, n2 = mock.node(), mock.node()
+    h.store.upsert_node(h.next_index(), n1)
+    h.store.upsert_node(h.next_index(), n2)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    # immediate reschedule policy
+    job.task_groups[0].reschedule_policy.delay_s = 0.0
+    job.task_groups[0].reschedule_policy.delay_function = "constant"
+    job.task_groups[0].reschedule_policy.unlimited = True
+    h.store.upsert_job(h.next_index(), job)
+    h.process("service", _register_eval(job))
+    alloc = h.store.allocs_by_job("default", job.id)[0]
+    failed_node = alloc.node_id
+
+    # fail the alloc
+    import time
+    from nomad_tpu.models import Allocation, TaskState
+    from nomad_tpu.models.alloc import TASK_STATE_DEAD
+    h.store.update_allocs_from_client(h.next_index(), [Allocation(
+        id=alloc.id, client_status=ALLOC_CLIENT_FAILED,
+        task_states={"web": TaskState(state=TASK_STATE_DEAD, failed=True,
+                                      finished_at=time.time() - 60)})])
+    h.process("service", _register_eval(job, trigger="alloc-failure"))
+    live = [a for a in h.store.allocs_by_job("default", job.id)
+            if not a.terminal_status()]
+    assert len(live) == 1
+    replacement = live[0]
+    assert replacement.id != alloc.id
+    assert replacement.previous_allocation == alloc.id
+    assert replacement.reschedule_tracker is not None
+    # penalty steering: replacement avoids the failed node
+    assert replacement.node_id != failed_node

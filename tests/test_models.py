@@ -16,7 +16,7 @@ def test_job_canonicalize_and_validate():
     j = mock.job()
     assert j.validate() == []
     assert j.task_groups[0].reschedule_policy is not None
-    assert j.task_groups[0].update is not None  # service gets default update
+    assert j.task_groups[0].update is None  # structs layer does not default it
 
 
 def test_job_validate_errors():
