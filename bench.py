@@ -1,0 +1,71 @@
+"""Benchmark: batched placement throughput on the device kernel.
+
+Scenario = BASELINE.json config #2: a batch job with count=10k placed
+over 1k in-memory nodes — the pure BinPackIterator path. The reference's
+headline number for this shape is the C1M claim of "thousands of
+container deployments per second" (~5k/s cluster-wide on 5k nodes,
+/root/reference/website/pages/intro/use-cases.mdx:56-58); vs_baseline is
+measured placements/sec over that 5000/s reference rate.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    from nomad_tpu.ops.select import SelectKernel, SelectRequest
+
+    n_nodes = 1000
+    total_placements = 10240
+    batch = 10240  # whole job in ONE device dispatch (scan carries state)
+
+    rng = np.random.RandomState(42)
+    capacity = np.tile(np.array([[4000.0, 8192.0, 102400.0]], np.float32),
+                       (n_nodes, 1))
+    used = (capacity * rng.uniform(0.0, 0.2, size=(n_nodes, 3))).astype(np.float32)
+    ask = np.array([100.0, 100.0, 10.0], np.float32)  # mock batch job task
+
+    kernel = SelectKernel()
+
+    def make_req(count):
+        return SelectRequest(
+            ask=ask, count=count,
+            feasible=np.ones(n_nodes, dtype=bool),
+            capacity=capacity, used=used.copy(),
+            desired_count=float(count),
+            tg_collisions=np.zeros(n_nodes, np.int32),
+            job_count=np.zeros(n_nodes, np.int32),
+        )
+
+    # warm-up / compile
+    kernel.select(make_req(batch))
+
+    placed = 0
+    t0 = time.perf_counter()
+    remaining = total_placements
+    dispatch_times = []
+    while remaining > 0:
+        count = min(batch, remaining)
+        t_d = time.perf_counter()
+        res = kernel.select(make_req(count))
+        dispatch_times.append(time.perf_counter() - t_d)
+        placed += res.placed
+        remaining -= count
+    elapsed = time.perf_counter() - t0
+
+    per_sec = placed / elapsed
+    baseline_rate = 5000.0  # C1M: "thousands of deployments per second"
+    print(json.dumps({
+        "metric": "placements_per_sec_batch10k_1k_nodes",
+        "value": round(per_sec, 1),
+        "unit": "placements/s",
+        "vs_baseline": round(per_sec / baseline_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from .sharded import ShardedSelect, make_mesh
+
+__all__ = ["ShardedSelect", "make_mesh"]

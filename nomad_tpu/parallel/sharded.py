@@ -1,0 +1,120 @@
+"""Multi-chip scheduling: the node axis sharded over a device mesh.
+
+The reference scales the node dimension by sampling (log2(n) candidates,
+stack.go:77-89); we scale it by sharding: the NodeTable's (N, dims)
+arrays live sharded over the `nodes` mesh axis, the fused select kernel
+runs SPMD under jit, and XLA inserts the cross-shard collectives for the
+argmax/top-k reduction and the one-hot carry updates (all-gather of the
+chosen index). This is the orchestrator's analog of data parallelism:
+feasibility+scoring are embarrassingly parallel per node; only the
+winner reduction crosses ICI (SURVEY.md §2.6/§2.7).
+
+Multi-host: the same jit program runs under multi-process JAX, with the
+node axis sharded across hosts' devices; DCN only carries the per-eval
+ask vectors and result placements (small), never the node table.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.select import (C_MAX, P_MAX, S_MAX, _bucket_k, _select_scan)
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), axis_names=("nodes",))
+
+
+class ShardedSelect:
+    """Dispatches the fused placement kernel with the node axis sharded
+    over a mesh. The same _select_scan program is used — sharding is
+    expressed purely through input shardings (SPMD via pjit)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.node_sharding = NamedSharding(mesh, P("nodes"))
+        self.node2_sharding = NamedSharding(mesh, P("nodes", None))
+        self.code_sharding = NamedSharding(mesh, P(None, "nodes"))
+        self.replicated = NamedSharding(mesh, P())
+
+    def pad_to_shards(self, n: int) -> int:
+        """Pad N so it divides evenly over the mesh."""
+        shards = self.mesh.devices.size
+        per = -(-n // shards)
+        # keep lanes aligned for the VPU
+        per = max(8, per)
+        return per * shards
+
+    def place(self, capacity, used, feasible, ask, count, *,
+              tg_collisions=None, job_count=None, spread_alg=False):
+        """Sharded multi-placement. Arrays are host numpy; this puts them
+        onto the mesh with the node axis sharded and runs the scan."""
+        n = capacity.shape[0]
+        n_pad = self.pad_to_shards(n)
+
+        def pad1(a, fill, dtype):
+            out = np.full(n_pad, fill, dtype=dtype)
+            out[:n] = a
+            return out
+
+        def pad2(a):
+            out = np.zeros((n_pad, a.shape[1]), dtype=np.float32)
+            out[:n] = a
+            return out
+
+        dev = jax.device_put
+        k = _bucket_k(max(count, 1))
+        c_axis = C_MAX + 1
+        args = dict(
+            capacity=dev(pad2(capacity), self.node2_sharding),
+            used0=dev(pad2(used), self.node2_sharding),
+            feasible=dev(pad1(feasible, False, bool), self.node_sharding),
+            ask=dev(np.asarray(ask, np.float32), self.replicated),
+            k_valid=jnp.int32(count),
+            tg_coll0=dev(pad1(tg_collisions if tg_collisions is not None
+                              else np.zeros(n, np.int32), 0, np.int32),
+                         self.node_sharding),
+            job_count0=dev(pad1(job_count if job_count is not None
+                                else np.zeros(n, np.int32), 0, np.int32),
+                           self.node_sharding),
+            distinct_hosts_flag=jnp.float32(0.0),
+            scan_exclusive=jnp.float32(0.0),
+            penalty=dev(np.zeros(n_pad, bool), self.node_sharding),
+            affinity_norm=dev(np.zeros(n_pad, np.float32), self.node_sharding),
+            desired_count=jnp.float32(max(count, 1)),
+            port_need=jnp.float32(0.0),
+            free_ports=dev(np.full(n_pad, 1e9, np.float32), self.node_sharding),
+            port_ok=dev(np.ones(n_pad, bool), self.node_sharding),
+            sp_codes=dev(np.full((S_MAX, n_pad), C_MAX, np.int32),
+                         self.code_sharding),
+            sp_counts0=dev(np.zeros((S_MAX, c_axis), np.float32), self.replicated),
+            sp_present0=dev(np.zeros((S_MAX, c_axis), bool), self.replicated),
+            sp_desired=dev(np.full((S_MAX, c_axis), -1.0, np.float32),
+                           self.replicated),
+            sp_weight=dev(np.zeros(S_MAX, np.float32), self.replicated),
+            sp_has_targets=dev(np.zeros(S_MAX, bool), self.replicated),
+            sp_valid=dev(np.zeros(S_MAX, bool), self.replicated),
+            sum_spread_w=jnp.float32(0.0),
+            dp_codes=dev(np.full((P_MAX, n_pad), C_MAX, np.int32),
+                         self.code_sharding),
+            dp_counts0=dev(np.zeros((P_MAX, c_axis), np.float32), self.replicated),
+            dp_limit=dev(np.zeros(P_MAX, np.float32), self.replicated),
+            dp_valid=dev(np.zeros(P_MAX, bool), self.replicated),
+        )
+        with self.mesh:
+            carry, outs = _select_scan(
+                *args.values(), k_steps=k, spread_alg=spread_alg,
+                s_live=0, p_live=0)
+        choices = np.asarray(outs[0])[:count]
+        scores = np.asarray(outs[1])[:count]
+        # clamp padding wins (shouldn't happen: padded lanes are infeasible)
+        choices = np.where(choices >= n, -1, choices)
+        return choices, scores
