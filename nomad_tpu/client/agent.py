@@ -1,0 +1,302 @@
+"""The client node agent: fingerprint -> register -> heartbeat ->
+watch allocations -> run tasks -> push status.
+
+Reference semantics: client/client.go (registerAndHeartbeat:1526,
+watchAllocations:1969 long-poll diff by modify index, runAllocs:2190),
+client/allocrunner (task fan-out, status aggregation), taskrunner
+(restart policy, kill handling).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..models import (
+    Allocation, Node, NodeResources, TaskState, TaskEvent,
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_PENDING,
+    ALLOC_CLIENT_RUNNING,
+    NODE_STATUS_INIT, NODE_STATUS_READY,
+)
+from ..models.alloc import TASK_STATE_DEAD, TASK_STATE_PENDING, TASK_STATE_RUNNING
+from ..models.resources import (NodeCpuResources, NodeDiskResources,
+                                NodeMemoryResources)
+from ..utils.ids import generate_uuid
+from .drivers import DRIVER_CATALOG, TaskHandle
+
+LOG = logging.getLogger("nomad_tpu.client")
+
+
+@dataclass
+class ClientConfig:
+    node_name: str = ""
+    datacenter: str = "dc1"
+    node_class: str = ""
+    cpu_shares: int = 4000
+    memory_mb: int = 8192
+    disk_mb: int = 100 * 1024
+    drivers: tuple = ("mock_driver", "raw_exec", "exec")
+    meta: dict = field(default_factory=dict)
+    poll_interval_s: float = 0.2
+    heartbeat_interval_s: float = 3.0
+
+
+class TaskRunner:
+    """One task's lifecycle: start -> wait -> restart policy -> dead
+    (taskrunner/task_runner.go Run:456, shouldRestart:699)."""
+
+    def __init__(self, alloc: Allocation, task, driver, on_update):
+        self.alloc = alloc
+        self.task = task
+        self.driver = driver
+        self.on_update = on_update
+        self.state = TaskState(state=TASK_STATE_PENDING)
+        self.handle: Optional[TaskHandle] = None
+        self._kill = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"task-{self.task.name}")
+        self._thread.start()
+
+    def kill(self) -> None:
+        self._kill.set()
+        if self.handle is not None:
+            self.driver.stop_task(self.handle, self.task.kill_timeout_s)
+
+    def run(self) -> None:
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
+            if self.alloc.job else None
+        policy = tg.restart_policy if tg else None
+        restarts = 0
+        while not self._kill.is_set():
+            try:
+                self.handle = self.driver.start_task(
+                    self.task.name, self.task.config, self.task.env)
+            except RuntimeError as e:
+                self.state = TaskState(
+                    state=TASK_STATE_DEAD, failed=True,
+                    finished_at=time.time(),
+                    events=[TaskEvent(type="Driver Failure", message=str(e),
+                                      failed=True, time=int(time.time()))])
+                self.on_update()
+                return
+            self.state = TaskState(state=TASK_STATE_RUNNING,
+                                   started_at=time.time(),
+                                   restarts=restarts)
+            self.on_update()
+            self.handle.wait()
+            exit_code = self.handle.exit_code or 0
+            failed = exit_code != 0
+            if self._kill.is_set():
+                self.state = TaskState(state=TASK_STATE_DEAD, failed=False,
+                                       restarts=restarts,
+                                       started_at=self.state.started_at,
+                                       finished_at=time.time())
+                self.on_update()
+                return
+            # restart within the attempt budget regardless of mode; mode
+            # only governs post-exhaustion behavior (restarts/restarts.go:
+            # "delay" waits out the interval, "fail" marks the task dead)
+            if failed and policy is not None and restarts < policy.attempts:
+                restarts += 1
+                self.state.restarts = restarts
+                self._kill.wait(min(policy.delay_s, 0.2))  # test-friendly cap
+                continue
+            self.state = TaskState(
+                state=TASK_STATE_DEAD, failed=failed, restarts=restarts,
+                started_at=self.state.started_at, finished_at=time.time(),
+                events=[TaskEvent(type="Terminated", exit_code=exit_code,
+                                  failed=failed, time=int(time.time()))])
+            self.on_update()
+            return
+
+
+class AllocRunner:
+    """Per-allocation lifecycle (allocrunner/alloc_runner.go Run:282,
+    clientAlloc:616 status aggregation)."""
+
+    def __init__(self, alloc: Allocation, drivers: Dict[str, object],
+                 push_update):
+        self.alloc = alloc
+        self.drivers = drivers
+        self.push_update = push_update
+        self.task_runners: List[TaskRunner] = []
+        self.client_status = ALLOC_CLIENT_PENDING
+        self._l = threading.Lock()
+        self.destroyed = False
+
+    def run(self) -> None:
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
+            if self.alloc.job else None
+        if tg is None:
+            self.client_status = ALLOC_CLIENT_FAILED
+            self._push()
+            return
+        for task in tg.tasks:
+            driver = self.drivers.get(task.driver)
+            if driver is None:
+                self.client_status = ALLOC_CLIENT_FAILED
+                self._push()
+                return
+            tr = TaskRunner(self.alloc, task, driver, self._on_task_update)
+            self.task_runners.append(tr)
+        for tr in self.task_runners:
+            tr.start()
+
+    def stop(self) -> None:
+        self.destroyed = True
+        for tr in self.task_runners:
+            tr.kill()
+
+    def _on_task_update(self) -> None:
+        with self._l:
+            states = {tr.task.name: tr.state for tr in self.task_runners}
+            # aggregate client status (alloc_runner.go getClientStatus)
+            if any(ts.state == TASK_STATE_DEAD and ts.failed
+                   for ts in states.values()):
+                status = ALLOC_CLIENT_FAILED
+            elif all(ts.state == TASK_STATE_DEAD for ts in states.values()):
+                status = ALLOC_CLIENT_COMPLETE
+            elif any(ts.state == TASK_STATE_RUNNING for ts in states.values()):
+                status = ALLOC_CLIENT_RUNNING
+            else:
+                status = ALLOC_CLIENT_PENDING
+            self.client_status = status
+        self._push()
+
+    def _push(self) -> None:
+        states = {tr.task.name: tr.state for tr in self.task_runners}
+        self.push_update(Allocation(
+            id=self.alloc.id, client_status=self.client_status,
+            task_states=states, modify_time=int(time.time())))
+
+
+class Client:
+    """The node agent. Talks to the server through a narrow RPC surface
+    (register_node/heartbeat/allocs_by_node/update_alloc_status_from_client)
+    — direct method calls in-process, gRPC later."""
+
+    def __init__(self, server, config: Optional[ClientConfig] = None):
+        self.server = server
+        self.config = config or ClientConfig()
+        self.node = self._fingerprint()
+        self.drivers = {name: DRIVER_CATALOG[name]()
+                        for name in self.config.drivers}
+        self.runners: Dict[str, AllocRunner] = {}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._seen_index = 0
+
+    # -- fingerprinting (client/fingerprint) ---------------------------
+    def _fingerprint(self) -> Node:
+        from ..models import DriverInfo, NetworkResource
+        node = Node(
+            id=generate_uuid(),
+            secret_id=generate_uuid(),
+            name=self.config.node_name or f"client-{generate_uuid()[:8]}",
+            datacenter=self.config.datacenter,
+            node_class=self.config.node_class,
+            status=NODE_STATUS_INIT,
+            attributes={
+                "kernel.name": "linux",
+                "arch": "x86",
+                "nomad.version": "0.1.0",
+            },
+            meta=dict(self.config.meta),
+            node_resources=NodeResources(
+                cpu=NodeCpuResources(cpu_shares=self.config.cpu_shares),
+                memory=NodeMemoryResources(memory_mb=self.config.memory_mb),
+                disk=NodeDiskResources(disk_mb=self.config.disk_mb),
+                networks=[NetworkResource(mode="host", device="eth0",
+                                          ip="127.0.0.1", mbits=1000)],
+            ),
+        )
+        for name in self.config.drivers:
+            node.attributes[f"driver.{name}"] = "1"
+            from ..models import DriverInfo as DI
+            node.drivers[name] = DI(detected=True, healthy=True)
+        node.compute_class()
+        return node
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self.node.status = NODE_STATUS_READY
+        self.server.register_node(self.node)
+        self.server.update_node_status(self.node.id, NODE_STATUS_READY)
+        t1 = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        t2 = threading.Thread(target=self._watch_allocs, daemon=True)
+        self._threads = [t1, t2]
+        t1.start()
+        t2.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for r in self.runners.values():
+            r.stop()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _heartbeat_loop(self) -> None:
+        interval = self.config.heartbeat_interval_s
+        while not self._stop.is_set():
+            try:
+                ttl = self.server.heartbeat(self.node.id)
+                # renew at half the granted TTL (client/client.go heartbeats
+                # inside the server-granted TTL window, never beyond it)
+                interval = min(self.config.heartbeat_interval_s, ttl / 2.0)
+            except Exception:
+                LOG.exception("heartbeat failed")
+            self._stop.wait(interval)
+
+    # -- alloc watching (client/client.go watchAllocations:1969) -------
+    def _watch_allocs(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._run_allocs()
+            except Exception:
+                LOG.exception("runAllocs failed")
+            # blocking query: wake on state change or poll interval
+            self.server.store.block_min_index(
+                self._seen_index, timeout_s=self.config.poll_interval_s)
+
+    def _run_allocs(self) -> None:
+        snap = self.server.store.snapshot()
+        self._seen_index = snap.latest_index()
+        server_allocs = {a.id: a for a in snap.allocs_by_node(self.node.id)}
+        # start new allocs
+        for aid, alloc in server_allocs.items():
+            if aid in self.runners:
+                continue
+            if alloc.terminal_status():
+                continue
+            if alloc.job is None:
+                continue
+            runner = AllocRunner(alloc, self.drivers, self._push_update)
+            self.runners[aid] = runner
+            runner.run()
+        # stop allocs the server wants stopped (or that vanished)
+        for aid, runner in list(self.runners.items()):
+            server_alloc = server_allocs.get(aid)
+            if server_alloc is None or server_alloc.server_terminal_status():
+                if not runner.destroyed:
+                    runner.stop()
+                if server_alloc is None:
+                    del self.runners[aid]
+                continue
+            # prune finished runners whose final status the server has
+            # acknowledged (client gc.go analog) so long-lived clients
+            # running many short batch jobs don't accumulate runners
+            if runner.client_status in ("complete", "failed") and \
+                    server_alloc.client_status == runner.client_status:
+                del self.runners[aid]
+
+    def _push_update(self, update: Allocation) -> None:
+        try:
+            self.server.update_alloc_status_from_client([update])
+        except Exception:
+            LOG.exception("alloc update push failed")

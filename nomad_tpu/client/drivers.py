@@ -1,0 +1,149 @@
+"""Task drivers.
+
+Reference semantics: plugins/drivers/driver.go DriverPlugin (StartTask/
+WaitTask/StopTask/DestroyTask/InspectTask); drivers/mock/driver.go
+(configurable fake: run_for, exit_code, start_error, kill_after —
+:113-226) and drivers/rawexec (fork/exec runner).
+
+In-process classes for now; the plugin process boundary (go-plugin gRPC
+in the reference) arrives with the gRPC layer.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class TaskHandle:
+    task_name: str
+    driver: str
+    config: dict
+    proc: Optional[object] = None
+    exit_code: Optional[int] = None
+    error: str = ""
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    _done: threading.Event = field(default_factory=threading.Event)
+    _kill: threading.Event = field(default_factory=threading.Event)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+def _parse_duration(val) -> float:
+    """'500ms' / '3s' / '2m' / numeric seconds."""
+    if isinstance(val, (int, float)):
+        return float(val)
+    s = str(val).strip()
+    for suffix, mult in (("ms", 0.001), ("s", 1.0), ("m", 60.0), ("h", 3600.0)):
+        if s.endswith(suffix):
+            try:
+                return float(s[: -len(suffix)]) * mult
+            except ValueError:
+                return 0.0
+    try:
+        return float(s)
+    except ValueError:
+        return 0.0
+
+
+class MockDriver:
+    """drivers/mock: runs for config['run_for'], exits config['exit_code'];
+    config['start_error'] fails the start."""
+
+    name = "mock_driver"
+
+    def fingerprint(self) -> Dict[str, str]:
+        return {"driver.mock_driver": "1"}
+
+    def start_task(self, task_name: str, config: dict, env: dict) -> TaskHandle:
+        if config.get("start_error"):
+            raise RuntimeError(str(config["start_error"]))
+        h = TaskHandle(task_name=task_name, driver=self.name, config=config,
+                       started_at=time.time())
+        run_for = _parse_duration(config.get("run_for", 0))
+        exit_code = int(config.get("exit_code", 0))
+
+        def run():
+            if run_for > 0:
+                h._kill.wait(run_for)
+            h.exit_code = 137 if h._kill.is_set() else exit_code
+            h.finished_at = time.time()
+            h._done.set()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return h
+
+    def stop_task(self, handle: TaskHandle, timeout_s: float = 5.0) -> None:
+        handle._kill.set()
+        handle.wait(timeout_s)
+
+
+class RawExecDriver:
+    """drivers/rawexec: plain fork/exec, no isolation."""
+
+    name = "raw_exec"
+
+    def fingerprint(self) -> Dict[str, str]:
+        return {"driver.raw_exec": "1"}
+
+    def start_task(self, task_name: str, config: dict, env: dict) -> TaskHandle:
+        command = config.get("command")
+        if not command:
+            raise RuntimeError("missing command")
+        args = [command] + list(config.get("args", []))
+        try:
+            proc = subprocess.Popen(
+                args, env={**env} if env else None,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        except OSError as e:
+            raise RuntimeError(f"failed to exec {command}: {e}")
+        h = TaskHandle(task_name=task_name, driver=self.name, config=config,
+                       proc=proc, started_at=time.time())
+
+        def wait():
+            code = proc.wait()
+            h.exit_code = code
+            h.finished_at = time.time()
+            h._done.set()
+
+        threading.Thread(target=wait, daemon=True).start()
+        return h
+
+    def stop_task(self, handle: TaskHandle, timeout_s: float = 5.0) -> None:
+        proc = handle.proc
+        if proc is None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        handle.wait(1.0)
+
+
+class ExecDriver(RawExecDriver):
+    """drivers/exec: in the reference this adds chroot+cgroup isolation
+    via shared/executor; isolation is a later-round concern, the
+    execution contract is the same."""
+
+    name = "exec"
+
+    def fingerprint(self) -> Dict[str, str]:
+        return {"driver.exec": "1"}
+
+
+DRIVER_CATALOG = {
+    "mock_driver": MockDriver,
+    "raw_exec": RawExecDriver,
+    "exec": ExecDriver,
+}

@@ -273,112 +273,148 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
     return carry, outs
 
 
+# Kinds for each packed argument: how its leading axis shards over a
+# node-axis mesh (parallel/sharded.py). "node"=[N], "node2"=[N,d],
+# "code"=[S,N] style, "rep"=replicated small state, "scalar"=0-d.
+PACK_SHARD_KINDS = {
+    "capacity": "node2", "used0": "node2", "feasible": "node",
+    "ask": "rep", "k_valid": "scalar",
+    "tg_coll0": "node", "job_count0": "node",
+    "distinct_hosts_flag": "scalar", "scan_exclusive": "scalar",
+    "penalty": "node", "affinity_norm": "node", "desired_count": "scalar",
+    "port_need": "scalar", "free_ports": "node", "port_ok": "node",
+    "sp_codes": "code", "sp_counts0": "rep", "sp_present0": "rep",
+    "sp_desired": "rep", "sp_weight": "rep", "sp_has_targets": "rep",
+    "sp_valid": "rep", "sum_spread_w": "scalar",
+    "dp_codes": "code", "dp_counts0": "rep", "dp_limit": "rep",
+    "dp_valid": "rep",
+}
+
+MAX_SCAN_STEPS = 65536
+
+
+def pack_request(req: SelectRequest, n_pad: int):
+    """Pad/pack a SelectRequest into the _select_scan argument dict
+    (keys match the kernel's parameter names; PACK_SHARD_KINDS describes
+    each argument's sharding axis). Shared by the single-device kernel
+    wrapper and the mesh-sharded dispatcher."""
+    if req.count > MAX_SCAN_STEPS:
+        raise ValueError(
+            f"count={req.count} exceeds the scan cap of {MAX_SCAN_STEPS}; "
+            f"split the placement batch")
+    n = len(req.feasible)
+
+    def pad1(a, fill=0.0, dtype=np.float32):
+        out = np.full(n_pad, fill, dtype=dtype)
+        out[:n] = a
+        return out
+
+    def pad2(a):
+        out = np.zeros((n_pad, a.shape[1]), dtype=np.float32)
+        out[:n] = a
+        return out
+
+    if req.affinity is not None and req.affinity_sum_weights > 0:
+        affinity_norm = pad1(req.affinity / req.affinity_sum_weights)
+    else:
+        affinity_norm = np.zeros(n_pad, dtype=np.float32)
+
+    s_live = min(len(req.spreads), S_MAX)
+    c_axis = C_MAX + 1
+    sp_codes = np.full((S_MAX, n_pad), C_MAX, dtype=np.int32)
+    sp_counts = np.zeros((S_MAX, c_axis), dtype=np.float32)
+    sp_present = np.zeros((S_MAX, c_axis), dtype=bool)
+    sp_desired = np.full((S_MAX, c_axis), -1.0, dtype=np.float32)
+    sp_weight = np.zeros(S_MAX, dtype=np.float32)
+    sp_has_targets = np.zeros(S_MAX, dtype=bool)
+    sp_valid = np.zeros(S_MAX, dtype=bool)
+    for s, sp in enumerate(req.spreads[:S_MAX]):
+        m = len(sp["codes"])
+        sp_codes[s, :m] = np.minimum(sp["codes"], C_MAX)
+        c = min(len(sp["counts"]), c_axis)
+        sp_counts[s, :c] = sp["counts"][:c]
+        sp_present[s, :c] = sp["present"][:c]
+        sp_desired[s, :c] = sp["desired"][:c]
+        sp_weight[s] = sp["weight"]
+        sp_has_targets[s] = sp["has_targets"]
+        sp_valid[s] = True
+
+    p_live = min(len(req.distinct_props), P_MAX)
+    dp_codes = np.full((P_MAX, n_pad), C_MAX, dtype=np.int32)
+    dp_counts = np.zeros((P_MAX, c_axis), dtype=np.float32)
+    dp_limit = np.zeros(P_MAX, dtype=np.float32)
+    dp_valid = np.zeros(P_MAX, dtype=bool)
+    for p, dp in enumerate(req.distinct_props[:P_MAX]):
+        m = len(dp["codes"])
+        dp_codes[p, :m] = np.minimum(dp["codes"], C_MAX)
+        c = min(len(dp["counts"]), c_axis)
+        dp_counts[p, :c] = dp["counts"][:c]
+        dp_limit[p] = dp["limit"]
+        dp_valid[p] = True
+
+    args = dict(
+        capacity=pad2(req.capacity),
+        used0=pad2(req.used),
+        feasible=pad1(req.feasible, False, bool),
+        ask=np.asarray(req.ask, np.float32),
+        k_valid=jnp.int32(req.count),
+        tg_coll0=pad1(req.tg_collisions, 0, np.int32),
+        job_count0=pad1(req.job_count, 0, np.int32),
+        distinct_hosts_flag=jnp.float32(1.0 if req.distinct_hosts else 0.0),
+        scan_exclusive=jnp.float32(1.0 if req.scan_exclusive else 0.0),
+        penalty=pad1(req.penalty if req.penalty is not None
+                     else np.zeros(n, bool), False, bool),
+        affinity_norm=affinity_norm,
+        desired_count=jnp.float32(req.desired_count),
+        port_need=jnp.float32(req.port_need),
+        free_ports=pad1(req.free_ports if req.free_ports is not None
+                        else np.full(n, 1e9, np.float32)),
+        port_ok=pad1(req.port_ok if req.port_ok is not None
+                     else np.ones(n, bool), False, bool),
+        sp_codes=sp_codes, sp_counts0=sp_counts, sp_present0=sp_present,
+        sp_desired=sp_desired, sp_weight=sp_weight,
+        sp_has_targets=sp_has_targets, sp_valid=sp_valid,
+        sum_spread_w=jnp.float32(req.sum_spread_weights),
+        dp_codes=dp_codes, dp_counts0=dp_counts, dp_limit=dp_limit,
+        dp_valid=dp_valid,
+    )
+    statics = dict(spread_alg=(req.algorithm == "spread"),
+                   s_live=s_live, p_live=p_live)
+    return args, statics
+
+
+def unpack_result(req: SelectRequest, outs) -> SelectResult:
+    (choices, finals, s_bin, s_anti, s_pen, s_aff, s_spread,
+     top_idx, top_scores, exhausted, _ok_counts) = [
+        np.asarray(o) for o in outs]
+    n = len(req.feasible)
+    kk = req.count
+    choices = choices[:kk]
+    choices = np.where(choices >= n, -1, choices)  # padding lanes
+    placed = int((choices >= 0).sum())
+    top_idx = np.where(top_idx >= n, -1, top_idx)
+    return SelectResult(
+        node_idx=choices,
+        final_score=finals[:kk],
+        scores={"binpack": s_bin[:kk], "job-anti-affinity": s_anti[:kk],
+                "node-reschedule-penalty": s_pen[:kk],
+                "node-affinity": s_aff[:kk],
+                "allocation-spread": s_spread[:kk]},
+        top_idx=top_idx[:kk], top_scores=top_scores[:kk],
+        nodes_evaluated=n,
+        nodes_filtered=int(n - np.count_nonzero(req.feasible)),
+        exhausted_dim=exhausted[:kk],
+        placed=placed,
+    )
+
+
 class SelectKernel:
     """Host wrapper: pads request arrays, dispatches the scan kernel, and
     unpacks results."""
 
     def select(self, req: SelectRequest) -> SelectResult:
-        n = len(req.feasible)
-        n_pad = _pad_n(n)
+        n_pad = _pad_n(len(req.feasible))
         k = _bucket_k(max(req.count, 1))
-
-        def pad1(a, fill=0.0, dtype=np.float32):
-            out = np.full(n_pad, fill, dtype=dtype)
-            out[:n] = a
-            return out
-
-        def pad2(a, fill=0.0):
-            out = np.full((n_pad, a.shape[1]), fill, dtype=np.float32)
-            out[:n] = a
-            return out
-
-        feasible = pad1(req.feasible, False, bool)
-        capacity = pad2(req.capacity)
-        used = pad2(req.used)
-        penalty = pad1(req.penalty if req.penalty is not None
-                       else np.zeros(n, bool), False, bool)
-        if req.affinity is not None and req.affinity_sum_weights > 0:
-            affinity_norm = pad1(req.affinity / req.affinity_sum_weights)
-        else:
-            affinity_norm = np.zeros(n_pad, dtype=np.float32)
-        tg_coll = pad1(req.tg_collisions, 0, np.int32)
-        job_cnt = pad1(req.job_count, 0, np.int32)
-        free_ports = pad1(req.free_ports if req.free_ports is not None
-                          else np.full(n, 1e9, np.float32))
-        port_ok = pad1(req.port_ok if req.port_ok is not None
-                       else np.ones(n, bool), False, bool)
-
-        s_live = min(len(req.spreads), S_MAX)
-        c_axis = C_MAX + 1
-        sp_codes = np.full((S_MAX, n_pad), C_MAX, dtype=np.int32)
-        sp_counts = np.zeros((S_MAX, c_axis), dtype=np.float32)
-        sp_present = np.zeros((S_MAX, c_axis), dtype=bool)
-        sp_desired = np.full((S_MAX, c_axis), -1.0, dtype=np.float32)
-        sp_weight = np.zeros(S_MAX, dtype=np.float32)
-        sp_has_targets = np.zeros(S_MAX, dtype=bool)
-        sp_valid = np.zeros(S_MAX, dtype=bool)
-        for s, sp in enumerate(req.spreads[:S_MAX]):
-            m = len(sp["codes"])
-            sp_codes[s, :m] = np.minimum(sp["codes"], C_MAX)
-            c = min(len(sp["counts"]), c_axis)
-            sp_counts[s, :c] = sp["counts"][:c]
-            sp_present[s, :c] = sp["present"][:c]
-            sp_desired[s, :c] = sp["desired"][:c]
-            sp_weight[s] = sp["weight"]
-            sp_has_targets[s] = sp["has_targets"]
-            sp_valid[s] = True
-
-        p_live = min(len(req.distinct_props), P_MAX)
-        dp_codes = np.full((P_MAX, n_pad), C_MAX, dtype=np.int32)
-        dp_counts = np.zeros((P_MAX, c_axis), dtype=np.float32)
-        dp_limit = np.zeros(P_MAX, dtype=np.float32)
-        dp_valid = np.zeros(P_MAX, dtype=bool)
-        for p, dp in enumerate(req.distinct_props[:P_MAX]):
-            m = len(dp["codes"])
-            dp_codes[p, :m] = np.minimum(dp["codes"], C_MAX)
-            c = min(len(dp["counts"]), c_axis)
-            dp_counts[p, :c] = dp["counts"][:c]
-            dp_limit[p] = dp["limit"]
-            dp_valid[p] = True
-
-        carry, outs = _select_scan(
-            jnp.asarray(capacity), jnp.asarray(used), jnp.asarray(feasible),
-            jnp.asarray(req.ask, dtype=jnp.float32), jnp.int32(req.count),
-            jnp.asarray(tg_coll), jnp.asarray(job_cnt),
-            jnp.float32(1.0 if req.distinct_hosts else 0.0),
-            jnp.float32(1.0 if req.scan_exclusive else 0.0),
-            jnp.asarray(penalty), jnp.asarray(affinity_norm),
-            jnp.float32(req.desired_count),
-            jnp.float32(req.port_need), jnp.asarray(free_ports),
-            jnp.asarray(port_ok),
-            jnp.asarray(sp_codes), jnp.asarray(sp_counts),
-            jnp.asarray(sp_present), jnp.asarray(sp_desired),
-            jnp.asarray(sp_weight), jnp.asarray(sp_has_targets),
-            jnp.asarray(sp_valid), jnp.float32(req.sum_spread_weights),
-            jnp.asarray(dp_codes), jnp.asarray(dp_counts),
-            jnp.asarray(dp_limit), jnp.asarray(dp_valid),
-            k_steps=k, spread_alg=(req.algorithm == "spread"),
-            s_live=s_live, p_live=p_live,
-        )
-        (choices, finals, s_bin, s_anti, s_pen, s_aff, s_spread,
-         top_idx, top_scores, exhausted, ok_counts) = [
-            np.asarray(o) for o in outs]
-
-        kk = req.count
-        choices = choices[:kk]
-        placed = int((choices >= 0).sum())
-        # nodes beyond the real table are padding; clamp top-k indices
-        top_idx = np.where(top_idx >= n, -1, top_idx)
-        return SelectResult(
-            node_idx=choices,
-            final_score=finals[:kk],
-            scores={"binpack": s_bin[:kk], "job-anti-affinity": s_anti[:kk],
-                    "node-reschedule-penalty": s_pen[:kk],
-                    "node-affinity": s_aff[:kk],
-                    "allocation-spread": s_spread[:kk]},
-            top_idx=top_idx[:kk], top_scores=top_scores[:kk],
-            nodes_evaluated=n,
-            nodes_filtered=int(n - np.count_nonzero(req.feasible)),
-            exhausted_dim=exhausted[:kk],
-            placed=placed,
-        )
+        args, statics = pack_request(req, n_pad)
+        _carry, outs = _select_scan(**args, k_steps=k, **statics)
+        return unpack_result(req, outs)

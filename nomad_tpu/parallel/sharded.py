@@ -19,11 +19,11 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.select import (C_MAX, P_MAX, S_MAX, _bucket_k, _select_scan)
+from ..ops.select import (PACK_SHARD_KINDS, SelectRequest, _bucket_k,
+                          _select_scan, pack_request, unpack_result)
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -53,68 +53,41 @@ class ShardedSelect:
         per = max(8, per)
         return per * shards
 
+    def _sharding_for(self, kind: str):
+        return {"node": self.node_sharding, "node2": self.node2_sharding,
+                "code": self.code_sharding, "rep": self.replicated,
+                "scalar": None}[kind]
+
+    def select(self, req: SelectRequest):
+        """Full sharded dispatch of a SelectRequest: identical semantics
+        to SelectKernel.select, with the node axis spread over the mesh.
+        Packing is shared with the single-device path (pack_request);
+        only the device placement differs."""
+        n_pad = self.pad_to_shards(len(req.feasible))
+        k = _bucket_k(max(req.count, 1))
+        args, statics = pack_request(req, n_pad)
+        placed_args = {}
+        for name, value in args.items():
+            sharding = self._sharding_for(PACK_SHARD_KINDS[name])
+            placed_args[name] = (value if sharding is None
+                                 else jax.device_put(value, sharding))
+        with self.mesh:
+            _carry, outs = _select_scan(**placed_args, k_steps=k, **statics)
+        return unpack_result(req, outs)
+
     def place(self, capacity, used, feasible, ask, count, *,
               tg_collisions=None, job_count=None, spread_alg=False):
-        """Sharded multi-placement. Arrays are host numpy; this puts them
-        onto the mesh with the node axis sharded and runs the scan."""
+        """Convenience wrapper: basic sharded multi-placement."""
         n = capacity.shape[0]
-        n_pad = self.pad_to_shards(n)
-
-        def pad1(a, fill, dtype):
-            out = np.full(n_pad, fill, dtype=dtype)
-            out[:n] = a
-            return out
-
-        def pad2(a):
-            out = np.zeros((n_pad, a.shape[1]), dtype=np.float32)
-            out[:n] = a
-            return out
-
-        dev = jax.device_put
-        k = _bucket_k(max(count, 1))
-        c_axis = C_MAX + 1
-        args = dict(
-            capacity=dev(pad2(capacity), self.node2_sharding),
-            used0=dev(pad2(used), self.node2_sharding),
-            feasible=dev(pad1(feasible, False, bool), self.node_sharding),
-            ask=dev(np.asarray(ask, np.float32), self.replicated),
-            k_valid=jnp.int32(count),
-            tg_coll0=dev(pad1(tg_collisions if tg_collisions is not None
-                              else np.zeros(n, np.int32), 0, np.int32),
-                         self.node_sharding),
-            job_count0=dev(pad1(job_count if job_count is not None
-                                else np.zeros(n, np.int32), 0, np.int32),
-                           self.node_sharding),
-            distinct_hosts_flag=jnp.float32(0.0),
-            scan_exclusive=jnp.float32(0.0),
-            penalty=dev(np.zeros(n_pad, bool), self.node_sharding),
-            affinity_norm=dev(np.zeros(n_pad, np.float32), self.node_sharding),
-            desired_count=jnp.float32(max(count, 1)),
-            port_need=jnp.float32(0.0),
-            free_ports=dev(np.full(n_pad, 1e9, np.float32), self.node_sharding),
-            port_ok=dev(np.ones(n_pad, bool), self.node_sharding),
-            sp_codes=dev(np.full((S_MAX, n_pad), C_MAX, np.int32),
-                         self.code_sharding),
-            sp_counts0=dev(np.zeros((S_MAX, c_axis), np.float32), self.replicated),
-            sp_present0=dev(np.zeros((S_MAX, c_axis), bool), self.replicated),
-            sp_desired=dev(np.full((S_MAX, c_axis), -1.0, np.float32),
-                           self.replicated),
-            sp_weight=dev(np.zeros(S_MAX, np.float32), self.replicated),
-            sp_has_targets=dev(np.zeros(S_MAX, bool), self.replicated),
-            sp_valid=dev(np.zeros(S_MAX, bool), self.replicated),
-            sum_spread_w=jnp.float32(0.0),
-            dp_codes=dev(np.full((P_MAX, n_pad), C_MAX, np.int32),
-                         self.code_sharding),
-            dp_counts0=dev(np.zeros((P_MAX, c_axis), np.float32), self.replicated),
-            dp_limit=dev(np.zeros(P_MAX, np.float32), self.replicated),
-            dp_valid=dev(np.zeros(P_MAX, bool), self.replicated),
+        req = SelectRequest(
+            ask=np.asarray(ask, np.float32), count=count,
+            feasible=feasible, capacity=capacity, used=used,
+            desired_count=float(max(count, 1)),
+            tg_collisions=(tg_collisions if tg_collisions is not None
+                           else np.zeros(n, np.int32)),
+            job_count=(job_count if job_count is not None
+                       else np.zeros(n, np.int32)),
+            algorithm="spread" if spread_alg else "binpack",
         )
-        with self.mesh:
-            carry, outs = _select_scan(
-                *args.values(), k_steps=k, spread_alg=spread_alg,
-                s_live=0, p_live=0)
-        choices = np.asarray(outs[0])[:count]
-        scores = np.asarray(outs[1])[:count]
-        # clamp padding wins (shouldn't happen: padded lanes are infeasible)
-        choices = np.where(choices >= n, -1, choices)
-        return choices, scores
+        res = self.select(req)
+        return res.node_idx, res.final_score

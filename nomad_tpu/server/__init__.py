@@ -1,0 +1,6 @@
+from .eval_broker import EvalBroker
+from .blocked_evals import BlockedEvals
+from .plan_queue import PlanQueue
+from .plan_applier import PlanApplier
+from .worker import Worker
+from .core import Server, ServerConfig

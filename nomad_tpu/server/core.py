@@ -1,0 +1,384 @@
+"""The server: state store + FSM apply + broker + plan pipeline + workers.
+
+Reference semantics: nomad/server.go (NewServer:295, setupWorkers:1438),
+nomad/fsm.go (the ~45 log-type dispatch collapses to the raft_apply
+switch here), nomad/leader.go (establishLeadership:222 — broker/blocked/
+plan-queue enablement, restoreEvals:496, reapFailedEvaluations:766),
+nomad/heartbeat.go (TTL timers -> node down -> createNodeEvals,
+node_endpoint.go:1318).
+
+Round-1 consensus: a single-node raft shim (monotonic index + serialized
+apply). The FSM surface is kept narrow and explicit so a replicated log
+can replace `raft_apply` without touching callers.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..models import (
+    Allocation, Evaluation, Job, Node,
+    EVAL_STATUS_FAILED, EVAL_STATUS_PENDING,
+    JOB_STATUS_PENDING, JOB_STATUS_RUNNING,
+    JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM,
+    NODE_STATUS_DOWN, NODE_STATUS_READY,
+    TRIGGER_JOB_DEREGISTER, TRIGGER_JOB_REGISTER, TRIGGER_NODE_UPDATE,
+)
+from ..state import StateStore
+from .blocked_evals import BlockedEvals
+from .eval_broker import EvalBroker, FAILED_QUEUE
+from .plan_applier import PlanApplier
+from .plan_queue import PlanQueue
+from .worker import Worker
+
+LOG = logging.getLogger("nomad_tpu.server")
+
+
+@dataclass
+class ServerConfig:
+    num_schedulers: int = 2
+    enabled_schedulers: tuple = ("service", "batch", "system")
+    heartbeat_ttl_s: float = 10.0
+    failed_eval_unblock_delay_s: float = 60.0
+    dev_mode: bool = True
+
+
+class Server:
+    def __init__(self, config: Optional[ServerConfig] = None):
+        self.config = config or ServerConfig()
+        self.store = StateStore()
+        self._raft_l = threading.Lock()
+        self._raft_index = 10
+
+        self.eval_broker = EvalBroker()
+        self.blocked_evals = BlockedEvals(self._unblock_enqueue)
+        self.plan_queue = PlanQueue()
+        self.plan_applier = PlanApplier(self.plan_queue, self)
+        self.workers: List[Worker] = []
+        self._heartbeat_timers: Dict[str, threading.Timer] = {}
+        self._hb_lock = threading.Lock()
+        self._leader = False
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self.establish_leadership()
+        self.plan_applier.start()
+        for i in range(self.config.num_schedulers):
+            w = Worker(self, list(self.config.enabled_schedulers), wid=i)
+            self.workers.append(w)
+            w.start()
+        self._reaper = threading.Thread(target=self._reap_failed_evals,
+                                        daemon=True, name="eval-reaper")
+        self._reaper.start()
+
+    def shutdown(self) -> None:
+        self._leader = False
+        for w in self.workers:
+            w.stop()
+        self.plan_applier.stop()
+        self.eval_broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.plan_queue.set_enabled(False)
+        with self._hb_lock:
+            for t in self._heartbeat_timers.values():
+                t.cancel()
+            self._heartbeat_timers.clear()
+
+    def establish_leadership(self) -> None:
+        """leader.go establishLeadership:222."""
+        self.eval_broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.plan_queue.set_enabled(True)
+        self._leader = True
+        self._restore_evals()
+
+    def _reap_failed_evals(self) -> None:
+        """Drain the broker's failed queue: mark the eval failed and
+        create a delayed failed-follow-up so the work retries after the
+        storm passes (leader.go reapFailedEvaluations:766)."""
+        while self._leader:
+            ev, token = self.eval_broker.dequeue([FAILED_QUEUE], timeout_s=0.5)
+            if ev is None:
+                continue
+            failed = ev.copy()
+            failed.status = EVAL_STATUS_FAILED
+            follow_up = ev.create_failed_follow_up_eval(
+                self.config.failed_eval_unblock_delay_s)
+            failed.next_eval = follow_up.id
+            try:
+                self.raft_apply("eval_update", dict(evals=[failed, follow_up]))
+                self.eval_broker.ack(ev.id, token)
+            except Exception:
+                LOG.exception("failed-eval reap for %s", ev.id)
+
+    def _restore_evals(self) -> None:
+        """Re-enqueue non-terminal evals after leadership (leader.go:496)."""
+        for ev in self.store.evals():
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    # -- raft shim -----------------------------------------------------
+    def raft_apply(self, msg_type: str, payload: dict) -> int:
+        """Serialized FSM apply (fsm.go Apply:210-300). Returns the index."""
+        with self._raft_l:
+            self._raft_index += 1
+            index = self._raft_index
+        fn = getattr(self, f"_apply_{msg_type}")
+        fn(index, payload)
+        return index
+
+    # -- FSM appliers --------------------------------------------------
+    def _apply_job_register(self, index: int, p: dict) -> None:
+        job: Job = p["job"]
+        self.store.upsert_job(index, job)
+        self.blocked_evals.untrack(job.namespace, job.id)
+        for ev in p.get("evals", []):
+            self.store.upsert_evals(index, [ev])
+            self.enqueue_eval(ev)
+
+    def _apply_job_deregister(self, index: int, p: dict) -> None:
+        namespace, job_id = p["namespace"], p["job_id"]
+        if p.get("purge"):
+            self.store.delete_job(index, namespace, job_id)
+        else:
+            job = self.store.job_by_id(namespace, job_id)
+            if job is not None:
+                stopped = job.copy()
+                stopped.stop = True
+                self.store.upsert_job(index, stopped)
+        for ev in p.get("evals", []):
+            self.store.upsert_evals(index, [ev])
+            self.enqueue_eval(ev)
+
+    def _apply_eval_update(self, index: int, p: dict) -> None:
+        evals: List[Evaluation] = p["evals"]
+        self.store.upsert_evals(index, evals)
+        for ev in evals:
+            self.enqueue_eval(ev)
+
+    def _apply_eval_delete(self, index: int, p: dict) -> None:
+        self.store.delete_evals(index, p["eval_ids"], p.get("alloc_ids"))
+
+    def _apply_node_register(self, index: int, p: dict) -> None:
+        node: Node = p["node"]
+        self.store.upsert_node(index, node)
+        stored = self.store.node_by_id(node.id)
+        if stored is not None and stored.ready():
+            self.blocked_evals.unblock(stored.computed_class, index)
+
+    def _apply_node_deregister(self, index: int, p: dict) -> None:
+        self.store.delete_node(index, p["node_ids"])
+
+    def _apply_node_status_update(self, index: int, p: dict) -> None:
+        node_id, status = p["node_id"], p["status"]
+        self.store.update_node_status(index, node_id, status, int(time.time()))
+        node = self.store.node_by_id(node_id)
+        if node is None:
+            return
+        if status == NODE_STATUS_READY:
+            self.blocked_evals.unblock(node.computed_class, index)
+        evals = p.get("evals", [])
+        if evals:
+            self.store.upsert_evals(index, evals)
+            for ev in evals:
+                self.enqueue_eval(ev)
+
+    def _apply_node_eligibility_update(self, index: int, p: dict) -> None:
+        self.store.update_node_eligibility(index, p["node_id"], p["eligibility"])
+        node = self.store.node_by_id(p["node_id"])
+        if node is not None and node.ready():
+            self.blocked_evals.unblock(node.computed_class, index)
+
+    def _apply_node_drain_update(self, index: int, p: dict) -> None:
+        self.store.update_node_drain(index, p["node_id"], p["drain_strategy"],
+                                     p.get("mark_eligible", False))
+
+    def _apply_alloc_client_update(self, index: int, p: dict) -> None:
+        allocs: List[Allocation] = p["allocs"]
+        self.store.update_allocs_from_client(index, allocs)
+        # failed/stopped allocs free capacity -> unblock by node class
+        for stub in allocs:
+            alloc = self.store.alloc_by_id(stub.id)
+            if alloc is None or not alloc.client_terminal_status():
+                continue
+            node = self.store.node_by_id(alloc.node_id)
+            if node is not None:
+                self.blocked_evals.unblock(node.computed_class, index)
+        for ev in p.get("evals", []):
+            self.store.upsert_evals(index, [ev])
+            self.enqueue_eval(ev)
+
+    def _apply_plan_results(self, index: int, p: dict) -> None:
+        self.store.upsert_plan_results(
+            index,
+            allocs_stopped=p["allocs_stopped"],
+            allocs_placed=p["allocs_placed"],
+            allocs_preempted=p["allocs_preempted"],
+            deployment=p.get("deployment"),
+            deployment_updates=p.get("deployment_updates"),
+            evals=p.get("evals"),
+        )
+        self._reconcile_job_statuses(index, p)
+
+    def _apply_scheduler_config(self, index: int, p: dict) -> None:
+        self.store.set_scheduler_config(index, p["config"])
+
+    def _apply_deployment_status_update(self, index: int, p: dict) -> None:
+        self.store.update_deployment_status(
+            index, p["update"], p.get("job"), p.get("evals"))
+        for ev in p.get("evals", []):
+            self.enqueue_eval(ev)
+
+    def _reconcile_job_statuses(self, index: int, p: dict) -> None:
+        """Derive job status from alloc states (fsm setJobStatus analog)."""
+        seen = set()
+        for a in p.get("allocs_placed", []):
+            key = (a.namespace, a.job_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            job = self.store.job_by_id(*key)
+            if job is not None and job.status == JOB_STATUS_PENDING:
+                self.store.set_job_status(index, key[0], key[1],
+                                          JOB_STATUS_RUNNING)
+
+    # -- eval routing --------------------------------------------------
+    def enqueue_eval(self, ev: Evaluation) -> None:
+        if ev.should_enqueue():
+            self.eval_broker.enqueue(ev)
+        elif ev.should_block():
+            self.blocked_evals.block(ev)
+
+    def _unblock_enqueue(self, ev: Evaluation) -> None:
+        """Blocked eval woken: back to pending + broker."""
+        woke = ev.copy()
+        woke.status = EVAL_STATUS_PENDING
+        index = self.raft_apply("eval_update", dict(evals=[woke]))
+
+    # -- north-bound API (the RPC endpoint surface) --------------------
+    def register_job(self, job: Job) -> Evaluation:
+        """Job.Register (nomad/job_endpoint.go:79): canonicalize,
+        validate, upsert, create eval."""
+        job.canonicalize()
+        errs = job.validate()
+        if errs:
+            raise ValueError("; ".join(errs))
+        ev = Evaluation(
+            namespace=job.namespace, priority=job.priority, type=job.type,
+            triggered_by=TRIGGER_JOB_REGISTER, job_id=job.id,
+            status=EVAL_STATUS_PENDING)
+        index = self.raft_apply("job_register", dict(job=job, evals=[]))
+        ev.job_modify_index = index
+        ev.modify_index = index
+        self.raft_apply("eval_update", dict(evals=[ev]))
+        return ev
+
+    def deregister_job(self, namespace: str, job_id: str,
+                       purge: bool = False) -> Evaluation:
+        job = self.store.job_by_id(namespace, job_id)
+        ev = Evaluation(
+            namespace=namespace,
+            priority=job.priority if job else 50,
+            type=job.type if job else JOB_TYPE_SERVICE,
+            triggered_by=TRIGGER_JOB_DEREGISTER, job_id=job_id,
+            status=EVAL_STATUS_PENDING)
+        self.raft_apply("job_deregister",
+                        dict(namespace=namespace, job_id=job_id, purge=purge,
+                             evals=[ev]))
+        return ev
+
+    def register_node(self, node: Node) -> None:
+        node.canonicalize()
+        if not node.computed_class:
+            node.compute_class()
+        self.raft_apply("node_register", dict(node=node))
+        self.reset_heartbeat_timer(node.id)
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        evals = []
+        if status == NODE_STATUS_DOWN:
+            evals = self._node_evals(node_id)
+        self.raft_apply("node_status_update",
+                        dict(node_id=node_id, status=status, evals=evals))
+
+    def update_alloc_status_from_client(self, allocs: List[Allocation]) -> None:
+        """Node.UpdateAlloc: client pushes task states; failed allocs
+        trigger alloc-failure evals (node_endpoint.go:1065)."""
+        evals = []
+        seen = set()
+        for stub in allocs:
+            existing = self.store.alloc_by_id(stub.id)
+            if existing is None:
+                continue
+            if stub.client_status == "failed" and (existing.namespace,
+                                                   existing.job_id) not in seen:
+                job = self.store.job_by_id(existing.namespace, existing.job_id)
+                if job is not None and not job.stopped():
+                    seen.add((existing.namespace, existing.job_id))
+                    evals.append(Evaluation(
+                        namespace=existing.namespace, priority=job.priority,
+                        type=job.type, triggered_by="alloc-failure",
+                        job_id=existing.job_id, status=EVAL_STATUS_PENDING))
+        self.raft_apply("alloc_client_update", dict(allocs=allocs, evals=evals))
+
+    def _node_evals(self, node_id: str) -> List[Evaluation]:
+        """One eval per job with allocs on the node + each system job
+        (node_endpoint.go createNodeEvals:1318)."""
+        evals = []
+        jobs = set()
+        for alloc in self.store.allocs_by_node(node_id):
+            key = (alloc.namespace, alloc.job_id)
+            if key in jobs:
+                continue
+            jobs.add(key)
+            job = alloc.job or self.store.job_by_id(*key)
+            if job is None:
+                continue
+            evals.append(Evaluation(
+                namespace=key[0], priority=job.priority, type=job.type,
+                triggered_by=TRIGGER_NODE_UPDATE, job_id=key[1],
+                node_id=node_id, status=EVAL_STATUS_PENDING))
+        for job in self.store.jobs():
+            if job.type == JOB_TYPE_SYSTEM and job.namespaced_id() not in jobs \
+                    and not job.stopped():
+                evals.append(Evaluation(
+                    namespace=job.namespace, priority=job.priority,
+                    type=job.type, triggered_by=TRIGGER_NODE_UPDATE,
+                    job_id=job.id, node_id=node_id,
+                    status=EVAL_STATUS_PENDING))
+        return evals
+
+    # -- heartbeats (nomad/heartbeat.go) -------------------------------
+    def reset_heartbeat_timer(self, node_id: str) -> None:
+        with self._hb_lock:
+            existing = self._heartbeat_timers.pop(node_id, None)
+            if existing is not None:
+                existing.cancel()
+            t = threading.Timer(self.config.heartbeat_ttl_s,
+                                self._invalidate_heartbeat, args=(node_id,))
+            t.daemon = True
+            self._heartbeat_timers[node_id] = t
+            t.start()
+
+    def _invalidate_heartbeat(self, node_id: str) -> None:
+        node = self.store.node_by_id(node_id)
+        if node is None or node.status == NODE_STATUS_DOWN:
+            return
+        LOG.warning("node %s missed heartbeat, marking down", node_id[:8])
+        self.update_node_status(node_id, NODE_STATUS_DOWN)
+
+    def heartbeat(self, node_id: str) -> float:
+        """Client TTL renewal; returns the TTL."""
+        node = self.store.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"node {node_id} not registered")
+        if node.status != NODE_STATUS_READY:
+            self.update_node_status(node_id, NODE_STATUS_READY)
+        self.reset_heartbeat_timer(node_id)
+        return self.config.heartbeat_ttl_s

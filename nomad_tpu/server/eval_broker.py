@@ -1,0 +1,309 @@
+"""EvalBroker: priority queue of pending evaluations with the
+at-most-one-outstanding-eval-per-job invariant.
+
+Reference semantics: nomad/eval_broker.go — Enqueue:181, Dequeue:329,
+Ack:531, Nack:595, nack re-enqueue delays:644, delayed-eval heap:751,
+per-job blocked heaps, delivery limit -> failed queue.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..models import Evaluation
+from ..utils.ids import generate_uuid
+
+FAILED_QUEUE = "_failed"
+
+DEFAULT_NACK_TIMEOUT_S = 60.0
+DEFAULT_DELIVERY_LIMIT = 3
+DEFAULT_INITIAL_NACK_DELAY_S = 1.0
+DEFAULT_SUBSEQUENT_NACK_DELAY_S = 20.0
+
+
+class _PQ:
+    """Priority heap: highest priority first, FIFO by create index."""
+
+    def __init__(self):
+        self._h: List[Tuple[int, int, int, Evaluation]] = []
+        self._seq = 0
+
+    def push(self, ev: Evaluation) -> None:
+        self._seq += 1
+        heapq.heappush(self._h, (-ev.priority, ev.create_index, self._seq, ev))
+
+    def pop(self) -> Evaluation:
+        return heapq.heappop(self._h)[3]
+
+    def peek(self) -> Optional[Evaluation]:
+        return self._h[0][3] if self._h else None
+
+    def __len__(self):
+        return len(self._h)
+
+
+class _Unack:
+    __slots__ = ("eval", "token", "nack_timer")
+
+    def __init__(self, ev, token, nack_timer):
+        self.eval = ev
+        self.token = token
+        self.nack_timer = nack_timer
+
+
+class BrokerStats:
+    def __init__(self):
+        self.total_ready = 0
+        self.total_unacked = 0
+        self.total_blocked = 0
+        self.total_waiting = 0
+
+    def as_dict(self):
+        return {"ready": self.total_ready, "unacked": self.total_unacked,
+                "blocked": self.total_blocked, "waiting": self.total_waiting}
+
+
+class EvalBroker:
+    def __init__(self, nack_timeout_s: float = DEFAULT_NACK_TIMEOUT_S,
+                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
+                 initial_nack_delay_s: float = DEFAULT_INITIAL_NACK_DELAY_S,
+                 subsequent_nack_delay_s: float = DEFAULT_SUBSEQUENT_NACK_DELAY_S):
+        self.nack_timeout_s = nack_timeout_s
+        self.delivery_limit = delivery_limit
+        self.initial_nack_delay_s = initial_nack_delay_s
+        self.subsequent_nack_delay_s = subsequent_nack_delay_s
+
+        self._l = threading.Condition()
+        self._enabled = False
+        self._ready: Dict[str, _PQ] = {}               # queue -> heap
+        self._unack: Dict[str, _Unack] = {}            # eval id -> unack
+        self._evals: Dict[str, int] = {}               # eval id -> dequeues
+        self._job_evals: Dict[Tuple[str, str], str] = {}   # (ns,job)->eval id
+        self._blocked: Dict[Tuple[str, str], _PQ] = {} # per-job pending heaps
+        self._requeue: Dict[str, Evaluation] = {}      # token -> reblocked eval
+        self._time_wait: Dict[str, threading.Timer] = {}
+        self._delayed: List[Tuple[float, int, Evaluation]] = []  # wait_until heap
+        self._delay_seq = 0
+        self._delay_timer: Optional[threading.Timer] = None
+        self.stats = BrokerStats()
+
+    # -- lifecycle -----------------------------------------------------
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._l:
+            self._enabled = enabled
+        if not enabled:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._l:
+            for unack in self._unack.values():
+                unack.nack_timer.cancel()
+            for timer in self._time_wait.values():
+                timer.cancel()
+            if self._delay_timer:
+                self._delay_timer.cancel()
+                self._delay_timer = None
+            self._ready.clear()
+            self._unack.clear()
+            self._evals.clear()
+            self._job_evals.clear()
+            self._blocked.clear()
+            self._requeue.clear()
+            self._time_wait.clear()
+            self._delayed.clear()
+            self.stats = BrokerStats()
+            self._l.notify_all()
+
+    # -- enqueue -------------------------------------------------------
+    def enqueue(self, ev: Evaluation) -> None:
+        with self._l:
+            self._process_enqueue(ev, "")
+
+    def enqueue_all(self, evals: Dict[str, Tuple[Evaluation, str]]) -> None:
+        """{eval_id: (eval, token)} — token set when reblocking."""
+        with self._l:
+            for ev, token in evals.values():
+                self._process_enqueue(ev, token)
+
+    def _process_enqueue(self, ev: Evaluation, token: str) -> None:
+        if not self._enabled:
+            return
+        if ev.id in self._evals:
+            if token == "":
+                return
+            unack = self._unack.get(ev.id)
+            if unack is not None and unack.token == token:
+                self._requeue[token] = ev
+            return
+        self._evals[ev.id] = 0
+
+        if ev.wait_s > 0:
+            self._process_waiting(ev)
+            return
+        if ev.wait_until > 0:
+            self._delay_seq += 1
+            heapq.heappush(self._delayed, (ev.wait_until, self._delay_seq, ev))
+            self.stats.total_waiting += 1
+            self._reset_delay_timer()
+            return
+        self._enqueue_locked(ev, ev.type)
+
+    def _process_waiting(self, ev: Evaluation) -> None:
+        timer = threading.Timer(ev.wait_s, self._enqueue_waiting, args=(ev,))
+        timer.daemon = True
+        timer.start()
+        self._time_wait[ev.id] = timer
+        self.stats.total_waiting += 1
+
+    def _enqueue_waiting(self, ev: Evaluation) -> None:
+        with self._l:
+            self._time_wait.pop(ev.id, None)
+            self.stats.total_waiting -= 1
+            self._enqueue_locked(ev, ev.type)
+
+    def _reset_delay_timer(self) -> None:
+        if self._delay_timer:
+            self._delay_timer.cancel()
+            self._delay_timer = None
+        if not self._delayed:
+            return
+        wait_until = self._delayed[0][0]
+        delay = max(0.0, wait_until - time.time())
+        self._delay_timer = threading.Timer(delay, self._pop_delayed)
+        self._delay_timer.daemon = True
+        self._delay_timer.start()
+
+    def _pop_delayed(self) -> None:
+        with self._l:
+            now = time.time()
+            while self._delayed and self._delayed[0][0] <= now:
+                _, _, ev = heapq.heappop(self._delayed)
+                self.stats.total_waiting -= 1
+                self._enqueue_locked(ev, ev.type)
+            self._reset_delay_timer()
+
+    def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
+        if not self._enabled:
+            return
+        key = (ev.namespace, ev.job_id)
+        pending = self._job_evals.get(key, "")
+        if pending == "":
+            self._job_evals[key] = ev.id
+        elif pending != ev.id:
+            blocked = self._blocked.setdefault(key, _PQ())
+            blocked.push(ev)
+            self.stats.total_blocked += 1
+            return
+        q = self._ready.setdefault(queue, _PQ())
+        q.push(ev)
+        self.stats.total_ready += 1
+        self._l.notify_all()
+
+    # -- dequeue -------------------------------------------------------
+    def dequeue(self, schedulers: List[str],
+                timeout_s: Optional[float] = None
+                ) -> Tuple[Optional[Evaluation], str]:
+        deadline = (time.monotonic() + timeout_s) if timeout_s is not None else None
+        with self._l:
+            while True:
+                best_queue = None
+                best = None
+                for sched in schedulers:
+                    q = self._ready.get(sched)
+                    if q is None or len(q) == 0:
+                        continue
+                    head = q.peek()
+                    if best is None or (-head.priority, head.create_index) < \
+                            (-best.priority, best.create_index):
+                        best = head
+                        best_queue = sched
+                if best is not None:
+                    return self._dequeue_for_sched(best_queue)
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None, ""
+                self._l.wait(remaining if remaining is not None else 1.0)
+                if deadline is None and not self._enabled:
+                    return None, ""
+
+    def _dequeue_for_sched(self, sched: str) -> Tuple[Evaluation, str]:
+        q = self._ready[sched]
+        ev = q.pop()
+        token = generate_uuid()
+        timer = threading.Timer(self.nack_timeout_s, self.nack,
+                                args=(ev.id, token))
+        timer.daemon = True
+        timer.start()
+        self._unack[ev.id] = _Unack(ev, token, timer)
+        self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
+        self.stats.total_ready -= 1
+        self.stats.total_unacked += 1
+        return ev, token
+
+    # -- ack/nack ------------------------------------------------------
+    def outstanding(self, eval_id: str) -> Optional[str]:
+        with self._l:
+            unack = self._unack.get(eval_id)
+            return unack.token if unack else None
+
+    def ack(self, eval_id: str, token: str) -> None:
+        with self._l:
+            try:
+                unack = self._unack.get(eval_id)
+                if unack is None:
+                    raise KeyError("Evaluation ID not found")
+                if unack.token != token:
+                    raise ValueError("Token does not match for Evaluation ID")
+                unack.nack_timer.cancel()
+                self.stats.total_unacked -= 1
+                del self._unack[eval_id]
+                self._evals.pop(eval_id, None)
+                key = (unack.eval.namespace, unack.eval.job_id)
+                self._job_evals.pop(key, None)
+                blocked = self._blocked.get(key)
+                if blocked is not None and len(blocked):
+                    ev = blocked.pop()
+                    if not len(blocked):
+                        del self._blocked[key]
+                    self.stats.total_blocked -= 1
+                    self._enqueue_locked(ev, ev.type)
+                requeued = self._requeue.pop(token, None)
+                if requeued is not None:
+                    self._process_enqueue(requeued, "")
+            finally:
+                self._requeue.pop(token, None)
+
+    def nack(self, eval_id: str, token: str) -> None:
+        with self._l:
+            self._requeue.pop(token, None)
+            unack = self._unack.get(eval_id)
+            if unack is None or unack.token != token:
+                return
+            unack.nack_timer.cancel()
+            del self._unack[eval_id]
+            self.stats.total_unacked -= 1
+            dequeues = self._evals.get(eval_id, 0)
+            if dequeues >= self.delivery_limit:
+                self._enqueue_locked(unack.eval, FAILED_QUEUE)
+            else:
+                ev = unack.eval
+                ev.wait_s = self._nack_reenqueue_delay(dequeues)
+                if ev.wait_s > 0:
+                    self._process_waiting(ev)
+                else:
+                    self._enqueue_locked(ev, ev.type)
+
+    def _nack_reenqueue_delay(self, prev_dequeues: int) -> float:
+        if prev_dequeues <= 0:
+            return 0.0
+        if prev_dequeues == 1:
+            return self.initial_nack_delay_s
+        return (prev_dequeues - 1) * self.subsequent_nack_delay_s

@@ -1,0 +1,137 @@
+"""The plan applier: THE serialization point of the cluster.
+
+Reference semantics: nomad/plan_apply.go — planApply:71 single goroutine,
+evaluatePlan:400 (per-node feasibility against the freshest snapshot),
+partial commits set RefreshIndex to force worker state refresh,
+preemption follow-up evals:287-310. The reference overlaps Raft-apply of
+plan N with verification of plan N+1; here commit is a fast in-memory
+state-store apply so the overlap is unnecessary, but the verification
+batches all touched nodes at once (the EvaluatePool:NumCPU/2 goroutines
+become one vectorized pass).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..models import (
+    Allocation, AllocsFit, Evaluation, Plan, PlanResult,
+    EVAL_STATUS_PENDING,
+)
+from ..models.evaluation import TRIGGER_PREEMPTION
+from .plan_queue import PlanQueue
+
+
+class PlanApplier:
+    def __init__(self, queue: PlanQueue, server):
+        self.queue = queue
+        self.server = server      # provides .store and .raft_apply()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="plan-applier")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            pending = self.queue.dequeue(timeout_s=0.2)
+            if pending is None:
+                continue
+            try:
+                result = self.apply(pending.plan)
+                pending.future.set_result(result)
+            except Exception as e:      # pragma: no cover - defensive
+                pending.future.set_exception(e)
+
+    # -- the core ------------------------------------------------------
+    def apply(self, plan: Plan) -> PlanResult:
+        store = self.server.store
+        snapshot = store.snapshot()
+
+        result = PlanResult()
+        rejected = False
+
+        # verify each touched node (evaluatePlan / evaluateNodePlan)
+        for node_id, placements in plan.node_allocation.items():
+            if self._evaluate_node(snapshot, plan, node_id):
+                result.node_allocation[node_id] = placements
+            else:
+                rejected = True
+        # stops/preemptions are always committable
+        result.node_update = dict(plan.node_update)
+        result.node_preemptions = dict(plan.node_preemptions)
+        result.deployment = plan.deployment
+        result.deployment_updates = list(plan.deployment_updates)
+        if rejected:
+            result.refresh_index = snapshot.latest_index()
+        if result.is_no_op():
+            return result
+
+        # commit through the raft shim (FSM ApplyPlanResults)
+        stopped = [a for allocs in result.node_update.values() for a in allocs]
+        placed = [a for allocs in result.node_allocation.values()
+                  for a in allocs]
+        preempted = [a for allocs in result.node_preemptions.values()
+                     for a in allocs]
+        for a in placed:
+            if a.job is None:
+                a.job = plan.job
+
+        # preempted allocs spawn follow-up evals for their jobs
+        # (plan_apply.go:287-310)
+        preempted_jobs = set()
+        evals: List[Evaluation] = []
+        for a in preempted:
+            existing = snapshot.alloc_by_id(a.id)
+            if existing is None:
+                continue
+            key = (existing.namespace, existing.job_id)
+            if key in preempted_jobs:
+                continue
+            preempted_jobs.add(key)
+            job = snapshot.job_by_id(*key)
+            if job is None:
+                continue
+            evals.append(Evaluation(
+                namespace=job.namespace, priority=job.priority,
+                type=job.type, triggered_by=TRIGGER_PREEMPTION,
+                job_id=job.id, status=EVAL_STATUS_PENDING))
+
+        index = self.server.raft_apply(
+            "plan_results",
+            dict(allocs_stopped=stopped, allocs_placed=placed,
+                 allocs_preempted=preempted, deployment=result.deployment,
+                 deployment_updates=result.deployment_updates, evals=evals))
+        result.alloc_index = index
+        for ev in evals:
+            self.server.enqueue_eval(ev)
+        return result
+
+    def _evaluate_node(self, snapshot, plan: Plan, node_id: str) -> bool:
+        """evaluateNodePlan (plan_apply.go:629): would this node's
+        placements fit against the freshest state?"""
+        node = snapshot.node_by_id(node_id)
+        if node is None:
+            return False
+        if node.status != "ready" and not plan.node_update.get(node_id):
+            return False
+        if node.drain or node.status != "ready":
+            # placements on draining/non-ready nodes rejected; pure stops ok
+            if plan.node_allocation.get(node_id):
+                return False
+
+        remove_ids = {a.id for a in plan.node_update.get(node_id, [])}
+        remove_ids |= {a.id for a in plan.node_preemptions.get(node_id, [])}
+        proposed = [a for a in snapshot.allocs_by_node(node_id)
+                    if not a.terminal_status() and a.id not in remove_ids]
+        proposed.extend(plan.node_allocation.get(node_id, []))
+        fit, _dim, _used = AllocsFit(node, proposed)
+        return fit

@@ -1,0 +1,68 @@
+"""PlanQueue: leader-side priority queue of submitted plans.
+
+Reference semantics: nomad/plan_queue.go — Enqueue:95 returns a future
+the worker blocks on; Dequeue:126 pops highest priority for the applier.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+from ..models import Plan
+
+
+class PendingPlan:
+    __slots__ = ("plan", "future")
+
+    def __init__(self, plan: Plan):
+        self.plan = plan
+        self.future: Future = Future()
+
+
+class PlanQueue:
+    def __init__(self):
+        self._l = threading.Condition()
+        self._enabled = False
+        self._heap: List[Tuple[int, int, PendingPlan]] = []
+        self._seq = 0
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._l:
+            self._enabled = enabled
+            if not enabled:
+                for _, _, pending in self._heap:
+                    pending.future.set_exception(
+                        RuntimeError("plan queue is disabled"))
+                self._heap.clear()
+            self._l.notify_all()
+
+    def enqueue(self, plan: Plan) -> Future:
+        with self._l:
+            if not self._enabled:
+                raise RuntimeError("plan queue is disabled")
+            pending = PendingPlan(plan)
+            self._seq += 1
+            heapq.heappush(self._heap, (-plan.priority, self._seq, pending))
+            self._l.notify_all()
+            return pending.future
+
+    def dequeue(self, timeout_s: Optional[float] = None) -> Optional[PendingPlan]:
+        import time
+        deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        with self._l:
+            while True:
+                if self._heap:
+                    return heapq.heappop(self._heap)[2]
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                self._l.wait(remaining if remaining is not None else 1.0)
+
+    def depth(self) -> int:
+        with self._l:
+            return len(self._heap)

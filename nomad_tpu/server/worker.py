@@ -1,0 +1,127 @@
+"""Scheduling worker: dequeue -> snapshot fence -> scheduler.process ->
+ack/nack. Implements the scheduler's Planner interface against the
+server (plan queue + raft shim).
+
+Reference semantics: nomad/worker.go — run:105-138, dequeueEvaluation:142,
+snapshotMinIndex:228, invokeScheduler:244, SubmitPlan:277-343 (snapshot
+index fencing + RefreshIndex handling), exponential backoff, pause
+during leadership transitions.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+from ..models import Evaluation, Plan, PlanResult
+from ..scheduler import new_scheduler
+
+LOG = logging.getLogger("nomad_tpu.worker")
+
+BACKOFF_BASE_S = 0.05
+BACKOFF_LIMIT_S = 3.0
+DEQUEUE_TIMEOUT_S = 0.5
+RAFT_SYNC_LIMIT = 10.0
+
+
+class Worker:
+    def __init__(self, server, enabled_schedulers: List[str], wid: int = 0):
+        self.server = server
+        self.schedulers = list(enabled_schedulers)
+        self.id = wid
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # per-eval state while processing
+        self._eval: Optional[Evaluation] = None
+        self._token: str = ""
+        self._snapshot_index = 0
+        self.stats = {"processed": 0, "failed": 0}
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"worker-{self.id}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def set_pause(self, paused: bool) -> None:
+        if paused:
+            self._paused.set()
+        else:
+            self._paused.clear()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            if self._paused.is_set():
+                time.sleep(0.05)
+                continue
+            # NOTE: workers never consume the failed queue — the leader's
+            # reaper turns those into delayed follow-up evals
+            # (leader.go reapFailedEvaluations:766 / Server._reap_failed_evals)
+            ev, token = self.server.eval_broker.dequeue(
+                self.schedulers, DEQUEUE_TIMEOUT_S)
+            if ev is None:
+                continue
+            self.process_eval(ev, token)
+
+    # -- single eval ---------------------------------------------------
+    def process_eval(self, ev: Evaluation, token: str) -> None:
+        self._eval = ev
+        self._token = token
+        try:
+            # wait for the state store to catch up to the eval
+            snap = self.server.store.snapshot_min_index(
+                ev.modify_index, timeout_s=RAFT_SYNC_LIMIT)
+            self._snapshot_index = snap.latest_index()
+            sched = new_scheduler(self._scheduler_for(ev), snap, self)
+            sched.process(ev)
+            self.server.eval_broker.ack(ev.id, token)
+            self.stats["processed"] += 1
+        except Exception:
+            LOG.exception("worker %d: eval %s failed", self.id, ev.id)
+            self.stats["failed"] += 1
+            try:
+                self.server.eval_broker.nack(ev.id, token)
+            except Exception:
+                pass
+        finally:
+            self._eval = None
+            self._token = ""
+
+    @staticmethod
+    def _scheduler_for(ev: Evaluation) -> str:
+        return ev.type if ev.type in ("service", "batch", "system") else "batch"
+
+    # -- Planner interface --------------------------------------------
+    def submit_plan(self, plan: Plan) -> Optional[PlanResult]:
+        plan.eval_token = self._token
+        plan.snapshot_index = self._snapshot_index
+        future = self.server.plan_queue.enqueue(plan)
+        result: PlanResult = future.result(timeout=30)
+        # if some placements were rejected, wait for the refresh index so
+        # the next attempt sees why (worker.go:318-340)
+        if result.refresh_index:
+            self.server.store.block_min_index(result.refresh_index - 1,
+                                              timeout_s=RAFT_SYNC_LIMIT)
+        return result
+
+    def refreshed_state(self, index: int):
+        return self.server.store.snapshot_min_index(index,
+                                                    timeout_s=RAFT_SYNC_LIMIT)
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.raft_apply("eval_update", dict(evals=[ev]))
+
+    def create_eval(self, ev: Evaluation) -> None:
+        ev.snapshot_index = self._snapshot_index
+        self.server.raft_apply("eval_update", dict(evals=[ev]))
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self.server.blocked_evals.block(ev)
