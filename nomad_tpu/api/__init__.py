@@ -1,0 +1,2 @@
+from .http import HTTPApiServer
+from .client import ApiClient, ApiError

@@ -1,0 +1,106 @@
+"""Python API client for the HTTP API (the api/ Go SDK equivalent,
+reference: api/api.go NewClient + typed wrappers)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class ApiClient:
+    def __init__(self, address: str = "http://127.0.0.1:4646"):
+        self.address = address.rstrip("/")
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 params: Optional[dict] = None) -> Any:
+        url = self.address + path
+        if params:
+            from urllib.parse import urlencode
+            url += "?" + urlencode(params)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers={"Content-Type":
+                                              "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=310) as resp:
+                return json.loads(resp.read() or "null")
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                msg = str(e)
+            raise ApiError(e.code, msg)
+        except urllib.error.URLError as e:
+            raise ApiError(0, f"unable to reach agent at {self.address}: "
+                              f"{e.reason}")
+
+    # -- jobs ----------------------------------------------------------
+    def register_job(self, spec) -> dict:
+        return self._request("PUT", "/v1/jobs", {"Job": spec})
+
+    def list_jobs(self, prefix: str = "") -> list:
+        return self._request("GET", "/v1/jobs",
+                             params={"prefix": prefix} if prefix else None)
+
+    def get_job(self, job_id: str) -> dict:
+        return self._request("GET", f"/v1/job/{job_id}")
+
+    def deregister_job(self, job_id: str, purge: bool = False) -> dict:
+        return self._request("DELETE", f"/v1/job/{job_id}",
+                             params={"purge": str(purge).lower()})
+
+    def job_allocations(self, job_id: str) -> list:
+        return self._request("GET", f"/v1/job/{job_id}/allocations")
+
+    def job_evaluations(self, job_id: str) -> list:
+        return self._request("GET", f"/v1/job/{job_id}/evaluations")
+
+    def job_summary(self, job_id: str) -> dict:
+        return self._request("GET", f"/v1/job/{job_id}/summary")
+
+    # -- nodes ---------------------------------------------------------
+    def list_nodes(self) -> list:
+        return self._request("GET", "/v1/nodes")
+
+    def get_node(self, node_id: str) -> dict:
+        return self._request("GET", f"/v1/node/{node_id}")
+
+    def node_allocations(self, node_id: str) -> list:
+        return self._request("GET", f"/v1/node/{node_id}/allocations")
+
+    def set_node_eligibility(self, node_id: str, eligible: bool) -> dict:
+        return self._request("POST", f"/v1/node/{node_id}/eligibility",
+                             {"Eligibility":
+                              "eligible" if eligible else "ineligible"})
+
+    def drain_node(self, node_id: str, deadline_s: float = 0.0,
+                   mark_eligible: bool = False,
+                   enable: bool = True) -> dict:
+        spec = {"Deadline": deadline_s} if enable else None
+        return self._request("POST", f"/v1/node/{node_id}/drain",
+                             {"DrainSpec": spec,
+                              "MarkEligible": mark_eligible})
+
+    # -- allocs / evals ------------------------------------------------
+    def get_allocation(self, alloc_id: str) -> dict:
+        return self._request("GET", f"/v1/allocation/{alloc_id}")
+
+    def list_allocations(self) -> list:
+        return self._request("GET", "/v1/allocations")
+
+    def get_evaluation(self, eval_id: str) -> dict:
+        return self._request("GET", f"/v1/evaluation/{eval_id}")
+
+    def agent_self(self) -> dict:
+        return self._request("GET", "/v1/agent/self")
+
+    def scheduler_config(self) -> dict:
+        return self._request("GET", "/v1/operator/scheduler/configuration")

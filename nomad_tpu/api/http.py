@@ -1,0 +1,283 @@
+"""North-bound HTTP JSON API.
+
+Reference semantics: command/agent/http.go (route registry :252-350,
+blocking-query params index/wait, X-Nomad-Index response header) and the
+per-domain handlers in command/agent/*_endpoint.go. Routes:
+
+  GET/PUT  /v1/jobs                    list / register
+  GET/DELETE /v1/job/<id>              read / deregister (?purge=true)
+  GET      /v1/job/<id>/allocations|evaluations|summary|versions
+  GET      /v1/nodes, /v1/node/<id>, /v1/node/<id>/allocations
+  POST     /v1/node/<id>/eligibility|drain
+  GET      /v1/allocations, /v1/allocation/<id>
+  GET      /v1/evaluations, /v1/evaluation/<id>
+  GET      /v1/status/leader, /v1/agent/self, /v1/operator/scheduler/configuration
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..jobspec import parse_job
+from ..jobspec.parse import parse_duration_s
+from ..models import Job, NODE_SCHED_ELIGIBLE, NODE_SCHED_INELIGIBLE
+from ..models.node import DrainSpec, DrainStrategy
+from ..utils.codec import from_wire, to_wire
+
+
+class HTTPApiServer:
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 4646):
+        self.server = server
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _respond(self, code: int, payload, index: Optional[int] = None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if index is not None:
+                    self.send_header("X-Nomad-Index", str(index))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, msg: str):
+                self._respond(code, {"error": msg})
+
+            def _body(self):
+                length = int(self.headers.get("Content-Length", 0))
+                if not length:
+                    return {}
+                return json.loads(self.rfile.read(length))
+
+            def _handle(self, method: str):
+                try:
+                    url = urlparse(self.path)
+                    q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                    # blocking query support (http.go parseWait)
+                    if "index" in q:
+                        wait_s = parse_duration_s(q.get("wait", "5m"), 300.0)
+                        api.server.store.block_min_index(
+                            int(q["index"]), timeout_s=min(wait_s, 300.0))
+                    result = api.route(method, url.path, q, self._body
+                                       if method in ("PUT", "POST") else None)
+                    if result is None:
+                        self._error(404, "not found")
+                    else:
+                        payload, index = result
+                        self._respond(200, payload, index)
+                except ValueError as e:
+                    self._error(400, str(e))
+                except KeyError as e:
+                    self._error(404, str(e))
+                except Exception as e:    # pragma: no cover
+                    self._error(500, f"{type(e).__name__}: {e}")
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="http-api")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    # -- routing -------------------------------------------------------
+    def route(self, method: str, path: str, q: dict, body_fn):
+        s = self.server
+        store = s.store
+        idx = store.latest_index()
+        ns = q.get("namespace", "default")
+
+        if path == "/v1/jobs":
+            if method == "GET":
+                prefix = q.get("prefix", "")
+                jobs = [self._job_stub(j) for j in store.jobs(ns)
+                        if j.id.startswith(prefix)]
+                return jobs, idx
+            if method in ("PUT", "POST"):
+                data = body_fn()
+                spec = data.get("Job", data.get("job", data))
+                job = from_wire(Job, spec) if isinstance(spec, dict) \
+                    else parse_job(spec)
+                ev = s.register_job(job)
+                return {"EvalID": ev.id, "JobModifyIndex": job.modify_index}, \
+                    store.latest_index()
+
+        if path == "/v1/jobs/parse" and method in ("PUT", "POST"):
+            data = body_fn()
+            job = parse_job(data.get("JobHCL", ""))
+            return to_wire(job), idx
+
+        m = re.match(r"^/v1/job/([^/]+)$", path)
+        if m:
+            job_id = m.group(1)
+            if method == "GET":
+                job = store.job_by_id(ns, job_id)
+                if job is None:
+                    return None
+                return to_wire(job), idx
+            if method == "DELETE":
+                purge = q.get("purge", "").lower() == "true"
+                ev = s.deregister_job(ns, job_id, purge=purge)
+                return {"EvalID": ev.id}, store.latest_index()
+
+        m = re.match(r"^/v1/job/([^/]+)/(\w+)$", path)
+        if m:
+            job_id, sub = m.group(1), m.group(2)
+            if sub == "allocations":
+                return [a.stub() for a in store.allocs_by_job(ns, job_id)], idx
+            if sub == "evaluations":
+                return [e.stub() for e in store.evals_by_job(ns, job_id)], idx
+            if sub == "summary":
+                summ = store.job_summary(ns, job_id)
+                return (to_wire(summ), idx) if summ else None
+            if sub == "versions":
+                return [to_wire(j) for j in store.job_versions(ns, job_id)], idx
+            if sub == "deployments":
+                return [to_wire(d)
+                        for d in store.deployments_by_job(ns, job_id)], idx
+
+        if path == "/v1/nodes" and method == "GET":
+            prefix = q.get("prefix", "")
+            return [n.stub() for n in store.nodes()
+                    if n.id.startswith(prefix)], idx
+
+        m = re.match(r"^/v1/node/([^/]+)$", path)
+        if m and method == "GET":
+            node = self._find_node(m.group(1))
+            if node is None:
+                return None
+            return to_wire(node), idx
+
+        m = re.match(r"^/v1/node/([^/]+)/(\w+)$", path)
+        if m:
+            node = self._find_node(m.group(1))
+            if node is None:
+                return None
+            sub = m.group(2)
+            if sub == "allocations" and method == "GET":
+                return [a.stub() for a in store.allocs_by_node(node.id)], idx
+            if sub == "eligibility" and method in ("PUT", "POST"):
+                data = body_fn()
+                elig = data.get("Eligibility", "")
+                if elig not in (NODE_SCHED_ELIGIBLE, NODE_SCHED_INELIGIBLE):
+                    raise ValueError(f"invalid eligibility {elig}")
+                s.raft_apply("node_eligibility_update",
+                             dict(node_id=node.id, eligibility=elig))
+                return {"NodeModifyIndex": store.latest_index()}, \
+                    store.latest_index()
+            if sub == "drain" and method in ("PUT", "POST"):
+                data = body_fn()
+                spec = data.get("DrainSpec")
+                strategy = None
+                if spec:
+                    strategy = DrainStrategy(drain_spec=DrainSpec(
+                        deadline_s=parse_duration_s(spec.get("Deadline"), 0.0),
+                        ignore_system_jobs=bool(
+                            spec.get("IgnoreSystemJobs", False))))
+                s.raft_apply("node_drain_update",
+                             dict(node_id=node.id, drain_strategy=strategy,
+                                  mark_eligible=data.get("MarkEligible", False)))
+                return {"NodeModifyIndex": store.latest_index()}, \
+                    store.latest_index()
+
+        if path == "/v1/allocations" and method == "GET":
+            prefix = q.get("prefix", "")
+            return [a.stub() for a in store.allocs()
+                    if a.id.startswith(prefix)], idx
+
+        m = re.match(r"^/v1/allocation/([^/]+)$", path)
+        if m and method == "GET":
+            alloc = self._unique_prefix(store.allocs(), m.group(1), "allocation")
+            if alloc is None:
+                return None
+            return to_wire(alloc), idx
+
+        if path == "/v1/evaluations" and method == "GET":
+            return [e.stub() for e in store.evals()], idx
+
+        m = re.match(r"^/v1/evaluation/([^/]+)$", path)
+        if m and method == "GET":
+            ev = self._unique_prefix(store.evals(), m.group(1), "evaluation")
+            if ev is None:
+                return None
+            return to_wire(ev), idx
+
+        if path == "/v1/status/leader":
+            return "127.0.0.1:4647", idx
+
+        if path == "/v1/agent/self":
+            return {"member": {"Name": "server", "Status": "alive"},
+                    "stats": {"broker": self.server.eval_broker.stats.as_dict()},
+                    "config": {"NumSchedulers":
+                               self.server.config.num_schedulers}}, idx
+
+        if path == "/v1/operator/scheduler/configuration":
+            if method == "GET":
+                return {"SchedulerConfig":
+                        to_wire(store.scheduler_config())}, idx
+            if method in ("PUT", "POST"):
+                data = body_fn()
+                from ..models import SchedulerConfiguration
+                cfg = from_wire(SchedulerConfiguration,
+                                data.get("SchedulerConfig", data))
+                self.server.raft_apply("scheduler_config", dict(config=cfg))
+                return {"Updated": True}, store.latest_index()
+
+        return None
+
+    def _find_node(self, prefix: str):
+        node = self.server.store.node_by_id(prefix)
+        if node is not None:
+            return node
+        matches = self.server.store.node_by_prefix(prefix)
+        if len(matches) > 1:
+            raise ValueError(
+                f"node prefix {prefix!r} matched {len(matches)} nodes")
+        return matches[0] if matches else None
+
+    @staticmethod
+    def _unique_prefix(items, prefix: str, what: str):
+        matches = [x for x in items if x.id.startswith(prefix)]
+        if len(matches) > 1:
+            raise ValueError(
+                f"{what} prefix {prefix!r} matched {len(matches)} {what}s")
+        return matches[0] if matches else None
+
+    @staticmethod
+    def _job_stub(job) -> dict:
+        return {
+            "ID": job.id, "Name": job.name, "Type": job.type,
+            "Priority": job.priority, "Status": job.status,
+            "Stop": job.stop,
+            "JobModifyIndex": job.job_modify_index,
+        }

@@ -1,0 +1,396 @@
+"""The command line interface.
+
+Reference semantics: command/ (~170 commands via mitchellh/cli; the core
+operator surface is implemented here: agent, job run/status/stop/init,
+node status/eligibility/drain, alloc status, eval status, server info).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+from typing import List, Optional
+
+from ..api.client import ApiClient, ApiError
+from ..utils.ids import short_id
+
+
+def _client(args) -> ApiClient:
+    return ApiClient(args.address)
+
+
+def _print_rows(rows: List[List[str]], header: List[str]) -> None:
+    table = [header] + rows
+    widths = [max(len(str(r[i])) for r in table) for i in range(len(header))]
+    for r in table:
+        print("  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip())
+
+
+# -- agent -------------------------------------------------------------
+def cmd_agent(args) -> int:
+    from ..server import Server, ServerConfig
+    from ..client import Client, ClientConfig
+    from ..api import HTTPApiServer
+
+    if not args.dev:
+        print("only -dev mode is supported in this build", file=sys.stderr)
+        return 1
+    # The scheduler kernels need a working JAX backend. If the TPU tunnel
+    # is unavailable (e.g. held by another process), fall back to CPU so
+    # the agent still serves.
+    import jax
+    try:
+        jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+        print("    WARNING: TPU backend unavailable; scheduling on CPU")
+    server = Server(ServerConfig(num_schedulers=args.num_schedulers))
+    server.start()
+    clients = []
+    for i in range(args.clients):
+        c = Client(server, ClientConfig(node_name=f"dev-client-{i}"))
+        c.start()
+        clients.append(c)
+    api = HTTPApiServer(server, port=args.http_port)
+    api.start()
+    print(f"==> nomad-tpu agent started (dev mode)")
+    print(f"    HTTP API: http://127.0.0.1:{api.port}")
+    print(f"    Nodes:    {args.clients}")
+    print(f"    Workers:  {args.num_schedulers}")
+
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        print("==> shutting down")
+        api.shutdown()
+        for c in clients:
+            c.shutdown()
+        server.shutdown()
+    return 0
+
+
+# -- job ---------------------------------------------------------------
+def cmd_job_init(args) -> int:
+    from .example_job import EXAMPLE_JOB
+    path = args.filename
+    try:
+        with open(path, "x") as f:
+            f.write(EXAMPLE_JOB)
+    except FileExistsError:
+        print(f"Job file {path} already exists", file=sys.stderr)
+        return 1
+    print(f"Example job file written to {path}")
+    return 0
+
+
+def cmd_job_run(args) -> int:
+    from ..jobspec import parse_job, job_to_spec
+    try:
+        with open(args.jobfile) as f:
+            job = parse_job(f.read())
+    except OSError as e:
+        print(f"Error reading job file: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        print(f"Error parsing job file {args.jobfile}: {e}", file=sys.stderr)
+        return 1
+    c = _client(args)
+    try:
+        resp = c.register_job(job_to_spec(job))
+    except ApiError as e:
+        print(f"Error submitting job: {e}", file=sys.stderr)
+        return 1
+    print(f"==> Evaluation {short_id(resp['EvalID'])} triggered by job "
+          f"\"{job.id}\"")
+    if args.detach:
+        return 0
+    return _monitor_eval(c, resp["EvalID"])
+
+
+def _monitor_eval(c: ApiClient, eval_id: str, timeout: float = 30.0) -> int:
+    deadline = time.time() + timeout
+    last_status = ""
+    while time.time() < deadline:
+        try:
+            ev = c.get_evaluation(eval_id)
+        except ApiError:
+            time.sleep(0.2)
+            continue
+        if ev["status"] != last_status:
+            last_status = ev["status"]
+            print(f"    Evaluation status: {last_status}")
+        if last_status in ("complete", "failed", "canceled"):
+            if ev.get("blocked_eval"):
+                print(f"    Blocked eval {short_id(ev['blocked_eval'])} "
+                      f"created (insufficient capacity)")
+            if ev.get("failed_tg_allocs"):
+                for tg, metric in ev["failed_tg_allocs"].items():
+                    print(f"    Task group {tg!r} failed to place: "
+                          f"{metric.get('constraint_filtered') or metric.get('dimension_exhausted')}")
+            print(f"==> Evaluation \"{short_id(eval_id)}\" finished with "
+                  f"status \"{last_status}\"")
+            return 0 if last_status == "complete" else 1
+        time.sleep(0.2)
+    print("timed out waiting for evaluation", file=sys.stderr)
+    return 1
+
+
+def cmd_job_status(args) -> int:
+    c = _client(args)
+    if not args.job_id:
+        jobs = c.list_jobs()
+        if not jobs:
+            print("No running jobs")
+            return 0
+        _print_rows([[j["ID"], j["Type"], str(j["Priority"]), j["Status"]]
+                     for j in jobs], ["ID", "Type", "Priority", "Status"])
+        return 0
+    try:
+        job = c.get_job(args.job_id)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"ID            = {job['id']}")
+    print(f"Name          = {job['name']}")
+    print(f"Type          = {job['type']}")
+    print(f"Priority      = {job['priority']}")
+    print(f"Datacenters   = {','.join(job['datacenters'])}")
+    print(f"Status        = {job['status']}")
+    summary = c.job_summary(args.job_id)
+    if summary:
+        print("\nSummary")
+        rows = []
+        for tg, counts in sorted(summary.get("summary", {}).items()):
+            rows.append([tg] + [str(counts.get(k, 0)) for k in
+                                ("starting", "running", "complete", "failed",
+                                 "lost")])
+        _print_rows(rows, ["Task Group", "Starting", "Running", "Complete",
+                           "Failed", "Lost"])
+    allocs = c.job_allocations(args.job_id)
+    if allocs:
+        print("\nAllocations")
+        _print_rows(
+            [[short_id(a["id"]), short_id(a["node_id"] or "--------"),
+              a["task_group"], a["desired_status"], a["client_status"]]
+             for a in allocs],
+            ["ID", "Node ID", "Task Group", "Desired", "Status"])
+    return 0
+
+
+def cmd_job_stop(args) -> int:
+    c = _client(args)
+    try:
+        resp = c.deregister_job(args.job_id, purge=args.purge)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"==> Evaluation {short_id(resp['EvalID'])} triggered by job "
+          f"deregister")
+    if args.detach:
+        return 0
+    return _monitor_eval(c, resp["EvalID"])
+
+
+# -- node --------------------------------------------------------------
+def cmd_node_status(args) -> int:
+    c = _client(args)
+    if not args.node_id:
+        nodes = c.list_nodes()
+        if not nodes:
+            print("No nodes registered")
+            return 0
+        _print_rows(
+            [[short_id(n["id"]), n["name"], n["datacenter"],
+              n["scheduling_eligibility"], n["status"]] for n in nodes],
+            ["ID", "Name", "DC", "Eligibility", "Status"])
+        return 0
+    try:
+        node = c.get_node(args.node_id)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"ID          = {short_id(node['id'])}")
+    print(f"Name        = {node['name']}")
+    print(f"Class       = {node['node_class'] or '<none>'}")
+    print(f"DC          = {node['datacenter']}")
+    print(f"Drain       = {node['drain']}")
+    print(f"Eligibility = {node['scheduling_eligibility']}")
+    print(f"Status      = {node['status']}")
+    res = node["node_resources"]
+    print(f"Resources   = cpu: {res['cpu']['cpu_shares']} MHz, "
+          f"memory: {res['memory']['memory_mb']} MiB, "
+          f"disk: {res['disk']['disk_mb']} MiB")
+    allocs = c.node_allocations(node["id"])
+    if allocs:
+        print("\nAllocations")
+        _print_rows(
+            [[short_id(a["id"]), a["task_group"], a["desired_status"],
+              a["client_status"]] for a in allocs],
+            ["ID", "Task Group", "Desired", "Status"])
+    return 0
+
+
+def cmd_node_eligibility(args) -> int:
+    if args.enable == args.disable:
+        print("Exactly one of -enable or -disable is required",
+              file=sys.stderr)
+        return 1
+    c = _client(args)
+    try:
+        c.set_node_eligibility(args.node_id, args.enable)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Node {short_id(args.node_id)} scheduling eligibility: "
+          f"{'eligible' if args.enable else 'ineligible'}")
+    return 0
+
+
+def cmd_node_drain(args) -> int:
+    if args.enable == args.disable:
+        print("Exactly one of -enable or -disable is required",
+              file=sys.stderr)
+        return 1
+    c = _client(args)
+    try:
+        if args.enable:
+            c.drain_node(args.node_id, deadline_s=args.deadline)
+            print(f"Node {short_id(args.node_id)} drain strategy set")
+        else:
+            c.drain_node(args.node_id, enable=False, mark_eligible=True)
+            print(f"Node {short_id(args.node_id)} drain disabled")
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+# -- alloc / eval ------------------------------------------------------
+def cmd_alloc_status(args) -> int:
+    c = _client(args)
+    try:
+        a = c.get_allocation(args.alloc_id)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"ID         = {short_id(a['id'])}")
+    print(f"Name       = {a['name']}")
+    print(f"Node ID    = {short_id(a['node_id'])}")
+    print(f"Job ID     = {a['job_id']}")
+    print(f"Desired    = {a['desired_status']}")
+    print(f"Status     = {a['client_status']}")
+    for task, state in (a.get("task_states") or {}).items():
+        print(f"\nTask \"{task}\" is \"{state['state']}\"" +
+              (" (failed)" if state.get("failed") else ""))
+    metrics = a.get("metrics")
+    if metrics and metrics.get("score_meta_data"):
+        print("\nPlacement Metrics")
+        print(f"  Nodes evaluated: {metrics['nodes_evaluated']}; "
+              f"filtered: {metrics['nodes_filtered']}; "
+              f"exhausted: {metrics['nodes_exhausted']}")
+        for sm in metrics["score_meta_data"][:3]:
+            print(f"  {short_id(sm['node_id'])}: {sm['norm_score']:.4f}")
+    return 0
+
+
+def cmd_eval_status(args) -> int:
+    c = _client(args)
+    try:
+        ev = c.get_evaluation(args.eval_id)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    for k in ("id", "type", "triggered_by", "job_id", "status",
+              "status_description"):
+        print(f"{k:<20}= {ev.get(k)}")
+    if ev.get("queued_allocations"):
+        print(f"{'queued':<20}= {ev['queued_allocations']}")
+    return 0
+
+
+def cmd_server_info(args) -> int:
+    c = _client(args)
+    info = c.agent_self()
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nomad-tpu",
+                                description="TPU-native workload orchestrator")
+    p.add_argument("-address", default="http://127.0.0.1:4646")
+    sub = p.add_subparsers(dest="cmd")
+
+    agent = sub.add_parser("agent", help="run the agent")
+    agent.add_argument("-dev", action="store_true")
+    agent.add_argument("-http-port", dest="http_port", type=int, default=4646)
+    agent.add_argument("-clients", type=int, default=1)
+    agent.add_argument("-num-schedulers", dest="num_schedulers", type=int,
+                       default=2)
+    agent.set_defaults(fn=cmd_agent)
+
+    job = sub.add_parser("job", help="job commands").add_subparsers(dest="sub")
+    run = job.add_parser("run")
+    run.add_argument("jobfile")
+    run.add_argument("-detach", action="store_true")
+    run.set_defaults(fn=cmd_job_run)
+    status = job.add_parser("status")
+    status.add_argument("job_id", nargs="?")
+    status.set_defaults(fn=cmd_job_status)
+    stop = job.add_parser("stop")
+    stop.add_argument("job_id")
+    stop.add_argument("-purge", action="store_true")
+    stop.add_argument("-detach", action="store_true")
+    stop.set_defaults(fn=cmd_job_stop)
+    init = job.add_parser("init")
+    init.add_argument("filename", nargs="?", default="example.nomad")
+    init.set_defaults(fn=cmd_job_init)
+
+    node = sub.add_parser("node", help="node commands").add_subparsers(dest="sub")
+    nstatus = node.add_parser("status")
+    nstatus.add_argument("node_id", nargs="?")
+    nstatus.set_defaults(fn=cmd_node_status)
+    nelig = node.add_parser("eligibility")
+    nelig.add_argument("node_id")
+    nelig.add_argument("-enable", action="store_true")
+    nelig.add_argument("-disable", action="store_true")
+    nelig.set_defaults(fn=cmd_node_eligibility)
+    ndrain = node.add_parser("drain")
+    ndrain.add_argument("node_id")
+    ndrain.add_argument("-enable", action="store_true")
+    ndrain.add_argument("-disable", action="store_true")
+    ndrain.add_argument("-deadline", type=float, default=0.0)
+    ndrain.set_defaults(fn=cmd_node_drain)
+
+    alloc = sub.add_parser("alloc").add_subparsers(dest="sub")
+    astatus = alloc.add_parser("status")
+    astatus.add_argument("alloc_id")
+    astatus.set_defaults(fn=cmd_alloc_status)
+
+    ev = sub.add_parser("eval").add_subparsers(dest="sub")
+    estatus = ev.add_parser("status")
+    estatus.add_argument("eval_id")
+    estatus.set_defaults(fn=cmd_eval_status)
+
+    srv = sub.add_parser("server").add_subparsers(dest="sub")
+    sinfo = srv.add_parser("info")
+    sinfo.set_defaults(fn=cmd_server_info)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    fn = getattr(args, "fn", None)
+    if fn is None:
+        parser.print_help()
+        return 1
+    return fn(args)

@@ -14,8 +14,13 @@ _HINTS_CACHE: dict = {}
 
 
 def to_wire(obj: Any) -> Any:
-    """Recursively convert dataclasses/enums/containers to plain data."""
-    if obj is None or isinstance(obj, (str, int, float, bool, bytes)):
+    """Recursively convert dataclasses/enums/containers to plain data.
+    bytes become tagged base64 dicts so the output is JSON-safe AND
+    round-trips losslessly even inside Any-typed containers."""
+    if isinstance(obj, bytes):
+        import base64
+        return {"__b64__": base64.b64encode(obj).decode("ascii")}
+    if obj is None or isinstance(obj, (str, int, float, bool)):
         return obj
     if isinstance(obj, enum.Enum):
         return obj.value
@@ -36,6 +41,11 @@ def from_wire(cls: Any, data: Any) -> Any:
     """Recursively build an instance of `cls` from plain data."""
     if data is None:
         return None
+    # tagged bytes decode regardless of the declared type, so bytes
+    # survive Any-typed containers (e.g. Task.config values)
+    if isinstance(data, dict) and len(data) == 1 and "__b64__" in data:
+        import base64
+        return base64.b64decode(data["__b64__"])
     origin = get_origin(cls)
     if origin in (typing.Union, types.UnionType):
         args = [a for a in get_args(cls) if a is not type(None)]
@@ -72,6 +82,11 @@ def from_wire(cls: Any, data: Any) -> Any:
         args = get_args(cls)
         vt = args[1] if len(args) == 2 else Any
         return {k: from_wire(vt, v) for k, v in data.items()}
-    if cls in (int, float, str, bool, bytes):
+    if cls is bytes:
+        if isinstance(data, str):
+            import base64
+            return base64.b64decode(data)
+        return bytes(data) if not isinstance(data, bytes) else data
+    if cls in (int, float, str, bool):
         return cls(data) if data is not None else None
     return data

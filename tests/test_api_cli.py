@@ -1,0 +1,174 @@
+"""HTTP API + CLI tests (reference patterns: command/agent/*_endpoint_test.go)."""
+
+import json
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import ApiClient, ApiError, HTTPApiServer
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.jobspec import job_to_spec
+from nomad_tpu.server import Server, ServerConfig
+
+
+def _wait_for(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    server = Server(ServerConfig(num_schedulers=2, heartbeat_ttl_s=60.0))
+    server.start()
+    client = Client(server, ClientConfig(node_name="api-test"))
+    client.start()
+    api = HTTPApiServer(server, port=0)   # ephemeral port
+    api.start()
+    c = ApiClient(f"http://127.0.0.1:{api.port}")
+    yield server, client, c
+    api.shutdown()
+    client.shutdown()
+    server.shutdown()
+
+
+def test_node_endpoints(cluster):
+    server, client, c = cluster
+    nodes = c.list_nodes()
+    assert len(nodes) == 1
+    assert nodes[0]["name"] == "api-test"
+    full = c.get_node(nodes[0]["id"][:8])   # prefix lookup
+    assert full["node_resources"]["cpu"]["cpu_shares"] == 4000
+
+
+def test_job_lifecycle_via_api(cluster):
+    server, client, c = cluster
+    job = mock.batch_job()
+    job.type = "service"
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].config = {"run_for": "60s"}
+    job.canonicalize()
+    resp = c.register_job(job_to_spec(job))
+    assert "EvalID" in resp
+
+    assert _wait_for(lambda: len(c.job_allocations(job.id)) == 2)
+    assert _wait_for(lambda: all(
+        a["client_status"] == "running" for a in c.job_allocations(job.id)))
+
+    jobs = c.list_jobs()
+    assert any(j["ID"] == job.id for j in jobs)
+    got = c.get_job(job.id)
+    assert got["type"] == "service"
+    summ = c.job_summary(job.id)
+    assert summ["summary"]["worker"]["running"] == 2
+
+    evs = c.job_evaluations(job.id)
+    assert evs and evs[0]["status"] == "complete"
+    ev = c.get_evaluation(evs[0]["id"][:8])
+    assert ev["job_id"] == job.id
+
+    alloc_stub = c.job_allocations(job.id)[0]
+    alloc = c.get_allocation(alloc_stub["id"][:8])
+    assert alloc["metrics"]["nodes_evaluated"] >= 1
+
+    c.deregister_job(job.id)
+    assert _wait_for(lambda: all(
+        a["desired_status"] == "stop" for a in c.job_allocations(job.id)))
+
+
+def test_register_invalid_job_400(cluster):
+    server, client, c = cluster
+    job = mock.batch_job()
+    job.datacenters = []
+    with pytest.raises(ApiError) as e:
+        c.register_job(job_to_spec(job))
+    assert e.value.status == 400
+    assert "datacenters" in str(e.value)
+
+
+def test_unknown_routes_404(cluster):
+    server, client, c = cluster
+    with pytest.raises(ApiError) as e:
+        c.get_job("nonexistent-job")
+    assert e.value.status == 404
+    with pytest.raises(ApiError):
+        c._request("GET", "/v1/bogus")
+
+
+def test_eligibility_endpoint(cluster):
+    server, client, c = cluster
+    node_id = c.list_nodes()[0]["id"]
+    c.set_node_eligibility(node_id, False)
+    assert c.get_node(node_id)["scheduling_eligibility"] == "ineligible"
+    c.set_node_eligibility(node_id, True)
+    assert c.get_node(node_id)["scheduling_eligibility"] == "eligible"
+
+
+def test_scheduler_config_endpoint(cluster):
+    server, client, c = cluster
+    cfg = c.scheduler_config()
+    assert cfg["SchedulerConfig"]["scheduler_algorithm"] == "binpack"
+
+
+def test_blocking_query_wakes_on_write(cluster):
+    server, client, c = cluster
+    import threading
+    idx = server.store.latest_index()
+    results = {}
+
+    def blocked():
+        t0 = time.time()
+        results["jobs"] = c._request("GET", "/v1/jobs",
+                                     params={"index": idx, "wait": "5s"})
+        results["elapsed"] = time.time() - t0
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    time.sleep(0.1)
+    job = mock.batch_job()
+    job.task_groups[0].tasks[0].config = {"run_for": "1s"}
+    c.register_job(job_to_spec(job))
+    t.join(timeout=6)
+    assert "jobs" in results
+    assert results["elapsed"] < 4.0   # woke before the 5s wait expired
+
+
+def test_cli_flow(cluster, tmp_path, capsys):
+    server, client, c = cluster
+    from nomad_tpu.cli.main import main
+    addr = c.address
+
+    # job init writes the example
+    jobfile = tmp_path / "example.nomad"
+    assert main(["job", "init", str(jobfile)]) == 0
+    assert jobfile.exists()
+
+    # job run (example uses mock_driver, runs long)
+    rc = main(["-address", addr, "job", "run", str(jobfile)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "Evaluation" in out and "complete" in out
+
+    # job status renders the table
+    assert main(["-address", addr, "job", "status", "example"]) == 0
+    out = capsys.readouterr().out
+    assert "running" in out
+    assert "cache" in out
+
+    # node status
+    assert main(["-address", addr, "node", "status"]) == 0
+    out = capsys.readouterr().out
+    assert "api-test" in out
+
+    # alloc status of the placed alloc
+    alloc_id = c.job_allocations("example")[0]["id"]
+    assert main(["-address", addr, "alloc", "status", alloc_id[:8]]) == 0
+    out = capsys.readouterr().out
+    assert "Placement Metrics" in out
+
+    # stop it
+    assert main(["-address", addr, "job", "stop", "-detach", "example"]) == 0
