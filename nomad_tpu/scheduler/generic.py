@@ -389,6 +389,10 @@ class GenericScheduler:
                             SelectOptions(
                                 penalty_node_ids=batch[0][1].penalty_node_ids))
                         option, metrics = fallback[0] if fallback else (None, metrics)
+                    # no fit anywhere: try preemption before failing
+                    # (BinPackIterator evict path, rank.go:415-448)
+                    if option is None:
+                        option = self._try_preemption(tg, metrics)
                     if option is not None:
                         self._append_placement(missing, tg, option,
                                                deployment_id, now)
@@ -417,6 +421,44 @@ class GenericScheduler:
                             node.computed_class, False)
                         self.ctx.eligibility.set_class_eligibility(
                             node.computed_class, prev or bool(mask[i]))
+
+    def _try_preemption(self, tg, metrics):
+        """When the kernel finds no fit, look for a node where evicting
+        lower-priority allocs (priority delta >= 10) makes room."""
+        from ..ops.tables import ProposedIndex as PI
+        from .preemption import find_preemption_placement, preemption_enabled
+        from .stack import RankedNode
+        if not preemption_enabled(self.state.scheduler_config(),
+                                  "batch" if self.batch else "service"):
+            return None
+        mask, _counts = self.engine.feasibility(tg)
+        proposed = PI(self.engine.table, self.job,
+                      self.state.allocs_by_job(self.job.namespace, self.job.id),
+                      self.plan)
+        found = find_preemption_placement(
+            self.state, self.engine.table, mask, proposed.used(),
+            self.engine.group_ask(tg), self.job, self.plan)
+        if found is None:
+            return None
+        idx, victims, score = found
+        node = self.engine.table.nodes[idx]
+        # victims free their ports too: rebuild this node's net index
+        # after staging the preemptions
+        for v in victims:
+            self.plan.append_preempted_alloc(v, "")
+        self.engine._net_cache.pop(node.id, None)
+        task_resources, shared, ok = self.engine._assign_resources(
+            node, tg, self.plan)
+        if not ok:
+            for v in victims:
+                lst = self.plan.node_preemptions.get(v.node_id, [])
+                self.plan.node_preemptions[v.node_id] = \
+                    [a for a in lst if a.id != v.id]
+            return None
+        return RankedNode(node=node, final_score=score,
+                          task_resources=task_resources,
+                          alloc_resources=shared, metrics=metrics,
+                          preempted_allocs=victims)
 
     @staticmethod
     def _get_select_options(missing) -> SelectOptions:
@@ -459,6 +501,9 @@ class GenericScheduler:
                 self._update_reschedule_tracker(alloc, prev, now)
         if missing.canary and self.deployment is not None:
             alloc.deployment_status = AllocDeploymentStatus(canary=True)
+        if option.preempted_allocs:
+            from .preemption import link_preemptions
+            link_preemptions(self.plan, alloc, option.preempted_allocs)
         self.plan.append_alloc(alloc)
 
     @staticmethod

@@ -1,0 +1,246 @@
+"""Preemption: choosing victim allocations on a node so a higher
+priority placement fits.
+
+Reference semantics: scheduler/preemption.go — candidates grouped by
+priority ascending with a >=10 priority delta (filterAndGroupPreemptibleAllocs:663),
+greedy closest-resource-distance selection (basicResourceDistance:608,
+scoreForTaskGroup:640 with the maxParallel penalty:13), then a
+superset-filter pass dropping redundant victims (filterSuperset:702).
+Node choice across candidates uses the logistic preemption score
+(rank.go preemptionScore:773: 1/(1+e^(0.0048*(netPriority-2048)))).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from ..models import Allocation, ComparableResources
+
+MAX_PARALLEL_PENALTY = 50.0
+PRIORITY_DELTA = 10
+
+
+def basic_resource_distance(ask: ComparableResources,
+                            used: ComparableResources) -> float:
+    mem = cpu = disk = 0.0
+    if ask.memory_mb > 0:
+        mem = (ask.memory_mb - used.memory_mb) / ask.memory_mb
+    if ask.cpu_shares > 0:
+        cpu = (ask.cpu_shares - used.cpu_shares) / ask.cpu_shares
+    if ask.disk_mb > 0:
+        disk = (ask.disk_mb - used.disk_mb) / ask.disk_mb
+    return math.sqrt(mem * mem + cpu * cpu + disk * disk)
+
+
+def score_for_task_group(ask: ComparableResources, used: ComparableResources,
+                         max_parallel: int, num_preempted: int) -> float:
+    penalty = 0.0
+    if max_parallel > 0 and num_preempted >= max_parallel:
+        penalty = float(num_preempted + 1 - max_parallel) * MAX_PARALLEL_PENALTY
+    return basic_resource_distance(ask, used) + penalty
+
+
+def net_priority(allocs: List[Allocation]) -> float:
+    """rank.go netPriority:749: max priority plus sum/max crowding factor."""
+    total = 0
+    mx = 0.0
+    for a in allocs:
+        prio = a.job.priority if a.job else 50
+        mx = max(mx, float(prio))
+        total += prio
+    if mx == 0:
+        return 0.0
+    return mx + total / mx
+
+
+def preemption_score(netprio: float) -> float:
+    """rank.go preemptionScore:773 — logistic, inflection at 2048."""
+    rate = 0.0048
+    origin = 2048.0
+    return 1.0 / (1.0 + math.exp(rate * (netprio - origin)))
+
+
+class Preemptor:
+    def __init__(self, job_priority: int, namespace: str, job_id: str):
+        self.job_priority = job_priority
+        self.namespace = namespace
+        self.job_id = job_id
+        self.current_preemptions: Dict[Tuple[str, str, str], int] = {}
+        self.alloc_details: Dict[str, Tuple[int, ComparableResources]] = {}
+        self.node_remaining: Optional[ComparableResources] = None
+        self.current_allocs: List[Allocation] = []
+        self.all_usage = ComparableResources()
+
+    def set_node(self, node) -> None:
+        remaining = node.comparable_resources()
+        remaining.subtract(node.comparable_reserved_resources())
+        self.node_remaining = remaining
+
+    def set_candidates(self, allocs: List[Allocation]) -> None:
+        """Candidates exclude the placing job's own allocs, but ALL
+        proposed allocs count against the node's remaining capacity —
+        otherwise same-job allocs on the node are invisible to the math
+        and preemption can approve an oversubscribing placement."""
+        self.current_allocs = []
+        self.all_usage = ComparableResources()
+        for alloc in allocs:
+            res = alloc.comparable_resources() or ComparableResources()
+            self.all_usage.add(res)
+            if alloc.job_id == self.job_id and alloc.namespace == self.namespace:
+                continue
+            max_parallel = 0
+            tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
+            if tg is not None and tg.migrate is not None:
+                max_parallel = tg.migrate.max_parallel
+            self.alloc_details[alloc.id] = (max_parallel, res)
+            self.current_allocs.append(alloc)
+
+    def set_preemptions(self, allocs: List[Allocation]) -> None:
+        self.current_preemptions = {}
+        for a in allocs:
+            key = (a.namespace, a.job_id, a.task_group)
+            self.current_preemptions[key] = self.current_preemptions.get(key, 0) + 1
+
+    def _num_preemptions(self, alloc: Allocation) -> int:
+        return self.current_preemptions.get(
+            (alloc.namespace, alloc.job_id, alloc.task_group), 0)
+
+    def preempt_for_task_group(self, ask: ComparableResources
+                               ) -> Optional[List[Allocation]]:
+        """Find victims so `ask` fits; None if impossible."""
+        needed = ask.copy()
+        remaining = self.node_remaining.copy()
+        remaining.subtract(self.all_usage)
+
+        groups = self._filter_and_group()
+        best: List[Allocation] = []
+        all_met = False
+        available = remaining.copy()
+
+        for _prio, allocs in groups:
+            allocs = list(allocs)
+            while allocs and not all_met:
+                best_idx = -1
+                best_dist = math.inf
+                for i, alloc in enumerate(allocs):
+                    max_parallel, res = self.alloc_details[alloc.id]
+                    dist = score_for_task_group(
+                        needed, res, max_parallel,
+                        self._num_preemptions(alloc))
+                    if dist < best_dist:
+                        best_dist = dist
+                        best_idx = i
+                closest = allocs.pop(best_idx)
+                closest_res = self.alloc_details[closest.id][1]
+                available.add(closest_res)
+                all_met, _dim = available.superset(ask)
+                best.append(closest)
+                needed.subtract(closest_res)
+            if all_met:
+                break
+        if not all_met:
+            return None
+        return self._filter_superset(best, remaining, ask)
+
+    def _filter_and_group(self) -> List[Tuple[int, List[Allocation]]]:
+        by_prio: Dict[int, List[Allocation]] = {}
+        for alloc in self.current_allocs:
+            if alloc.job is None:
+                continue
+            if self.job_priority - alloc.job.priority < PRIORITY_DELTA:
+                continue
+            by_prio.setdefault(alloc.job.priority, []).append(alloc)
+        return sorted(by_prio.items())
+
+    def _filter_superset(self, best: List[Allocation],
+                         remaining: ComparableResources,
+                         ask: ComparableResources) -> List[Allocation]:
+        # sort by distance descending (largest victims first)
+        best = sorted(
+            best,
+            key=lambda a: basic_resource_distance(
+                self.alloc_details[a.id][1], ask),
+            reverse=True)
+        available = remaining.copy()
+        out: List[Allocation] = []
+        for alloc in best:
+            out.append(alloc)
+            available.add(self.alloc_details[alloc.id][1])
+            met, _ = available.superset(ask)
+            if met:
+                break
+        return out
+
+
+def link_preemptions(plan, alloc, victims: List[Allocation]) -> None:
+    """Record victims on the preempting alloc and stamp the victim stubs
+    with the preemptor's id (generic_sched.go handlePreemptions)."""
+    alloc.preempted_allocations = [v.id for v in victims]
+    victim_ids = set(alloc.preempted_allocations)
+    for stubs in plan.node_preemptions.values():
+        for stub in stubs:
+            if stub.id in victim_ids and not stub.preempted_by_allocation:
+                stub.preempted_by_allocation = alloc.id
+                stub.desired_description = f"Preempted by alloc ID {alloc.id}"
+
+
+def preemption_enabled(sched_config, scheduler_type: str) -> bool:
+    """operator.go PreemptionConfig gates per scheduler type."""
+    pc = sched_config.preemption_config
+    if scheduler_type == "system":
+        return pc.system_scheduler_enabled
+    if scheduler_type == "batch":
+        return pc.batch_scheduler_enabled
+    if scheduler_type == "service":
+        return pc.service_scheduler_enabled
+    return False
+
+
+def find_preemption_placement(snapshot, table, mask, used, ask_vec, job,
+                              plan) -> Optional[Tuple[int, List[Allocation], float]]:
+    """Across feasible-but-full nodes, find the best (node_idx, victims,
+    score) by the logistic preemption score combined with bin-packing —
+    the host-side PreemptionScoringIterator + BinPack fallback
+    (rank.go:415-448, 732-745)."""
+    import numpy as np
+    from ..models.funcs import ScoreFitBinPack
+
+    ask = ComparableResources(cpu_shares=float(ask_vec[0]),
+                              memory_mb=float(ask_vec[1]),
+                              disk_mb=float(ask_vec[2]))
+    current_preempted: List[Allocation] = []
+    for allocs in plan.node_preemptions.values():
+        current_preempted.extend(allocs)
+
+    stopped_ids = {a.id for allocs in plan.node_update.values() for a in allocs}
+    stopped_ids |= {a.id for a in current_preempted}
+
+    best: Optional[Tuple[int, List[Allocation], float]] = None
+    fits = np.all(used + np.asarray(ask_vec)[None, :] <= table.capacity + 1e-6,
+                  axis=1)
+    for i in np.nonzero(mask & ~fits)[0]:
+        node = table.nodes[i]
+        proposed = [a for a in snapshot.allocs_by_node(node.id)
+                    if not a.terminal_status() and a.id not in stopped_ids]
+        proposed.extend(plan.node_allocation.get(node.id, []))
+        p = Preemptor(job.priority, job.namespace, job.id)
+        p.set_node(node)
+        p.set_candidates(proposed)
+        p.set_preemptions(current_preempted)
+        victims = p.preempt_for_task_group(ask)
+        if not victims:
+            continue
+        # score: binpack fit after eviction + logistic preemption score
+        util = ComparableResources()
+        victim_ids = {v.id for v in victims}
+        for a in proposed:
+            if a.id not in victim_ids:
+                util.add(a.comparable_resources())
+        util.add(ask)
+        binpack = ScoreFitBinPack(node, util) / 18.0
+        pscore = preemption_score(net_priority(victims))
+        final = (binpack + pscore) / 2.0
+        if best is None or final > best[2]:
+            best = (int(i), victims, final)
+    return best

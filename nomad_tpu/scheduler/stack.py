@@ -50,6 +50,7 @@ class RankedNode:
     task_resources: Dict[str, AllocatedTaskResources]
     alloc_resources: Optional[AllocatedSharedResources]
     metrics: AllocMetric
+    preempted_allocs: Optional[list] = None
 
 
 class PlacementEngine:

@@ -138,13 +138,23 @@ class SystemScheduler:
             ask = engine.group_ask(tg)
             fits = np.all(used + ask[None, :] <= table.capacity + 1e-6, axis=1)
 
+            from .preemption import preemption_enabled
+            preempt_ok = preemption_enabled(self.state.scheduler_config(),
+                                            "system")
             placed = 0
             exhausted = 0
             for i in missing_idx:
                 node = table.nodes[i]
+                victims = None
                 if not fits[i]:
-                    exhausted += 1
-                    continue
+                    if preempt_ok:
+                        victims = self._find_victims(node, tg, engine, ask)
+                    if not victims:
+                        exhausted += 1
+                        continue
+                    for v in victims:
+                        self.plan.append_preempted_alloc(v, "")
+                    engine._net_cache.pop(node.id, None)
                 task_resources, shared, ok = engine._assign_resources(
                     node, tg, self.plan)
                 if not ok:
@@ -169,6 +179,9 @@ class SystemScheduler:
                     metrics=AllocMetric(nodes_evaluated=n,
                                         nodes_available=dict(engine.by_dc)),
                 )
+                if victims:
+                    from .preemption import link_preemptions
+                    link_preemptions(self.plan, alloc, victims)
                 self.plan.append_alloc(alloc)
                 placed += 1
             if exhausted:
@@ -182,6 +195,27 @@ class SystemScheduler:
                 self.queued_allocs[tg.name] = exhausted
 
         return self._finish()
+
+    def _find_victims(self, node, tg, engine, ask):
+        """Preemption candidates on one node for a system placement."""
+        from ..models import ComparableResources
+        from .preemption import Preemptor
+        stopped = {a.id for allocs in self.plan.node_update.values()
+                   for a in allocs}
+        stopped |= {a.id for allocs in self.plan.node_preemptions.values()
+                    for a in allocs}
+        proposed = [a for a in self.state.allocs_by_node(node.id)
+                    if not a.terminal_status() and a.id not in stopped]
+        proposed.extend(self.plan.node_allocation.get(node.id, []))
+        p = Preemptor(self.job.priority, self.job.namespace, self.job.id)
+        p.set_node(node)
+        p.set_candidates(proposed)
+        current = [a for allocs in self.plan.node_preemptions.values()
+                   for a in allocs]
+        p.set_preemptions(current)
+        return p.preempt_for_task_group(ComparableResources(
+            cpu_shares=float(ask[0]), memory_mb=float(ask[1]),
+            disk_mb=float(ask[2])))
 
     def _finish(self):
         if self.plan.is_no_op():

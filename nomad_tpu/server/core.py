@@ -45,15 +45,18 @@ class ServerConfig:
     heartbeat_ttl_s: float = 10.0
     failed_eval_unblock_delay_s: float = 60.0
     dev_mode: bool = True
+    data_dir: str = ""              # empty == in-memory only
+    snapshot_every: int = 1024      # WAL entries between snapshots
 
 
 class Server:
     def __init__(self, config: Optional[ServerConfig] = None):
         self.config = config or ServerConfig()
         self.store = StateStore()
-        self._raft_l = threading.Lock()
+        # RLock: FSM appliers can nest (e.g. a node-register unblocking a
+        # blocked eval re-enters raft_apply on the same thread)
+        self._raft_l = threading.RLock()
         self._raft_index = 10
-
         self.eval_broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self._unblock_enqueue)
         self.plan_queue = PlanQueue()
@@ -62,6 +65,26 @@ class Server:
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._hb_lock = threading.Lock()
         self._leader = False
+
+        # restore persisted state AFTER all subsystems exist: WAL replay
+        # drives the same FSM appliers (broker/blocked are disabled until
+        # leadership, so replay has no scheduling side effects)
+        self.persistence = None
+        if self.config.data_dir:
+            from .persistence import Persistence
+            self.persistence = Persistence(self.config.data_dir,
+                                           self.config.snapshot_every)
+            highest, entries = self.persistence.restore_into(self.store)
+            self._raft_index = max(self._raft_index, highest)
+            for index, msg_type, payload in entries:
+                if index <= highest:
+                    continue
+                try:
+                    getattr(self, f"_apply_{msg_type}")(index, payload)
+                    self._raft_index = max(self._raft_index, index)
+                except Exception:
+                    LOG.exception("WAL replay failed at %d/%s",
+                                  index, msg_type)
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -95,6 +118,11 @@ class Server:
         self.plan_queue.set_enabled(True)
         self._leader = True
         self._restore_evals()
+        # restored nodes need TTL timers or a dead node stays ready
+        # forever (heartbeat.go initializeHeartbeatTimers)
+        for node in self.store.nodes():
+            if not node.terminal_status():
+                self.reset_heartbeat_timer(node.id)
 
     def _reap_failed_evals(self) -> None:
         """Drain the broker's failed queue: mark the eval failed and
@@ -125,12 +153,19 @@ class Server:
 
     # -- raft shim -----------------------------------------------------
     def raft_apply(self, msg_type: str, payload: dict) -> int:
-        """Serialized FSM apply (fsm.go Apply:210-300). Returns the index."""
+        """Serialized FSM apply (fsm.go Apply:210-300). Returns the index.
+        The whole record+apply+snapshot sequence runs under the raft lock
+        so WAL order == apply order and a snapshot can never truncate an
+        entry whose effects it doesn't contain."""
         with self._raft_l:
             self._raft_index += 1
             index = self._raft_index
-        fn = getattr(self, f"_apply_{msg_type}")
-        fn(index, payload)
+            if self.persistence is not None:
+                self.persistence.record(index, msg_type, payload)
+            fn = getattr(self, f"_apply_{msg_type}")
+            fn(index, payload)
+            if self.persistence is not None:
+                self.persistence.maybe_snapshot(self.store)
         return index
 
     # -- FSM appliers --------------------------------------------------

@@ -65,9 +65,15 @@ class PlanApplier:
                 result.node_allocation[node_id] = placements
             else:
                 rejected = True
-        # stops/preemptions are always committable
+        # stops are always committable; preemptions commit only when the
+        # placement they made room for was accepted — otherwise victims
+        # would be evicted for an alloc that never enters state
         result.node_update = dict(plan.node_update)
-        result.node_preemptions = dict(plan.node_preemptions)
+        result.node_preemptions = {
+            node_id: victims
+            for node_id, victims in plan.node_preemptions.items()
+            if node_id in result.node_allocation
+            or node_id not in plan.node_allocation}
         result.deployment = plan.deployment
         result.deployment_updates = list(plan.deployment_updates)
         if rejected:

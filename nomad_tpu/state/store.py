@@ -674,6 +674,102 @@ class StateStore(StateSnapshot):
             root = root.with_index("scheduler_config", index)
             self._publish(root)
 
+    # -- checkpoint / restore (fsm.go Snapshot:1360 / Restore:1374) ----
+    def dump(self) -> dict:
+        """Wire-encode the full database for a snapshot file."""
+        from ..utils.codec import to_wire
+        root = self._root
+        out = {"indexes": dict(root.indexes.items()), "tables": {}}
+        plain = out["tables"]
+        plain["nodes"] = [to_wire(n) for n in root.table("nodes").values()]
+        plain["jobs"] = [to_wire(j) for j in root.table("jobs").values()]
+        plain["job_versions"] = [
+            {"key": list(k), "versions": {str(v): to_wire(j)
+                                          for v, j in versions.items()}}
+            for k, versions in root.table("job_versions").items()]
+        plain["evals"] = [to_wire(e) for e in root.table("evals").values()]
+        plain["allocs"] = [to_wire(a) for a in root.table("allocs").values()]
+        plain["deployments"] = [to_wire(d)
+                                for d in root.table("deployments").values()]
+        plain["job_summaries"] = [to_wire(s) for s in
+                                  root.table("job_summaries").values()]
+        cfg = root.table("scheduler_config").get("config")
+        plain["scheduler_config"] = to_wire(cfg) if cfg else None
+        return out
+
+    def restore(self, data: dict) -> None:
+        """Rebuild the database from a dump. Replaces all state."""
+        from ..models import SchedulerConfiguration
+        from ..utils.codec import from_wire
+        with self._lock:
+            root = _Root(Hamt(), Hamt())
+            t = root.table("nodes")
+            for w in data["tables"].get("nodes", []):
+                node = from_wire(Node, w)
+                t = t.set(node.id, node)
+            root = root.with_table("nodes", t)
+
+            t = root.table("jobs")
+            for w in data["tables"].get("jobs", []):
+                job = from_wire(Job, w)
+                t = t.set(job.namespaced_id(), job)
+            root = root.with_table("jobs", t)
+
+            t = root.table("job_versions")
+            for entry in data["tables"].get("job_versions", []):
+                key = tuple(entry["key"])
+                versions = Hamt()
+                for v, w in entry["versions"].items():
+                    versions = versions.set(int(v), from_wire(Job, w))
+                t = t.set(key, versions)
+            root = root.with_table("job_versions", t)
+
+            t = root.table("evals")
+            for w in data["tables"].get("evals", []):
+                ev = from_wire(Evaluation, w)
+                t = t.set(ev.id, ev)
+                root = root.with_table("evals", t)
+                root = self._index_add(root, "evals_by_job",
+                                       (ev.namespace, ev.job_id), ev.id)
+                t = root.table("evals")
+
+            t = root.table("allocs")
+            for w in data["tables"].get("allocs", []):
+                a = from_wire(Allocation, w)
+                t = t.set(a.id, a)
+                root = root.with_table("allocs", t)
+                root = self._index_add(root, "allocs_by_node", a.node_id, a.id)
+                root = self._index_add(root, "allocs_by_job",
+                                       (a.namespace, a.job_id), a.id)
+                root = self._index_add(root, "allocs_by_eval", a.eval_id, a.id)
+                t = root.table("allocs")
+
+            t = root.table("deployments")
+            for w in data["tables"].get("deployments", []):
+                d = from_wire(Deployment, w)
+                t = t.set(d.id, d)
+                root = root.with_table("deployments", t)
+                root = self._index_add(root, "deployments_by_job",
+                                       (d.namespace, d.job_id), d.id)
+                t = root.table("deployments")
+
+            t = root.table("job_summaries")
+            for w in data["tables"].get("job_summaries", []):
+                s = from_wire(JobSummary, w)
+                t = t.set((s.namespace, s.job_id), s)
+            root = root.with_table("job_summaries", t)
+
+            cfg = data["tables"].get("scheduler_config")
+            if cfg:
+                root = root.with_table(
+                    "scheduler_config",
+                    root.table("scheduler_config").set(
+                        "config", from_wire(SchedulerConfiguration, cfg)))
+
+            for table, index in data.get("indexes", {}).items():
+                root = root.with_index(table, index)
+            self._publish(root)
+
     # -- job status reconciliation (fsm setJobStatus analog) ----------
     def set_job_status(self, index: int, namespace: str, job_id: str,
                        status: str, description: str = "") -> None:

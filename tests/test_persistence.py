@@ -1,0 +1,144 @@
+"""Checkpoint/resume tests: WAL replay, snapshot restore, crash
+tolerance (reference patterns: nomad/fsm_test.go snapshot round trips)."""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.persistence import RaftLog
+from nomad_tpu.state import StateStore
+
+
+def _wait_for(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_store_dump_restore_roundtrip():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(11, n)
+    j = mock.job()
+    s.upsert_job(12, j)
+    a = mock.alloc()
+    a.node_id = n.id
+    a.job_id = j.id
+    s.upsert_allocs(13, [a])
+    e = mock.evaluation()
+    s.upsert_evals(14, [e])
+    d = mock.deployment()
+    s.upsert_deployment(15, d)
+
+    data = s.dump()
+    s2 = StateStore()
+    s2.restore(data)
+    assert s2.node_by_id(n.id).name == n.name
+    assert s2.job_by_id("default", j.id).version == 0
+    assert s2.alloc_by_id(a.id).job is not None
+    assert len(s2.allocs_by_node(n.id)) == 1
+    assert len(s2.allocs_by_job("default", j.id)) == 1
+    assert s2.eval_by_id(e.id) is not None
+    assert s2.deployment_by_id(d.id) is not None
+    assert s2.latest_index() == s.latest_index()
+    assert s2.job_summary("default", j.id) is not None
+
+
+def test_wal_replay_and_torn_write(tmp_path):
+    log = RaftLog(str(tmp_path / "raft.log"))
+    log.open()
+    log.append(1, "node_register", {"node": mock.node()})
+    log.append(2, "eval_update", {"evals": [mock.evaluation()]})
+    log.close()
+    # simulate a torn final frame
+    with open(str(tmp_path / "raft.log"), "ab") as f:
+        f.write(b"\xff\x00\x00\x00partial")
+    entries = log.replay()
+    assert len(entries) == 2
+    assert entries[0][1] == "node_register"
+    assert entries[0][2]["node"].name == "foobar"
+    assert entries[1][2]["evals"][0].status == "pending"
+
+
+def test_server_restart_recovers_state(tmp_path):
+    data_dir = str(tmp_path / "data")
+    server = Server(ServerConfig(num_schedulers=2, data_dir=data_dir,
+                                 heartbeat_ttl_s=60.0))
+    server.start()
+    client = Client(server, ClientConfig(node_name="persist-client"))
+    client.start()
+    job = mock.batch_job()
+    job.type = "service"
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].config = {"run_for": "60s"}
+    job.canonicalize()
+    server.register_job(job)
+    assert _wait_for(lambda: len(
+        server.store.allocs_by_job("default", job.id)) == 2)
+    node_id = client.node.id
+    client.shutdown()
+    server.shutdown()
+
+    # "restart" the server from the same data dir
+    server2 = Server(ServerConfig(num_schedulers=2, data_dir=data_dir,
+                                  heartbeat_ttl_s=60.0))
+    assert server2.store.job_by_id("default", job.id) is not None
+    assert len(server2.store.allocs_by_job("default", job.id)) == 2
+    assert server2.store.node_by_id(node_id) is not None
+    assert server2._raft_index >= server.store.latest_index()
+    server2.start()
+    server2.shutdown()
+
+
+def test_snapshot_truncates_wal(tmp_path):
+    data_dir = str(tmp_path / "snap")
+    server = Server(ServerConfig(num_schedulers=0, data_dir=data_dir,
+                                 snapshot_every=5))
+    server.start()
+    for i in range(12):
+        server.raft_apply("node_register", dict(node=mock.node()))
+    server.shutdown()
+    # WAL should have been truncated at least twice; snapshot exists
+    assert os.path.exists(os.path.join(data_dir, "state.snap"))
+    wal_entries = RaftLog(os.path.join(data_dir, "raft.log")).replay()
+    assert len(wal_entries) < 12
+
+    server2 = Server(ServerConfig(num_schedulers=0, data_dir=data_dir))
+    assert len(server2.store.nodes()) == 12
+
+
+def test_blocked_eval_survives_restart(tmp_path):
+    data_dir = str(tmp_path / "blocked")
+    server = Server(ServerConfig(num_schedulers=2, data_dir=data_dir,
+                                 heartbeat_ttl_s=60.0))
+    server.start()
+    client = Client(server, ClientConfig(node_name="c1"))
+    client.start()
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources.cpu = 9000   # cannot place
+    server.register_job(job)
+    assert _wait_for(lambda: server.blocked_evals.blocked_count() == 1)
+    client.shutdown()
+    server.shutdown()
+
+    server2 = Server(ServerConfig(num_schedulers=2, data_dir=data_dir,
+                                  heartbeat_ttl_s=60.0))
+    server2.start()   # restore_evals re-blocks it
+    assert server2.blocked_evals.blocked_count() == 1
+    # a big node joining unblocks and places
+    big = Client(server2, ClientConfig(node_name="big", cpu_shares=16000))
+    big.start()
+    try:
+        assert _wait_for(lambda: len(
+            server2.store.allocs_by_job("default", job.id)) == 1, timeout=15)
+    finally:
+        big.shutdown()
+        server2.shutdown()

@@ -1,0 +1,180 @@
+"""Preemption tests (reference: scheduler/preemption_test.go patterns)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.models import (ComparableResources, SchedulerConfiguration,
+                              ALLOC_DESIRED_EVICT)
+from nomad_tpu.models.evaluation import Evaluation
+from nomad_tpu.models.scheduler_config import PreemptionConfig
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.scheduler.preemption import (
+    Preemptor, basic_resource_distance, preemption_score, net_priority)
+
+
+def _mk_alloc(job, node_id, cpu, mem, tg="web"):
+    a = mock.alloc()
+    a.job = job
+    a.job_id = job.id
+    a.node_id = node_id
+    a.task_group = tg
+    a.allocated_resources.tasks["web"].cpu.cpu_shares = cpu
+    a.allocated_resources.tasks["web"].memory.memory_mb = mem
+    a.allocated_resources.tasks["web"].networks = []
+    return a
+
+
+def test_resource_distance():
+    ask = ComparableResources(cpu_shares=1000, memory_mb=1000, disk_mb=0)
+    exact = ComparableResources(cpu_shares=1000, memory_mb=1000)
+    assert basic_resource_distance(ask, exact) == pytest.approx(0.0)
+    half = ComparableResources(cpu_shares=500, memory_mb=500)
+    assert basic_resource_distance(ask, half) == pytest.approx(0.7071, abs=1e-3)
+
+
+def test_preemption_score_logistic():
+    assert preemption_score(2048.0) == pytest.approx(0.5)
+    assert preemption_score(0.0) > 0.99
+    assert preemption_score(10000.0) < 0.01
+
+
+def test_preemptor_picks_lowest_priority_closest():
+    node = mock.node()   # 3900 cpu avail
+    lo = mock.job()
+    lo.priority = 20
+    hi = mock.job()
+    hi.priority = 40
+    placing = mock.job()
+    placing.priority = 70
+    a1 = _mk_alloc(lo, node.id, 1000, 2000)    # low prio, close to ask
+    a2 = _mk_alloc(lo, node.id, 2800, 5800)    # low prio, big
+    a3 = _mk_alloc(hi, node.id, 1000, 2000)    # higher prio
+    p = Preemptor(placing.priority, "default", placing.id)
+    p.set_node(node)
+    p.set_candidates([a1, a2, a3])
+    # node is oversubscribed; greedy picks a1 (distance 0) then a2, and
+    # the superset filter keeps only a2 since it alone frees enough
+    # (preemption.go filterSuperset:702)
+    victims = p.preempt_for_task_group(
+        ComparableResources(cpu_shares=1000, memory_mb=2000))
+    assert victims is not None
+    assert all(v.job.priority == 20 for v in victims)
+    assert [v.id for v in victims] == [a2.id]
+
+
+def test_preemptor_priority_delta_gate():
+    node = mock.node()
+    near = mock.job()
+    near.priority = 45    # delta < 10 vs 50: not preemptible
+    placing = mock.job()
+    placing.priority = 50
+    a = _mk_alloc(near, node.id, 3500, 7000)
+    p = Preemptor(placing.priority, "default", placing.id)
+    p.set_node(node)
+    p.set_candidates([a])
+    assert p.preempt_for_task_group(
+        ComparableResources(cpu_shares=1000, memory_mb=1000)) is None
+
+
+def test_preemptor_superset_filter():
+    node = mock.node()
+    lo = mock.job()
+    lo.priority = 10
+    placing = mock.job()
+    placing.priority = 70
+    # node is full: 3 allocs of 1300 cpu each
+    allocs = [_mk_alloc(lo, node.id, 1300, 2600) for _ in range(3)]
+    p = Preemptor(placing.priority, "default", placing.id)
+    p.set_node(node)
+    p.set_candidates(allocs)
+    victims = p.preempt_for_task_group(
+        ComparableResources(cpu_shares=1200, memory_mb=2000))
+    assert victims is not None
+    assert len(victims) == 1   # one eviction is enough
+
+
+def test_service_preemption_end_to_end():
+    h = Harness()
+    # enable service preemption
+    h.store.set_scheduler_config(1, SchedulerConfiguration(
+        preemption_config=PreemptionConfig(service_scheduler_enabled=True)))
+    n = mock.node()
+    h.store.upsert_node(h.next_index(), n)
+    # fill the node with a low-priority job
+    lowjob = mock.job()
+    lowjob.priority = 20
+    lowjob.task_groups[0].count = 7   # 7*500 = 3500 of 3900
+    lowjob.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), lowjob)
+    h.process("service", Evaluation(namespace="default", type="service",
+                                    triggered_by="job-register",
+                                    job_id=lowjob.id))
+    assert len(h.store.allocs_by_job("default", lowjob.id)) == 7
+
+    # high priority job needs 1000 cpu: must preempt
+    hijob = mock.job()
+    hijob.priority = 70
+    hijob.task_groups[0].count = 1
+    hijob.task_groups[0].tasks[0].resources.cpu = 1000
+    hijob.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), hijob)
+    h.process("service", Evaluation(namespace="default", type="service",
+                                    triggered_by="job-register",
+                                    job_id=hijob.id))
+    placed = h.store.allocs_by_job("default", hijob.id)
+    assert len(placed) == 1
+    assert placed[0].preempted_allocations
+    evicted = [h.store.alloc_by_id(aid)
+               for aid in placed[0].preempted_allocations]
+    assert all(a.desired_status == ALLOC_DESIRED_EVICT for a in evicted)
+    assert all(a.preempted_by_allocation == placed[0].id for a in evicted)
+    # minimal victim set: 3500+1000 <= 3900 needs 2 evictions (600 free + 2*500)
+    assert len(evicted) == 2
+
+
+def test_preemption_disabled_by_default_for_service():
+    h = Harness()
+    n = mock.node()
+    h.store.upsert_node(h.next_index(), n)
+    lowjob = mock.job()
+    lowjob.priority = 20
+    lowjob.task_groups[0].count = 7
+    lowjob.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), lowjob)
+    h.process("service", Evaluation(namespace="default", type="service",
+                                    triggered_by="job-register",
+                                    job_id=lowjob.id))
+    hijob = mock.job()
+    hijob.priority = 70
+    hijob.task_groups[0].count = 1
+    hijob.task_groups[0].tasks[0].resources.cpu = 1000
+    hijob.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), hijob)
+    h.process("service", Evaluation(namespace="default", type="service",
+                                    triggered_by="job-register",
+                                    job_id=hijob.id))
+    assert h.store.allocs_by_job("default", hijob.id) == []
+    assert "web" in h.evals[-1].failed_tg_allocs
+
+
+def test_system_preemption_enabled_by_default():
+    h = Harness()
+    n = mock.node()
+    h.store.upsert_node(h.next_index(), n)
+    lowjob = mock.job()
+    lowjob.priority = 20
+    lowjob.task_groups[0].count = 7
+    lowjob.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), lowjob)
+    h.process("service", Evaluation(namespace="default", type="service",
+                                    triggered_by="job-register",
+                                    job_id=lowjob.id))
+    sysjob = mock.system_job()     # priority 100, needs 500cpu/256mb
+    sysjob.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), sysjob)
+    h.process("system", Evaluation(namespace="default", type="system",
+                                   triggered_by="job-register",
+                                   job_id=sysjob.id))
+    placed = h.store.allocs_by_job("default", sysjob.id)
+    assert len(placed) == 1
+    assert placed[0].preempted_allocations
