@@ -129,7 +129,9 @@ class HTTPApiServer:
                 job = from_wire(Job, spec) if isinstance(spec, dict) \
                     else parse_job(spec)
                 ev = s.register_job(job)
-                return {"EvalID": ev.id, "JobModifyIndex": job.modify_index}, \
+                # periodic/parameterized registrations create no eval
+                return {"EvalID": ev.id if ev is not None else "",
+                        "JobModifyIndex": job.modify_index}, \
                     store.latest_index()
 
         if path == "/v1/jobs/parse" and method in ("PUT", "POST"):

@@ -107,6 +107,11 @@ def cmd_job_run(args) -> int:
     except ApiError as e:
         print(f"Error submitting job: {e}", file=sys.stderr)
         return 1
+    if not resp.get("EvalID"):
+        # periodic/parameterized jobs register without an eval
+        print(f"Job registration successful (no evaluation: "
+              f"\"{job.id}\" is periodic or parameterized)")
+        return 0
     print(f"==> Evaluation {short_id(resp['EvalID'])} triggered by job "
           f"\"{job.id}\"")
     if args.detach:

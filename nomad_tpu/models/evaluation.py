@@ -39,6 +39,7 @@ CORE_JOB_NODE_GC = "node-gc"
 CORE_JOB_JOB_GC = "job-gc"
 CORE_JOB_DEPLOYMENT_GC = "deployment-gc"
 CORE_JOB_CSI_VOLUME_CLAIM_GC = "csi-volume-claim-gc"
+CORE_JOB_FORCE_GC = "force-gc"
 
 
 @dataclass

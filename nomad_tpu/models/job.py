@@ -117,7 +117,10 @@ class MigrateStrategy:
 
 @dataclass
 class PeriodicConfig:
-    enabled: bool = False
+    # a present periodic stanza defaults to enabled (api/jobs.go
+    # canonicalizes Enabled=true when the block exists); "no periodic"
+    # is represented by Job.periodic is None
+    enabled: bool = True
     spec: str = ""             # cron expression
     spec_type: str = "cron"
     prohibit_overlap: bool = False
@@ -423,6 +426,36 @@ class Job:
                 errs.append("system jobs may not have an affinity stanza")
             if self.spreads:
                 errs.append("system jobs may not have a spread stanza")
+        if self.periodic is not None and self.periodic.enabled:
+            # structs.go:4126 — periodic only with the batch scheduler
+            if self.type != JOB_TYPE_BATCH:
+                errs.append(
+                    f"periodic can only be used with {JOB_TYPE_BATCH!r} jobs")
+            if self.periodic.timezone not in ("", "UTC", "Etc/UTC"):
+                errs.append("periodic timezone must be UTC")
+            if self.periodic.spec_type != "cron":
+                errs.append(
+                    f"unknown periodic spec type {self.periodic.spec_type!r}")
+            else:
+                from ..utils.cron import Cron, CronParseError
+                try:
+                    Cron(self.periodic.spec)
+                except CronParseError as e:
+                    errs.append(f"invalid cron spec: {e}")
+        if self.periodic is not None and self.periodic.enabled \
+                and self.parameterized_job is not None:
+            errs.append("a job cannot be both periodic and parameterized")
+        if self.parameterized_job is not None:
+            # structs.go:4137 — parameterized only with the batch scheduler
+            if self.type != JOB_TYPE_BATCH:
+                errs.append(
+                    f"parameterized job can only be used with "
+                    f"{JOB_TYPE_BATCH!r} jobs")
+            if self.parameterized_job.payload not in (
+                    "optional", "required", "forbidden"):
+                errs.append(
+                    f"invalid parameterized payload mode "
+                    f"{self.parameterized_job.payload!r}")
         return errs
 
     # -- queries -------------------------------------------------------

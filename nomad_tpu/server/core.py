@@ -24,16 +24,24 @@ from ..models import (
     Allocation, Evaluation, Job, Node,
     EVAL_STATUS_FAILED, EVAL_STATUS_PENDING,
     JOB_STATUS_PENDING, JOB_STATUS_RUNNING,
-    JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM,
+    JOB_TYPE_CORE, JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM,
     NODE_STATUS_DOWN, NODE_STATUS_READY,
     TRIGGER_JOB_DEREGISTER, TRIGGER_JOB_REGISTER, TRIGGER_NODE_UPDATE,
 )
+from ..models.evaluation import (
+    CORE_JOB_DEPLOYMENT_GC, CORE_JOB_EVAL_GC, CORE_JOB_FORCE_GC,
+    CORE_JOB_JOB_GC, CORE_JOB_NODE_GC, TRIGGER_SCHEDULED,
+)
 from ..state import StateStore
+from ..utils.timetable import TimeTable
 from .blocked_evals import BlockedEvals
 from .eval_broker import EvalBroker, FAILED_QUEUE
+from .periodic import PeriodicDispatch
 from .plan_applier import PlanApplier
 from .plan_queue import PlanQueue
 from .worker import Worker
+
+CORE_JOB_PRIORITY = 200  # structs.go CoreJobPriority = 2 * JobMaxPriority
 
 LOG = logging.getLogger("nomad_tpu.server")
 
@@ -47,6 +55,12 @@ class ServerConfig:
     dev_mode: bool = True
     data_dir: str = ""              # empty == in-memory only
     snapshot_every: int = 1024      # WAL entries between snapshots
+    # GC cadence + retention (nomad/config.go *GCInterval/*GCThreshold)
+    gc_interval_s: float = 60.0
+    eval_gc_threshold_s: float = 3600.0
+    job_gc_threshold_s: float = 4 * 3600.0
+    node_gc_threshold_s: float = 24 * 3600.0
+    deployment_gc_threshold_s: float = 3600.0
 
 
 class Server:
@@ -61,6 +75,8 @@ class Server:
         self.blocked_evals = BlockedEvals(self._unblock_enqueue)
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.plan_queue, self)
+        self.time_table = TimeTable()
+        self.periodic = PeriodicDispatch(self)
         self.workers: List[Worker] = []
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._hb_lock = threading.Lock()
@@ -74,14 +90,20 @@ class Server:
             from .persistence import Persistence
             self.persistence = Persistence(self.config.data_dir,
                                            self.config.snapshot_every)
+            self.persistence.extra_provider = lambda: {
+                "time_table": self.time_table.dump()}
             highest, entries = self.persistence.restore_into(self.store)
+            self.time_table.restore(
+                self.persistence.restored_extra.get("time_table", []))
             self._raft_index = max(self._raft_index, highest)
-            for index, msg_type, payload in entries:
+            for index, msg_type, payload, ts in entries:
                 if index <= highest:
                     continue
                 try:
                     getattr(self, f"_apply_{msg_type}")(index, payload)
                     self._raft_index = max(self._raft_index, index)
+                    if ts:
+                        self.time_table.witness(index, ts)
                 except Exception:
                     LOG.exception("WAL replay failed at %d/%s",
                                   index, msg_type)
@@ -91,15 +113,20 @@ class Server:
         self.establish_leadership()
         self.plan_applier.start()
         for i in range(self.config.num_schedulers):
-            w = Worker(self, list(self.config.enabled_schedulers), wid=i)
+            w = Worker(self, list(self.config.enabled_schedulers)
+                       + [JOB_TYPE_CORE], wid=i)
             self.workers.append(w)
             w.start()
         self._reaper = threading.Thread(target=self._reap_failed_evals,
                                         daemon=True, name="eval-reaper")
         self._reaper.start()
+        self._gc_ticker = threading.Thread(target=self._schedule_periodic_gc,
+                                           daemon=True, name="gc-ticker")
+        self._gc_ticker.start()
 
     def shutdown(self) -> None:
         self._leader = False
+        self.periodic.stop()
         for w in self.workers:
             w.stop()
         self.plan_applier.stop()
@@ -123,6 +150,11 @@ class Server:
         for node in self.store.nodes():
             if not node.terminal_status():
                 self.reset_heartbeat_timer(node.id)
+        # leader.go restorePeriodicDispatcher:222 — re-track periodic jobs
+        self.periodic.set_enabled(True)
+        for job in self.store.jobs():
+            if job.is_periodic():
+                self.periodic.add(job)
 
     def _reap_failed_evals(self) -> None:
         """Drain the broker's failed queue: mark the eval failed and
@@ -131,6 +163,11 @@ class Server:
         while self._leader:
             ev, token = self.eval_broker.dequeue([FAILED_QUEUE], timeout_s=0.5)
             if ev is None:
+                continue
+            if ev.type == JOB_TYPE_CORE:
+                # core evals are in-memory only — drop, never persist;
+                # the GC ticker will enqueue a fresh one next interval
+                self.eval_broker.ack(ev.id, token)
                 continue
             failed = ev.copy()
             failed.status = EVAL_STATUS_FAILED
@@ -142,6 +179,30 @@ class Server:
                 self.eval_broker.ack(ev.id, token)
             except Exception:
                 LOG.exception("failed-eval reap for %s", ev.id)
+
+    def _schedule_periodic_gc(self) -> None:
+        """leader.go schedulePeriodic:689 — enqueue `_core` GC evals on a
+        ticker. These evals are in-memory only (never raft-applied)."""
+        last = time.monotonic()
+        while self._leader:
+            time.sleep(min(self.config.gc_interval_s / 4.0, 0.5))
+            if time.monotonic() - last < self.config.gc_interval_s:
+                continue
+            last = time.monotonic()
+            for core_job in (CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC,
+                             CORE_JOB_NODE_GC, CORE_JOB_DEPLOYMENT_GC):
+                self.eval_broker.enqueue(self._core_eval(core_job))
+
+    def _core_eval(self, core_job: str) -> Evaluation:
+        return Evaluation(
+            priority=CORE_JOB_PRIORITY, type=JOB_TYPE_CORE,
+            triggered_by=TRIGGER_SCHEDULED, job_id=core_job,
+            status=EVAL_STATUS_PENDING,
+            modify_index=self._raft_index)
+
+    def force_gc(self) -> None:
+        """`nomad system gc` (system_endpoint.go): a forced full GC pass."""
+        self.eval_broker.enqueue(self._core_eval(CORE_JOB_FORCE_GC))
 
     def _restore_evals(self) -> None:
         """Re-enqueue non-terminal evals after leadership (leader.go:496)."""
@@ -164,6 +225,7 @@ class Server:
                 self.persistence.record(index, msg_type, payload)
             fn = getattr(self, f"_apply_{msg_type}")
             fn(index, payload)
+            self.time_table.witness(index)
             if self.persistence is not None:
                 self.persistence.maybe_snapshot(self.store)
         return index
@@ -173,6 +235,8 @@ class Server:
         job: Job = p["job"]
         self.store.upsert_job(index, job)
         self.blocked_evals.untrack(job.namespace, job.id)
+        self.store.reconcile_job_status(index, job.namespace, job.id)
+        self.periodic.add(self.store.job_by_id(job.namespace, job.id) or job)
         for ev in p.get("evals", []):
             self.store.upsert_evals(index, [ev])
             self.enqueue_eval(ev)
@@ -181,12 +245,15 @@ class Server:
         namespace, job_id = p["namespace"], p["job_id"]
         if p.get("purge"):
             self.store.delete_job(index, namespace, job_id)
+            self.periodic.remove(namespace, job_id)
         else:
             job = self.store.job_by_id(namespace, job_id)
             if job is not None:
                 stopped = job.copy()
                 stopped.stop = True
                 self.store.upsert_job(index, stopped)
+                self.store.reconcile_job_status(index, namespace, job_id)
+                self.periodic.add(stopped)  # untracks a stopped periodic
         for ev in p.get("evals", []):
             self.store.upsert_evals(index, [ev])
             self.enqueue_eval(ev)
@@ -196,6 +263,8 @@ class Server:
         self.store.upsert_evals(index, evals)
         for ev in evals:
             self.enqueue_eval(ev)
+            if ev.job_id and ev.type != JOB_TYPE_CORE:
+                self.store.reconcile_job_status(index, ev.namespace, ev.job_id)
 
     def _apply_eval_delete(self, index: int, p: dict) -> None:
         self.store.delete_evals(index, p["eval_ids"], p.get("alloc_ids"))
@@ -248,6 +317,7 @@ class Server:
         for ev in p.get("evals", []):
             self.store.upsert_evals(index, [ev])
             self.enqueue_eval(ev)
+        self._reconcile_job_statuses(index, {"allocs_placed": allocs})
 
     def _apply_plan_results(self, index: int, p: dict) -> None:
         self.store.upsert_plan_results(
@@ -264,6 +334,13 @@ class Server:
     def _apply_scheduler_config(self, index: int, p: dict) -> None:
         self.store.set_scheduler_config(index, p["config"])
 
+    def _apply_periodic_launch(self, index: int, p: dict) -> None:
+        self.store.upsert_periodic_launch(index, p["namespace"], p["job_id"],
+                                          p["launch_time"])
+
+    def _apply_deployment_delete(self, index: int, p: dict) -> None:
+        self.store.delete_deployments(index, p["deployment_ids"])
+
     def _apply_deployment_status_update(self, index: int, p: dict) -> None:
         self.store.update_deployment_status(
             index, p["update"], p.get("job"), p.get("evals"))
@@ -273,15 +350,14 @@ class Server:
     def _reconcile_job_statuses(self, index: int, p: dict) -> None:
         """Derive job status from alloc states (fsm setJobStatus analog)."""
         seen = set()
-        for a in p.get("allocs_placed", []):
+        for stub in (p.get("allocs_placed", []) + p.get("allocs_stopped", [])
+                     + p.get("allocs_preempted", [])):
+            a = self.store.alloc_by_id(stub.id) or stub
             key = (a.namespace, a.job_id)
-            if key in seen:
+            if key in seen or not key[1]:
                 continue
             seen.add(key)
-            job = self.store.job_by_id(*key)
-            if job is not None and job.status == JOB_STATUS_PENDING:
-                self.store.set_job_status(index, key[0], key[1],
-                                          JOB_STATUS_RUNNING)
+            self.store.reconcile_job_status(index, *key)
 
     # -- eval routing --------------------------------------------------
     def enqueue_eval(self, ev: Evaluation) -> None:
@@ -297,21 +373,72 @@ class Server:
         index = self.raft_apply("eval_update", dict(evals=[woke]))
 
     # -- north-bound API (the RPC endpoint surface) --------------------
-    def register_job(self, job: Job) -> Evaluation:
+    def register_job(self, job: Job,
+                     triggered_by: str = TRIGGER_JOB_REGISTER
+                     ) -> Optional[Evaluation]:
         """Job.Register (nomad/job_endpoint.go:79): canonicalize,
-        validate, upsert, create eval."""
+        validate, upsert, create eval. Periodic and parameterized jobs
+        get no eval — the dispatcher / Job.Dispatch creates child jobs
+        which do (job_endpoint.go:236-247)."""
         job.canonicalize()
         errs = job.validate()
         if errs:
             raise ValueError("; ".join(errs))
+        index = self.raft_apply("job_register", dict(job=job, evals=[]))
+        if job.is_periodic() or job.is_parameterized():
+            return None
         ev = Evaluation(
             namespace=job.namespace, priority=job.priority, type=job.type,
-            triggered_by=TRIGGER_JOB_REGISTER, job_id=job.id,
+            triggered_by=triggered_by, job_id=job.id,
             status=EVAL_STATUS_PENDING)
-        index = self.raft_apply("job_register", dict(job=job, evals=[]))
         ev.job_modify_index = index
         ev.modify_index = index
         self.raft_apply("eval_update", dict(evals=[ev]))
+        return ev
+
+    def dispatch_job(self, namespace: str, job_id: str,
+                     payload: bytes = b"",
+                     meta: Optional[Dict[str, str]] = None) -> Evaluation:
+        """Job.Dispatch (nomad/job_endpoint.go Dispatch): instantiate a
+        parameterized job as a one-shot child with the given payload and
+        meta. Child ID is `<parent>/dispatch-<unix>-<rand>`."""
+        import os
+        meta = dict(meta or {})
+        parent = self.store.job_by_id(namespace, job_id)
+        if parent is None:
+            raise KeyError(f"job {job_id} not found")
+        if not parent.is_parameterized():
+            raise ValueError(f"job {job_id} is not parameterized")
+        if parent.stopped():
+            raise ValueError(f"job {job_id} is stopped")
+        cfg = parent.parameterized_job
+        if cfg.payload == "forbidden" and payload:
+            raise ValueError("payload forbidden by the parameterized job")
+        if cfg.payload == "required" and not payload:
+            raise ValueError("payload required by the parameterized job")
+        if len(payload) > 16 * 1024:
+            raise ValueError("payload exceeds the 16KiB maximum")
+        required = set(cfg.meta_required)
+        allowed = required | set(cfg.meta_optional)
+        missing = required - set(meta)
+        if missing:
+            raise ValueError(f"missing required meta keys: {sorted(missing)}")
+        unexpected = set(meta) - allowed
+        if unexpected:
+            raise ValueError(f"unpermitted meta keys: {sorted(unexpected)}")
+
+        child = parent.copy()
+        child.id = (f"{parent.id}/dispatch-{int(time.time())}-"
+                    f"{os.urandom(4).hex()}")
+        child.parent_id = parent.id
+        child.dispatched = True
+        child.payload = payload
+        child.meta = {**parent.meta, **meta}
+        child.status = ""
+        child.stable = False
+        child.version = 0
+        ev = self.register_job(child)
+        assert ev is not None
         return ev
 
     def deregister_job(self, namespace: str, job_id: str,

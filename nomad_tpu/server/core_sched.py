@@ -1,0 +1,166 @@
+"""CoreScheduler: the `_core` garbage-collection pseudo-scheduler.
+
+Reference semantics: nomad/core_sched.go — the leader periodically (and
+on `nomad system gc`, forced) enqueues `_core` evals whose JobID names
+the GC pass (eval-gc / job-gc / node-gc / deployment-gc). A worker
+dequeues them like any other eval and runs this scheduler, which deletes
+objects older than a threshold. "Older than" is expressed as a raft
+index cutoff obtained from the leader's TimeTable (nomad/timetable.go),
+so every GC decision is a pure function of indexes in the snapshot.
+
+Forced GC (`JobID == "force-gc"`) uses the max index as cutoff.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from ..models import Evaluation, JOB_STATUS_DEAD
+from ..models.evaluation import (
+    CORE_JOB_DEPLOYMENT_GC, CORE_JOB_EVAL_GC, CORE_JOB_FORCE_GC,
+    CORE_JOB_JOB_GC, CORE_JOB_NODE_GC,
+)
+
+LOG = logging.getLogger("nomad_tpu.core_sched")
+
+
+class CoreScheduler:
+    """Processes one `_core` eval against a state snapshot. Deletions go
+    through the server's raft_apply so they hit the WAL like any FSM op."""
+
+    def __init__(self, snapshot, server):
+        self.snap = snapshot
+        self.srv = server
+
+    # -- entry ---------------------------------------------------------
+    def process(self, ev: Evaluation) -> None:
+        job = ev.job_id
+        if job == CORE_JOB_EVAL_GC:
+            self._eval_gc(self._cutoff(self.srv.config.eval_gc_threshold_s))
+        elif job == CORE_JOB_JOB_GC:
+            self._job_gc(self._cutoff(self.srv.config.job_gc_threshold_s))
+        elif job == CORE_JOB_NODE_GC:
+            self._node_gc(self._cutoff(self.srv.config.node_gc_threshold_s))
+        elif job == CORE_JOB_DEPLOYMENT_GC:
+            self._deployment_gc(
+                self._cutoff(self.srv.config.deployment_gc_threshold_s))
+        elif job == CORE_JOB_FORCE_GC:
+            cutoff = 1 << 62
+            self._deployment_gc(cutoff)
+            self._eval_gc(cutoff)
+            self._job_gc(cutoff)
+            self._node_gc(cutoff)
+        else:
+            LOG.warning("unknown core gc job %r", job)
+
+    def _cutoff(self, threshold_s: float) -> int:
+        import time
+        return self.srv.time_table.nearest_index(time.time() - threshold_s)
+
+    # -- passes --------------------------------------------------------
+    def _eval_gc(self, cutoff: int) -> None:
+        """core_sched.go evalGC / gcEval: a terminal eval older than the
+        cutoff is collected together with its allocs, but only if every
+        alloc is itself GC-able (terminal on both desired+client axes).
+        Evals from live batch jobs are retained so reschedule history
+        survives (core_sched.go:186-200)."""
+        gc_evals: List[str] = []
+        gc_allocs: List[str] = []
+        for ev in self.snap.evals():
+            collect, allocs = self._gc_eval(ev, cutoff)
+            if collect:
+                gc_evals.append(ev.id)
+            gc_allocs.extend(allocs)
+        if gc_evals or gc_allocs:
+            LOG.info("eval GC: %d evals, %d allocs",
+                     len(gc_evals), len(gc_allocs))
+            self.srv.raft_apply("eval_delete",
+                                dict(eval_ids=gc_evals, alloc_ids=gc_allocs))
+
+    def _gc_eval(self, ev: Evaluation, cutoff: int):
+        if not ev.terminal_status() or ev.modify_index > cutoff:
+            return False, []
+        job = self.snap.job_by_id(ev.namespace, ev.job_id)
+        if ev.type == "batch":
+            # retain the eval (and its allocs) unless the job is gone
+            # or dead — reschedule tracking for batch reads old allocs
+            if job is not None and job.status != JOB_STATUS_DEAD:
+                return False, []
+        allocs = self.snap.allocs_by_eval(ev.id)
+        gc_allocs = []
+        all_gc = True
+        for a in allocs:
+            if self._alloc_gc_able(a, cutoff):
+                gc_allocs.append(a.id)
+            else:
+                all_gc = False
+        return all_gc, gc_allocs
+
+    @staticmethod
+    def _alloc_gc_able(alloc, cutoff: int) -> bool:
+        return (alloc.modify_index <= cutoff
+                and alloc.terminal_status()
+                and alloc.client_terminal_status())
+
+    def _job_gc(self, cutoff: int) -> None:
+        """core_sched.go jobGC: dead, old jobs whose every eval (and every
+        alloc) is GC-able are purged outright."""
+        for job in self.snap.jobs():
+            if job.status != JOB_STATUS_DEAD or job.modify_index > cutoff:
+                continue
+            if job.is_periodic() and not job.stopped():
+                continue
+            evals = self.snap.evals_by_job(job.namespace, job.id)
+            gc_evals, gc_allocs, all_gc = [], [], True
+            for ev in evals:
+                if ev.job_id != job.id:
+                    continue
+                ok, allocs = self._gc_eval(ev, cutoff)
+                if ok:
+                    gc_evals.append(ev.id)
+                    gc_allocs.extend(allocs)
+                else:
+                    all_gc = False
+            # allocs not attached to a collected eval block the job too
+            for a in self.snap.allocs_by_job(job.namespace, job.id):
+                if not self._alloc_gc_able(a, cutoff):
+                    all_gc = False
+            if not all_gc:
+                continue
+            LOG.info("job GC: %s/%s (+%d evals)", job.namespace, job.id,
+                     len(gc_evals))
+            if gc_evals or gc_allocs:
+                self.srv.raft_apply(
+                    "eval_delete", dict(eval_ids=gc_evals,
+                                        alloc_ids=gc_allocs))
+            self.srv.raft_apply(
+                "job_deregister", dict(namespace=job.namespace, job_id=job.id,
+                                       purge=True, evals=[]))
+
+    def _node_gc(self, cutoff: int) -> None:
+        """core_sched.go nodeGC: down nodes past the threshold with no
+        remaining (non-GC-able) allocs are deregistered."""
+        gc = []
+        for node in self.snap.nodes():
+            if not node.terminal_status() or node.modify_index > cutoff:
+                continue
+            allocs = self.snap.allocs_by_node(node.id)
+            if any(not a.terminal_status() for a in allocs):
+                continue
+            gc.append(node.id)
+        if gc:
+            LOG.info("node GC: %d nodes", len(gc))
+            self.srv.raft_apply("node_deregister", dict(node_ids=gc))
+
+    def _deployment_gc(self, cutoff: int) -> None:
+        """core_sched.go deploymentGC: terminal deployments past the
+        threshold are deleted (their allocs are handled by eval GC)."""
+        gc = []
+        for d in self.snap.deployments():
+            if d.active() or d.modify_index > cutoff:
+                continue
+            gc.append(d.id)
+        if gc:
+            LOG.info("deployment GC: %d deployments", len(gc))
+            self.srv.raft_apply("deployment_delete", dict(deployment_ids=gc))

@@ -1,0 +1,183 @@
+"""PeriodicDispatch: cron-style launcher for periodic jobs.
+
+Reference semantics: nomad/periodic.go — the leader tracks every
+periodic job (PeriodicDispatch.Add:208), keeps a heap of next launch
+times, and at each fire derives a child job named
+`<parent>/periodic-<unix>` (periodic.go deriveJob / structs.go
+JobPeriodicLaunchSuffix), records the launch in the periodic_launch
+table, and registers the child (creating a normal eval). prohibit_overlap
+skips a launch while a previous child is non-terminal. ForceRun backs
+`nomad job periodic force`.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..models import Evaluation, Job, JOB_STATUS_DEAD, EVAL_STATUS_PENDING
+from ..models.evaluation import TRIGGER_PERIODIC_JOB
+from ..utils.cron import Cron, CronParseError
+
+LOG = logging.getLogger("nomad_tpu.periodic")
+
+PERIODIC_LAUNCH_SUFFIX = "/periodic-"
+
+
+class PeriodicDispatch:
+    def __init__(self, server):
+        self.srv = server
+        self._lock = threading.Lock()
+        self._tracked: Dict[Tuple[str, str], Job] = {}
+        # heap entries carry a generation; re-adding a job bumps the
+        # generation so stale entries are discarded on pop instead of
+        # firing duplicate launches (periodic.go Add updates in place)
+        self._gen: Dict[Tuple[str, str], int] = {}
+        self._heap: List[Tuple[float, Tuple[str, str], int]] = []
+        self._wake = threading.Condition(self._lock)
+        self._enabled = False
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+
+    # -- lifecycle (leader.go enables on leadership) -------------------
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                self._tracked.clear()
+                self._heap.clear()
+            self._wake.notify_all()
+        if enabled and self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="periodic-dispatch")
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._enabled = False
+            self._wake.notify_all()
+
+    # -- tracking ------------------------------------------------------
+    def add(self, job: Job) -> None:
+        """Track (or retrack) a periodic job; untrack if it stopped being
+        periodic (periodic.go Add:208)."""
+        with self._lock:
+            if not self._enabled:
+                return
+            key = (job.namespace, job.id)
+            gen = self._gen.get(key, 0) + 1
+            self._gen[key] = gen
+            if not job.is_periodic() or job.stopped():
+                self._tracked.pop(key, None)
+                return
+            self._tracked[key] = job
+            nxt = self._next_launch(job, time.time())
+            if nxt > 0:
+                heapq.heappush(self._heap, (nxt, key, gen))
+                self._wake.notify_all()
+
+    def remove(self, namespace: str, job_id: str) -> None:
+        with self._lock:
+            key = (namespace, job_id)
+            self._tracked.pop(key, None)
+            self._gen[key] = self._gen.get(key, 0) + 1
+
+    def tracked(self) -> List[Job]:
+        with self._lock:
+            return list(self._tracked.values())
+
+    @staticmethod
+    def _next_launch(job: Job, after: float) -> float:
+        try:
+            return Cron(job.periodic.spec).next_after(after)
+        except CronParseError:
+            LOG.warning("job %s has invalid cron %r", job.id,
+                        job.periodic.spec)
+            return 0.0
+
+    # -- firing --------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                if not self._enabled or not self._heap:
+                    self._wake.wait(0.2)
+                    continue
+                when, key, gen = self._heap[0]
+                now = time.time()
+                if when > now:
+                    self._wake.wait(min(when - now, 0.5))
+                    continue
+                heapq.heappop(self._heap)
+                if self._gen.get(key) != gen:
+                    continue  # superseded by a newer add/remove
+                job = self._tracked.get(key)
+            if job is None:
+                continue
+            try:
+                self.force_run(job.namespace, job.id, launch_time=when)
+            except Exception:
+                LOG.exception("periodic launch of %s failed", key)
+            with self._lock:
+                job = self._tracked.get(key)
+                if job is not None and self._gen.get(key) == gen:
+                    # compute from now, not the scheduled time: missed
+                    # windows (suspend, stall) are skipped, not burst-
+                    # replayed (periodic.go nextLaunch from time.Now())
+                    nxt = self._next_launch(job, max(when, time.time()))
+                    if nxt > 0:
+                        heapq.heappush(self._heap, (nxt, key, gen))
+
+    def force_run(self, namespace: str, job_id: str,
+                  launch_time: float = 0.0) -> Optional[Evaluation]:
+        """Launch one instance now (periodic.go ForceRun / createEval).
+        Returns the eval for the child job, or None if skipped."""
+        launch_time = launch_time or time.time()
+        job = self.srv.store.job_by_id(namespace, job_id)
+        if job is None or not job.is_periodic() or job.stopped():
+            raise ValueError(f"job {job_id} is not a tracked periodic job")
+        if job.periodic.prohibit_overlap and self._has_running_child(job):
+            LOG.info("skipping launch of %s: prohibit_overlap and a child "
+                     "is still running", job_id)
+            return None
+        # duplicate-launch guard: child IDs are stamped with whole
+        # seconds, so a second launch in the same second would clobber
+        # the first child (periodic.go createEval checks the
+        # periodic_launch table the same way)
+        last = self.srv.store.periodic_launch(namespace, job_id)
+        if last is not None and int(last) >= int(launch_time):
+            LOG.info("skipping launch of %s: already launched at %d",
+                     job_id, int(last))
+            return None
+        child = self.derive_job(job, launch_time)
+        ev = self.srv.register_job(child, triggered_by=TRIGGER_PERIODIC_JOB)
+        self.srv.raft_apply("periodic_launch",
+                            dict(namespace=namespace, job_id=job_id,
+                                 launch_time=launch_time))
+        return ev
+
+    def _has_running_child(self, parent: Job) -> bool:
+        for child in self.srv.store.jobs_by_parent(parent.namespace,
+                                                   parent.id):
+            if child.status != JOB_STATUS_DEAD:
+                return True
+        return False
+
+    @staticmethod
+    def derive_job(parent: Job, launch_time: float) -> Job:
+        """periodic.go deriveJob: a copy with the launch-stamped ID, the
+        parent link, and the periodic stanza stripped so the child is an
+        ordinary one-shot job."""
+        child = parent.copy()
+        child.id = f"{parent.id}{PERIODIC_LAUNCH_SUFFIX}{int(launch_time)}"
+        child.parent_id = parent.id
+        child.periodic = None
+        child.status = ""
+        child.stable = False
+        child.version = 0
+        return child

@@ -95,8 +95,9 @@ class RaftLog:
             self._f = None
 
     def append(self, index: int, msg_type: str, payload: dict) -> None:
+        import time as _time
         frame = msgpack.packb(
-            {"i": index, "t": msg_type,
+            {"i": index, "t": msg_type, "ts": _time.time(),
              "p": encode_payload(msg_type, payload)},
             use_bin_type=True)
         with self._l:
@@ -124,7 +125,8 @@ class RaftLog:
                     decoded = decode_payload(entry["t"], entry["p"])
                 except Exception:
                     break  # corrupt frame: treat like a torn tail
-                out.append((entry["i"], entry["t"], decoded))
+                out.append((entry["i"], entry["t"], decoded,
+                            entry.get("ts", 0.0)))
                 self._good_offset = f.tell()
         return out
 
@@ -147,6 +149,10 @@ class Persistence:
         self.log = RaftLog(os.path.join(data_dir, self.WAL))
         self._since_snapshot = 0
         self._l = threading.Lock()
+        # server-level state (e.g. the GC TimeTable) rides along in the
+        # snapshot under "extra"; the provider is set by the Server
+        self.extra_provider = None
+        self.restored_extra: dict = {}
 
     @property
     def snapshot_path(self) -> str:
@@ -161,6 +167,7 @@ class Persistence:
                 data = msgpack.unpackb(f.read(), raw=False,
                                        strict_map_key=False)
             # snapshot index tuples were listified by msgpack
+            self.restored_extra = data.pop("extra", {}) or {}
             store.restore(data)
             highest = store.latest_index()
         entries = self.log.replay()
@@ -182,6 +189,8 @@ class Persistence:
 
     def snapshot(self, store) -> None:
         data = store.dump()
+        if self.extra_provider is not None:
+            data["extra"] = self.extra_provider()
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(msgpack.packb(data, use_bin_type=True))

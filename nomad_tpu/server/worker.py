@@ -15,7 +15,7 @@ import threading
 import time
 from typing import List, Optional
 
-from ..models import Evaluation, Plan, PlanResult
+from ..models import Evaluation, JOB_TYPE_CORE, Plan, PlanResult
 from ..scheduler import new_scheduler
 
 LOG = logging.getLogger("nomad_tpu.worker")
@@ -80,7 +80,13 @@ class Worker:
             snap = self.server.store.snapshot_min_index(
                 ev.modify_index, timeout_s=RAFT_SYNC_LIMIT)
             self._snapshot_index = snap.latest_index()
-            sched = new_scheduler(self._scheduler_for(ev), snap, self)
+            if ev.type == JOB_TYPE_CORE:
+                # worker.go invokeScheduler: _core evals get the GC
+                # pseudo-scheduler, not a placement scheduler
+                from .core_sched import CoreScheduler
+                sched = CoreScheduler(snap, self.server)
+            else:
+                sched = new_scheduler(self._scheduler_for(ev), snap, self)
             sched.process(ev)
             self.server.eval_broker.ack(ev.id, token)
             self.stats["processed"] += 1

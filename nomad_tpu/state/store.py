@@ -188,6 +188,18 @@ class StateSnapshot:
             return None
         return max(ds, key=lambda d: d.create_index)
 
+    # -- periodic launches ---------------------------------------------
+    def periodic_launch(self, namespace: str, job_id: str) -> Optional[float]:
+        """Last launch time for a periodic job (periodic_launch table)."""
+        return self._root.table("periodic_launches").get((namespace, job_id))
+
+    def periodic_launches(self) -> Dict[Tuple[str, str], float]:
+        return dict(self._root.table("periodic_launches").items())
+
+    # -- children (periodic / dispatch) --------------------------------
+    def jobs_by_parent(self, namespace: str, parent_id: str) -> List[Job]:
+        return [j for j in self.jobs(namespace) if j.parent_id == parent_id]
+
     # -- config --------------------------------------------------------
     def scheduler_config(self) -> SchedulerConfiguration:
         return (self._root.table("scheduler_config").get("config")
@@ -353,6 +365,11 @@ class StateStore(StateSnapshot):
             root = root.with_table("job_versions",
                                    root.table("job_versions").set(key, versions))
             root = self._ensure_job_summary(root, index, job)
+            if job.parent_id:
+                root = self._bump_parent_children(
+                    root, index, (job.namespace, job.parent_id),
+                    existing.status if existing is not None else None,
+                    job.status)
             root = root.with_index("jobs", index)
             self._publish(root)
 
@@ -360,7 +377,14 @@ class StateStore(StateSnapshot):
         with self._lock:
             root = self._root
             key = (namespace, job_id)
+            existing = root.table("jobs").get(key)
+            if existing is not None and existing.parent_id:
+                root = self._bump_parent_children(
+                    root, index, (namespace, existing.parent_id),
+                    existing.status, None)
             root = root.with_table("jobs", root.table("jobs").delete(key))
+            root = root.with_table("periodic_launches",
+                                   root.table("periodic_launches").delete(key))
             root = root.with_table("job_versions",
                                    root.table("job_versions").delete(key))
             root = root.with_table("job_summaries",
@@ -663,6 +687,41 @@ class StateStore(StateSnapshot):
                         .with_index("evals", index))
             self._publish(root)
 
+    # -- periodic launches ---------------------------------------------
+    def upsert_periodic_launch(self, index: int, namespace: str, job_id: str,
+                               launch_time: float) -> None:
+        with self._lock:
+            root = self._root
+            t = root.table("periodic_launches")
+            root = root.with_table("periodic_launches",
+                                   t.set((namespace, job_id), launch_time))
+            root = root.with_index("periodic_launches", index)
+            self._publish(root)
+
+    def delete_periodic_launch(self, index: int, namespace: str,
+                               job_id: str) -> None:
+        with self._lock:
+            root = self._root
+            t = root.table("periodic_launches").delete((namespace, job_id))
+            root = root.with_table("periodic_launches", t)
+            root = root.with_index("periodic_launches", index)
+            self._publish(root)
+
+    # -- deployments GC ------------------------------------------------
+    def delete_deployments(self, index: int, deployment_ids: List[str]) -> None:
+        with self._lock:
+            root = self._root
+            for did in deployment_ids:
+                d = root.table("deployments").get(did)
+                if d is None:
+                    continue
+                root = root.with_table("deployments",
+                                       root.table("deployments").delete(did))
+                root = self._index_del(root, "deployments_by_job",
+                                       (d.namespace, d.job_id), did)
+            root = root.with_index("deployments", index)
+            self._publish(root)
+
     # -- scheduler config ---------------------------------------------
     def set_scheduler_config(self, index: int,
                              config: SchedulerConfiguration) -> None:
@@ -695,6 +754,9 @@ class StateStore(StateSnapshot):
                                   root.table("job_summaries").values()]
         cfg = root.table("scheduler_config").get("config")
         plain["scheduler_config"] = to_wire(cfg) if cfg else None
+        plain["periodic_launches"] = [
+            {"key": list(k), "launch_time": v}
+            for k, v in root.table("periodic_launches").items()]
         return out
 
     def restore(self, data: dict) -> None:
@@ -759,6 +821,11 @@ class StateStore(StateSnapshot):
                 t = t.set((s.namespace, s.job_id), s)
             root = root.with_table("job_summaries", t)
 
+            t = root.table("periodic_launches")
+            for entry in data["tables"].get("periodic_launches", []):
+                t = t.set(tuple(entry["key"]), entry["launch_time"])
+            root = root.with_table("periodic_launches", t)
+
             cfg = data["tables"].get("scheduler_config")
             if cfg:
                 root = root.with_table(
@@ -779,8 +846,78 @@ class StateStore(StateSnapshot):
             job = root.table("jobs").get(key)
             if job is None:
                 return
+            old_status = job.status
             job = replace(job, status=status, status_description=description,
                           modify_index=index)
             root = root.with_table("jobs", root.table("jobs").set(key, job))
             root = root.with_index("jobs", index)
+            if job.parent_id and old_status != status:
+                root = self._bump_parent_children(
+                    root, index, (namespace, job.parent_id), old_status, status)
             self._publish(root)
+
+    def derive_job_status(self, namespace: str, job_id: str) -> Optional[str]:
+        """Compute what a job's status should be from its allocs + evals
+        (state_store.go getJobStatus): stop -> dead; any non-terminal
+        alloc -> running; any non-terminal eval -> pending; periodic /
+        parameterized parents idle at running; else dead once it has
+        history, pending when brand new."""
+        job = self.job_by_id(namespace, job_id)
+        if job is None:
+            return None
+        if job.stop:
+            return JOB_STATUS_DEAD
+        allocs = self.allocs_by_job(namespace, job_id)
+        for a in allocs:
+            if not a.terminal_status():
+                return JOB_STATUS_RUNNING
+        evals = self.evals_by_job(namespace, job_id)
+        has_eval = False
+        for e in evals:
+            if e.job_id != job_id:
+                continue
+            has_eval = True
+            if not e.terminal_status():
+                return JOB_STATUS_PENDING
+        if (job.periodic is not None and job.periodic.enabled) or \
+                (job.parameterized_job is not None and not job.dispatched):
+            return JOB_STATUS_RUNNING
+        if allocs or has_eval:
+            return JOB_STATUS_DEAD
+        return JOB_STATUS_PENDING
+
+    def reconcile_job_status(self, index: int, namespace: str,
+                             job_id: str) -> None:
+        want = self.derive_job_status(namespace, job_id)
+        job = self.job_by_id(namespace, job_id)
+        if want is None or job is None or job.status == want:
+            return
+        self.set_job_status(index, namespace, job_id, want)
+
+    @staticmethod
+    def _children_bucket(status: str) -> Optional[str]:
+        return {JOB_STATUS_PENDING: "children_pending",
+                JOB_STATUS_RUNNING: "children_running",
+                JOB_STATUS_DEAD: "children_dead"}.get(status)
+
+    def _bump_parent_children(self, root: _Root, index: int, parent_key,
+                              old_status: Optional[str],
+                              new_status: Optional[str]) -> _Root:
+        """Maintain the parent JobSummary children counters
+        (state_store.go setJobSummary children accounting)."""
+        summaries = root.table("job_summaries")
+        s: Optional[JobSummary] = summaries.get(parent_key)
+        if s is None:
+            return root
+        ob = self._children_bucket(old_status) if old_status else None
+        nb = self._children_bucket(new_status) if new_status else None
+        if ob == nb:
+            return root
+        changes = {}
+        if ob is not None:
+            changes[ob] = max(0, getattr(s, ob) - 1)
+        if nb is not None:
+            changes[nb] = getattr(s, nb) + 1
+        s = replace(s, modify_index=index, **changes)
+        return root.with_table("job_summaries", summaries.set(parent_key, s)) \
+                   .with_index("job_summaries", index)

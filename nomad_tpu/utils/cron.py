@@ -1,0 +1,135 @@
+"""Minimal 5-field cron evaluator for periodic jobs.
+
+Reference semantics: nomad/periodic.go uses gorhill/cronexpr to compute
+`Next(fromTime)` for a PeriodicConfig spec (periodic.go Next / structs.go
+PeriodicConfig.Next). This is a dependency-free equivalent supporting the
+standard minute hour day-of-month month day-of-week fields with
+`*`, lists, ranges, and `*/step`, plus the `@hourly/@daily/@weekly`
+shorthands. Times are UTC (PeriodicConfig.timezone other than UTC is
+rejected at validate time in round 1).
+"""
+
+from __future__ import annotations
+
+import calendar
+from datetime import datetime, timedelta, timezone
+from typing import List, Sequence
+
+_SHORTHAND = {
+    "@minutely": "* * * * *",
+    "@hourly": "0 * * * *",
+    "@daily": "0 0 * * *",
+    "@midnight": "0 0 * * *",
+    "@weekly": "0 0 * * 0",
+    "@monthly": "0 0 1 * *",
+    "@yearly": "0 0 1 1 *",
+    "@annually": "0 0 1 1 *",
+}
+
+_RANGES = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 6))
+
+_MONTH_NAMES = {name.lower(): i for i, name in
+                enumerate(calendar.month_abbr) if name}
+_DAY_NAMES = {name.lower(): (i + 1) % 7 for i, name in
+              enumerate(calendar.day_abbr)}  # mon=1 .. sun=0
+
+
+class CronParseError(ValueError):
+    pass
+
+
+def _parse_field(field: str, lo: int, hi: int, names: dict) -> List[int]:
+    out = set()
+    for part in field.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise CronParseError(f"bad step {step_s!r}")
+            if step <= 0:
+                raise CronParseError(f"bad step {step}")
+        if part in ("*", ""):
+            start, end = lo, hi
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            start, end = _num(a, names), _num(b, names)
+        else:
+            start = end = _num(part, names)
+            if step > 1:
+                end = hi
+        if start < lo or end > hi or start > end:
+            raise CronParseError(f"field {field!r} out of range [{lo},{hi}]")
+        out.update(range(start, end + 1, step))
+    return sorted(out)
+
+
+def _num(tok: str, names: dict) -> int:
+    t = tok.strip().lower()
+    if t in names:
+        return names[t]
+    try:
+        n = int(t)
+    except ValueError:
+        raise CronParseError(f"bad value {tok!r}")
+    # cron allows 7 for sunday in day-of-week
+    if names is _DAY_NAMES and n == 7:
+        return 0
+    return n
+
+
+class Cron:
+    def __init__(self, spec: str):
+        spec = spec.strip()
+        spec = _SHORTHAND.get(spec, spec)
+        fields = spec.split()
+        if len(fields) != 5:
+            raise CronParseError(
+                f"cron spec needs 5 fields, got {len(fields)}: {spec!r}")
+        self.minutes = _parse_field(fields[0], 0, 59, {})
+        self.hours = _parse_field(fields[1], 0, 23, {})
+        self.doms = _parse_field(fields[2], 1, 31, {})
+        self.months = _parse_field(fields[3], 1, 12, _MONTH_NAMES)
+        self.dows = _parse_field(fields[4], 0, 6, _DAY_NAMES)
+        self._dom_star = fields[2] == "*"
+        self._dow_star = fields[4] == "*"
+
+    def _day_match(self, dt: datetime) -> bool:
+        dom_ok = dt.day in self.doms
+        dow_ok = ((dt.weekday() + 1) % 7) in self.dows  # python mon=0
+        # standard cron: if both dom and dow are restricted, match either
+        if not self._dom_star and not self._dow_star:
+            return dom_ok or dow_ok
+        return dom_ok and dow_ok
+
+    def next_after(self, after_unix: float) -> float:
+        """Smallest fire time strictly greater than after_unix (UTC).
+        Returns 0.0 if none within ~5 years (mirrors PeriodicConfig.Next
+        returning the zero time on no-match)."""
+        dt = datetime.fromtimestamp(int(after_unix), tz=timezone.utc)
+        dt = dt.replace(second=0, microsecond=0) + timedelta(minutes=1)
+        limit = dt + timedelta(days=5 * 366)
+        while dt < limit:
+            if dt.month not in self.months:
+                # jump to the 1st of the next month
+                y, m = dt.year, dt.month + 1
+                if m > 12:
+                    y, m = y + 1, 1
+                dt = dt.replace(year=y, month=m, day=1, hour=0, minute=0)
+                continue
+            if not self._day_match(dt):
+                dt = (dt + timedelta(days=1)).replace(hour=0, minute=0)
+                continue
+            if dt.hour not in self.hours:
+                dt = (dt + timedelta(hours=1)).replace(minute=0)
+                continue
+            if dt.minute not in self.minutes:
+                dt = dt + timedelta(minutes=1)
+                continue
+            return dt.timestamp()
+        return 0.0
+
+
+def next_launch(spec: str, after_unix: float) -> float:
+    return Cron(spec).next_after(after_unix)

@@ -1,0 +1,60 @@
+"""TimeTable: sparse mapping between wall-clock time and raft index.
+
+Reference semantics: nomad/timetable.go — the leader witnesses
+(index, time) pairs at a bounded granularity; GC converts "older than
+threshold duration" into "index <= NearestIndex(now - threshold)" so all
+GC decisions are pure functions of raft indexes.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import List, Tuple
+
+
+class TimeTable:
+    def __init__(self, granularity_s: float = 1.0, limit: int = 72 * 3600):
+        self._granularity = granularity_s
+        self._limit = limit           # max entries retained
+        self._lock = threading.Lock()
+        self._times: List[float] = []
+        self._indexes: List[int] = []
+
+    def witness(self, index: int, when: float = 0.0) -> None:
+        when = when or time.time()
+        with self._lock:
+            if self._times and when - self._times[-1] < self._granularity:
+                return
+            self._times.append(when)
+            self._indexes.append(index)
+            if len(self._times) > self._limit:
+                self._times = self._times[-self._limit:]
+                self._indexes = self._indexes[-self._limit:]
+
+    def nearest_index(self, when: float) -> int:
+        """Largest witnessed index at-or-before `when` (0 if none)."""
+        with self._lock:
+            i = bisect.bisect_right(self._times, when)
+            if i == 0:
+                return 0
+            return self._indexes[i - 1]
+
+    def nearest_time(self, index: int) -> float:
+        with self._lock:
+            i = bisect.bisect_right(self._indexes, index)
+            if i == 0:
+                return 0.0
+            return self._times[i - 1]
+
+    # -- persistence (nomad persists the timetable in FSM snapshots so
+    #    GC cutoffs survive restarts, fsm.go persistTimeTable) ---------
+    def dump(self) -> List[Tuple[float, int]]:
+        with self._lock:
+            return list(zip(self._times, self._indexes))
+
+    def restore(self, entries) -> None:
+        with self._lock:
+            self._times = [float(t) for t, _ in entries]
+            self._indexes = [int(i) for _, i in entries]

@@ -1,0 +1,278 @@
+"""CoreScheduler GC, PeriodicDispatch, and parameterized dispatch.
+
+Reference scenarios: nomad/core_sched_test.go, nomad/periodic_test.go,
+nomad/job_endpoint_test.go (dispatch), utils/cron vs gorhill/cronexpr.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu.mock import fixtures as mock
+from nomad_tpu.models import (
+    Allocation, Evaluation, JOB_STATUS_DEAD, JOB_STATUS_RUNNING,
+    NODE_STATUS_DOWN,
+)
+from nomad_tpu.models.evaluation import (
+    CORE_JOB_FORCE_GC, EVAL_STATUS_COMPLETE,
+)
+from nomad_tpu.models.job import ParameterizedJobConfig, PeriodicConfig
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.core_sched import CoreScheduler
+from nomad_tpu.server.periodic import PeriodicDispatch
+from nomad_tpu.utils.cron import Cron, CronParseError
+
+
+# ---------------------------------------------------------------- cron
+def test_cron_every_minute():
+    c = Cron("* * * * *")
+    # 2026-01-01 00:00:30 UTC -> next minute boundary
+    t = 1767225630.0
+    nxt = c.next_after(t)
+    assert nxt == 1767225660.0
+
+
+def test_cron_hourly_and_shorthand():
+    base = 1767225630.0  # 00:00:30 UTC
+    assert Cron("0 * * * *").next_after(base) == Cron("@hourly").next_after(base)
+    nxt = Cron("30 2 * * *").next_after(base)
+    lt = time.gmtime(nxt)
+    assert (lt.tm_hour, lt.tm_min) == (2, 30)
+
+
+def test_cron_step_and_range():
+    c = Cron("*/15 * * * *")
+    nxt = c.next_after(1767225660.0)  # 00:01:00
+    assert time.gmtime(nxt).tm_min == 15
+    c2 = Cron("0 9-17 * * mon-fri")
+    nxt2 = c2.next_after(1767225600.0)  # thu jan 1 2026
+    lt = time.gmtime(nxt2)
+    assert lt.tm_hour == 9 and lt.tm_wday < 5
+
+
+def test_cron_invalid():
+    with pytest.raises(CronParseError):
+        Cron("61 * * * *")
+    with pytest.raises(CronParseError):
+        Cron("* * *")
+
+
+# ------------------------------------------------------------------ GC
+def _terminal_eval(job):
+    ev = mock.evaluation()
+    ev.job_id = job.id
+    ev.namespace = job.namespace
+    ev.status = EVAL_STATUS_COMPLETE
+    return ev
+
+
+def test_eval_gc_collects_terminal_evals():
+    srv = Server(ServerConfig(num_schedulers=0, eval_gc_threshold_s=0.0))
+    srv.time_table._granularity = 0.0
+    job = mock.job()
+    job.stop = True
+    srv.raft_apply("job_register", dict(job=job, evals=[]))
+    ev = _terminal_eval(job)
+    srv.raft_apply("eval_update", dict(evals=[ev]))
+    alloc = mock.alloc()
+    alloc.job_id, alloc.namespace = job.id, job.namespace
+    alloc.eval_id = ev.id
+    alloc.desired_status = "stop"
+    alloc.client_status = "complete"
+    srv.raft_apply(
+        "plan_results",
+        dict(allocs_stopped=[], allocs_placed=[alloc], allocs_preempted=[]))
+
+    CoreScheduler(srv.store.snapshot(), srv).process(
+        Evaluation(type="_core", job_id="eval-gc"))
+    assert srv.store.eval_by_id(ev.id) is None
+    assert srv.store.alloc_by_id(alloc.id) is None
+
+
+def test_eval_gc_spares_running_allocs():
+    srv = Server(ServerConfig(num_schedulers=0, eval_gc_threshold_s=0.0))
+    srv.time_table._granularity = 0.0
+    job = mock.job()
+    srv.raft_apply("job_register", dict(job=job, evals=[]))
+    ev = _terminal_eval(job)
+    srv.raft_apply("eval_update", dict(evals=[ev]))
+    alloc = mock.alloc()
+    alloc.job_id, alloc.namespace = job.id, job.namespace
+    alloc.eval_id = ev.id
+    alloc.client_status = "running"
+    srv.raft_apply(
+        "plan_results",
+        dict(allocs_stopped=[], allocs_placed=[alloc], allocs_preempted=[]))
+
+    CoreScheduler(srv.store.snapshot(), srv).process(
+        Evaluation(type="_core", job_id="eval-gc"))
+    assert srv.store.eval_by_id(ev.id) is not None
+    assert srv.store.alloc_by_id(alloc.id) is not None
+
+
+def test_job_gc_purges_dead_jobs():
+    srv = Server(ServerConfig(num_schedulers=0, job_gc_threshold_s=0.0))
+    srv.time_table._granularity = 0.0
+    job = mock.job()
+    job.stop = True
+    srv.raft_apply("job_register", dict(job=job, evals=[]))
+    assert srv.store.job_by_id(job.namespace, job.id).status == JOB_STATUS_DEAD
+
+    CoreScheduler(srv.store.snapshot(), srv).process(
+        Evaluation(type="_core", job_id="job-gc"))
+    assert srv.store.job_by_id(job.namespace, job.id) is None
+
+
+def test_node_gc_removes_old_down_nodes():
+    srv = Server(ServerConfig(num_schedulers=0, node_gc_threshold_s=0.0))
+    srv.time_table._granularity = 0.0
+    node = mock.node()
+    srv.raft_apply("node_register", dict(node=node))
+    srv.raft_apply("node_status_update",
+                   dict(node_id=node.id, status=NODE_STATUS_DOWN))
+
+    CoreScheduler(srv.store.snapshot(), srv).process(
+        Evaluation(type="_core", job_id="node-gc"))
+    assert srv.store.node_by_id(node.id) is None
+
+
+def test_force_gc_runs_every_pass():
+    srv = Server(ServerConfig(num_schedulers=0))
+    job = mock.job()
+    job.stop = True
+    srv.raft_apply("job_register", dict(job=job, evals=[]))
+    node = mock.node()
+    srv.raft_apply("node_register", dict(node=node))
+    srv.raft_apply("node_status_update",
+                   dict(node_id=node.id, status=NODE_STATUS_DOWN))
+    # force GC ignores thresholds entirely
+    CoreScheduler(srv.store.snapshot(), srv).process(
+        Evaluation(type="_core", job_id=CORE_JOB_FORCE_GC))
+    assert srv.store.job_by_id(job.namespace, job.id) is None
+    assert srv.store.node_by_id(node.id) is None
+
+
+# ------------------------------------------------------------ periodic
+def _periodic_job():
+    job = mock.job()
+    job.type = "batch"
+    job.periodic = PeriodicConfig(enabled=True, spec="* * * * *")
+    for tg in job.task_groups:
+        tg.count = 1
+    return job
+
+
+def test_periodic_register_creates_no_eval_and_tracks():
+    srv = Server(ServerConfig(num_schedulers=0))
+    srv.establish_leadership()
+    job = _periodic_job()
+    ev = srv.register_job(job)
+    assert ev is None
+    tracked = srv.periodic.tracked()
+    assert [j.id for j in tracked] == [job.id]
+    # periodic parents idle at running status
+    assert srv.store.job_by_id(job.namespace, job.id).status == JOB_STATUS_RUNNING
+
+
+def test_periodic_force_run_derives_child():
+    srv = Server(ServerConfig(num_schedulers=0))
+    srv.establish_leadership()
+    job = _periodic_job()
+    srv.register_job(job)
+    ev = srv.periodic.force_run(job.namespace, job.id)
+    assert ev is not None
+    child = srv.store.job_by_id(job.namespace, ev.job_id)
+    assert child is not None
+    assert child.parent_id == job.id
+    assert child.periodic is None
+    assert child.id.startswith(job.id + "/periodic-")
+    assert srv.store.periodic_launch(job.namespace, job.id) is not None
+    # parent summary counts the child
+    summary = srv.store.job_summary(job.namespace, job.id)
+    assert summary.children_pending + summary.children_running >= 1
+
+
+def test_periodic_prohibit_overlap_skips():
+    srv = Server(ServerConfig(num_schedulers=0))
+    srv.establish_leadership()
+    job = _periodic_job()
+    job.periodic.prohibit_overlap = True
+    srv.register_job(job)
+    first = srv.periodic.force_run(job.namespace, job.id)
+    assert first is not None
+    # child still pending -> second launch skipped
+    second = srv.periodic.force_run(job.namespace, job.id)
+    assert second is None
+
+
+def test_periodic_fires_on_schedule():
+    srv = Server(ServerConfig(num_schedulers=0))
+    srv.establish_leadership()
+    job = _periodic_job()
+    srv.register_job(job)
+    # drop a next-launch in the immediate past directly into the heap
+    with srv.periodic._lock:
+        srv.periodic._heap.clear()
+        import heapq
+        key = (job.namespace, job.id)
+        heapq.heappush(srv.periodic._heap,
+                       (time.time() - 1, key, srv.periodic._gen[key]))
+        srv.periodic._wake.notify_all()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        children = srv.store.jobs_by_parent(job.namespace, job.id)
+        if children:
+            break
+        time.sleep(0.05)
+    assert srv.store.jobs_by_parent(job.namespace, job.id)
+    srv.shutdown()
+
+
+# ------------------------------------------------------------ dispatch
+def _parameterized_job():
+    job = mock.job()
+    job.type = "batch"
+    job.parameterized_job = ParameterizedJobConfig(
+        payload="optional", meta_required=["who"], meta_optional=["color"])
+    return job
+
+
+def test_dispatch_creates_child_with_payload_and_meta():
+    srv = Server(ServerConfig(num_schedulers=0))
+    job = _parameterized_job()
+    assert srv.register_job(job) is None
+    ev = srv.dispatch_job(job.namespace, job.id, payload=b"hello",
+                          meta={"who": "world"})
+    child = srv.store.job_by_id(job.namespace, ev.job_id)
+    assert child.dispatched
+    assert child.parent_id == job.id
+    assert child.payload == b"hello"
+    assert child.meta["who"] == "world"
+    assert child.id.startswith(job.id + "/dispatch-")
+    # the child DID get an eval
+    assert ev.job_id == child.id
+
+
+def test_dispatch_validates_meta_and_payload():
+    srv = Server(ServerConfig(num_schedulers=0))
+    job = _parameterized_job()
+    srv.register_job(job)
+    with pytest.raises(ValueError, match="required meta"):
+        srv.dispatch_job(job.namespace, job.id)
+    with pytest.raises(ValueError, match="unpermitted"):
+        srv.dispatch_job(job.namespace, job.id,
+                         meta={"who": "x", "nope": "y"})
+    job2 = _parameterized_job()
+    job2.id = "forbid"
+    job2.parameterized_job = ParameterizedJobConfig(payload="forbidden")
+    srv.register_job(job2)
+    with pytest.raises(ValueError, match="forbidden"):
+        srv.dispatch_job(job2.namespace, job2.id, payload=b"x")
+
+
+def test_dispatch_rejects_non_parameterized():
+    srv = Server(ServerConfig(num_schedulers=0))
+    job = mock.job()
+    srv.register_job(job)
+    with pytest.raises(ValueError, match="not parameterized"):
+        srv.dispatch_job(job.namespace, job.id)
