@@ -89,6 +89,41 @@ class ApiClient:
                              {"DrainSpec": spec,
                               "MarkEligible": mark_eligible})
 
+    def job_deployments(self, job_id: str) -> list:
+        return self._request("GET", f"/v1/job/{job_id}/deployments")
+
+    def job_versions(self, job_id: str) -> list:
+        return self._request("GET", f"/v1/job/{job_id}/versions")
+
+    def revert_job(self, job_id: str, version: int) -> dict:
+        return self._request("POST", f"/v1/job/{job_id}/revert",
+                             {"JobID": job_id, "JobVersion": version})
+
+    # -- deployments ---------------------------------------------------
+    def list_deployments(self, prefix: str = "") -> list:
+        return self._request("GET", "/v1/deployments",
+                             params={"prefix": prefix} if prefix else None)
+
+    def get_deployment(self, deployment_id: str) -> dict:
+        return self._request("GET", f"/v1/deployment/{deployment_id}")
+
+    def deployment_allocations(self, deployment_id: str) -> list:
+        return self._request("GET",
+                             f"/v1/deployment/allocations/{deployment_id}")
+
+    def promote_deployment(self, deployment_id: str,
+                           groups: Optional[list] = None) -> dict:
+        return self._request("POST", f"/v1/deployment/promote/{deployment_id}",
+                             {"DeploymentID": deployment_id, "Groups": groups})
+
+    def fail_deployment(self, deployment_id: str) -> dict:
+        return self._request("POST", f"/v1/deployment/fail/{deployment_id}",
+                             {})
+
+    def pause_deployment(self, deployment_id: str, pause: bool) -> dict:
+        return self._request("POST", f"/v1/deployment/pause/{deployment_id}",
+                             {"Pause": pause})
+
     # -- allocs / evals ------------------------------------------------
     def get_allocation(self, alloc_id: str) -> dict:
         return self._request("GET", f"/v1/allocation/{alloc_id}")

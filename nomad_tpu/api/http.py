@@ -224,6 +224,51 @@ class HTTPApiServer:
                 return None
             return to_wire(alloc), idx
 
+        if path == "/v1/deployments" and method == "GET":
+            prefix = q.get("prefix", "")
+            return [to_wire(d) for d in store.deployments()
+                    if d.id.startswith(prefix)], idx
+
+        m = re.match(r"^/v1/deployment/([^/]+)/([^/]+)$", path)
+        if m:
+            action = m.group(1)
+            d = self._unique_prefix(store.deployments(), m.group(2),
+                                    "deployment")
+            if d is None:
+                return None
+            if action == "allocations" and method == "GET":
+                return [a.stub()
+                        for a in store.allocs_by_deployment(d.id)], idx
+            if method in ("PUT", "POST"):
+                if action == "promote":
+                    data = body_fn()
+                    groups = data.get("Groups")
+                    ev = s.promote_deployment(d.id, groups)
+                    return {"EvalID": ev.id}, store.latest_index()
+                if action == "fail":
+                    ev = s.fail_deployment(d.id)
+                    return {"EvalID": ev.id if ev else ""}, store.latest_index()
+                if action == "pause":
+                    data = body_fn()
+                    s.pause_deployment(d.id, bool(data.get("Pause", False)))
+                    return {"DeploymentModifyIndex": store.latest_index()}, \
+                        store.latest_index()
+
+        m = re.match(r"^/v1/deployment/([^/]+)$", path)
+        if m and method == "GET":
+            d = self._unique_prefix(store.deployments(), m.group(1),
+                                    "deployment")
+            if d is None:
+                return None
+            return to_wire(d), idx
+
+        m = re.match(r"^/v1/job/([^/]+)/revert$", path)
+        if m and method in ("PUT", "POST"):
+            data = body_fn()
+            ev = s.revert_job(ns, m.group(1),
+                              int(data.get("JobVersion", 0)))
+            return {"EvalID": ev.id if ev else ""}, store.latest_index()
+
         if path == "/v1/evaluations" and method == "GET":
             return [e.stub() for e in store.evals()], idx
 

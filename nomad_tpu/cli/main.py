@@ -203,6 +203,114 @@ def cmd_job_stop(args) -> int:
     return _monitor_eval(c, resp["EvalID"])
 
 
+# -- deployment --------------------------------------------------------
+def cmd_deployment_list(args) -> int:
+    c = _client(args)
+    deployments = c.list_deployments()
+    if not deployments:
+        print("No deployments")
+        return 0
+    _print_rows(
+        [[short_id(d["id"]), d["job_id"], str(d["job_version"]), d["status"],
+          d["status_description"]] for d in deployments],
+        ["ID", "Job ID", "Job Version", "Status", "Description"])
+    return 0
+
+
+def cmd_deployment_status(args) -> int:
+    c = _client(args)
+    try:
+        d = c.get_deployment(args.deployment_id)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"ID          = {short_id(d['id'])}")
+    print(f"Job ID      = {d['job_id']}")
+    print(f"Job Version = {d['job_version']}")
+    print(f"Status      = {d['status']}")
+    print(f"Description = {d['status_description']}")
+    if d.get("task_groups"):
+        print("\nDeployed")
+        rows = []
+        for tg, s in sorted(d["task_groups"].items()):
+            rows.append([tg, str(s["auto_revert"]), str(s["promoted"])
+                         if s["desired_canaries"] else "N/A",
+                         str(s["desired_total"]), str(s["placed_allocs"]),
+                         str(s["healthy_allocs"]), str(s["unhealthy_allocs"])])
+        _print_rows(rows, ["Task Group", "Auto Revert", "Promoted", "Desired",
+                           "Placed", "Healthy", "Unhealthy"])
+    return 0
+
+
+def cmd_deployment_promote(args) -> int:
+    c = _client(args)
+    try:
+        resp = c.promote_deployment(args.deployment_id,
+                                    args.group or None)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"==> Evaluation {short_id(resp['EvalID'])} triggered by "
+          f"deployment promotion")
+    if args.detach:
+        return 0
+    return _monitor_eval(c, resp["EvalID"])
+
+
+def cmd_deployment_fail(args) -> int:
+    c = _client(args)
+    try:
+        resp = c.fail_deployment(args.deployment_id)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Deployment {short_id(args.deployment_id)} marked as failed")
+    if resp.get("EvalID") and not args.detach:
+        return _monitor_eval(c, resp["EvalID"])
+    return 0
+
+
+def cmd_deployment_pause(args) -> int:
+    c = _client(args)
+    try:
+        c.pause_deployment(args.deployment_id, pause=not args.resume)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Deployment {short_id(args.deployment_id)} "
+          f"{'resumed' if args.resume else 'paused'}")
+    return 0
+
+
+def cmd_job_revert(args) -> int:
+    c = _client(args)
+    try:
+        resp = c.revert_job(args.job_id, args.version)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"==> Evaluation {short_id(resp['EvalID'])} triggered by job "
+          f"revert")
+    if args.detach:
+        return 0
+    return _monitor_eval(c, resp["EvalID"])
+
+
+def cmd_job_history(args) -> int:
+    c = _client(args)
+    try:
+        versions = c.job_versions(args.job_id)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    for v in sorted(versions, key=lambda j: -j["version"]):
+        print(f"Version     = {v['version']}")
+        print(f"Stable      = {v['stable']}")
+        print(f"Submit Date = {v.get('submit_time', '')}")
+        print("")
+    return 0
+
+
 # -- node --------------------------------------------------------------
 def cmd_node_status(args) -> int:
     c = _client(args)
@@ -357,6 +465,37 @@ def build_parser() -> argparse.ArgumentParser:
     init = job.add_parser("init")
     init.add_argument("filename", nargs="?", default="example.nomad")
     init.set_defaults(fn=cmd_job_init)
+    revert = job.add_parser("revert")
+    revert.add_argument("job_id")
+    revert.add_argument("version", type=int)
+    revert.add_argument("-detach", action="store_true")
+    revert.set_defaults(fn=cmd_job_revert)
+    history = job.add_parser("history")
+    history.add_argument("job_id")
+    history.set_defaults(fn=cmd_job_history)
+
+    dep = sub.add_parser("deployment",
+                         help="deployment commands").add_subparsers(dest="sub")
+    dlist = dep.add_parser("list")
+    dlist.set_defaults(fn=cmd_deployment_list)
+    dstatus = dep.add_parser("status")
+    dstatus.add_argument("deployment_id")
+    dstatus.set_defaults(fn=cmd_deployment_status)
+    dpromote = dep.add_parser("promote")
+    dpromote.add_argument("deployment_id")
+    dpromote.add_argument("-group", action="append")
+    dpromote.add_argument("-detach", action="store_true")
+    dpromote.set_defaults(fn=cmd_deployment_promote)
+    dfail = dep.add_parser("fail")
+    dfail.add_argument("deployment_id")
+    dfail.add_argument("-detach", action="store_true")
+    dfail.set_defaults(fn=cmd_deployment_fail)
+    dpause = dep.add_parser("pause")
+    dpause.add_argument("deployment_id")
+    dpause.set_defaults(fn=cmd_deployment_pause, resume=False)
+    dresume = dep.add_parser("resume")
+    dresume.add_argument("deployment_id")
+    dresume.set_defaults(fn=cmd_deployment_pause, resume=True)
 
     node = sub.add_parser("node", help="node commands").add_subparsers(dest="sub")
     nstatus = node.add_parser("status")

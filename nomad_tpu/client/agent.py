@@ -104,7 +104,14 @@ class TaskRunner:
             # "delay" waits out the interval, "fail" marks the task dead)
             if failed and policy is not None and restarts < policy.attempts:
                 restarts += 1
-                self.state.restarts = restarts
+                # visible restart transition: the alloc health monitor
+                # must see the task leave "running" or a crash-looping
+                # task would be reported deployment-healthy
+                self.state = TaskState(
+                    state=TASK_STATE_PENDING, restarts=restarts,
+                    events=[TaskEvent(type="Restarting", exit_code=exit_code,
+                                      failed=failed, time=int(time.time()))])
+                self.on_update()
                 self._kill.wait(min(policy.delay_s, 0.2))  # test-friendly cap
                 continue
             self.state = TaskState(
@@ -127,6 +134,7 @@ class AllocRunner:
         self.push_update = push_update
         self.task_runners: List[TaskRunner] = []
         self.client_status = ALLOC_CLIENT_PENDING
+        self.deployment_status = alloc.deployment_status
         self._l = threading.Lock()
         self.destroyed = False
 
@@ -147,6 +155,53 @@ class AllocRunner:
             self.task_runners.append(tr)
         for tr in self.task_runners:
             tr.start()
+        if self.alloc.deployment_id and tg.update is not None:
+            threading.Thread(target=self._watch_health, args=(tg.update,),
+                             daemon=True,
+                             name=f"health-{self.alloc.id[:8]}").start()
+
+    def _watch_health(self, update) -> None:
+        """Deployment health monitor (allocrunner/health_hook.go +
+        allochealth/tracker.go): healthy once every task has been running
+        continuously for min_healthy_time; unhealthy on task failure or
+        when healthy_deadline expires first."""
+        deadline = time.time() + update.healthy_deadline_s
+        healthy_since: Optional[float] = None
+        seen_restarts = -1
+        while not self.destroyed:
+            with self._l:
+                states = [tr.state for tr in self.task_runners]
+            if any(ts.state == TASK_STATE_DEAD and ts.failed for ts in states):
+                self._set_health(False)
+                return
+            restarts = sum(ts.restarts for ts in states)
+            if restarts != seen_restarts:
+                # a restart resets the continuous-running clock
+                # (allochealth/tracker.go watchTaskEvents)
+                seen_restarts = restarts
+                healthy_since = None
+            if states and all(ts.state == TASK_STATE_RUNNING for ts in states):
+                now = time.time()
+                started = max(ts.started_at or now for ts in states)
+                since = max(healthy_since or started, started)
+                healthy_since = since
+                if now - since >= update.min_healthy_time_s:
+                    self._set_health(True)
+                    return
+            else:
+                healthy_since = None
+            if time.time() > deadline:
+                self._set_health(False)
+                return
+            time.sleep(0.05)
+
+    def _set_health(self, healthy: bool) -> None:
+        from ..models.alloc import AllocDeploymentStatus
+        canary = bool(self.alloc.deployment_status
+                      and self.alloc.deployment_status.canary)
+        self.deployment_status = AllocDeploymentStatus(
+            healthy=healthy, timestamp=time.time(), canary=canary)
+        self._push()
 
     def stop(self) -> None:
         self.destroyed = True
@@ -173,7 +228,8 @@ class AllocRunner:
         states = {tr.task.name: tr.state for tr in self.task_runners}
         self.push_update(Allocation(
             id=self.alloc.id, client_status=self.client_status,
-            task_states=states, modify_time=int(time.time())))
+            task_states=states, deployment_status=self.deployment_status,
+            modify_time=int(time.time())))
 
 
 class Client:

@@ -35,6 +35,10 @@ from ..models.evaluation import (
 from ..state import StateStore
 from ..utils.timetable import TimeTable
 from .blocked_evals import BlockedEvals
+from .deployment_watcher import (
+    DeploymentsWatcher, fail_deployment, pause_deployment,
+    promote_deployment,
+)
 from .eval_broker import EvalBroker, FAILED_QUEUE
 from .periodic import PeriodicDispatch
 from .plan_applier import PlanApplier
@@ -77,6 +81,7 @@ class Server:
         self.plan_applier = PlanApplier(self.plan_queue, self)
         self.time_table = TimeTable()
         self.periodic = PeriodicDispatch(self)
+        self.deployments_watcher = DeploymentsWatcher(self)
         self.workers: List[Worker] = []
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._hb_lock = threading.Lock()
@@ -126,6 +131,7 @@ class Server:
 
     def shutdown(self) -> None:
         self._leader = False
+        self.deployments_watcher.set_enabled(False)
         self.periodic.stop()
         for w in self.workers:
             w.stop()
@@ -155,6 +161,7 @@ class Server:
         for job in self.store.jobs():
             if job.is_periodic():
                 self.periodic.add(job)
+        self.deployments_watcher.set_enabled(True)
 
     def _reap_failed_evals(self) -> None:
         """Drain the broker's failed queue: mark the eval failed and
@@ -347,6 +354,16 @@ class Server:
         for ev in p.get("evals", []):
             self.enqueue_eval(ev)
 
+    def _apply_deployment_promotion(self, index: int, p: dict) -> None:
+        self.store.update_deployment_promotion(
+            index, p["deployment_id"], p.get("groups"), p.get("evals"))
+        for ev in p.get("evals", []):
+            self.enqueue_eval(ev)
+
+    def _apply_job_stability(self, index: int, p: dict) -> None:
+        self.store.update_job_stability(
+            index, p["namespace"], p["job_id"], p["version"], p["stable"])
+
     def _reconcile_job_statuses(self, index: int, p: dict) -> None:
         """Derive job status from alloc states (fsm setJobStatus analog)."""
         seen = set()
@@ -454,6 +471,34 @@ class Server:
                         dict(namespace=namespace, job_id=job_id, purge=purge,
                              evals=[ev]))
         return ev
+
+    # -- deployment endpoints (nomad/deployment_endpoint.go) -----------
+    def promote_deployment(self, deployment_id: str,
+                           groups: Optional[List[str]] = None) -> Evaluation:
+        return promote_deployment(self, deployment_id, groups)
+
+    def fail_deployment(self, deployment_id: str,
+                        **kw) -> Optional[Evaluation]:
+        return fail_deployment(self, deployment_id, **kw)
+
+    def pause_deployment(self, deployment_id: str, pause: bool) -> None:
+        pause_deployment(self, deployment_id, pause)
+
+    def revert_job(self, namespace: str, job_id: str,
+                   version: int) -> Optional[Evaluation]:
+        """Job.Revert (nomad/job_endpoint.go Revert): re-register an
+        older version's spec as a new version."""
+        target = self.store.job_by_id_and_version(namespace, job_id, version)
+        if target is None:
+            raise KeyError(f"job {job_id} version {version} not found")
+        current = self.store.job_by_id(namespace, job_id)
+        if current is not None and current.version == version:
+            raise ValueError(
+                f"job {job_id} is already at version {version}")
+        rolled = target.copy()
+        rolled.stable = False
+        rolled.version = 0          # reassigned by upsert_job
+        return self.register_job(rolled)
 
     def register_node(self, node: Node) -> None:
         node.canonicalize()

@@ -1,0 +1,282 @@
+"""Deployment watcher — drives rolling updates to a verdict.
+
+Watches every active deployment and, on allocation-health changes:
+auto-promotes canary deployments once all canaries are healthy
+(deployment_watcher.go), fails deployments with unhealthy allocations
+and auto-reverts the job to its latest stable version
+(deployments_watcher.go FailDeployment + Job rollback), enforces
+per-task-group progress deadlines, and marks deployments successful
+(+ the job version stable) when every group reaches its desired healthy
+count.
+
+Reference semantics: nomad/deploymentwatcher/deployments_watcher.go
+(Watcher:75, watchDeployments), deployment_watcher.go (watch:345,
+autoPromoteDeployment:505, FailDeployment:300, progress deadline at
+watch:370-430, setDeploymentStatus) and state_store.go
+UpdateDeploymentPromotion / UpdateJobStability.
+
+The structural translation: instead of one goroutine per deployment,
+a single thread re-evaluates all active deployments on every state-store
+index change (the store's blocking watch is the getAllocsCh analog) plus
+a short tick for deadline expiry. Per-deployment progress deadlines are
+tracked in memory and re-derived after leader restart — deadlines
+restart on leadership change, matching the reference's behavior of
+recreating watchers from state.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..models import Evaluation, EVAL_STATUS_PENDING
+from ..models.deployment import (
+    Deployment, DeploymentStatusUpdate,
+    DEPLOYMENT_STATUS_FAILED, DEPLOYMENT_STATUS_PAUSED,
+    DEPLOYMENT_STATUS_RUNNING, DEPLOYMENT_STATUS_SUCCESSFUL,
+    DESC_FAILED_ALLOCATIONS, DESC_FAILED_BY_USER, DESC_PROGRESS_DEADLINE,
+    DESC_SUCCESSFUL,
+)
+from ..models.evaluation import TRIGGER_DEPLOYMENT_WATCHER
+
+LOG = logging.getLogger("nomad_tpu.deployments")
+
+
+class DeploymentsWatcher:
+    """Leader-only service (enabled in establishLeadership, leader.go:222)."""
+
+    TICK_S = 0.25
+
+    def __init__(self, server):
+        self.server = server
+        self._enabled = False
+        self._gen = 0   # generation token: stale threads see a bump and exit
+        self._thread: Optional[threading.Thread] = None
+        # deployment_id -> tg name -> {"healthy": int, "deadline": float}
+        self._progress: Dict[str, Dict[str, dict]] = {}
+
+    def set_enabled(self, enabled: bool) -> None:
+        if enabled and not self._enabled:
+            self._enabled = True
+            self._gen += 1
+            self._thread = threading.Thread(target=self._run,
+                                            args=(self._gen,), daemon=True,
+                                            name="deployment-watcher")
+            self._thread.start()
+        elif not enabled:
+            self._enabled = False
+            self._progress.clear()
+
+    # -- watch loop ----------------------------------------------------
+    def _run(self, gen: int) -> None:
+        while self._enabled and gen == self._gen:
+            snap = self.server.store.snapshot()
+            try:
+                self._scan(snap)
+            except Exception:
+                LOG.exception("deployment scan failed")
+            # wake on any state change, or tick for deadline expiry
+            self.server.store.block_min_index(snap.latest_index() + 1,
+                                              timeout_s=self.TICK_S)
+
+    def _scan(self, snap) -> None:
+        active = set()
+        for d in snap.deployments():
+            if not d.active():
+                continue
+            active.add(d.id)
+            try:
+                self._evaluate(snap, d)
+            except Exception:
+                LOG.exception("evaluating deployment %s", d.id[:8])
+        for did in list(self._progress):
+            if did not in active:
+                del self._progress[did]
+
+    def _evaluate(self, snap, d: Deployment) -> None:
+        if d.status == DEPLOYMENT_STATUS_PAUSED:
+            return
+        job = snap.job_by_id(d.namespace, d.job_id)
+        if job is None or job.stopped() or job.version != d.job_version:
+            # superseded/stopped jobs are cancelled by the reconciler's
+            # deployment_updates on its next pass — nothing to do here
+            return
+        allocs = snap.allocs_by_deployment(d.id)
+
+        # 1. failure: any alloc reported unhealthy (watch:370)
+        if any(a.deployment_status is not None
+               and a.deployment_status.is_unhealthy() for a in allocs):
+            self.server.fail_deployment(d.id, desc=DESC_FAILED_ALLOCATIONS)
+            return
+
+        # 2. progress deadline per task group (watch:390-430)
+        now = time.time()
+        track = self._progress.setdefault(d.id, {})
+        for name, state in d.task_groups.items():
+            rec = track.get(name)
+            if rec is None or state.healthy_allocs > rec["healthy"]:
+                track[name] = {"healthy": state.healthy_allocs,
+                               "deadline": now + state.progress_deadline_s}
+            elif (state.progress_deadline_s > 0 and now > rec["deadline"]
+                  and state.healthy_allocs < state.desired_total):
+                self.server.fail_deployment(d.id, desc=DESC_PROGRESS_DEADLINE)
+                return
+
+        # 3. auto-promotion (autoPromoteDeployment:505)
+        if d.requires_promotion():
+            if d.has_auto_promote() and self._canaries_healthy(snap, d):
+                try:
+                    self.server.promote_deployment(d.id)
+                except (ValueError, KeyError) as e:
+                    LOG.debug("auto-promote %s: %s", d.id[:8], e)
+            return  # unpromoted deployments can't complete
+
+        # 4. success: every group at desired healthy count
+        if d.task_groups and all(s.healthy_allocs >= s.desired_total
+                                 for s in d.task_groups.values()):
+            self._succeed(d)
+
+    def _canaries_healthy(self, snap, d: Deployment) -> bool:
+        """All desired canaries placed AND healthy (autoPromote check)."""
+        by_id = {a.id: a for a in snap.allocs_by_deployment(d.id)}
+        for state in d.task_groups.values():
+            if state.desired_canaries == 0:
+                continue
+            healthy = sum(
+                1 for cid in state.placed_canaries
+                if (a := by_id.get(cid)) is not None
+                and a.deployment_status is not None
+                and a.deployment_status.is_healthy())
+            if healthy < state.desired_canaries:
+                return False
+        return True
+
+    def _succeed(self, d: Deployment) -> None:
+        update = DeploymentStatusUpdate(
+            deployment_id=d.id, status=DEPLOYMENT_STATUS_SUCCESSFUL,
+            status_description=DESC_SUCCESSFUL)
+        self.server.raft_apply("deployment_status_update",
+                               dict(update=update, evals=[]))
+        # the completed version becomes the rollback target
+        self.server.raft_apply("job_stability",
+                               dict(namespace=d.namespace, job_id=d.job_id,
+                                    version=d.job_version, stable=True))
+        self._progress.pop(d.id, None)
+        LOG.info("deployment %s for %s v%d successful",
+                 d.id[:8], d.job_id, d.job_version)
+
+
+# -- server-side RPC surface (Deployment.Promote/Fail/Pause endpoints) --
+
+def make_watcher_eval(d: Deployment, job) -> Evaluation:
+    return Evaluation(
+        namespace=d.namespace,
+        priority=job.priority if job is not None else 50,
+        type=job.type if job is not None else "service",
+        triggered_by=TRIGGER_DEPLOYMENT_WATCHER,
+        job_id=d.job_id,
+        deployment_id=d.id,
+        status=EVAL_STATUS_PENDING)
+
+
+def promote_deployment(server, deployment_id: str,
+                       groups: Optional[List[str]] = None) -> Evaluation:
+    """Deployment.Promote (deployment_watcher.go PromoteDeployment:255):
+    validate canary health, flip promoted, emit a reconcile eval."""
+    d = server.store.deployment_by_id(deployment_id)
+    if d is None:
+        raise KeyError(f"deployment {deployment_id} not found")
+    if not d.active():
+        raise ValueError(f"deployment {deployment_id} has terminal status "
+                         f"{d.status}")
+    if not d.requires_promotion():
+        raise ValueError("deployment has nothing to promote")
+    snap = server.store.snapshot()
+    by_id = {a.id: a for a in snap.allocs_by_deployment(d.id)}
+    for name, state in d.task_groups.items():
+        if state.desired_canaries == 0 or (groups and name not in groups):
+            continue
+        healthy = sum(1 for cid in state.placed_canaries
+                      if (a := by_id.get(cid)) is not None
+                      and a.deployment_status is not None
+                      and a.deployment_status.is_healthy())
+        if healthy < state.desired_canaries:
+            raise ValueError(
+                f"task group {name!r} has {healthy}/{state.desired_canaries} "
+                f"healthy canaries — promotion requires all canaries healthy")
+    job = server.store.job_by_id(d.namespace, d.job_id)
+    ev = make_watcher_eval(d, job)
+    server.raft_apply("deployment_promotion",
+                      dict(deployment_id=deployment_id, groups=groups,
+                           evals=[ev]))
+    return ev
+
+
+def fail_deployment(server, deployment_id: str,
+                    desc: str = DESC_FAILED_BY_USER) -> Optional[Evaluation]:
+    """Deployment.Fail: mark failed; if any group has auto_revert, roll
+    the job back to its latest stable version
+    (deployment_watcher.go FailDeployment:300 + latestStableJob:760)."""
+    d = server.store.deployment_by_id(deployment_id)
+    if d is None:
+        raise KeyError(f"deployment {deployment_id} not found")
+    if not d.active():
+        raise ValueError(f"deployment {deployment_id} has terminal status "
+                         f"{d.status}")
+    job = server.store.job_by_id(d.namespace, d.job_id)
+    rollback = None
+    if any(s.auto_revert for s in d.task_groups.values()):
+        rollback = latest_stable_job(server.store, d)
+        if rollback is not None and job is not None \
+                and not job.specchanged(rollback):
+            rollback = None  # stable spec == failed spec; don't loop
+    if rollback is not None:
+        desc = f"{desc} - rolling back to job version {rollback.version}"
+    update = DeploymentStatusUpdate(
+        deployment_id=d.id, status=DEPLOYMENT_STATUS_FAILED,
+        status_description=desc)
+    ev = make_watcher_eval(d, job)
+    payload = dict(update=update, evals=[ev])
+    if rollback is not None:
+        rolled = rollback.copy()
+        rolled.stable = False
+        rolled.version = 0          # reassigned by upsert_job
+        payload["job"] = rolled
+    server.raft_apply("deployment_status_update", payload)
+    if rollback is not None:
+        LOG.info("deployment %s failed; rolled %s back to version %d",
+                 d.id[:8], d.job_id, rollback.version)
+    return ev
+
+
+def pause_deployment(server, deployment_id: str, pause: bool) -> None:
+    """Deployment.Pause (deployment_watcher.go PauseDeployment:233)."""
+    from ..models.deployment import DESC_RUNNING
+    d = server.store.deployment_by_id(deployment_id)
+    if d is None:
+        raise KeyError(f"deployment {deployment_id} not found")
+    if not d.active():
+        raise ValueError(f"deployment {deployment_id} has terminal status "
+                         f"{d.status}")
+    if pause:
+        update = DeploymentStatusUpdate(
+            deployment_id=d.id, status=DEPLOYMENT_STATUS_PAUSED,
+            status_description="Deployment is paused")
+    else:
+        update = DeploymentStatusUpdate(
+            deployment_id=d.id, status=DEPLOYMENT_STATUS_RUNNING,
+            status_description=DESC_RUNNING)
+    server.raft_apply("deployment_status_update", dict(update=update, evals=[]))
+
+
+def latest_stable_job(store, d: Deployment):
+    """Newest job version flagged stable, excluding the deployed one
+    (deployment_watcher.go latestStableJob:760)."""
+    best = None
+    for v in store.job_versions(d.namespace, d.job_id):
+        if v.stable and v.version != d.job_version \
+                and (best is None or v.version > best.version):
+            best = v
+    return best

@@ -46,6 +46,10 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "scheduler_config": {"config": SchedulerConfiguration},
     "deployment_status_update": {"update": DeploymentStatusUpdate,
                                  "job": Job, "evals": [Evaluation]},
+    "deployment_promotion": {"evals": [Evaluation]},
+    "job_stability": {},
+    "deployment_delete": {},
+    "periodic_launch": {},
 }
 
 

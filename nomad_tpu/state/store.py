@@ -213,7 +213,9 @@ class StateStore(StateSnapshot):
     def __init__(self):
         root = _Root(Hamt(), Hamt())
         super().__init__(root)
-        self._lock = threading.Lock()
+        # RLock: composite mutations re-enter (e.g. update_deployment_status
+        # upserting the rolled-back job via upsert_job)
+        self._lock = threading.RLock()
         self._watch = threading.Condition()
 
     # -- snapshot / blocking ------------------------------------------
@@ -664,6 +666,9 @@ class StateStore(StateSnapshot):
         state_store.go UpsertPlanResults)."""
         with self._lock:
             root = self._root
+            new_placed = [a for a in allocs_placed
+                          if a.deployment_id
+                          and root.table("allocs").get(a.id) is None]
             for a in allocs_stopped:
                 root = self._upsert_alloc_impl(root, index, a)
             for a in allocs_placed:
@@ -672,6 +677,8 @@ class StateStore(StateSnapshot):
                 root = self._upsert_alloc_impl(root, index, a)
             if deployment is not None:
                 root = self._upsert_deployment_impl(root, index, deployment)
+            for a in new_placed:
+                root = self._deployment_account_placement(root, index, a)
             for du in (deployment_updates or []):
                 d = root.table("deployments").get(du.deployment_id)
                 if d is not None:
@@ -685,6 +692,84 @@ class StateStore(StateSnapshot):
             root = (root.with_index("allocs", index)
                         .with_index("deployments", index)
                         .with_index("evals", index))
+            self._publish(root)
+
+    def _deployment_account_placement(self, root: _Root, index: int,
+                                      alloc: Allocation) -> _Root:
+        """Bump placed counts / canary list on the owning deployment
+        (state_store.go updateDeploymentWithAlloc)."""
+        d: Optional[Deployment] = root.table("deployments").get(alloc.deployment_id)
+        if d is None or not d.active():
+            return root
+        state = d.task_groups.get(alloc.task_group)
+        if state is None:
+            return root
+        canaries = state.placed_canaries
+        if (alloc.deployment_status is not None and alloc.deployment_status.canary
+                and alloc.id not in canaries):
+            canaries = canaries + [alloc.id]
+        new_state = replace(state, placed_allocs=state.placed_allocs + 1,
+                            placed_canaries=canaries)
+        d = replace(d, task_groups={**d.task_groups,
+                                    alloc.task_group: new_state},
+                    modify_index=index)
+        return root.with_table("deployments",
+                               root.table("deployments").set(d.id, d)) \
+                   .with_index("deployments", index)
+
+    def update_deployment_promotion(self, index: int, deployment_id: str,
+                                    groups: Optional[List[str]] = None,
+                                    evals: Optional[List[Evaluation]] = None) -> None:
+        """Mark task groups promoted (state_store.go
+        UpdateDeploymentPromotion). Validation happens at the RPC layer;
+        the FSM apply is unconditional so WAL replay is deterministic."""
+        from ..models.deployment import DESC_RUNNING
+        with self._lock:
+            root = self._root
+            d: Optional[Deployment] = root.table("deployments").get(deployment_id)
+            if d is None:
+                raise KeyError(f"deployment {deployment_id} not found")
+            new_states = dict(d.task_groups)
+            for name, state in d.task_groups.items():
+                if state.desired_canaries == 0:
+                    continue
+                if groups and name not in groups:
+                    continue
+                new_states[name] = replace(state, promoted=True)
+            d = replace(d, task_groups=new_states,
+                        status_description=DESC_RUNNING, modify_index=index)
+            root = root.with_table("deployments",
+                                   root.table("deployments").set(d.id, d))
+            for e in (evals or []):
+                root = self._upsert_eval_impl(root, index, e)
+            root = root.with_index("deployments", index)
+            if evals:
+                root = root.with_index("evals", index)
+            self._publish(root)
+
+    def update_job_stability(self, index: int, namespace: str, job_id: str,
+                             version: int, stable: bool) -> None:
+        """Flag a job version (un)stable (state_store.go
+        UpdateJobStability) — the auto-revert target marker."""
+        with self._lock:
+            root = self._root
+            key = (namespace, job_id)
+            versions = root.table("job_versions").get(key)
+            if versions is not None:
+                v = versions.get(version)
+                if v is not None:
+                    v = v.copy()
+                    v.stable = stable
+                    root = root.with_table(
+                        "job_versions",
+                        root.table("job_versions").set(key, versions.set(version, v)))
+            current: Optional[Job] = root.table("jobs").get(key)
+            if current is not None and current.version == version:
+                current = current.copy()
+                current.stable = stable
+                current.modify_index = index
+                root = root.with_table("jobs", root.table("jobs").set(key, current))
+            root = root.with_index("jobs", index)
             self._publish(root)
 
     # -- periodic launches ---------------------------------------------
