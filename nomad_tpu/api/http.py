@@ -206,9 +206,8 @@ class HTTPApiServer:
                         deadline_s=parse_duration_s(spec.get("Deadline"), 0.0),
                         ignore_system_jobs=bool(
                             spec.get("IgnoreSystemJobs", False))))
-                s.raft_apply("node_drain_update",
-                             dict(node_id=node.id, drain_strategy=strategy,
-                                  mark_eligible=data.get("MarkEligible", False)))
+                s.update_node_drain(node.id, strategy,
+                                    data.get("MarkEligible", False))
                 return {"NodeModifyIndex": store.latest_index()}, \
                     store.latest_index()
 

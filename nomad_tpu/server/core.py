@@ -39,6 +39,7 @@ from .deployment_watcher import (
     DeploymentsWatcher, fail_deployment, pause_deployment,
     promote_deployment,
 )
+from .drainer import NodeDrainer, drain_allocs
 from .eval_broker import EvalBroker, FAILED_QUEUE
 from .periodic import PeriodicDispatch
 from .plan_applier import PlanApplier
@@ -82,6 +83,7 @@ class Server:
         self.time_table = TimeTable()
         self.periodic = PeriodicDispatch(self)
         self.deployments_watcher = DeploymentsWatcher(self)
+        self.node_drainer = NodeDrainer(self)
         self.workers: List[Worker] = []
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._hb_lock = threading.Lock()
@@ -132,6 +134,7 @@ class Server:
     def shutdown(self) -> None:
         self._leader = False
         self.deployments_watcher.set_enabled(False)
+        self.node_drainer.set_enabled(False)
         self.periodic.stop()
         for w in self.workers:
             w.stop()
@@ -162,6 +165,7 @@ class Server:
             if job.is_periodic():
                 self.periodic.add(job)
         self.deployments_watcher.set_enabled(True)
+        self.node_drainer.set_enabled(True)
 
     def _reap_failed_evals(self) -> None:
         """Drain the broker's failed queue: mark the eval failed and
@@ -310,6 +314,12 @@ class Server:
         self.store.update_node_drain(index, p["node_id"], p["drain_strategy"],
                                      p.get("mark_eligible", False))
 
+    def _apply_alloc_desired_transition(self, index: int, p: dict) -> None:
+        self.store.update_alloc_desired_transitions(
+            index, p["alloc_ids"], p["transition"], p.get("evals"))
+        for ev in p.get("evals", []):
+            self.enqueue_eval(ev)
+
     def _apply_alloc_client_update(self, index: int, p: dict) -> None:
         allocs: List[Allocation] = p["allocs"]
         self.store.update_allocs_from_client(index, allocs)
@@ -351,6 +361,13 @@ class Server:
     def _apply_deployment_status_update(self, index: int, p: dict) -> None:
         self.store.update_deployment_status(
             index, p["update"], p.get("job"), p.get("evals"))
+        st = p.get("stability")
+        if st:
+            # same raft entry as the status change: success + stable marker
+            # commit or replay together
+            self.store.update_job_stability(
+                index, st["namespace"], st["job_id"], st["version"],
+                st["stable"])
         for ev in p.get("evals", []):
             self.enqueue_eval(ev)
 
@@ -499,6 +516,23 @@ class Server:
         rolled.stable = False
         rolled.version = 0          # reassigned by upsert_job
         return self.register_job(rolled)
+
+    # -- node drain (nomad/node_endpoint.go UpdateDrain) ---------------
+    def update_node_drain(self, node_id: str, drain_strategy,
+                          mark_eligible: bool = False) -> None:
+        """Start or clear a drain. Stamps the force deadline from the
+        spec's relative deadline (structs.go DrainStrategy.DeadlineTime)."""
+        if drain_strategy is not None \
+                and drain_strategy.drain_spec.deadline_s > 0 \
+                and drain_strategy.force_deadline == 0:
+            drain_strategy.force_deadline = (
+                time.time() + drain_strategy.drain_spec.deadline_s)
+        self.raft_apply("node_drain_update",
+                        dict(node_id=node_id, drain_strategy=drain_strategy,
+                             mark_eligible=mark_eligible))
+
+    def drain_allocs(self, allocs, jobs) -> None:
+        drain_allocs(self, allocs, jobs)
 
     def register_node(self, node: Node) -> None:
         node.canonicalize()

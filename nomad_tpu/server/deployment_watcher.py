@@ -61,29 +61,36 @@ class DeploymentsWatcher:
         if enabled and not self._enabled:
             self._enabled = True
             self._gen += 1
+            # deadlines restart on (re-)election: clear here, where no
+            # older-generation thread can repopulate after the clear
+            self._progress.clear()
             self._thread = threading.Thread(target=self._run,
                                             args=(self._gen,), daemon=True,
                                             name="deployment-watcher")
             self._thread.start()
         elif not enabled:
             self._enabled = False
-            self._progress.clear()
+
+    def _live(self, gen: int) -> bool:
+        return self._enabled and gen == self._gen
 
     # -- watch loop ----------------------------------------------------
     def _run(self, gen: int) -> None:
-        while self._enabled and gen == self._gen:
+        while self._live(gen):
             snap = self.server.store.snapshot()
             try:
-                self._scan(snap)
+                self._scan(snap, gen)
             except Exception:
                 LOG.exception("deployment scan failed")
             # wake on any state change, or tick for deadline expiry
             self.server.store.block_min_index(snap.latest_index() + 1,
                                               timeout_s=self.TICK_S)
 
-    def _scan(self, snap) -> None:
+    def _scan(self, snap, gen: int) -> None:
         active = set()
         for d in snap.deployments():
+            if not self._live(gen):
+                return  # stale thread must not raft-apply as non-leader
             if not d.active():
                 continue
             active.add(d.id)
@@ -126,7 +133,8 @@ class DeploymentsWatcher:
 
         # 3. auto-promotion (autoPromoteDeployment:505)
         if d.requires_promotion():
-            if d.has_auto_promote() and self._canaries_healthy(snap, d):
+            if d.has_auto_promote() \
+                    and not unhealthy_canary_groups(snap, d):
                 try:
                     self.server.promote_deployment(d.id)
                 except (ValueError, KeyError) as e:
@@ -138,37 +146,43 @@ class DeploymentsWatcher:
                                  for s in d.task_groups.values()):
             self._succeed(d)
 
-    def _canaries_healthy(self, snap, d: Deployment) -> bool:
-        """All desired canaries placed AND healthy (autoPromote check)."""
-        by_id = {a.id: a for a in snap.allocs_by_deployment(d.id)}
-        for state in d.task_groups.values():
-            if state.desired_canaries == 0:
-                continue
-            healthy = sum(
-                1 for cid in state.placed_canaries
-                if (a := by_id.get(cid)) is not None
-                and a.deployment_status is not None
-                and a.deployment_status.is_healthy())
-            if healthy < state.desired_canaries:
-                return False
-        return True
-
     def _succeed(self, d: Deployment) -> None:
         update = DeploymentStatusUpdate(
             deployment_id=d.id, status=DEPLOYMENT_STATUS_SUCCESSFUL,
             status_description=DESC_SUCCESSFUL)
-        self.server.raft_apply("deployment_status_update",
-                               dict(update=update, evals=[]))
-        # the completed version becomes the rollback target
-        self.server.raft_apply("job_stability",
-                               dict(namespace=d.namespace, job_id=d.job_id,
-                                    version=d.job_version, stable=True))
+        # one raft entry: a crash must never leave the deployment
+        # successful without the version flagged stable (the auto-revert
+        # target), so stability rides in the same apply
+        self.server.raft_apply(
+            "deployment_status_update",
+            dict(update=update, evals=[],
+                 stability=dict(namespace=d.namespace, job_id=d.job_id,
+                                version=d.job_version, stable=True)))
         self._progress.pop(d.id, None)
         LOG.info("deployment %s for %s v%d successful",
                  d.id[:8], d.job_id, d.job_version)
 
 
 # -- server-side RPC surface (Deployment.Promote/Fail/Pause endpoints) --
+
+def unhealthy_canary_groups(snap, d: Deployment,
+                            groups: Optional[List[str]] = None) -> List[str]:
+    """Task groups whose desired canaries are not all placed+healthy.
+    Shared by auto-promote and the Promote RPC so both gates agree."""
+    by_id = {a.id: a for a in snap.allocs_by_deployment(d.id)}
+    bad = []
+    for name, state in d.task_groups.items():
+        if state.desired_canaries == 0 or (groups and name not in groups):
+            continue
+        healthy = sum(
+            1 for cid in state.placed_canaries
+            if (a := by_id.get(cid)) is not None
+            and a.deployment_status is not None
+            and a.deployment_status.is_healthy())
+        if healthy < state.desired_canaries:
+            bad.append(name)
+    return bad
+
 
 def make_watcher_eval(d: Deployment, job) -> Evaluation:
     return Evaluation(
@@ -193,19 +207,11 @@ def promote_deployment(server, deployment_id: str,
                          f"{d.status}")
     if not d.requires_promotion():
         raise ValueError("deployment has nothing to promote")
-    snap = server.store.snapshot()
-    by_id = {a.id: a for a in snap.allocs_by_deployment(d.id)}
-    for name, state in d.task_groups.items():
-        if state.desired_canaries == 0 or (groups and name not in groups):
-            continue
-        healthy = sum(1 for cid in state.placed_canaries
-                      if (a := by_id.get(cid)) is not None
-                      and a.deployment_status is not None
-                      and a.deployment_status.is_healthy())
-        if healthy < state.desired_canaries:
-            raise ValueError(
-                f"task group {name!r} has {healthy}/{state.desired_canaries} "
-                f"healthy canaries — promotion requires all canaries healthy")
+    bad = unhealthy_canary_groups(server.store.snapshot(), d, groups)
+    if bad:
+        raise ValueError(
+            f"task groups {bad} do not have all canaries placed and "
+            f"healthy canaries — promotion requires all canaries healthy")
     job = server.store.job_by_id(d.namespace, d.job_id)
     ev = make_watcher_eval(d, job)
     server.raft_apply("deployment_promotion",

@@ -1,0 +1,162 @@
+"""Node drainer — staged migration of allocations off draining nodes.
+
+Reference semantics: nomad/drainer/ (drainer.go NodeDrainer:130, run:225;
+watch_jobs.go drainingJobWatcher batches migrations honoring the task
+group's migrate{max_parallel}; drain_heap.go tracks per-node force
+deadlines; watch_nodes.go marks the drain complete when the node has no
+more draining allocs). The drainer never stops allocations itself: it
+flags DesiredTransition.Migrate on a bounded batch and emits node-drain
+evaluations; the reconciler then stops the flagged allocs and places
+replacements elsewhere (reconcile_util.go filterByTainted honors the
+transition). System-job allocations are drained only after all service/
+batch allocations have left (or at the force deadline), matching
+watch_nodes.go's service-first ordering; `ignore_system_jobs` leaves them
+in place.
+
+Structural translation: one thread re-evaluating all draining nodes on
+every store index change plus a deadline tick, same shape as
+deployment_watcher.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..models import Evaluation, EVAL_STATUS_PENDING, JOB_TYPE_SYSTEM
+from ..models.alloc import DesiredTransition
+from ..models.evaluation import TRIGGER_NODE_DRAIN
+
+LOG = logging.getLogger("nomad_tpu.drainer")
+
+
+class NodeDrainer:
+    """Leader-only service (leader.go establishLeadership enables it)."""
+
+    TICK_S = 0.25
+
+    def __init__(self, server):
+        self.server = server
+        self._enabled = False
+        self._gen = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def set_enabled(self, enabled: bool) -> None:
+        if enabled and not self._enabled:
+            self._enabled = True
+            self._gen += 1
+            self._thread = threading.Thread(target=self._run,
+                                            args=(self._gen,), daemon=True,
+                                            name="node-drainer")
+            self._thread.start()
+        elif not enabled:
+            self._enabled = False
+
+    def _run(self, gen: int) -> None:
+        while self._enabled and gen == self._gen:
+            snap = self.server.store.snapshot()
+            try:
+                for node in snap.nodes():
+                    if node.drain_strategy is not None:
+                        self._drain_node(snap, node)
+            except Exception:
+                LOG.exception("drain scan failed")
+            self.server.store.block_min_index(snap.latest_index() + 1,
+                                              timeout_s=self.TICK_S)
+
+    def _drain_node(self, snap, node) -> None:
+        strat = node.drain_strategy
+        now = time.time()
+        force = strat.force_deadline > 0 and now >= strat.force_deadline
+
+        # live allocs still on the node, split by job type
+        service: List[Tuple[object, object]] = []   # (alloc, job)
+        system: List[Tuple[object, object]] = []
+        # client-live allocs only; desired-stop-but-still-running allocs
+        # stay in the set so they count against the migrate budget
+        for a in snap.allocs_by_node(node.id):
+            if a.client_terminal_status():
+                continue
+            job = a.job or snap.job_by_id(a.namespace, a.job_id)
+            if job is not None and job.type == JOB_TYPE_SYSTEM:
+                if not strat.drain_spec.ignore_system_jobs:
+                    system.append((a, job))
+                continue
+            service.append((a, job))
+
+        if not service:
+            if system:
+                # all services gone: evals let the system scheduler stop
+                # its allocs (the draining node is no longer "ready").
+                # Skip jobs whose allocs are already stopping, or every
+                # tick re-emits an identical eval while the client kills.
+                pending = {(j.namespace, j.id): j for a, j in system
+                           if not a.server_terminal_status()}
+                if pending:
+                    self._emit_evals(pending)
+                return
+            LOG.info("node %s drain complete", node.id[:8])
+            self.server.update_node_drain(node.id, None, mark_eligible=False)
+            return
+
+        # batch service/batch migrations per task group, bounded by
+        # migrate.max_parallel minus migrations still in flight
+        by_tg: Dict[Tuple[str, str, str], List[Tuple[object, object]]] = {}
+        for a, job in service:
+            by_tg.setdefault((a.namespace, a.job_id, a.task_group),
+                             []).append((a, job))
+        to_flag = []
+        jobs: Dict[Tuple[str, str], object] = {}
+        for (ns, job_id, tg_name), items in by_tg.items():
+            job = items[0][1]
+            tg = job.lookup_task_group(tg_name) if job else None
+            max_parallel = (tg.migrate.max_parallel
+                            if tg is not None and tg.migrate is not None else 1)
+            if force:
+                max_parallel = len(items)
+            in_flight = sum(
+                1 for a, _ in items
+                if a.desired_transition.should_migrate() or a.terminal_status())
+            budget = max(0, max_parallel - in_flight)
+            for a, _ in items:
+                if budget <= 0:
+                    break
+                if a.desired_transition.should_migrate() or a.terminal_status():
+                    continue
+                to_flag.append(a)
+                jobs[(ns, job_id)] = job
+                budget -= 1
+        if to_flag:
+            self.server.drain_allocs(to_flag, jobs)
+
+    def _emit_evals(self, jobs: Dict[Tuple[str, str], object]) -> None:
+        evals = [_drain_eval(job) for job in jobs.values()]
+        # skip if an identical pending eval is already queued for the job
+        pending = {(e.namespace, e.job_id)
+                   for e in self.server.store.evals()
+                   if e.status == EVAL_STATUS_PENDING
+                   and e.triggered_by == TRIGGER_NODE_DRAIN}
+        evals = [e for e in evals if (e.namespace, e.job_id) not in pending]
+        if evals:
+            self.server.raft_apply("eval_update", dict(evals=evals))
+
+
+def _drain_eval(job) -> Evaluation:
+    return Evaluation(
+        namespace=job.namespace, priority=job.priority, type=job.type,
+        triggered_by=TRIGGER_NODE_DRAIN, job_id=job.id,
+        status=EVAL_STATUS_PENDING)
+
+
+def drain_allocs(server, allocs, jobs: Dict[Tuple[str, str], object]) -> None:
+    """Flag DesiredTransition.Migrate and emit one eval per affected job
+    (drainer.go drainAllocs -> AllocUpdateDesiredTransition raft apply)."""
+    evals = [_drain_eval(job) for job in jobs.values()]
+    server.raft_apply(
+        "alloc_desired_transition",
+        dict(alloc_ids=[a.id for a in allocs],
+             transition=DesiredTransition(migrate=True),
+             evals=evals))
+    LOG.info("draining %d allocs across %d jobs", len(allocs), len(jobs))

@@ -21,6 +21,7 @@ import msgpack
 
 from ..models import (Allocation, Deployment, Evaluation, Job, Node,
                       SchedulerConfiguration)
+from ..models.alloc import DesiredTransition
 from ..models.deployment import DeploymentStatusUpdate
 from ..models.node import DrainStrategy
 from ..utils.codec import from_wire, to_wire
@@ -47,6 +48,8 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "deployment_status_update": {"update": DeploymentStatusUpdate,
                                  "job": Job, "evals": [Evaluation]},
     "deployment_promotion": {"evals": [Evaluation]},
+    "alloc_desired_transition": {"transition": DesiredTransition,
+                                 "evals": [Evaluation]},
     "job_stability": {},
     "deployment_delete": {},
     "periodic_launch": {},

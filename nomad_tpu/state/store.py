@@ -694,6 +694,31 @@ class StateStore(StateSnapshot):
                         .with_index("evals", index))
             self._publish(root)
 
+    def update_alloc_desired_transitions(self, index: int,
+                                         alloc_ids: List[str],
+                                         transition,
+                                         evals: Optional[List[Evaluation]] = None) -> None:
+        """Set server-desired transitions (state_store.go
+        UpdateAllocsDesiredTransitions) — the drainer's migrate flag."""
+        with self._lock:
+            root = self._root
+            updates = {k: v for k, v in vars(transition).items()
+                       if v is not None}
+            for aid in alloc_ids:
+                a: Optional[Allocation] = root.table("allocs").get(aid)
+                if a is None:
+                    continue
+                a = replace(a, desired_transition=replace(
+                    a.desired_transition, **updates), modify_index=index)
+                root = root.with_table("allocs",
+                                       root.table("allocs").set(aid, a))
+            for e in (evals or []):
+                root = self._upsert_eval_impl(root, index, e)
+            root = root.with_index("allocs", index)
+            if evals:
+                root = root.with_index("evals", index)
+            self._publish(root)
+
     def _deployment_account_placement(self, root: _Root, index: int,
                                       alloc: Allocation) -> _Root:
         """Bump placed counts / canary list on the owning deployment
@@ -736,8 +761,12 @@ class StateStore(StateSnapshot):
                 if groups and name not in groups:
                     continue
                 new_states[name] = replace(state, promoted=True)
+            # a paused deployment keeps its pause description; only a
+            # running one flips to the plain running text
+            desc = (DESC_RUNNING if d.status == "running"
+                    else d.status_description)
             d = replace(d, task_groups=new_states,
-                        status_description=DESC_RUNNING, modify_index=index)
+                        status_description=desc, modify_index=index)
             root = root.with_table("deployments",
                                    root.table("deployments").set(d.id, d))
             for e in (evals or []):
