@@ -89,6 +89,19 @@ class ApiClient:
                              {"DrainSpec": spec,
                               "MarkEligible": mark_eligible})
 
+    def plan_job(self, job_id: str, spec, diff: bool = True) -> dict:
+        return self._request("POST", f"/v1/job/{job_id}/plan",
+                             {"Job": spec, "Diff": diff})
+
+    def scale_job(self, job_id: str, group: str, count: int,
+                  message: str = "") -> dict:
+        return self._request("POST", f"/v1/job/{job_id}/scale",
+                             {"Count": count, "Target": {"Group": group},
+                              "Message": message})
+
+    def job_scale_status(self, job_id: str) -> dict:
+        return self._request("GET", f"/v1/job/{job_id}/scale")
+
     def job_deployments(self, job_id: str) -> list:
         return self._request("GET", f"/v1/job/{job_id}/deployments")
 
@@ -133,6 +146,25 @@ class ApiClient:
 
     def get_evaluation(self, eval_id: str) -> dict:
         return self._request("GET", f"/v1/evaluation/{eval_id}")
+
+    def search(self, prefix: str, context: str = "all") -> dict:
+        return self._request("POST", "/v1/search",
+                             {"Prefix": prefix, "Context": context})
+
+    def stream_events(self, topics: Optional[list] = None,
+                      index: int = 0):
+        """Generator of event batches from /v1/event/stream (NDJSON).
+        topics: ["Job:my-job", "Node:*"]-style filters."""
+        from urllib.parse import urlencode
+        params = [("topic", t) for t in (topics or [])] + [("index", index)]
+        url = f"{self.address}/v1/event/stream?{urlencode(params)}"
+        req = urllib.request.Request(url)
+        with urllib.request.urlopen(req, timeout=310) as resp:
+            for line in resp:
+                line = line.strip()
+                if not line or line == b"{}":
+                    continue
+                yield json.loads(line)
 
     def agent_self(self) -> dict:
         return self._request("GET", "/v1/agent/self")

@@ -64,6 +64,11 @@ class HTTPApiServer:
                 try:
                     url = urlparse(self.path)
                     q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                    if url.path == "/v1/event/stream" and method == "GET":
+                        # topics repeat: ?topic=Job:myjob&topic=Node:*
+                        raw = parse_qs(url.query).get("topic", [])
+                        return api.stream_events(self, raw,
+                                                 int(q.get("index", 0)))
                     # blocking query support (http.go parseWait)
                     if "index" in q:
                         wait_s = parse_duration_s(q.get("wait", "5m"), 300.0)
@@ -268,6 +273,42 @@ class HTTPApiServer:
                               int(data.get("JobVersion", 0)))
             return {"EvalID": ev.id if ev else ""}, store.latest_index()
 
+        m = re.match(r"^/v1/job/([^/]+)/plan$", path)
+        if m and method in ("PUT", "POST"):
+            data = body_fn()
+            spec = data.get("Job", data)
+            job = from_wire(Job, spec) if isinstance(spec, dict) \
+                else parse_job(spec)
+            result = s.plan_job(job, diff=bool(data.get("Diff", True)))
+            return result, idx
+
+        m = re.match(r"^/v1/job/([^/]+)/scale$", path)
+        if m:
+            job_id = m.group(1)
+            if method == "GET":
+                job = store.job_by_id(ns, job_id)
+                if job is None:
+                    return None
+                summ = store.job_summary(ns, job_id)
+                return {
+                    "JobID": job.id, "JobStopped": job.stopped(),
+                    "TaskGroups": {
+                        tg.name: {"Desired": tg.count,
+                                  **(summ.summary.get(tg.name, {})
+                                     if summ else {})}
+                        for tg in job.task_groups},
+                    "ScalingEvents": store.scaling_events(ns, job_id),
+                }, idx
+            if method in ("PUT", "POST"):
+                data = body_fn()
+                target = data.get("Target", {})
+                ev = s.scale_job(
+                    ns, job_id, target.get("Group", ""),
+                    count=data.get("Count"),
+                    message=data.get("Message", ""),
+                    error=bool(data.get("Error", False)))
+                return {"EvalID": ev.id if ev else ""}, store.latest_index()
+
         if path == "/v1/evaluations" and method == "GET":
             return [e.stub() for e in store.evals()], idx
 
@@ -277,6 +318,11 @@ class HTTPApiServer:
             if ev is None:
                 return None
             return to_wire(ev), idx
+
+        if path == "/v1/search" and method in ("PUT", "POST"):
+            data = body_fn()
+            return self._search(data.get("Prefix", ""),
+                                data.get("Context", "all"), ns), idx
 
         if path == "/v1/status/leader":
             return "127.0.0.1:4647", idx
@@ -300,6 +346,67 @@ class HTTPApiServer:
                 return {"Updated": True}, store.latest_index()
 
         return None
+
+    # -- search (nomad/search_endpoint.go: prefix search, 20-match cap) --
+    TRUNCATE_LIMIT = 20
+
+    def _search(self, prefix: str, context: str, ns: str) -> dict:
+        store = self.server.store
+        sources = {
+            "jobs": lambda: [j.id for j in store.jobs(ns)],
+            "nodes": lambda: [n.id for n in store.nodes()],
+            "allocs": lambda: [a.id for a in store.allocs()],
+            "evals": lambda: [e.id for e in store.evals()],
+            "deployment": lambda: [d.id for d in store.deployments()],
+        }
+        if context != "all":
+            if context not in sources:
+                raise ValueError(f"invalid search context {context!r}")
+            sources = {context: sources[context]}
+        matches, truncations = {}, {}
+        for name, fn in sources.items():
+            ids = sorted(i for i in fn() if i.startswith(prefix))
+            truncations[name] = len(ids) > self.TRUNCATE_LIMIT
+            matches[name] = ids[:self.TRUNCATE_LIMIT]
+        return {"Matches": matches, "Truncations": truncations}
+
+    # -- event stream (nomad/stream/ndjson.go over chunked HTTP) --------
+    def stream_events(self, handler, raw_topics, from_index: int):
+        from ..server.event_broker import ALL_KEYS, TOPIC_ALL
+        from ..utils.codec import to_wire
+        topics = {}
+        for t in raw_topics:
+            topic, _, key = t.partition(":")
+            topics.setdefault(topic or TOPIC_ALL, []).append(key or ALL_KEYS)
+        sub, backlog = self.server.events.subscribe(
+            topics or None, from_index)
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Transfer-Encoding", "chunked")
+            handler.end_headers()
+
+            def write_chunk(data: bytes):
+                handler.wfile.write(f"{len(data):x}\r\n".encode()
+                                    + data + b"\r\n")
+                handler.wfile.flush()
+
+            def emit(events):
+                if not events:
+                    write_chunk(b"{}\n")  # heartbeat (ndjson.go keepalive)
+                    return
+                payload = {"Index": max(e.index for e in events),
+                           "Events": [to_wire(e) for e in events]}
+                write_chunk((json.dumps(payload) + "\n").encode())
+
+            if backlog:
+                emit(backlog)
+            while True:
+                emit(sub.next_events(timeout_s=5.0))
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away
+        finally:
+            sub.unsubscribe()
 
     def _find_node(self, prefix: str):
         node = self.server.store.node_by_id(prefix)

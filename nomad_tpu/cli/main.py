@@ -203,6 +203,84 @@ def cmd_job_stop(args) -> int:
     return _monitor_eval(c, resp["EvalID"])
 
 
+def cmd_job_plan(args) -> int:
+    from ..jobspec import parse_job, job_to_spec
+    try:
+        with open(args.jobfile) as f:
+            job = parse_job(f.read())
+    except (OSError, ValueError) as e:
+        print(f"Error reading job file: {e}", file=sys.stderr)
+        return 1
+    c = _client(args)
+    try:
+        result = c.plan_job(job.id, job_to_spec(job))
+    except ApiError as e:
+        print(f"Error during plan: {e}", file=sys.stderr)
+        return 1
+    _print_job_diff(result.get("diff") or {})
+    print("\nScheduler dry-run:")
+    failed = result.get("failed_tg_allocs") or {}
+    if not failed:
+        print("- All tasks successfully allocated.")
+    else:
+        for tg, metric in failed.items():
+            print(f"- WARNING: Failed to place allocations for task group "
+                  f"{tg!r}.")
+            for k in ("constraint_filtered", "dimension_exhausted"):
+                if metric.get(k):
+                    print(f"    {k}: {metric[k]}")
+    ann = result.get("annotations") or {}
+    for tg, upd in (ann.get("desired_tg_updates") or {}).items():
+        parts = [f"{k}: {v}" for k, v in sorted(upd.items()) if v]
+        if parts:
+            print(f"  Task group {tg!r}: " + ", ".join(parts))
+    return 1 if failed else 0
+
+
+_DIFF_MARK = {"Added": "+", "Deleted": "-", "Edited": "~", "None": " "}
+
+
+def _print_job_diff(diff: dict, indent: str = "") -> None:
+    if not diff:
+        return
+    mark = _DIFF_MARK.get(diff.get("Type", "None"), " ")
+    print(f"{indent}{mark} Job: {diff.get('ID', '')!r}")
+    for f in diff.get("Fields", []):
+        print(f"{indent}  {_DIFF_MARK[f['Type']]} {f['Name']}: "
+              f"{f['Old']!r} => {f['New']!r}")
+    for tg in diff.get("TaskGroups", []):
+        print(f"{indent}{_DIFF_MARK[tg['Type']]} Task Group: "
+              f"{tg.get('Name', '')!r}")
+        _print_object_diff(tg, indent + "  ")
+        for task in tg.get("Tasks", []):
+            print(f"{indent}  {_DIFF_MARK[task['Type']]} Task: "
+                  f"{task.get('Name', '')!r}")
+            _print_object_diff(task, indent + "    ")
+
+
+def _print_object_diff(obj: dict, indent: str) -> None:
+    for f in obj.get("Fields", []):
+        print(f"{indent}{_DIFF_MARK[f['Type']]} {f['Name']}: "
+              f"{f['Old']!r} => {f['New']!r}")
+    for o in obj.get("Objects", []):
+        print(f"{indent}{_DIFF_MARK[o['Type']]} {o.get('Name', '')}")
+        _print_object_diff(o, indent + "  ")
+
+
+def cmd_job_scale(args) -> int:
+    c = _client(args)
+    try:
+        resp = c.scale_job(args.job_id, args.group, args.count)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"==> Evaluation {short_id(resp['EvalID'])} triggered by job "
+          f"scale")
+    if args.detach:
+        return 0
+    return _monitor_eval(c, resp["EvalID"])
+
+
 # -- deployment --------------------------------------------------------
 def cmd_deployment_list(args) -> int:
     c = _client(args)
@@ -473,6 +551,15 @@ def build_parser() -> argparse.ArgumentParser:
     history = job.add_parser("history")
     history.add_argument("job_id")
     history.set_defaults(fn=cmd_job_history)
+    plan = job.add_parser("plan")
+    plan.add_argument("jobfile")
+    plan.set_defaults(fn=cmd_job_plan)
+    scale = job.add_parser("scale")
+    scale.add_argument("job_id")
+    scale.add_argument("group")
+    scale.add_argument("count", type=int)
+    scale.add_argument("-detach", action="store_true")
+    scale.set_defaults(fn=cmd_job_scale)
 
     dep = sub.add_parser("deployment",
                          help="deployment commands").add_subparsers(dest="sub")

@@ -277,6 +277,15 @@ def _group(name: str, body: dict, job_update: Optional[dict],
     update_body = body.get("update", job_update)
     migrate = body.get("migrate", job_migrate)
     sacd = body.get("stop_after_client_disconnect")
+    scaling = body.get("scaling")
+    if isinstance(scaling, dict):
+        from ..models.job import Scaling
+        scaling = Scaling(enabled=bool(scaling.get("enabled", True)),
+                          min=int(scaling.get("min", 0)),
+                          max=int(scaling.get("max", 0)),
+                          policy=dict(scaling.get("policy", {})))
+    else:
+        scaling = None
     return TaskGroup(
         name=name,
         count=int(body.get("count", 1)),
@@ -291,6 +300,7 @@ def _group(name: str, body: dict, job_update: Optional[dict],
         restart_policy=_restart(body.get("restart")),
         reschedule_policy=_reschedule(body.get("reschedule")),
         update=_update(update_body),
+        scaling=scaling,
         migrate=MigrateStrategy(
             max_parallel=int(migrate.get("max_parallel", 1)),
             min_healthy_time_s=parse_duration_s(

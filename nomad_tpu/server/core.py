@@ -41,6 +41,7 @@ from .deployment_watcher import (
 )
 from .drainer import NodeDrainer, drain_allocs
 from .eval_broker import EvalBroker, FAILED_QUEUE
+from .event_broker import EventBroker, events_from_apply
 from .periodic import PeriodicDispatch
 from .plan_applier import PlanApplier
 from .plan_queue import PlanQueue
@@ -84,6 +85,7 @@ class Server:
         self.periodic = PeriodicDispatch(self)
         self.deployments_watcher = DeploymentsWatcher(self)
         self.node_drainer = NodeDrainer(self)
+        self.events = EventBroker()
         self.workers: List[Worker] = []
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._hb_lock = threading.Lock()
@@ -239,6 +241,14 @@ class Server:
             self.time_table.witness(index)
             if self.persistence is not None:
                 self.persistence.maybe_snapshot(self.store)
+            # change events fan out AFTER the commit (stream/event_broker
+            # subscribers see only applied state); WAL replay bypasses
+            # raft_apply so restores don't replay the event history
+            try:
+                self.events.publish(events_from_apply(msg_type, payload,
+                                                      index))
+            except Exception:
+                LOG.exception("event publish for %s", msg_type)
         return index
 
     # -- FSM appliers --------------------------------------------------
@@ -488,6 +498,90 @@ class Server:
                         dict(namespace=namespace, job_id=job_id, purge=purge,
                              evals=[ev]))
         return ev
+
+    def plan_job(self, job: Job, diff: bool = True) -> dict:
+        """Job.Plan (nomad/job_endpoint.go Plan:600): dry-run the
+        scheduler against a copy of current state; nothing is committed.
+        Returns the annotated plan, failed placements, and the job diff."""
+        from ..models.diff import job_diff
+        from ..scheduler.harness import Harness
+        job = job.copy()
+        job.canonicalize()
+        errs = job.validate()
+        if errs:
+            raise ValueError("; ".join(errs))
+        old_job = self.store.job_by_id(job.namespace, job.id)
+
+        shadow = StateStore()
+        shadow.restore(self.store.dump())
+        h = Harness(shadow)
+        index = self.store.latest_index() + 1
+        shadow.upsert_job(index, job)
+        ev = Evaluation(
+            namespace=job.namespace, priority=job.priority, type=job.type,
+            triggered_by=TRIGGER_JOB_REGISTER, job_id=job.id,
+            status=EVAL_STATUS_PENDING, annotate_plan=True)
+        ev.job_modify_index = index
+        h.process(job.type if job.type in self.config.enabled_schedulers
+                  else JOB_TYPE_SERVICE, ev)
+        plan = h.plans[-1] if h.plans else None
+        from ..utils.codec import to_wire
+        annotations = (to_wire(plan.annotations)
+                       if plan is not None and plan.annotations else None)
+        final_eval = h.evals[-1] if h.evals else ev
+        return {
+            "annotations": annotations,
+            "failed_tg_allocs": {tg: to_wire(m) for tg, m in
+                                 (final_eval.failed_tg_allocs or {}).items()},
+            "diff": job_diff(old_job, job) if diff else None,
+            "job_modify_index": old_job.job_modify_index if old_job else 0,
+            "next_version": (old_job.version + 1
+                             if old_job is not None
+                             and old_job.specchanged(job) else
+                             old_job.version if old_job else 0),
+        }
+
+    def scale_job(self, namespace: str, job_id: str, group: str,
+                  count: Optional[int] = None, message: str = "",
+                  error: bool = False) -> Optional[Evaluation]:
+        """Job.Scale (nomad/job_endpoint.go Scale:969): adjust one task
+        group's count within its scaling policy bounds; always records a
+        scaling event (the autoscaler's audit trail)."""
+        from ..models.evaluation import TRIGGER_JOB_SCALE
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            raise KeyError(f"job {job_id} not found")
+        if job.stopped():
+            raise ValueError(f"job {job_id} is stopped")
+        job = job.copy()
+        tg = job.lookup_task_group(group)
+        if tg is None:
+            raise KeyError(f"task group {group!r} not found in {job_id}")
+        ev = None
+        if count is not None and not error:
+            if tg.scaling is not None:
+                if count < tg.scaling.min:
+                    raise ValueError(
+                        f"count {count} below scaling policy minimum "
+                        f"{tg.scaling.min}")
+                if tg.scaling.max and count > tg.scaling.max:
+                    raise ValueError(
+                        f"count {count} above scaling policy maximum "
+                        f"{tg.scaling.max}")
+            prev = tg.count
+            tg.count = count
+            ev = self.register_job(job, triggered_by=TRIGGER_JOB_SCALE)
+            message = message or f"scaled from {prev} to {count}"
+        self.raft_apply("scaling_event", dict(
+            namespace=namespace, job_id=job_id,
+            event=dict(task_group=group, count=count, message=message,
+                       error=error, eval_id=ev.id if ev else "",
+                       time=int(time.time()))))
+        return ev
+
+    def _apply_scaling_event(self, index: int, p: dict) -> None:
+        self.store.add_scaling_event(index, p["namespace"], p["job_id"],
+                                     p["event"])
 
     # -- deployment endpoints (nomad/deployment_endpoint.go) -----------
     def promote_deployment(self, deployment_id: str,

@@ -1,0 +1,186 @@
+"""Event stream: change events from FSM commits, fan-out to subscribers.
+
+Reference semantics: nomad/stream/event_broker.go (EventBroker:24,
+Publish:76, Subscribe:94 — ring buffer + per-topic filtered
+subscriptions), nomad/state/events.go (eventsFromChanges — mapping FSM
+log types to topic/type/key events), and nomad/stream/ndjson.go (the
+HTTP NDJSON bridge lives in api/http.py's /v1/event/stream route).
+
+Topics mirror structs.TopicJob/Eval/Alloc/Deployment/Node; filter keys
+are the object IDs. The ring buffer holds the last `size` event batches
+so a new subscriber can replay recent history from a given index.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+TOPIC_JOB = "Job"
+TOPIC_EVAL = "Evaluation"
+TOPIC_ALLOC = "Allocation"
+TOPIC_DEPLOYMENT = "Deployment"
+TOPIC_NODE = "Node"
+TOPIC_ALL = "*"
+
+ALL_KEYS = "*"
+
+
+@dataclass
+class Event:
+    topic: str = ""
+    type: str = ""              # e.g. JobRegistered, NodeDrain, PlanResult
+    key: str = ""               # primary id (job id, node id, ...)
+    namespace: str = ""
+    index: int = 0
+    payload: dict = field(default_factory=dict)
+
+    def matches(self, topics: Dict[str, List[str]]) -> bool:
+        for topic, keys in topics.items():
+            if topic not in (TOPIC_ALL, self.topic):
+                continue
+            if not keys or ALL_KEYS in keys or self.key in keys:
+                return True
+        return False
+
+
+class Subscription:
+    """One consumer's view: a bounded queue of matching events."""
+
+    def __init__(self, broker: "EventBroker", topics: Dict[str, List[str]],
+                 max_queued: int = 1024):
+        self._broker = broker
+        self.topics = topics
+        self._cond = threading.Condition()
+        self._queue: List[Event] = []
+        self._max = max_queued
+        self.closed = False
+
+    def deliver(self, events: List[Event]) -> None:
+        matched = [e for e in events if e.matches(self.topics)]
+        if not matched:
+            return
+        with self._cond:
+            self._queue.extend(matched)
+            if len(self._queue) > self._max:
+                # drop oldest — a slow consumer must not block the broker
+                del self._queue[:len(self._queue) - self._max]
+            self._cond.notify_all()
+
+    def next_events(self, timeout_s: float = 10.0) -> List[Event]:
+        """Block until events arrive (or timeout -> empty list)."""
+        with self._cond:
+            if not self._queue:
+                self._cond.wait(timeout_s)
+            out, self._queue = self._queue, []
+            return out
+
+    def unsubscribe(self) -> None:
+        self.closed = True
+        self._broker._remove(self)
+        with self._cond:
+            self._cond.notify_all()
+
+
+class EventBroker:
+    def __init__(self, size: int = 4096):
+        self._l = threading.Lock()
+        self._buffer: List[Event] = []   # ring of recent events
+        self._size = size
+        self._subs: List[Subscription] = []
+        self.latest_index = 0
+
+    def publish(self, events: List[Event]) -> None:
+        if not events:
+            return
+        with self._l:
+            self._buffer.extend(events)
+            if len(self._buffer) > self._size:
+                del self._buffer[:len(self._buffer) - self._size]
+            self.latest_index = max(self.latest_index,
+                                    max(e.index for e in events))
+            subs = list(self._subs)
+        for s in subs:
+            s.deliver(events)
+
+    def subscribe(self, topics: Optional[Dict[str, List[str]]] = None,
+                  from_index: int = 0) -> Tuple[Subscription, List[Event]]:
+        """Returns the subscription plus any buffered events newer than
+        from_index (replay for late joiners)."""
+        topics = topics or {TOPIC_ALL: [ALL_KEYS]}
+        sub = Subscription(self, topics)
+        with self._l:
+            backlog = [e for e in self._buffer
+                       if e.index > from_index and e.matches(topics)]
+            self._subs.append(sub)
+        return sub, backlog
+
+    def _remove(self, sub: Subscription) -> None:
+        with self._l:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+
+# -- FSM commit -> events (nomad/state/events.go eventsFromChanges) ----
+
+def events_from_apply(msg_type: str, payload: dict, index: int) -> List[Event]:
+    from ..utils.codec import to_wire
+    out: List[Event] = []
+
+    def add(topic, etype, key, namespace="", obj=None):
+        out.append(Event(topic=topic, type=etype, key=key,
+                         namespace=namespace, index=index,
+                         payload=to_wire(obj) if obj is not None else {}))
+
+    if msg_type == "job_register":
+        job = payload["job"]
+        add(TOPIC_JOB, "JobRegistered", job.id, job.namespace, job)
+    elif msg_type == "job_deregister":
+        add(TOPIC_JOB, "JobDeregistered", payload["job_id"],
+            payload["namespace"])
+    elif msg_type == "eval_update":
+        for ev in payload.get("evals", []):
+            add(TOPIC_EVAL, "EvaluationUpdated", ev.id, ev.namespace, ev)
+    elif msg_type == "node_register":
+        node = payload["node"]
+        add(TOPIC_NODE, "NodeRegistration", node.id)
+    elif msg_type == "node_deregister":
+        for nid in payload.get("node_ids", []):
+            add(TOPIC_NODE, "NodeDeregistration", nid)
+    elif msg_type == "node_status_update":
+        add(TOPIC_NODE, "NodeStatusUpdate", payload["node_id"])
+        out[-1].payload = {"status": payload.get("status", "")}
+    elif msg_type == "node_drain_update":
+        add(TOPIC_NODE, "NodeDrain", payload["node_id"])
+    elif msg_type == "node_eligibility_update":
+        add(TOPIC_NODE, "NodeEligibility", payload["node_id"])
+        out[-1].payload = {"eligibility": payload.get("eligibility", "")}
+    elif msg_type == "alloc_client_update":
+        for a in payload.get("allocs", []):
+            add(TOPIC_ALLOC, "AllocationUpdated", a.id, a.namespace)
+            out[-1].payload = {"client_status": a.client_status}
+        for ev in payload.get("evals", []):
+            add(TOPIC_EVAL, "EvaluationUpdated", ev.id, ev.namespace, ev)
+    elif msg_type == "alloc_desired_transition":
+        for aid in payload.get("alloc_ids", []):
+            add(TOPIC_ALLOC, "AllocationUpdateDesiredStatus", aid)
+    elif msg_type == "plan_results":
+        for a in payload.get("allocs_placed", []):
+            add(TOPIC_ALLOC, "PlanResult", a.id, a.namespace)
+        for a in payload.get("allocs_stopped", []):
+            add(TOPIC_ALLOC, "AllocationUpdateDesiredStatus", a.id,
+                a.namespace)
+        d = payload.get("deployment")
+        if d is not None:
+            add(TOPIC_DEPLOYMENT, "DeploymentStatusUpdate", d.id,
+                d.namespace, d)
+    elif msg_type == "deployment_status_update":
+        u = payload["update"]
+        add(TOPIC_DEPLOYMENT, "DeploymentStatusUpdate", u.deployment_id)
+        out[-1].payload = {"status": u.status,
+                           "status_description": u.status_description}
+    elif msg_type == "deployment_promotion":
+        add(TOPIC_DEPLOYMENT, "DeploymentPromotion",
+            payload["deployment_id"])
+    return out

@@ -51,6 +51,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "alloc_desired_transition": {"transition": DesiredTransition,
                                  "evals": [Evaluation]},
     "job_stability": {},
+    "scaling_event": {},
     "deployment_delete": {},
     "periodic_launch": {},
 }

@@ -836,6 +836,28 @@ class StateStore(StateSnapshot):
             root = root.with_index("deployments", index)
             self._publish(root)
 
+    # -- scaling events (state_store.go UpsertScalingEvent) ------------
+    JOB_TRACKED_SCALING_EVENTS = 20
+
+    def add_scaling_event(self, index: int, namespace: str, job_id: str,
+                          event: dict) -> None:
+        with self._lock:
+            root = self._root
+            key = (namespace, job_id)
+            events = list(root.table("scaling_events").get(key) or [])
+            event = dict(event, create_index=index)
+            events.insert(0, event)
+            del events[self.JOB_TRACKED_SCALING_EVENTS:]
+            root = root.with_table(
+                "scaling_events",
+                root.table("scaling_events").set(key, events))
+            root = root.with_index("scaling_events", index)
+            self._publish(root)
+
+    def scaling_events(self, namespace: str, job_id: str) -> List[dict]:
+        return list(self._root.table("scaling_events")
+                    .get((namespace, job_id)) or [])
+
     # -- scheduler config ---------------------------------------------
     def set_scheduler_config(self, index: int,
                              config: SchedulerConfiguration) -> None:
@@ -871,6 +893,9 @@ class StateStore(StateSnapshot):
         plain["periodic_launches"] = [
             {"key": list(k), "launch_time": v}
             for k, v in root.table("periodic_launches").items()]
+        plain["scaling_events"] = [
+            {"key": list(k), "events": v}
+            for k, v in root.table("scaling_events").items()]
         return out
 
     def restore(self, data: dict) -> None:
@@ -939,6 +964,11 @@ class StateStore(StateSnapshot):
             for entry in data["tables"].get("periodic_launches", []):
                 t = t.set(tuple(entry["key"]), entry["launch_time"])
             root = root.with_table("periodic_launches", t)
+
+            t = root.table("scaling_events")
+            for entry in data["tables"].get("scaling_events", []):
+                t = t.set(tuple(entry["key"]), list(entry["events"]))
+            root = root.with_table("scaling_events", t)
 
             cfg = data["tables"].get("scheduler_config")
             if cfg:
