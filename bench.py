@@ -8,15 +8,40 @@ container deployments per second" (~5k/s cluster-wide on 5k nodes,
 measured placements/sec over that 5000/s reference rate.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Robustness: the ambient accelerator is probed in a subprocess with a
+timeout before this process touches JAX; if the probe fails or hangs the
+run falls back to the host CPU platform, and a hard failure still emits
+the JSON line with an "error" field instead of a traceback (VERDICT
+round 1, item 1b).
 """
 
 import json
+import sys
 import time
+import traceback
 
 import numpy as np
 
+BASELINE_RATE = 5000.0  # C1M: "thousands of deployments per second"
 
-def main() -> None:
+
+def _init_backend() -> str:
+    """Pick a usable JAX backend BEFORE this process initializes one.
+    The ambient platform (the axon TPU under the driver) is probed in a
+    subprocess with a timeout, because a dead tunnel hangs jax.devices()
+    rather than raising; post-init platform switches are silently ignored
+    by jax, so the decision must be made up front. Returns the platform
+    name in use."""
+    from nomad_tpu.utils.platform import force_cpu_platform, probe_accelerator
+
+    platform = probe_accelerator(timeout_s=120.0)
+    if platform is None or platform == "cpu":
+        force_cpu_platform(1)
+        platform = "cpu"
+    return platform
+
+
+def run_kernel_bench():
     from nomad_tpu.ops.select import SelectKernel, SelectRequest
 
     n_nodes = 1000
@@ -47,24 +72,35 @@ def main() -> None:
     placed = 0
     t0 = time.perf_counter()
     remaining = total_placements
-    dispatch_times = []
     while remaining > 0:
         count = min(batch, remaining)
-        t_d = time.perf_counter()
         res = kernel.select(make_req(count))
-        dispatch_times.append(time.perf_counter() - t_d)
         placed += res.placed
         remaining -= count
     elapsed = time.perf_counter() - t0
+    return placed / elapsed
 
-    per_sec = placed / elapsed
-    baseline_rate = 5000.0  # C1M: "thousands of deployments per second"
-    print(json.dumps({
-        "metric": "placements_per_sec_batch10k_1k_nodes",
-        "value": round(per_sec, 1),
-        "unit": "placements/s",
-        "vs_baseline": round(per_sec / baseline_rate, 2),
-    }))
+
+def main() -> None:
+    try:
+        platform = _init_backend()
+        per_sec = run_kernel_bench()
+        print(json.dumps({
+            "metric": "placements_per_sec_batch10k_1k_nodes",
+            "value": round(per_sec, 1),
+            "unit": "placements/s",
+            "vs_baseline": round(per_sec / BASELINE_RATE, 2),
+            "platform": platform,
+        }))
+    except Exception as e:
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "placements_per_sec_batch10k_1k_nodes",
+            "value": 0.0,
+            "unit": "placements/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
 
 
 if __name__ == "__main__":

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import time
@@ -38,14 +39,14 @@ def cmd_agent(args) -> int:
     if not args.dev:
         print("only -dev mode is supported in this build", file=sys.stderr)
         return 1
-    # The scheduler kernels need a working JAX backend. If the TPU tunnel
-    # is unavailable (e.g. held by another process), fall back to CPU so
-    # the agent still serves.
-    import jax
-    try:
-        jax.devices()
-    except RuntimeError:
-        jax.config.update("jax_platforms", "cpu")
+    # The scheduler kernels need a working JAX backend. A dead TPU tunnel
+    # can hang (not raise) on first device use, so probe it in a
+    # subprocess with a timeout and fall back to CPU so the agent still
+    # serves (utils/platform.py).
+    from ..utils.platform import force_cpu_platform, probe_accelerator
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu" and \
+            probe_accelerator(timeout_s=60.0) is None:
+        force_cpu_platform(1)
         print("    WARNING: TPU backend unavailable; scheduling on CPU")
     server = Server(ServerConfig(num_schedulers=args.num_schedulers))
     server.start()
@@ -625,3 +626,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.print_help()
         return 1
     return fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -136,8 +136,13 @@ class PlanApplier:
 
         remove_ids = {a.id for a in plan.node_update.get(node_id, [])}
         remove_ids |= {a.id for a in plan.node_preemptions.get(node_id, [])}
+        # In-place updates reuse the alloc ID: the planned version replaces
+        # the snapshot version, so drop the old copy before appending or the
+        # node double-counts its resources (plan_apply.go:674-678).
+        placements = plan.node_allocation.get(node_id, [])
+        remove_ids |= {a.id for a in placements}
         proposed = [a for a in snapshot.allocs_by_node(node_id)
                     if not a.terminal_status() and a.id not in remove_ids]
-        proposed.extend(plan.node_allocation.get(node_id, []))
+        proposed.extend(placements)
         fit, _dim, _used = AllocsFit(node, proposed)
         return fit

@@ -1,24 +1,15 @@
 """Test configuration: force JAX onto a virtual 8-device CPU platform so
-sharding/pjit tests exercise multi-chip layouts without TPU hardware.
-
-The image's sitecustomize imports jax at interpreter startup with
-JAX_PLATFORMS=axon already in the environment, so jax's config default
-is baked before this file runs — env-var edits here are too late.
-jax.config.update works because backends initialize lazily at first use.
-"""
+sharding/pjit tests exercise multi-chip layouts without TPU hardware
+(nomad_tpu.utils.platform.force_cpu_platform does the heavy lifting —
+the image's sitecustomize pins JAX_PLATFORMS=axon, so the config must be
+flipped before any backend initializes)."""
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-import jax  # noqa: E402
+from nomad_tpu.utils.platform import force_cpu_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-try:
-    jax.config.update("jax_num_cpu_devices", 8)
-except Exception:
-    pass  # older jax: XLA_FLAGS fallback above covers it
+force_cpu_platform(8)

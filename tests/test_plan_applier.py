@@ -1,0 +1,71 @@
+"""Plan applier regression tests (reference: nomad/plan_apply_test.go).
+
+The critical semantics: in-place updates reuse the alloc ID, so the
+applier must drop the snapshot copy of any alloc whose ID appears in
+plan.node_allocation before fit-checking (plan_apply.go:674-678) —
+otherwise the node double-counts resources and reserved ports collide
+with themselves.
+"""
+
+import copy
+
+from nomad_tpu import mock
+from nomad_tpu.models import Plan
+from nomad_tpu.server.core import Server, ServerConfig
+
+
+def _server():
+    srv = Server(ServerConfig(num_schedulers=0, heartbeat_ttl_s=60.0))
+    return srv
+
+
+def test_inplace_update_same_id_not_double_counted():
+    """A plan updating an existing port-bearing alloc in place (same ID,
+    same reserved port) must be accepted, not rejected as a phantom port
+    collision."""
+    srv = _server()
+    node = mock.node()
+    existing = mock.alloc()
+    existing.node_id = node.id
+    existing.client_status = "running"
+    srv.store.upsert_node(100, node)
+    srv.store.upsert_allocs(101, [existing])
+
+    updated = copy.deepcopy(existing)
+    updated.job = existing.job      # in-place update: same ID, same ports
+
+    plan = Plan(priority=50)
+    plan.job = existing.job
+    plan.node_allocation = {node.id: [updated]}
+    plan.snapshot_index = srv.store.latest_index()
+
+    result = srv.plan_applier.apply(plan)
+    full, expected, actual = result.full_commit(plan)
+    assert full, (
+        f"in-place update rejected: committed {actual}/{expected}; "
+        f"refresh_index={result.refresh_index}")
+
+
+def test_true_port_collision_still_rejected():
+    """Sanity: a genuinely conflicting placement (different alloc ID,
+    same reserved port) is still rejected."""
+    srv = _server()
+    node = mock.node()
+    existing = mock.alloc()
+    existing.node_id = node.id
+    existing.client_status = "running"
+    srv.store.upsert_node(100, node)
+    srv.store.upsert_allocs(101, [existing])
+
+    clash = mock.alloc()            # fresh ID, same reserved port 5000
+    clash.node_id = node.id
+
+    plan = Plan(priority=50)
+    plan.job = clash.job
+    plan.node_allocation = {node.id: [clash]}
+    plan.snapshot_index = srv.store.latest_index()
+
+    result = srv.plan_applier.apply(plan)
+    full, _, _ = result.full_commit(plan)
+    assert not full
+    assert result.refresh_index > 0
