@@ -1,0 +1,1 @@
+from .ladder import run_ladder  # noqa: F401

@@ -1,0 +1,213 @@
+"""End-to-end scheduler benchmarks over the BASELINE.json ladder.
+
+Unlike the raw-kernel benchmark (bench.py run_kernel_bench), every
+number here drives the REAL control plane path: state store snapshot →
+GenericScheduler.process → reconciler → placement kernel → plan →
+plan application back into the store — the same work the reference's
+`nomad.worker.invoke_scheduler_service` metric times
+(/root/reference/nomad/worker.go:199).
+
+Ladder configs (BASELINE.md):
+  #2  batch job count=10k over 1k nodes        -> placements/sec e2e
+  #3  service job w/ spread+affinity, 10k nodes -> p99 Process() latency
+  #4  mixed-priority preemption, 1k nodes       -> preemption evals/sec
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import numpy as np
+
+
+def _seed_nodes(h, n: int, dcs: int = 4):
+    from ..mock import fixtures as mock
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        node.name = f"node-{i}"
+        node.datacenter = f"dc{(i % dcs) + 1}"
+        node.meta["rack"] = f"r{i % 16}"
+        node.compute_class()
+        nodes.append(node)
+        h.store.upsert_node(h.next_index(), node)
+    return nodes
+
+
+def _eval_for(job):
+    from ..models import (Evaluation, EVAL_STATUS_PENDING,
+                          TRIGGER_JOB_REGISTER)
+    from ..utils.ids import generate_uuid
+    return Evaluation(
+        id=generate_uuid(), namespace=job.namespace, priority=job.priority,
+        triggered_by=TRIGGER_JOB_REGISTER, job_id=job.id,
+        status=EVAL_STATUS_PENDING, type=job.type)
+
+
+def bench_batch_e2e(n_nodes: int = 1000, count: int = 10000,
+                    warm: bool = True) -> Dict:
+    """Ladder #2: one batch job, count instances, through the full
+    scheduler. Returns {rate, process_s, placed}."""
+    from ..mock import fixtures as mock
+    from ..scheduler.harness import Harness
+
+    def once() -> Dict:
+        h = Harness()
+        _seed_nodes(h, n_nodes, dcs=1)
+        job = mock.batch_job()
+        job.datacenters = ["dc1"]
+        job.task_groups[0].count = count
+        h.store.upsert_job(h.next_index(), job)
+        t0 = time.perf_counter()
+        h.process("batch", _eval_for(job))
+        elapsed = time.perf_counter() - t0
+        placed = sum(len(a) for a in h.plans[0].node_allocation.values()) \
+            if h.plans else 0
+        return {"rate": placed / elapsed, "process_s": elapsed,
+                "placed": placed}
+
+    if warm:
+        once()  # compile + caches
+    return once()
+
+
+def bench_service_p99(n_nodes: int = 10000, n_evals: int = 50,
+                      count: int = 10) -> Dict:
+    """Ladder #3: service jobs with spread{} + affinity{} over a 10k-node
+    table; p99 of full Process() latency across n_evals evals (the
+    BASELINE target is p99 <= 100 ms)."""
+    from ..mock import fixtures as mock
+    from ..models import Affinity, Spread, SpreadTarget
+    from ..scheduler.harness import Harness
+
+    h = Harness()
+    _seed_nodes(h, n_nodes)
+
+    def make_job(i: int):
+        job = mock.job()
+        job.id = f"svc-{i}"
+        job.datacenters = [f"dc{d}" for d in (1, 2, 3, 4)]
+        tg = job.task_groups[0]
+        tg.count = count
+        # drop the dynamic-port ask so the bench isolates scheduling,
+        # not port bookkeeping; ladder #3 is about spread/affinity
+        for t in tg.tasks:
+            t.resources.networks = []
+        tg.networks = []
+        tg.spreads = [Spread(attribute="${node.datacenter}", weight=50,
+                             spread_target=[SpreadTarget("dc1", 40),
+                                            SpreadTarget("dc2", 30)]),
+                      Spread(attribute="${meta.rack}", weight=30)]
+        tg.affinities = [Affinity(ltarget="${meta.rack}", rtarget="r3",
+                                  operand="=", weight=50)]
+        return job
+
+    # warm compile for this table shape
+    wjob = make_job(10**6)
+    h.store.upsert_job(h.next_index(), wjob)
+    h.process("service", _eval_for(wjob))
+
+    times: List[float] = []
+    placed = 0
+    t_all = time.perf_counter()
+    for i in range(n_evals):
+        job = make_job(i)
+        h.store.upsert_job(h.next_index(), job)
+        t0 = time.perf_counter()
+        h.process("service", _eval_for(job))
+        times.append(time.perf_counter() - t0)
+    wall = time.perf_counter() - t_all
+    for plan in h.plans[1:]:  # skip warm-up plan
+        placed += sum(len(a) for a in plan.node_allocation.values())
+    arr = np.array(times)
+    return {
+        "p50_ms": float(np.percentile(arr, 50) * 1e3),
+        "p99_ms": float(np.percentile(arr, 99) * 1e3),
+        "rate": placed / wall,
+        "placed": placed,
+    }
+
+
+def bench_preemption(n_nodes: int = 1000, n_evals: int = 10,
+                     count: int = 50) -> Dict:
+    """Ladder #4: nodes saturated by low-priority batch allocs; a
+    high-priority service job must preempt to place. Measures e2e evals
+    with the preemption path live."""
+    from ..mock import fixtures as mock
+    from ..scheduler.harness import Harness
+
+    h = Harness()
+    h.store.set_scheduler_config(
+        h.next_index(),
+        _preemption_config())
+    _seed_nodes(h, n_nodes, dcs=1)
+    # fill: one low-prio batch job consuming most of each node
+    filler = mock.batch_job()
+    filler.datacenters = ["dc1"]
+    filler.priority = 20
+    filler.task_groups[0].count = n_nodes
+    filler.task_groups[0].tasks[0].resources.cpu = 3300
+    filler.task_groups[0].tasks[0].resources.memory_mb = 6000
+    h.store.upsert_job(h.next_index(), filler)
+    h.process("batch", _eval_for(filler))
+
+    times: List[float] = []
+    placed = 0
+    t_all = time.perf_counter()
+    for i in range(n_evals):
+        hi = mock.job()
+        hi.id = f"hi-{i}"
+        hi.priority = 80
+        hi.datacenters = ["dc1"]
+        tg = hi.task_groups[0]
+        tg.count = count
+        for t in tg.tasks:
+            t.resources.networks = []
+            t.resources.cpu = 2000
+            t.resources.memory_mb = 4000
+        tg.networks = []
+        h.store.upsert_job(h.next_index(), hi)
+        t0 = time.perf_counter()
+        h.process("service", _eval_for(hi))
+        times.append(time.perf_counter() - t0)
+    wall = time.perf_counter() - t_all
+    preempted = 0
+    for plan in h.plans[1:]:
+        placed += sum(len(a) for a in plan.node_allocation.values())
+        preempted += sum(len(a) for a in plan.node_preemptions.values())
+    return {
+        "rate": placed / wall,
+        "placed": placed,
+        "preempted": preempted,
+        "p99_ms": float(np.percentile(np.array(times), 99) * 1e3),
+    }
+
+
+def _preemption_config():
+    from ..models import PreemptionConfig, SchedulerConfiguration
+    return SchedulerConfiguration(
+        preemption_config=PreemptionConfig(
+            system_scheduler_enabled=True,
+            batch_scheduler_enabled=True,
+            service_scheduler_enabled=True))
+
+
+def run_ladder(quick: bool = False) -> Dict:
+    """Run the full ladder; returns a flat dict of results."""
+    out: Dict = {}
+    r2 = bench_batch_e2e()
+    out["e2e_placements_per_sec"] = round(r2["rate"], 1)
+    out["e2e_batch10k_process_s"] = round(r2["process_s"], 3)
+    out["e2e_batch10k_placed"] = r2["placed"]
+    r3 = bench_service_p99(n_nodes=2000 if quick else 10000,
+                           n_evals=10 if quick else 50)
+    out["service_p99_ms"] = round(r3["p99_ms"], 1)
+    out["service_p50_ms"] = round(r3["p50_ms"], 1)
+    out["service_placements_per_sec"] = round(r3["rate"], 1)
+    r4 = bench_preemption(n_nodes=200 if quick else 1000,
+                          n_evals=3 if quick else 10)
+    out["preemption_placements_per_sec"] = round(r4["rate"], 1)
+    out["preemption_preempted"] = r4["preempted"]
+    out["preemption_p99_ms"] = round(r4["p99_ms"], 1)
+    return out

@@ -62,11 +62,11 @@ def _bucket_k(k: int) -> int:
 @dataclasses.dataclass
 class SelectRequest:
     """Host-side inputs for placing `count` instances of one task group."""
-    ask: np.ndarray                  # f32[3] cpu/mem/disk per instance
+    ask: np.ndarray                  # f32[D] cpu/mem/disk[/mbits] per instance
     count: int
     feasible: np.ndarray             # bool[N] all static checks combined
-    capacity: np.ndarray             # f32[N,3]
-    used: np.ndarray                 # f32[N,3] live + plan overlay
+    capacity: np.ndarray             # f32[N,D]
+    used: np.ndarray                 # f32[N,D] live + plan overlay
     desired_count: float             # anti-affinity denominator (tg count)
     tg_collisions: np.ndarray        # i32[N] proposed allocs of job+tg
     job_count: np.ndarray            # i32[N] proposed allocs of job
@@ -99,7 +99,7 @@ class SelectResult:
     top_scores: np.ndarray           # f32[K, TOP_K]
     nodes_evaluated: int
     nodes_filtered: int
-    exhausted_dim: np.ndarray        # i32[K, 3] counts per cpu/mem/disk
+    exhausted_dim: np.ndarray        # i32[K, D] counts per DIM_NAMES dim
     placed: int
 
 
@@ -145,12 +145,14 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
         after = used + ask[None, :]
         fit_dims = after <= capacity + 1e-6
         fit = jnp.all(fit_dims, axis=1)
-        # first failing dimension counts (metrics): cpu > mem > disk
-        fail_cpu = feas & ~fit_dims[:, 0]
-        fail_mem = feas & fit_dims[:, 0] & ~fit_dims[:, 1]
-        fail_disk = feas & fit_dims[:, 0] & fit_dims[:, 1] & ~fit_dims[:, 2]
-        exhausted = jnp.stack([fail_cpu.sum(), fail_mem.sum(),
-                               fail_disk.sum()]).astype(jnp.int32)
+        # first-failing-dimension counts (metrics), dimension-generic in
+        # DIM_NAMES order (cpu > memory > disk > network)
+        prefix_ok = jnp.cumprod(fit_dims.astype(jnp.int32), axis=1)
+        earlier_ok = jnp.concatenate(
+            [jnp.ones((n, 1), dtype=bool), prefix_ok[:, :-1].astype(bool)],
+            axis=1)
+        first_fail = feas[:, None] & earlier_ok & ~fit_dims
+        exhausted = first_fail.sum(axis=0).astype(jnp.int32)
 
         # ---- bin-pack / spread fit score ------------------------------
         free_cpu = 1.0 - after[:, 0] / cap_cpu

@@ -23,15 +23,17 @@ from ..models.job import (CONSTRAINT_DISTINCT_HOSTS,
                           CONSTRAINT_DISTINCT_PROPERTY)
 from .targets import TargetColumns, constraint_mask
 
-RES_DIMS = 3  # cpu_shares, memory_mb, disk_mb
-DIM_NAMES = ("cpu", "memory", "disk")
+RES_DIMS = 4  # cpu_shares, memory_mb, disk_mb, network_mbits
+DIM_NAMES = ("cpu", "memory", "disk", "network")
 
 
-def _alloc_usage(alloc) -> Tuple[float, float, float]:
+def _alloc_usage(alloc) -> Tuple[float, float, float, float]:
     c = alloc.comparable_resources()
     if c is None:
-        return (0.0, 0.0, 0.0)
-    return (float(c.cpu_shares), float(c.memory_mb), float(c.disk_mb))
+        return (0.0, 0.0, 0.0, 0.0)
+    mbits = sum(nw.mbits for nw in c.networks)
+    return (float(c.cpu_shares), float(c.memory_mb), float(c.disk_mb),
+            float(mbits))
 
 
 class NodeTable:
@@ -43,6 +45,23 @@ class NodeTable:
         self.ids = [n.id for n in nodes]
         self.id_to_idx = {nid: i for i, nid in enumerate(self.ids)}
         self.cols = TargetColumns(nodes)
+        # applied-alloc registry for the delta path (alloc id -> the
+        # object version whose usage is currently accounted); a Hamt so
+        # clone_for_deltas is O(1) even at 2M allocs
+        from ..utils.hamt import Hamt
+        self.alloc_by_id: Hamt = Hamt()
+        # attribute dictionary-encodings, valid per table version
+        self._attr_codes_cache: Dict[str, Tuple[np.ndarray, List[str]]] = {}
+        # until finalize() seals the table it is private to its builder:
+        # bulk loads append rows in place and batch the registry, avoiding
+        # O(allocs-per-node^2) copy-on-write during build
+        self._sealed = False
+        self._pending_allocs: List[Tuple[str, object]] = []
+        # cross-eval static feasibility memoization, content-addressed by
+        # constraint/driver/volume set (the columnar analog of computed-
+        # node-class memoization, feasible.go:1026-1118); valid for this
+        # table version — node attribute columns are immutable here
+        self.mask_cache: Dict[Tuple, List] = {}
 
         self.capacity = np.zeros((self.n, RES_DIMS), dtype=np.float32)
         self.ready = np.zeros(self.n, dtype=bool)
@@ -53,6 +72,14 @@ class NodeTable:
             self.capacity[i, 0] = res.cpu_shares - reserved.cpu_shares
             self.capacity[i, 1] = res.memory_mb - reserved.memory_mb
             self.capacity[i, 2] = res.disk_mb - reserved.disk_mb
+            # network bandwidth as a fit dimension: the reference checks
+            # it per-device inside BinPackIterator via AssignNetwork
+            # (structs/network.go:406); here total free mbits is a kernel
+            # column so the scan never over-commits a node the host-side
+            # assigner would then reject
+            networks = (node.node_resources.networks
+                        if node.node_resources else [])
+            self.capacity[i, 3] = sum(nw.mbits for nw in networks)
             self.ready[i] = node.ready()
             self.datacenters[i] = node.datacenter
 
@@ -69,7 +96,7 @@ class NodeTable:
             idx.set_node(node)
             self._net_bits[i] = self._merge_bits(idx)
 
-        self._free_ports_dirty = True
+        self._free_ports_dirty = None  # None == all rows dirty
 
     @staticmethod
     def _merge_bits(idx: NetworkIndex) -> int:
@@ -102,15 +129,50 @@ class NodeTable:
         t.finalize()
         return t
 
-    def add_alloc_usage(self, i: int, alloc) -> None:
-        u = _alloc_usage(alloc)
-        self.base_used[i, 0] += u[0]
-        self.base_used[i, 1] += u[1]
-        self.base_used[i, 2] += u[2]
-        self.live_allocs[i].append(alloc)
+    @classmethod
+    def build_all(cls, snapshot) -> "NodeTable":
+        """Resident-table build: ALL nodes regardless of status/DC —
+        readiness and datacenter become per-eval feasibility masks so
+        one table serves every eval (SURVEY §7.2 step 8)."""
+        return cls.build(snapshot, datacenters=None, include_all=True)
+
+    def clone_for_deltas(self) -> "NodeTable":
+        """Copy-on-write clone sharing the immutable node columns
+        (capacity, attrs, ids) but with private usage state, so alloc
+        deltas applied to the clone never mutate a version an in-flight
+        eval is reading (MVCC for the device-facing cache)."""
+        t = NodeTable.__new__(NodeTable)
+        t.nodes = self.nodes
+        t.n = self.n
+        t.ids = self.ids
+        t.id_to_idx = self.id_to_idx
+        t.cols = self.cols
+        t.capacity = self.capacity
+        t.ready = self.ready
+        t.datacenters = self.datacenters
+        t.base_used = self.base_used.copy()
+        # outer list copied; ROW lists are immutable by convention (the
+        # mutators replace rows instead of appending in place), so inner
+        # lists are shared between versions
+        t.live_allocs = self.live_allocs[:]
+        t._net_bits = self._net_bits[:]
+        t.free_ports = self.free_ports.copy()
+        t._port_col_cache = {}
+        t._free_ports_dirty = (None if self._free_ports_dirty is None
+                               else set(self._free_ports_dirty))
+        self._seal()
+        t.alloc_by_id = self.alloc_by_id  # persistent map: O(1) share
+        t.mask_cache = self.mask_cache  # node columns shared => masks too
+        t._attr_codes_cache = self._attr_codes_cache
+        t._sealed = True
+        t._pending_allocs = []
+        return t
+
+    @staticmethod
+    def _alloc_port_bits(alloc) -> int:
         res = alloc.allocated_resources
+        bits = 0
         if res is not None:
-            bits = self._net_bits[i]
             for nw in res.shared.networks:
                 for ports in (nw.reserved_ports, nw.dynamic_ports):
                     for p in ports:
@@ -122,19 +184,94 @@ class NodeTable:
                         for p in ports:
                             if p.value > 0:
                                 bits |= 1 << p.value
-            self._net_bits[i] = bits
-        self._free_ports_dirty = True
+        return bits
+
+    def add_alloc_usage(self, i: int, alloc) -> None:
+        u = _alloc_usage(alloc)
+        self.base_used[i, 0] += u[0]
+        self.base_used[i, 1] += u[1]
+        self.base_used[i, 2] += u[2]
+        self.base_used[i, 3] += u[3]
+        if self._sealed:
+            self.live_allocs[i] = self.live_allocs[i] + [alloc]  # row CoW
+            self.alloc_by_id = self.alloc_by_id.set(alloc.id, alloc)
+        else:
+            self.live_allocs[i].append(alloc)
+            self._pending_allocs.append((alloc.id, alloc))
+        self._net_bits[i] |= self._alloc_port_bits(alloc)
+        self._mark_ports_dirty(i)
+
+    def remove_alloc_usage(self, i: int, alloc) -> None:
+        """Inverse of add_alloc_usage. Port bits are simply cleared:
+        host ports are exclusive per node, so no other live alloc can
+        hold the same bit."""
+        u = _alloc_usage(alloc)
+        self.base_used[i, 0] -= u[0]
+        self.base_used[i, 1] -= u[1]
+        self.base_used[i, 2] -= u[2]
+        self.base_used[i, 3] -= u[3]
+        self._seal()
+        self.live_allocs[i] = [a for a in self.live_allocs[i]
+                               if a.id != alloc.id]
+        self.alloc_by_id = self.alloc_by_id.delete(alloc.id)
+        bits = self._alloc_port_bits(alloc)
+        # keep ports that the node itself reserves (reserved_host_ports)
+        node_bits = 0
+        node = self.nodes[i]
+        if node.reserved_resources and \
+                node.reserved_resources.reserved_host_ports:
+            idx = NetworkIndex()
+            idx.set_node(node)
+            node_bits = self._merge_bits(idx)
+        self._net_bits[i] &= ~(bits & ~node_bits)
+        self._mark_ports_dirty(i)
+
+    def apply_alloc_change(self, snapshot, alloc_id: str) -> None:
+        """Reconcile one alloc's accounted usage with the snapshot's
+        current version (the resident-table delta path)."""
+        old = self.alloc_by_id.get(alloc_id)
+        new = snapshot.alloc_by_id(alloc_id)
+        new_live = new is not None and not new.terminal_status()
+        if old is not None:
+            i = self.id_to_idx.get(old.node_id)
+            if i is not None:
+                self.remove_alloc_usage(i, old)
+        if new_live:
+            i = self.id_to_idx.get(new.node_id)
+            if i is not None:
+                self.add_alloc_usage(i, new)
+
+    def _mark_ports_dirty(self, i: int) -> None:
+        if self._free_ports_dirty is None:
+            return  # already fully dirty
+        self._free_ports_dirty.add(i)
+
+    def _seal(self) -> None:
+        if self._sealed:
+            return
+        self._sealed = True
+        if self._pending_allocs:
+            self.alloc_by_id = self.alloc_by_id.update(self._pending_allocs)
+            self._pending_allocs = []
 
     def finalize(self) -> None:
-        """Recompute derived columns after usage changes."""
-        if self._free_ports_dirty:
-            from ..models.networks import MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT
-            span = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
-            mask = ((1 << span) - 1) << MIN_DYNAMIC_PORT
-            for i in range(self.n):
-                self.free_ports[i] = span - (self._net_bits[i] & mask).bit_count()
-            self._free_ports_dirty = False
-            self._port_col_cache.clear()
+        """Seal the bulk-load phase and recompute derived port columns
+        for rows whose usage changed."""
+        self._seal()
+        dirty = self._free_ports_dirty
+        if dirty is None:
+            rows = range(self.n)
+        elif dirty:
+            rows = dirty
+        else:
+            return
+        from ..models.networks import MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT
+        span = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+        mask = ((1 << span) - 1) << MIN_DYNAMIC_PORT
+        for i in rows:
+            self.free_ports[i] = span - (self._net_bits[i] & mask).bit_count()
+        self._free_ports_dirty = set()
+        self._port_col_cache.clear()
 
     # -- feasibility columns ------------------------------------------
     def port_used_col(self, port: int) -> np.ndarray:
@@ -192,7 +329,10 @@ class NodeTable:
     def attr_codes(self, attribute: str) -> Tuple[np.ndarray, List[str]]:
         """Dictionary-encode one attribute over nodes.
         Returns (codes i32[N] with code==len(values) meaning missing,
-        values list)."""
+        values list). Cached per table version (attributes immutable)."""
+        hit = self._attr_codes_cache.get(attribute)
+        if hit is not None:
+            return hit
         vals, found = self.cols.resolve(attribute)
         mapping: Dict[str, int] = {}
         codes = np.zeros(self.n, dtype=np.int32)
@@ -209,7 +349,54 @@ class NodeTable:
         values = list(mapping.keys())
         missing = len(values)
         codes[codes == -1] = missing
+        self._attr_codes_cache[attribute] = (codes, values)
         return codes, values
+
+
+class NodeTableCache:
+    """Resident node table shared across evals (SURVEY §7.2 step 8).
+
+    Each refresh produces a NEW table version via copy-on-write
+    (clone_for_deltas), so snapshots taken earlier keep reading their
+    version — the device-facing analog of the store's MVCC roots.
+    Alloc changes apply as row deltas from the store changelog; node-set
+    changes (rare: registration, status flips, drain) trigger a full
+    rebuild because they invalidate the attribute columns."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._table: Optional[NodeTable] = None
+        self._index = -1
+
+    def get(self, snapshot) -> NodeTable:
+        store = snapshot._store
+        target = snapshot.latest_index()
+        with self._lock:
+            if self._table is not None and self._index == target:
+                return self._table
+            if self._table is None or target < self._index:
+                # older snapshot than the cache: serve it a private build
+                if self._table is not None and target < self._index:
+                    return NodeTable.build_all(snapshot)
+                self._table = NodeTable.build_all(snapshot)
+                self._index = target
+                return self._table
+            changes = store.changes_since(self._index, target)
+            if changes is None or any(k == "node" for k, _ in changes):
+                self._table = NodeTable.build_all(snapshot)
+                self._index = target
+                return self._table
+            if changes:
+                # last-write-wins dedupe, then row deltas on a fresh clone
+                seen = dict.fromkeys(aid for _k, aid in changes)
+                t = self._table.clone_for_deltas()
+                for aid in seen:
+                    t.apply_alloc_change(snapshot, aid)
+                t.finalize()
+                self._table = t
+            self._index = target
+            return self._table
 
 
 class ProposedIndex:
@@ -264,7 +451,7 @@ class ProposedIndex:
                     continue
                 # the stub may lack resources; look it up in live allocs
                 usage = _alloc_usage(a)
-                if usage == (0.0, 0.0, 0.0):
+                if not any(usage):
                     for live in table.live_allocs[i]:
                         if live.id == a.id:
                             usage = _alloc_usage(live)

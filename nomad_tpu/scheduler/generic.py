@@ -319,6 +319,7 @@ class GenericScheduler:
         engine.job = self.job
         engine.table = t
         engine.by_dc = {node.datacenter: 1}
+        engine._base_mask = t.ready.copy()
         engine._mask_cache = {}
         engine._net_cache = {}
         mask, _counts = engine.feasibility(tg)
@@ -408,15 +409,20 @@ class GenericScheduler:
                     if stop_prev and missing.previous_alloc is not None:
                         self.plan.remove_update(missing.previous_alloc)
 
-        # record class eligibility for the blocked eval
+        # record class eligibility for the blocked eval — only over nodes
+        # in the iteration set (ready & in-DC): a down node's class must
+        # stay UNKNOWN so BlockedEvals wakes the eval when it recovers
+        # (the resident table holds all nodes; feasible.go's iterator
+        # never saw non-ready ones)
         if self.failed_tg_allocs and self.engine.table is not None:
+            base = self.engine._base_mask
             for tg_name in self.failed_tg_allocs:
                 tg = self.job.lookup_task_group(tg_name)
                 if tg is None:
                     continue
                 mask, _counts = self.engine.feasibility(tg)
                 for i, node in enumerate(self.engine.table.nodes):
-                    if node.computed_class:
+                    if node.computed_class and bool(base[i]):
                         prev = self.ctx.eligibility.class_eligibility.get(
                             node.computed_class, False)
                         self.ctx.eligibility.set_class_eligibility(

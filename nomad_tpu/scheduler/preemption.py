@@ -231,6 +231,19 @@ def find_preemption_placement(snapshot, table, mask, used, ask_vec, job,
         victims = p.preempt_for_task_group(ask)
         if not victims:
             continue
+        # bandwidth guard: victims are chosen by cpu/mem/disk distance,
+        # so verify the eviction also covers the ask's network dimension
+        # (full network-preemption variant: preemption.go PreemptForNetwork
+        # — tracked as the in-kernel preemption milestone)
+        if len(ask_vec) > 3 and ask_vec[3] > 0:
+            freed_mbits = 0.0
+            for v in victims:
+                cr = v.comparable_resources()
+                if cr is not None:
+                    freed_mbits += sum(nw.mbits for nw in cr.networks)
+            if used[i, 3] - freed_mbits + ask_vec[3] > \
+                    table.capacity[i, 3] + 1e-6:
+                continue
         # score: binpack fit after eviction + logistic preemption score
         util = ComparableResources()
         victim_ids = {v.id for v in victims}

@@ -72,13 +72,25 @@ class PlacementEngine:
         self._mask_cache.clear()
 
     def set_nodes(self, datacenters: List[str]) -> int:
-        """Build the node table for ready nodes in the datacenters
-        (readyNodesInDCs, scheduler/util.go:233). Returns node count."""
-        self.table = NodeTable.build(self.snapshot, datacenters=datacenters)
-        self.by_dc = {}
-        for node in self.table.nodes:
-            self.by_dc[node.datacenter] = self.by_dc.get(node.datacenter, 0) + 1
-        return self.table.n
+        """Point at the snapshot's resident node table; readiness and
+        datacenter membership become per-eval mask components instead of
+        a table rebuild (readyNodesInDCs, scheduler/util.go:233, as a
+        column filter). Returns the ready-in-DC node count."""
+        import collections
+
+        self.table = self.snapshot.node_table()
+        t = self.table
+        self._base_mask = t.ready & t.dc_mask(datacenters)
+        n_ready = int(self._base_mask.sum())
+        self.by_dc = dict(collections.Counter(
+            t.datacenters[self._base_mask].tolist()))
+        return n_ready
+
+    def eligible_node_ids(self) -> set:
+        """Node ids that are ready and in the eval's datacenters (the
+        old readyNodesInDCs result set)."""
+        t = self.table
+        return {t.ids[i] for i in np.nonzero(self._base_mask)[0]}
 
     def set_node_list(self, nodes: List[Node]) -> None:
         """Restrict to an explicit node list (in-place update checks)."""
@@ -89,6 +101,7 @@ class PlacementEngine:
                     self.table.add_alloc_usage(self.table.id_to_idx[node.id],
                                                alloc)
         self.table.finalize()
+        self._base_mask = self.table.ready.copy()
         self.by_dc = {}
         for node in nodes:
             self.by_dc[node.datacenter] = self.by_dc.get(node.datacenter, 0) + 1
@@ -101,40 +114,68 @@ class PlacementEngine:
             out.extend(t.constraints)
         return out
 
-    def feasibility(self, tg: TaskGroup) -> Tuple[np.ndarray, Dict[str, int]]:
-        """(mask bool[N], filtered_counts per constraint string).
-        Vectorized FeasibilityWrapper (feasible.go:994-1134)."""
+    def _static_key(self, tg: TaskGroup) -> Tuple:
+        """Content-addressed key for the static feasibility columns:
+        immune to job-object mutation, and shared between jobs with
+        identical constraint sets (the columnar analog of computed-
+        node-class memoization, feasible.go:1026-1118)."""
+        drivers = tuple(t.driver for t in tg.tasks if t.driver)
+        cons = tuple((c.ltarget, c.rtarget, c.operand)
+                     for c in self._combined_constraints(tg)
+                     if c.operand not in (CONSTRAINT_DISTINCT_HOSTS,
+                                          CONSTRAINT_DISTINCT_PROPERTY))
+        vols = tuple(sorted(
+            (req.source, bool(getattr(req, "read_only", False)))
+            for req in (tg.volumes or {}).values()
+            if getattr(req, "type", "host") == "host"))
+        return (drivers, cons, vols)
+
+    def _static_checks(self, tg: TaskGroup) -> List[Tuple[str, np.ndarray]]:
+        """Ordered (reason, bool[N]) columns for drivers, constraints and
+        host volumes — cached on the table version (cross-eval), since
+        they depend only on node attributes."""
         t = self.table
-        key = (id(self.job), self.job.version, tg.name)
-        cached = self._mask_cache.get(key)
-        if cached is not None:
-            return cached
-        mask = t.ready.copy()
-        counts: Dict[str, int] = {}
-
-        def apply(m: np.ndarray, reason: str):
-            nonlocal mask
-            newly = mask & ~m
-            n = int(newly.sum())
-            if n:
-                counts[reason] = counts.get(reason, 0) + n
-            mask &= m
-
+        key = self._static_key(tg)
+        hit = t.mask_cache.get(key)
+        if hit is not None:
+            return hit
+        checks: List[Tuple[str, np.ndarray]] = []
         # drivers (DriverChecker)
         for task in tg.tasks:
             if task.driver:
-                apply(t.driver_mask(task.driver),
-                      f"missing drivers \"{task.driver}\"")
+                checks.append((f"missing drivers \"{task.driver}\"",
+                               t.driver_mask(task.driver)))
         # constraints (job + group + tasks)
         for c in self._combined_constraints(tg):
             if c.operand in (CONSTRAINT_DISTINCT_HOSTS,
                              CONSTRAINT_DISTINCT_PROPERTY):
                 continue
-            apply(constraint_mask(t.cols, c.ltarget, c.rtarget, c.operand),
-                  str(c))
+            checks.append((str(c), constraint_mask(t.cols, c.ltarget,
+                                                   c.rtarget, c.operand)))
         # host volumes
         if tg.volumes:
-            apply(t.host_volume_mask(tg.volumes), "missing compatible host volumes")
+            checks.append(("missing compatible host volumes",
+                           t.host_volume_mask(tg.volumes)))
+        t.mask_cache[key] = checks
+        return checks
+
+    def feasibility(self, tg: TaskGroup) -> Tuple[np.ndarray, Dict[str, int]]:
+        """(mask bool[N], filtered_counts per constraint string).
+        Vectorized FeasibilityWrapper (feasible.go:994-1134). Static
+        columns come from the cross-eval cache; the per-eval work is
+        masking them against ready-in-DC and counting."""
+        key = (id(self.job), self.job.version, tg.name)
+        cached = self._mask_cache.get(key)
+        if cached is not None:
+            return cached
+        mask = self._base_mask.copy()
+        counts: Dict[str, int] = {}
+        for reason, m in self._static_checks(tg):
+            newly = mask & ~m
+            n = int(newly.sum())
+            if n:
+                counts[reason] = counts.get(reason, 0) + n
+            mask &= m
         self._mask_cache[key] = (mask, counts)
         return mask, counts
 
@@ -144,7 +185,10 @@ class PlacementEngine:
         cpu = sum(t.resources.cpu for t in tg.tasks)
         mem = sum(t.resources.memory_mb for t in tg.tasks)
         disk = tg.ephemeral_disk.size_mb if tg.ephemeral_disk else 0
-        return np.array([cpu, mem, disk], dtype=np.float32)
+        mbits = sum(nw.mbits for nw in tg.networks)
+        for t in tg.tasks:
+            mbits += sum(nw.mbits for nw in t.resources.networks)
+        return np.array([cpu, mem, disk, mbits], dtype=np.float32)
 
     @staticmethod
     def _port_asks(tg: TaskGroup) -> Tuple[int, List[int]]:

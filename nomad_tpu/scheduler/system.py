@@ -88,7 +88,7 @@ class SystemScheduler:
                                        []).append(alloc)
 
         # stop allocs on nodes that are no longer ready / in the node set
-        valid_nodes = set(table.ids)
+        valid_nodes = engine.eligible_node_ids()
         for alloc in allocs:
             if alloc.terminal_status():
                 continue

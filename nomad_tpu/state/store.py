@@ -29,7 +29,7 @@ from ..models import (
     NODE_SCHED_ELIGIBLE, NODE_SCHED_INELIGIBLE,
 )
 from ..models.deployment import DeploymentStatusUpdate
-from ..utils.hamt import Hamt
+from ..utils.hamt import EditContext, Hamt
 
 
 @dataclass
@@ -46,22 +46,43 @@ class JobSummary:
 
 
 class _Root:
-    """One immutable version of the whole database."""
+    """One immutable version of the whole database.
 
-    __slots__ = ("tables", "indexes")
+    `edit()` opens a transient write transaction (utils/hamt.py
+    EditContext): all table writes through the returned root share one
+    edit context, so a transaction touching k keys path-copies each trie
+    node at most once. `frozen()` seals the transaction before publish —
+    published roots are immutable again."""
 
-    def __init__(self, tables: Hamt, indexes: Hamt):
+    __slots__ = ("tables", "indexes", "_ctx")
+
+    def __init__(self, tables: Hamt, indexes: Hamt, _ctx=None):
         self.tables = tables      # name -> Hamt(primary key -> object)
         self.indexes = indexes    # table name -> last modify index
+        self._ctx = _ctx
 
     def table(self, name: str) -> Hamt:
-        return self.tables.get(name) or Hamt()
+        # always normalize the edit context: a stored table may carry the
+        # ctx of the transaction that wrote it, and writing through a
+        # stale ctx would mutate published nodes
+        t = self.tables.get(name) or Hamt()
+        return t.with_ctx(self._ctx)
 
     def with_table(self, name: str, t: Hamt) -> "_Root":
-        return _Root(self.tables.set(name, t), self.indexes)
+        return _Root(self.tables.set(name, t), self.indexes, self._ctx)
 
     def with_index(self, name: str, idx: int) -> "_Root":
-        return _Root(self.tables, self.indexes.set(name, idx))
+        return _Root(self.tables, self.indexes.set(name, idx), self._ctx)
+
+    def edit(self) -> "_Root":
+        ctx = EditContext()
+        return _Root(self.tables.with_ctx(ctx), self.indexes.with_ctx(ctx),
+                     ctx)
+
+    def frozen(self) -> "_Root":
+        if self._ctx is None:
+            return self
+        return _Root(self.tables.frozen(), self.indexes.frozen())
 
 
 TABLES = (
@@ -78,8 +99,19 @@ JOB_TRACKED_VERSIONS = 6  # structs.go JobTrackedVersions
 class StateSnapshot:
     """A read-only view at one index. Safe to hold across scheduler runs."""
 
-    def __init__(self, root: _Root):
+    def __init__(self, root: _Root, store: "StateStore" = None):
         self._root = root
+        self._store = store
+
+    def node_table(self):
+        """The columnar node table for this snapshot. Snapshots taken
+        from a live store share its resident delta-maintained table
+        (ops/tables.py NodeTableCache — SURVEY §7.2 step 8: no per-eval
+        rebuild); detached snapshots build fresh."""
+        from ..ops.tables import NodeTable
+        if self._store is None:
+            return NodeTable.build_all(self)
+        return self._store.table_cache.get(self)
 
     # -- index bookkeeping --------------------------------------------
     def index(self, table: str) -> int:
@@ -210,17 +242,50 @@ class StateStore(StateSnapshot):
     """The mutable handle: all writes go through FSM-style apply methods
     that stamp a raft-like index and notify blocked watchers."""
 
+    CHANGELOG_MAX = 200_000
+
     def __init__(self):
-        root = _Root(Hamt(), Hamt())
+        root = _Root(Hamt(), Hamt()).edit()
         super().__init__(root)
+        self._store = self  # StateStore doubles as its own snapshot view
         # RLock: composite mutations re-enter (e.g. update_deployment_status
         # upserting the rolled-back job via upsert_job)
         self._lock = threading.RLock()
         self._watch = threading.Condition()
+        # bounded changelog feeding the resident NodeTable's delta path:
+        # (index, kind, key) in index order; entries at or below
+        # _change_floor may have been pruned
+        self._changes: List[Tuple[int, str, str]] = []
+        self._change_indexes: List[int] = []
+        self._change_floor = 0
+        from ..ops.tables import NodeTableCache
+        self.table_cache = NodeTableCache()
+
+    # -- changelog -----------------------------------------------------
+    def _log_change(self, index: int, kind: str, key: str) -> None:
+        self._changes.append((index, kind, key))
+        self._change_indexes.append(index)
+        if len(self._changes) > self.CHANGELOG_MAX:
+            drop = len(self._changes) - self.CHANGELOG_MAX
+            self._change_floor = self._changes[drop - 1][0]
+            del self._changes[:drop]
+            del self._change_indexes[:drop]
+
+    def changes_since(self, from_idx: int,
+                      to_idx: int) -> Optional[List[Tuple[str, str]]]:
+        """Node/alloc changes with from_idx < index <= to_idx, or None if
+        the log no longer reaches back to from_idx (caller rebuilds)."""
+        import bisect
+        with self._lock:
+            if from_idx < self._change_floor:
+                return None
+            lo = bisect.bisect_right(self._change_indexes, from_idx)
+            hi = bisect.bisect_right(self._change_indexes, to_idx)
+            return [(k, key) for (_i, k, key) in self._changes[lo:hi]]
 
     # -- snapshot / blocking ------------------------------------------
     def snapshot(self) -> StateSnapshot:
-        return StateSnapshot(self._root)
+        return StateSnapshot(self._root, self)
 
     def snapshot_min_index(self, index: int, timeout_s: float = 5.0) -> StateSnapshot:
         """Wait until the store has caught up to `index`, then snapshot
@@ -248,7 +313,8 @@ class StateStore(StateSnapshot):
             return True
 
     def _publish(self, root: _Root) -> None:
-        self._root = root
+        # seal any open edit context: published roots are immutable
+        self._root = root.frozen()
         with self._watch:
             self._watch.notify_all()
 
@@ -256,8 +322,11 @@ class StateStore(StateSnapshot):
     @staticmethod
     def _index_add(root: _Root, table: str, key, member) -> _Root:
         t = root.table(table)
-        members = t.get(key) or Hamt()
-        return root.with_table(table, t.set(key, members.set(member, True)))
+        # nested member sets ride the transaction's edit context but are
+        # stored frozen so no stale ctx can ever mutate published nodes
+        members = (t.get(key) or Hamt()).with_ctx(root._ctx)
+        return root.with_table(
+            table, t.set(key, members.set(member, True).frozen()))
 
     @staticmethod
     def _index_del(root: _Root, table: str, key, member) -> _Root:
@@ -268,12 +337,12 @@ class StateStore(StateSnapshot):
         members = members.delete(member)
         if len(members) == 0:
             return root.with_table(table, t.delete(key))
-        return root.with_table(table, t.set(key, members))
+        return root.with_table(table, t.set(key, members.frozen()))
 
     # -- nodes ---------------------------------------------------------
     def upsert_node(self, index: int, node: Node) -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             existing = root.table("nodes").get(node.id)
             if existing is not None:
                 node.create_index = existing.create_index
@@ -289,15 +358,18 @@ class StateStore(StateSnapshot):
                 node.compute_class()
             root = root.with_table("nodes", root.table("nodes").set(node.id, node))
             root = root.with_index("nodes", index)
+            self._log_change(index, "node", node.id)
             self._publish(root)
 
     def delete_node(self, index: int, node_ids: List[str]) -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             t = root.table("nodes")
             for nid in node_ids:
                 t = t.delete(nid)
             root = root.with_table("nodes", t).with_index("nodes", index)
+            for nid in node_ids:
+                self._log_change(index, "node", nid)
             self._publish(root)
 
     def update_node_status(self, index: int, node_id: str, status: str,
@@ -328,19 +400,20 @@ class StateStore(StateSnapshot):
                               scheduling_eligibility=eligibility)
 
     def _update_node(self, index: int, node_id: str, **changes) -> None:
-        root = self._root
+        root = self._root.edit()
         node = root.table("nodes").get(node_id)
         if node is None:
             raise KeyError(f"node {node_id} not found")
         node = replace(node, modify_index=index, **changes)
         root = root.with_table("nodes", root.table("nodes").set(node_id, node))
         root = root.with_index("nodes", index)
+        self._log_change(index, "node", node_id)
         self._publish(root)
 
     # -- jobs ----------------------------------------------------------
     def upsert_job(self, index: int, job: Job) -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             key = job.namespaced_id()
             existing = root.table("jobs").get(key)
             if existing is not None:
@@ -377,7 +450,7 @@ class StateStore(StateSnapshot):
 
     def delete_job(self, index: int, namespace: str, job_id: str) -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             key = (namespace, job_id)
             existing = root.table("jobs").get(key)
             if existing is not None and existing.parent_id:
@@ -414,7 +487,7 @@ class StateStore(StateSnapshot):
     # -- evals ---------------------------------------------------------
     def upsert_evals(self, index: int, evals: List[Evaluation]) -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             for e in evals:
                 root = self._upsert_eval_impl(root, index, e)
             root = root.with_index("evals", index)
@@ -437,7 +510,7 @@ class StateStore(StateSnapshot):
     def delete_evals(self, index: int, eval_ids: List[str],
                      alloc_ids: Optional[List[str]] = None) -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             for eid in eval_ids:
                 e = root.table("evals").get(eid)
                 if e is None:
@@ -446,14 +519,14 @@ class StateStore(StateSnapshot):
                 root = self._index_del(root, "evals_by_job",
                                        (e.namespace, e.job_id), eid)
             for aid in (alloc_ids or []):
-                root = self._delete_alloc_impl(root, aid)
+                root = self._delete_alloc_impl(root, aid, index)
             root = root.with_index("evals", index).with_index("allocs", index)
             self._publish(root)
 
     # -- allocs --------------------------------------------------------
     def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             for a in allocs:
                 root = self._upsert_alloc_impl(root, index, a)
             root = root.with_index("allocs", index)
@@ -497,12 +570,15 @@ class StateStore(StateSnapshot):
             root = self._index_del(root, "allocs_by_node", existing.node_id, a.id)
             root = self._index_add(root, "allocs_by_node", a.node_id, a.id)
         root = self._update_summary_for_alloc(root, index, existing, a)
+        self._log_change(index, "alloc", a.id)
         return root
 
-    def _delete_alloc_impl(self, root: _Root, alloc_id: str) -> _Root:
+    def _delete_alloc_impl(self, root: _Root, alloc_id: str,
+                           index: int = 0) -> _Root:
         a = root.table("allocs").get(alloc_id)
         if a is None:
             return root
+        self._log_change(index, "alloc", alloc_id)
         root = root.with_table("allocs", root.table("allocs").delete(alloc_id))
         root = self._index_del(root, "allocs_by_node", a.node_id, alloc_id)
         root = self._index_del(root, "allocs_by_job",
@@ -514,7 +590,7 @@ class StateStore(StateSnapshot):
                                   allocs: List[Allocation]) -> None:
         """Client pushes task states / client status (node_endpoint.go:1065)."""
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             for update in allocs:
                 existing = root.table("allocs").get(update.id)
                 if existing is None:
@@ -533,6 +609,7 @@ class StateStore(StateSnapshot):
                                        root.table("allocs").set(merged.id, merged))
                 root = self._update_summary_for_alloc(root, index, existing, merged)
                 root = self._maybe_update_deployment_health(root, index, merged)
+                self._log_change(index, "alloc", merged.id)
             root = root.with_index("allocs", index)
             self._publish(root)
 
@@ -634,7 +711,7 @@ class StateStore(StateSnapshot):
                                  job: Optional[Job] = None,
                                  evals: Optional[List[Evaluation]] = None) -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             d = root.table("deployments").get(update.deployment_id)
             if d is None:
                 raise KeyError(f"deployment {update.deployment_id} not found")
@@ -647,7 +724,7 @@ class StateStore(StateSnapshot):
             if job is not None:
                 self._publish(root)
                 self.upsert_job(index, job)
-                root = self._root
+                root = self._root.edit()
             for e in (evals or []):
                 root = self._upsert_eval_impl(root, index, e)
             if evals:
@@ -665,7 +742,7 @@ class StateStore(StateSnapshot):
         """Apply a verified plan atomically (fsm.go ApplyPlanResults /
         state_store.go UpsertPlanResults)."""
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             new_placed = [a for a in allocs_placed
                           if a.deployment_id
                           and root.table("allocs").get(a.id) is None]
@@ -701,7 +778,7 @@ class StateStore(StateSnapshot):
         """Set server-desired transitions (state_store.go
         UpdateAllocsDesiredTransitions) — the drainer's migrate flag."""
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             updates = {k: v for k, v in vars(transition).items()
                        if v is not None}
             for aid in alloc_ids:
@@ -712,6 +789,7 @@ class StateStore(StateSnapshot):
                     a.desired_transition, **updates), modify_index=index)
                 root = root.with_table("allocs",
                                        root.table("allocs").set(aid, a))
+                self._log_change(index, "alloc", aid)
             for e in (evals or []):
                 root = self._upsert_eval_impl(root, index, e)
             root = root.with_index("allocs", index)
@@ -750,7 +828,7 @@ class StateStore(StateSnapshot):
         the FSM apply is unconditional so WAL replay is deterministic."""
         from ..models.deployment import DESC_RUNNING
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             d: Optional[Deployment] = root.table("deployments").get(deployment_id)
             if d is None:
                 raise KeyError(f"deployment {deployment_id} not found")
@@ -781,7 +859,7 @@ class StateStore(StateSnapshot):
         """Flag a job version (un)stable (state_store.go
         UpdateJobStability) — the auto-revert target marker."""
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             key = (namespace, job_id)
             versions = root.table("job_versions").get(key)
             if versions is not None:
@@ -805,7 +883,7 @@ class StateStore(StateSnapshot):
     def upsert_periodic_launch(self, index: int, namespace: str, job_id: str,
                                launch_time: float) -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             t = root.table("periodic_launches")
             root = root.with_table("periodic_launches",
                                    t.set((namespace, job_id), launch_time))
@@ -815,7 +893,7 @@ class StateStore(StateSnapshot):
     def delete_periodic_launch(self, index: int, namespace: str,
                                job_id: str) -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             t = root.table("periodic_launches").delete((namespace, job_id))
             root = root.with_table("periodic_launches", t)
             root = root.with_index("periodic_launches", index)
@@ -824,7 +902,7 @@ class StateStore(StateSnapshot):
     # -- deployments GC ------------------------------------------------
     def delete_deployments(self, index: int, deployment_ids: List[str]) -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             for did in deployment_ids:
                 d = root.table("deployments").get(did)
                 if d is None:
@@ -842,7 +920,7 @@ class StateStore(StateSnapshot):
     def add_scaling_event(self, index: int, namespace: str, job_id: str,
                           event: dict) -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             key = (namespace, job_id)
             events = list(root.table("scaling_events").get(key) or [])
             event = dict(event, create_index=index)
@@ -903,7 +981,16 @@ class StateStore(StateSnapshot):
         from ..models import SchedulerConfiguration
         from ..utils.codec import from_wire
         with self._lock:
-            root = _Root(Hamt(), Hamt())
+            # invalidate the changelog AND the resident table cache:
+            # restore replaces state wholesale, so a cached table at the
+            # same numeric index would silently serve pre-restore rows
+            self._changes.clear()
+            self._change_indexes.clear()
+            self._change_floor = max(
+                [0] + [int(i) for i in data.get("indexes", {}).values()])
+            from ..ops.tables import NodeTableCache
+            self.table_cache = NodeTableCache()
+            root = _Root(Hamt(), Hamt()).edit()
             t = root.table("nodes")
             for w in data["tables"].get("nodes", []):
                 node = from_wire(Node, w)
@@ -985,7 +1072,7 @@ class StateStore(StateSnapshot):
     def set_job_status(self, index: int, namespace: str, job_id: str,
                        status: str, description: str = "") -> None:
         with self._lock:
-            root = self._root
+            root = self._root.edit()
             key = (namespace, job_id)
             job = root.table("jobs").get(key)
             if job is None:

@@ -43,14 +43,53 @@ def _index(bitmap: int, bit: int) -> int:
     return (bitmap & (bit - 1)).bit_count()
 
 
+class EditContext:
+    """Transient edit session (the clojure/immer "transient" trick).
+
+    Nodes created while an edit context is active are tagged as owned by
+    it; subsequent writes through the same context mutate them in place
+    instead of path-copying again, so a transaction of k writes allocates
+    O(k·log n) nodes once instead of re-copying the path per write.
+    Owned nodes are only ever reachable from unpublished roots, so
+    published snapshots stay immutable. `keepalive` pins created nodes so
+    an id() is never recycled into a false ownership claim."""
+
+    __slots__ = ("owned", "keepalive")
+
+    def __init__(self):
+        self.owned = set()
+        self.keepalive = []
+
+    def adopt(self, node):
+        self.owned.add(id(node))
+        self.keepalive.append(node)
+        return node
+
+
 class Hamt:
-    """Immutable hash map. set/delete return new maps sharing structure."""
+    """Immutable hash map. set/delete return new maps sharing structure.
 
-    __slots__ = ("_root", "_size")
+    `with_ctx(ctx)` returns a view whose writes run transiently through
+    the given EditContext (see EditContext); reads are identical."""
 
-    def __init__(self, _root: _Node = _EMPTY, _size: int = 0):
+    __slots__ = ("_root", "_size", "_ctx")
+
+    def __init__(self, _root: _Node = _EMPTY, _size: int = 0,
+                 _ctx: "EditContext" = None):
         self._root = _root
         self._size = _size
+        self._ctx = _ctx
+
+    def with_ctx(self, ctx: "EditContext") -> "Hamt":
+        if ctx is self._ctx:
+            return self
+        return Hamt(self._root, self._size, ctx)
+
+    def frozen(self) -> "Hamt":
+        """Drop the edit context: further writes are fully persistent."""
+        if self._ctx is None:
+            return self
+        return Hamt(self._root, self._size, None)
 
     # -- reads ---------------------------------------------------------
     def __len__(self) -> int:
@@ -111,9 +150,12 @@ class Hamt:
     def __iter__(self) -> Iterator[Any]:
         return self.keys()
 
-    # -- writes (persistent) ------------------------------------------
+    # -- writes (persistent; transient when a ctx is attached) ---------
     def set(self, key, value) -> "Hamt":
         h = hash(key)
+        if self._ctx is not None:
+            new_root, added = _set_t(self._root, 0, h, key, value, self._ctx)
+            return Hamt(new_root, self._size + (1 if added else 0), self._ctx)
         new_root, added = _set(self._root, 0, h, key, value)
         return Hamt(new_root, self._size + (1 if added else 0))
 
@@ -126,13 +168,19 @@ class Hamt:
         if isinstance(new_root, tuple):  # collapsed to single leaf
             node = _Node(1 << ((h := hash(new_root[0])) & _MASK), (new_root,))
             new_root = node
-        return Hamt(new_root, self._size - 1)
+        return Hamt(new_root, self._size - 1, self._ctx)
 
     def update(self, pairs) -> "Hamt":
-        m = self
-        for k, v in (pairs.items() if isinstance(pairs, dict) else pairs):
-            m = m.set(k, v)
-        return m
+        """Batch set; runs through one EditContext so the whole batch
+        path-copies each trie node at most once."""
+        items = pairs.items() if isinstance(pairs, dict) else pairs
+        ctx = self._ctx or EditContext()
+        root = self._root
+        size = self._size
+        for k, v in items:
+            root, added = _set_t(root, 0, hash(k), k, v, ctx)
+            size += 1 if added else 0
+        return Hamt(root, size, self._ctx)
 
 
 def _set(node, shift: int, h: int, key, value):
@@ -171,6 +219,65 @@ def _set(node, shift: int, h: int, key, value):
     else:
         child = _merge_leaves(shift + _BITS, kh, (k, v), h, (key, value))
     return _Node(node.bitmap, node.entries[:idx] + (child,) + node.entries[idx + 1:]), True
+
+
+def _set_t(node, shift: int, h: int, key, value, ctx):
+    """Transient _set: nodes owned by ctx are mutated in place; anything
+    else is path-copied once and adopted. Returns (node, added_bool)."""
+    if isinstance(node, _Collision):
+        if node.hash == h:
+            for i, (k, _) in enumerate(node.pairs):
+                if k == key:
+                    pairs = (node.pairs[:i] + ((key, value),)
+                             + node.pairs[i + 1:])
+                    return _Collision(h, pairs), False
+            return _Collision(h, node.pairs + ((key, value),)), True
+        bit = 1 << ((node.hash >> shift) & _MASK)
+        wrapped = ctx.adopt(_Node(bit, (node,)))
+        return _set_t(wrapped, shift, h, key, value, ctx)
+
+    owned = id(node) in ctx.owned
+    bit = 1 << ((h >> shift) & _MASK)
+    idx = _index(node.bitmap, bit)
+    if not (node.bitmap & bit):
+        entries = node.entries[:idx] + ((key, value),) + node.entries[idx:]
+        if owned:
+            node.bitmap |= bit
+            node.entries = entries
+            return node, True
+        return ctx.adopt(_Node(node.bitmap | bit, entries)), True
+
+    entry = node.entries[idx]
+    if isinstance(entry, (_Node, _Collision)):
+        child, added = _set_t(entry, shift + _BITS, h, key, value, ctx)
+        if child is entry:
+            return node, added  # child mutated in place
+        entries = node.entries[:idx] + (child,) + node.entries[idx + 1:]
+        if owned:
+            node.entries = entries
+            return node, added
+        return ctx.adopt(_Node(node.bitmap, entries)), added
+
+    k, v = entry
+    if k == key:
+        entries = (node.entries[:idx] + ((key, value),)
+                   + node.entries[idx + 1:])
+        if owned:
+            node.entries = entries
+            return node, False
+        return ctx.adopt(_Node(node.bitmap, entries)), False
+
+    kh = hash(k)
+    if kh == h:
+        child = _Collision(h, ((k, v), (key, value)))
+    else:
+        child = ctx.adopt(_merge_leaves(shift + _BITS, kh, (k, v),
+                                        h, (key, value)))
+    entries = node.entries[:idx] + (child,) + node.entries[idx + 1:]
+    if owned:
+        node.entries = entries
+        return node, True
+    return ctx.adopt(_Node(node.bitmap, entries)), True
 
 
 def _merge_leaves(shift: int, h1: int, leaf1, h2: int, leaf2) -> _Node:
