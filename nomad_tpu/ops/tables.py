@@ -375,10 +375,10 @@ class NodeTableCache:
         with self._lock:
             if self._table is not None and self._index == target:
                 return self._table
-            if self._table is None or target < self._index:
+            if self._table is not None and target < self._index:
                 # older snapshot than the cache: serve it a private build
-                if self._table is not None and target < self._index:
-                    return NodeTable.build_all(snapshot)
+                return NodeTable.build_all(snapshot)
+            if self._table is None:
                 self._table = NodeTable.build_all(snapshot)
                 self._index = target
                 return self._table

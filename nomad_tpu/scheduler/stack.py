@@ -328,6 +328,7 @@ class PlacementEngine:
             spreads=spreads,
             sum_spread_weights=sum_spread_w,
             distinct_props=distinct_props,
+            n_considered=int(self._base_mask.sum()),
         )
         res = self.kernel.select(req)
         elapsed = time.monotonic_ns() - start

@@ -82,7 +82,16 @@ class _Root:
     def frozen(self) -> "_Root":
         if self._ctx is None:
             return self
-        return _Root(self.tables.frozen(), self.indexes.frozen())
+        # deep-freeze: the VALUES of `tables` are per-table Hamts that
+        # still carry this transaction's EditContext; leaving it attached
+        # would pin every trie node the transaction created (via
+        # ctx.keepalive) for as long as the table value survives, and
+        # force table() to re-wrap on every read
+        tables = self.tables.frozen()
+        for name, t in tables.items():
+            if t._ctx is not None:
+                tables = tables.set(name, t.frozen())
+        return _Root(tables, self.indexes.frozen())
 
 
 TABLES = (
@@ -574,7 +583,7 @@ class StateStore(StateSnapshot):
         return root
 
     def _delete_alloc_impl(self, root: _Root, alloc_id: str,
-                           index: int = 0) -> _Root:
+                           index: int) -> _Root:
         a = root.table("allocs").get(alloc_id)
         if a is None:
             return root
