@@ -330,6 +330,7 @@ class GenericScheduler:
         if self.job is None:
             return
         n = self.engine.set_nodes(self.job.datacenters)
+        self._preemption_rounds = {}   # tg name -> PreemptionRound
 
         deployment_id = ""
         if self.deployment is not None and self.deployment.active():
@@ -430,9 +431,11 @@ class GenericScheduler:
 
     def _try_preemption(self, tg, metrics):
         """When the kernel finds no fit, look for a node where evicting
-        lower-priority allocs (priority delta >= 10) makes room."""
+        lower-priority allocs (priority delta >= 10) makes room. The
+        PreemptionRound is cached per task group for the whole eval so
+        repeated failures share per-node victim computations."""
         from ..ops.tables import ProposedIndex as PI
-        from .preemption import find_preemption_placement, preemption_enabled
+        from .preemption import PreemptionRound, preemption_enabled
         from .stack import RankedNode
         if not preemption_enabled(self.state.scheduler_config(),
                                   "batch" if self.batch else "service"):
@@ -441,9 +444,13 @@ class GenericScheduler:
         proposed = PI(self.engine.table, self.job,
                       self.state.allocs_by_job(self.job.namespace, self.job.id),
                       self.plan)
-        found = find_preemption_placement(
-            self.state, self.engine.table, mask, proposed.used(),
-            self.engine.group_ask(tg), self.job, self.plan)
+        round_ = self._preemption_rounds.get(tg.name)
+        if round_ is None or round_.plan is not self.plan:
+            round_ = PreemptionRound(
+                self.state, self.engine.table, mask,
+                self.engine.group_ask(tg), self.job, self.plan)
+            self._preemption_rounds[tg.name] = round_
+        found = round_.find_placement(proposed.used())
         if found is None:
             return None
         idx, victims, score = found

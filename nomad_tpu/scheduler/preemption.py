@@ -197,63 +197,172 @@ def preemption_enabled(sched_config, scheduler_type: str) -> bool:
     return False
 
 
-def find_preemption_placement(snapshot, table, mask, used, ask_vec, job,
-                              plan) -> Optional[Tuple[int, List[Allocation], float]]:
-    """Across feasible-but-full nodes, find the best (node_idx, victims,
-    score) by the logistic preemption score combined with bin-packing —
-    the host-side PreemptionScoringIterator + BinPack fallback
-    (rank.go:415-448, 732-745)."""
-    import numpy as np
-    from ..models.funcs import ScoreFitBinPack
+class PreemptionRound:
+    """Preemption placement across nodes, amortized over an eval.
 
-    ask = ComparableResources(cpu_shares=float(ask_vec[0]),
-                              memory_mb=float(ask_vec[1]),
-                              disk_mb=float(ask_vec[2]))
-    current_preempted: List[Allocation] = []
-    for allocs in plan.node_preemptions.values():
-        current_preempted.extend(allocs)
+    The naive fallback recomputed every node's victim set for every
+    failed instance — O(instances x nodes) Preemptor runs, the dominant
+    cost of preemption-heavy evals. This round object computes each
+    node's (victims, score) entry once and then only re-derives entries
+    whose inputs changed: the plan state touching the node (placements,
+    stops, preemptions) is captured in a per-node signature, plus the
+    global max_parallel preemption counts for the job groups present on
+    the node (the only cross-node coupling in the scoring —
+    scoreForTaskGroup's penalty). Semantics per node are byte-identical
+    to the one-shot path: PreemptionScoringIterator + BinPack fallback
+    (rank.go:415-448, 732-745).
+    """
 
-    stopped_ids = {a.id for allocs in plan.node_update.values() for a in allocs}
-    stopped_ids |= {a.id for a in current_preempted}
+    def __init__(self, snapshot, table, mask, ask_vec, job, plan):
+        import numpy as np
+        self.snapshot = snapshot
+        self.table = table
+        self.mask = mask
+        self.ask_vec = ask_vec
+        self.job = job
+        self.plan = plan
+        self.ask = ComparableResources(cpu_shares=float(ask_vec[0]),
+                                       memory_mb=float(ask_vec[1]),
+                                       disk_mb=float(ask_vec[2]))
+        n = len(table.nodes)
+        # computed state: known[i] -> score[i] (-1 = infeasible) and
+        # victim lists; invalidation is *dirty-tracked* from the plan's
+        # per-node entry counts instead of re-hashed per call
+        self._known = np.zeros(n, bool)
+        self._scores = np.full(n, -1.0, np.float64)
+        self._victims: Dict[int, List[Allocation]] = {}
+        # idx -> group keys on the node that carry max_parallel > 0
+        self._mp_groups: Dict[int, frozenset] = {}
+        self._last_counts: Dict[str, Tuple[int, int, int]] = {}
+        self._last_mp_counts: Dict[Tuple, int] = {}
 
-    best: Optional[Tuple[int, List[Allocation], float]] = None
-    fits = np.all(used + np.asarray(ask_vec)[None, :] <= table.capacity + 1e-6,
-                  axis=1)
-    for i in np.nonzero(mask & ~fits)[0]:
-        node = table.nodes[i]
-        proposed = [a for a in snapshot.allocs_by_node(node.id)
+    # -- plan-state dirty tracking ------------------------------------
+    def _preempted_now(self) -> List[Allocation]:
+        out: List[Allocation] = []
+        for allocs in self.plan.node_preemptions.values():
+            out.extend(allocs)
+        return out
+
+    def _invalidate_dirty(self, current: List[Allocation]) -> None:
+        """Drop cached entries for nodes whose plan state changed since
+        the last call. Only nodes that appear in the plan's dicts can
+        have changed — O(touched nodes), not O(all nodes)."""
+        p = self.plan
+        id_to_idx = self.table.id_to_idx
+        touched: Dict[str, Tuple[int, int, int]] = {}
+        for nid in (p.node_allocation.keys() | p.node_update.keys()
+                    | p.node_preemptions.keys()):
+            touched[nid] = (len(p.node_allocation.get(nid, ())),
+                            len(p.node_update.get(nid, ())),
+                            len(p.node_preemptions.get(nid, ())))
+        for nid, counts in touched.items():
+            if self._last_counts.get(nid) != counts:
+                self._last_counts[nid] = counts
+                idx = id_to_idx.get(nid)
+                if idx is not None:
+                    self._known[idx] = False
+        # global coupling: max_parallel penalties depend on the total
+        # preempted count per group; invalidate nodes holding candidates
+        # of groups whose count changed
+        mp_counts: Dict[Tuple, int] = {}
+        for a in current:
+            key = (a.namespace, a.job_id, a.task_group)
+            mp_counts[key] = mp_counts.get(key, 0) + 1
+        if mp_counts != self._last_mp_counts:
+            changed = {k for k in (mp_counts.keys()
+                                   | self._last_mp_counts.keys())
+                       if mp_counts.get(k) != self._last_mp_counts.get(k)}
+            self._last_mp_counts = mp_counts
+            for idx, groups in self._mp_groups.items():
+                if groups & changed:
+                    self._known[idx] = False
+
+    # -- per-node evaluation (exact one-shot semantics) ----------------
+    def _evaluate_node(self, i: int, used_row,
+                       current: List[Allocation],
+                       stopped_ids: set) -> Tuple[Optional[List[Allocation]],
+                                                  float]:
+        from ..models.funcs import ScoreFitBinPack
+
+        node = self.table.nodes[i]
+        proposed = [a for a in self.snapshot.allocs_by_node(node.id)
                     if not a.terminal_status() and a.id not in stopped_ids]
-        proposed.extend(plan.node_allocation.get(node.id, []))
-        p = Preemptor(job.priority, job.namespace, job.id)
+        proposed.extend(self.plan.node_allocation.get(node.id, []))
+        p = Preemptor(self.job.priority, self.job.namespace, self.job.id)
         p.set_node(node)
         p.set_candidates(proposed)
-        p.set_preemptions(current_preempted)
-        victims = p.preempt_for_task_group(ask)
+        p.set_preemptions(current)
+        # remember the max_parallel-bearing groups for invalidation
+        mp = set()
+        for a in p.current_allocs:
+            if p.alloc_details[a.id][0] > 0:
+                mp.add((a.namespace, a.job_id, a.task_group))
+        self._mp_groups[i] = frozenset(mp)
+        victims = p.preempt_for_task_group(self.ask)
         if not victims:
-            continue
+            return None, 0.0
         # bandwidth guard: victims are chosen by cpu/mem/disk distance,
         # so verify the eviction also covers the ask's network dimension
-        # (full network-preemption variant: preemption.go PreemptForNetwork
-        # — tracked as the in-kernel preemption milestone)
-        if len(ask_vec) > 3 and ask_vec[3] > 0:
+        # (full network-preemption variant: preemption.go PreemptForNetwork)
+        if len(self.ask_vec) > 3 and self.ask_vec[3] > 0:
             freed_mbits = 0.0
             for v in victims:
                 cr = v.comparable_resources()
                 if cr is not None:
                     freed_mbits += sum(nw.mbits for nw in cr.networks)
-            if used[i, 3] - freed_mbits + ask_vec[3] > \
-                    table.capacity[i, 3] + 1e-6:
-                continue
+            if used_row[3] - freed_mbits + self.ask_vec[3] > \
+                    self.table.capacity[i, 3] + 1e-6:
+                return None, 0.0
         # score: binpack fit after eviction + logistic preemption score
         util = ComparableResources()
         victim_ids = {v.id for v in victims}
         for a in proposed:
             if a.id not in victim_ids:
                 util.add(a.comparable_resources())
-        util.add(ask)
+        util.add(self.ask)
         binpack = ScoreFitBinPack(node, util) / 18.0
         pscore = preemption_score(net_priority(victims))
-        final = (binpack + pscore) / 2.0
-        if best is None or final > best[2]:
-            best = (int(i), victims, final)
-    return best
+        return victims, (binpack + pscore) / 2.0
+
+    # -- entry ---------------------------------------------------------
+    def find_placement(self, used) -> Optional[Tuple[int, List[Allocation],
+                                                     float]]:
+        """Best (node_idx, victims, score) for one failed instance, or
+        None. `used` is the current proposed usage [N, D]."""
+        import numpy as np
+
+        current = self._preempted_now()
+        self._invalidate_dirty(current)
+
+        fits = np.all(used + np.asarray(self.ask_vec)[None, :]
+                      <= self.table.capacity + 1e-6, axis=1)
+        candidates = self.mask & ~fits
+        pending = np.nonzero(candidates & ~self._known)[0]
+        if len(pending):
+            stopped_ids = {a.id for allocs in self.plan.node_update.values()
+                           for a in allocs}
+            stopped_ids |= {a.id for a in current}
+            for i in pending:
+                i = int(i)
+                victims, score = self._evaluate_node(
+                    i, used[i], current, stopped_ids)
+                self._known[i] = True
+                if victims:
+                    self._scores[i] = score
+                    self._victims[i] = victims
+                else:
+                    self._scores[i] = -1.0
+                    self._victims.pop(i, None)
+        masked = np.where(candidates & self._known, self._scores, -1.0)
+        best_i = int(np.argmax(masked))
+        if masked[best_i] < 0:
+            return None
+        return best_i, self._victims[best_i], float(masked[best_i])
+
+
+def find_preemption_placement(snapshot, table, mask, used, ask_vec, job,
+                              plan) -> Optional[Tuple[int, List[Allocation], float]]:
+    """One-shot wrapper over PreemptionRound (kept for callers that
+    only need a single placement)."""
+    return PreemptionRound(snapshot, table, mask, ask_vec, job,
+                           plan).find_placement(used)

@@ -65,6 +65,9 @@ class PlacementEngine:
         # per-eval NetworkIndex cache: shared across select_batch calls so
         # port offers stay consistent between task groups of one plan
         self._net_cache: Dict[str, NetworkIndex] = {}
+        self._shared_by_dc: Dict[str, int] = {}
+        self._shared_filtered: Dict[str, int] = {}
+        self._prev_meta: Tuple = (None, None)
 
     # -- setup ---------------------------------------------------------
     def set_job(self, job: Job) -> None:
@@ -335,6 +338,9 @@ class PlacementEngine:
 
         # host-side port assignment for winners, plan-consistent
         out: List[Tuple[Optional[RankedNode], AllocMetric]] = []
+        self._shared_by_dc = dict(self.by_dc)
+        self._shared_filtered = dict(filtered_counts)
+        self._prev_meta = (None, None)
         for step in range(count):
             idx = int(res.node_idx[step])
             metrics = self._metrics_for_step(res, step, filtered_counts,
@@ -364,14 +370,24 @@ class PlacementEngine:
         m = AllocMetric()
         m.nodes_evaluated = res.nodes_evaluated
         m.nodes_filtered = res.nodes_filtered
-        m.nodes_available = dict(self.by_dc)
-        m.constraint_filtered = dict(filtered_counts)
+        # shared read-only dicts: a 10k-instance batch would otherwise
+        # copy these per instance
+        m.nodes_available = self._shared_by_dc
+        m.constraint_filtered = self._shared_filtered
         ex = res.exhausted_dim[step]
         m.nodes_exhausted = int(ex.sum())
         for d, name in enumerate(DIM_NAMES):
             if int(ex[d]):
                 m.dimension_exhausted[name] = int(ex[d])
         m.allocation_time_ns = int(elapsed_ns)
+        # chunked placements repeat identical top-k rows; reuse the
+        # previous step's NodeScoreMeta list when unchanged
+        prev_step, prev_list = self._prev_meta
+        if prev_step is not None and \
+                np.array_equal(res.top_idx[step], res.top_idx[prev_step]) and \
+                np.array_equal(res.top_scores[step], res.top_scores[prev_step]):
+            m.score_meta_data = prev_list
+            return m
         for k in range(TOP_K):
             ni = int(res.top_idx[step][k])
             sc = float(res.top_scores[step][k])
@@ -380,6 +396,7 @@ class PlacementEngine:
             m.score_meta_data.append(NodeScoreMeta(
                 node_id=self.table.ids[ni],
                 scores={"final": sc}, norm_score=sc))
+        self._prev_meta = (step, m.score_meta_data)
         return m
 
     def _net_index_for(self, node: Node, plan) -> NetworkIndex:

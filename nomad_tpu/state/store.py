@@ -105,6 +105,25 @@ TABLES = (
 JOB_TRACKED_VERSIONS = 6  # structs.go JobTrackedVersions
 
 
+def _client_status_bucket(a: Optional["Allocation"]) -> Optional[str]:
+    """JobSummary bucket for an alloc's client status
+    (state_store.go updateSummaryWithAlloc)."""
+    if a is None:
+        return None
+    cs = a.client_status
+    if cs == ALLOC_CLIENT_PENDING:
+        return "starting"
+    if cs == ALLOC_CLIENT_RUNNING:
+        return "running"
+    if cs == ALLOC_CLIENT_COMPLETE:
+        return "complete"
+    if cs == ALLOC_CLIENT_FAILED:
+        return "failed"
+    if cs == ALLOC_CLIENT_LOST:
+        return "lost"
+    return None
+
+
 class StateSnapshot:
     """A read-only view at one index. Safe to hold across scheduler runs."""
 
@@ -665,22 +684,7 @@ class StateStore(StateSnapshot):
         tg = new.task_group
         counts = dict(s.summary.get(tg, {}))
 
-        def bucket(a: Optional[Allocation]) -> Optional[str]:
-            if a is None:
-                return None
-            cs = a.client_status
-            if cs == ALLOC_CLIENT_PENDING:
-                return "starting"
-            if cs == ALLOC_CLIENT_RUNNING:
-                return "running"
-            if cs == ALLOC_CLIENT_COMPLETE:
-                return "complete"
-            if cs == ALLOC_CLIENT_FAILED:
-                return "failed"
-            if cs == ALLOC_CLIENT_LOST:
-                return "lost"
-            return None
-
+        bucket = _client_status_bucket
         ob, nb = bucket(old), bucket(new)
         if ob == nb:
             if old is not None:
@@ -752,13 +756,19 @@ class StateStore(StateSnapshot):
         state_store.go UpsertPlanResults)."""
         with self._lock:
             root = self._root.edit()
-            new_placed = [a for a in allocs_placed
-                          if a.deployment_id
-                          and root.table("allocs").get(a.id) is None]
+            t_allocs = root.table("allocs")
+            fresh = [a for a in allocs_placed
+                     if t_allocs.get(a.id) is None]
+            fresh_ids = {a.id for a in fresh}
+            new_placed = [a for a in fresh if a.deployment_id]
             for a in allocs_stopped:
                 root = self._upsert_alloc_impl(root, index, a)
+            # in-place updates go through the general path; brand-new
+            # placements take the bulk path (one index write per key)
             for a in allocs_placed:
-                root = self._upsert_alloc_impl(root, index, a)
+                if a.id not in fresh_ids:
+                    root = self._upsert_alloc_impl(root, index, a)
+            root = self._bulk_insert_allocs(root, index, fresh)
             for a in allocs_preempted:
                 root = self._upsert_alloc_impl(root, index, a)
             if deployment is not None:
@@ -779,6 +789,68 @@ class StateStore(StateSnapshot):
                         .with_index("deployments", index)
                         .with_index("evals", index))
             self._publish(root)
+
+    def _bulk_insert_allocs(self, root: _Root, index: int,
+                            allocs: List[Allocation]) -> _Root:
+        """Insert allocations known to be ABSENT from the table. Same
+        effect as _upsert_alloc_impl per alloc, but secondary-index and
+        job-summary writes are grouped per key — a 10k-alloc plan apply
+        does ~1 outer write per touched node/job/eval instead of 14
+        HAMT writes per alloc."""
+        if not allocs:
+            return root
+        t = root.table("allocs")
+        for a in allocs:
+            a.create_index = index
+            a.modify_index = index
+            a.alloc_modify_index = index
+            t = t.set(a.id, a)
+            self._log_change(index, "alloc", a.id)
+        root = root.with_table("allocs", t)
+
+        for table, keyfn in (
+                ("allocs_by_node", lambda a: a.node_id),
+                ("allocs_by_job", lambda a: (a.namespace, a.job_id)),
+                ("allocs_by_eval", lambda a: a.eval_id)):
+            groups: Dict = {}
+            for a in allocs:
+                groups.setdefault(keyfn(a), []).append(a.id)
+            tt = root.table(table)
+            for key, ids in groups.items():
+                members = (tt.get(key) or Hamt()).with_ctx(root._ctx)
+                for aid in ids:
+                    members = members.set(aid, True)
+                tt = tt.set(key, members.frozen())
+            root = root.with_table(table, tt)
+
+        # job summaries: aggregate bucket deltas per job
+        per_job: Dict = {}
+        for a in allocs:
+            nb = _client_status_bucket(a)
+            if nb is None:
+                continue
+            deltas = per_job.setdefault((a.namespace, a.job_id), {})
+            k = (a.task_group, nb)
+            deltas[k] = deltas.get(k, 0) + 1
+        if per_job:
+            summaries = root.table("job_summaries")
+            changed = False
+            for key, deltas in per_job.items():
+                s: Optional[JobSummary] = summaries.get(key)
+                if s is None:
+                    continue
+                summ = dict(s.summary)
+                for (tg, b), cnt in deltas.items():
+                    counts = dict(summ.get(tg, {}))
+                    counts[b] = counts.get(b, 0) + cnt
+                    summ[tg] = counts
+                summaries = summaries.set(
+                    key, replace(s, summary=summ, modify_index=index))
+                changed = True
+            if changed:
+                root = root.with_table("job_summaries", summaries) \
+                           .with_index("job_summaries", index)
+        return root
 
     def update_alloc_desired_transitions(self, index: int,
                                          alloc_ids: List[str],
