@@ -32,35 +32,67 @@ def _print_rows(rows: List[List[str]], header: List[str]) -> None:
 
 # -- agent -------------------------------------------------------------
 def cmd_agent(args) -> int:
-    from ..server import Server, ServerConfig
     from ..client import Client, ClientConfig
-    from ..api import HTTPApiServer
 
-    if not args.dev:
-        print("only -dev mode is supported in this build", file=sys.stderr)
+    is_server = args.dev or args.server
+    is_client = args.dev or args.client
+    if not is_server and not is_client:
+        print("specify -dev, -server and/or -client", file=sys.stderr)
         return 1
-    # The scheduler kernels need a working JAX backend. A dead TPU tunnel
-    # can hang (not raise) on first device use, so probe it in a
-    # subprocess with a timeout and fall back to CPU so the agent still
-    # serves (utils/platform.py).
-    from ..utils.platform import force_cpu_platform, probe_accelerator
-    if os.environ.get("JAX_PLATFORMS", "") != "cpu" and \
-            probe_accelerator(timeout_s=60.0) is None:
-        force_cpu_platform(1)
-        print("    WARNING: TPU backend unavailable; scheduling on CPU")
-    server = Server(ServerConfig(num_schedulers=args.num_schedulers))
-    server.start()
+    if is_client and not is_server and not args.servers:
+        print("-client requires -servers host:port", file=sys.stderr)
+        return 1
+
+    server = None
+    rpc = None
+    api = None
     clients = []
-    for i in range(args.clients):
-        c = Client(server, ClientConfig(node_name=f"dev-client-{i}"))
+
+    if is_server:
+        from ..server import Server, ServerConfig
+        from ..api import HTTPApiServer
+        from ..rpc import RpcServer
+        # The scheduler kernels need a working JAX backend. A dead TPU
+        # tunnel can hang (not raise) on first device use, so probe it
+        # in a subprocess with a timeout and fall back to CPU so the
+        # agent still serves (utils/platform.py).
+        from ..utils.platform import force_cpu_platform, probe_accelerator
+        if os.environ.get("JAX_PLATFORMS", "") != "cpu" and \
+                probe_accelerator(timeout_s=60.0) is None:
+            force_cpu_platform(1)
+            print("    WARNING: TPU backend unavailable; scheduling on CPU")
+        server = Server(ServerConfig(num_schedulers=args.num_schedulers))
+        server.start()
+        rpc = RpcServer(server, port=args.rpc_port)
+        rpc.start()
+        api = HTTPApiServer(server, port=args.http_port)
+        api.start()
+
+    n_local_clients = args.clients if is_client else 0
+    for i in range(n_local_clients):
+        if server is not None:
+            c = Client(server, ClientConfig(node_name=f"dev-client-{i}"))
+        else:
+            from ..rpc import RemoteTransport
+            c = Client(RemoteTransport(args.servers),
+                       ClientConfig(node_name=args.node_name or
+                                    f"client-{i}"))
         c.start()
         clients.append(c)
-    api = HTTPApiServer(server, port=args.http_port)
-    api.start()
-    print(f"==> nomad-tpu agent started (dev mode)")
-    print(f"    HTTP API: http://127.0.0.1:{api.port}")
-    print(f"    Nodes:    {args.clients}")
-    print(f"    Workers:  {args.num_schedulers}")
+
+    mode = "dev" if args.dev else \
+        "+".join(m for m, on in (("server", is_server),
+                                 ("client", is_client)) if on)
+    print(f"==> nomad-tpu agent started ({mode} mode)")
+    if api is not None:
+        print(f"    HTTP API: http://127.0.0.1:{api.port}")
+    if rpc is not None:
+        print(f"    RPC:      {rpc.addr}")
+    if clients:
+        print(f"    Nodes:    {len(clients)}")
+    if server is not None:
+        print(f"    Workers:  {args.num_schedulers}")
+    sys.stdout.flush()
 
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
@@ -70,10 +102,14 @@ def cmd_agent(args) -> int:
             time.sleep(0.2)
     finally:
         print("==> shutting down")
-        api.shutdown()
+        if api is not None:
+            api.shutdown()
         for c in clients:
             c.shutdown()
-        server.shutdown()
+        if rpc is not None:
+            rpc.shutdown()
+        if server is not None:
+            server.shutdown()
     return 0
 
 
@@ -522,7 +558,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     agent = sub.add_parser("agent", help="run the agent")
     agent.add_argument("-dev", action="store_true")
+    agent.add_argument("-server", action="store_true")
+    agent.add_argument("-client", action="store_true")
+    agent.add_argument("-servers", default="",
+                       help="server RPC address host:port (client mode)")
+    agent.add_argument("-node-name", dest="node_name", default="")
     agent.add_argument("-http-port", dest="http_port", type=int, default=4646)
+    agent.add_argument("-rpc-port", dest="rpc_port", type=int, default=4647)
     agent.add_argument("-clients", type=int, default=1)
     agent.add_argument("-num-schedulers", dest="num_schedulers", type=int,
                        default=2)

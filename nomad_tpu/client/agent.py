@@ -233,12 +233,20 @@ class AllocRunner:
 
 
 class Client:
-    """The node agent. Talks to the server through a narrow RPC surface
-    (register_node/heartbeat/allocs_by_node/update_alloc_status_from_client)
-    — direct method calls in-process, gRPC later."""
+    """The node agent. Talks to the server through the narrow
+    ServerTransport surface (rpc/transport.py): direct method calls
+    in-process (dev agent), or the wire RPC layer in a real cluster.
+    Accepts either a Server object (wrapped in InProcTransport, the
+    historical signature) or any ServerTransport."""
 
     def __init__(self, server, config: Optional[ClientConfig] = None):
-        self.server = server
+        from ..rpc.transport import InProcTransport, ServerTransport
+        if isinstance(server, ServerTransport):
+            self.transport = server
+            self.server = getattr(server, "server", None)
+        else:
+            self.transport = InProcTransport(server)
+            self.server = server
         self.config = config or ClientConfig()
         self.node = self._fingerprint()
         self.drivers = {name: DRIVER_CATALOG[name]()
@@ -282,8 +290,8 @@ class Client:
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
         self.node.status = NODE_STATUS_READY
-        self.server.register_node(self.node)
-        self.server.update_node_status(self.node.id, NODE_STATUS_READY)
+        self.transport.register_node(self.node)
+        self.transport.update_node_status(self.node.id, NODE_STATUS_READY)
         t1 = threading.Thread(target=self._heartbeat_loop, daemon=True)
         t2 = threading.Thread(target=self._watch_allocs, daemon=True)
         self._threads = [t1, t2]
@@ -292,16 +300,21 @@ class Client:
 
     def shutdown(self) -> None:
         self._stop.set()
-        for r in self.runners.values():
+        # copy: the alloc-watch thread may still mutate the dict until
+        # it observes _stop
+        for r in list(self.runners.values()):
             r.stop()
         for t in self._threads:
             t.join(timeout=2)
+        close = getattr(self.transport, "close", None)
+        if close is not None:
+            close()
 
     def _heartbeat_loop(self) -> None:
         interval = self.config.heartbeat_interval_s
         while not self._stop.is_set():
             try:
-                ttl = self.server.heartbeat(self.node.id)
+                ttl = self.transport.heartbeat(self.node.id)
                 # renew at half the granted TTL (client/client.go heartbeats
                 # inside the server-granted TTL window, never beyond it)
                 interval = min(self.config.heartbeat_interval_s, ttl / 2.0)
@@ -316,14 +329,16 @@ class Client:
                 self._run_allocs()
             except Exception:
                 LOG.exception("runAllocs failed")
-            # blocking query: wake on state change or poll interval
-            self.server.store.block_min_index(
-                self._seen_index, timeout_s=self.config.poll_interval_s)
+                self._stop.wait(self.config.poll_interval_s)
 
     def _run_allocs(self) -> None:
-        snap = self.server.store.snapshot()
-        self._seen_index = snap.latest_index()
-        server_allocs = {a.id: a for a in snap.allocs_by_node(self.node.id)}
+        # long-poll: the server blocks until state moves past the index
+        # we've seen (or the wait expires), node_endpoint.go:926
+        allocs, index = self.transport.get_client_allocs(
+            self.node.id, self._seen_index,
+            max(self.config.poll_interval_s, 0.05))
+        self._seen_index = index
+        server_allocs = {a.id: a for a in allocs}
         # start new allocs
         for aid, alloc in server_allocs.items():
             if aid in self.runners:
@@ -353,6 +368,6 @@ class Client:
 
     def _push_update(self, update: Allocation) -> None:
         try:
-            self.server.update_alloc_status_from_client([update])
+            self.transport.update_alloc_status([update])
         except Exception:
             LOG.exception("alloc update push failed")

@@ -1,0 +1,70 @@
+"""Wire framing and codec.
+
+Frame layout: 4-byte big-endian length prefix + payload. The payload is
+a 3-element array:
+    request:  [seq, method, args]
+    response: [seq, error-or-None, result]
+encoded by a pluggable codec backend — msgpack by default (the
+reference's go-msgpack codec, nomad/rpc.go:27), with the native C++
+codec slot reserved (utils/native). Model objects cross the wire as
+plain dicts via utils/codec.to_wire/from_wire.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any, Optional, Tuple
+
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class RpcError(Exception):
+    """Server-side error surfaced to the caller."""
+
+
+def _default_backend():
+    import msgpack
+
+    def dumps(obj):
+        return msgpack.packb(obj, use_bin_type=True)
+
+    def loads(buf):
+        return msgpack.unpackb(buf, raw=False, strict_map_key=False)
+
+    return dumps, loads
+
+
+class FrameCodec:
+    """Reads/writes length-prefixed frames on a socket."""
+
+    def __init__(self, sock: socket.socket, backend=None):
+        self.sock = sock
+        self._dumps, self._loads = backend or _default_backend()
+        self._rbuf = b""
+
+    def write_frame(self, payload: Any) -> None:
+        buf = self._dumps(payload)
+        self.sock.sendall(struct.pack(">I", len(buf)) + buf)
+
+    def read_frame(self) -> Optional[Any]:
+        """One frame, or None on clean EOF."""
+        header = self._read_exact(4)
+        if header is None:
+            return None
+        (length,) = struct.unpack(">I", header)
+        if length > MAX_FRAME:
+            raise RpcError(f"frame too large: {length}")
+        body = self._read_exact(length)
+        if body is None:
+            return None
+        return self._loads(body)
+
+    def _read_exact(self, n: int) -> Optional[bytes]:
+        while len(self._rbuf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                return None
+            self._rbuf += chunk
+        out, self._rbuf = self._rbuf[:n], self._rbuf[n:]
+        return out

@@ -1,0 +1,152 @@
+"""Server-side RPC endpoint registry and TCP listener.
+
+The method table mirrors the reference's endpoint structs
+(nomad/server.go:264 `endpoints`, node_endpoint.go, job_endpoint.go):
+each method declares how to decode its typed arguments and runs against
+the Server object. Long-poll methods (Node.GetClientAllocs) block
+server-side on the state store's watch condition exactly like blocking
+queries over go-memdb watch channels (node_endpoint.go:926).
+
+Concurrency model: one handler thread per in-flight request; responses
+are written under a per-connection lock and matched by seq on the
+client — the functional equivalent of net/rpc over yamux streams.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..models import Allocation, Node
+from ..utils.codec import from_wire, to_wire
+from .codec import FrameCodec
+
+LOG = logging.getLogger("nomad_tpu.rpc")
+
+
+def _get_client_allocs(server, args: Dict) -> Dict:
+    node_id = args["node_id"]
+    min_index = int(args.get("min_index", 0))
+    max_wait_s = float(args.get("max_wait_s", 30.0))
+    store = server.store
+    if min_index > 0:
+        store.block_min_index(min_index, timeout_s=max_wait_s)
+    snap = store.snapshot()
+    allocs = snap.allocs_by_node(node_id)
+    return {"allocs": [to_wire(a) for a in allocs],
+            "index": snap.latest_index()}
+
+
+def build_method_table(server) -> Dict[str, Any]:
+    """method name -> callable(args dict) -> wire-safe result."""
+
+    def node_register(args):
+        node = from_wire(Node, args["node"])
+        server.register_node(node)
+        return {"heartbeat_ttl_s": server.config.heartbeat_ttl_s}
+
+    def node_update_status(args):
+        server.update_node_status(args["node_id"], args["status"])
+        return {}
+
+    def node_heartbeat(args):
+        return {"ttl_s": server.heartbeat(args["node_id"])}
+
+    def node_update_alloc(args):
+        allocs = [from_wire(Allocation, a) for a in args["allocs"]]
+        server.update_alloc_status_from_client(allocs)
+        return {}
+
+    def node_get_client_allocs(args):
+        return _get_client_allocs(server, args)
+
+    def status_ping(_args):
+        return {"status": "ok", "leader": True,
+                "index": server.store.latest_index()}
+
+    return {
+        "Node.Register": node_register,
+        "Node.UpdateStatus": node_update_status,
+        "Node.Heartbeat": node_heartbeat,
+        "Node.UpdateAlloc": node_update_alloc,
+        "Node.GetClientAllocs": node_get_client_allocs,
+        "Status.Ping": status_ping,
+    }
+
+
+class RpcServer:
+    """Threaded TCP RPC listener bound to a Server instance."""
+
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0):
+        self.server = server
+        self.methods = build_method_table(server)
+        rpc = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                rpc._serve_conn(self.request)
+
+        class Listener(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._listener = Listener((host, port), Handler)
+        self.host, self.port = self._listener.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._listener.serve_forever, daemon=True,
+            name="rpc-listener")
+        self._thread.start()
+        LOG.info("rpc listening on %s:%d", self.host, self.port)
+
+    def shutdown(self) -> None:
+        self._listener.shutdown()
+        self._listener.server_close()
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- per-connection serving ---------------------------------------
+    def _serve_conn(self, sock: socket.socket) -> None:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        codec = FrameCodec(sock)
+        wlock = threading.Lock()
+        try:
+            while True:
+                frame = codec.read_frame()
+                if frame is None:
+                    return
+                seq, method, args = frame
+                t = threading.Thread(
+                    target=self._dispatch, daemon=True,
+                    args=(codec, wlock, seq, method, args),
+                    name=f"rpc-{method}")
+                t.start()
+        except (ConnectionError, OSError):
+            return
+
+    def _dispatch(self, codec: FrameCodec, wlock: threading.Lock,
+                  seq: int, method: str, args: Dict) -> None:
+        err: Optional[str] = None
+        result: Any = None
+        fn = self.methods.get(method)
+        if fn is None:
+            err = f"unknown rpc method: {method}"
+        else:
+            try:
+                result = fn(args or {})
+            except Exception as e:          # surfaced to the caller
+                LOG.exception("rpc %s failed", method)
+                err = f"{type(e).__name__}: {e}"
+        try:
+            with wlock:
+                codec.write_frame([seq, err, result])
+        except (ConnectionError, OSError):
+            pass

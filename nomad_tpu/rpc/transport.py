@@ -1,0 +1,100 @@
+"""The client agent's narrow server surface, in-proc or over the wire.
+
+The client agent only ever needs five verbs (client/client.go's RPC
+usage): register, status update, heartbeat, long-poll allocs, push
+alloc status. `InProcTransport` binds them to a Server object in the
+same process (dev agent); `RemoteTransport` sends them through
+RpcClient — the same split as the reference's dev-mode agent embedding
+a server vs. a real cluster (command/agent/agent.go).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..models import Allocation, Node
+from ..utils.codec import from_wire, to_wire
+
+
+class ServerTransport:
+    """Interface: what the client agent needs from a server."""
+
+    def register_node(self, node: Node) -> float:
+        raise NotImplementedError
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        raise NotImplementedError
+
+    def heartbeat(self, node_id: str) -> float:
+        raise NotImplementedError
+
+    def get_client_allocs(self, node_id: str, min_index: int,
+                          max_wait_s: float
+                          ) -> Tuple[List[Allocation], int]:
+        raise NotImplementedError
+
+    def update_alloc_status(self, allocs: List[Allocation]) -> None:
+        raise NotImplementedError
+
+
+class InProcTransport(ServerTransport):
+    def __init__(self, server):
+        self.server = server
+
+    def register_node(self, node: Node) -> float:
+        self.server.register_node(node)
+        return self.server.config.heartbeat_ttl_s
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        self.server.update_node_status(node_id, status)
+
+    def heartbeat(self, node_id: str) -> float:
+        return self.server.heartbeat(node_id)
+
+    def get_client_allocs(self, node_id: str, min_index: int,
+                          max_wait_s: float
+                          ) -> Tuple[List[Allocation], int]:
+        store = self.server.store
+        if min_index > 0:
+            store.block_min_index(min_index, timeout_s=max_wait_s)
+        snap = store.snapshot()
+        return snap.allocs_by_node(node_id), snap.latest_index()
+
+    def update_alloc_status(self, allocs: List[Allocation]) -> None:
+        self.server.update_alloc_status_from_client(allocs)
+
+
+class RemoteTransport(ServerTransport):
+    def __init__(self, addr: str):
+        from .client import RpcClient
+        self.rpc = RpcClient(addr)
+
+    def close(self) -> None:
+        self.rpc.close()
+
+    def register_node(self, node: Node) -> float:
+        res = self.rpc.call("Node.Register", {"node": to_wire(node)})
+        return float(res.get("heartbeat_ttl_s", 10.0))
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        self.rpc.call("Node.UpdateStatus",
+                      {"node_id": node_id, "status": status})
+
+    def heartbeat(self, node_id: str) -> float:
+        return float(self.rpc.call("Node.Heartbeat",
+                                   {"node_id": node_id})["ttl_s"])
+
+    def get_client_allocs(self, node_id: str, min_index: int,
+                          max_wait_s: float
+                          ) -> Tuple[List[Allocation], int]:
+        res = self.rpc.call(
+            "Node.GetClientAllocs",
+            {"node_id": node_id, "min_index": min_index,
+             "max_wait_s": max_wait_s},
+            timeout_s=max_wait_s + 30.0)
+        allocs = [from_wire(Allocation, a) for a in res["allocs"]]
+        return allocs, int(res["index"])
+
+    def update_alloc_status(self, allocs: List[Allocation]) -> None:
+        self.rpc.call("Node.UpdateAlloc",
+                      {"allocs": [to_wire(a) for a in allocs]})

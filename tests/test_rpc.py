@@ -1,0 +1,210 @@
+"""The wire RPC boundary: msgpack frames over TCP between server and
+client agent (reference: nomad/rpc.go, node_endpoint.go:926 long-poll,
+client/client.go watchAllocations).
+
+Three tiers:
+  1. raw RpcServer/RpcClient semantics (errors, concurrency, blocking
+     queries),
+  2. a full Client agent connected over real TCP running a job,
+  3. separate OS processes: `agent -server` and `agent -client`
+     subprocesses driven through the HTTP API.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.models import ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_RUNNING
+from nomad_tpu.rpc import RemoteTransport, RpcClient, RpcError, RpcServer
+from nomad_tpu.server import Server, ServerConfig
+
+
+def _wait_for(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def rpc_cluster():
+    server = Server(ServerConfig(num_schedulers=2, heartbeat_ttl_s=30.0))
+    server.start()
+    rpc = RpcServer(server, port=0)
+    rpc.start()
+    yield server, rpc
+    rpc.shutdown()
+    server.shutdown()
+
+
+# -- tier 1: raw rpc ---------------------------------------------------
+def test_ping_and_unknown_method(rpc_cluster):
+    _server, rpc = rpc_cluster
+    c = RpcClient(rpc.addr)
+    assert c.call("Status.Ping")["status"] == "ok"
+    with pytest.raises(RpcError, match="unknown rpc method"):
+        c.call("No.Such.Method")
+    c.close()
+
+
+def test_node_register_and_heartbeat_over_wire(rpc_cluster):
+    server, rpc = rpc_cluster
+    t = RemoteTransport(rpc.addr)
+    node = mock.node()
+    ttl = t.register_node(node)
+    assert ttl > 0
+    assert server.store.node_by_id(node.id) is not None
+    assert t.heartbeat(node.id) > 0
+    with pytest.raises(RpcError):
+        t.heartbeat("nonexistent-node")
+    t.close()
+
+
+def test_get_client_allocs_blocks_until_index(rpc_cluster):
+    server, rpc = rpc_cluster
+    t = RemoteTransport(rpc.addr)
+    node = mock.node()
+    t.register_node(node)
+    allocs, index = t.get_client_allocs(node.id, 0, 1.0)
+    assert allocs == []
+    # a long-poll past the current index should block ~max_wait
+    t0 = time.time()
+    _allocs, index2 = t.get_client_allocs(node.id, index, 0.5)
+    elapsed = time.time() - t0
+    assert elapsed >= 0.3
+    assert index2 >= index
+    t.close()
+
+
+def test_concurrent_calls_one_connection(rpc_cluster):
+    """A slow long-poll must not block other calls on the same
+    connection (the yamux-multiplexing property)."""
+    server, rpc = rpc_cluster
+    t = RemoteTransport(rpc.addr)
+    node = mock.node()
+    t.register_node(node)
+    _, index = t.get_client_allocs(node.id, 0, 1.0)
+
+    import threading
+    done = []
+
+    def long_poll():
+        t.get_client_allocs(node.id, index, 3.0)
+        done.append("poll")
+
+    th = threading.Thread(target=long_poll, daemon=True)
+    th.start()
+    time.sleep(0.1)
+    t0 = time.time()
+    t.heartbeat(node.id)          # same TCP connection, should not wait
+    assert time.time() - t0 < 1.0
+    th.join(timeout=10)
+    assert done == ["poll"]
+    t.close()
+
+
+# -- tier 2: client agent over the wire --------------------------------
+def test_client_agent_runs_job_over_wire(rpc_cluster):
+    server, rpc = rpc_cluster
+    client = Client(RemoteTransport(rpc.addr),
+                    ClientConfig(node_name="wire-client"))
+    client.start()
+    try:
+        assert _wait_for(lambda: server.store.node_by_id(client.node.id)
+                         is not None)
+        job = mock.batch_job()
+        job.task_groups[0].count = 2
+        job.task_groups[0].tasks[0].config = {"run_for": "100ms"}
+        server.register_job(job)
+        assert _wait_for(lambda: len(
+            server.store.allocs_by_job("default", job.id)) == 2), \
+            "allocs never placed"
+        assert _wait_for(lambda: all(
+            a.client_status == ALLOC_CLIENT_COMPLETE
+            for a in server.store.allocs_by_job("default", job.id))), \
+            [a.client_status
+             for a in server.store.allocs_by_job("default", job.id)]
+    finally:
+        client.shutdown()
+
+
+# -- tier 3: separate OS processes -------------------------------------
+@pytest.mark.slow
+def test_server_and_client_subprocesses(tmp_path):
+    import json
+    import urllib.request
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PYTHONUNBUFFERED"] = "1"
+    http_port = 14646
+    rpc_port = 14647
+
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "nomad_tpu.cli", "agent", "-server",
+         "-http-port", str(http_port), "-rpc-port", str(rpc_port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd="/root/repo", text=True)
+    cli = None
+    try:
+        def http(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}{path}", timeout=2) as r:
+                return json.loads(r.read())
+
+        def server_up():
+            try:
+                http("/v1/nodes")
+                return True
+            except Exception:
+                return False
+
+        assert _wait_for(server_up, timeout=60), "server never came up"
+
+        cli = subprocess.Popen(
+            [sys.executable, "-m", "nomad_tpu.cli", "agent", "-client",
+             "-servers", f"127.0.0.1:{rpc_port}",
+             "-node-name", "subproc-client"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            cwd="/root/repo", text=True)
+
+        assert _wait_for(
+            lambda: any(n.get("name") == "subproc-client"
+                        for n in http("/v1/nodes")), timeout=30), \
+            "client node never registered"
+
+        # submit a job through the HTTP API
+        from nomad_tpu.api.client import ApiClient
+        from nomad_tpu.utils.codec import to_wire
+        job = mock.batch_job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].config = {"run_for": "200ms"}
+        api = ApiClient(f"http://127.0.0.1:{http_port}")
+        api.register_job(to_wire(job))
+
+        def alloc_complete():
+            allocs = http(f"/v1/job/{job.id}/allocations")
+            return allocs and all(
+                a.get("client_status") == "complete" for a in allocs)
+
+        assert _wait_for(alloc_complete, timeout=60), \
+            http(f"/v1/job/{job.id}/allocations")
+    finally:
+        for p in (cli, srv):
+            if p is not None:
+                p.send_signal(signal.SIGTERM)
+        for p in (cli, srv):
+            if p is not None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
