@@ -55,10 +55,15 @@ def cmd_agent(args) -> int:
         # The scheduler kernels need a working JAX backend. A dead TPU
         # tunnel can hang (not raise) on first device use, so probe it
         # in a subprocess with a timeout and fall back to CPU so the
-        # agent still serves (utils/platform.py).
+        # agent still serves. NOTE: JAX_PLATFORMS=cpu in the env is NOT
+        # sufficient — the image's sitecustomize registers the
+        # accelerator plugin at interpreter startup, so the in-process
+        # config update in force_cpu_platform is required
+        # (utils/platform.py).
         from ..utils.platform import force_cpu_platform, probe_accelerator
-        if os.environ.get("JAX_PLATFORMS", "") != "cpu" and \
-                probe_accelerator(timeout_s=60.0) is None:
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            force_cpu_platform(1)
+        elif probe_accelerator(timeout_s=60.0) is None:
             force_cpu_platform(1)
             print("    WARNING: TPU backend unavailable; scheduling on CPU")
         server = Server(ServerConfig(num_schedulers=args.num_schedulers))

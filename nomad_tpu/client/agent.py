@@ -42,6 +42,35 @@ class ClientConfig:
     meta: dict = field(default_factory=dict)
     poll_interval_s: float = 0.2
     heartbeat_interval_s: float = 3.0
+    # device fingerprinting: statically declared device groups
+    # (NodeDeviceResource) plus optional JAX accelerator autodetection
+    # (the TPU-native analog of devices/gpu/nvidia fingerprint)
+    devices: tuple = ()
+    fingerprint_accelerators: bool = False
+
+
+def fingerprint_accelerator_devices():
+    """Detect locally attached JAX accelerators as a device group
+    (devices/gpu/nvidia/device.go Fingerprint, re-aimed at TPUs).
+    Returns [] when no accelerator backend is available."""
+    from ..models import NodeDevice, NodeDeviceResource
+    try:
+        import jax
+        if jax.default_backend() == "cpu":
+            return []
+        devs = jax.devices()
+    except Exception:
+        return []
+    if not devs:
+        return []
+    kind = devs[0].platform            # "tpu" / "gpu"
+    name = getattr(devs[0], "device_kind", kind) or kind
+    return [NodeDeviceResource(
+        vendor="google" if kind == "tpu" else "",
+        type=kind, name=str(name).replace(" ", "-").lower(),
+        attributes={"count": len(devs)},
+        instances=[NodeDevice(id=f"{kind}-{d.id}", healthy=True)
+                   for d in devs])]
 
 
 class TaskRunner:
@@ -284,6 +313,12 @@ class Client:
             node.attributes[f"driver.{name}"] = "1"
             from ..models import DriverInfo as DI
             node.drivers[name] = DI(detected=True, healthy=True)
+        node.node_resources.devices = list(self.config.devices)
+        if self.config.fingerprint_accelerators:
+            node.node_resources.devices.extend(
+                fingerprint_accelerator_devices())
+        for g in node.node_resources.devices:
+            node.attributes[f"device.{g.type}"] = str(len(g.instances))
         node.compute_class()
         return node
 

@@ -1,6 +1,7 @@
 from .fixtures import (
     node,
     nvidia_node,
+    tpu_node,
     job,
     batch_job,
     system_job,

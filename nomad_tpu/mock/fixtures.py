@@ -85,6 +85,28 @@ def nvidia_node() -> Node:
     return n
 
 
+def tpu_node(chips: int = 4) -> Node:
+    """Node with a TPU device group (the on-theme analog of
+    mock.go NvidiaNode:114)."""
+    n = node()
+    n.node_resources.devices = [
+        NodeDeviceResource(
+            vendor="google", type="tpu", name="v5e",
+            attributes={
+                "hbm_gib": 16,
+                "cores": 1,
+                "topology": f"{chips}x1",
+            },
+            instances=[
+                NodeDevice(id=f"tpu-{i}", healthy=True)
+                for i in range(chips)
+            ],
+        )
+    ]
+    n.compute_class()
+    return n
+
+
 def _web_task() -> Task:
     return Task(
         name="web",

@@ -80,6 +80,12 @@ class SelectRequest:
     port_need: float = 0.0
     free_ports: Optional[np.ndarray] = None     # f32[N]
     port_ok: Optional[np.ndarray] = None        # bool[N]
+    # device dimension (scheduler/devices.py): placements-remaining
+    # slots per node (consumed 1 per placement), the "devices" scorer
+    # column, and whether that scorer fires (any ask has affinities)
+    dev_slots: Optional[np.ndarray] = None      # f32[N]
+    dev_score: Optional[np.ndarray] = None      # f32[N]
+    dev_fires: bool = False
     # spreads: list of dicts with codes i32[N], counts f32[C+1],
     #          present bool[C+1], desired f32[C+1] (-1 == none),
     #          has_implicit, implicit_desired, weight, has_targets
@@ -113,6 +119,7 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
                  tg_coll0, job_count0, distinct_hosts_flag, scan_exclusive,
                  penalty, affinity_norm, desired_count,
                  port_need, free_ports, port_ok,
+                 dev_slots0, dev_score, dev_fires,
                  sp_codes, sp_counts0, sp_present0, sp_desired,
                  sp_weight, sp_has_targets, sp_valid, sum_spread_w,
                  dp_codes, dp_counts0, dp_limit, dp_valid,
@@ -127,7 +134,7 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
     cap_mem = jnp.maximum(capacity[:, 1], 1e-9)
 
     def step(carry, step_i):
-        (used, tg_coll, job_cnt, scan_placed, free_p,
+        (used, tg_coll, job_cnt, scan_placed, free_p, dev_slots,
          sp_counts, sp_present, dp_counts) = carry
 
         # ---- feasibility beyond the static mask -----------------------
@@ -138,6 +145,7 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
         feas &= jnp.where(scan_exclusive > 0, scan_placed == 0, True)
         feas &= free_p >= port_need
         feas &= port_ok
+        feas &= dev_slots >= 1.0
         # distinct_property: count(value)+1 <= limit, missing attr fails
         for p in range(p_live):
             codes = dp_codes[p]
@@ -184,6 +192,9 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
         aff_fires = affinity_norm != 0.0
         aff = affinity_norm
 
+        # ---- device affinity ("devices" scorer, rank.go:456) ---------
+        dev = jnp.where(dev_fires > 0, dev_score, 0.0)
+
         # ---- spread ---------------------------------------------------
         spread_total = jnp.zeros(n, dtype=jnp.float32)
         for s in range(s_live):
@@ -229,8 +240,9 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
         fired = (1.0 + anti_fires.astype(jnp.float32)
                  + pen_fires.astype(jnp.float32)
                  + aff_fires.astype(jnp.float32)
-                 + spread_fires.astype(jnp.float32))
-        final = (binpack + anti + pen + aff + spread_total) / fired
+                 + spread_fires.astype(jnp.float32)
+                 + jnp.where(dev_fires > 0, 1.0, 0.0))
+        final = (binpack + anti + pen + aff + spread_total + dev) / fired
 
         # ---- masked argmax -------------------------------------------
         ok = feas & fit
@@ -248,6 +260,7 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
         job_cnt = job_cnt + onehot.astype(jnp.int32)
         scan_placed = scan_placed + onehot.astype(jnp.int32)
         free_p = free_p - onehot.astype(jnp.float32) * port_need
+        dev_slots = dev_slots - onehot.astype(jnp.float32)
         c_axis = sp_counts.shape[-1]
         chosen_sp_codes = sp_codes[:, choice]           # [S]
         sp_upd = (jax.nn.one_hot(chosen_sp_codes, c_axis,
@@ -268,26 +281,29 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
                jnp.where(valid, pen[jnp.maximum(choice, 0)], 0.0),
                jnp.where(valid, aff[jnp.maximum(choice, 0)], 0.0),
                jnp.where(valid, spread_total[jnp.maximum(choice, 0)], 0.0),
+               jnp.where(valid, dev[jnp.maximum(choice, 0)], 0.0),
                top_idx.astype(jnp.int32), top_scores,
                exhausted, ok.sum().astype(jnp.int32))
-        return (used, tg_coll, job_cnt, scan_placed, free_p,
+        return (used, tg_coll, job_cnt, scan_placed, free_p, dev_slots,
                 sp_counts, sp_present, dp_counts), out
 
     carry0 = (used0, tg_coll0, job_count0,
-              jnp.zeros(n, dtype=jnp.int32), free_ports,
+              jnp.zeros(n, dtype=jnp.int32), free_ports, dev_slots0,
               sp_counts0, sp_present0, dp_counts0)
     carry, outs = jax.lax.scan(step, carry0, jnp.arange(k_steps))
     return carry, outs
 
 
 def _local_final_score(after, cap_cpu, cap_mem, coll, penalty, affinity,
-                       desired_count, spread_alg: bool):
+                       desired_count, spread_alg: bool,
+                       dev_score=0.0, dev_fires=0.0):
     """Node-local score (binpack/spread fit + anti-affinity + penalty +
-    affinity, normalized over fired scorers). Shape-polymorphic over the
-    leading axes: after[..., D], cap/coll/penalty/affinity[...]. This is
-    the spread-free subset of the scan step's scoring, shared with the
-    chunked kernel (semantics: rank.go BinPack/JobAntiAffinity/
-    NodeReschedulingPenalty/NodeAffinity/ScoreNormalization)."""
+    affinity + device affinity, normalized over fired scorers).
+    Shape-polymorphic over the leading axes: after[..., D],
+    cap/coll/penalty/affinity/dev_score[...]. This is the spread-free
+    subset of the scan step's scoring, shared with the chunked kernel
+    (semantics: rank.go BinPack/JobAntiAffinity/NodeReschedulingPenalty/
+    NodeAffinity/device scoring:456/ScoreNormalization)."""
     free_cpu = 1.0 - after[..., 0] / cap_cpu
     free_mem = 1.0 - after[..., 1] / cap_mem
     total = jnp.power(10.0, free_cpu) + jnp.power(10.0, free_mem)
@@ -302,10 +318,12 @@ def _local_final_score(after, cap_cpu, cap_mem, coll, penalty, affinity,
                      -(collf + 1.0) / jnp.maximum(desired_count, 1.0), 0.0)
     pen = jnp.where(penalty, -1.0, 0.0)
     aff_fires = affinity != 0.0
+    dev = jnp.where(dev_fires > 0, dev_score, 0.0)
     fired = (1.0 + anti_fires.astype(jnp.float32)
              + penalty.astype(jnp.float32)
-             + aff_fires.astype(jnp.float32))
-    final = (binpack + anti + pen + affinity) / fired
+             + aff_fires.astype(jnp.float32)
+             + jnp.where(dev_fires > 0, 1.0, 0.0))
+    final = (binpack + anti + pen + affinity + dev) / fired
     return final, binpack, anti, pen
 
 
@@ -313,6 +331,7 @@ def _local_final_score(after, cap_cpu, cap_mem, coll, penalty, affinity,
 def _select_chunked(capacity, used0, feasible, ask, k_valid,
                     tg_coll0, penalty, affinity_norm, desired_count,
                     port_need, free_ports, port_ok,
+                    dev_slots0, dev_score, dev_fires,
                     *, max_steps: int, spread_alg: bool):
     """Chunked greedy placement for node-local scoring (no spread, no
     distinct-hosts/-property, no reserved-port exclusivity). Exactly
@@ -339,14 +358,15 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
     arange_j = jnp.arange(CHUNK_J, dtype=jnp.float32)
 
     def cond(state):
-        (_used, _coll, _freep, remaining, step, alive, *_outs) = state
+        (_used, _coll, _freep, _dev, remaining, step, alive, *_outs) = state
         return (remaining > 0) & alive & (step < max_steps)
 
     def body(state):
-        (used, coll, free_p, remaining, step, _alive,
+        (used, coll, free_p, dev_slots, remaining, step, _alive,
          out_choice, out_chunk, out_ti, out_ts, out_exh, out_feas) = state
 
-        feas = feasible & (free_p >= port_need) & port_ok
+        feas = feasible & (free_p >= port_need) & port_ok & \
+            (dev_slots >= 1.0)
         after = used + ask[None, :]
         fit_dims = after <= capacity + 1e-6
         fit = jnp.all(fit_dims, axis=1)
@@ -359,7 +379,7 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
 
         final, _b, _a, _p = _local_final_score(
             after, cap_cpu, cap_mem, coll, penalty, affinity_norm,
-            desired_count, spread_alg)
+            desired_count, spread_alg, dev_score, dev_fires)
         ok = feas & fit
         masked = jnp.where(ok, final, NEG_INF)
         top_scores, top_idx = jax.lax.top_k(masked, max(TOP_K, 2))
@@ -374,8 +394,9 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
         m_fit = jnp.min(per_dim)
         m_port = jnp.where(port_need > 0,
                            jnp.floor(free_p[choice] / port_need), 1e9)
-        a_max = jnp.minimum(jnp.minimum(m_fit, m_port),
-                            remaining.astype(jnp.float32))
+        a_max = jnp.minimum(
+            jnp.minimum(jnp.minimum(m_fit, m_port), dev_slots[choice]),
+            remaining.astype(jnp.float32))
 
         # score of the choice after each sub-placement a (state used_c +
         # a*ask, then + ask for the instance itself — the scan scores on
@@ -385,7 +406,7 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
         final_j, _, _, _ = _local_final_score(
             after_j, cap_cpu[choice], cap_mem[choice], coll_j,
             penalty[choice], affinity_norm[choice],
-            desired_count, spread_alg)
+            desired_count, spread_alg, dev_score[choice], dev_fires)
         # argmax tie rule: lowest index wins, so the choice survives a
         # tie with the runner-up only if its index is lower
         wins = (final_j > runner_val) | \
@@ -400,6 +421,7 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
         used = used + jnp.where(onehot[:, None], chunk * ask[None, :], 0.0)
         coll = coll + jnp.where(onehot, chunk_i, 0)
         free_p = free_p - onehot.astype(jnp.float32) * chunk * port_need
+        dev_slots = dev_slots - onehot.astype(jnp.float32) * chunk
 
         out_choice = out_choice.at[step].set(
             jnp.where(valid, choice, -1).astype(jnp.int32))
@@ -409,11 +431,12 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
         out_exh = out_exh.at[step].set(exhausted)
         out_feas = out_feas.at[step].set(ok.sum().astype(jnp.int32))
 
-        return (used, coll, free_p, remaining - chunk_i, step + 1, valid,
+        return (used, coll, free_p, dev_slots, remaining - chunk_i,
+                step + 1, valid,
                 out_choice, out_chunk, out_ti, out_ts, out_exh, out_feas)
 
     d = capacity.shape[1]
-    state0 = (used0, tg_coll0, free_ports, k_valid,
+    state0 = (used0, tg_coll0, free_ports, dev_slots0, k_valid,
               jnp.int32(0), jnp.bool_(True),
               jnp.full(max_steps, -1, jnp.int32),
               jnp.zeros(max_steps, jnp.int32),
@@ -422,9 +445,9 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
               jnp.zeros((max_steps, d), jnp.int32),
               jnp.zeros(max_steps, jnp.int32))
     out = jax.lax.while_loop(cond, body, state0)
-    (used, coll, free_p, remaining, steps, _alive,
+    (used, coll, free_p, dev_slots, remaining, steps, _alive,
      out_choice, out_chunk, out_ti, out_ts, out_exh, out_feas) = out
-    return ((used, coll, free_p),
+    return ((used, coll, free_p, dev_slots),
             (out_choice, out_chunk, out_ti, out_ts, out_exh, out_feas,
              remaining, steps))
 
@@ -439,6 +462,7 @@ PACK_SHARD_KINDS = {
     "distinct_hosts_flag": "scalar", "scan_exclusive": "scalar",
     "penalty": "node", "affinity_norm": "node", "desired_count": "scalar",
     "port_need": "scalar", "free_ports": "node", "port_ok": "node",
+    "dev_slots0": "node", "dev_score": "node", "dev_fires": "scalar",
     "sp_codes": "code", "sp_counts0": "rep", "sp_present0": "rep",
     "sp_desired": "rep", "sp_weight": "rep", "sp_has_targets": "rep",
     "sp_valid": "rep", "sum_spread_w": "scalar",
@@ -530,6 +554,11 @@ def pack_request(req: SelectRequest, n_pad: int):
                         else np.full(n, 1e9, np.float32)),
         port_ok=pad1(req.port_ok if req.port_ok is not None
                      else np.ones(n, bool), False, bool),
+        dev_slots0=pad1(req.dev_slots if req.dev_slots is not None
+                        else np.full(n, 1e9, np.float32)),
+        dev_score=pad1(req.dev_score if req.dev_score is not None
+                       else np.zeros(n, np.float32)),
+        dev_fires=np.float32(1.0 if req.dev_fires else 0.0),
         sp_codes=sp_codes, sp_counts0=sp_counts, sp_present0=sp_present,
         sp_desired=sp_desired, sp_weight=sp_weight,
         sp_has_targets=sp_has_targets, sp_valid=sp_valid,
@@ -545,7 +574,7 @@ def pack_request(req: SelectRequest, n_pad: int):
 def unpack_result(req: SelectRequest, outs) -> SelectResult:
     # ONE batched transfer: per-array np.asarray would serialize a
     # ~100ms device round trip per output over a tunneled TPU
-    (choices, finals, s_bin, s_anti, s_pen, s_aff, s_spread,
+    (choices, finals, s_bin, s_anti, s_pen, s_aff, s_spread, s_dev,
      top_idx, top_scores, exhausted, _ok_counts) = jax.device_get(outs)
     n = len(req.feasible)
     kk = req.count
@@ -559,7 +588,8 @@ def unpack_result(req: SelectRequest, outs) -> SelectResult:
         scores={"binpack": s_bin[:kk], "job-anti-affinity": s_anti[:kk],
                 "node-reschedule-penalty": s_pen[:kk],
                 "node-affinity": s_aff[:kk],
-                "allocation-spread": s_spread[:kk]},
+                "allocation-spread": s_spread[:kk],
+                "devices": s_dev[:kk]},
         top_idx=top_idx[:kk], top_scores=top_scores[:kk],
         nodes_evaluated=(req.n_considered if req.n_considered is not None
                          else n),
@@ -572,7 +602,8 @@ def unpack_result(req: SelectRequest, outs) -> SelectResult:
 
 _CHUNKED_ARGS = ("capacity", "used0", "feasible", "ask", "k_valid",
                  "tg_coll0", "penalty", "affinity_norm", "desired_count",
-                 "port_need", "free_ports", "port_ok")
+                 "port_need", "free_ports", "port_ok",
+                 "dev_slots0", "dev_score", "dev_fires")
 
 _accel_rtt_cache: List[float] = []
 
@@ -683,7 +714,7 @@ class SelectKernel:
         max_steps = 64 if req.count <= 64 else 512
         rounds = []
         while True:
-            (used, coll, freep), outs = _select_chunked(
+            (used, coll, freep, devs), outs = _select_chunked(
                 **cargs, max_steps=max_steps, spread_alg=spread_alg)
             (choice, chunk, ti, ts, exh, feas,
              rem, steps) = jax.device_get(outs)
@@ -697,7 +728,7 @@ class SelectKernel:
                 break                        # infeasible: nothing placed
             # ran out of steps: continue from the device-resident carry
             cargs.update(used0=used, tg_coll0=coll, free_ports=freep,
-                         k_valid=np.int32(rem))
+                         dev_slots0=devs, k_valid=np.int32(rem))
         return _expand_chunks(req, rounds)
 
 
@@ -720,6 +751,7 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
     s_anti = np.zeros(k_total, np.float32)
     s_pen = np.zeros(k_total, np.float32)
     s_aff = np.zeros(k_total, np.float32)
+    s_dev = np.zeros(k_total, np.float32)
     top_i = np.full((k_total, TOP_K), -1, np.int32)
     top_s = np.full((k_total, TOP_K), NEG_INF, np.float32)
     exh_out = np.zeros((k_total, d), np.int32)
@@ -728,6 +760,7 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
     if req.affinity is not None and req.affinity_sum_weights > 0:
         aff_col = (req.affinity / req.affinity_sum_weights).astype(np.float32)
     pen_col = req.penalty
+    dev_col = req.dev_score if req.dev_fires else None
 
     pos = 0
     extra = {}                               # node -> already placed here
@@ -763,10 +796,13 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
             pen = np.float32(-1.0 if pen_f else 0.0)
             aff = np.float32(aff_col[c]) if aff_col is not None else \
                 np.float32(0.0)
+            dev = np.float32(dev_col[c]) if dev_col is not None else \
+                np.float32(0.0)
             fired = (1.0 + anti_fires.astype(np.float32)
                      + np.float32(1.0 if pen_f else 0.0)
-                     + np.float32(1.0 if aff != 0.0 else 0.0))
-            fin = ((binp + anti + pen + aff) / fired).astype(np.float32)
+                     + np.float32(1.0 if aff != 0.0 else 0.0)
+                     + np.float32(1.0 if dev_col is not None else 0.0))
+            fin = ((binp + anti + pen + aff + dev) / fired).astype(np.float32)
 
             sl = slice(pos, pos + m)
             node_idx[sl] = c
@@ -775,6 +811,7 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
             s_anti[sl] = anti
             s_pen[sl] = pen
             s_aff[sl] = aff
+            s_dev[sl] = dev
             top_i[sl] = np.where(ti[s] >= n, -1, ti[s])
             top_s[sl] = ts[s]
             exh_out[sl] = exh[s]
@@ -793,7 +830,8 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
         scores={"binpack": s_bin, "job-anti-affinity": s_anti,
                 "node-reschedule-penalty": s_pen,
                 "node-affinity": s_aff,
-                "allocation-spread": np.zeros(k_total, np.float32)},
+                "allocation-spread": np.zeros(k_total, np.float32),
+                "devices": s_dev},
         top_idx=top_i, top_scores=top_s,
         nodes_evaluated=considered,
         nodes_filtered=int(considered - np.count_nonzero(req.feasible)),

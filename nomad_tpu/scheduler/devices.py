@@ -1,0 +1,262 @@
+"""Device scheduling: feasibility columns, slot accounting, affinity
+scoring, and instance assignment.
+
+Reference semantics:
+  - DeviceChecker (scheduler/feasible.go:1138): a node is feasible when
+    every requested device has a matching group with enough HEALTHY
+    instances satisfying the request's constraints (a capability check,
+    independent of current usage).
+  - deviceAllocator.AssignDevice (scheduler/device.go:32): pick the
+    highest-affinity matching group with enough FREE instances and
+    reserve concrete instance IDs.
+  - BinPack device scoring (scheduler/rank.go:456-461): the "devices"
+    scorer fires whenever any ask carries affinities; its value is
+    sum(matched weights of chosen groups) / sum(|weights| of all asks).
+
+Columnar mapping: the capability mask is a static column cached with
+the other feasibility checks; current usage collapses into one
+"placement slots" column (min over asks of free-matching-instances //
+ask.count) the kernel decrements per placement; the affinity score is a
+per-node column fed to the kernel as an additional scorer. Concrete
+instance IDs are assigned host-side for winners only, mirroring the
+port-assignment split (SURVEY.md §7.3 item 1).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models import AllocatedDeviceResource, Node, RequestedDevice
+from ..models.constraints import Constraint
+from ..models.device_accounting import DeviceAccounter
+
+_DEV_TARGET = re.compile(r"^\$\{device\.(.+)\}$")
+
+
+def combined_device_asks(tg) -> List[RequestedDevice]:
+    """All device requests of a task group's tasks, in task order."""
+    out: List[RequestedDevice] = []
+    for t in tg.tasks:
+        out.extend(t.resources.devices)
+    return out
+
+
+def resolve_device_target(target: str, group) -> Tuple[Optional[object], bool]:
+    """${device.vendor|type|model|attr.<key>} -> value (device.go
+    resolveDeviceTarget). Non-interpolated targets are literals."""
+    m = _DEV_TARGET.match(target or "")
+    if not m:
+        return target, target != ""
+    key = m.group(1)
+    if key == "vendor":
+        return group.vendor, True
+    if key == "type":
+        return group.type, True
+    if key in ("model", "name"):
+        return group.name, True
+    if key.startswith("attr."):
+        v = group.attributes.get(key[len("attr."):])
+        return v, v is not None
+    return None, False
+
+
+def _compare(op: str, lval, rval) -> bool:
+    """Attribute comparison: numeric when both sides parse as numbers,
+    else lexical (psstructs Attribute.Compare, simplified: no units)."""
+    if op in ("is_set",):
+        return lval is not None
+    if op in ("is_not_set",):
+        return lval is None
+    if lval is None or rval is None:
+        return False
+    try:
+        ln, rn = float(lval), float(rval)
+        lval, rval = ln, rn
+    except (TypeError, ValueError):
+        lval, rval = str(lval), str(rval)
+    if op in ("=", "==", "is"):
+        return lval == rval
+    if op in ("!=", "not"):
+        return lval != rval
+    if op == "<":
+        return lval < rval
+    if op == "<=":
+        return lval <= rval
+    if op == ">":
+        return lval > rval
+    if op == ">=":
+        return lval >= rval
+    if op == "regexp":
+        return re.search(str(rval), str(lval)) is not None
+    return False
+
+
+def group_satisfies(group, req: RequestedDevice) -> bool:
+    """Name match + constraint checks (feasible.go nodeDeviceMatches)."""
+    if not group.matches_request(req):
+        return False
+    for c in req.constraints:
+        lval, lok = resolve_device_target(c.ltarget, group)
+        rval, rok = resolve_device_target(c.rtarget, group)
+        if c.operand == "is_set":
+            if not lok:
+                return False
+            continue
+        if c.operand == "is_not_set":
+            if lok:
+                return False
+            continue
+        if not lok or not rok:
+            return False
+        if not _compare(c.operand, lval, rval):
+            return False
+    return True
+
+
+def group_affinity_score(group, req: RequestedDevice) -> Tuple[float, float]:
+    """(choice score used to pick among groups, matched weights
+    contributed to the node's 'devices' scorer) — device.go:74-96."""
+    if not req.affinities:
+        return 0.0, 0.0
+    total = 0.0
+    choice = 0.0
+    matched = 0.0
+    for a in req.affinities:
+        total += abs(float(a.weight))
+        lval, lok = resolve_device_target(a.ltarget, group)
+        rval, rok = resolve_device_target(a.rtarget, group)
+        if not lok or not rok:
+            continue
+        if _compare(a.operand, lval, rval):
+            choice += float(a.weight)
+            matched += float(a.weight)
+    if total > 0:
+        choice /= total
+    return choice, matched
+
+
+def total_affinity_weight(asks: List[RequestedDevice]) -> float:
+    return sum(abs(float(a.weight))
+               for req in asks for a in req.affinities)
+
+
+def static_device_mask(nodes: List[Node],
+                       asks: List[RequestedDevice]) -> np.ndarray:
+    """DeviceChecker capability mask: every ask has a satisfying group
+    with enough healthy instances (usage-independent, cacheable)."""
+    n = len(nodes)
+    mask = np.ones(n, dtype=bool)
+    for i, node in enumerate(nodes):
+        groups = node.node_resources.devices
+        for req in asks:
+            ok = False
+            for g in groups:
+                if not group_satisfies(g, req):
+                    continue
+                healthy = sum(1 for inst in g.instances if inst.healthy)
+                if healthy >= req.count:
+                    ok = True
+                    break
+            if not ok:
+                mask[i] = False
+                break
+    return mask
+
+
+def free_instance_counts(node: Node, allocs) -> Dict[Tuple, int]:
+    """(vendor, type, name) -> free healthy instances given allocs."""
+    acct = DeviceAccounter(node)
+    acct.add_allocs(allocs)
+    return {gid: len(acct.free_instances(gid))
+            for gid in acct.devices}
+
+
+def device_columns(nodes: List[Node], asks: List[RequestedDevice],
+                   allocs_for_node) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """Per-eval device columns for the kernel:
+      slots[N]  — placements of this task group the node can still hold
+                  (min over asks of free-matching // count); nodes with
+                  no device asks get +inf
+      score[N]  — the 'devices' scorer value per node
+      fires     — True when any ask has affinities (rank.go:457)
+    `allocs_for_node(node_id)` yields the proposed allocs to account.
+    """
+    n = len(nodes)
+    slots = np.full(n, np.inf, dtype=np.float32)
+    score = np.zeros(n, dtype=np.float32)
+    if not asks:
+        return slots, score, False
+    total_w = total_affinity_weight(asks)
+    for i, node in enumerate(nodes):
+        groups = node.node_resources.devices
+        if not groups:
+            slots[i] = 0.0
+            continue
+        free = free_instance_counts(node, allocs_for_node(node.id))
+        node_slots = np.inf
+        matched_sum = 0.0
+        for req in asks:
+            best: Optional[Tuple[float, float, int]] = None
+            for g in groups:
+                if not group_satisfies(g, req):
+                    continue
+                f = free.get(g.id_tuple(), 0)
+                if f < req.count:
+                    continue
+                choice, matched = group_affinity_score(g, req)
+                if best is None or choice > best[0]:
+                    best = (choice, matched, f)
+            if best is None:
+                node_slots = 0.0
+                break
+            node_slots = min(node_slots, best[2] // max(req.count, 1))
+            matched_sum += best[1]
+        slots[i] = node_slots
+        if total_w > 0 and node_slots > 0:
+            score[i] = matched_sum / total_w
+    return slots, score, total_w > 0
+
+
+def assign_devices(node: Node, tg, allocs,
+                   acct: Optional[DeviceAccounter] = None) -> Tuple[
+        Optional[Dict[str, List[AllocatedDeviceResource]]], float]:
+    """Concrete instance assignment for a winning node: per task, per
+    request, pick the best-scoring matching group with enough free
+    instances and reserve IDs (device.go AssignDevice + AddReserved).
+    Pass a shared accounter so successive placements within one eval
+    see each other's reservations (the plan only carries them after
+    select_batch returns). Returns (task -> offers, matched-weights
+    sum) or (None, 0)."""
+    if acct is None:
+        acct = DeviceAccounter(node)
+        acct.add_allocs(allocs)
+    out: Dict[str, List[AllocatedDeviceResource]] = {}
+    matched_sum = 0.0
+    for task in tg.tasks:
+        offers: List[AllocatedDeviceResource] = []
+        for req in task.resources.devices:
+            best = None       # (choice_score, matched, group, free_ids)
+            for g in node.node_resources.devices:
+                if not group_satisfies(g, req):
+                    continue
+                free_ids = acct.free_instances(g.id_tuple())
+                if len(free_ids) < req.count:
+                    continue
+                choice, matched = group_affinity_score(g, req)
+                if best is None or choice > best[0]:
+                    best = (choice, matched, g, free_ids)
+            if best is None:
+                return None, 0.0
+            _choice, matched, g, free_ids = best
+            offer = AllocatedDeviceResource(
+                vendor=g.vendor, type=g.type, name=g.name,
+                device_ids=list(free_ids[:req.count]))
+            acct.add_reserved(offer)
+            offers.append(offer)
+            matched_sum += matched
+        if offers:
+            out[task.name] = offers
+    return out, matched_sum

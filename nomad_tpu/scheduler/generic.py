@@ -322,6 +322,7 @@ class GenericScheduler:
         engine._base_mask = t.ready.copy()
         engine._mask_cache = {}
         engine._net_cache = {}
+        engine._dev_cache = {}
         mask, _counts = engine.feasibility(tg)
         return bool(mask[0])
 

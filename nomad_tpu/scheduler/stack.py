@@ -65,6 +65,8 @@ class PlacementEngine:
         # per-eval NetworkIndex cache: shared across select_batch calls so
         # port offers stay consistent between task groups of one plan
         self._net_cache: Dict[str, NetworkIndex] = {}
+        # per-eval device accounters, same lifetime/purpose as _net_cache
+        self._dev_cache: Dict[str, object] = {}
         self._shared_by_dc: Dict[str, int] = {}
         self._shared_filtered: Dict[str, int] = {}
         self._prev_meta: Tuple = (None, None)
@@ -131,7 +133,11 @@ class PlacementEngine:
             (req.source, bool(getattr(req, "read_only", False)))
             for req in (tg.volumes or {}).values()
             if getattr(req, "type", "host") == "host"))
-        return (drivers, cons, vols)
+        devs = tuple(
+            (r.name, r.count,
+             tuple((c.ltarget, c.rtarget, c.operand) for c in r.constraints))
+            for t in tg.tasks for r in t.resources.devices)
+        return (drivers, cons, vols, devs)
 
     def _static_checks(self, tg: TaskGroup) -> List[Tuple[str, np.ndarray]]:
         """Ordered (reason, bool[N]) columns for drivers, constraints and
@@ -159,6 +165,12 @@ class PlacementEngine:
         if tg.volumes:
             checks.append(("missing compatible host volumes",
                            t.host_volume_mask(tg.volumes)))
+        # devices: capability mask (DeviceChecker, feasible.go:1138)
+        from .devices import combined_device_asks, static_device_mask
+        asks = combined_device_asks(tg)
+        if asks:
+            checks.append(("missing devices",
+                           static_device_mask(t.nodes, asks)))
         t.mask_cache[key] = checks
         return checks
 
@@ -307,6 +319,17 @@ class PlacementEngine:
         dyn_ports, reserved_ports = self._port_asks(tg)
         port_ok = t.reserved_ports_ok(reserved_ports) if reserved_ports else None
 
+        # device columns (scheduler/devices.py): per-eval slot counts
+        # and the "devices" affinity scorer
+        from .devices import combined_device_asks, device_columns
+        dev_asks = combined_device_asks(tg)
+        dev_slots = dev_score = None
+        dev_fires = False
+        if dev_asks:
+            dev_slots, dev_score, dev_fires = device_columns(
+                t.nodes, dev_asks,
+                lambda nid: self._proposed_allocs_on(nid, proposed.plan))
+
         spreads, sum_spread_w = self._spread_inputs(tg, proposed)
         distinct_props = self._distinct_prop_inputs(tg, proposed)
 
@@ -328,6 +351,9 @@ class PlacementEngine:
             port_need=float(dyn_ports),
             free_ports=t.free_ports,
             port_ok=port_ok,
+            dev_slots=dev_slots,
+            dev_score=dev_score,
+            dev_fires=dev_fires,
             spreads=spreads,
             sum_spread_weights=sum_spread_w,
             distinct_props=distinct_props,
@@ -399,6 +425,21 @@ class PlacementEngine:
         self._prev_meta = (step, m.score_meta_data)
         return m
 
+    def _proposed_allocs_on(self, node_id: str, plan) -> list:
+        """This node's proposed allocations: snapshot minus plan
+        stops/preemptions plus plan placements (context.go:120-157)."""
+        stopped = set()
+        if plan is not None:
+            for a in plan.node_update.get(node_id, []):
+                stopped.add(a.id)
+            for a in plan.node_preemptions.get(node_id, []):
+                stopped.add(a.id)
+        out = [a for a in self.snapshot.allocs_by_node(node_id)
+               if not a.terminal_status() and a.id not in stopped]
+        if plan is not None:
+            out.extend(plan.node_allocation.get(node_id, []))
+        return out
+
     def _net_index_for(self, node: Node, plan) -> NetworkIndex:
         """NetworkIndex over the node's *proposed* allocations: snapshot
         allocs minus plan stops/preemptions plus plan placements (the
@@ -438,6 +479,23 @@ class PlacementEngine:
                 disk_mb=tg.ephemeral_disk.size_mb if tg.ephemeral_disk else 0,
                 networks=[offer])
 
+        # device instance assignment for the winner (device.go
+        # AssignDevice; failures surface like port failures). The
+        # accounter is cached per eval so instances reserved for earlier
+        # placements of this batch stay reserved.
+        dev_offers = {}
+        from .devices import assign_devices, combined_device_asks
+        if combined_device_asks(tg):
+            from ..models.device_accounting import DeviceAccounter
+            acct = self._dev_cache.get(node.id)
+            if acct is None:
+                acct = DeviceAccounter(node)
+                acct.add_allocs(self._proposed_allocs_on(node.id, plan))
+                self._dev_cache[node.id] = acct
+            dev_offers, _matched = assign_devices(node, tg, [], acct)
+            if dev_offers is None:
+                return {}, None, False
+
         task_resources: Dict[str, AllocatedTaskResources] = {}
         for task in tg.tasks:
             tr = AllocatedTaskResources(
@@ -450,5 +508,7 @@ class PlacementEngine:
                     return {}, None, False
                 idx.add_reserved(offer)
                 tr.networks = [offer]
+            if task.name in dev_offers:
+                tr.devices = list(dev_offers[task.name])
             task_resources[task.name] = tr
         return task_resources, shared, True

@@ -144,5 +144,7 @@ class PlanApplier:
         proposed = [a for a in snapshot.allocs_by_node(node_id)
                     if not a.terminal_status() and a.id not in remove_ids]
         proposed.extend(placements)
-        fit, _dim, _used = AllocsFit(node, proposed)
+        fit, _dim, _used = AllocsFit(
+            node, proposed,
+            check_devices=bool(node.node_resources.devices))
         return fit
