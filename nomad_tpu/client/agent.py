@@ -42,6 +42,10 @@ class ClientConfig:
     meta: dict = field(default_factory=dict)
     poll_interval_s: float = 0.2
     heartbeat_interval_s: float = 3.0
+    # durable state: when set, alloc/task/driver-handle transitions
+    # persist here and a restarted client restores + re-attaches
+    # (client/state/state_database.go)
+    state_dir: Optional[str] = None
     # device fingerprinting: statically declared device groups
     # (NodeDeviceResource) plus optional JAX accelerator autodetection
     # (the TPU-native analog of devices/gpu/nvidia fingerprint)
@@ -75,15 +79,19 @@ def fingerprint_accelerator_devices():
 
 class TaskRunner:
     """One task's lifecycle: start -> wait -> restart policy -> dead
-    (taskrunner/task_runner.go Run:456, shouldRestart:699)."""
+    (taskrunner/task_runner.go Run:456, shouldRestart:699). An attached
+    handle (restored via driver RecoverTask, task_runner.go:996) skips
+    the initial start and resumes at the wait."""
 
-    def __init__(self, alloc: Allocation, task, driver, on_update):
+    def __init__(self, alloc: Allocation, task, driver, on_update,
+                 attached: Optional[TaskHandle] = None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
         self.on_update = on_update
         self.state = TaskState(state=TASK_STATE_PENDING)
         self.handle: Optional[TaskHandle] = None
+        self._attached = attached
         self._kill = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -103,19 +111,27 @@ class TaskRunner:
         policy = tg.restart_policy if tg else None
         restarts = 0
         while not self._kill.is_set():
-            try:
-                self.handle = self.driver.start_task(
-                    self.task.name, self.task.config, self.task.env)
-            except RuntimeError as e:
-                self.state = TaskState(
-                    state=TASK_STATE_DEAD, failed=True,
-                    finished_at=time.time(),
-                    events=[TaskEvent(type="Driver Failure", message=str(e),
-                                      failed=True, time=int(time.time()))])
-                self.on_update()
-                return
+            if self._attached is not None:
+                self.handle = self._attached
+                self._attached = None
+                started_at = self.handle.started_at or time.time()
+            else:
+                try:
+                    self.handle = self.driver.start_task(
+                        self.task.name, self.task.config, self.task.env)
+                except RuntimeError as e:
+                    self.state = TaskState(
+                        state=TASK_STATE_DEAD, failed=True,
+                        finished_at=time.time(),
+                        events=[TaskEvent(type="Driver Failure",
+                                          message=str(e),
+                                          failed=True,
+                                          time=int(time.time()))])
+                    self.on_update()
+                    return
+                started_at = time.time()
             self.state = TaskState(state=TASK_STATE_RUNNING,
-                                   started_at=time.time(),
+                                   started_at=started_at,
                                    restarts=restarts)
             self.on_update()
             self.handle.wait()
@@ -157,17 +173,20 @@ class AllocRunner:
     clientAlloc:616 status aggregation)."""
 
     def __init__(self, alloc: Allocation, drivers: Dict[str, object],
-                 push_update):
+                 push_update, persist=None):
         self.alloc = alloc
         self.drivers = drivers
         self.push_update = push_update
+        self.persist = persist            # (alloc_id, task, state, handle)
         self.task_runners: List[TaskRunner] = []
         self.client_status = ALLOC_CLIENT_PENDING
         self.deployment_status = alloc.deployment_status
         self._l = threading.Lock()
         self.destroyed = False
 
-    def run(self) -> None:
+    def run(self, attached: Optional[Dict[str, TaskHandle]] = None) -> None:
+        """Start (or, with `attached` handles from driver recovery,
+        resume) the alloc's tasks."""
         tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
             if self.alloc.job else None
         if tg is None:
@@ -180,7 +199,8 @@ class AllocRunner:
                 self.client_status = ALLOC_CLIENT_FAILED
                 self._push()
                 return
-            tr = TaskRunner(self.alloc, task, driver, self._on_task_update)
+            tr = TaskRunner(self.alloc, task, driver, self._on_task_update,
+                            attached=(attached or {}).get(task.name))
             self.task_runners.append(tr)
         for tr in self.task_runners:
             tr.start()
@@ -238,6 +258,11 @@ class AllocRunner:
             tr.kill()
 
     def _on_task_update(self) -> None:
+        if self.persist is not None:
+            for tr in self.task_runners:
+                self.persist(
+                    self.alloc.id, tr.task.name, tr.state,
+                    tr.handle.recoverable_state() if tr.handle else None)
         with self._l:
             states = {tr.task.name: tr.state for tr in self.task_runners}
             # aggregate client status (alloc_runner.go getClientStatus)
@@ -277,6 +302,10 @@ class Client:
             self.transport = InProcTransport(server)
             self.server = server
         self.config = config or ClientConfig()
+        self.state_db = None
+        if self.config.state_dir:
+            from .state_db import ClientStateDB
+            self.state_db = ClientStateDB(self.config.state_dir)
         self.node = self._fingerprint()
         self.drivers = {name: DRIVER_CATALOG[name]()
                         for name in self.config.drivers}
@@ -288,9 +317,18 @@ class Client:
     # -- fingerprinting (client/fingerprint) ---------------------------
     def _fingerprint(self) -> Node:
         from ..models import DriverInfo, NetworkResource
+        # stable node identity across restarts (client.go persists the
+        # node ID in the data dir) — without it a restarted client would
+        # register as a new node and orphan its allocs
+        node_id = secret = None
+        if self.state_db is not None:
+            ident = self.state_db.load_identity()
+            if ident:
+                node_id = ident.get("node_id")
+                secret = ident.get("secret_id")
         node = Node(
-            id=generate_uuid(),
-            secret_id=generate_uuid(),
+            id=node_id or generate_uuid(),
+            secret_id=secret or generate_uuid(),
             name=self.config.node_name or f"client-{generate_uuid()[:8]}",
             datacenter=self.config.datacenter,
             node_class=self.config.node_class,
@@ -320,6 +358,8 @@ class Client:
         for g in node.node_resources.devices:
             node.attributes[f"device.{g.type}"] = str(len(g.instances))
         node.compute_class()
+        if self.state_db is not None:
+            self.state_db.save_identity(node.id, node.secret_id)
         return node
 
     # -- lifecycle -----------------------------------------------------
@@ -327,23 +367,77 @@ class Client:
         self.node.status = NODE_STATUS_READY
         self.transport.register_node(self.node)
         self.transport.update_node_status(self.node.id, NODE_STATUS_READY)
+        self._restore_state()
         t1 = threading.Thread(target=self._heartbeat_loop, daemon=True)
         t2 = threading.Thread(target=self._watch_allocs, daemon=True)
         self._threads = [t1, t2]
         t1.start()
         t2.start()
 
-    def shutdown(self) -> None:
+    def _restore_state(self) -> None:
+        """Rebuild alloc runners from the state DB, re-attaching to live
+        tasks via driver RecoverTask (client.go restoreState:1055,
+        task_runner.go:996). Unrecoverable tasks restart fresh."""
+        if self.state_db is None:
+            return
+        from ..models import Allocation
+        from ..utils.codec import from_wire
+        for aid, rec in list(self.state_db.state.items()):
+            alloc_data = rec.get("alloc")
+            if not alloc_data:
+                continue
+            alloc = from_wire(Allocation, alloc_data)
+            if alloc.terminal_status() or alloc.server_terminal_status():
+                self.state_db.delete_alloc(aid)
+                continue
+            attached: Dict[str, TaskHandle] = {}
+            for task_name, tstate in (rec.get("tasks") or {}).items():
+                hstate = tstate.get("handle")
+                if not hstate:
+                    continue
+                # only re-attach tasks that were last seen running
+                st = (tstate.get("state") or {}).get("state")
+                if st != TASK_STATE_RUNNING:
+                    continue
+                driver = self.drivers.get(hstate.get("driver", ""))
+                if driver is None:
+                    continue
+                recover = getattr(driver, "recover_task", None)
+                handle = recover(hstate) if recover else None
+                if handle is not None:
+                    attached[task_name] = handle
+                    LOG.info("re-attached task %s of alloc %s",
+                             task_name, aid[:8])
+            runner = AllocRunner(alloc, self.drivers, self._push_update,
+                                 persist=self._persist_task)
+            self.runners[aid] = runner
+            runner.run(attached=attached)
+
+    def _persist_task(self, alloc_id, task_name, state, handle_state):
+        if self.state_db is not None:
+            try:
+                self.state_db.put_task(alloc_id, task_name, state,
+                                       handle_state)
+            except Exception:
+                LOG.exception("state persist failed")
+
+    def shutdown(self, kill_tasks: bool = True) -> None:
+        """kill_tasks=False detaches without stopping tasks — the
+        restart-without-killing-tasks path (the reference client leaves
+        tasks running and re-attaches after restart)."""
         self._stop.set()
-        # copy: the alloc-watch thread may still mutate the dict until
-        # it observes _stop
-        for r in list(self.runners.values()):
-            r.stop()
+        if kill_tasks:
+            # copy: the alloc-watch thread may still mutate the dict
+            # until it observes _stop
+            for r in list(self.runners.values()):
+                r.stop()
         for t in self._threads:
             t.join(timeout=2)
         close = getattr(self.transport, "close", None)
         if close is not None:
             close()
+        if self.state_db is not None:
+            self.state_db.close()
 
     def _heartbeat_loop(self) -> None:
         interval = self.config.heartbeat_interval_s
@@ -382,8 +476,11 @@ class Client:
                 continue
             if alloc.job is None:
                 continue
-            runner = AllocRunner(alloc, self.drivers, self._push_update)
+            runner = AllocRunner(alloc, self.drivers, self._push_update,
+                                 persist=self._persist_task)
             self.runners[aid] = runner
+            if self.state_db is not None:
+                self.state_db.put_alloc(alloc)
             runner.run()
         # stop allocs the server wants stopped (or that vanished)
         for aid, runner in list(self.runners.items()):
@@ -391,6 +488,8 @@ class Client:
             if server_alloc is None or server_alloc.server_terminal_status():
                 if not runner.destroyed:
                     runner.stop()
+                if self.state_db is not None:
+                    self.state_db.delete_alloc(aid)
                 if server_alloc is None:
                     del self.runners[aid]
                 continue
@@ -399,6 +498,8 @@ class Client:
             # running many short batch jobs don't accumulate runners
             if runner.client_status in ("complete", "failed") and \
                     server_alloc.client_status == runner.client_status:
+                if self.state_db is not None:
+                    self.state_db.delete_alloc(aid)
                 del self.runners[aid]
 
     def _push_update(self, update: Allocation) -> None:

@@ -28,14 +28,30 @@ class TaskHandle:
     error: str = ""
     started_at: float = 0.0
     finished_at: float = 0.0
+    id: str = ""
     _done: threading.Event = field(default_factory=threading.Event)
     _kill: threading.Event = field(default_factory=threading.Event)
+
+    def __post_init__(self):
+        if not self.id:
+            from ..utils.ids import generate_uuid
+            self.id = generate_uuid()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def recoverable_state(self) -> dict:
+        """What the client state DB persists so a restarted client can
+        re-attach (plugins/drivers TaskHandle / RecoverTask)."""
+        pid = None
+        if self.proc is not None:
+            pid = getattr(self.proc, "pid", None)
+        return {"id": self.id, "task_name": self.task_name,
+                "driver": self.driver, "config": dict(self.config),
+                "pid": pid, "started_at": self.started_at}
 
 
 def _parse_duration(val) -> float:
@@ -87,6 +103,34 @@ class MockDriver:
         handle._kill.set()
         handle.wait(timeout_s)
 
+    def recover_task(self, state: dict) -> Optional[TaskHandle]:
+        """Re-attach to a 'live' mock task (drivers/mock recovery
+        simulation knobs, driver.go:169-264): fails when the persisted
+        config asks for it; otherwise reconstructs a handle whose
+        remaining runtime is derived from the persisted start time, so
+        a task that should still be running keeps 'running' and one
+        past its run_for completes immediately."""
+        config = state.get("config", {})
+        if config.get("recover_error"):
+            return None
+        run_for = _parse_duration(config.get("run_for", 0))
+        exit_code = int(config.get("exit_code", 0))
+        started_at = float(state.get("started_at") or time.time())
+        h = TaskHandle(task_name=state["task_name"], driver=self.name,
+                       config=config, started_at=started_at,
+                       id=state.get("id", ""))
+        remaining = started_at + run_for - time.time()
+
+        def run():
+            if remaining > 0:
+                h._kill.wait(remaining)
+            h.exit_code = 137 if h._kill.is_set() else exit_code
+            h.finished_at = time.time()
+            h._done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        return h
+
 
 class RawExecDriver:
     """drivers/rawexec: plain fork/exec, no isolation."""
@@ -122,6 +166,16 @@ class RawExecDriver:
     def stop_task(self, handle: TaskHandle, timeout_s: float = 5.0) -> None:
         proc = handle.proc
         if proc is None:
+            pid = getattr(handle, "_recovered_pid", None)
+            if pid:
+                import os
+                import signal as _signal
+                try:
+                    os.kill(pid, _signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+            handle._kill.set()
+            handle.wait(timeout_s)
             return
         proc.terminate()
         try:
@@ -129,6 +183,40 @@ class RawExecDriver:
         except subprocess.TimeoutExpired:
             proc.kill()
         handle.wait(1.0)
+
+    def recover_task(self, state: dict) -> Optional[TaskHandle]:
+        """Re-attach to a running process by pid (the executor
+        re-attach path, task_runner.go:996). A non-child pid can't be
+        wait()ed, so liveness is polled."""
+        import os
+        pid = state.get("pid")
+        if not pid:
+            return None
+        try:
+            os.kill(pid, 0)
+        except (ProcessLookupError, PermissionError):
+            return None
+        h = TaskHandle(task_name=state["task_name"], driver=self.name,
+                       config=state.get("config", {}),
+                       started_at=float(state.get("started_at") or 0),
+                       id=state.get("id", ""))
+        h._recovered_pid = pid
+
+        def poll():
+            while not h._kill.is_set():
+                try:
+                    os.kill(pid, 0)
+                except (ProcessLookupError, PermissionError):
+                    break
+                time.sleep(0.1)
+            # exit status of a non-child is unknowable; treat
+            # disappeared-without-kill as clean exit
+            h.exit_code = 137 if h._kill.is_set() else 0
+            h.finished_at = time.time()
+            h._done.set()
+
+        threading.Thread(target=poll, daemon=True).start()
+        return h
 
 
 class ExecDriver(RawExecDriver):
