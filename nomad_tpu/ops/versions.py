@@ -18,21 +18,25 @@ _VERSION_RE = re.compile(
 
 
 class Version:
-    __slots__ = ("segments", "prerelease")
+    __slots__ = ("segments", "prerelease", "orig_len")
 
-    def __init__(self, segments: Tuple[int, ...], prerelease: str):
+    def __init__(self, segments: Tuple[int, ...], prerelease: str,
+                 orig_len: int = 0):
         self.segments = segments
         self.prerelease = prerelease
+        # segment count as written: "~> 1.0" and "~> 1.0.0" differ in
+        # which segment the pessimistic operator bumps (go-version)
+        self.orig_len = orig_len or len(segments)
 
     @classmethod
     def parse(cls, s: str) -> Optional["Version"]:
         m = _VERSION_RE.match(s.strip())
         if not m:
             return None
-        segs = tuple(int(p) for p in m.group(1).split("."))
+        raw = tuple(int(p) for p in m.group(1).split("."))
         # normalize to 3 segments for comparison (go-version pads)
-        segs = segs + (0,) * (3 - len(segs)) if len(segs) < 3 else segs
-        return cls(segs, m.group(2) or "")
+        segs = raw + (0,) * (3 - len(raw)) if len(raw) < 3 else raw
+        return cls(segs, m.group(2) or "", orig_len=len(raw))
 
     def _cmp_key(self):
         # a prerelease sorts before the release itself
@@ -84,20 +88,23 @@ def _check_one(op: str, have: Version, want: Version) -> bool:
     if op == "<=":
         return c <= 0
     if op == "~>":
-        # pessimistic: >= want and < next significant release
+        # pessimistic, keyed on the constraint's WRITTEN precision
+        # (go-version): "~> 1.0" = >= 1.0, < 2.0; "~> 1.0.0" =
+        # >= 1.0.0, < 1.1.0
         if c < 0:
             return False
-        want_segs = want.segments
-        if len(want_segs) <= 1:
-            return have.segments[0] == want_segs[0]
-        upper = want_segs[:-2] + (want_segs[-2] + 1,)
-        return have.segments[:len(upper) - 1] == upper[:-1] and \
-            have.segments[len(upper) - 1] < upper[-1]
+        bump = max(want.orig_len - 2, 0)
+        upper = want.segments[:bump] + (want.segments[bump] + 1,)
+        return (have.segments[:bump] == upper[:bump]
+                and have.segments[bump] < upper[bump])
     return False
 
 
-def version_matches(version_str: str, constraint_str: str,
+def version_matches(version_str, constraint_str: str,
                     strict_semver: bool = False) -> bool:
+    # attribute values may be ints/floats (feasible.go converts
+    # non-string types before parsing)
+    version_str = str(version_str)
     v = Version.parse(version_str)
     if v is None:
         return False
@@ -106,4 +113,15 @@ def version_matches(version_str: str, constraint_str: str,
     constraints = parse_constraints(constraint_str)
     if constraints is None:
         return False
+    if strict_semver and any(op == "~>" for op, _ in constraints):
+        # the strict semver parser has no pessimistic operator
+        # (feasible.go newSemverConstraintParser)
+        return False
+    if not strict_semver and v.prerelease:
+        # go-version: a prerelease version only matches constraint
+        # parts whose own version carries a prerelease (the "version"
+        # operand excludes prereleases from ordinary ranges;
+        # feasible_test.go:917 table)
+        if any(want.prerelease == "" for _op, want in constraints):
+            return False
     return all(_check_one(op, v, want) for op, want in constraints)

@@ -1,0 +1,212 @@
+"""Scalar (pure Python/NumPy, one node at a time) reference of the
+placement pipeline — an independent re-derivation of the reference
+iterator chain used to property-test the fused kernels.
+
+Mirrors, step by step per placed instance:
+  feasibility  (static mask, distinct_hosts, scan-exclusive reserved
+                ports, dynamic port budget, device slots,
+                distinct_property limits)
+  fit          (AllocsFit over all dims, structs/funcs.go:102)
+  scoring      (binpack 20-10^fc-10^fm /18 rank.go:188; job
+                anti-affinity rank.go:502; reschedule penalty :564;
+                node affinity :637; spread targeted/even spread.go:110;
+                device affinity :456; normalization = mean over FIRED
+                scorers :696)
+  selection    (full masked argmax, lowest index wins ties)
+
+Deliberately written with plain loops and float32 math so a bug in the
+kernel's vectorization cannot be mirrored here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+F = np.float32
+
+
+def scalar_select(req):
+    """Returns (node_idx list, final_scores list, per-component dict)."""
+    n = len(req.feasible)
+    d = req.capacity.shape[1]
+    used = req.used.astype(F).copy()
+    coll = req.tg_collisions.astype(np.int64).copy()
+    job_cnt = req.job_count.astype(np.int64).copy()
+    scan_placed = np.zeros(n, np.int64)
+    free_ports = (req.free_ports.astype(F).copy()
+                  if req.free_ports is not None else np.full(n, 1e9, F))
+    port_ok = (req.port_ok.copy() if req.port_ok is not None
+               else np.ones(n, bool))
+    dev_slots = (req.dev_slots.astype(F).copy()
+                 if req.dev_slots is not None else np.full(n, 1e9, F))
+    ask = np.asarray(req.ask, F)
+    desired = F(max(req.desired_count, 1.0))
+    spread_alg = req.algorithm == "spread"
+    aff = None
+    if req.affinity is not None and req.affinity_sum_weights > 0:
+        aff = (req.affinity / F(req.affinity_sum_weights)).astype(F)
+    pen = req.penalty if req.penalty is not None else np.zeros(n, bool)
+
+    sp_state = []
+    for sp in req.spreads:
+        sp_state.append(dict(
+            codes=np.asarray(sp["codes"]),
+            counts=np.asarray(sp["counts"], F).copy(),
+            present=np.asarray(sp["present"], bool).copy(),
+            desired=np.asarray(sp["desired"], F),
+            weight=F(sp["weight"]),
+            has_targets=bool(sp["has_targets"])))
+    sum_spread_w = F(req.sum_spread_weights)
+    dp_state = []
+    for dp in req.distinct_props:
+        dp_state.append(dict(
+            codes=np.asarray(dp["codes"]),
+            counts=np.asarray(dp["counts"], F).copy(),
+            limit=F(dp["limit"])))
+
+    out_nodes, out_final, comps = [], [], {
+        "binpack": [], "job-anti-affinity": [],
+        "node-reschedule-penalty": [], "node-affinity": [],
+        "allocation-spread": [], "devices": []}
+
+    for _step in range(req.count):
+        best_i = -1
+        best = None
+        for i in range(n):
+            if not req.feasible[i]:
+                continue
+            if req.distinct_hosts and job_cnt[i] != 0:
+                continue
+            if req.scan_exclusive and scan_placed[i] != 0:
+                continue
+            if free_ports[i] < req.port_need:
+                continue
+            if not port_ok[i]:
+                continue
+            if dev_slots[i] < 1.0:
+                continue
+            dp_fail = False
+            for dp in dp_state:
+                c = dp["codes"][i]
+                missing = c == len(dp["counts"]) - 1
+                if missing or dp["counts"][c] + 1.0 > dp["limit"]:
+                    dp_fail = True
+                    break
+            if dp_fail:
+                continue
+            after = used[i] + ask
+            if np.any(after > req.capacity[i] + 1e-6):
+                continue
+
+            # -- scoring (float32 like the kernel) ---------------------
+            cap_cpu = F(max(req.capacity[i, 0], 1e-9))
+            cap_mem = F(max(req.capacity[i, 1], 1e-9))
+            free_cpu = F(1.0) - after[0] / cap_cpu
+            free_mem = F(1.0) - after[1] / cap_mem
+            total = F(np.power(F(10.0), free_cpu)
+                      + np.power(F(10.0), free_mem))
+            if spread_alg:
+                fit_score = min(max(total - F(2.0), F(0.0)), F(18.0))
+            else:
+                fit_score = min(max(F(20.0) - total, F(0.0)), F(18.0))
+            binpack = F(fit_score / F(18.0))
+
+            c = F(coll[i])
+            anti_fires = c > 0
+            anti = F(-(c + 1.0) / desired) if anti_fires else F(0.0)
+
+            pen_fires = bool(pen[i])
+            pen_v = F(-1.0) if pen_fires else F(0.0)
+
+            aff_v = F(aff[i]) if aff is not None else F(0.0)
+            aff_fires = aff_v != 0.0
+
+            spread_total = F(0.0)
+            for sp in sp_state:
+                code = sp["codes"][i]
+                c_axis = len(sp["counts"])
+                missing = code == c_axis - 1
+                w = F(sp["weight"] / max(sum_spread_w, 1e-9))
+                if sp["has_targets"]:
+                    if missing:
+                        contrib = F(-1.0)
+                    else:
+                        des = sp["desired"][code]
+                        used_cnt = sp["counts"][code] + F(1.0)
+                        if des >= 0.0:
+                            contrib = F((des - used_cnt)
+                                        / max(des, 1e-9) * w)
+                        else:
+                            contrib = F(-1.0)
+                else:
+                    pres = sp["present"]
+                    cnts = sp["counts"]
+                    if not pres.any():
+                        contrib = F(0.0)
+                    else:
+                        min_cnt = cnts[pres].min()
+                        max_cnt = cnts[pres].max()
+                        cur = cnts[code]
+                        if cur == min_cnt:
+                            if min_cnt == max_cnt:
+                                contrib = F(-1.0)
+                            elif min_cnt == 0.0:
+                                contrib = F(1.0)
+                            else:
+                                contrib = F((max_cnt - min_cnt)
+                                            / max(min_cnt, 1e-9))
+                        elif min_cnt == 0.0:
+                            contrib = F(-1.0)
+                        else:
+                            contrib = F((min_cnt - cur)
+                                        / max(min_cnt, 1e-9))
+                    if missing:
+                        contrib = F(-1.0)
+                spread_total = F(spread_total + contrib)
+            spread_fires = spread_total != 0.0
+
+            dev_v = F(req.dev_score[i]) if req.dev_fires and \
+                req.dev_score is not None else F(0.0)
+
+            fired = F(1.0 + float(anti_fires) + float(pen_fires)
+                      + float(aff_fires) + float(spread_fires)
+                      + float(bool(req.dev_fires)))
+            final = F((binpack + anti + pen_v + aff_v + spread_total
+                       + dev_v) / fired)
+
+            if best is None or final > best[0]:
+                best = (final, binpack, anti, pen_v, aff_v,
+                        spread_total, dev_v)
+                best_i = i
+
+        if best is None:
+            out_nodes.append(-1)
+            out_final.append(0.0)
+            for k in comps:
+                comps[k].append(0.0)
+            continue
+
+        out_nodes.append(best_i)
+        out_final.append(float(best[0]))
+        comps["binpack"].append(float(best[1]))
+        comps["job-anti-affinity"].append(float(best[2]))
+        comps["node-reschedule-penalty"].append(float(best[3]))
+        comps["node-affinity"].append(float(best[4]))
+        comps["allocation-spread"].append(float(best[5]))
+        comps["devices"].append(float(best[6]))
+
+        # -- state updates ---------------------------------------------
+        used[best_i] += ask
+        coll[best_i] += 1
+        job_cnt[best_i] += 1
+        scan_placed[best_i] += 1
+        free_ports[best_i] -= F(req.port_need)
+        dev_slots[best_i] -= F(1.0)
+        for sp in sp_state:
+            code = sp["codes"][best_i]
+            sp["counts"][code] += 1.0
+            sp["present"][code] = True
+        for dp in dp_state:
+            dp["counts"][dp["codes"][best_i]] += 1.0
+
+    return out_nodes, out_final, comps

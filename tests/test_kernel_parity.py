@@ -1,0 +1,155 @@
+"""Randomized kernel-vs-scalar parity (VERDICT r1 item 8).
+
+Hundreds of randomized scenarios — spreads (targeted + even),
+distinct_property, distinct_hosts, reserved/dynamic ports, devices,
+penalties, affinities, both scoring algorithms, saturation — asserting
+the fused kernel (scan or chunked, whichever SelectKernel routes to)
+produces the same placements and scores as the independent scalar
+reference in tests/scalar_reference.py.
+"""
+
+import numpy as np
+import pytest
+
+import nomad_tpu.ops.select as sel
+from scalar_reference import scalar_select
+
+S_CODES = 6
+
+
+def _mk_spread(rng, n, count, targeted: bool):
+    codes = rng.randint(0, S_CODES, n).astype(np.int32)
+    counts = np.zeros(sel.C_MAX + 1, np.float32)
+    present = np.zeros(sel.C_MAX + 1, bool)
+    if rng.rand() < 0.5:
+        # pre-existing allocs on some values
+        for c in rng.randint(0, S_CODES, rng.randint(1, 4)):
+            counts[c] += rng.randint(1, 4)
+            present[c] = True
+    desired = np.full(sel.C_MAX + 1, -1.0, np.float32)
+    if targeted:
+        for c in range(S_CODES):
+            if rng.rand() < 0.6:
+                desired[c] = float(rng.randint(1, count + 2))
+    return dict(codes=codes, counts=counts, present=present,
+                desired=desired, weight=float(rng.randint(10, 100)),
+                has_targets=targeted)
+
+
+def _random_request(rng, *, spreads=False, dprops=False, dhosts=False,
+                    ports=False, devices=False, algorithm="binpack",
+                    tight=False):
+    n = rng.randint(4, 120)
+    count = rng.randint(1, 40)
+    capacity = rng.uniform(500, 4000, size=(n, 4)).astype(np.float32)
+    capacity[:, 2] *= 20
+    capacity[:, 3] = 1000.0
+    frac = 0.85 if tight else 0.5
+    used = (capacity * rng.uniform(0, frac, size=(n, 4))).astype(np.float32)
+    ask = np.array([rng.uniform(50, 600), rng.uniform(50, 600),
+                    rng.uniform(1, 50), 0], np.float32)
+    aff = (rng.uniform(-1, 1, n) * (rng.rand(n) > 0.5)).astype(np.float32)
+
+    sp = []
+    sum_w = 0.0
+    if spreads:
+        for _ in range(rng.randint(1, 3)):
+            s = _mk_spread(rng, n, count, targeted=rng.rand() < 0.5)
+            sp.append(s)
+            sum_w += s["weight"]
+    dp = []
+    if dprops:
+        dp.append(dict(codes=rng.randint(0, S_CODES, n).astype(np.int32),
+                       counts=np.zeros(sel.C_MAX + 1, np.float32),
+                       limit=float(rng.randint(1, 4))))
+    dev_slots = dev_score = None
+    dev_fires = False
+    if devices:
+        dev_slots = rng.randint(0, 5, n).astype(np.float32)
+        dev_score = (rng.uniform(0, 1, n)
+                     * (rng.rand(n) > 0.5)).astype(np.float32)
+        dev_fires = bool(rng.rand() < 0.7)
+
+    return sel.SelectRequest(
+        ask=ask, count=count,
+        feasible=rng.rand(n) > 0.15,
+        capacity=capacity, used=used,
+        desired_count=float(count),
+        tg_collisions=rng.randint(0, 3, n).astype(np.int32),
+        job_count=rng.randint(0, 2, n).astype(np.int32),
+        distinct_hosts=dhosts,
+        penalty=rng.rand(n) > 0.85,
+        affinity=aff, affinity_sum_weights=1.0,
+        algorithm=algorithm,
+        scan_exclusive=bool(ports and rng.rand() < 0.4),
+        port_need=float(rng.randint(0, 3)) if ports else 0.0,
+        free_ports=(rng.uniform(0, 15, n).astype(np.float32)
+                    if ports else None),
+        port_ok=(rng.rand(n) > 0.1) if ports else None,
+        dev_slots=dev_slots, dev_score=dev_score, dev_fires=dev_fires,
+        spreads=sp, sum_spread_weights=sum_w,
+        distinct_props=dp,
+    )
+
+
+def _copy_req(req):
+    import dataclasses
+    kw = {}
+    for f in dataclasses.fields(req):
+        v = getattr(req, f.name)
+        if isinstance(v, np.ndarray):
+            v = v.copy()
+        elif f.name == "spreads":
+            v = [dict(s, counts=s["counts"].copy(),
+                      present=s["present"].copy()) for s in v]
+        elif f.name == "distinct_props":
+            v = [dict(s, counts=s["counts"].copy()) for s in v]
+        kw[f.name] = v
+    return sel.SelectRequest(**kw)
+
+
+def _assert_parity(req, seed):
+    ref = _copy_req(req)
+    res = sel.SelectKernel().select(req)
+    exp_nodes, exp_final, exp_comps = scalar_select(ref)
+    got = res.node_idx.tolist()
+    assert got == exp_nodes, (
+        f"seed {seed}: placements diverge\nkernel={got}\nscalar={exp_nodes}")
+    np.testing.assert_allclose(res.final_score, exp_final,
+                               rtol=2e-4, atol=2e-5,
+                               err_msg=f"seed {seed}: final scores")
+    for name, exp in exp_comps.items():
+        np.testing.assert_allclose(
+            res.scores[name], exp, rtol=2e-4, atol=2e-5,
+            err_msg=f"seed {seed}: component {name}")
+
+
+FEATURE_SETS = [
+    dict(),                                           # pure binpack
+    dict(algorithm="spread"),
+    dict(spreads=True),
+    dict(spreads=True, algorithm="spread"),
+    dict(dprops=True),
+    dict(dhosts=True),
+    dict(ports=True),
+    dict(devices=True),
+    dict(spreads=True, dprops=True, ports=True, devices=True),
+    dict(tight=True, spreads=True, dhosts=True),
+]
+
+
+@pytest.mark.parametrize("features", range(len(FEATURE_SETS)))
+@pytest.mark.parametrize("seed", range(8))
+def test_kernel_matches_scalar(seed, features):
+    rng = np.random.RandomState(seed * 100 + features)
+    req = _random_request(rng, **FEATURE_SETS[features])
+    _assert_parity(req, (seed, features))
+
+
+def test_saturation_tail_parity():
+    """Placements that exhaust the cluster: failure tails match."""
+    rng = np.random.RandomState(1234)
+    for trial in range(5):
+        req = _random_request(rng, tight=True)
+        req.count = 60           # guaranteed to overflow small clusters
+        _assert_parity(req, ("sat", trial))
