@@ -86,6 +86,12 @@ class SelectRequest:
     dev_slots: Optional[np.ndarray] = None      # f32[N]
     dev_score: Optional[np.ndarray] = None      # f32[N]
     dev_fires: bool = False
+    # preemption competition (rank.go:415-448 + PreemptionScoringIterator
+    # :714): nodes whose fit comes from evicting victims carry the
+    # logistic preemption score as an extra fired scorer; `used` must
+    # already reflect the hypothetical evictions for those nodes.
+    # 0 = no preemption on this node (the logistic is never exactly 0).
+    pre_score: Optional[np.ndarray] = None      # f32[N]
     # spreads: list of dicts with codes i32[N], counts f32[C+1],
     #          present bool[C+1], desired f32[C+1] (-1 == none),
     #          has_implicit, implicit_desired, weight, has_targets
@@ -119,7 +125,7 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
                  tg_coll0, job_count0, distinct_hosts_flag, scan_exclusive,
                  penalty, affinity_norm, desired_count,
                  port_need, free_ports, port_ok,
-                 dev_slots0, dev_score, dev_fires,
+                 dev_slots0, dev_score, dev_fires, pre_score,
                  sp_codes, sp_counts0, sp_present0, sp_desired,
                  sp_weight, sp_has_targets, sp_valid, sum_spread_w,
                  dp_codes, dp_counts0, dp_limit, dp_valid,
@@ -195,6 +201,9 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
         # ---- device affinity ("devices" scorer, rank.go:456) ---------
         dev = jnp.where(dev_fires > 0, dev_score, 0.0)
 
+        # ---- preemption scorer (rank.go:714 logistic) ----------------
+        pre_fires = pre_score != 0.0
+
         # ---- spread ---------------------------------------------------
         spread_total = jnp.zeros(n, dtype=jnp.float32)
         for s in range(s_live):
@@ -241,8 +250,10 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
                  + pen_fires.astype(jnp.float32)
                  + aff_fires.astype(jnp.float32)
                  + spread_fires.astype(jnp.float32)
-                 + jnp.where(dev_fires > 0, 1.0, 0.0))
-        final = (binpack + anti + pen + aff + spread_total + dev) / fired
+                 + jnp.where(dev_fires > 0, 1.0, 0.0)
+                 + pre_fires.astype(jnp.float32))
+        final = (binpack + anti + pen + aff + spread_total + dev
+                 + pre_score) / fired
 
         # ---- masked argmax -------------------------------------------
         ok = feas & fit
@@ -282,6 +293,7 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
                jnp.where(valid, aff[jnp.maximum(choice, 0)], 0.0),
                jnp.where(valid, spread_total[jnp.maximum(choice, 0)], 0.0),
                jnp.where(valid, dev[jnp.maximum(choice, 0)], 0.0),
+               jnp.where(valid, pre_score[jnp.maximum(choice, 0)], 0.0),
                top_idx.astype(jnp.int32), top_scores,
                exhausted, ok.sum().astype(jnp.int32))
         return (used, tg_coll, job_cnt, scan_placed, free_p, dev_slots,
@@ -296,7 +308,7 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
 
 def _local_final_score(after, cap_cpu, cap_mem, coll, penalty, affinity,
                        desired_count, spread_alg: bool,
-                       dev_score=0.0, dev_fires=0.0):
+                       dev_score=0.0, dev_fires=0.0, pre_score=0.0):
     """Node-local score (binpack/spread fit + anti-affinity + penalty +
     affinity + device affinity, normalized over fired scorers).
     Shape-polymorphic over the leading axes: after[..., D],
@@ -319,11 +331,13 @@ def _local_final_score(after, cap_cpu, cap_mem, coll, penalty, affinity,
     pen = jnp.where(penalty, -1.0, 0.0)
     aff_fires = affinity != 0.0
     dev = jnp.where(dev_fires > 0, dev_score, 0.0)
+    pre_fires = pre_score != 0.0
     fired = (1.0 + anti_fires.astype(jnp.float32)
              + penalty.astype(jnp.float32)
              + aff_fires.astype(jnp.float32)
-             + jnp.where(dev_fires > 0, 1.0, 0.0))
-    final = (binpack + anti + pen + affinity + dev) / fired
+             + jnp.where(dev_fires > 0, 1.0, 0.0)
+             + pre_fires.astype(jnp.float32))
+    final = (binpack + anti + pen + affinity + dev + pre_score) / fired
     return final, binpack, anti, pen
 
 
@@ -331,7 +345,7 @@ def _local_final_score(after, cap_cpu, cap_mem, coll, penalty, affinity,
 def _select_chunked(capacity, used0, feasible, ask, k_valid,
                     tg_coll0, penalty, affinity_norm, desired_count,
                     port_need, free_ports, port_ok,
-                    dev_slots0, dev_score, dev_fires,
+                    dev_slots0, dev_score, dev_fires, pre_score,
                     *, max_steps: int, spread_alg: bool):
     """Chunked greedy placement for node-local scoring (no spread, no
     distinct-hosts/-property, no reserved-port exclusivity). Exactly
@@ -379,7 +393,7 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
 
         final, _b, _a, _p = _local_final_score(
             after, cap_cpu, cap_mem, coll, penalty, affinity_norm,
-            desired_count, spread_alg, dev_score, dev_fires)
+            desired_count, spread_alg, dev_score, dev_fires, pre_score)
         ok = feas & fit
         masked = jnp.where(ok, final, NEG_INF)
         top_scores, top_idx = jax.lax.top_k(masked, max(TOP_K, 2))
@@ -406,7 +420,8 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
         final_j, _, _, _ = _local_final_score(
             after_j, cap_cpu[choice], cap_mem[choice], coll_j,
             penalty[choice], affinity_norm[choice],
-            desired_count, spread_alg, dev_score[choice], dev_fires)
+            desired_count, spread_alg, dev_score[choice], dev_fires,
+            pre_score[choice])
         # argmax tie rule: lowest index wins, so the choice survives a
         # tie with the runner-up only if its index is lower
         wins = (final_j > runner_val) | \
@@ -463,6 +478,7 @@ PACK_SHARD_KINDS = {
     "penalty": "node", "affinity_norm": "node", "desired_count": "scalar",
     "port_need": "scalar", "free_ports": "node", "port_ok": "node",
     "dev_slots0": "node", "dev_score": "node", "dev_fires": "scalar",
+    "pre_score": "node",
     "sp_codes": "code", "sp_counts0": "rep", "sp_present0": "rep",
     "sp_desired": "rep", "sp_weight": "rep", "sp_has_targets": "rep",
     "sp_valid": "rep", "sum_spread_w": "scalar",
@@ -559,6 +575,8 @@ def pack_request(req: SelectRequest, n_pad: int):
         dev_score=pad1(req.dev_score if req.dev_score is not None
                        else np.zeros(n, np.float32)),
         dev_fires=np.float32(1.0 if req.dev_fires else 0.0),
+        pre_score=pad1(req.pre_score if req.pre_score is not None
+                       else np.zeros(n, np.float32)),
         sp_codes=sp_codes, sp_counts0=sp_counts, sp_present0=sp_present,
         sp_desired=sp_desired, sp_weight=sp_weight,
         sp_has_targets=sp_has_targets, sp_valid=sp_valid,
@@ -574,7 +592,7 @@ def pack_request(req: SelectRequest, n_pad: int):
 def unpack_result(req: SelectRequest, outs) -> SelectResult:
     # ONE batched transfer: per-array np.asarray would serialize a
     # ~100ms device round trip per output over a tunneled TPU
-    (choices, finals, s_bin, s_anti, s_pen, s_aff, s_spread, s_dev,
+    (choices, finals, s_bin, s_anti, s_pen, s_aff, s_spread, s_dev, s_pre,
      top_idx, top_scores, exhausted, _ok_counts) = jax.device_get(outs)
     n = len(req.feasible)
     kk = req.count
@@ -589,7 +607,8 @@ def unpack_result(req: SelectRequest, outs) -> SelectResult:
                 "node-reschedule-penalty": s_pen[:kk],
                 "node-affinity": s_aff[:kk],
                 "allocation-spread": s_spread[:kk],
-                "devices": s_dev[:kk]},
+                "devices": s_dev[:kk],
+                "preemption": s_pre[:kk]},
         top_idx=top_idx[:kk], top_scores=top_scores[:kk],
         nodes_evaluated=(req.n_considered if req.n_considered is not None
                          else n),
@@ -603,7 +622,7 @@ def unpack_result(req: SelectRequest, outs) -> SelectResult:
 _CHUNKED_ARGS = ("capacity", "used0", "feasible", "ask", "k_valid",
                  "tg_coll0", "penalty", "affinity_norm", "desired_count",
                  "port_need", "free_ports", "port_ok",
-                 "dev_slots0", "dev_score", "dev_fires")
+                 "dev_slots0", "dev_score", "dev_fires", "pre_score")
 
 _accel_rtt_cache: List[float] = []
 
@@ -752,6 +771,7 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
     s_pen = np.zeros(k_total, np.float32)
     s_aff = np.zeros(k_total, np.float32)
     s_dev = np.zeros(k_total, np.float32)
+    s_pre = np.zeros(k_total, np.float32)
     top_i = np.full((k_total, TOP_K), -1, np.int32)
     top_s = np.full((k_total, TOP_K), NEG_INF, np.float32)
     exh_out = np.zeros((k_total, d), np.int32)
@@ -761,6 +781,7 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
         aff_col = (req.affinity / req.affinity_sum_weights).astype(np.float32)
     pen_col = req.penalty
     dev_col = req.dev_score if req.dev_fires else None
+    pre_col = req.pre_score
 
     pos = 0
     extra = {}                               # node -> already placed here
@@ -798,11 +819,15 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
                 np.float32(0.0)
             dev = np.float32(dev_col[c]) if dev_col is not None else \
                 np.float32(0.0)
+            pre = np.float32(pre_col[c]) if pre_col is not None else \
+                np.float32(0.0)
             fired = (1.0 + anti_fires.astype(np.float32)
                      + np.float32(1.0 if pen_f else 0.0)
                      + np.float32(1.0 if aff != 0.0 else 0.0)
-                     + np.float32(1.0 if dev_col is not None else 0.0))
-            fin = ((binp + anti + pen + aff + dev) / fired).astype(np.float32)
+                     + np.float32(1.0 if dev_col is not None else 0.0)
+                     + np.float32(1.0 if pre != 0.0 else 0.0))
+            fin = ((binp + anti + pen + aff + dev + pre)
+                   / fired).astype(np.float32)
 
             sl = slice(pos, pos + m)
             node_idx[sl] = c
@@ -812,6 +837,7 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
             s_pen[sl] = pen
             s_aff[sl] = aff
             s_dev[sl] = dev
+            s_pre[sl] = pre
             top_i[sl] = np.where(ti[s] >= n, -1, ti[s])
             top_s[sl] = ts[s]
             exh_out[sl] = exh[s]
@@ -831,7 +857,7 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
                 "node-reschedule-penalty": s_pen,
                 "node-affinity": s_aff,
                 "allocation-spread": np.zeros(k_total, np.float32),
-                "devices": s_dev},
+                "devices": s_dev, "preemption": s_pre},
         top_idx=top_i, top_scores=top_s,
         nodes_evaluated=considered,
         nodes_filtered=int(considered - np.count_nonzero(req.feasible)),

@@ -378,7 +378,8 @@ class GenericScheduler:
                     self.state.allocs_by_job(self.job.namespace, self.job.id),
                     self.plan)
                 options_list = self.engine.select_batch(
-                    tg, len(batch), proposed, batch[0][1])
+                    tg, len(batch), proposed, batch[0][1],
+                    preemption_round=self._preemption_round_for(tg))
 
                 for (missing, _opts), (option, metrics) in zip(batch, options_list):
                     # preferred-node miss falls back to the full node set
@@ -430,27 +431,35 @@ class GenericScheduler:
                         self.ctx.eligibility.set_class_eligibility(
                             node.computed_class, prev or bool(mask[i]))
 
+    def _preemption_round_for(self, tg):
+        """Per-(eval, task group) PreemptionRound when preemption is
+        enabled for this scheduler type; None otherwise."""
+        from .preemption import PreemptionRound, preemption_enabled
+        if not preemption_enabled(self.state.scheduler_config(),
+                                  "batch" if self.batch else "service"):
+            return None
+        round_ = self._preemption_rounds.get(tg.name)
+        if round_ is None or round_.plan is not self.plan:
+            mask, _counts = self.engine.feasibility(tg)
+            round_ = PreemptionRound(
+                self.state, self.engine.table, mask,
+                self.engine.group_ask(tg), self.job, self.plan, tg=tg)
+            self._preemption_rounds[tg.name] = round_
+        return round_
+
     def _try_preemption(self, tg, metrics):
         """When the kernel finds no fit, look for a node where evicting
         lower-priority allocs (priority delta >= 10) makes room. The
         PreemptionRound is cached per task group for the whole eval so
         repeated failures share per-node victim computations."""
         from ..ops.tables import ProposedIndex as PI
-        from .preemption import PreemptionRound, preemption_enabled
         from .stack import RankedNode
-        if not preemption_enabled(self.state.scheduler_config(),
-                                  "batch" if self.batch else "service"):
+        round_ = self._preemption_round_for(tg)
+        if round_ is None:
             return None
-        mask, _counts = self.engine.feasibility(tg)
         proposed = PI(self.engine.table, self.job,
                       self.state.allocs_by_job(self.job.namespace, self.job.id),
                       self.plan)
-        round_ = self._preemption_rounds.get(tg.name)
-        if round_ is None or round_.plan is not self.plan:
-            round_ = PreemptionRound(
-                self.state, self.engine.table, mask,
-                self.engine.group_ask(tg), self.job, self.plan)
-            self._preemption_rounds[tg.name] = round_
         found = round_.find_placement(proposed.used())
         if found is None:
             return None

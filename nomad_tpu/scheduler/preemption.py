@@ -143,6 +143,109 @@ class Preemptor:
             return None
         return self._filter_superset(best, remaining, ask)
 
+    def preempt_for_device(self, req, node) -> Optional[List[Allocation]]:
+        """Victims freeing device instances so `req` (a RequestedDevice)
+        fits — preemption.go PreemptForDevice:472. Candidates holding
+        instances of a matching group are taken lowest-priority-first,
+        closest-distance within a priority band, until enough instances
+        are free."""
+        from .devices import group_satisfies
+
+        def held_in(alloc, gid) -> int:
+            res = alloc.allocated_resources
+            if res is None:
+                return 0
+            return sum(len(dev.device_ids)
+                       for tr in res.tasks.values()
+                       for dev in tr.devices if dev.id_tuple() == gid)
+
+        best: Optional[List[Allocation]] = None
+        for g in node.node_resources.devices:
+            if not group_satisfies(g, req):
+                continue
+            gid = g.id_tuple()
+            total = sum(1 for i in g.instances if i.healthy)
+            held_all = 0
+            holders: List[Tuple[int, Allocation, int]] = []
+            for alloc in self.current_allocs:
+                h = held_in(alloc, gid)
+                if h == 0:
+                    continue
+                held_all += h
+                if alloc.job is not None and \
+                        self.job_priority - alloc.job.priority >= \
+                        PRIORITY_DELTA:
+                    holders.append((alloc.job.priority, alloc, h))
+            free = total - held_all
+            if free >= req.count:
+                return []                   # nothing to evict
+            holders.sort(key=lambda t: (t[0], t[1].id))
+            victims: List[Allocation] = []
+            for _prio, alloc, h in holders:
+                victims.append(alloc)
+                free += h
+                if free >= req.count:
+                    break
+            if free >= req.count and \
+                    (best is None or len(victims) < len(best)):
+                best = victims
+        return best
+
+    def preempt_for_network(self, reserved_ports: List[int],
+                            mbits_needed: float, node,
+                            already_freed_mbits: float = 0.0,
+                            skip_ids: Optional[set] = None
+                            ) -> Optional[List[Allocation]]:
+        """Victims freeing colliding reserved ports and/or bandwidth —
+        preemption.go PreemptForNetwork:270. Port holders are mandatory
+        victims; bandwidth shortfall fills lowest-priority-first."""
+        want_ports = set(reserved_ports or [])
+        victims: List[Allocation] = []
+        victim_ids = set()
+        eligible: List[Tuple[int, float, Allocation, float]] = []
+        used_mbits = 0.0
+        node_mbits = sum(nw.mbits for nw in
+                         node.node_resources.networks) or 0.0
+        for alloc in self.current_allocs:
+            _mp, res = self.alloc_details[alloc.id]
+            alloc_ports = set()
+            alloc_mbits = 0.0
+            for nw in res.networks:
+                alloc_mbits += nw.mbits
+                alloc_ports.update(p.value for p in nw.reserved_ports)
+            used_mbits += alloc_mbits
+            is_eligible = (alloc.job is not None and self.job_priority -
+                           alloc.job.priority >= PRIORITY_DELTA)
+            if want_ports & alloc_ports:
+                if skip_ids and alloc.id in skip_ids:
+                    continue                # already evicted upstream
+                if not is_eligible:
+                    return None             # holder can't be preempted
+                victims.append(alloc)
+                victim_ids.add(alloc.id)
+            elif is_eligible and alloc_mbits > 0:
+                eligible.append((alloc.job.priority,
+                                 -alloc_mbits, alloc, alloc_mbits))
+        freed = already_freed_mbits + sum(
+            sum(nw.mbits for nw in self.alloc_details[v.id][1].networks)
+            for v in victims)
+        if node_mbits and mbits_needed > 0:
+            shortfall = (used_mbits - freed + mbits_needed) - node_mbits
+            if shortfall > 0:
+                eligible.sort(key=lambda t: (t[0], t[1], t[2].id))
+                for _prio, _neg, alloc, mb in eligible:
+                    if alloc.id in victim_ids or \
+                            (skip_ids and alloc.id in skip_ids):
+                        continue
+                    victims.append(alloc)
+                    victim_ids.add(alloc.id)
+                    shortfall -= mb
+                    if shortfall <= 0:
+                        break
+                if shortfall > 0:
+                    return None
+        return victims
+
     def _filter_and_group(self) -> List[Tuple[int, List[Allocation]]]:
         by_prio: Dict[int, List[Allocation]] = {}
         for alloc in self.current_allocs:
@@ -213,7 +316,8 @@ class PreemptionRound:
     (rank.go:415-448, 732-745).
     """
 
-    def __init__(self, snapshot, table, mask, ask_vec, job, plan):
+    def __init__(self, snapshot, table, mask, ask_vec, job, plan,
+                 tg=None):
         import numpy as np
         self.snapshot = snapshot
         self.table = table
@@ -221,6 +325,7 @@ class PreemptionRound:
         self.ask_vec = ask_vec
         self.job = job
         self.plan = plan
+        self.tg = tg          # enables device/network preemption variants
         self.ask = ComparableResources(cpu_shares=float(ask_vec[0]),
                                        memory_mb=float(ask_vec[1]),
                                        disk_mb=float(ask_vec[2]))
@@ -230,6 +335,8 @@ class PreemptionRound:
         # per-node entry counts instead of re-hashed per call
         self._known = np.zeros(n, bool)
         self._scores = np.full(n, -1.0, np.float64)
+        self._logistic = np.zeros(n, np.float64)
+        self._freed = np.zeros((n, 4), np.float64)
         self._victims: Dict[int, List[Allocation]] = {}
         # idx -> group keys on the node that carry max_parallel > 0
         self._mp_groups: Dict[int, frozenset] = {}
@@ -284,6 +391,8 @@ class PreemptionRound:
                                                   float]:
         from ..models.funcs import ScoreFitBinPack
 
+        import numpy as np
+
         node = self.table.nodes[i]
         proposed = [a for a in self.snapshot.allocs_by_node(node.id)
                     if not a.terminal_status() and a.id not in stopped_ids]
@@ -298,21 +407,60 @@ class PreemptionRound:
             if p.alloc_details[a.id][0] > 0:
                 mp.add((a.namespace, a.job_id, a.task_group))
         self._mp_groups[i] = frozenset(mp)
-        victims = p.preempt_for_task_group(self.ask)
-        if not victims:
-            return None, 0.0
-        # bandwidth guard: victims are chosen by cpu/mem/disk distance,
-        # so verify the eviction also covers the ask's network dimension
-        # (full network-preemption variant: preemption.go PreemptForNetwork)
-        if len(self.ask_vec) > 3 and self.ask_vec[3] > 0:
+
+        # resource-dimension victims (skipped when the node already
+        # fits on cpu/mem/disk and is a candidate only for device/port
+        # reasons)
+        res_fits = bool(np.all(
+            used_row[:3] + np.asarray(self.ask_vec[:3])
+            <= self.table.capacity[i, :3] + 1e-6))
+        if res_fits:
+            victims: List[Allocation] = []
+        else:
+            victims = p.preempt_for_task_group(self.ask)
+            if not victims:
+                return None, 0.0
+            victims = list(victims)
+        victim_ids = {v.id for v in victims}
+
+        # device variant (preemption.go PreemptForDevice:472)
+        if self.tg is not None:
+            from .devices import combined_device_asks
+            for reqd in combined_device_asks(self.tg):
+                dvict = p.preempt_for_device(reqd, node)
+                if dvict is None:
+                    return None, 0.0
+                for v in dvict:
+                    if v.id not in victim_ids:
+                        victims.append(v)
+                        victim_ids.add(v.id)
+
+        # network variant (preemption.go PreemptForNetwork:270):
+        # reserved-port collisions and the bandwidth dimension
+        reserved_ports: List[int] = []
+        if self.tg is not None:
+            from .stack import PlacementEngine
+            _dyn, reserved_ports = PlacementEngine._port_asks(self.tg)
+        mbits_needed = float(self.ask_vec[3]) \
+            if len(self.ask_vec) > 3 else 0.0
+        if reserved_ports or mbits_needed > 0:
             freed_mbits = 0.0
             for v in victims:
                 cr = v.comparable_resources()
                 if cr is not None:
                     freed_mbits += sum(nw.mbits for nw in cr.networks)
-            if used_row[3] - freed_mbits + self.ask_vec[3] > \
-                    self.table.capacity[i, 3] + 1e-6:
+            nvict = p.preempt_for_network(reserved_ports, mbits_needed,
+                                          node,
+                                          already_freed_mbits=freed_mbits,
+                                          skip_ids=victim_ids)
+            if nvict is None:
                 return None, 0.0
+            for v in nvict:
+                if v.id not in victim_ids:
+                    victims.append(v)
+                    victim_ids.add(v.id)
+        if not victims:
+            return None, 0.0
         # score: binpack fit after eviction + logistic preemption score
         util = ComparableResources()
         victim_ids = {v.id for v in victims}
@@ -322,6 +470,20 @@ class PreemptionRound:
         util.add(self.ask)
         binpack = ScoreFitBinPack(node, util) / 18.0
         pscore = preemption_score(net_priority(victims))
+        # resources the evictions free, in kernel dim order
+        # (cpu, memory, disk, network mbits)
+        import numpy as np
+        freed = np.zeros(4, np.float64)
+        for v in victims:
+            cr = v.comparable_resources()
+            if cr is None:
+                continue
+            freed[0] += cr.cpu_shares
+            freed[1] += cr.memory_mb
+            freed[2] += cr.disk_mb
+            freed[3] += sum(nw.mbits for nw in cr.networks)
+        self._logistic[i] = pscore
+        self._freed[i] = freed
         return victims, (binpack + pscore) / 2.0
 
     # -- entry ---------------------------------------------------------
@@ -352,12 +514,60 @@ class PreemptionRound:
                     self._victims[i] = victims
                 else:
                     self._scores[i] = -1.0
+                    self._logistic[i] = 0.0
+                    self._freed[i] = 0.0
                     self._victims.pop(i, None)
         masked = np.where(candidates & self._known, self._scores, -1.0)
         best_i = int(np.argmax(masked))
         if masked[best_i] < 0:
             return None
         return best_i, self._victims[best_i], float(masked[best_i])
+
+    def columns(self, used, extra_candidates=None
+                ) -> Tuple["np.ndarray", "np.ndarray"]:
+        """Kernel competition columns (rank.go:415-448): for every
+        masked node that doesn't fit but CAN fit after evictions,
+        (logistic preemption score, freed resources). `used` rows for
+        those nodes should be reduced by `freed` before the kernel so
+        fit and binpack reflect the post-eviction node."""
+        import numpy as np
+
+        current = self._preempted_now()
+        self._invalidate_dirty(current)
+        fits = np.all(used + np.asarray(self.ask_vec)[None, :]
+                      <= self.table.capacity + 1e-6, axis=1)
+        candidates = self.mask & ~fits
+        if extra_candidates is not None:
+            # nodes failing only on devices/reserved ports (the
+            # PreemptForDevice / PreemptForNetwork variants)
+            candidates |= self.mask & extra_candidates
+        pending = np.nonzero(candidates & ~self._known)[0]
+        if len(pending):
+            stopped_ids = {a.id for allocs in self.plan.node_update.values()
+                           for a in allocs}
+            stopped_ids |= {a.id for a in current}
+            for i in pending:
+                i = int(i)
+                victims, score = self._evaluate_node(
+                    i, used[i], current, stopped_ids)
+                self._known[i] = True
+                if victims:
+                    self._scores[i] = score
+                    self._victims[i] = victims
+                else:
+                    self._scores[i] = -1.0
+                    self._logistic[i] = 0.0
+                    self._freed[i] = 0.0
+                    self._victims.pop(i, None)
+        ok = candidates & self._known & (self._scores >= 0)
+        d = used.shape[1]
+        pre_score = np.where(ok, self._logistic, 0.0).astype(np.float32)
+        freed = np.where(ok[:, None], self._freed[:, :d],
+                         0.0).astype(np.float32)
+        return pre_score, freed
+
+    def victims_for(self, idx: int):
+        return self._victims.get(idx)
 
 
 def find_preemption_placement(snapshot, table, mask, used, ask_vec, job,

@@ -286,9 +286,17 @@ class PlacementEngine:
     # -- the main entry ------------------------------------------------
     def select_batch(self, tg: TaskGroup, count: int, proposed: ProposedIndex,
                      options: Optional[SelectOptions] = None,
+                     preemption_round=None,
                      ) -> List[Tuple[Optional[RankedNode], AllocMetric]]:
         """Place `count` instances of tg in one kernel dispatch. Returns
-        one (RankedNode-or-None, metrics) pair per requested instance."""
+        one (RankedNode-or-None, metrics) pair per requested instance.
+
+        With a PreemptionRound, full nodes whose fit comes from evicting
+        lower-priority allocs compete in the same argmax (rank.go
+        :415-448 + PreemptionScoringIterator): their `used` rows are
+        reduced by the victims' resources and they carry the logistic
+        preemption scorer; victims are staged into the plan when such a
+        node wins."""
         assert self.table is not None and self.job is not None
         t = self.table
         start = time.monotonic_ns()
@@ -333,12 +341,38 @@ class PlacementEngine:
         spreads, sum_spread_w = self._spread_inputs(tg, proposed)
         distinct_props = self._distinct_prop_inputs(tg, proposed)
 
+        used_arr = proposed.used()
+        pre_score = None
+        if preemption_round is not None:
+            extra = None
+            if dev_slots is not None:
+                extra = dev_slots < 1.0
+            if port_ok is not None:
+                extra = (~port_ok) if extra is None else (extra | ~port_ok)
+            pre_score, freed = preemption_round.columns(
+                used_arr, extra_candidates=extra)
+            if pre_score.any():
+                # reflect hypothetical evictions so fit/binpack see the
+                # post-eviction node (rank.go computes util after evict)
+                used_arr = np.maximum(used_arr - freed, 0.0)
+                pre_ok = pre_score > 0
+                # evictions also unlock device slots and reserved ports
+                # (one preempted placement per node per batch; the rest
+                # re-evaluate next round)
+                if dev_slots is not None:
+                    dev_slots = np.where(pre_ok & (dev_slots < 1.0),
+                                         1.0, dev_slots)
+                if port_ok is not None:
+                    port_ok = port_ok | pre_ok
+            else:
+                pre_score = None
+
         req = SelectRequest(
             ask=self.group_ask(tg),
             count=count,
             feasible=mask,
             capacity=t.capacity,
-            used=proposed.used(),
+            used=used_arr,
             desired_count=float(max(tg.count, 1)),
             tg_collisions=proposed.tg_counts(tg.name),
             job_count=proposed.job_count,
@@ -354,6 +388,7 @@ class PlacementEngine:
             dev_slots=dev_slots,
             dev_score=dev_score,
             dev_fires=dev_fires,
+            pre_score=pre_score,
             spreads=spreads,
             sum_spread_weights=sum_spread_w,
             distinct_props=distinct_props,
@@ -367,6 +402,7 @@ class PlacementEngine:
         self._shared_by_dc = dict(self.by_dc)
         self._shared_filtered = dict(filtered_counts)
         self._prev_meta = (None, None)
+        staged_victims = set()
         for step in range(count):
             idx = int(res.node_idx[step])
             metrics = self._metrics_for_step(res, step, filtered_counts,
@@ -375,6 +411,18 @@ class PlacementEngine:
                 out.append((None, metrics))
                 continue
             node = t.nodes[idx]
+            # a preempting winner stages its victims before resource
+            # assignment (they free ports/devices too)
+            victims = None
+            if pre_score is not None and pre_score[idx] > 0 \
+                    and idx not in staged_victims:
+                victims = preemption_round.victims_for(idx)
+                if victims:
+                    staged_victims.add(idx)
+                    for v in victims:
+                        proposed.plan.append_preempted_alloc(v, "")
+                    self._net_cache.pop(node.id, None)
+                    self._dev_cache.pop(node.id, None)
             task_resources, shared, ok = self._assign_resources(
                 node, tg, proposed.plan)
             if not ok:
@@ -387,6 +435,7 @@ class PlacementEngine:
                 task_resources=task_resources,
                 alloc_resources=shared,
                 metrics=metrics,
+                preempted_allocs=victims,
             ), metrics))
         return out
 

@@ -67,7 +67,7 @@ def scalar_select(req):
     out_nodes, out_final, comps = [], [], {
         "binpack": [], "job-anti-affinity": [],
         "node-reschedule-penalty": [], "node-affinity": [],
-        "allocation-spread": [], "devices": []}
+        "allocation-spread": [], "devices": [], "preemption": []}
 
     for _step in range(req.count):
         best_i = -1
@@ -167,16 +167,19 @@ def scalar_select(req):
 
             dev_v = F(req.dev_score[i]) if req.dev_fires and \
                 req.dev_score is not None else F(0.0)
+            pre_v = F(req.pre_score[i]) if req.pre_score is not None \
+                else F(0.0)
 
             fired = F(1.0 + float(anti_fires) + float(pen_fires)
                       + float(aff_fires) + float(spread_fires)
-                      + float(bool(req.dev_fires)))
+                      + float(bool(req.dev_fires))
+                      + float(pre_v != 0.0))
             final = F((binpack + anti + pen_v + aff_v + spread_total
-                       + dev_v) / fired)
+                       + dev_v + pre_v) / fired)
 
             if best is None or final > best[0]:
                 best = (final, binpack, anti, pen_v, aff_v,
-                        spread_total, dev_v)
+                        spread_total, dev_v, pre_v)
                 best_i = i
 
         if best is None:
@@ -194,6 +197,7 @@ def scalar_select(req):
         comps["node-affinity"].append(float(best[4]))
         comps["allocation-spread"].append(float(best[5]))
         comps["devices"].append(float(best[6]))
+        comps["preemption"].append(float(best[7]))
 
         # -- state updates ---------------------------------------------
         used[best_i] += ask

@@ -37,8 +37,8 @@ def _mk_spread(rng, n, count, targeted: bool):
 
 
 def _random_request(rng, *, spreads=False, dprops=False, dhosts=False,
-                    ports=False, devices=False, algorithm="binpack",
-                    tight=False):
+                    ports=False, devices=False, preempt=False,
+                    algorithm="binpack", tight=False):
     n = rng.randint(4, 120)
     count = rng.randint(1, 40)
     capacity = rng.uniform(500, 4000, size=(n, 4)).astype(np.float32)
@@ -69,6 +69,10 @@ def _random_request(rng, *, spreads=False, dprops=False, dhosts=False,
         dev_score = (rng.uniform(0, 1, n)
                      * (rng.rand(n) > 0.5)).astype(np.float32)
         dev_fires = bool(rng.rand() < 0.7)
+    pre_score = None
+    if preempt:
+        pre_score = (rng.uniform(0.1, 1, n)
+                     * (rng.rand(n) > 0.6)).astype(np.float32)
 
     return sel.SelectRequest(
         ask=ask, count=count,
@@ -87,6 +91,7 @@ def _random_request(rng, *, spreads=False, dprops=False, dhosts=False,
                     if ports else None),
         port_ok=(rng.rand(n) > 0.1) if ports else None,
         dev_slots=dev_slots, dev_score=dev_score, dev_fires=dev_fires,
+        pre_score=pre_score,
         spreads=sp, sum_spread_weights=sum_w,
         distinct_props=dp,
     )
@@ -135,6 +140,8 @@ FEATURE_SETS = [
     dict(devices=True),
     dict(spreads=True, dprops=True, ports=True, devices=True),
     dict(tight=True, spreads=True, dhosts=True),
+    dict(preempt=True),
+    dict(preempt=True, spreads=True, devices=True),
 ]
 
 

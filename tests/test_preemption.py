@@ -178,3 +178,245 @@ def test_system_preemption_enabled_by_default():
     placed = h.store.allocs_by_job("default", sysjob.id)
     assert len(placed) == 1
     assert placed[0].preempted_allocations
+
+
+def test_mixed_competition_preempting_node_can_win():
+    """rank.go:415-448 semantics: a full node whose post-eviction
+    binpack + logistic preemption score beats an empty node's plain
+    binpack score wins the SAME selection. A low-priority filler on a
+    node leaves it 'full'; the empty node has a weak (nearly empty)
+    binpack score; the preempting node scores (binpack-after-evict +
+    ~1.0 logistic)/2, which is higher."""
+    from nomad_tpu import mock
+    from nomad_tpu.models import (Evaluation, EVAL_STATUS_PENDING,
+                                  TRIGGER_JOB_REGISTER)
+    from nomad_tpu.scheduler.harness import Harness
+    from nomad_tpu.utils.ids import generate_uuid
+
+    h = Harness()
+    from nomad_tpu.models import PreemptionConfig, SchedulerConfiguration
+    h.store.set_scheduler_config(
+        h.next_index(),
+        SchedulerConfiguration(preemption_config=PreemptionConfig(
+            service_scheduler_enabled=True, batch_scheduler_enabled=True)))
+
+    full = mock.node()
+    full.name = "full-node"
+    empty = mock.node()
+    empty.name = "empty-node"
+    h.store.upsert_node(h.next_index(), full)
+    h.store.upsert_node(h.next_index(), empty)
+
+    # low-prio filler saturating the full node
+    filler = mock.job()
+    filler.id = "filler"
+    filler.priority = 10   # netPriority ~10+1 -> logistic ~1.0
+    tg = filler.task_groups[0]
+    tg.count = 1
+    for t in tg.tasks:
+        t.resources.networks = []
+        t.resources.cpu = 3600
+        t.resources.memory_mb = 7000
+    tg.networks = []
+    h.store.upsert_job(h.next_index(), filler)
+    ev = Evaluation(id=generate_uuid(), namespace="default", priority=10,
+                    triggered_by=TRIGGER_JOB_REGISTER, job_id=filler.id,
+                    status=EVAL_STATUS_PENDING, type="service")
+    h.process("service", ev)
+    filler_alloc_node = [a for p in h.plans
+                         for allocs in p.node_allocation.values()
+                         for a in allocs][0].node_id
+
+    # also occupy the other node slightly so its binpack score is low
+    # (near-empty binpack score ~ (20-2*10^~1)/18 ~ 0)
+    hi = mock.job()
+    hi.id = "hi"
+    hi.priority = 80
+    tg = hi.task_groups[0]
+    tg.count = 1
+    for t in tg.tasks:
+        t.resources.networks = []
+        t.resources.cpu = 2000
+        t.resources.memory_mb = 4000
+    tg.networks = []
+    h.store.upsert_job(h.next_index(), hi)
+    ev2 = Evaluation(id=generate_uuid(), namespace="default", priority=80,
+                     triggered_by=TRIGGER_JOB_REGISTER, job_id=hi.id,
+                     status=EVAL_STATUS_PENDING, type="service")
+    h.process("service", ev2)
+    plan = h.plans[-1]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 1
+    preempted = [a for allocs in plan.node_preemptions.values()
+                 for a in allocs]
+    # the preempting node must win: (binpack-after-evict ~0.77 +
+    # logistic ~1.0)/2 ~ 0.88 beats the empty node's near-zero binpack
+    assert placed[0].node_id == filler_alloc_node
+    assert len(preempted) == 1
+    assert placed[0].preempted_allocations == [preempted[0].id]
+
+
+def _dev_holder(node, prio, instance_ids, job_id="holder"):
+    from nomad_tpu import mock
+    from nomad_tpu.models import AllocatedDeviceResource
+    from nomad_tpu.utils.ids import generate_uuid
+    a = mock.alloc()
+    a.id = generate_uuid()
+    a.node_id = node.id
+    a.job = mock.job()
+    a.job.priority = prio
+    a.job.id = job_id
+    a.job_id = job_id
+    tr = a.allocated_resources.tasks["web"]
+    tr.networks = []
+    g = node.node_resources.devices[0]
+    tr.devices = [AllocatedDeviceResource(
+        vendor=g.vendor, type=g.type, name=g.name,
+        device_ids=list(instance_ids))]
+    return a
+
+
+def test_preempt_for_device_frees_instances():
+    """preemption.go PreemptForDevice: lowest-priority holders of the
+    needed device group are evicted until enough instances free."""
+    from nomad_tpu import mock
+    from nomad_tpu.models import RequestedDevice
+    from nomad_tpu.scheduler.preemption import Preemptor
+    node = mock.nvidia_node()
+    ids = [i.id for i in node.node_resources.devices[0].instances]
+    low = _dev_holder(node, 20, ids[:2], "low")
+    high = _dev_holder(node, 40, ids[2:], "high")
+    p = Preemptor(80, "default", "the-job")
+    p.set_node(node)
+    p.set_candidates([low, high])
+    p.set_preemptions([])
+    # 2 needed, 0 free -> evict the lowest-priority holder only
+    victims = p.preempt_for_device(RequestedDevice(name="gpu", count=2), node)
+    assert victims is not None and [v.id for v in victims] == [low.id]
+    # 3 needed -> both holders fall
+    victims3 = p.preempt_for_device(RequestedDevice(name="gpu", count=3), node)
+    assert victims3 is not None and len(victims3) == 2
+    # nothing to evict when enough already free
+    p2 = Preemptor(80, "default", "the-job")
+    p2.set_node(node)
+    p2.set_candidates([low])
+    p2.set_preemptions([])
+    assert p2.preempt_for_device(
+        RequestedDevice(name="gpu", count=2), node) == []
+
+
+def test_preempt_for_device_ineligible_holders_block():
+    from nomad_tpu import mock
+    from nomad_tpu.models import RequestedDevice
+    from nomad_tpu.scheduler.preemption import Preemptor
+    node = mock.nvidia_node()
+    ids = [i.id for i in node.node_resources.devices[0].instances]
+    close = _dev_holder(node, 75, ids, "close")   # delta < 10
+    p = Preemptor(80, "default", "the-job")
+    p.set_node(node)
+    p.set_candidates([close])
+    p.set_preemptions([])
+    assert p.preempt_for_device(
+        RequestedDevice(name="gpu", count=1), node) is None
+
+
+def _port_holder(node, prio, port, mbits=100, job_id="net-holder"):
+    from nomad_tpu import mock
+    from nomad_tpu.models import NetworkResource, Port
+    from nomad_tpu.utils.ids import generate_uuid
+    a = mock.alloc()
+    a.id = generate_uuid()
+    a.node_id = node.id
+    a.job = mock.job()
+    a.job.priority = prio
+    a.job.id = job_id
+    a.job_id = job_id
+    tr = a.allocated_resources.tasks["web"]
+    tr.networks = [NetworkResource(
+        device="eth0", ip="192.168.0.100", mbits=mbits,
+        reserved_ports=[Port(label="p", value=port)])]
+    return a
+
+
+def test_preempt_for_network_port_collision():
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler.preemption import Preemptor
+    node = mock.node()
+    holder = _port_holder(node, 20, 8080)
+    other = _port_holder(node, 20, 9090, job_id="other")
+    p = Preemptor(80, "default", "the-job")
+    p.set_node(node)
+    p.set_candidates([holder, other])
+    p.set_preemptions([])
+    victims = p.preempt_for_network([8080], 0.0, node)
+    assert victims is not None and [v.id for v in victims] == [holder.id]
+    # ineligible holder blocks the node
+    p2 = Preemptor(25, "default", "the-job")
+    p2.set_node(node)
+    p2.set_candidates([holder])
+    p2.set_preemptions([])
+    assert p2.preempt_for_network([8080], 0.0, node) is None
+
+
+def test_preempt_for_network_bandwidth():
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler.preemption import Preemptor
+    node = mock.node()   # eth0 1000 mbits
+    hog = _port_holder(node, 20, 8080, mbits=800, job_id="hog")
+    small = _port_holder(node, 30, 9090, mbits=100, job_id="small")
+    p = Preemptor(80, "default", "the-job")
+    p.set_node(node)
+    p.set_candidates([hog, small])
+    p.set_preemptions([])
+    # need 500 mbits; used 900/1000 -> shortfall 400 -> evict the
+    # lowest-priority (hog) first
+    victims = p.preempt_for_network([], 500.0, node)
+    assert victims is not None
+    assert [v.id for v in victims] == [hog.id]
+
+
+def test_scheduler_preempts_for_devices_e2e():
+    """A device job whose instances are all held by low-priority allocs
+    places by evicting them (device preemption through the full
+    scheduler)."""
+    from nomad_tpu import mock
+    from nomad_tpu.models import (Evaluation, RequestedDevice,
+                                  EVAL_STATUS_PENDING,
+                                  TRIGGER_JOB_REGISTER,
+                                  PreemptionConfig, SchedulerConfiguration)
+    from nomad_tpu.scheduler.harness import Harness
+    from nomad_tpu.utils.ids import generate_uuid
+
+    h = Harness()
+    h.store.set_scheduler_config(
+        h.next_index(),
+        SchedulerConfiguration(preemption_config=PreemptionConfig(
+            service_scheduler_enabled=True)))
+    node = mock.nvidia_node()
+    h.store.upsert_node(h.next_index(), node)
+    ids = [i.id for i in node.node_resources.devices[0].instances]
+    holder = _dev_holder(node, 20, ids, "low-dev")
+    h.store.upsert_job(h.next_index(), holder.job)
+    h.store.upsert_allocs(h.next_index(), [holder])
+
+    job = mock.job()
+    job.id = "needs-gpu"
+    job.priority = 80
+    tg = job.task_groups[0]
+    tg.count = 1
+    for t in tg.tasks:
+        t.resources.networks = []
+        t.resources.devices = [RequestedDevice(name="gpu", count=2)]
+    tg.networks = []
+    h.store.upsert_job(h.next_index(), job)
+    ev = Evaluation(id=generate_uuid(), namespace="default", priority=80,
+                    triggered_by=TRIGGER_JOB_REGISTER, job_id=job.id,
+                    status=EVAL_STATUS_PENDING, type="service")
+    h.process("service", ev)
+    plan = h.plans[-1]
+    placed = [a for al in plan.node_allocation.values() for a in al]
+    preempted = [a for al in plan.node_preemptions.values() for a in al]
+    assert len(placed) == 1, h.evals
+    assert [a.id for a in preempted] == [holder.id]
+    devs = placed[0].allocated_resources.tasks["web"].devices
+    assert len(devs[0].device_ids) == 2
