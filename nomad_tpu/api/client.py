@@ -16,8 +16,10 @@ class ApiError(RuntimeError):
 
 
 class ApiClient:
-    def __init__(self, address: str = "http://127.0.0.1:4646"):
+    def __init__(self, address: str = "http://127.0.0.1:4646",
+                 token: str = ""):
         self.address = address.rstrip("/")
+        self.token = token
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
                  params: Optional[dict] = None) -> Any:
@@ -26,9 +28,11 @@ class ApiClient:
             from urllib.parse import urlencode
             url += "?" + urlencode(params)
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Nomad-Token"] = self.token
         req = urllib.request.Request(url, data=data, method=method,
-                                     headers={"Content-Type":
-                                              "application/json"})
+                                     headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=310) as resp:
                 return json.loads(resp.read() or "null")
@@ -168,6 +172,39 @@ class ApiClient:
 
     def agent_self(self) -> dict:
         return self._request("GET", "/v1/agent/self")
+
+    # -- ACL ------------------------------------------------------------
+    def acl_bootstrap(self) -> dict:
+        return self._request("POST", "/v1/acl/bootstrap")
+
+    def acl_policies(self) -> list:
+        return self._request("GET", "/v1/acl/policies")
+
+    def acl_policy(self, name: str) -> dict:
+        return self._request("GET", f"/v1/acl/policy/{name}")
+
+    def acl_upsert_policy(self, name: str, rules: str,
+                          description: str = "") -> dict:
+        return self._request("PUT", f"/v1/acl/policy/{name}",
+                             {"rules": rules, "description": description})
+
+    def acl_delete_policy(self, name: str) -> dict:
+        return self._request("DELETE", f"/v1/acl/policy/{name}")
+
+    def acl_tokens(self) -> list:
+        return self._request("GET", "/v1/acl/tokens")
+
+    def acl_create_token(self, name: str = "", type_: str = "client",
+                         policies=None) -> dict:
+        return self._request("PUT", "/v1/acl/token",
+                             {"name": name, "type": type_,
+                              "policies": policies or []})
+
+    def acl_delete_token(self, accessor_id: str) -> dict:
+        return self._request("DELETE", f"/v1/acl/token/{accessor_id}")
+
+    def acl_token_self(self) -> dict:
+        return self._request("GET", "/v1/acl/token/self")
 
     def scheduler_config(self) -> dict:
         return self._request("GET", "/v1/operator/scheduler/configuration")

@@ -64,7 +64,12 @@ class HTTPApiServer:
                 try:
                     url = urlparse(self.path)
                     q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                    token = self.headers.get("X-Nomad-Token", "")
                     if url.path == "/v1/event/stream" and method == "GET":
+                        acl = api.server.resolve_token(token)
+                        if not (acl.is_management() or acl.allow_namespace(
+                                q.get("namespace", "default"))):
+                            raise PermissionError("Permission denied")
                         # topics repeat: ?topic=Job:myjob&topic=Node:*
                         raw = parse_qs(url.query).get("topic", [])
                         return api.stream_events(self, raw,
@@ -75,12 +80,15 @@ class HTTPApiServer:
                         api.server.store.block_min_index(
                             int(q["index"]), timeout_s=min(wait_s, 300.0))
                     result = api.route(method, url.path, q, self._body
-                                       if method in ("PUT", "POST") else None)
+                                       if method in ("PUT", "POST") else None,
+                                       token=token)
                     if result is None:
                         self._error(404, "not found")
                     else:
                         payload, index = result
                         self._respond(200, payload, index)
+                except PermissionError as e:
+                    self._error(403, str(e) or "Permission denied")
                 except ValueError as e:
                     self._error(400, str(e))
                 except KeyError as e:
@@ -115,12 +123,143 @@ class HTTPApiServer:
         if self._thread:
             self._thread.join(timeout=2)
 
+    # -- ACL enforcement (command/agent http.go wrap + acl checks) -----
+    @staticmethod
+    def _enforce(acl, method: str, path: str, ns: str) -> None:
+        """Raise PermissionError unless the compiled ACL allows the
+        route. Capability mapping follows the reference endpoints'
+        aclObj checks (job_endpoint.go, node_endpoint.go, ...)."""
+        if acl.is_management():
+            return
+
+        def need(ok: bool):
+            if not ok:
+                raise PermissionError("Permission denied")
+
+        write = method in ("PUT", "POST", "DELETE")
+        if path == "/v1/status/leader" or path == "/v1/jobs/parse":
+            return
+        if path.startswith("/v1/acl/"):
+            return                      # own authz in the route bodies
+        if path == "/v1/jobs":
+            need(acl.allow_namespace_operation(
+                ns, "submit-job" if write else "list-jobs"))
+            return
+        if path.startswith("/v1/job/"):
+            cap = "read-job"
+            if write:
+                cap = "submit-job"
+                if path.endswith("/scale"):
+                    cap = "scale-job"
+                elif path.endswith("/dispatch"):
+                    cap = "dispatch-job"
+            need(acl.allow_namespace_operation(ns, cap))
+            return
+        if path == "/v1/nodes" or path.startswith("/v1/node/"):
+            sub_write = write or path.endswith(("/drain", "/eligibility"))
+            need(acl.allow_node_write() if sub_write
+                 else acl.allow_node_read())
+            return
+        if path.startswith(("/v1/allocation", "/v1/evaluation",
+                            "/v1/deployment")):
+            need(acl.allow_namespace_operation(
+                ns, "submit-job" if write else "read-job"))
+            return
+        if path == "/v1/search":
+            need(acl.allow_namespace(ns) or acl.allow_node_read())
+            return
+        if path.startswith("/v1/agent"):
+            need(acl.allow_agent_write() if write else acl.allow_agent_read())
+            return
+        if path.startswith("/v1/operator"):
+            need(acl.allow_operator_write() if write
+                 else acl.allow_operator_read())
+            return
+        raise PermissionError("Permission denied")
+
     # -- routing -------------------------------------------------------
-    def route(self, method: str, path: str, q: dict, body_fn):
+    def route(self, method: str, path: str, q: dict, body_fn, token: str = ""):
         s = self.server
         store = s.store
         idx = store.latest_index()
         ns = q.get("namespace", "default")
+
+        acl = s.resolve_token(token)
+        if s.config.acl_enabled:
+            self._enforce(acl, method, path, ns)
+
+        if path.startswith("/v1/acl/"):
+            return self._route_acl(method, path, body_fn, acl, token)
+
+        return self._route_main(method, path, q, body_fn, ns, idx)
+
+    def _route_acl(self, method: str, path: str, body_fn, acl, token: str):
+        """ACL endpoints (nomad/acl_endpoint.go): bootstrap once without
+        a token; token/self with any valid token; everything else needs
+        a management token."""
+        s = self.server
+        store = s.store
+        idx = store.latest_index()
+
+        if path == "/v1/acl/bootstrap" and method in ("PUT", "POST"):
+            tok = s.bootstrap_acl()
+            return to_wire(tok), store.latest_index()
+
+        if path == "/v1/acl/token/self" and method == "GET":
+            tok = store.acl_token_by_secret(token) if token else None
+            if tok is None:
+                raise PermissionError("ACL token not found")
+            return to_wire(tok), idx
+
+        if s.config.acl_enabled and not acl.is_management():
+            raise PermissionError("Permission denied")
+
+        from ..acl import AclPolicy
+        if path == "/v1/acl/policies" and method == "GET":
+            return [{"name": p.name, "description": p.description,
+                     "modify_index": p.modify_index}
+                    for p in store.acl_policies()], idx
+        m = re.match(r"^/v1/acl/policy/([^/]+)$", path)
+        if m:
+            name = m.group(1)
+            if method == "GET":
+                p = store.acl_policy(name)
+                return (to_wire(p), idx) if p else None
+            if method in ("PUT", "POST"):
+                data = body_fn()
+                p = AclPolicy(name=name,
+                              description=data.get("description", ""),
+                              rules=data.get("rules", ""))
+                s.upsert_acl_policies([p])
+                return {"ok": True}, store.latest_index()
+            if method == "DELETE":
+                s.delete_acl_policies([name])
+                return {"ok": True}, store.latest_index()
+        if path == "/v1/acl/tokens" and method == "GET":
+            return [t.stub() for t in store.acl_tokens()], idx
+        if path == "/v1/acl/token" and method in ("PUT", "POST"):
+            data = body_fn()
+            tok = s.create_acl_token(
+                name=data.get("name", ""),
+                type_=data.get("type", "client"),
+                policies=data.get("policies") or [],
+                global_=bool(data.get("global", False)))
+            return to_wire(tok), store.latest_index()
+        m = re.match(r"^/v1/acl/token/([^/]+)$", path)
+        if m:
+            accessor = m.group(1)
+            if method == "GET":
+                tok = store.acl_token_by_accessor(accessor)
+                return (to_wire(tok), idx) if tok else None
+            if method == "DELETE":
+                s.delete_acl_tokens([accessor])
+                return {"ok": True}, store.latest_index()
+        return None
+
+    def _route_main(self, method: str, path: str, q: dict, body_fn,
+                    ns: str, idx: int):
+        s = self.server
+        store = s.store
 
         if path == "/v1/jobs":
             if method == "GET":

@@ -20,7 +20,7 @@ from ..utils.ids import short_id
 
 
 def _client(args) -> ApiClient:
-    return ApiClient(args.address)
+    return ApiClient(args.address, token=getattr(args, "token", ""))
 
 
 def _print_rows(rows: List[List[str]], header: List[str]) -> None:
@@ -66,7 +66,8 @@ def cmd_agent(args) -> int:
         elif probe_accelerator(timeout_s=60.0) is None:
             force_cpu_platform(1)
             print("    WARNING: TPU backend unavailable; scheduling on CPU")
-        server = Server(ServerConfig(num_schedulers=args.num_schedulers))
+        server = Server(ServerConfig(num_schedulers=args.num_schedulers,
+                                     acl_enabled=args.acl_enabled))
         server.start()
         rpc = RpcServer(server, port=args.rpc_port)
         rpc.start()
@@ -555,10 +556,57 @@ def cmd_server_info(args) -> int:
     return 0
 
 
+# -- acl ---------------------------------------------------------------
+def cmd_acl_bootstrap(args) -> int:
+    c = _client(args)
+    tok = c.acl_bootstrap()
+    print(f"Accessor ID = {tok['accessor_id']}")
+    print(f"Secret ID   = {tok['secret_id']}")
+    print(f"Type        = {tok['type']}")
+    return 0
+
+
+def cmd_acl_policy_apply(args) -> int:
+    with open(args.file) as f:
+        rules = f.read()
+    _client(args).acl_upsert_policy(args.name, rules,
+                                    description=args.description)
+    print(f"Successfully wrote policy {args.name!r}")
+    return 0
+
+
+def cmd_acl_policy_list(args) -> int:
+    rows = [[p["name"], p.get("description", "")]
+            for p in _client(args).acl_policies()]
+    _print_rows(rows, ["Name", "Description"])
+    return 0
+
+
+def cmd_acl_token_create(args) -> int:
+    tok = _client(args).acl_create_token(
+        name=args.name, type_=args.type,
+        policies=args.policy or [])
+    print(f"Accessor ID = {tok['accessor_id']}")
+    print(f"Secret ID   = {tok['secret_id']}")
+    print(f"Type        = {tok['type']}")
+    print(f"Policies    = {tok['policies']}")
+    return 0
+
+
+def cmd_acl_token_list(args) -> int:
+    rows = [[t["accessor_id"][:8], t["name"], t["type"],
+             ",".join(t.get("policies", []))]
+            for t in _client(args).acl_tokens()]
+    _print_rows(rows, ["Accessor", "Name", "Type", "Policies"])
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="nomad-tpu",
                                 description="TPU-native workload orchestrator")
     p.add_argument("-address", default="http://127.0.0.1:4646")
+    p.add_argument("-token", default=os.environ.get("NOMAD_TOKEN", ""),
+                   help="ACL token secret (env NOMAD_TOKEN)")
     sub = p.add_subparsers(dest="cmd")
 
     agent = sub.add_parser("agent", help="run the agent")
@@ -570,6 +618,8 @@ def build_parser() -> argparse.ArgumentParser:
     agent.add_argument("-node-name", dest="node_name", default="")
     agent.add_argument("-http-port", dest="http_port", type=int, default=4646)
     agent.add_argument("-rpc-port", dest="rpc_port", type=int, default=4647)
+    agent.add_argument("-acl-enabled", dest="acl_enabled",
+                       action="store_true")
     agent.add_argument("-clients", type=int, default=1)
     agent.add_argument("-num-schedulers", dest="num_schedulers", type=int,
                        default=2)
@@ -661,6 +711,25 @@ def build_parser() -> argparse.ArgumentParser:
     srv = sub.add_parser("server").add_subparsers(dest="sub")
     sinfo = srv.add_parser("info")
     sinfo.set_defaults(fn=cmd_server_info)
+
+    acl = sub.add_parser("acl", help="ACL policies and tokens")
+    acl_sub = acl.add_subparsers(dest="acl_cmd", required=True)
+    ab = acl_sub.add_parser("bootstrap")
+    ab.set_defaults(fn=cmd_acl_bootstrap)
+    ap_ = acl_sub.add_parser("policy-apply")
+    ap_.add_argument("name")
+    ap_.add_argument("file")
+    ap_.add_argument("-description", default="")
+    ap_.set_defaults(fn=cmd_acl_policy_apply)
+    apl = acl_sub.add_parser("policy-list")
+    apl.set_defaults(fn=cmd_acl_policy_list)
+    atc = acl_sub.add_parser("token-create")
+    atc.add_argument("-name", default="")
+    atc.add_argument("-type", default="client")
+    atc.add_argument("-policy", action="append")
+    atc.set_defaults(fn=cmd_acl_token_create)
+    atl = acl_sub.add_parser("token-list")
+    atl.set_defaults(fn=cmd_acl_token_list)
 
     return p
 

@@ -67,6 +67,8 @@ class ServerConfig:
     job_gc_threshold_s: float = 4 * 3600.0
     node_gc_threshold_s: float = 24 * 3600.0
     deployment_gc_threshold_s: float = 3600.0
+    # ACL subsystem (nomad/config.go ACLEnabled)
+    acl_enabled: bool = False
 
 
 class Server:
@@ -90,6 +92,7 @@ class Server:
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._hb_lock = threading.Lock()
         self._leader = False
+        self._acl_cache: Dict = {}      # (policies, index) -> compiled ACL
 
         # restore persisted state AFTER all subsystems exist: WAL replay
         # drives the same FSM appliers (broker/blocked are disabled until
@@ -360,6 +363,19 @@ class Server:
 
     def _apply_scheduler_config(self, index: int, p: dict) -> None:
         self.store.set_scheduler_config(index, p["config"])
+
+    # ACL appliers (fsm.go applyACL*; nomad/acl_endpoint.go)
+    def _apply_acl_policy_upsert(self, index: int, p: dict) -> None:
+        self.store.upsert_acl_policies(index, p["policies"])
+
+    def _apply_acl_policy_delete(self, index: int, p: dict) -> None:
+        self.store.delete_acl_policies(index, p["names"])
+
+    def _apply_acl_token_upsert(self, index: int, p: dict) -> None:
+        self.store.upsert_acl_tokens(index, p["tokens"])
+
+    def _apply_acl_token_delete(self, index: int, p: dict) -> None:
+        self.store.delete_acl_tokens(index, p["accessor_ids"])
 
     def _apply_periodic_launch(self, index: int, p: dict) -> None:
         self.store.upsert_periodic_launch(index, p["namespace"], p["job_id"],
@@ -688,6 +704,73 @@ class Server:
                     job_id=job.id, node_id=node_id,
                     status=EVAL_STATUS_PENDING))
         return evals
+
+    # -- ACL (nomad/acl_endpoint.go; acl/acl.go engine) ----------------
+    def bootstrap_acl(self):
+        """One-time management-token mint (acl_endpoint.go Bootstrap).
+        Raises if the cluster already has tokens."""
+        from ..acl import new_token
+        if self.store.acl_tokens():
+            raise PermissionError("ACL bootstrap already done")
+        token = new_token(name="Bootstrap Token", type_="management",
+                          global_=True)
+        self.raft_apply("acl_token_upsert", dict(tokens=[token]))
+        return token
+
+    def upsert_acl_policies(self, policies) -> int:
+        from ..acl import parse_policy_rules
+        for p in policies:
+            if not p.name:
+                raise ValueError("policy name required")
+            parse_policy_rules(p.rules)        # validate
+        return self.raft_apply("acl_policy_upsert", dict(policies=policies))
+
+    def delete_acl_policies(self, names) -> int:
+        return self.raft_apply("acl_policy_delete", dict(names=names))
+
+    def create_acl_token(self, name: str = "", type_: str = "client",
+                         policies=None, global_: bool = False):
+        from ..acl import new_token
+        if type_ not in ("client", "management"):
+            raise ValueError(f"invalid token type {type_!r}")
+        if type_ == "client" and not policies:
+            raise ValueError("client token requires policies")
+        token = new_token(name=name, type_=type_, policies=policies,
+                          global_=global_)
+        self.raft_apply("acl_token_upsert", dict(tokens=[token]))
+        return token
+
+    def delete_acl_tokens(self, accessor_ids) -> int:
+        return self.raft_apply("acl_token_delete",
+                               dict(accessor_ids=accessor_ids))
+
+    def resolve_token(self, secret_id):
+        """secret -> compiled ACL (nomad/acl.go ResolveToken). With ACLs
+        disabled everything is management; with no token the anonymous
+        deny-all ACL applies; unknown secrets are rejected."""
+        from ..acl import ACL_MANAGEMENT, compile_acl
+        from ..acl.acl import ACL_DENY_ALL
+        if not self.config.acl_enabled:
+            return ACL_MANAGEMENT
+        if not secret_id:
+            return ACL_DENY_ALL
+        token = self.store.acl_token_by_secret(secret_id)
+        if token is None:
+            raise PermissionError("ACL token not found")
+        if token.type == "management":
+            return ACL_MANAGEMENT
+        key = (tuple(sorted(token.policies)),
+               self.store._root.indexes.get("acl_policies") or 0)
+        cached = self._acl_cache.get(key)
+        if cached is not None:
+            return cached
+        policies = [p for name in token.policies
+                    if (p := self.store.acl_policy(name)) is not None]
+        acl = compile_acl(policies)
+        if len(self._acl_cache) > 256:
+            self._acl_cache.clear()
+        self._acl_cache[key] = acl
+        return acl
 
     # -- heartbeats (nomad/heartbeat.go) -------------------------------
     def reset_heartbeat_timer(self, node_id: str) -> None:

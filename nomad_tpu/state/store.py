@@ -97,6 +97,7 @@ class _Root:
 TABLES = (
     "nodes", "jobs", "job_versions", "evals", "allocs", "deployments",
     "job_summaries", "scheduler_config", "periodic_launches",
+    "acl_policies", "acl_tokens",
     # secondary indexes
     "allocs_by_node", "allocs_by_job", "allocs_by_eval", "evals_by_job",
     "deployments_by_job",
@@ -1028,6 +1029,84 @@ class StateStore(StateSnapshot):
             root = root.with_index("scheduler_config", index)
             self._publish(root)
 
+    # -- ACL (state_store.go ACLPolicy/ACLToken tables) ----------------
+    def upsert_acl_policies(self, index: int, policies: List) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("acl_policies")
+            for p in policies:
+                existing = t.get(p.name)
+                p.create_index = existing.create_index if existing else index
+                p.modify_index = index
+                t = t.set(p.name, p)
+            root = root.with_table("acl_policies", t) \
+                       .with_index("acl_policies", index)
+            self._publish(root)
+
+    def delete_acl_policies(self, index: int, names: List[str]) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("acl_policies")
+            for name in names:
+                t = t.delete(name)
+            root = root.with_table("acl_policies", t) \
+                       .with_index("acl_policies", index)
+            self._publish(root)
+
+    def acl_policy(self, name: str):
+        return self._root.table("acl_policies").get(name)
+
+    def acl_policies(self) -> List:
+        return sorted(self._root.table("acl_policies").values(),
+                      key=lambda p: p.name)
+
+    def upsert_acl_tokens(self, index: int, tokens: List) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("acl_tokens")
+            for tok in tokens:
+                existing = t.get(tok.accessor_id)
+                tok.create_index = existing.create_index if existing \
+                    else index
+                tok.modify_index = index
+                t = t.set(tok.accessor_id, tok)
+                root = root.with_table("acl_tokens", t)
+                root = self._index_add(root, "acl_tokens_by_secret",
+                                       tok.secret_id, tok.accessor_id)
+            root = root.with_table("acl_tokens", t) \
+                       .with_index("acl_tokens", index)
+            self._publish(root)
+
+    def delete_acl_tokens(self, index: int, accessor_ids: List[str]) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("acl_tokens")
+            for aid in accessor_ids:
+                tok = t.get(aid)
+                if tok is None:
+                    continue
+                t = t.delete(aid)
+                root = self._index_del(root, "acl_tokens_by_secret",
+                                       tok.secret_id, aid)
+            root = root.with_table("acl_tokens", t) \
+                       .with_index("acl_tokens", index)
+            self._publish(root)
+
+    def acl_token_by_accessor(self, accessor_id: str):
+        return self._root.table("acl_tokens").get(accessor_id)
+
+    def acl_token_by_secret(self, secret_id: str):
+        members = self._root.table("acl_tokens_by_secret").get(secret_id)
+        if not members:
+            return None
+        for aid in members.keys():
+            return self._root.table("acl_tokens").get(aid)
+        return None
+
+    def acl_tokens(self) -> List:
+        return sorted(self._root.table("acl_tokens").values(),
+                      key=lambda t: t.accessor_id)
+
     # -- checkpoint / restore (fsm.go Snapshot:1360 / Restore:1374) ----
     def dump(self) -> dict:
         """Wire-encode the full database for a snapshot file."""
@@ -1055,6 +1134,10 @@ class StateStore(StateSnapshot):
         plain["scaling_events"] = [
             {"key": list(k), "events": v}
             for k, v in root.table("scaling_events").items()]
+        plain["acl_policies"] = [to_wire(p) for p in
+                                 root.table("acl_policies").values()]
+        plain["acl_tokens"] = [to_wire(t) for t in
+                               root.table("acl_tokens").values()]
         return out
 
     def restore(self, data: dict) -> None:
@@ -1144,6 +1227,21 @@ class StateStore(StateSnapshot):
                     "scheduler_config",
                     root.table("scheduler_config").set(
                         "config", from_wire(SchedulerConfiguration, cfg)))
+
+            from ..acl import AclPolicy, AclToken
+            t = root.table("acl_policies")
+            for w in data["tables"].get("acl_policies", []):
+                p = from_wire(AclPolicy, w)
+                t = t.set(p.name, p)
+            root = root.with_table("acl_policies", t)
+            t = root.table("acl_tokens")
+            for w in data["tables"].get("acl_tokens", []):
+                tok = from_wire(AclToken, w)
+                t = t.set(tok.accessor_id, tok)
+                root = root.with_table("acl_tokens", t)
+                root = self._index_add(root, "acl_tokens_by_secret",
+                                       tok.secret_id, tok.accessor_id)
+                t = root.table("acl_tokens")
 
             for table, index in data.get("indexes", {}).items():
                 root = root.with_index(table, index)
