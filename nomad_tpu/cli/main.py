@@ -68,8 +68,12 @@ def cmd_agent(args) -> int:
             print("    WARNING: TPU backend unavailable; scheduling on CPU")
         server = Server(ServerConfig(num_schedulers=args.num_schedulers,
                                      acl_enabled=args.acl_enabled))
-        server.start()
         rpc = RpcServer(server, port=args.rpc_port)
+        if args.server_peers:
+            peers = [p.strip() for p in args.server_peers.split(",")
+                     if p.strip()]
+            server.attach_raft(rpc, peers)
+        server.start()
         rpc.start()
         api = HTTPApiServer(server, port=args.http_port)
         api.start()
@@ -620,6 +624,9 @@ def build_parser() -> argparse.ArgumentParser:
     agent.add_argument("-rpc-port", dest="rpc_port", type=int, default=4647)
     agent.add_argument("-acl-enabled", dest="acl_enabled",
                        action="store_true")
+    agent.add_argument("-server-peers", dest="server_peers", default="",
+                       help="comma-separated rpc addrs of ALL servers "
+                            "(incl. this one) to form a raft cluster")
     agent.add_argument("-clients", type=int, default=1)
     agent.add_argument("-num-schedulers", dest="num_schedulers", type=int,
                        default=2)

@@ -77,12 +77,18 @@ def build_method_table(server) -> Dict[str, Any]:
     }
 
 
+# client-facing writes that must run on the leader (rpc.go forward())
+WRITE_METHODS = frozenset({"Node.Register", "Node.UpdateStatus",
+                           "Node.Heartbeat", "Node.UpdateAlloc"})
+
+
 class RpcServer:
     """Threaded TCP RPC listener bound to a Server instance."""
 
     def __init__(self, server, host: str = "127.0.0.1", port: int = 0):
         self.server = server
         self.methods = build_method_table(server)
+        self.raft = None                   # set by Server.attach_raft
         rpc = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -141,7 +147,11 @@ class RpcServer:
             err = f"unknown rpc method: {method}"
         else:
             try:
-                result = fn(args or {})
+                if self.raft is not None and method in WRITE_METHODS \
+                        and not self.raft.is_leader():
+                    result = self.raft.forward_rpc(method, args or {})
+                else:
+                    result = fn(args or {})
             except Exception as e:          # surfaced to the caller
                 LOG.exception("rpc %s failed", method)
                 err = f"{type(e).__name__}: {e}"

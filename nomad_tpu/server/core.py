@@ -93,6 +93,8 @@ class Server:
         self._hb_lock = threading.Lock()
         self._leader = False
         self._acl_cache: Dict = {}      # (policies, index) -> compiled ACL
+        self.raft = None                # multi-server consensus (raft.py)
+        self._in_replicated_apply = False
 
         # restore persisted state AFTER all subsystems exist: WAL replay
         # drives the same FSM appliers (broker/blocked are disabled until
@@ -121,8 +123,20 @@ class Server:
                                   index, msg_type)
 
     # -- lifecycle -----------------------------------------------------
+    def attach_raft(self, rpc_server, peers, self_addr: str = "") -> None:
+        """Join a multi-server cluster: the raft node drives leadership
+        (nomad/server.go setupRaft + leader.go monitorLeadership)."""
+        from .raft import RaftNode
+        self.raft = RaftNode(self, self_addr or rpc_server.addr,
+                             list(peers), data_dir=self.config.data_dir)
+        rpc_server.methods.update(self.raft.rpc_methods())
+        rpc_server.raft = self.raft
+
     def start(self) -> None:
-        self.establish_leadership()
+        if self.raft is None:
+            self.establish_leadership()
+        else:
+            self.raft.start()
         self.plan_applier.start()
         for i in range(self.config.num_schedulers):
             w = Worker(self, list(self.config.enabled_schedulers)
@@ -136,7 +150,60 @@ class Server:
                                            daemon=True, name="gc-ticker")
         self._gc_ticker.start()
 
+    def revoke_leadership(self) -> None:
+        """leader.go revokeLeadership:1038 — disable leader-only
+        services; workers stay up, parked on the disabled broker."""
+        self._leader = False
+        self.eval_broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.plan_queue.set_enabled(False)
+        self.periodic.set_enabled(False)
+        self.deployments_watcher.set_enabled(False)
+        self.node_drainer.set_enabled(False)
+        with self._hb_lock:
+            for t in self._heartbeat_timers.values():
+                t.cancel()
+            self._heartbeat_timers.clear()
+
+    def apply_replicated(self, index: int, msg_type: str,
+                         enc_payload: dict) -> None:
+        """Apply a replicated log entry on a follower. Nested
+        raft_apply calls from FSM side effects are suppressed — the
+        leader ran the same appliers and its nested writes arrive as
+        their own log entries."""
+        from .persistence import decode_payload
+        payload = decode_payload(msg_type, enc_payload)
+        with self._raft_l:
+            self._in_replicated_apply = True
+            try:
+                self._raft_index = index
+                if self.persistence is not None:
+                    self.persistence.record(index, msg_type, payload)
+                fn = getattr(self, f"_apply_{msg_type}")
+                fn(index, payload)
+                self.time_table.witness(index)
+                if self.persistence is not None:
+                    self.persistence.maybe_snapshot(self.store)
+            finally:
+                self._in_replicated_apply = False
+            try:
+                self.events.publish(events_from_apply(msg_type, payload,
+                                                      index))
+            except Exception:
+                LOG.exception("event publish for %s", msg_type)
+
+    def install_snapshot(self, data: dict) -> None:
+        """Full-state reseed from the leader (fsm.go Restore:1374)."""
+        with self._raft_l:
+            self.store.restore(data)
+            self._raft_index = max(self._raft_index,
+                                   self.store.latest_index())
+            if self.persistence is not None:
+                self.persistence.snapshot(self.store)
+
     def shutdown(self) -> None:
+        if self.raft is not None:
+            self.raft.stop()
         self._leader = False
         self.deployments_watcher.set_enabled(False)
         self.node_drainer.set_enabled(False)
@@ -228,17 +295,28 @@ class Server:
             elif ev.should_block():
                 self.blocked_evals.block(ev)
 
-    # -- raft shim -----------------------------------------------------
+    # -- raft apply ----------------------------------------------------
     def raft_apply(self, msg_type: str, payload: dict) -> int:
         """Serialized FSM apply (fsm.go Apply:210-300). Returns the index.
         The whole record+apply+snapshot sequence runs under the raft lock
         so WAL order == apply order and a snapshot can never truncate an
-        entry whose effects it doesn't contain."""
+        entry whose effects it doesn't contain. In a multi-server
+        cluster, non-leaders forward the write to the leader (rpc.go
+        forward()); a leader additionally appends the entry to the
+        replication log."""
+        if self.raft is not None and not self.raft.is_leader():
+            if self._in_replicated_apply:
+                # FSM side effect during a replicated apply: the
+                # leader's equivalent entry arrives via the log
+                return self._raft_index
+            return self.raft.forward_apply(msg_type, payload)
         with self._raft_l:
             self._raft_index += 1
             index = self._raft_index
             if self.persistence is not None:
                 self.persistence.record(index, msg_type, payload)
+            if self.raft is not None:
+                self.raft.record_entry(index, msg_type, payload)
             fn = getattr(self, f"_apply_{msg_type}")
             fn(index, payload)
             self.time_table.witness(index)
@@ -774,6 +852,8 @@ class Server:
 
     # -- heartbeats (nomad/heartbeat.go) -------------------------------
     def reset_heartbeat_timer(self, node_id: str) -> None:
+        if self.raft is not None and not self._leader:
+            return              # TTL timers are leader-only (heartbeat.go)
         with self._hb_lock:
             existing = self._heartbeat_timers.pop(node_id, None)
             if existing is not None:

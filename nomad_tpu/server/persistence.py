@@ -57,6 +57,21 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
 }
 
 
+def _register_acl_schemas() -> None:
+    # deferred: nomad_tpu.acl imports jobspec which imports models —
+    # registering lazily avoids a cycle at module import time
+    from ..acl import AclPolicy, AclToken
+    SCHEMAS.update({
+        "acl_policy_upsert": {"policies": [AclPolicy]},
+        "acl_policy_delete": {},
+        "acl_token_upsert": {"tokens": [AclToken]},
+        "acl_token_delete": {},
+    })
+
+
+_register_acl_schemas()
+
+
 def encode_payload(msg_type: str, payload: dict) -> dict:
     out = {}
     for k, v in payload.items():

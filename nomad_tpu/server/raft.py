@@ -1,0 +1,432 @@
+"""Multi-server consensus: leader election + log replication + snapshot
+install over the wire RPC layer.
+
+Reference shape: nomad/server.go setupRaft:1214 (hashicorp/raft),
+nomad/leader.go monitorLeadership:54 (establish/revoke hooks),
+nomad/fsm.go Snapshot/Restore:1360-1374, rpc.go forward() (writes go to
+the leader). SURVEY §7.2 step 7 blesses a "single-leader Raft-lite":
+
+  - terms + randomized election timeouts + majority votes with the
+    log-up-to-date check (Raft §5.2/§5.4.1)
+  - the leader assigns log indexes and applies entries to its FSM
+    immediately (the pre-existing single-node raft_apply semantics are
+    preserved bit-for-bit, including nested applies); followers receive
+    entries in order over AppendEntries and apply them with nested
+    side-effect applies suppressed (the leader's equivalents arrive as
+    their own entries)
+  - commit acknowledgement is therefore leader-local with asynchronous
+    quorum replication (primary/backup): a leader failing before its
+    tail replicates can lose that tail on failover — weaker than full
+    Raft commit, stated here explicitly
+  - a follower whose applied state diverges from the new leader's log
+    (e.g. a deposed leader with an unreplicated applied tail) cannot
+    truncate applied state; it is reseeded with a full snapshot install
+    (store.dump()/restore()), the FSM-snapshot analog
+  - membership is static configuration (no serf/autopilot)
+
+Write forwarding: a non-leader server forwards (msg_type, payload)
+through Raft.Forward; the client-facing RPC layer additionally forwards
+whole write RPCs to the leader (rpc.go forward()).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .persistence import decode_payload, encode_payload
+
+LOG = logging.getLogger("nomad_tpu.raft")
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+HEARTBEAT_S = 0.1
+ELECTION_MIN_S = 0.5
+ELECTION_MAX_S = 1.0
+MAX_BATCH = 256
+
+
+class RaftNode:
+    def __init__(self, server, self_addr: str, peers: List[str],
+                 data_dir: str = ""):
+        self.server = server
+        self.self_addr = self_addr
+        self.peers = [p for p in peers if p != self_addr]
+        self.cluster_size = len(self.peers) + 1
+        self.data_dir = data_dir
+
+        self._lock = threading.RLock()
+        self.role = FOLLOWER
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.leader_addr: Optional[str] = None
+        # entries AFTER the compaction base: (index, term, type, enc)
+        self.log: List[Tuple[int, int, str, dict]] = []
+        self.base_index = server._raft_index
+        self.base_term = 0
+        self.needs_snapshot = False
+
+        self._last_heartbeat = time.monotonic()
+        self._election_deadline = self._new_deadline()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # per-peer replication state (leader)
+        self._next_index: Dict[str, int] = {}
+        self._clients: Dict[str, object] = {}
+        self._load_vote_state()
+
+    # -- persistence of (term, votedFor) — Raft §5.1 -------------------
+    def _vote_path(self) -> str:
+        return os.path.join(self.data_dir, "raft_vote.json") \
+            if self.data_dir else ""
+
+    def _load_vote_state(self) -> None:
+        path = self._vote_path()
+        if not path or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                d = json.load(f)
+            self.term = int(d.get("term", 0))
+            self.voted_for = d.get("voted_for")
+        except (OSError, json.JSONDecodeError):
+            pass
+
+    def _save_vote_state(self) -> None:
+        path = self._vote_path()
+        if not path:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
+        os.replace(tmp, path)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        t = threading.Thread(target=self._ticker, daemon=True,
+                             name="raft-ticker")
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for c in self._clients.values():
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    # -- helpers -------------------------------------------------------
+    def _new_deadline(self) -> float:
+        return time.monotonic() + random.uniform(ELECTION_MIN_S,
+                                                 ELECTION_MAX_S)
+
+    def is_leader(self) -> bool:
+        return self.role == LEADER
+
+    def last_log(self) -> Tuple[int, int]:
+        with self._lock:
+            if self.log:
+                e = self.log[-1]
+                return e[0], e[1]
+            return self.base_index, self.base_term
+
+    def _client(self, addr: str):
+        from ..rpc.client import RpcClient
+        c = self._clients.get(addr)
+        if c is None:
+            c = RpcClient(addr, dial_timeout_s=1.0)
+            self._clients[addr] = c
+        return c
+
+    # -- the leader append hook (called from Server.raft_apply) --------
+    def record_entry(self, index: int, msg_type: str,
+                     payload: dict) -> None:
+        with self._lock:
+            self.log.append((index, self.term, msg_type,
+                             encode_payload(msg_type, payload)))
+
+    # -- follower write forwarding ------------------------------------
+    def forward_apply(self, msg_type: str, payload: dict,
+                      timeout_s: float = 10.0) -> int:
+        leader = self.leader_addr
+        if not leader:
+            raise RuntimeError("no cluster leader")
+        res = self._client(leader).call(
+            "Raft.Forward",
+            {"msg_type": msg_type,
+             "payload": encode_payload(msg_type, payload)},
+            timeout_s=timeout_s)
+        return int(res["index"])
+
+    def forward_rpc(self, method: str, args: dict, timeout_s: float = 30.0):
+        leader = self.leader_addr
+        if not leader:
+            raise RuntimeError("no cluster leader")
+        return self._client(leader).call(method, args, timeout_s=timeout_s)
+
+    # -- role transitions ----------------------------------------------
+    def _become_follower(self, term: int, leader: Optional[str]) -> None:
+        was_leader = self.role == LEADER
+        self.role = FOLLOWER
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._save_vote_state()
+        if leader:
+            self.leader_addr = leader
+        self._election_deadline = self._new_deadline()
+        if was_leader:
+            LOG.warning("stepping down (term %d)", self.term)
+            self.server.revoke_leadership()
+
+    def _become_leader(self) -> None:
+        self.role = LEADER
+        self.leader_addr = self.self_addr
+        last, _ = self.last_log()
+        self._next_index = {p: last + 1 for p in self.peers}
+        LOG.warning("elected leader (term %d)", self.term)
+        self.server.establish_leadership()
+
+    # -- ticker: elections + leader heartbeats -------------------------
+    def _ticker(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(HEARTBEAT_S / 2)
+            with self._lock:
+                role = self.role
+            if role == LEADER:
+                self._replicate_all()
+            elif time.monotonic() > self._election_deadline:
+                self._run_election()
+
+    def _run_election(self) -> None:
+        with self._lock:
+            self.role = CANDIDATE
+            self.term += 1
+            self.voted_for = self.self_addr
+            self._save_vote_state()
+            term = self.term
+            self._election_deadline = self._new_deadline()
+        last_index, last_term = self.last_log()
+        votes = 1
+        for peer in self.peers:
+            try:
+                res = self._client(peer).call(
+                    "Raft.RequestVote",
+                    {"term": term, "candidate": self.self_addr,
+                     "last_log_index": last_index,
+                     "last_log_term": last_term},
+                    timeout_s=0.5)
+            except Exception:
+                continue
+            with self._lock:
+                if res["term"] > self.term:
+                    self._become_follower(res["term"], None)
+                    return
+            if res.get("granted"):
+                votes += 1
+        with self._lock:
+            if self.role == CANDIDATE and self.term == term and \
+                    votes * 2 > self.cluster_size:
+                self._become_leader()
+
+    # -- leader replication -------------------------------------------
+    def _replicate_all(self) -> None:
+        for peer in self.peers:
+            try:
+                self._replicate_peer(peer)
+            except Exception as e:
+                LOG.debug("replicate to %s failed: %s", peer, e)
+
+    def _replicate_peer(self, peer: str) -> None:
+        with self._lock:
+            if self.role != LEADER:
+                return
+            term = self.term
+            next_idx = self._next_index.get(peer, self.base_index + 1)
+            if next_idx <= self.base_index:
+                self._send_snapshot(peer, term)
+                return
+            offset = next_idx - self.base_index - 1
+            entries = self.log[offset:offset + MAX_BATCH]
+            if offset > len(self.log):
+                entries = []
+            if offset == 0:
+                prev_index, prev_term = self.base_index, self.base_term
+            elif offset - 1 < len(self.log):
+                e = self.log[offset - 1]
+                prev_index, prev_term = e[0], e[1]
+            else:
+                last = self.log[-1] if self.log else None
+                prev_index = last[0] if last else self.base_index
+                prev_term = last[1] if last else self.base_term
+            commit = self.log[-1][0] if self.log else self.base_index
+        res = self._client(peer).call(
+            "Raft.AppendEntries",
+            {"term": term, "leader": self.self_addr,
+             "prev_index": prev_index, "prev_term": prev_term,
+             "entries": [[e[0], e[1], e[2], e[3]] for e in entries],
+             "leader_commit": commit},
+            timeout_s=5.0)
+        with self._lock:
+            if res["term"] > self.term:
+                self._become_follower(res["term"], None)
+                return
+            if res.get("needs_snapshot"):
+                self._send_snapshot(peer, term)
+            elif res.get("success"):
+                if entries:
+                    self._next_index[peer] = entries[-1][0] + 1
+            else:
+                self._next_index[peer] = max(
+                    self.base_index + 1,
+                    min(self._next_index.get(peer, 1) - 1,
+                        int(res.get("hint", 0)) + 1))
+
+    def _send_snapshot(self, peer: str, term: int) -> None:
+        data = self.server.store.dump()
+        last_index, last_term = self.last_log()
+        res = self._client(peer).call(
+            "Raft.InstallSnapshot",
+            {"term": term, "leader": self.self_addr,
+             "snapshot": data, "base_index": last_index,
+             "base_term": last_term},
+            timeout_s=30.0)
+        with self._lock:
+            if res["term"] > self.term:
+                self._become_follower(res["term"], None)
+                return
+            self._next_index[peer] = last_index + 1
+
+    # -- compaction ----------------------------------------------------
+    def compact(self, keep: int = 4096) -> None:
+        with self._lock:
+            if len(self.log) <= keep:
+                return
+            drop = len(self.log) - keep
+            e = self.log[drop - 1]
+            self.base_index, self.base_term = e[0], e[1]
+            self.log = self.log[drop:]
+
+    # -- RPC handlers --------------------------------------------------
+    def rpc_methods(self) -> Dict:
+        return {
+            "Raft.RequestVote": self._handle_request_vote,
+            "Raft.AppendEntries": self._handle_append_entries,
+            "Raft.InstallSnapshot": self._handle_install_snapshot,
+            "Raft.Forward": self._handle_forward,
+            "Raft.Status": self._handle_status,
+        }
+
+    def _handle_status(self, _args) -> dict:
+        with self._lock:
+            last_index, last_term = self.last_log()
+            return {"role": self.role, "term": self.term,
+                    "leader": self.leader_addr,
+                    "last_log_index": last_index,
+                    "last_log_term": last_term}
+
+    def _handle_request_vote(self, args: dict) -> dict:
+        term = int(args["term"])
+        candidate = args["candidate"]
+        with self._lock:
+            if term > self.term:
+                self._become_follower(term, None)
+            if term < self.term:
+                return {"term": self.term, "granted": False}
+            last_index, last_term = self.last_log()
+            up_to_date = (args["last_log_term"], args["last_log_index"]) \
+                >= (last_term, last_index)
+            if up_to_date and self.voted_for in (None, candidate):
+                self.voted_for = candidate
+                self._save_vote_state()
+                self._election_deadline = self._new_deadline()
+                return {"term": self.term, "granted": True}
+            return {"term": self.term, "granted": False}
+
+    def _handle_append_entries(self, args: dict) -> dict:
+        term = int(args["term"])
+        with self._lock:
+            if term < self.term:
+                return {"term": self.term, "success": False}
+            if term > self.term or self.role != FOLLOWER:
+                self._become_follower(term, args["leader"])
+            self.leader_addr = args["leader"]
+            self._election_deadline = self._new_deadline()
+            if self.needs_snapshot:
+                return {"term": self.term, "success": False,
+                        "needs_snapshot": True}
+
+            prev_index = int(args["prev_index"])
+            prev_term = int(args["prev_term"])
+            last_index, _ = self.last_log()
+            applied = self.server._raft_index
+            # consistency check at prev_index
+            if prev_index > last_index:
+                return {"term": self.term, "success": False,
+                        "hint": last_index}
+            if prev_index > self.base_index:
+                e = self.log[prev_index - self.base_index - 1]
+                if e[1] != prev_term:
+                    # conflicting suffix: applied state cannot be
+                    # unwound -> full reseed
+                    if prev_index <= applied:
+                        self.needs_snapshot = True
+                        return {"term": self.term, "success": False,
+                                "needs_snapshot": True}
+                    del self.log[prev_index - self.base_index - 1:]
+                    return {"term": self.term, "success": False,
+                            "hint": prev_index - 1}
+            elif prev_index < self.base_index:
+                return {"term": self.term, "success": False,
+                        "needs_snapshot": True}
+
+            to_apply = []
+            for idx, eterm, mtype, enc in args.get("entries", []):
+                idx = int(idx)
+                pos = idx - self.base_index - 1
+                if pos < len(self.log):
+                    if self.log[pos][1] == eterm:
+                        continue                  # already have it
+                    if idx <= applied:
+                        self.needs_snapshot = True
+                        return {"term": self.term, "success": False,
+                                "needs_snapshot": True}
+                    del self.log[pos:]
+                self.log.append((idx, int(eterm), mtype, enc))
+                to_apply.append((idx, mtype, enc))
+        # apply outside the raft lock (FSM has its own serialization)
+        for idx, mtype, enc in to_apply:
+            if idx > self.server._raft_index:
+                self.server.apply_replicated(idx, mtype, enc)
+        return {"term": self.term, "success": True}
+
+    def _handle_install_snapshot(self, args: dict) -> dict:
+        term = int(args["term"])
+        with self._lock:
+            if term < self.term:
+                return {"term": self.term}
+            self._become_follower(term, args["leader"])
+            self._election_deadline = self._new_deadline()
+        self.server.install_snapshot(args["snapshot"])
+        with self._lock:
+            self.base_index = int(args["base_index"])
+            self.base_term = int(args["base_term"])
+            self.log = []
+            self.needs_snapshot = False
+        LOG.warning("installed snapshot at index %d", self.base_index)
+        return {"term": self.term}
+
+    def _handle_forward(self, args: dict) -> dict:
+        if not self.is_leader():
+            raise RuntimeError("not the leader")
+        payload = decode_payload(args["msg_type"], args["payload"])
+        index = self.server.raft_apply(args["msg_type"], payload)
+        return {"index": index}

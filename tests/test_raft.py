@@ -1,0 +1,154 @@
+"""Multi-server consensus: election, replication, write forwarding,
+failover, snapshot reseed (reference: nomad/server.go setupRaft,
+leader.go, fsm.go Snapshot/Restore; raft-lite semantics documented in
+server/raft.py).
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.rpc import RpcServer
+from nomad_tpu.server import Server, ServerConfig
+
+
+def _wait_for(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _make_cluster(n=3, num_schedulers=1):
+    servers = []
+    rpcs = []
+    for _ in range(n):
+        s = Server(ServerConfig(num_schedulers=num_schedulers,
+                                heartbeat_ttl_s=30.0))
+        r = RpcServer(s, port=0)
+        servers.append(s)
+        rpcs.append(r)
+    addrs = [r.addr for r in rpcs]
+    for s, r in zip(servers, rpcs):
+        s.attach_raft(r, addrs)
+        r.start()
+        s.start()
+    return servers, rpcs, addrs
+
+
+def _leaders(servers):
+    return [s for s in servers if s.raft.is_leader()]
+
+
+@pytest.fixture
+def cluster():
+    servers, rpcs, addrs = _make_cluster()
+    yield servers, rpcs, addrs
+    for s, r in zip(servers, rpcs):
+        try:
+            r.shutdown()
+            s.shutdown()
+        except Exception:
+            pass
+
+
+@pytest.mark.slow
+def test_single_leader_elected(cluster):
+    servers, _rpcs, _addrs = cluster
+    assert _wait_for(lambda: len(_leaders(servers)) == 1, timeout=10), \
+        [s.raft.role for s in servers]
+    leader = _leaders(servers)[0]
+    # followers agree on the leader address
+    assert _wait_for(lambda: all(
+        s.raft.leader_addr == leader.raft.self_addr for s in servers))
+
+
+@pytest.mark.slow
+def test_replication_and_follower_forwarding(cluster):
+    servers, _rpcs, _addrs = cluster
+    assert _wait_for(lambda: len(_leaders(servers)) == 1, timeout=10)
+    leader = _leaders(servers)[0]
+    followers = [s for s in servers if s is not leader]
+
+    # write through the leader: replicates everywhere
+    node = mock.node()
+    leader.register_node(node)
+    assert _wait_for(lambda: all(
+        s.store.node_by_id(node.id) is not None for s in servers)), \
+        "node did not replicate"
+
+    # write through a FOLLOWER: forwarded to the leader, then replicated
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    followers[0].register_job(job)
+    assert _wait_for(lambda: all(
+        s.store.job_by_id("default", job.id) is not None
+        for s in servers)), "forwarded write did not replicate"
+
+    # the leader scheduled it (broker enabled only there)
+    assert _wait_for(lambda: len(
+        leader.store.allocs_by_job("default", job.id)) == 1)
+    assert _wait_for(lambda: all(len(
+        s.store.allocs_by_job("default", job.id)) == 1 for s in servers)), \
+        "allocs did not replicate"
+
+
+@pytest.mark.slow
+def test_failover_elects_new_leader_and_serves_writes(cluster):
+    servers, rpcs, _addrs = cluster
+    assert _wait_for(lambda: len(_leaders(servers)) == 1, timeout=10)
+    leader = _leaders(servers)[0]
+    li = servers.index(leader)
+
+    # seed state pre-failover
+    node = mock.node()
+    leader.register_node(node)
+    assert _wait_for(lambda: all(
+        s.store.node_by_id(node.id) is not None for s in servers))
+
+    rpcs[li].shutdown()
+    leader.shutdown()
+    rest = [s for s in servers if s is not leader]
+    assert _wait_for(lambda: len(_leaders(rest)) == 1, timeout=10), \
+        [s.raft.role for s in rest]
+    new_leader = _leaders(rest)[0]
+    assert new_leader is not leader
+
+    # pre-failover state survived and new writes land
+    assert new_leader.store.node_by_id(node.id) is not None
+    job = mock.batch_job()
+    new_leader.register_job(job)
+    assert _wait_for(lambda: all(
+        s.store.job_by_id("default", job.id) is not None for s in rest))
+
+
+@pytest.mark.slow
+def test_snapshot_reseed_of_fresh_follower():
+    """A server joining with empty state catches up via snapshot
+    install when the leader's log has been compacted past its needs."""
+    servers, rpcs, addrs = _make_cluster(n=3)
+    try:
+        assert _wait_for(lambda: len(_leaders(servers)) == 1, timeout=10)
+        leader = _leaders(servers)[0]
+        for i in range(5):
+            node = mock.node()
+            node.name = f"n{i}"
+            leader.register_node(node)
+        # compact the leader's log to force snapshot path for laggards
+        leader.raft.compact(keep=0)
+        # wipe a follower's raft progress by simulating a fresh joiner:
+        follower = [s for s in servers if s is not leader][0]
+        follower.raft.needs_snapshot = True
+        assert _wait_for(
+            lambda: len(list(follower.store.nodes())) >= 5, timeout=10), \
+            len(list(follower.store.nodes()))
+    finally:
+        for s, r in zip(servers, rpcs):
+            try:
+                r.shutdown()
+                s.shutdown()
+            except Exception:
+                pass
