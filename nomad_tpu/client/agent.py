@@ -51,6 +51,9 @@ class ClientConfig:
     # (the TPU-native analog of devices/gpu/nvidia fingerprint)
     devices: tuple = ()
     fingerprint_accelerators: bool = False
+    # drivers to run behind the plugin PROCESS boundary
+    # (plugins/driver_client.py; go-plugin analog) instead of in-proc
+    plugin_drivers: tuple = ()
 
 
 def fingerprint_accelerator_devices():
@@ -307,8 +310,13 @@ class Client:
             from .state_db import ClientStateDB
             self.state_db = ClientStateDB(self.config.state_dir)
         self.node = self._fingerprint()
-        self.drivers = {name: DRIVER_CATALOG[name]()
-                        for name in self.config.drivers}
+        self.drivers = {}
+        for name in self.config.drivers:
+            if name in self.config.plugin_drivers:
+                from ..plugins import ExternalDriver
+                self.drivers[name] = ExternalDriver(name)
+            else:
+                self.drivers[name] = DRIVER_CATALOG[name]()
         self.runners: Dict[str, AllocRunner] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -436,6 +444,10 @@ class Client:
         close = getattr(self.transport, "close", None)
         if close is not None:
             close()
+        for d in self.drivers.values():
+            stop = getattr(d, "shutdown", None)
+            if stop is not None:
+                stop()
         if self.state_db is not None:
             self.state_db.close()
 

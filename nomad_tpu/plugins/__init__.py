@@ -1,0 +1,5 @@
+from .base import HANDSHAKE_COOKIE_KEY, HANDSHAKE_COOKIE_VALUE, serve_plugin
+from .driver_client import ExternalDriver
+
+__all__ = ["HANDSHAKE_COOKIE_KEY", "HANDSHAKE_COOKIE_VALUE",
+           "serve_plugin", "ExternalDriver"]

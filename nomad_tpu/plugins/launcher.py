@@ -1,0 +1,20 @@
+"""Plugin process entry: `python -m nomad_tpu.plugins.launcher <driver>`
+(the re-exec'd plugin binary pattern of go-plugin / `nomad logmon`)."""
+
+import sys
+
+from ..client.drivers import DRIVER_CATALOG
+from .base import serve_plugin
+
+
+def main() -> int:
+    if len(sys.argv) != 2 or sys.argv[1] not in DRIVER_CATALOG:
+        print(f"usage: launcher <{'|'.join(DRIVER_CATALOG)}>",
+              file=sys.stderr)
+        return 1
+    serve_plugin(DRIVER_CATALOG[sys.argv[1]]())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,11 +83,15 @@ WRITE_METHODS = frozenset({"Node.Register", "Node.UpdateStatus",
 
 
 class RpcServer:
-    """Threaded TCP RPC listener bound to a Server instance."""
+    """Threaded TCP RPC listener. Bound to a Server instance by
+    default; a custom method table makes it a generic RPC endpoint
+    (the plugin boundary reuses it, plugins/base.py)."""
 
-    def __init__(self, server, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, server=None, host: str = "127.0.0.1", port: int = 0,
+                 methods: Optional[Dict[str, Any]] = None):
         self.server = server
-        self.methods = build_method_table(server)
+        self.methods = methods if methods is not None \
+            else build_method_table(server)
         self.raft = None                   # set by Server.attach_raft
         rpc = self
 
