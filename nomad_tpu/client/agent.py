@@ -10,6 +10,7 @@ client/allocrunner (task fan-out, status aggregation), taskrunner
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -46,6 +47,9 @@ class ClientConfig:
     # persist here and a restarted client restores + re-attaches
     # (client/state/state_database.go)
     state_dir: Optional[str] = None
+    # base directory for per-alloc dir trees (client/allocdir);
+    # empty -> the system temp dir
+    alloc_dir: str = ""
     # device fingerprinting: statically declared device groups
     # (NodeDeviceResource) plus optional JAX accelerator autodetection
     # (the TPU-native analog of devices/gpu/nvidia fingerprint)
@@ -87,16 +91,46 @@ class TaskRunner:
     the initial start and resumes at the wait."""
 
     def __init__(self, alloc: Allocation, task, driver, on_update,
-                 attached: Optional[TaskHandle] = None):
+                 attached: Optional[TaskHandle] = None,
+                 node=None, alloc_dir=None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
         self.on_update = on_update
+        self.node = node
+        self.alloc_dir = alloc_dir
         self.state = TaskState(state=TASK_STATE_PENDING)
         self.handle: Optional[TaskHandle] = None
         self._attached = attached
         self._kill = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _prestart(self):
+        """Prestart hook pipeline (taskrunner hooks: allocdir env,
+        artifact fetch, template render) + driver config interpolation.
+        Returns (config, env) or raises HookError."""
+        from .hooks import fetch_artifacts, render_templates
+        from .taskenv import build_task_env, interpolate_config
+        alloc_path = task_path = secrets_path = ""
+        log_dir = None
+        if self.alloc_dir is not None:
+            alloc_path = self.alloc_dir.shared
+            task_path, local, secrets_path = \
+                self.alloc_dir.task_paths(self.task.name)
+            log_dir = self.alloc_dir.logs
+        env = build_task_env(self.alloc, self.task, self.node,
+                             alloc_dir=alloc_path, task_dir=task_path,
+                             secrets_dir=secrets_path)
+        if self.alloc_dir is not None:
+            fetch_artifacts(self.task, task_path, env, self.node)
+            render_templates(self.task, task_path, env, self.node)
+        config = interpolate_config(self.task.config, env, self.node)
+        lc = self.task.log_config
+        ctx = {"task_dir": task_path or None,
+               "log_dir": log_dir,
+               "log_max_files": lc.max_files if lc else 10,
+               "log_max_file_size_mb": lc.max_file_size_mb if lc else 10}
+        return config, env, ctx
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.run, daemon=True,
@@ -120,13 +154,17 @@ class TaskRunner:
                 started_at = self.handle.started_at or time.time()
             else:
                 try:
+                    from .hooks import HookError
+                    config, env, ctx = self._prestart()
                     self.handle = self.driver.start_task(
-                        self.task.name, self.task.config, self.task.env)
-                except RuntimeError as e:
+                        self.task.name, config, env, ctx=ctx)
+                except (RuntimeError, HookError) as e:
+                    kind = "Setup Failure" if not isinstance(
+                        e, RuntimeError) else "Driver Failure"
                     self.state = TaskState(
                         state=TASK_STATE_DEAD, failed=True,
                         finished_at=time.time(),
-                        events=[TaskEvent(type="Driver Failure",
+                        events=[TaskEvent(type=kind,
                                           message=str(e),
                                           failed=True,
                                           time=int(time.time()))])
@@ -176,16 +214,20 @@ class AllocRunner:
     clientAlloc:616 status aggregation)."""
 
     def __init__(self, alloc: Allocation, drivers: Dict[str, object],
-                 push_update, persist=None):
+                 push_update, persist=None, node=None,
+                 alloc_dir_base: str = ""):
         self.alloc = alloc
         self.drivers = drivers
         self.push_update = push_update
         self.persist = persist            # (alloc_id, task, state, handle)
+        self.node = node
         self.task_runners: List[TaskRunner] = []
         self.client_status = ALLOC_CLIENT_PENDING
         self.deployment_status = alloc.deployment_status
         self._l = threading.Lock()
         self.destroyed = False
+        from .allocdir import AllocDir
+        self.alloc_dir = AllocDir(alloc_dir_base, alloc.id)
 
     def run(self, attached: Optional[Dict[str, TaskHandle]] = None) -> None:
         """Start (or, with `attached` handles from driver recovery,
@@ -196,6 +238,7 @@ class AllocRunner:
             self.client_status = ALLOC_CLIENT_FAILED
             self._push()
             return
+        self.alloc_dir.build([t.name for t in tg.tasks])
         for task in tg.tasks:
             driver = self.drivers.get(task.driver)
             if driver is None:
@@ -203,7 +246,8 @@ class AllocRunner:
                 self._push()
                 return
             tr = TaskRunner(self.alloc, task, driver, self._on_task_update,
-                            attached=(attached or {}).get(task.name))
+                            attached=(attached or {}).get(task.name),
+                            node=self.node, alloc_dir=self.alloc_dir)
             self.task_runners.append(tr)
         for tr in self.task_runners:
             tr.start()
@@ -259,6 +303,12 @@ class AllocRunner:
         self.destroyed = True
         for tr in self.task_runners:
             tr.kill()
+
+    def destroy(self) -> None:
+        """Release the alloc's directory tree (client GC)."""
+        if not self.destroyed:
+            self.stop()
+        self.alloc_dir.destroy()
 
     def _on_task_update(self) -> None:
         if self.persist is not None:
@@ -417,7 +467,9 @@ class Client:
                     LOG.info("re-attached task %s of alloc %s",
                              task_name, aid[:8])
             runner = AllocRunner(alloc, self.drivers, self._push_update,
-                                 persist=self._persist_task)
+                                 persist=self._persist_task,
+                                 node=self.node,
+                                 alloc_dir_base=self.config.alloc_dir)
             self.runners[aid] = runner
             runner.run(attached=attached)
 
@@ -489,7 +541,9 @@ class Client:
             if alloc.job is None:
                 continue
             runner = AllocRunner(alloc, self.drivers, self._push_update,
-                                 persist=self._persist_task)
+                                 persist=self._persist_task,
+                                 node=self.node,
+                                 alloc_dir_base=self.config.alloc_dir)
             self.runners[aid] = runner
             if self.state_db is not None:
                 self.state_db.put_alloc(alloc)
@@ -503,11 +557,14 @@ class Client:
                 if self.state_db is not None:
                     self.state_db.delete_alloc(aid)
                 if server_alloc is None:
+                    runner.destroy()
                     del self.runners[aid]
                 continue
             # prune finished runners whose final status the server has
             # acknowledged (client gc.go analog) so long-lived clients
-            # running many short batch jobs don't accumulate runners
+            # running many short batch jobs don't accumulate runners.
+            # The alloc DIR stays for log inspection until the server
+            # garbage-collects the alloc (the None branch above).
             if runner.client_status in ("complete", "failed") and \
                     server_alloc.client_status == runner.client_status:
                 if self.state_db is not None:

@@ -80,7 +80,8 @@ class MockDriver:
     def fingerprint(self) -> Dict[str, str]:
         return {"driver.mock_driver": "1"}
 
-    def start_task(self, task_name: str, config: dict, env: dict) -> TaskHandle:
+    def start_task(self, task_name: str, config: dict, env: dict,
+                   ctx: Optional[dict] = None) -> TaskHandle:
         if config.get("start_error"):
             raise RuntimeError(str(config["start_error"]))
         h = TaskHandle(task_name=task_name, driver=self.name, config=config,
@@ -140,19 +141,36 @@ class RawExecDriver:
     def fingerprint(self) -> Dict[str, str]:
         return {"driver.raw_exec": "1"}
 
-    def start_task(self, task_name: str, config: dict, env: dict) -> TaskHandle:
+    def start_task(self, task_name: str, config: dict, env: dict,
+                   ctx: Optional[dict] = None) -> TaskHandle:
         command = config.get("command")
         if not command:
             raise RuntimeError("missing command")
         args = [command] + list(config.get("args", []))
+        ctx = ctx or {}
+        cwd = ctx.get("task_dir") or None
+        # logmon: pump stdout/stderr into size-rotated files under the
+        # alloc's log dir (client/logmon); without a log dir, discard
+        log_dir = ctx.get("log_dir")
+        stdout = stderr = subprocess.DEVNULL
+        if log_dir:
+            stdout = stderr = subprocess.PIPE
         try:
             proc = subprocess.Popen(
-                args, env={**env} if env else None,
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+                args, env={**env} if env else None, cwd=cwd,
+                stdout=stdout, stderr=stderr)
         except OSError as e:
             raise RuntimeError(f"failed to exec {command}: {e}")
         h = TaskHandle(task_name=task_name, driver=self.name, config=config,
                        proc=proc, started_at=time.time())
+        if log_dir:
+            from .logmon import RotatingWriter, pump
+            max_files = int(ctx.get("log_max_files", 10))
+            max_mb = int(ctx.get("log_max_file_size_mb", 10))
+            pump(proc.stdout, RotatingWriter(
+                log_dir, f"{task_name}.stdout", max_files, max_mb))
+            pump(proc.stderr, RotatingWriter(
+                log_dir, f"{task_name}.stderr", max_files, max_mb))
 
         def wait():
             code = proc.wait()

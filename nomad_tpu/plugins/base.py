@@ -38,7 +38,8 @@ def build_driver_methods(driver) -> Dict:
 
     def start_task(args):
         h = driver.start_task(args["task_name"], args.get("config") or {},
-                              args.get("env") or {})
+                              args.get("env") or {},
+                              ctx=args.get("ctx") or None)
         handles[h.id] = h
         return {"handle_id": h.id, "started_at": h.started_at}
 

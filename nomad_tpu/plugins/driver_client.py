@@ -148,11 +148,12 @@ class ExternalDriver:
     def fingerprint(self) -> Dict[str, str]:
         return self.call("Driver.Fingerprint", {})["attributes"]
 
-    def start_task(self, task_name: str, config: dict, env: dict):
+    def start_task(self, task_name: str, config: dict, env: dict,
+                   ctx: Optional[dict] = None):
         try:
             res = self.call("Driver.StartTask",
                             {"task_name": task_name, "config": config,
-                             "env": env})
+                             "env": env, "ctx": ctx})
         except RpcError as e:
             raise RuntimeError(str(e))
         h = ProxyHandle(self, res["handle_id"], task_name, config,
