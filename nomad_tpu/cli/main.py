@@ -137,11 +137,25 @@ def cmd_job_init(args) -> int:
     return 0
 
 
+def _collect_vars(args) -> dict:
+    """-var k=v flags + NOMAD_VAR_* env (jobspec2 variable inputs)."""
+    out = {}
+    for k, v in os.environ.items():
+        if k.startswith("NOMAD_VAR_"):
+            out[k[len("NOMAD_VAR_"):]] = v
+    for kv in getattr(args, "var", None) or []:
+        if "=" not in kv:
+            raise ValueError(f"-var expects key=value, got {kv!r}")
+        k, v = kv.split("=", 1)
+        out[k] = v
+    return out
+
+
 def cmd_job_run(args) -> int:
     from ..jobspec import parse_job, job_to_spec
     try:
         with open(args.jobfile) as f:
-            job = parse_job(f.read())
+            job = parse_job(f.read(), variables=_collect_vars(args))
     except OSError as e:
         print(f"Error reading job file: {e}", file=sys.stderr)
         return 1
@@ -636,6 +650,8 @@ def build_parser() -> argparse.ArgumentParser:
     run = job.add_parser("run")
     run.add_argument("jobfile")
     run.add_argument("-detach", action="store_true")
+    run.add_argument("-var", action="append",
+                     help="variable value key=value (repeatable)")
     run.set_defaults(fn=cmd_job_run)
     status = job.add_parser("status")
     status.add_argument("job_id", nargs="?")

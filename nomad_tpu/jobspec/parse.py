@@ -318,8 +318,11 @@ def _group(name: str, body: dict, job_update: Optional[dict],
     )
 
 
-def parse_job(src: str) -> Job:
-    """Parse an HCL or JSON jobspec into a canonicalized Job."""
+def parse_job(src: str, variables: dict = None) -> Job:
+    """Parse an HCL or JSON jobspec into a canonicalized Job. HCL goes
+    through the HCL2 evaluation layer (variables/locals/functions/
+    dynamic blocks, jobspec2/parse.go) with caller-supplied variable
+    values."""
     src = src.strip()
     if src.startswith("{"):
         data = json.loads(src)
@@ -332,7 +335,8 @@ def parse_job(src: str) -> Job:
             job.canonicalize()
             return job
     else:
-        parsed = parse_hcl(src)
+        from .hcl2 import evaluate
+        parsed = evaluate(parse_hcl(src), variables)
         data = parsed.get("job")
         if data is None:
             raise ValueError("jobspec must contain a 'job' block")
@@ -389,9 +393,9 @@ def parse_job(src: str) -> Job:
     return job
 
 
-def parse_job_file(path: str) -> Job:
+def parse_job_file(path: str, variables: dict = None) -> Job:
     with open(path) as f:
-        return parse_job(f.read())
+        return parse_job(f.read(), variables)
 
 
 def job_to_spec(job: Job) -> dict:
