@@ -173,6 +173,16 @@ class ApiClient:
     def agent_self(self) -> dict:
         return self._request("GET", "/v1/agent/self")
 
+    def metrics(self) -> dict:
+        return self._request("GET", "/v1/metrics")
+
+    def agent_profile(self, seconds: float = 1.0) -> dict:
+        return self._request("GET", "/v1/agent/pprof/profile",
+                             params={"seconds": seconds})
+
+    def agent_threads(self) -> dict:
+        return self._request("GET", "/v1/agent/pprof/threads")
+
     # -- ACL ------------------------------------------------------------
     def acl_bootstrap(self) -> dict:
         return self._request("POST", "/v1/acl/bootstrap")

@@ -65,6 +65,11 @@ class HTTPApiServer:
                     url = urlparse(self.path)
                     q = {k: v[0] for k, v in parse_qs(url.query).items()}
                     token = self.headers.get("X-Nomad-Token", "")
+                    if url.path == "/v1/agent/monitor" and method == "GET":
+                        acl = api.server.resolve_token(token)
+                        if not (acl.is_management() or acl.allow_agent_read()):
+                            raise PermissionError("Permission denied")
+                        return api.stream_monitor(self, q)
                     if url.path == "/v1/event/stream" and method == "GET":
                         acl = api.server.resolve_token(token)
                         if not (acl.is_management() or acl.allow_namespace(
@@ -168,7 +173,7 @@ class HTTPApiServer:
         if path == "/v1/search":
             need(acl.allow_namespace(ns) or acl.allow_node_read())
             return
-        if path.startswith("/v1/agent"):
+        if path.startswith("/v1/agent") or path == "/v1/metrics":
             need(acl.allow_agent_write() if write else acl.allow_agent_read())
             return
         if path.startswith("/v1/operator"):
@@ -472,6 +477,45 @@ class HTTPApiServer:
                     "config": {"NumSchedulers":
                                self.server.config.num_schedulers}}, idx
 
+        if path == "/v1/metrics" and method == "GET":
+            from ..utils import metrics
+            return metrics.snapshot(), idx
+
+        if path == "/v1/agent/pprof/cmdline" and method == "GET":
+            import sys as _sys
+            return {"cmdline": list(_sys.argv)}, idx
+
+        if path == "/v1/agent/pprof/profile" and method == "GET":
+            # agent_endpoint.go:339 — CPU profile for ?seconds=N; the
+            # Python analog runs cProfile over the window and returns
+            # the cumulative-sorted pstats report
+            import cProfile
+            import io as _io
+            import pstats
+            import time as _time
+            seconds = min(float(q.get("seconds", 1)), 30.0)
+            pr = cProfile.Profile()
+            pr.enable()
+            _time.sleep(seconds)
+            pr.disable()
+            out = _io.StringIO()
+            pstats.Stats(pr, stream=out).sort_stats("cumulative") \
+                .print_stats(50)
+            return {"profile": out.getvalue(), "seconds": seconds}, idx
+
+        if path == "/v1/agent/pprof/threads" and method == "GET":
+            # goroutine-dump analog: all python thread stacks
+            import sys as _sys
+            import traceback as _tb
+            frames = _sys._current_frames()
+            import threading as _threading
+            names = {t.ident: t.name for t in _threading.enumerate()}
+            dump = {}
+            for tid, frame in frames.items():
+                dump[names.get(tid, str(tid))] = \
+                    "".join(_tb.format_stack(frame))
+            return {"threads": dump}, idx
+
         if path == "/v1/operator/scheduler/configuration":
             if method == "GET":
                 return {"SchedulerConfig":
@@ -510,6 +554,35 @@ class HTTPApiServer:
         return {"Matches": matches, "Truncations": truncations}
 
     # -- event stream (nomad/stream/ndjson.go over chunked HTTP) --------
+    def stream_monitor(self, handler, q: dict):
+        """/v1/agent/monitor (agent_endpoint.go monitor): stream agent
+        log lines as NDJSON at >= log_level."""
+        from ..utils.monitor import get_buffer, parse_level
+        buf = get_buffer()
+        level = parse_level(q.get("log_level", "info"))
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Transfer-Encoding", "chunked")
+            handler.end_headers()
+
+            def write_chunk(data: bytes):
+                handler.wfile.write(f"{len(data):x}\r\n".encode()
+                                    + data + b"\r\n")
+                handler.wfile.flush()
+
+            seq = 0
+            while True:
+                seq, lines = buf.read_since(seq, level, timeout_s=5.0)
+                if not lines:
+                    write_chunk(b"{}\n")            # keepalive
+                    continue
+                for line in lines:
+                    write_chunk((json.dumps({"Data": line}) + "\n")
+                                .encode())
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away
+
     def stream_events(self, handler, raw_topics, from_index: int):
         from ..server.event_broker import ALL_KEYS, TOPIC_ALL
         from ..utils.codec import to_wire

@@ -149,6 +149,41 @@ class Server:
         self._gc_ticker = threading.Thread(target=self._schedule_periodic_gc,
                                            daemon=True, name="gc-ticker")
         self._gc_ticker.start()
+        self._stats_ticker = threading.Thread(target=self._emit_stats,
+                                              daemon=True,
+                                              name="stats-ticker")
+        self._stats_ticker.start()
+
+    def _emit_stats(self) -> None:
+        """Periodic gauge emission (eval_broker.go:825 EmitStats,
+        blocked_evals stats, worker counters)."""
+        from ..utils import metrics
+        while not getattr(self, "_shutdown", False):
+            time.sleep(1.0)
+            try:
+                bs = self.eval_broker.stats
+                metrics.set_gauge("nomad.broker.total_ready",
+                                  bs.total_ready)
+                metrics.set_gauge("nomad.broker.total_unacked",
+                                  bs.total_unacked)
+                metrics.set_gauge("nomad.broker.total_blocked",
+                                  bs.total_blocked)
+                metrics.set_gauge("nomad.broker.total_waiting",
+                                  bs.total_waiting)
+                metrics.set_gauge(
+                    "nomad.blocked_evals.total_blocked",
+                    len(getattr(self.blocked_evals, "_captured", {}))
+                    + len(getattr(self.blocked_evals, "_escaped", {})))
+                metrics.set_gauge(
+                    "nomad.worker.total_processed",
+                    sum(w.stats["processed"] for w in self.workers))
+                metrics.set_gauge(
+                    "nomad.worker.total_failed",
+                    sum(w.stats["failed"] for w in self.workers))
+                metrics.set_gauge("nomad.state.latest_index",
+                                  self.store.latest_index())
+            except Exception:       # pragma: no cover — best effort
+                pass
 
     def revoke_leadership(self) -> None:
         """leader.go revokeLeadership:1038 — disable leader-only
@@ -202,6 +237,7 @@ class Server:
                 self.persistence.snapshot(self.store)
 
     def shutdown(self) -> None:
+        self._shutdown = True
         if self.raft is not None:
             self.raft.stop()
         self._leader = False

@@ -53,6 +53,16 @@ class PlanApplier:
 
     # -- the core ------------------------------------------------------
     def apply(self, plan: Plan) -> PlanResult:
+        import time as _time
+        from ..utils import metrics
+        _t0 = _time.monotonic()
+        try:
+            return self._apply(plan)
+        finally:
+            metrics.measure_since("nomad.plan.evaluate", _t0)
+            metrics.incr_counter("nomad.plan.apply")
+
+    def _apply(self, plan: Plan) -> PlanResult:
         store = self.server.store
         snapshot = store.snapshot()
 

@@ -73,12 +73,15 @@ class Worker:
 
     # -- single eval ---------------------------------------------------
     def process_eval(self, ev: Evaluation, token: str) -> None:
+        from ..utils import metrics
         self._eval = ev
         self._token = token
         try:
             # wait for the state store to catch up to the eval
+            t0 = time.monotonic()
             snap = self.server.store.snapshot_min_index(
                 ev.modify_index, timeout_s=RAFT_SYNC_LIMIT)
+            metrics.measure_since("nomad.worker.wait_for_index", t0)
             self._snapshot_index = snap.latest_index()
             if ev.type == JOB_TYPE_CORE:
                 # worker.go invokeScheduler: _core evals get the GC
@@ -87,7 +90,12 @@ class Worker:
                 sched = CoreScheduler(snap, self.server)
             else:
                 sched = new_scheduler(self._scheduler_for(ev), snap, self)
+            t0 = time.monotonic()
             sched.process(ev)
+            metrics.measure_since(
+                f"nomad.worker.invoke_scheduler_{self._scheduler_for(ev)}"
+                if ev.type != JOB_TYPE_CORE
+                else "nomad.worker.invoke_scheduler_core", t0)
             self.server.eval_broker.ack(ev.id, token)
             self.stats["processed"] += 1
         except Exception:
@@ -107,10 +115,13 @@ class Worker:
 
     # -- Planner interface --------------------------------------------
     def submit_plan(self, plan: Plan) -> Optional[PlanResult]:
+        from ..utils import metrics
+        t0 = time.monotonic()
         plan.eval_token = self._token
         plan.snapshot_index = self._snapshot_index
         future = self.server.plan_queue.enqueue(plan)
         result: PlanResult = future.result(timeout=30)
+        metrics.measure_since("nomad.worker.submit_plan", t0)
         # if some placements were rejected, wait for the refresh index so
         # the next attempt sees why (worker.go:318-340)
         if result.refresh_index:

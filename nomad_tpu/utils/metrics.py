@@ -1,0 +1,91 @@
+"""In-memory telemetry registry (reference: armon/go-metrics as wired
+by command/agent/command.go setupTelemetry — counters, gauges, and
+timer samples with aggregate statistics, served by /v1/metrics in the
+InmemSink's shape).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class _Sample:
+    __slots__ = ("count", "sum", "min", "max", "last")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+        self.last = 0.0
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        self.last = v
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._l = threading.Lock()
+        self._gauges: Dict[str, float] = {}
+        self._counters: Dict[str, _Sample] = {}
+        self._samples: Dict[str, _Sample] = {}
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._l:
+            self._gauges[name] = float(value)
+
+    def incr_counter(self, name: str, n: float = 1.0) -> None:
+        with self._l:
+            self._counters.setdefault(name, _Sample()).add(n)
+
+    def add_sample_ms(self, name: str, ms: float) -> None:
+        with self._l:
+            self._samples.setdefault(name, _Sample()).add(ms)
+
+    def measure_since(self, name: str, start_monotonic: float) -> None:
+        """go-metrics MeasureSince: record elapsed milliseconds."""
+        self.add_sample_ms(name, (time.monotonic() - start_monotonic)
+                           * 1000.0)
+
+    def snapshot(self) -> dict:
+        """The /v1/metrics InmemSink display shape."""
+        with self._l:
+            def agg(d):
+                return [{"Name": k, "Count": s.count, "Sum": s.sum,
+                         "Min": (0.0 if s.count == 0 else s.min),
+                         "Max": s.max,
+                         "Mean": (s.sum / s.count) if s.count else 0.0}
+                        for k, s in sorted(d.items())]
+            return {
+                "Timestamp": time.strftime("%Y-%m-%d %H:%M:%S +0000",
+                                           time.gmtime()),
+                "Gauges": [{"Name": k, "Value": v}
+                           for k, v in sorted(self._gauges.items())],
+                "Counters": agg(self._counters),
+                "Samples": agg(self._samples),
+            }
+
+
+GLOBAL = MetricsRegistry()
+
+
+def set_gauge(name: str, value: float) -> None:
+    GLOBAL.set_gauge(name, value)
+
+
+def incr_counter(name: str, n: float = 1.0) -> None:
+    GLOBAL.incr_counter(name, n)
+
+
+def measure_since(name: str, start_monotonic: float) -> None:
+    GLOBAL.measure_since(name, start_monotonic)
+
+
+def snapshot() -> dict:
+    return GLOBAL.snapshot()

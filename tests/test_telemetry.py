@@ -1,0 +1,137 @@
+"""Telemetry: metrics registry, instrumentation, /v1/metrics,
+agent monitor stream, pprof analogs (reference: armon/go-metrics via
+setupTelemetry, worker.go:162-282 measure points, agent_endpoint.go
+monitor/pprof).
+"""
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import HTTPApiServer
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.utils.metrics import MetricsRegistry
+from nomad_tpu.utils.monitor import MonitorBuffer
+
+
+def _wait_for(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_registry_counters_gauges_samples():
+    r = MetricsRegistry()
+    r.set_gauge("g", 3.5)
+    r.incr_counter("c")
+    r.incr_counter("c", 2)
+    r.add_sample_ms("s", 10.0)
+    r.add_sample_ms("s", 30.0)
+    snap = r.snapshot()
+    assert snap["Gauges"] == [{"Name": "g", "Value": 3.5}]
+    c = snap["Counters"][0]
+    assert c["Name"] == "c" and c["Count"] == 2 and c["Sum"] == 3
+    s = snap["Samples"][0]
+    assert s["Count"] == 2 and s["Min"] == 10.0 and s["Max"] == 30.0 \
+        and s["Mean"] == 20.0
+
+
+def test_monitor_buffer_levels_and_blocking():
+    buf = MonitorBuffer()
+    log = logging.getLogger("nomad_tpu.test-monitor")
+    log.addHandler(buf)
+    log.setLevel(logging.DEBUG)
+    log.info("hello-info")
+    log.debug("hello-debug")
+    seq, lines = buf.read_since(0, logging.INFO, timeout_s=1.0)
+    assert any("hello-info" in ln for ln in lines)
+    assert not any("hello-debug" in ln for ln in lines)
+    # blocking read wakes on a new record
+    got = []
+
+    def reader():
+        _s, ls = buf.read_since(seq, logging.INFO, timeout_s=5.0)
+        got.extend(ls)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.1)
+    log.warning("wake-up")
+    t.join(timeout=5)
+    assert any("wake-up" in ln for ln in got)
+
+
+@pytest.fixture
+def api_cluster():
+    from nomad_tpu.client import Client, ClientConfig
+    server = Server(ServerConfig(num_schedulers=2, heartbeat_ttl_s=30.0))
+    server.start()
+    client = Client(server, ClientConfig(node_name="telemetry"))
+    client.start()
+    api = HTTPApiServer(server, port=0)
+    api.start()
+    yield server, api
+    api.shutdown()
+    client.shutdown()
+    server.shutdown()
+
+
+@pytest.mark.slow
+def test_metrics_endpoint_reflects_scheduling(api_cluster):
+    server, api = api_cluster
+    job = mock.batch_job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].config = {"run_for": "50ms"}
+    server.register_job(job)
+    assert _wait_for(lambda: len(
+        server.store.allocs_by_job("default", job.id)) == 2)
+
+    c = ApiClient(f"http://127.0.0.1:{api.port}")
+    assert _wait_for(lambda: any(
+        s["Name"].startswith("nomad.worker.invoke_scheduler")
+        for s in c.metrics()["Samples"]), timeout=10)
+    snap = c.metrics()
+    names = {s["Name"] for s in snap["Samples"]}
+    assert "nomad.worker.submit_plan" in names
+    assert "nomad.plan.evaluate" in names
+    assert _wait_for(lambda: any(
+        g["Name"] == "nomad.state.latest_index" and g["Value"] > 0
+        for g in c.metrics()["Gauges"]), timeout=5)
+
+
+@pytest.mark.slow
+def test_monitor_stream_and_pprof(api_cluster):
+    server, api = api_cluster
+    c = ApiClient(f"http://127.0.0.1:{api.port}")
+
+    # pprof analogs
+    threads = c.agent_threads()["threads"]
+    assert any("plan-applier" in name for name in threads)
+    prof = c.agent_profile(seconds=0.2)
+    assert "profile" in prof
+
+    # monitor: start streaming, then emit a log line and see it arrive
+    url = f"http://127.0.0.1:{api.port}/v1/agent/monitor?log_level=info"
+    resp = urllib.request.urlopen(url, timeout=10)
+    logging.getLogger("nomad_tpu.server").warning("monitor-probe-123")
+    found = False
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        line = resp.readline()
+        if not line:
+            break
+        text = line.decode().strip()
+        if "monitor-probe-123" in text:
+            found = True
+            break
+    resp.close()
+    assert found
