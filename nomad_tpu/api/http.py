@@ -170,6 +170,10 @@ class HTTPApiServer:
             need(acl.allow_namespace_operation(
                 ns, "submit-job" if write else "read-job"))
             return
+        if path == "/v1/volumes" or path.startswith("/v1/volume/"):
+            need(acl.allow_namespace_operation(
+                ns, "csi-write-volume" if write else "csi-read-volume"))
+            return
         if path == "/v1/search":
             need(acl.allow_namespace(ns) or acl.allow_node_read())
             return
@@ -470,6 +474,30 @@ class HTTPApiServer:
 
         if path == "/v1/status/leader":
             return "127.0.0.1:4647", idx
+
+        if path == "/v1/volumes" and method == "GET":
+            vols = store.csi_volumes(ns)
+            return [v.stub() for v in vols], idx
+
+        m = re.match(r"^/v1/volume/csi/([^/]+)$", path)
+        if m:
+            vol_id = m.group(1)
+            if method == "GET":
+                v = store.csi_volume(ns, vol_id)
+                return (to_wire(v), idx) if v else None
+            if method in ("PUT", "POST"):
+                from ..models.csi import CSIVolume
+                data = body_fn()
+                spec = data.get("Volume", data.get("volume", data))
+                vol = from_wire(CSIVolume, spec)
+                vol.id = vol.id or vol_id
+                vol.namespace = vol.namespace or ns
+                s.register_csi_volume(vol)
+                return {"ok": True}, store.latest_index()
+            if method == "DELETE":
+                s.deregister_csi_volume(
+                    ns, vol_id, force=q.get("force", "") == "true")
+                return {"ok": True}, store.latest_index()
 
         if path == "/v1/agent/self":
             return {"member": {"Name": "server", "Status": "alive"},

@@ -92,13 +92,14 @@ class TaskRunner:
 
     def __init__(self, alloc: Allocation, task, driver, on_update,
                  attached: Optional[TaskHandle] = None,
-                 node=None, alloc_dir=None):
+                 node=None, alloc_dir=None, derive_vault=None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
         self.on_update = on_update
         self.node = node
         self.alloc_dir = alloc_dir
+        self.derive_vault = derive_vault
         self.state = TaskState(state=TASK_STATE_PENDING)
         self.handle: Optional[TaskHandle] = None
         self._attached = attached
@@ -121,6 +122,16 @@ class TaskRunner:
         env = build_task_env(self.alloc, self.task, self.node,
                              alloc_dir=alloc_path, task_dir=task_path,
                              secrets_dir=secrets_path)
+        # vault hook (taskrunner/vault_hook.go): derive a token and
+        # expose it as VAULT_TOKEN when the task carries a vault stanza
+        if self.task.vault is not None and self.derive_vault is not None \
+                and self.task.vault.env:
+            try:
+                tokens = self.derive_vault(self.alloc.id, [self.task.name])
+                env["VAULT_TOKEN"] = tokens.get(self.task.name, "")
+            except Exception as e:
+                from .hooks import HookError
+                raise HookError(f"vault token derivation failed: {e}")
         if self.alloc_dir is not None:
             fetch_artifacts(self.task, task_path, env, self.node)
             render_templates(self.task, task_path, env, self.node)
@@ -215,11 +226,12 @@ class AllocRunner:
 
     def __init__(self, alloc: Allocation, drivers: Dict[str, object],
                  push_update, persist=None, node=None,
-                 alloc_dir_base: str = ""):
+                 alloc_dir_base: str = "", derive_vault=None):
         self.alloc = alloc
         self.drivers = drivers
         self.push_update = push_update
         self.persist = persist            # (alloc_id, task, state, handle)
+        self.derive_vault = derive_vault
         self.node = node
         self.task_runners: List[TaskRunner] = []
         self.client_status = ALLOC_CLIENT_PENDING
@@ -247,7 +259,8 @@ class AllocRunner:
                 return
             tr = TaskRunner(self.alloc, task, driver, self._on_task_update,
                             attached=(attached or {}).get(task.name),
-                            node=self.node, alloc_dir=self.alloc_dir)
+                            node=self.node, alloc_dir=self.alloc_dir,
+                            derive_vault=self.derive_vault)
             self.task_runners.append(tr)
         for tr in self.task_runners:
             tr.start()
@@ -469,7 +482,9 @@ class Client:
             runner = AllocRunner(alloc, self.drivers, self._push_update,
                                  persist=self._persist_task,
                                  node=self.node,
-                                 alloc_dir_base=self.config.alloc_dir)
+                                 alloc_dir_base=self.config.alloc_dir,
+                                 derive_vault=self.transport
+                                 .derive_vault_token)
             self.runners[aid] = runner
             runner.run(attached=attached)
 
@@ -543,7 +558,9 @@ class Client:
             runner = AllocRunner(alloc, self.drivers, self._push_update,
                                  persist=self._persist_task,
                                  node=self.node,
-                                 alloc_dir_base=self.config.alloc_dir)
+                                 alloc_dir_base=self.config.alloc_dir,
+                                 derive_vault=self.transport
+                                 .derive_vault_token)
             self.runners[aid] = runner
             if self.state_db is not None:
                 self.state_db.put_alloc(alloc)

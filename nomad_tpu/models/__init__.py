@@ -124,3 +124,4 @@ from .funcs import (
     FilterTerminalAllocs,
 )
 from .scheduler_config import SchedulerConfiguration, PreemptionConfig
+from .csi import CSIVolume

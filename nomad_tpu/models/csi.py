@@ -1,0 +1,84 @@
+"""CSI volume model (reference: nomad/structs/csi.go — CSIVolume with
+access/attachment modes and read/write claim tracking; claim capacity
+rules per access mode).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# access modes (structs/csi.go CSIVolumeAccessMode)
+ACCESS_SINGLE_NODE_READER = "single-node-reader-only"
+ACCESS_SINGLE_NODE_WRITER = "single-node-writer"
+ACCESS_MULTI_NODE_READER = "multi-node-reader-only"
+ACCESS_MULTI_NODE_SINGLE_WRITER = "multi-node-single-writer"
+ACCESS_MULTI_NODE_MULTI_WRITER = "multi-node-multi-writer"
+
+ATTACHMENT_FILE_SYSTEM = "file-system"
+ATTACHMENT_BLOCK_DEVICE = "block-device"
+
+CLAIM_READ = "read"
+CLAIM_WRITE = "write"
+
+
+@dataclass
+class CSIVolume:
+    id: str = ""
+    namespace: str = "default"
+    name: str = ""
+    plugin_id: str = ""
+    access_mode: str = ACCESS_SINGLE_NODE_WRITER
+    attachment_mode: str = ATTACHMENT_FILE_SYSTEM
+    schedulable: bool = True
+    # topology: node ids where the volume is reachable; empty == all
+    topology_node_ids: List[str] = field(default_factory=list)
+    read_allocs: Dict[str, str] = field(default_factory=dict)   # id->node
+    write_allocs: Dict[str, str] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+    # -- claim capacity (csi.go WriteFreeClaims/ReadSchedulable) -------
+    def write_schedulable(self) -> bool:
+        if not self.schedulable:
+            return False
+        if self.access_mode in (ACCESS_SINGLE_NODE_WRITER,
+                                ACCESS_MULTI_NODE_SINGLE_WRITER):
+            return len(self.write_allocs) == 0
+        if self.access_mode == ACCESS_MULTI_NODE_MULTI_WRITER:
+            return True
+        return False                         # reader-only modes
+
+    def read_schedulable(self) -> bool:
+        if not self.schedulable:
+            return False
+        if self.access_mode in (ACCESS_SINGLE_NODE_READER,
+                                ACCESS_SINGLE_NODE_WRITER):
+            # single-node modes serve one alloc at a time overall
+            return not self.read_allocs and not self.write_allocs \
+                or self.access_mode == ACCESS_SINGLE_NODE_WRITER
+        return True
+
+    def claimable(self, read_only: bool) -> bool:
+        return self.read_schedulable() if read_only \
+            else self.write_schedulable()
+
+    def claim(self, alloc_id: str, node_id: str, read_only: bool) -> None:
+        if read_only:
+            self.read_allocs[alloc_id] = node_id
+        else:
+            self.write_allocs[alloc_id] = node_id
+
+    def release(self, alloc_id: str) -> bool:
+        hit = self.read_allocs.pop(alloc_id, None) is not None
+        hit = (self.write_allocs.pop(alloc_id, None) is not None) or hit
+        return hit
+
+    def stub(self) -> dict:
+        return {"id": self.id, "namespace": self.namespace,
+                "name": self.name, "plugin_id": self.plugin_id,
+                "access_mode": self.access_mode,
+                "schedulable": self.schedulable,
+                "current_readers": len(self.read_allocs),
+                "current_writers": len(self.write_allocs),
+                "modify_index": self.modify_index}

@@ -63,6 +63,10 @@ def build_method_table(server) -> Dict[str, Any]:
     def node_get_client_allocs(args):
         return _get_client_allocs(server, args)
 
+    def node_derive_vault_token(args):
+        return {"tokens": server.derive_vault_token(
+            args["alloc_id"], list(args.get("tasks") or []))}
+
     def status_ping(_args):
         return {"status": "ok", "leader": True,
                 "index": server.store.latest_index()}
@@ -73,6 +77,7 @@ def build_method_table(server) -> Dict[str, Any]:
         "Node.Heartbeat": node_heartbeat,
         "Node.UpdateAlloc": node_update_alloc,
         "Node.GetClientAllocs": node_get_client_allocs,
+        "Node.DeriveVaultToken": node_derive_vault_token,
         "Status.Ping": status_ping,
     }
 

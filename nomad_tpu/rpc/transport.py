@@ -36,6 +36,9 @@ class ServerTransport:
     def update_alloc_status(self, allocs: List[Allocation]) -> None:
         raise NotImplementedError
 
+    def derive_vault_token(self, alloc_id: str, tasks) -> dict:
+        raise NotImplementedError
+
 
 class InProcTransport(ServerTransport):
     def __init__(self, server):
@@ -62,6 +65,9 @@ class InProcTransport(ServerTransport):
 
     def update_alloc_status(self, allocs: List[Allocation]) -> None:
         self.server.update_alloc_status_from_client(allocs)
+
+    def derive_vault_token(self, alloc_id: str, tasks) -> dict:
+        return self.server.derive_vault_token(alloc_id, list(tasks))
 
 
 class RemoteTransport(ServerTransport):
@@ -98,3 +104,8 @@ class RemoteTransport(ServerTransport):
     def update_alloc_status(self, allocs: List[Allocation]) -> None:
         self.rpc.call("Node.UpdateAlloc",
                       {"allocs": [to_wire(a) for a in allocs]})
+
+    def derive_vault_token(self, alloc_id: str, tasks) -> dict:
+        return self.rpc.call("Node.DeriveVaultToken",
+                             {"alloc_id": alloc_id,
+                              "tasks": list(tasks)})["tokens"]

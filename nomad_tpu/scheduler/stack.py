@@ -302,6 +302,29 @@ class PlacementEngine:
         start = time.monotonic_ns()
         mask, filtered_counts = self.feasibility(tg)
         mask = mask.copy()
+        filtered_counts = dict(filtered_counts)
+
+        # CSI volumes are transient feasibility (claims churn per plan,
+        # so never memoized — CSIVolumeChecker, feasible.go:194): the
+        # volume must exist, be claimable for the requested mode, and
+        # the node must be inside its topology
+        csi_reqs = [r for r in (tg.volumes or {}).values()
+                    if getattr(r, "type", "host") == "csi"]
+        for req in csi_reqs:
+            vol = self.snapshot.csi_volume(self.job.namespace, req.source)
+            before = int(mask.sum())
+            if vol is None or not vol.claimable(bool(req.read_only)):
+                mask[:] = False
+            elif vol.topology_node_ids:
+                topo = set(vol.topology_node_ids)
+                topo_mask = np.fromiter((nid in topo for nid in t.ids),
+                                        dtype=bool, count=t.n)
+                mask &= topo_mask
+            newly = before - int(mask.sum())
+            if newly:
+                filtered_counts[f"missing CSI Volume {req.source}"] = \
+                    filtered_counts.get(
+                        f"missing CSI Volume {req.source}", 0) + newly
 
         options = options or SelectOptions()
         if options.preferred_nodes:

@@ -153,6 +153,10 @@ class Server:
                                               daemon=True,
                                               name="stats-ticker")
         self._stats_ticker.start()
+        self._volume_watcher = threading.Thread(target=self._watch_volumes,
+                                                daemon=True,
+                                                name="volume-watcher")
+        self._volume_watcher.start()
 
     def _emit_stats(self) -> None:
         """Periodic gauge emission (eval_broker.go:825 EmitStats,
@@ -491,6 +495,22 @@ class Server:
     def _apply_acl_token_delete(self, index: int, p: dict) -> None:
         self.store.delete_acl_tokens(index, p["accessor_ids"])
 
+    # CSI volume appliers (fsm.go applyCSIVolume*)
+    def _apply_csi_volume_register(self, index: int, p: dict) -> None:
+        self.store.upsert_csi_volumes(index, p["volumes"])
+
+    def _apply_csi_volume_deregister(self, index: int, p: dict) -> None:
+        self.store.delete_csi_volume(index, p["namespace"], p["volume_id"])
+
+    def _apply_csi_volume_claim(self, index: int, p: dict) -> None:
+        self.store.csi_volume_claim(index, p["namespace"], p["volume_id"],
+                                    p["alloc_id"], p["node_id"],
+                                    p["read_only"])
+
+    def _apply_csi_volume_release(self, index: int, p: dict) -> None:
+        self.store.csi_volume_release(index, p["namespace"],
+                                      p["volume_id"], p["alloc_id"])
+
     def _apply_periodic_launch(self, index: int, p: dict) -> None:
         self.store.upsert_periodic_launch(index, p["namespace"], p["job_id"],
                                           p["launch_time"])
@@ -550,11 +570,13 @@ class Server:
     def register_job(self, job: Job,
                      triggered_by: str = TRIGGER_JOB_REGISTER
                      ) -> Optional[Evaluation]:
-        """Job.Register (nomad/job_endpoint.go:79): canonicalize,
-        validate, upsert, create eval. Periodic and parameterized jobs
+        """Job.Register (nomad/job_endpoint.go:79): the admission
+        pipeline — canonicalize, implied constraints, validate — then
+        upsert and create an eval. Periodic and parameterized jobs
         get no eval — the dispatcher / Job.Dispatch creates child jobs
         which do (job_endpoint.go:236-247)."""
         job.canonicalize()
+        self._implied_constraints(job)
         errs = job.validate()
         if errs:
             raise ValueError("; ".join(errs))
@@ -885,6 +907,88 @@ class Server:
             self._acl_cache.clear()
         self._acl_cache[key] = acl
         return acl
+
+    @staticmethod
+    def _implied_constraints(job: Job) -> None:
+        """jobImpliedConstraints (job_endpoint_hooks.go:114): auto-add
+        group constraints implied by feature use — vault stanzas need a
+        vault-capable node, signal-based change modes need nodes
+        advertising those signals."""
+        from ..models import Constraint
+        for tg in job.task_groups:
+            wants_vault = any(t.vault is not None for t in tg.tasks)
+            signals = set()
+            for t in tg.tasks:
+                if t.kill_signal:
+                    signals.add(t.kill_signal)
+                if t.vault is not None and t.vault.change_signal:
+                    signals.add(t.vault.change_signal)
+                for tmpl in t.templates:
+                    if tmpl.change_signal:
+                        signals.add(tmpl.change_signal)
+            have = {(c.ltarget, c.operand) for c in tg.constraints}
+            if wants_vault and \
+                    ("${attr.vault.version}", "is_set") not in have:
+                tg.constraints.append(Constraint(
+                    ltarget="${attr.vault.version}", rtarget="",
+                    operand="is_set"))
+            if signals and ("${attr.os.signals}",
+                            "set_contains") not in have:
+                tg.constraints.append(Constraint(
+                    ltarget="${attr.os.signals}",
+                    rtarget=",".join(sorted(signals)),
+                    operand="set_contains"))
+
+    # -- CSI volumes (nomad/csi_endpoint.go; volumewatcher/) -----------
+    def register_csi_volume(self, volume) -> int:
+        if not volume.id or not volume.plugin_id:
+            raise ValueError("volume requires id and plugin_id")
+        return self.raft_apply("csi_volume_register",
+                               dict(volumes=[volume]))
+
+    def deregister_csi_volume(self, namespace: str, volume_id: str,
+                              force: bool = False) -> int:
+        v = self.store.csi_volume(namespace, volume_id)
+        if v is None:
+            raise KeyError(f"volume {volume_id} not found")
+        if not force and (v.read_allocs or v.write_allocs):
+            raise ValueError(
+                f"volume {volume_id} has active claims (use force)")
+        return self.raft_apply("csi_volume_deregister",
+                               dict(namespace=namespace,
+                                    volume_id=volume_id))
+
+    def _watch_volumes(self) -> None:
+        """Volume watcher (nomad/volumewatcher): release claims held by
+        terminal allocations so volumes become schedulable again."""
+        while not getattr(self, "_shutdown", False):
+            time.sleep(1.0)
+            if not self._leader:
+                continue
+            try:
+                for v in self.store.csi_volumes():
+                    for aid in (list(v.read_allocs)
+                                + list(v.write_allocs)):
+                        alloc = self.store.alloc_by_id(aid)
+                        if alloc is None or alloc.terminal_status():
+                            self.raft_apply(
+                                "csi_volume_release",
+                                dict(namespace=v.namespace,
+                                     volume_id=v.id, alloc_id=aid))
+            except Exception:     # pragma: no cover — best effort
+                LOG.exception("volume watcher pass failed")
+
+    # -- Vault integration (nomad/vault.go DeriveVaultToken) -----------
+    def derive_vault_token(self, alloc_id: str, tasks) -> Dict[str, str]:
+        """Token derivation for tasks with a vault stanza. No real
+        Vault exists in this build: tokens are locally-minted opaque
+        ids, honoring the API contract (vault.go CreateToken) so the
+        client-side plumbing (env injection, renewal hooks) is real."""
+        alloc = self.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc {alloc_id} not found")
+        from ..utils.ids import generate_uuid
+        return {t: f"s.{generate_uuid()[:24]}" for t in tasks}
 
     # -- heartbeats (nomad/heartbeat.go) -------------------------------
     def reset_heartbeat_timer(self, node_id: str) -> None:

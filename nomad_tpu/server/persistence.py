@@ -61,11 +61,16 @@ def _register_acl_schemas() -> None:
     # deferred: nomad_tpu.acl imports jobspec which imports models —
     # registering lazily avoids a cycle at module import time
     from ..acl import AclPolicy, AclToken
+    from ..models.csi import CSIVolume
     SCHEMAS.update({
         "acl_policy_upsert": {"policies": [AclPolicy]},
         "acl_policy_delete": {},
         "acl_token_upsert": {"tokens": [AclToken]},
         "acl_token_delete": {},
+        "csi_volume_register": {"volumes": [CSIVolume]},
+        "csi_volume_deregister": {},
+        "csi_volume_claim": {},
+        "csi_volume_release": {},
     })
 
 

@@ -97,7 +97,7 @@ class _Root:
 TABLES = (
     "nodes", "jobs", "job_versions", "evals", "allocs", "deployments",
     "job_summaries", "scheduler_config", "periodic_launches",
-    "acl_policies", "acl_tokens",
+    "acl_policies", "acl_tokens", "csi_volumes",
     # secondary indexes
     "allocs_by_node", "allocs_by_job", "allocs_by_eval", "evals_by_job",
     "deployments_by_job",
@@ -150,6 +150,9 @@ class StateSnapshot:
         return max([0] + list(self._root.indexes.values()))
 
     # -- nodes ---------------------------------------------------------
+    def csi_volume(self, namespace: str, volume_id: str):
+        return self._root.table("csi_volumes").get((namespace, volume_id))
+
     def node_by_id(self, node_id: str) -> Optional[Node]:
         return self._root.table("nodes").get(node_id)
 
@@ -772,6 +775,11 @@ class StateStore(StateSnapshot):
             root = self._bulk_insert_allocs(root, index, fresh)
             for a in allocs_preempted:
                 root = self._upsert_alloc_impl(root, index, a)
+            # claim CSI volumes for placements whose task group requests
+            # them (csi_hook claim-at-placement; the volume watcher
+            # releases claims once allocs turn terminal)
+            root = self._claim_csi_for_placements(root, index,
+                                                  allocs_placed)
             if deployment is not None:
                 root = self._upsert_deployment_impl(root, index, deployment)
             for a in new_placed:
@@ -1107,6 +1115,100 @@ class StateStore(StateSnapshot):
         return sorted(self._root.table("acl_tokens").values(),
                       key=lambda t: t.accessor_id)
 
+    # -- CSI volumes (state_store.go CSIVolume*) -----------------------
+    def upsert_csi_volumes(self, index: int, volumes: List) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("csi_volumes")
+            for v in volumes:
+                existing = t.get((v.namespace, v.id))
+                v.create_index = existing.create_index if existing else index
+                v.modify_index = index
+                t = t.set((v.namespace, v.id), v)
+            root = root.with_table("csi_volumes", t) \
+                       .with_index("csi_volumes", index)
+            self._publish(root)
+
+    def delete_csi_volume(self, index: int, namespace: str,
+                          volume_id: str) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("csi_volumes").delete((namespace, volume_id))
+            root = root.with_table("csi_volumes", t) \
+                       .with_index("csi_volumes", index)
+            self._publish(root)
+
+    def csi_volume(self, namespace: str, volume_id: str):
+        return self._root.table("csi_volumes").get((namespace, volume_id))
+
+    def csi_volumes(self, namespace: Optional[str] = None) -> List:
+        vols = list(self._root.table("csi_volumes").values())
+        if namespace is not None:
+            vols = [v for v in vols if v.namespace == namespace]
+        return sorted(vols, key=lambda v: (v.namespace, v.id))
+
+    def _claim_csi_for_placements(self, root: _Root, index: int,
+                                  allocs_placed) -> _Root:
+        from dataclasses import replace as _replace
+        for a in allocs_placed:
+            job = a.job or root.table("jobs").get((a.namespace, a.job_id))
+            tg = job.lookup_task_group(a.task_group) if job else None
+            if tg is None or not tg.volumes:
+                continue
+            for req in tg.volumes.values():
+                if getattr(req, "type", "host") != "csi":
+                    continue
+                t = root.table("csi_volumes")
+                v = t.get((a.namespace, req.source))
+                if v is None:
+                    continue
+                v = _replace(v, read_allocs=dict(v.read_allocs),
+                             write_allocs=dict(v.write_allocs),
+                             modify_index=index)
+                v.claim(a.id, a.node_id, bool(req.read_only))
+                root = root.with_table(
+                    "csi_volumes", t.set((a.namespace, req.source), v))
+                root = root.with_index("csi_volumes", index)
+        return root
+
+    def csi_volume_claim(self, index: int, namespace: str, volume_id: str,
+                         alloc_id: str, node_id: str,
+                         read_only: bool) -> None:
+        from dataclasses import replace as _replace
+        with self._lock:
+            root = self._root.edit()
+            v = root.table("csi_volumes").get((namespace, volume_id))
+            if v is None:
+                raise KeyError(f"volume {volume_id} not found")
+            v = _replace(v, read_allocs=dict(v.read_allocs),
+                         write_allocs=dict(v.write_allocs),
+                         modify_index=index)
+            v.claim(alloc_id, node_id, read_only)
+            root = root.with_table(
+                "csi_volumes",
+                root.table("csi_volumes").set((namespace, volume_id), v))
+            root = root.with_index("csi_volumes", index)
+            self._publish(root)
+
+    def csi_volume_release(self, index: int, namespace: str,
+                           volume_id: str, alloc_id: str) -> None:
+        from dataclasses import replace as _replace
+        with self._lock:
+            root = self._root.edit()
+            v = root.table("csi_volumes").get((namespace, volume_id))
+            if v is None:
+                return
+            v = _replace(v, read_allocs=dict(v.read_allocs),
+                         write_allocs=dict(v.write_allocs),
+                         modify_index=index)
+            if not v.release(alloc_id):
+                return
+            root = root.with_table(
+                "csi_volumes",
+                root.table("csi_volumes").set((namespace, volume_id), v))
+            root = root.with_index("csi_volumes", index)
+            self._publish(root)
+
     # -- checkpoint / restore (fsm.go Snapshot:1360 / Restore:1374) ----
     def dump(self) -> dict:
         """Wire-encode the full database for a snapshot file."""
@@ -1138,6 +1240,8 @@ class StateStore(StateSnapshot):
                                  root.table("acl_policies").values()]
         plain["acl_tokens"] = [to_wire(t) for t in
                                root.table("acl_tokens").values()]
+        plain["csi_volumes"] = [to_wire(v) for v in
+                                root.table("csi_volumes").values()]
         return out
 
     def restore(self, data: dict) -> None:
@@ -1227,6 +1331,13 @@ class StateStore(StateSnapshot):
                     "scheduler_config",
                     root.table("scheduler_config").set(
                         "config", from_wire(SchedulerConfiguration, cfg)))
+
+            from ..models.csi import CSIVolume
+            t = root.table("csi_volumes")
+            for w in data["tables"].get("csi_volumes", []):
+                v = from_wire(CSIVolume, w)
+                t = t.set((v.namespace, v.id), v)
+            root = root.with_table("csi_volumes", t)
 
             from ..acl import AclPolicy, AclToken
             t = root.table("acl_policies")

@@ -1,0 +1,204 @@
+"""CSI volumes, volume watcher, implied constraints, vault tokens
+(reference: nomad/structs/csi.go, nomad/csi_endpoint.go,
+nomad/volumewatcher/, scheduler/feasible.go CSIVolumeChecker:194,
+nomad/job_endpoint_hooks.go:114, nomad/vault.go).
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.models import CSIVolume, Evaluation, VaultConfig
+from nomad_tpu.models.csi import (ACCESS_MULTI_NODE_MULTI_WRITER,
+                                  ACCESS_MULTI_NODE_READER,
+                                  ACCESS_SINGLE_NODE_WRITER)
+from nomad_tpu.models.job import VolumeRequest
+from nomad_tpu.scheduler.harness import Harness
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.utils.ids import generate_uuid
+
+
+def _wait_for(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _eval_for(job):
+    from nomad_tpu.models import EVAL_STATUS_PENDING, TRIGGER_JOB_REGISTER
+    return Evaluation(
+        id=generate_uuid(), namespace=job.namespace, priority=job.priority,
+        triggered_by=TRIGGER_JOB_REGISTER, job_id=job.id,
+        status=EVAL_STATUS_PENDING, type=job.type)
+
+
+def _csi_job(volume_source, read_only=False, count=1, name="csi-job"):
+    job = mock.job()
+    job.id = name
+    tg = job.task_groups[0]
+    tg.count = count
+    for t in tg.tasks:
+        t.resources.networks = []
+    tg.networks = []
+    tg.volumes = {"vol": VolumeRequest(
+        name="vol", type="csi", source=volume_source,
+        read_only=read_only)}
+    return job
+
+
+# -- claim semantics ---------------------------------------------------
+def test_claim_capacity_rules():
+    v = CSIVolume(id="v1", access_mode=ACCESS_SINGLE_NODE_WRITER)
+    assert v.claimable(read_only=False)
+    v.claim("a1", "n1", read_only=False)
+    assert not v.claimable(read_only=False)
+    assert v.release("a1")
+    assert v.claimable(read_only=False)
+
+    multi = CSIVolume(id="v2", access_mode=ACCESS_MULTI_NODE_MULTI_WRITER)
+    multi.claim("a1", "n1", False)
+    assert multi.claimable(read_only=False)
+
+    reader = CSIVolume(id="v3", access_mode=ACCESS_MULTI_NODE_READER)
+    assert reader.claimable(read_only=True)
+    assert not reader.claimable(read_only=False)
+
+    unsched = CSIVolume(id="v4", schedulable=False)
+    assert not unsched.claimable(read_only=True)
+
+
+# -- scheduling --------------------------------------------------------
+def test_csi_feasibility_and_claim_on_placement():
+    h = Harness()
+    n = mock.node()
+    h.store.upsert_node(h.next_index(), n)
+
+    # no volume registered: placement fails with the CSI reason
+    job = _csi_job("data-vol")
+    h.store.upsert_job(h.next_index(), job)
+    h.process("service", _eval_for(job))
+    assert h.evals and "web" in h.evals[-1].failed_tg_allocs
+    metrics = h.evals[-1].failed_tg_allocs["web"]
+    assert any("CSI" in k for k in metrics.constraint_filtered), \
+        metrics.constraint_filtered
+
+    # register the volume: placement succeeds and the claim lands
+    vol = CSIVolume(id="data-vol", plugin_id="p1",
+                    access_mode=ACCESS_SINGLE_NODE_WRITER)
+    h.store.upsert_csi_volumes(h.next_index(), [vol])
+    job2 = _csi_job("data-vol", name="csi-job-2")
+    h.store.upsert_job(h.next_index(), job2)
+    h.process("service", _eval_for(job2))
+    placed = h.store.allocs_by_job("default", job2.id)
+    assert len(placed) == 1
+    v = h.store.csi_volume("default", "data-vol")
+    assert placed[0].id in v.write_allocs
+
+    # a second writer job can't claim the single-writer volume
+    job3 = _csi_job("data-vol", name="csi-job-3")
+    h.store.upsert_job(h.next_index(), job3)
+    h.process("service", _eval_for(job3))
+    assert h.store.allocs_by_job("default", job3.id) == []
+
+
+def test_csi_topology_restricts_nodes():
+    h = Harness()
+    n1, n2 = mock.node(), mock.node()
+    h.store.upsert_node(h.next_index(), n1)
+    h.store.upsert_node(h.next_index(), n2)
+    vol = CSIVolume(id="topo-vol", plugin_id="p1",
+                    access_mode=ACCESS_SINGLE_NODE_WRITER,
+                    topology_node_ids=[n2.id])
+    h.store.upsert_csi_volumes(h.next_index(), [vol])
+    job = _csi_job("topo-vol", name="topo-job")
+    h.store.upsert_job(h.next_index(), job)
+    h.process("service", _eval_for(job))
+    placed = h.store.allocs_by_job("default", job.id)
+    assert len(placed) == 1 and placed[0].node_id == n2.id
+
+
+# -- volume watcher ----------------------------------------------------
+@pytest.mark.slow
+def test_volume_watcher_releases_terminal_claims():
+    from nomad_tpu.client import Client, ClientConfig
+    server = Server(ServerConfig(num_schedulers=2, heartbeat_ttl_s=30.0))
+    server.start()
+    client = Client(server, ClientConfig(node_name="csi-client"))
+    client.start()
+    try:
+        vol = CSIVolume(id="batch-vol", plugin_id="p1",
+                        access_mode=ACCESS_SINGLE_NODE_WRITER)
+        server.register_csi_volume(vol)
+        job = _csi_job("batch-vol", name="csi-batch")
+        job.type = "batch"
+        job.task_groups[0].tasks[0].config = {"run_for": "100ms"}
+        server.register_job(job)
+        assert _wait_for(lambda: len(
+            server.store.allocs_by_job("default", job.id)) == 1)
+        # claim exists while running/pending
+        assert _wait_for(lambda: len(server.store.csi_volume(
+            "default", "batch-vol").write_allocs) == 1)
+        # after completion the watcher releases it
+        assert _wait_for(lambda: len(server.store.csi_volume(
+            "default", "batch-vol").write_allocs) == 0, timeout=20)
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+# -- endpoints ---------------------------------------------------------
+def test_csi_http_routes():
+    from nomad_tpu.api import HTTPApiServer
+    from nomad_tpu.api.client import ApiClient, ApiError
+    server = Server(ServerConfig(num_schedulers=0))
+    api = HTTPApiServer(server, port=0)
+    api.start()
+    try:
+        c = ApiClient(f"http://127.0.0.1:{api.port}")
+        c._request("PUT", "/v1/volume/csi/web-vol",
+                   {"Volume": {"id": "web-vol", "plugin_id": "p1"}})
+        vols = c._request("GET", "/v1/volumes")
+        assert [v["id"] for v in vols] == ["web-vol"]
+        got = c._request("GET", "/v1/volume/csi/web-vol")
+        assert got["plugin_id"] == "p1"
+        c._request("DELETE", "/v1/volume/csi/web-vol")
+        assert c._request("GET", "/v1/volumes") == []
+    finally:
+        api.shutdown()
+        server.shutdown()
+
+
+# -- admission hooks ---------------------------------------------------
+def test_implied_constraints_vault_and_signals():
+    server = Server(ServerConfig(num_schedulers=0))
+    try:
+        job = mock.job()
+        task = job.task_groups[0].tasks[0]
+        task.vault = VaultConfig(policies=["app"], change_signal="SIGHUP",
+                                 change_mode="signal")
+        server.register_job(job)
+        stored = server.store.job_by_id("default", job.id)
+        cons = {(c.ltarget, c.operand)
+                for c in stored.task_groups[0].constraints}
+        assert ("${attr.vault.version}", "is_set") in cons
+        assert ("${attr.os.signals}", "set_contains") in cons
+    finally:
+        server.shutdown()
+
+
+def test_vault_token_derivation_and_env():
+    server = Server(ServerConfig(num_schedulers=0))
+    try:
+        alloc = mock.alloc()
+        server.store.upsert_allocs(server.raft_apply(
+            "eval_update", dict(evals=[])) or 1, [alloc])
+        tokens = server.derive_vault_token(alloc.id, ["web"])
+        assert tokens["web"].startswith("s.")
+        with pytest.raises(KeyError):
+            server.derive_vault_token("nope", ["web"])
+    finally:
+        server.shutdown()
