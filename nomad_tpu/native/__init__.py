@@ -1,0 +1,91 @@
+"""Native runtime components.
+
+The reference keeps its wire codec compiled (go-msgpack + generated
+encoders); here codec.cpp is a CPython extension built on demand with
+g++ and loaded as `nomad_tpu_native_codec`. The build is cached beside
+the source keyed by source hash + python ABI; failures fall back to the
+pure-python msgpack package transparently (the wire format is
+identical, so mixed clusters interoperate).
+
+NOMAD_TPU_NATIVE=0 disables the native path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+from typing import Optional
+
+LOG = logging.getLogger("nomad_tpu.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "codec.cpp")
+_loaded = None
+_attempted = False
+
+
+def _cache_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    abi = sysconfig.get_config_var("SOABI") or "abi3"
+    cache_dir = os.environ.get(
+        "NOMAD_TPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "nomad-tpu"))
+    os.makedirs(cache_dir, exist_ok=True)
+    return os.path.join(cache_dir,
+                        f"nomad_tpu_native_codec-{digest}.{abi}.so")
+
+
+def _build(so_path: str) -> bool:
+    include = sysconfig.get_path("include")
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+           f"-I{include}", _SRC, "-o", so_path + ".tmp"]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        LOG.warning("native codec build failed to run: %s", e)
+        return False
+    if out.returncode != 0:
+        LOG.warning("native codec build failed:\n%s", out.stderr[-2000:])
+        return False
+    os.replace(so_path + ".tmp", so_path)
+    return True
+
+
+def load_codec():
+    """Returns the native codec module, or None (with msgpack fallback
+    left to the caller)."""
+    global _loaded, _attempted
+    if _loaded is not None or _attempted:
+        return _loaded
+    _attempted = True
+    if os.environ.get("NOMAD_TPU_NATIVE", "1") == "0":
+        return None
+    try:
+        so = _cache_path()
+        if not os.path.exists(so) and not _build(so):
+            return None
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "nomad_tpu_native_codec", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        # self-check before trusting it on the wire
+        probe = {"a": [1, -7, 2.5, "x", b"\x00\xff", None, True],
+                 "nested": {"k": [list(range(40))]}}
+        import msgpack
+        if msgpack.unpackb(mod.packb(probe), raw=False) != probe or \
+                mod.unpackb(msgpack.packb(probe, use_bin_type=True)) \
+                != probe:
+            LOG.warning("native codec self-check failed; falling back")
+            return None
+        _loaded = mod
+        return mod
+    except Exception as e:       # pragma: no cover — env-dependent
+        LOG.warning("native codec unavailable: %s", e)
+        return None

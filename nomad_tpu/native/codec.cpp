@@ -1,0 +1,314 @@
+// Native msgpack codec for the RPC wire format.
+//
+// The reference's hot wire path is a compiled codec (go-msgpack,
+// nomad/rpc.go:27 + structs.generated.go codegen); this is the rebuild's
+// equivalent: a CPython extension encoding/decoding the msgpack subset
+// the RPC layer and WAL use (nil, bool, int, float64, str, bin, array,
+// map). Output is standard msgpack, wire-compatible with python-msgpack
+// peers in mixed clusters.
+//
+// Built on demand by nomad_tpu/native/__init__.py (g++ -O2 -shared),
+// loaded as the module `nomad_tpu_native_codec`.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------
+// encoder
+// ---------------------------------------------------------------------
+struct Encoder {
+  std::vector<uint8_t> buf;
+
+  void put(uint8_t b) { buf.push_back(b); }
+  void put_bytes(const void* p, size_t n) {
+    const uint8_t* c = static_cast<const uint8_t*>(p);
+    buf.insert(buf.end(), c, c + n);
+  }
+  void put_be16(uint16_t v) {
+    put(v >> 8); put(v & 0xff);
+  }
+  void put_be32(uint32_t v) {
+    put(v >> 24); put((v >> 16) & 0xff); put((v >> 8) & 0xff);
+    put(v & 0xff);
+  }
+  void put_be64(uint64_t v) {
+    for (int s = 56; s >= 0; s -= 8) put((v >> s) & 0xff);
+  }
+
+  bool encode(PyObject* obj);
+
+  bool encode_long(PyObject* obj) {
+    int overflow = 0;
+    long long v = PyLong_AsLongLongAndOverflow(obj, &overflow);
+    if (overflow > 0) {
+      unsigned long long u = PyLong_AsUnsignedLongLong(obj);
+      if (PyErr_Occurred()) return false;
+      put(0xcf); put_be64(u);
+      return true;
+    }
+    if (overflow < 0) {
+      PyErr_SetString(PyExc_OverflowError, "int too small for msgpack");
+      return false;
+    }
+    if (v >= 0) {
+      if (v < 0x80) { put(static_cast<uint8_t>(v)); }
+      else if (v <= 0xff) { put(0xcc); put(static_cast<uint8_t>(v)); }
+      else if (v <= 0xffff) { put(0xcd); put_be16(v); }
+      else if (v <= 0xffffffffLL) { put(0xce); put_be32(v); }
+      else { put(0xcf); put_be64(v); }
+    } else {
+      if (v >= -32) { put(static_cast<uint8_t>(v)); }
+      else if (v >= -128) { put(0xd0); put(static_cast<uint8_t>(v)); }
+      else if (v >= -32768) { put(0xd1); put_be16(static_cast<uint16_t>(v)); }
+      else if (v >= -2147483648LL) {
+        put(0xd2); put_be32(static_cast<uint32_t>(v));
+      } else { put(0xd3); put_be64(static_cast<uint64_t>(v)); }
+    }
+    return true;
+  }
+
+  bool encode_str(PyObject* obj) {
+    Py_ssize_t n = 0;
+    const char* s = PyUnicode_AsUTF8AndSize(obj, &n);
+    if (s == nullptr) return false;
+    if (n < 32) put(0xa0 | static_cast<uint8_t>(n));
+    else if (n <= 0xff) { put(0xd9); put(static_cast<uint8_t>(n)); }
+    else if (n <= 0xffff) { put(0xda); put_be16(n); }
+    else { put(0xdb); put_be32(n); }
+    put_bytes(s, n);
+    return true;
+  }
+
+  bool encode_bin(const char* p, Py_ssize_t n) {
+    if (n <= 0xff) { put(0xc4); put(static_cast<uint8_t>(n)); }
+    else if (n <= 0xffff) { put(0xc5); put_be16(n); }
+    else { put(0xc6); put_be32(n); }
+    put_bytes(p, n);
+    return true;
+  }
+
+  bool encode_array_header(Py_ssize_t n) {
+    if (n < 16) put(0x90 | static_cast<uint8_t>(n));
+    else if (n <= 0xffff) { put(0xdc); put_be16(n); }
+    else { put(0xdd); put_be32(n); }
+    return true;
+  }
+};
+
+bool Encoder::encode(PyObject* obj) {
+  if (obj == Py_None) { put(0xc0); return true; }
+  if (obj == Py_True) { put(0xc3); return true; }
+  if (obj == Py_False) { put(0xc2); return true; }
+  if (PyLong_CheckExact(obj)) return encode_long(obj);
+  if (PyFloat_CheckExact(obj)) {
+    double d = PyFloat_AS_DOUBLE(obj);
+    uint64_t bits;
+    std::memcpy(&bits, &d, 8);
+    put(0xcb); put_be64(bits);
+    return true;
+  }
+  if (PyUnicode_CheckExact(obj)) return encode_str(obj);
+  if (PyBytes_CheckExact(obj))
+    return encode_bin(PyBytes_AS_STRING(obj), PyBytes_GET_SIZE(obj));
+  if (PyByteArray_CheckExact(obj))
+    return encode_bin(PyByteArray_AS_STRING(obj),
+                      PyByteArray_GET_SIZE(obj));
+  if (PyList_CheckExact(obj)) {
+    Py_ssize_t n = PyList_GET_SIZE(obj);
+    encode_array_header(n);
+    for (Py_ssize_t i = 0; i < n; i++)
+      if (!encode(PyList_GET_ITEM(obj, i))) return false;
+    return true;
+  }
+  if (PyTuple_CheckExact(obj)) {
+    Py_ssize_t n = PyTuple_GET_SIZE(obj);
+    encode_array_header(n);
+    for (Py_ssize_t i = 0; i < n; i++)
+      if (!encode(PyTuple_GET_ITEM(obj, i))) return false;
+    return true;
+  }
+  if (PyDict_CheckExact(obj)) {
+    Py_ssize_t n = PyDict_GET_SIZE(obj);
+    if (n < 16) put(0x80 | static_cast<uint8_t>(n));
+    else if (n <= 0xffff) { put(0xde); put_be16(n); }
+    else { put(0xdf); put_be32(n); }
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(obj, &pos, &key, &value)) {
+      if (!encode(key)) return false;
+      if (!encode(value)) return false;
+    }
+    return true;
+  }
+  // fall back: bools subclass int etc.
+  if (PyBool_Check(obj)) { put(obj == Py_True ? 0xc3 : 0xc2); return true; }
+  if (PyLong_Check(obj)) return encode_long(obj);
+  if (PyUnicode_Check(obj)) return encode_str(obj);
+  PyErr_Format(PyExc_TypeError, "cannot msgpack-encode %s",
+               Py_TYPE(obj)->tp_name);
+  return false;
+}
+
+// ---------------------------------------------------------------------
+// decoder
+// ---------------------------------------------------------------------
+struct Decoder {
+  const uint8_t* p;
+  const uint8_t* end;
+
+  bool need(size_t n) {
+    if (static_cast<size_t>(end - p) < n) {
+      PyErr_SetString(PyExc_ValueError, "msgpack: truncated input");
+      return false;
+    }
+    return true;
+  }
+  uint64_t be(size_t n) {
+    uint64_t v = 0;
+    for (size_t i = 0; i < n; i++) v = (v << 8) | p[i];
+    p += n;
+    return v;
+  }
+
+  PyObject* decode();
+
+  PyObject* decode_str(size_t n) {
+    if (!need(n)) return nullptr;
+    PyObject* s = PyUnicode_DecodeUTF8(
+        reinterpret_cast<const char*>(p), n, "replace");
+    p += n;
+    return s;
+  }
+  PyObject* decode_bin(size_t n) {
+    if (!need(n)) return nullptr;
+    PyObject* b = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(p), n);
+    p += n;
+    return b;
+  }
+  PyObject* decode_array(size_t n) {
+    PyObject* lst = PyList_New(n);
+    if (!lst) return nullptr;
+    for (size_t i = 0; i < n; i++) {
+      PyObject* item = decode();
+      if (!item) { Py_DECREF(lst); return nullptr; }
+      PyList_SET_ITEM(lst, i, item);
+    }
+    return lst;
+  }
+  PyObject* decode_map(size_t n) {
+    PyObject* d = PyDict_New();
+    if (!d) return nullptr;
+    for (size_t i = 0; i < n; i++) {
+      PyObject* k = decode();
+      if (!k) { Py_DECREF(d); return nullptr; }
+      PyObject* v = decode();
+      if (!v) { Py_DECREF(k); Py_DECREF(d); return nullptr; }
+      PyDict_SetItem(d, k, v);
+      Py_DECREF(k);
+      Py_DECREF(v);
+    }
+    return d;
+  }
+};
+
+PyObject* Decoder::decode() {
+  if (!need(1)) return nullptr;
+  uint8_t tag = *p++;
+  if (tag < 0x80) return PyLong_FromLong(tag);
+  if (tag >= 0xe0) return PyLong_FromLong(static_cast<int8_t>(tag));
+  if ((tag & 0xf0) == 0x80) return decode_map(tag & 0x0f);
+  if ((tag & 0xf0) == 0x90) return decode_array(tag & 0x0f);
+  if ((tag & 0xe0) == 0xa0) return decode_str(tag & 0x1f);
+  switch (tag) {
+    case 0xc0: Py_RETURN_NONE;
+    case 0xc2: Py_RETURN_FALSE;
+    case 0xc3: Py_RETURN_TRUE;
+    case 0xc4: if (!need(1)) return nullptr; return decode_bin(be(1));
+    case 0xc5: if (!need(2)) return nullptr; return decode_bin(be(2));
+    case 0xc6: if (!need(4)) return nullptr; return decode_bin(be(4));
+    case 0xca: {
+      if (!need(4)) return nullptr;
+      uint32_t bits = static_cast<uint32_t>(be(4));
+      float f;
+      std::memcpy(&f, &bits, 4);
+      return PyFloat_FromDouble(f);
+    }
+    case 0xcb: {
+      if (!need(8)) return nullptr;
+      uint64_t bits = be(8);
+      double d;
+      std::memcpy(&d, &bits, 8);
+      return PyFloat_FromDouble(d);
+    }
+    case 0xcc: if (!need(1)) return nullptr; return PyLong_FromUnsignedLongLong(be(1));
+    case 0xcd: if (!need(2)) return nullptr; return PyLong_FromUnsignedLongLong(be(2));
+    case 0xce: if (!need(4)) return nullptr; return PyLong_FromUnsignedLongLong(be(4));
+    case 0xcf: if (!need(8)) return nullptr; return PyLong_FromUnsignedLongLong(be(8));
+    case 0xd0: if (!need(1)) return nullptr; return PyLong_FromLongLong(static_cast<int8_t>(be(1)));
+    case 0xd1: if (!need(2)) return nullptr; return PyLong_FromLongLong(static_cast<int16_t>(be(2)));
+    case 0xd2: if (!need(4)) return nullptr; return PyLong_FromLongLong(static_cast<int32_t>(be(4)));
+    case 0xd3: if (!need(8)) return nullptr; return PyLong_FromLongLong(static_cast<int64_t>(be(8)));
+    case 0xd9: if (!need(1)) return nullptr; return decode_str(be(1));
+    case 0xda: if (!need(2)) return nullptr; return decode_str(be(2));
+    case 0xdb: if (!need(4)) return nullptr; return decode_str(be(4));
+    case 0xdc: if (!need(2)) return nullptr; return decode_array(be(2));
+    case 0xdd: if (!need(4)) return nullptr; return decode_array(be(4));
+    case 0xde: if (!need(2)) return nullptr; return decode_map(be(2));
+    case 0xdf: if (!need(4)) return nullptr; return decode_map(be(4));
+  }
+  PyErr_Format(PyExc_ValueError, "msgpack: unsupported tag 0x%02x", tag);
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------
+// module
+// ---------------------------------------------------------------------
+PyObject* py_packb(PyObject*, PyObject* arg) {
+  Encoder enc;
+  enc.buf.reserve(256);
+  if (!enc.encode(arg)) return nullptr;
+  return PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(enc.buf.data()), enc.buf.size());
+}
+
+PyObject* py_unpackb(PyObject*, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) return nullptr;
+  Decoder dec;
+  dec.p = static_cast<const uint8_t*>(view.buf);
+  dec.end = dec.p + view.len;
+  PyObject* out = dec.decode();
+  if (out != nullptr && dec.p != dec.end) {
+    Py_DECREF(out);
+    out = nullptr;
+    PyErr_SetString(PyExc_ValueError, "msgpack: trailing bytes");
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+PyMethodDef methods[] = {
+    {"packb", py_packb, METH_O, "Encode a value tree to msgpack bytes"},
+    {"unpackb", py_unpackb, METH_O, "Decode msgpack bytes"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "nomad_tpu_native_codec",
+    "Native msgpack codec for the RPC wire format", -1, methods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+extern "C" PyMODINIT_FUNC PyInit_nomad_tpu_native_codec(void) {
+  return PyModule_Create(&moduledef);
+}

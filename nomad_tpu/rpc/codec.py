@@ -24,6 +24,14 @@ class RpcError(Exception):
 
 
 def _default_backend():
+    # the native C++ codec (nomad_tpu/native/codec.cpp) when it builds
+    # and self-checks; python-msgpack otherwise — both speak standard
+    # msgpack, so mixed clusters interoperate
+    from ..native import load_codec
+    native = load_codec()
+    if native is not None:
+        return native.packb, native.unpackb
+
     import msgpack
 
     def dumps(obj):
