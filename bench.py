@@ -121,6 +121,16 @@ def main() -> None:
     except Exception as e:
         traceback.print_exc(file=sys.stderr)
         out["ladder_error"] = f"{type(e).__name__}: {e}"
+
+    # ladder #5 — C2M scale (50k nodes, pre-seeded allocs, resident
+    # table). Sized to stay within the bench's time budget.
+    try:
+        from nomad_tpu.bench.ladder import bench_c2m_scale
+        out.update(bench_c2m_scale(n_nodes=50000, seed_allocs=40000,
+                                   n_service=10))
+    except Exception as e:
+        traceback.print_exc(file=sys.stderr)
+        out["c2m_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(out))
 
 

@@ -204,6 +204,98 @@ def _preemption_config():
             service_scheduler_enabled=True))
 
 
+def bench_c2m_scale(n_nodes: int = 50000, seed_allocs: int = 100000,
+                    batch_count: int = 10000, n_service: int = 10) -> Dict:
+    """Ladder #5 (C2M replay scale): a 50k-node cluster pre-loaded with
+    ~100k running allocs via bulk plan applies, then (a) a 10k-instance
+    batch job e2e and (b) service-eval p99 — all against the resident
+    delta-maintained node table (no per-eval rebuild)."""
+    from ..mock import fixtures as mock
+    from ..scheduler.harness import Harness
+
+    h = Harness()
+    _seed_nodes(h, n_nodes)
+
+    # bulk-load running allocs through the real plan-apply path in
+    # chunks (the C2M substrate: ~2 allocs/node at the default sizes)
+    filler = mock.batch_job()
+    filler.datacenters = [f"dc{d}" for d in (1, 2, 3, 4)]
+    filler.priority = 20
+    t0 = time.perf_counter()
+    remaining = seed_allocs
+    chunk = 20000
+    while remaining > 0:
+        filler_chunk = mock.batch_job()
+        filler_chunk.id = f"filler-{remaining}"
+        filler_chunk.datacenters = filler.datacenters
+        tg = filler_chunk.task_groups[0]
+        tg.count = min(chunk, remaining)
+        tg.tasks[0].resources.cpu = 50
+        tg.tasks[0].resources.memory_mb = 64
+        tg.tasks[0].resources.networks = []
+        tg.networks = []
+        h.store.upsert_job(h.next_index(), filler_chunk)
+        h.process("batch", _eval_for(filler_chunk))
+        remaining -= tg.count
+    seed_s = time.perf_counter() - t0
+    total_allocs = len(list(h.store.allocs()))
+
+    # (a) batch throughput at scale
+    job = mock.batch_job()
+    job.id = "c2m-batch"
+    job.datacenters = filler.datacenters
+    tg = job.task_groups[0]
+    tg.count = batch_count
+    tg.tasks[0].resources.networks = []
+    tg.networks = []
+    h.store.upsert_job(h.next_index(), job)
+    t0 = time.perf_counter()
+    h.process("batch", _eval_for(job))
+    batch_s = time.perf_counter() - t0
+    placed = sum(len(a) for a in h.plans[-1].node_allocation.values())
+
+    # (b) service p99 at scale (spread + affinity live)
+    from ..models import Affinity, Spread, SpreadTarget
+
+    def make_svc(i):
+        svc = mock.job()
+        svc.id = f"c2m-svc-{i}"
+        svc.datacenters = filler.datacenters
+        tg = svc.task_groups[0]
+        tg.count = 10
+        for t in tg.tasks:
+            t.resources.networks = []
+        tg.networks = []
+        tg.spreads = [Spread(attribute="${node.datacenter}", weight=50,
+                             spread_target=[SpreadTarget("dc1", 40),
+                                            SpreadTarget("dc2", 30)])]
+        tg.affinities = [Affinity(ltarget="${meta.rack}", rtarget="r3",
+                                  operand="=", weight=50)]
+        return svc
+
+    warm = make_svc(10**6)
+    h.store.upsert_job(h.next_index(), warm)
+    h.process("service", _eval_for(warm))   # compile at this table shape
+
+    times: List[float] = []
+    for i in range(n_service):
+        svc = make_svc(i)
+        h.store.upsert_job(h.next_index(), svc)
+        t0 = time.perf_counter()
+        h.process("service", _eval_for(svc))
+        times.append(time.perf_counter() - t0)
+    arr = np.array(times)
+    return {
+        "c2m_nodes": n_nodes,
+        "c2m_allocs": total_allocs,
+        "c2m_seed_rate": round(seed_allocs / seed_s, 1),
+        "c2m_batch_placements_per_sec": round(placed / batch_s, 1),
+        "c2m_batch_placed": placed,
+        "c2m_service_p99_ms": round(float(np.percentile(arr, 99) * 1e3), 1),
+        "c2m_service_p50_ms": round(float(np.percentile(arr, 50) * 1e3), 1),
+    }
+
+
 def run_ladder(quick: bool = False) -> Dict:
     """Run the full ladder; returns a flat dict of results."""
     out: Dict = {}

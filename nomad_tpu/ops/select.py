@@ -384,23 +384,42 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
         after = used + ask[None, :]
         fit_dims = after <= capacity + 1e-6
         fit = jnp.all(fit_dims, axis=1)
-        prefix_ok = jnp.cumprod(fit_dims.astype(jnp.int32), axis=1)
-        earlier_ok = jnp.concatenate(
-            [jnp.ones((n, 1), dtype=bool), prefix_ok[:, :-1].astype(bool)],
-            axis=1)
-        first_fail = feas[:, None] & earlier_ok & ~fit_dims
-        exhausted = first_fail.sum(axis=0).astype(jnp.int32)
 
         final, _b, _a, _p = _local_final_score(
             after, cap_cpu, cap_mem, coll, penalty, affinity_norm,
             desired_count, spread_alg, dev_score, dev_fires, pre_score)
         ok = feas & fit
         masked = jnp.where(ok, final, NEG_INF)
-        top_scores, top_idx = jax.lax.top_k(masked, max(TOP_K, 2))
-        choice = top_idx[0]
-        valid = top_scores[0] > NEG_INF / 2
-        runner_val = top_scores[1]
-        runner_idx = top_idx[1]
+        # winner + runner-up as two argmax reductions — a full top_k
+        # over the node axis per step dominates large tables
+        choice = jnp.argmax(masked)
+        valid = masked[choice] > NEG_INF / 2
+        masked2 = masked.at[choice].set(NEG_INF)
+        runner_idx = jnp.argmax(masked2)
+        runner_val = masked2[runner_idx]
+
+        # diagnostics (top-k score meta + per-dimension exhaustion) are
+        # only materialized on the first step and on failing steps; the
+        # host reuses the dispatch-level snapshot for later chunks
+        def _meta(_):
+            top_scores, top_idx = jax.lax.top_k(masked, TOP_K)
+            prefix_ok = jnp.cumprod(fit_dims.astype(jnp.int32), axis=1)
+            earlier_ok = jnp.concatenate(
+                [jnp.ones((n, 1), dtype=bool),
+                 prefix_ok[:, :-1].astype(bool)], axis=1)
+            first_fail = feas[:, None] & earlier_ok & ~fit_dims
+            return (top_idx.astype(jnp.int32), top_scores,
+                    first_fail.sum(axis=0).astype(jnp.int32),
+                    ok.sum().astype(jnp.int32))
+
+        def _no_meta(_):
+            return (jnp.full((TOP_K,), -1, jnp.int32),
+                    jnp.full((TOP_K,), NEG_INF, jnp.float32),
+                    jnp.full((capacity.shape[1],), -1, jnp.int32),
+                    jnp.int32(-1))
+
+        top_idx, top_scores, exhausted, feas_count = jax.lax.cond(
+            (step == 0) | ~valid, _meta, _no_meta, operand=None)
 
         # max instances that physically fit on the chosen node
         free_dims = capacity[choice] - used[choice]
@@ -432,19 +451,20 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
         chunk = jnp.where(valid, chunk, 0.0)
         chunk_i = chunk.astype(jnp.int32)
 
-        onehot = (jnp.arange(n) == choice) & valid
-        used = used + jnp.where(onehot[:, None], chunk * ask[None, :], 0.0)
-        coll = coll + jnp.where(onehot, chunk_i, 0)
-        free_p = free_p - onehot.astype(jnp.float32) * chunk * port_need
-        dev_slots = dev_slots - onehot.astype(jnp.float32) * chunk
+        # indexed scatters: chunk is 0 on invalid steps, so the adds
+        # are no-ops without O(N) select masks
+        used = used.at[choice].add(chunk * ask)
+        coll = coll.at[choice].add(chunk_i)
+        free_p = free_p.at[choice].add(-chunk * port_need)
+        dev_slots = dev_slots.at[choice].add(-chunk)
 
         out_choice = out_choice.at[step].set(
             jnp.where(valid, choice, -1).astype(jnp.int32))
         out_chunk = out_chunk.at[step].set(chunk_i)
-        out_ti = out_ti.at[step].set(top_idx[:TOP_K].astype(jnp.int32))
-        out_ts = out_ts.at[step].set(top_scores[:TOP_K])
+        out_ti = out_ti.at[step].set(top_idx)
+        out_ts = out_ts.at[step].set(top_scores)
         out_exh = out_exh.at[step].set(exhausted)
-        out_feas = out_feas.at[step].set(ok.sum().astype(jnp.int32))
+        out_feas = out_feas.at[step].set(feas_count)
 
         return (used, coll, free_p, dev_slots, remaining - chunk_i,
                 step + 1, valid,
@@ -786,12 +806,18 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
     pos = 0
     extra = {}                               # node -> already placed here
     fail = None
+    # the kernel materializes top-k/exhaustion meta only on the first
+    # and failing steps; ordinary steps carry sentinels and reuse the
+    # dispatch-level snapshot
+    last_meta = None
     for (choice, chunk, ti, ts, exh, _feas) in rounds:
         for s in range(len(choice)):
             c = int(choice[s])
             m = int(chunk[s])
+            if exh[s][0] >= 0:
+                last_meta = (ti[s], ts[s], exh[s])
             if m <= 0 or c < 0:
-                fail = (ti[s], ts[s], exh[s])
+                fail = last_meta
                 continue
             m = min(m, k_total - pos)
             prior = extra.get(c, 0)
@@ -838,9 +864,11 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
             s_aff[sl] = aff
             s_dev[sl] = dev
             s_pre[sl] = pre
-            top_i[sl] = np.where(ti[s] >= n, -1, ti[s])
-            top_s[sl] = ts[s]
-            exh_out[sl] = exh[s]
+            m_ti, m_ts, m_exh = last_meta if last_meta is not None \
+                else (ti[s], ts[s], np.zeros_like(exh[s]))
+            top_i[sl] = np.where(m_ti >= n, -1, m_ti)
+            top_s[sl] = m_ts
+            exh_out[sl] = np.maximum(m_exh, 0)
             extra[c] = prior + m
             pos += m
     if fail is not None and pos < k_total:
