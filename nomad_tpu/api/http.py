@@ -17,6 +17,7 @@ per-domain handlers in command/agent/*_endpoint.go. Routes:
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -31,8 +32,15 @@ from ..utils.codec import from_wire, to_wire
 
 
 class HTTPApiServer:
-    def __init__(self, server, host: str = "127.0.0.1", port: int = 4646):
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 4646,
+                 alloc_dir_bases=None):
         self.server = server
+        # where co-located clients keep alloc dirs — lets the agent
+        # serve fs/logs endpoints directly (the reference forwards
+        # these to the client over RPC, client/fs_endpoint.go)
+        import tempfile
+        self.alloc_dir_bases = list(alloc_dir_bases or []) + [
+            os.path.join(tempfile.gettempdir(), "nomad-tpu-allocs")]
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -169,6 +177,14 @@ class HTTPApiServer:
                             "/v1/deployment")):
             need(acl.allow_namespace_operation(
                 ns, "submit-job" if write else "read-job"))
+            return
+        if path.startswith("/v1/client/fs/"):
+            # logs need read-logs; browsing/reading arbitrary files
+            # needs read-fs (the reference splits these capabilities)
+            if path.startswith("/v1/client/fs/logs/"):
+                need(acl.allow_namespace_operation(ns, "read-logs"))
+            else:
+                need(acl.allow_namespace_operation(ns, "read-fs"))
             return
         if path == "/v1/volumes" or path.startswith("/v1/volume/"):
             need(acl.allow_namespace_operation(
@@ -475,6 +491,10 @@ class HTTPApiServer:
         if path == "/v1/status/leader":
             return "127.0.0.1:4647", idx
 
+        m = re.match(r"^/v1/client/fs/(logs|ls|cat)/([^/]+)$", path)
+        if m and method == "GET":
+            return self._client_fs(m.group(1), m.group(2), q, ns, idx)
+
         if path == "/v1/volumes" and method == "GET":
             vols = store.csi_volumes(ns)
             return [v.stub() for v in vols], idx
@@ -582,6 +602,88 @@ class HTTPApiServer:
         return {"Matches": matches, "Truncations": truncations}
 
     # -- event stream (nomad/stream/ndjson.go over chunked HTTP) --------
+    def _alloc_base(self, alloc_id: str) -> Optional[str]:
+        for base in self.alloc_dir_bases:
+            p = os.path.join(base, alloc_id)
+            if os.path.isdir(p):
+                return p
+        return None
+
+    def _client_fs(self, op: str, alloc_prefix: str, q: dict, ns: str,
+                   idx: int):
+        """/v1/client/fs/{logs,ls,cat} (client/fs_endpoint.go): serve a
+        co-located alloc's log files and directory tree. The alloc must
+        live in the request's (ACL-checked) namespace."""
+        alloc = self._unique_prefix(
+            [a for a in self.server.store.allocs() if a.namespace == ns],
+            alloc_prefix, "allocation")
+        if alloc is None:
+            return None
+        base = self._alloc_base(alloc.id)
+        if base is None:
+            raise KeyError(f"alloc dir for {alloc.id[:8]} not found "
+                           f"on this agent")
+        if op == "logs":
+            task = q.get("task", "")
+            if not task:
+                tg = alloc.job.lookup_task_group(alloc.task_group) \
+                    if alloc.job else None
+                if tg and len(tg.tasks) == 1:
+                    task = tg.tasks[0].name
+                else:
+                    raise ValueError("task parameter required")
+            stream = q.get("type", "stdout")
+            log_dir = os.path.join(base, "alloc", "logs")
+            try:
+                names = sorted(
+                    (f for f in os.listdir(log_dir)
+                     if f.startswith(f"{task}.{stream}.")),
+                    key=lambda f: int(f.rsplit(".", 1)[1]))
+            except (FileNotFoundError, ValueError):
+                names = []
+            # offset-aware: stat sizes, open/seek only the tail files
+            # instead of joining every rotated file per poll
+            offset = int(q.get("offset", 0))
+            paths = [os.path.join(log_dir, f) for f in names]
+            sizes = [os.path.getsize(p) for p in paths]
+            total = sum(sizes)
+            chunks = []
+            skip = offset
+            for p, size in zip(paths, sizes):
+                if skip >= size:
+                    skip -= size
+                    continue
+                with open(p, "rb") as f:
+                    if skip:
+                        f.seek(skip)
+                        skip = 0
+                    chunks.append(f.read())
+            data = b"".join(chunks)
+            return {"Data": data.decode("utf-8", "replace"),
+                    "Offset": total}, idx
+        rel = q.get("path", "/").lstrip("/")
+        target = os.path.realpath(os.path.join(base, rel))
+        real_base = os.path.realpath(base)
+        if target != real_base and \
+                not target.startswith(real_base + os.sep):
+            raise ValueError("path escapes the alloc dir")
+        if op == "ls":
+            if not os.path.isdir(target):
+                return None
+            out = []
+            for name in sorted(os.listdir(target)):
+                p = os.path.join(target, name)
+                out.append({"Name": name,
+                            "IsDir": os.path.isdir(p),
+                            "Size": os.path.getsize(p)
+                            if os.path.isfile(p) else 0})
+            return out, idx
+        # cat
+        if not os.path.isfile(target):
+            return None
+        with open(target, "rb") as f:
+            return {"Data": f.read().decode("utf-8", "replace")}, idx
+
     def stream_monitor(self, handler, q: dict):
         """/v1/agent/monitor (agent_endpoint.go monitor): stream agent
         log lines as NDJSON at >= log_level."""

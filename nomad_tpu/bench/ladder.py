@@ -218,16 +218,15 @@ def bench_c2m_scale(n_nodes: int = 50000, seed_allocs: int = 100000,
 
     # bulk-load running allocs through the real plan-apply path in
     # chunks (the C2M substrate: ~2 allocs/node at the default sizes)
-    filler = mock.batch_job()
-    filler.datacenters = [f"dc{d}" for d in (1, 2, 3, 4)]
-    filler.priority = 20
+    dcs = [f"dc{d}" for d in (1, 2, 3, 4)]
     t0 = time.perf_counter()
     remaining = seed_allocs
     chunk = 20000
     while remaining > 0:
         filler_chunk = mock.batch_job()
         filler_chunk.id = f"filler-{remaining}"
-        filler_chunk.datacenters = filler.datacenters
+        filler_chunk.priority = 20
+        filler_chunk.datacenters = dcs
         tg = filler_chunk.task_groups[0]
         tg.count = min(chunk, remaining)
         tg.tasks[0].resources.cpu = 50
@@ -243,7 +242,7 @@ def bench_c2m_scale(n_nodes: int = 50000, seed_allocs: int = 100000,
     # (a) batch throughput at scale
     job = mock.batch_job()
     job.id = "c2m-batch"
-    job.datacenters = filler.datacenters
+    job.datacenters = dcs
     tg = job.task_groups[0]
     tg.count = batch_count
     tg.tasks[0].resources.networks = []
@@ -260,7 +259,7 @@ def bench_c2m_scale(n_nodes: int = 50000, seed_allocs: int = 100000,
     def make_svc(i):
         svc = mock.job()
         svc.id = f"c2m-svc-{i}"
-        svc.datacenters = filler.datacenters
+        svc.datacenters = dcs
         tg = svc.task_groups[0]
         tg.count = 10
         for t in tg.tasks:

@@ -75,18 +75,23 @@ def cmd_agent(args) -> int:
             server.attach_raft(rpc, peers)
         server.start()
         rpc.start()
-        api = HTTPApiServer(server, port=args.http_port)
+        api = HTTPApiServer(server, port=args.http_port,
+                            alloc_dir_bases=[args.alloc_dir_base]
+                            if args.alloc_dir_base else None)
         api.start()
 
     n_local_clients = args.clients if is_client else 0
     for i in range(n_local_clients):
         if server is not None:
-            c = Client(server, ClientConfig(node_name=f"dev-client-{i}"))
+            c = Client(server, ClientConfig(
+                node_name=f"dev-client-{i}",
+                alloc_dir=args.alloc_dir_base))
         else:
             from ..rpc import RemoteTransport
             c = Client(RemoteTransport(args.servers),
                        ClientConfig(node_name=args.node_name or
-                                    f"client-{i}"))
+                                    f"client-{i}",
+                                    alloc_dir=args.alloc_dir_base))
         c.start()
         clients.append(c)
 
@@ -574,6 +579,34 @@ def cmd_server_info(args) -> int:
     return 0
 
 
+def cmd_alloc_logs(args) -> int:
+    c = _client(args)
+    try:
+        out = c._request(
+            "GET", f"/v1/client/fs/logs/{args.alloc_id}",
+            params={"task": args.task,
+                    "type": "stderr" if args.stderr else "stdout"})
+    except ApiError as e:
+        print(f"Error reading logs: {e}", file=sys.stderr)
+        return 1
+    sys.stdout.write(out.get("Data", ""))
+    return 0
+
+
+def cmd_alloc_fs(args) -> int:
+    c = _client(args)
+    try:
+        out = c._request("GET", f"/v1/client/fs/ls/{args.alloc_id}",
+                         params={"path": args.path})
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    rows = [["d" if e["IsDir"] else "-", str(e["Size"]), e["Name"]]
+            for e in out]
+    _print_rows(rows, ["Mode", "Size", "Name"])
+    return 0
+
+
 # -- acl ---------------------------------------------------------------
 def cmd_acl_bootstrap(args) -> int:
     c = _client(args)
@@ -641,6 +674,8 @@ def build_parser() -> argparse.ArgumentParser:
     agent.add_argument("-server-peers", dest="server_peers", default="",
                        help="comma-separated rpc addrs of ALL servers "
                             "(incl. this one) to form a raft cluster")
+    agent.add_argument("-alloc-dir", dest="alloc_dir_base", default="",
+                       help="base directory for alloc dirs (fs/logs)")
     agent.add_argument("-clients", type=int, default=1)
     agent.add_argument("-num-schedulers", dest="num_schedulers", type=int,
                        default=2)
@@ -725,6 +760,15 @@ def build_parser() -> argparse.ArgumentParser:
     astatus = alloc.add_parser("status")
     astatus.add_argument("alloc_id")
     astatus.set_defaults(fn=cmd_alloc_status)
+    alogs = alloc.add_parser("logs")
+    alogs.add_argument("alloc_id")
+    alogs.add_argument("task", nargs="?", default="")
+    alogs.add_argument("-stderr", action="store_true")
+    alogs.set_defaults(fn=cmd_alloc_logs)
+    afs = alloc.add_parser("fs")
+    afs.add_argument("alloc_id")
+    afs.add_argument("path", nargs="?", default="/")
+    afs.set_defaults(fn=cmd_alloc_fs)
 
     ev = sub.add_parser("eval").add_subparsers(dest="sub")
     estatus = ev.add_parser("status")

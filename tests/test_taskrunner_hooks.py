@@ -215,3 +215,93 @@ def test_artifact_failure_fails_task(tmp_path):
     finally:
         client.shutdown()
         server.shutdown()
+
+
+@pytest.mark.slow
+def test_fs_and_logs_http_endpoints(tmp_path):
+    """/v1/client/fs/{logs,ls,cat} serve a co-located alloc's files
+    (client/fs_endpoint.go analog)."""
+    from nomad_tpu.api import HTTPApiServer
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.client import Client, ClientConfig
+    from nomad_tpu.server import Server, ServerConfig
+
+    alloc_base = tmp_path / "allocs"
+    server = Server(ServerConfig(num_schedulers=2, heartbeat_ttl_s=30.0))
+    server.start()
+    client = Client(server, ClientConfig(node_name="fs-client",
+                                         alloc_dir=str(alloc_base)))
+    client.start()
+    api = HTTPApiServer(server, port=0,
+                        alloc_dir_bases=[str(alloc_base)])
+    api.start()
+    try:
+        job = mock.batch_job()
+        job.task_groups[0].count = 1
+        task = job.task_groups[0].tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh",
+                       "args": ["-c", "echo log-line-abc"]}
+        server.register_job(job)
+        assert _wait_for(lambda: all(
+            a.client_status == ALLOC_CLIENT_COMPLETE
+            for a in server.store.allocs_by_job("default", job.id))
+            and server.store.allocs_by_job("default", job.id))
+        alloc = server.store.allocs_by_job("default", job.id)[0]
+
+        c = ApiClient(f"http://127.0.0.1:{api.port}")
+        out = {}
+        assert _wait_for(lambda: "log-line-abc" in (out.update(
+            d=c._request("GET", f"/v1/client/fs/logs/{alloc.id}",
+                         params={"task": task.name})) or out["d"]["Data"]))
+        # prefix lookup + default task resolution
+        short = c._request("GET", f"/v1/client/fs/logs/{alloc.id[:8]}")
+        assert "log-line-abc" in short["Data"]
+        # ls + cat + escape protection
+        ls = c._request("GET", f"/v1/client/fs/ls/{alloc.id}",
+                        params={"path": "/alloc/logs"})
+        names = [e["Name"] for e in ls]
+        assert f"{task.name}.stdout.0" in names
+        cat = c._request("GET", f"/v1/client/fs/cat/{alloc.id}",
+                         params={"path":
+                                 f"/alloc/logs/{task.name}.stdout.0"})
+        assert "log-line-abc" in cat["Data"]
+        from nomad_tpu.api.client import ApiError
+        with pytest.raises(ApiError):
+            c._request("GET", f"/v1/client/fs/cat/{alloc.id}",
+                       params={"path": "/../../../etc/passwd"})
+    finally:
+        api.shutdown()
+        client.shutdown()
+        server.shutdown()
+
+
+def test_fs_endpoint_namespace_isolation(tmp_path):
+    """An alloc is only addressable through its own namespace
+    (review: cross-namespace fs bypass)."""
+    from nomad_tpu.api import HTTPApiServer
+    from nomad_tpu.api.client import ApiClient, ApiError
+    from nomad_tpu.server import Server, ServerConfig
+
+    server = Server(ServerConfig(num_schedulers=0))
+    api = HTTPApiServer(server, port=0,
+                        alloc_dir_bases=[str(tmp_path)])
+    api.start()
+    try:
+        a = mock.alloc()
+        a.namespace = "secret"
+        server.store.upsert_allocs(1, [a])
+        os.makedirs(tmp_path / a.id / "alloc" / "logs", exist_ok=True)
+        c = ApiClient(f"http://127.0.0.1:{api.port}")
+        # default-namespace request must not resolve the secret alloc
+        with pytest.raises(ApiError) as e:
+            c._request("GET", f"/v1/client/fs/ls/{a.id}",
+                       params={"path": "/"})
+        assert e.value.status == 404
+        # through its own namespace it resolves
+        out = c._request("GET", f"/v1/client/fs/ls/{a.id}",
+                         params={"path": "/", "namespace": "secret"})
+        assert isinstance(out, list)
+    finally:
+        api.shutdown()
+        server.shutdown()
