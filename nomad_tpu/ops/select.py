@@ -164,14 +164,6 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
         after = used + ask[None, :]
         fit_dims = after <= capacity + 1e-6
         fit = jnp.all(fit_dims, axis=1)
-        # first-failing-dimension counts (metrics), dimension-generic in
-        # DIM_NAMES order (cpu > memory > disk > network)
-        prefix_ok = jnp.cumprod(fit_dims.astype(jnp.int32), axis=1)
-        earlier_ok = jnp.concatenate(
-            [jnp.ones((n, 1), dtype=bool), prefix_ok[:, :-1].astype(bool)],
-            axis=1)
-        first_fail = feas[:, None] & earlier_ok & ~fit_dims
-        exhausted = first_fail.sum(axis=0).astype(jnp.int32)
 
         # ---- bin-pack / spread fit score ------------------------------
         free_cpu = 1.0 - after[:, 0] / cap_cpu
@@ -262,28 +254,48 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
         valid = (masked[choice] > NEG_INF / 2) & (step_i < k_valid)
         choice_out = jnp.where(valid, choice, -1)
 
-        top_scores, top_idx = jax.lax.top_k(masked, TOP_K)
+        # diagnostics (top-k meta, per-dimension exhaustion) only on the
+        # first and failing steps — a full top_k + [N,D] scan per step
+        # dominates large tables; per-instance scores are exact always
+        def _meta(_):
+            top_scores, top_idx = jax.lax.top_k(masked, TOP_K)
+            prefix_ok = jnp.cumprod(fit_dims.astype(jnp.int32), axis=1)
+            earlier_ok = jnp.concatenate(
+                [jnp.ones((n, 1), dtype=bool),
+                 prefix_ok[:, :-1].astype(bool)], axis=1)
+            first_fail = feas[:, None] & earlier_ok & ~fit_dims
+            return (top_idx.astype(jnp.int32), top_scores,
+                    first_fail.sum(axis=0).astype(jnp.int32),
+                    ok.sum().astype(jnp.int32))
+
+        def _no_meta(_):
+            return (jnp.full((TOP_K,), -1, jnp.int32),
+                    jnp.full((TOP_K,), NEG_INF, jnp.float32),
+                    jnp.full((capacity.shape[1],), -1, jnp.int32),
+                    jnp.int32(-1))
+
+        top_idx, top_scores, exhausted, ok_count = jax.lax.cond(
+            (step_i == 0) | ~valid, _meta, _no_meta, operand=None)
 
         # ---- carry updates (the placement happens here) ---------------
-        onehot = (jnp.arange(n) == choice) & valid
-        used = used + jnp.where(onehot[:, None], ask[None, :], 0.0)
-        tg_coll = tg_coll + onehot.astype(jnp.int32)
-        job_cnt = job_cnt + onehot.astype(jnp.int32)
-        scan_placed = scan_placed + onehot.astype(jnp.int32)
-        free_p = free_p - onehot.astype(jnp.float32) * port_need
-        dev_slots = dev_slots - onehot.astype(jnp.float32)
-        c_axis = sp_counts.shape[-1]
+        inc = jnp.where(valid, 1, 0)
+        incf = inc.astype(jnp.float32)
+        used = used.at[choice].add(incf * ask)
+        tg_coll = tg_coll.at[choice].add(inc)
+        job_cnt = job_cnt.at[choice].add(inc)
+        scan_placed = scan_placed.at[choice].add(inc)
+        free_p = free_p.at[choice].add(-incf * port_need)
+        dev_slots = dev_slots.at[choice].add(-incf)
         chosen_sp_codes = sp_codes[:, choice]           # [S]
-        sp_upd = (jax.nn.one_hot(chosen_sp_codes, c_axis,
-                                 dtype=sp_counts.dtype) *
-                  jnp.where(valid, 1.0, 0.0))
-        sp_counts = sp_counts + sp_upd
-        sp_present = sp_present | (sp_upd > 0)
+        sp_counts = sp_counts.at[jnp.arange(sp_counts.shape[0]),
+                                 chosen_sp_codes].add(incf)
+        sp_present = sp_present.at[jnp.arange(sp_counts.shape[0]),
+                                   chosen_sp_codes].set(
+            sp_present[jnp.arange(sp_counts.shape[0]),
+                       chosen_sp_codes] | valid)
         chosen_dp_codes = dp_codes[:, choice]
-        dp_upd = (jax.nn.one_hot(chosen_dp_codes, dp_counts.shape[-1],
-                                 dtype=dp_counts.dtype) *
-                  jnp.where(valid, 1.0, 0.0))
-        dp_counts = dp_counts + dp_upd
+        dp_counts = dp_counts.at[jnp.arange(dp_counts.shape[0]),
+                                 chosen_dp_codes].add(incf)
 
         out = (choice_out.astype(jnp.int32),
                jnp.where(valid, masked[jnp.maximum(choice, 0)], 0.0),
@@ -294,8 +306,8 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
                jnp.where(valid, spread_total[jnp.maximum(choice, 0)], 0.0),
                jnp.where(valid, dev[jnp.maximum(choice, 0)], 0.0),
                jnp.where(valid, pre_score[jnp.maximum(choice, 0)], 0.0),
-               top_idx.astype(jnp.int32), top_scores,
-               exhausted, ok.sum().astype(jnp.int32))
+               top_idx, top_scores,
+               exhausted, ok_count)
         return (used, tg_coll, job_cnt, scan_placed, free_p, dev_slots,
                 sp_counts, sp_present, dp_counts), out
 
@@ -614,6 +626,21 @@ def unpack_result(req: SelectRequest, outs) -> SelectResult:
     # ~100ms device round trip per output over a tunneled TPU
     (choices, finals, s_bin, s_anti, s_pen, s_aff, s_spread, s_dev, s_pre,
      top_idx, top_scores, exhausted, _ok_counts) = jax.device_get(outs)
+    # meta rows (top-k, exhaustion) are materialized only on the first
+    # and failing steps; forward-fill the sentinels in between
+    sentinel = exhausted[:, 0] < 0
+    if sentinel.any():
+        top_idx = top_idx.copy()
+        top_scores = top_scores.copy()
+        exhausted = exhausted.copy()
+        last = 0
+        for s in range(len(exhausted)):
+            if sentinel[s]:
+                top_idx[s] = top_idx[last]
+                top_scores[s] = top_scores[last]
+                exhausted[s] = exhausted[last]
+            else:
+                last = s
     n = len(req.feasible)
     kk = req.count
     choices = choices[:kk]
