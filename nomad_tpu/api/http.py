@@ -200,6 +200,9 @@ class HTTPApiServer:
             need(acl.allow_operator_write() if write
                  else acl.allow_operator_read())
             return
+        if path.startswith("/v1/system"):
+            need(acl.allow_operator_write())
+            return
         raise PermissionError("Permission denied")
 
     # -- routing -------------------------------------------------------
@@ -563,6 +566,27 @@ class HTTPApiServer:
                 dump[names.get(tid, str(tid))] = \
                     "".join(_tb.format_stack(frame))
             return {"threads": dump}, idx
+
+        if path == "/v1/operator/raft/configuration" and method == "GET":
+            raft = getattr(s, "raft", None)
+            if raft is None:
+                return {"Servers": [{"Address": "in-process",
+                                     "Leader": True, "Term": 0}],
+                        "Index": idx}, idx
+            with raft._lock:
+                servers = [{"Address": raft.self_addr,
+                            "Role": raft.role,
+                            "Leader": raft.is_leader(),
+                            "Term": raft.term,
+                            "LastLogIndex": raft.last_log()[0]}]
+                for p in raft.peers:
+                    servers.append({"Address": p,
+                                    "Leader": p == raft.leader_addr})
+            return {"Servers": servers, "Index": idx}, idx
+
+        if path == "/v1/system/gc" and method in ("PUT", "POST"):
+            s.force_gc()
+            return {"ok": True}, idx
 
         if path == "/v1/operator/scheduler/configuration":
             if method == "GET":

@@ -607,6 +607,23 @@ def cmd_alloc_fs(args) -> int:
     return 0
 
 
+def cmd_operator_raft(args) -> int:
+    c = _client(args)
+    out = c._request("GET", "/v1/operator/raft/configuration")
+    rows = [[s.get("Address", ""), s.get("Role", ""),
+             "yes" if s.get("Leader") else "no",
+             str(s.get("Term", "")), str(s.get("LastLogIndex", ""))]
+            for s in out.get("Servers", [])]
+    _print_rows(rows, ["Address", "Role", "Leader", "Term", "LastIndex"])
+    return 0
+
+
+def cmd_system_gc(args) -> int:
+    _client(args)._request("PUT", "/v1/system/gc")
+    print("GC triggered")
+    return 0
+
+
 # -- acl ---------------------------------------------------------------
 def cmd_acl_bootstrap(args) -> int:
     c = _client(args)
@@ -778,6 +795,14 @@ def build_parser() -> argparse.ArgumentParser:
     srv = sub.add_parser("server").add_subparsers(dest="sub")
     sinfo = srv.add_parser("info")
     sinfo.set_defaults(fn=cmd_server_info)
+
+    op = sub.add_parser("operator").add_subparsers(dest="sub")
+    oraft = op.add_parser("raft-status")
+    oraft.set_defaults(fn=cmd_operator_raft)
+
+    system = sub.add_parser("system").add_subparsers(dest="sub")
+    sgc = system.add_parser("gc")
+    sgc.set_defaults(fn=cmd_system_gc)
 
     acl = sub.add_parser("acl", help="ACL policies and tokens")
     acl_sub = acl.add_subparsers(dest="acl_cmd", required=True)

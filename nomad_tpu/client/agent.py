@@ -526,9 +526,40 @@ class Client:
                 # renew at half the granted TTL (client/client.go heartbeats
                 # inside the server-granted TTL window, never beyond it)
                 interval = min(self.config.heartbeat_interval_s, ttl / 2.0)
+                self._last_heartbeat_ok = time.time()
+                self._heartbeat_ttl = ttl
             except Exception:
-                LOG.exception("heartbeat failed")
+                LOG.warning("heartbeat failed", exc_info=True)
+                self._check_heartbeat_stop()
             self._stop.wait(interval)
+
+    def _check_heartbeat_stop(self) -> None:
+        """heartbeatstop.go: when the client has lost its servers past
+        the heartbeat TTL, stop allocs whose task group sets
+        stop_after_client_disconnect once that duration has elapsed
+        since the last successful heartbeat."""
+        last = getattr(self, "_last_heartbeat_ok", None)
+        if last is None:
+            return
+        ttl = getattr(self, "_heartbeat_ttl", self.config.heartbeat_interval_s)
+        offline_for = time.time() - last
+        if offline_for < ttl:
+            return
+        for runner in list(self.runners.values()):
+            if runner.destroyed:
+                continue
+            tg = runner.alloc.job.lookup_task_group(runner.alloc.task_group) \
+                if runner.alloc.job else None
+            stop_after = getattr(tg, "stop_after_client_disconnect_s",
+                                 None) if tg else None
+            if stop_after is None:
+                continue
+            if offline_for >= stop_after:
+                LOG.warning(
+                    "stopping alloc %s: client disconnected %.1fs "
+                    "(stop_after_client_disconnect=%.1fs)",
+                    runner.alloc.id[:8], offline_for, stop_after)
+                runner.stop()
 
     # -- alloc watching (client/client.go watchAllocations:1969) -------
     def _watch_allocs(self) -> None:

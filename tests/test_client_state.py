@@ -211,3 +211,62 @@ def test_client_restart_completes_short_task(tmp_path):
             c2.shutdown()
     finally:
         server.shutdown()
+
+
+def test_heartbeatstop_stops_marked_allocs():
+    """heartbeatstop.go: allocs with stop_after_client_disconnect stop
+    once the client has been server-less past the TTL + duration;
+    unmarked allocs keep running."""
+    from nomad_tpu.models import ALLOC_CLIENT_RUNNING
+    from nomad_tpu.rpc.transport import InProcTransport
+
+    class FlakyTransport(InProcTransport):
+        fail = False
+
+        def heartbeat(self, node_id):
+            if self.fail:
+                raise ConnectionError("servers unreachable")
+            return 0.2    # tiny TTL so the test is fast
+
+    server = Server(ServerConfig(num_schedulers=2, heartbeat_ttl_s=30.0))
+    server.start()
+    transport = FlakyTransport(server)
+    client = Client(transport,
+                    ClientConfig(node_name="hb-stop",
+                                 heartbeat_interval_s=0.1))
+    client.start()
+    try:
+        job = mock.batch_job()
+        job.type = "service"
+        job.id = "stops"
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.stop_after_client_disconnect_s = 0.3
+        tg.tasks[0].config = {"run_for": "60s"}
+        job.canonicalize()
+        server.register_job(job)
+
+        job2 = mock.batch_job()
+        job2.type = "service"
+        job2.id = "stays"
+        job2.task_groups[0].count = 1
+        job2.task_groups[0].tasks[0].config = {"run_for": "60s"}
+        job2.canonicalize()
+        server.register_job(job2)
+
+        assert _wait_for(lambda: all(
+            a.client_status == ALLOC_CLIENT_RUNNING
+            for j in ("stops", "stays")
+            for a in server.store.allocs_by_job("default", j))
+            and server.store.allocs_by_job("default", "stops")
+            and server.store.allocs_by_job("default", "stays"))
+
+        transport.fail = True
+        stop_alloc = server.store.allocs_by_job("default", "stops")[0]
+        stay_alloc = server.store.allocs_by_job("default", "stays")[0]
+        assert _wait_for(
+            lambda: client.runners[stop_alloc.id].destroyed, timeout=10)
+        assert not client.runners[stay_alloc.id].destroyed
+    finally:
+        client.shutdown()
+        server.shutdown()
