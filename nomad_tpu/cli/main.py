@@ -34,6 +34,15 @@ def _print_rows(rows: List[List[str]], header: List[str]) -> None:
 def cmd_agent(args) -> int:
     from ..client import Client, ClientConfig
 
+    if args.config:
+        from .agent_config import apply_to_args, load_agent_config
+        try:
+            apply_to_args(load_agent_config(args.config), args)
+        except (OSError, ValueError) as e:
+            print(f"Error loading config {args.config}: {e}",
+                  file=sys.stderr)
+            return 1
+
     is_server = args.dev or args.server
     is_client = args.dev or args.client
     if not is_server and not is_client:
@@ -67,7 +76,9 @@ def cmd_agent(args) -> int:
             force_cpu_platform(1)
             print("    WARNING: TPU backend unavailable; scheduling on CPU")
         server = Server(ServerConfig(num_schedulers=args.num_schedulers,
-                                     acl_enabled=args.acl_enabled))
+                                     acl_enabled=args.acl_enabled,
+                                     data_dir=getattr(args, "data_dir",
+                                                      "")))
         rpc = RpcServer(server, port=args.rpc_port)
         if args.server_peers:
             peers = [p.strip() for p in args.server_peers.split(",")
@@ -81,17 +92,22 @@ def cmd_agent(args) -> int:
         api.start()
 
     n_local_clients = args.clients if is_client else 0
+    client_kw = dict(
+        alloc_dir=args.alloc_dir_base,
+        state_dir=getattr(args, "state_dir", None) or None,
+        datacenter=getattr(args, "datacenter", "") or "dc1",
+        meta=getattr(args, "client_meta", None) or {})
     for i in range(n_local_clients):
         if server is not None:
             c = Client(server, ClientConfig(
-                node_name=f"dev-client-{i}",
-                alloc_dir=args.alloc_dir_base))
+                node_name=args.node_name or f"dev-client-{i}",
+                **client_kw))
         else:
             from ..rpc import RemoteTransport
             c = Client(RemoteTransport(args.servers),
                        ClientConfig(node_name=args.node_name or
                                     f"client-{i}",
-                                    alloc_dir=args.alloc_dir_base))
+                                    **client_kw))
         c.start()
         clients.append(c)
 
@@ -693,6 +709,8 @@ def build_parser() -> argparse.ArgumentParser:
                             "(incl. this one) to form a raft cluster")
     agent.add_argument("-alloc-dir", dest="alloc_dir_base", default="",
                        help="base directory for alloc dirs (fs/logs)")
+    agent.add_argument("-config", default="",
+                       help="HCL agent config file (flags win on merge)")
     agent.add_argument("-clients", type=int, default=1)
     agent.add_argument("-num-schedulers", dest="num_schedulers", type=int,
                        default=2)
