@@ -492,7 +492,16 @@ class HTTPApiServer:
                                 data.get("Context", "all"), ns), idx
 
         if path == "/v1/status/leader":
-            return "127.0.0.1:4647", idx
+            # status_endpoint.go Leader: the raft leader's RPC address;
+            # mid-election there IS no leader and saying otherwise would
+            # route leader-only traffic at a candidate
+            raft = getattr(s, "raft", None)
+            if raft is not None:
+                if not raft.leader_addr:
+                    raise RuntimeError("No cluster leader")
+                return raft.leader_addr, idx
+            rpc = getattr(s, "rpc_server", None)
+            return (rpc.addr if rpc is not None else "127.0.0.1:4647"), idx
 
         m = re.match(r"^/v1/client/fs/(logs|ls|cat)/([^/]+)$", path)
         if m and method == "GET":

@@ -80,6 +80,7 @@ def cmd_agent(args) -> int:
                                      data_dir=getattr(args, "data_dir",
                                                       "")))
         rpc = RpcServer(server, port=args.rpc_port)
+        server.rpc_server = rpc
         if args.server_peers:
             peers = [p.strip() for p in args.server_peers.split(",")
                      if p.strip()]

@@ -50,14 +50,9 @@ class CSIVolume:
         return False                         # reader-only modes
 
     def read_schedulable(self) -> bool:
-        if not self.schedulable:
-            return False
-        if self.access_mode in (ACCESS_SINGLE_NODE_READER,
-                                ACCESS_SINGLE_NODE_WRITER):
-            # single-node modes serve one alloc at a time overall
-            return not self.read_allocs and not self.write_allocs \
-                or self.access_mode == ACCESS_SINGLE_NODE_WRITER
-        return True
+        # reads are never claim-limited, in any access mode
+        # (csi.go ReadSchedulable:361 checks volume health only)
+        return self.schedulable
 
     def claimable(self, read_only: bool) -> bool:
         return self.read_schedulable() if read_only \

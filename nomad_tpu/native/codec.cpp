@@ -159,13 +159,31 @@ bool Encoder::encode(PyObject* obj) {
 // ---------------------------------------------------------------------
 // decoder
 // ---------------------------------------------------------------------
+// wire hardening (python-msgpack enforces the same class of limits):
+// bounded recursion so a crafted deeply-nested frame cannot overflow
+// the C stack, and container headers validated against the remaining
+// bytes before allocation so a 4-byte header cannot force a multi-GB
+// PyList_New.
+static const int kMaxDepth = 512;
+
 struct Decoder {
   const uint8_t* p;
   const uint8_t* end;
+  int depth = 0;
 
   bool need(size_t n) {
     if (static_cast<size_t>(end - p) < n) {
       PyErr_SetString(PyExc_ValueError, "msgpack: truncated input");
+      return false;
+    }
+    return true;
+  }
+  // every element needs >=1 encoded byte; reject headers promising
+  // more elements than bytes remain (mult = min bytes per element)
+  bool plausible(size_t n, size_t mult) {
+    if (n > static_cast<size_t>(end - p) / mult + 1) {
+      PyErr_SetString(PyExc_ValueError,
+                      "msgpack: container length exceeds input");
       return false;
     }
     return true;
@@ -194,6 +212,7 @@ struct Decoder {
     return b;
   }
   PyObject* decode_array(size_t n) {
+    if (!plausible(n, 1)) return nullptr;
     PyObject* lst = PyList_New(n);
     if (!lst) return nullptr;
     for (size_t i = 0; i < n; i++) {
@@ -204,6 +223,7 @@ struct Decoder {
     return lst;
   }
   PyObject* decode_map(size_t n) {
+    if (!plausible(n, 2)) return nullptr;   // key + value per entry
     PyObject* d = PyDict_New();
     if (!d) return nullptr;
     for (size_t i = 0; i < n; i++) {
@@ -219,7 +239,18 @@ struct Decoder {
   }
 };
 
+struct DepthGuard {
+  int& d;
+  explicit DepthGuard(int& depth) : d(depth) { d++; }
+  ~DepthGuard() { d--; }
+};
+
 PyObject* Decoder::decode() {
+  if (depth >= kMaxDepth) {
+    PyErr_SetString(PyExc_ValueError, "msgpack: nesting too deep");
+    return nullptr;
+  }
+  DepthGuard guard(depth);
   if (!need(1)) return nullptr;
   uint8_t tag = *p++;
   if (tag < 0x80) return PyLong_FromLong(tag);

@@ -310,6 +310,8 @@ class PlacementEngine:
         # the node must be inside its topology
         csi_reqs = [r for r in (tg.volumes or {}).values()
                     if getattr(r, "type", "host") == "csi"]
+        csi_write_cap = None        # max placements this batch can claim
+        csi_cap_source = ""
         for req in csi_reqs:
             vol = self.snapshot.csi_volume(self.job.namespace, req.source)
             before = int(mask.sum())
@@ -325,6 +327,23 @@ class PlacementEngine:
                 filtered_counts[f"missing CSI Volume {req.source}"] = \
                     filtered_counts.get(
                         f"missing CSI Volume {req.source}", 0) + newly
+            # single-writer volumes admit ONE write claim: a count>1
+            # batch must not stage more placements than the volume can
+            # claim (csi.go WriteFreeClaims:385 is per-claim; the plan
+            # applier re-verifies against the freshest state)
+            if vol is not None and not bool(req.read_only):
+                from ..models.csi import (ACCESS_MULTI_NODE_SINGLE_WRITER,
+                                          ACCESS_SINGLE_NODE_WRITER)
+                if vol.access_mode in (ACCESS_SINGLE_NODE_WRITER,
+                                       ACCESS_MULTI_NODE_SINGLE_WRITER):
+                    free = 0 if vol.write_allocs else 1
+                    if csi_write_cap is None or free < csi_write_cap:
+                        csi_write_cap = free
+                        csi_cap_source = req.source
+
+        count_requested = count
+        if csi_write_cap is not None and 0 < csi_write_cap < count:
+            count = csi_write_cap
 
         options = options or SelectOptions()
         if options.preferred_nodes:
@@ -437,6 +456,7 @@ class PlacementEngine:
             # a preempting winner stages its victims before resource
             # assignment (they free ports/devices too)
             victims = None
+            saved_net = saved_dev = None
             if pre_score is not None and pre_score[idx] > 0 \
                     and idx not in staged_victims:
                 victims = preemption_round.victims_for(idx)
@@ -444,11 +464,33 @@ class PlacementEngine:
                     staged_victims.add(idx)
                     for v in victims:
                         proposed.plan.append_preempted_alloc(v, "")
-                    self._net_cache.pop(node.id, None)
-                    self._dev_cache.pop(node.id, None)
+                    saved_net = self._net_cache.pop(node.id, None)
+                    saved_dev = self._dev_cache.pop(node.id, None)
             task_resources, shared, ok = self._assign_resources(
                 node, tg, proposed.plan)
             if not ok:
+                # roll the staged victims back: an eviction without a
+                # replacement placement must not reach the plan
+                # (generic.py _try_preemption does the same one-shot)
+                if victims:
+                    staged_victims.discard(idx)
+                    evicted = {v.id for v in victims}
+                    kept = [a for a in proposed.plan.node_preemptions
+                            .get(node.id, []) if a.id not in evicted]
+                    if kept:
+                        proposed.plan.node_preemptions[node.id] = kept
+                    else:
+                        proposed.plan.node_preemptions.pop(node.id, None)
+                    # _assign_resources may have rebuilt the caches with
+                    # the victims excluded; those entries are poison now
+                    # that the victims are unstaged — drop them before
+                    # restoring the pre-staging versions
+                    self._net_cache.pop(node.id, None)
+                    self._dev_cache.pop(node.id, None)
+                    if saved_net is not None:
+                        self._net_cache[node.id] = saved_net
+                    if saved_dev is not None:
+                        self._dev_cache[node.id] = saved_dev
                 metrics.exhausted_node(node, "network: port assignment failed")
                 out.append((None, metrics))
                 continue
@@ -460,6 +502,15 @@ class PlacementEngine:
                 metrics=metrics,
                 preempted_allocs=victims,
             ), metrics))
+        # instances beyond the CSI write-claim budget fail placement
+        # with the volume named, instead of being staged unclaimable
+        for _ in range(count_requested - count):
+            m = AllocMetric()
+            m.nodes_evaluated = int(self._base_mask.sum())
+            m.constraint_filtered = {
+                f"CSI volume {csi_cap_source} has exhausted its "
+                "available writer claims": m.nodes_evaluated}
+            out.append((None, m))
         return out
 
     def _metrics_for_step(self, res, step: int,

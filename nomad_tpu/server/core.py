@@ -95,6 +95,7 @@ class Server:
         self._acl_cache: Dict = {}      # (policies, index) -> compiled ACL
         self.raft = None                # multi-server consensus (raft.py)
         self._in_replicated_apply = False
+        self._apply_tl = threading.local()   # nested-apply depth/max idx
 
         # restore persisted state AFTER all subsystems exist: WAL replay
         # drives the same FSM appliers (broker/blocked are disabled until
@@ -342,35 +343,76 @@ class Server:
         so WAL order == apply order and a snapshot can never truncate an
         entry whose effects it doesn't contain. In a multi-server
         cluster, non-leaders forward the write to the leader (rpc.go
-        forward()); a leader additionally appends the entry to the
-        replication log."""
+        forward()); the leader appends the entry to the replication log
+        and — once the outermost apply of the call chain finishes —
+        blocks until a majority holds it before acking (quorum commit;
+        nested FSM side-effect applies produce higher indexes, so the
+        outermost waits for the chain's max index)."""
+        index, waiter = self.raft_apply_async(msg_type, payload)
+        if waiter is not None:
+            waiter()
+        return index
+
+    def raft_apply_async(self, msg_type: str, payload: dict):
+        """The non-blocking half of raft_apply: local apply + log append
+        now, quorum ack deferred. Returns (index, waiter) where waiter
+        is None (nested/forwarded/no-raft: nothing to wait for at this
+        frame) or a callable that blocks until the call chain's highest
+        index is majority-replicated in the term it was stamped with,
+        raising otherwise. The plan applier uses this to overlap plan
+        N's replication with plan N+1's verification (plan_apply.go:44-70
+        pipelining). The log append runs FIRST and refuses on a deposed
+        leader, so losing leadership mid-flight aborts before any WAL
+        write or local state mutation."""
         if self.raft is not None and not self.raft.is_leader():
             if self._in_replicated_apply:
                 # FSM side effect during a replicated apply: the
                 # leader's equivalent entry arrives via the log
-                return self._raft_index
-            return self.raft.forward_apply(msg_type, payload)
-        with self._raft_l:
-            self._raft_index += 1
-            index = self._raft_index
-            if self.persistence is not None:
-                self.persistence.record(index, msg_type, payload)
-            if self.raft is not None:
-                self.raft.record_entry(index, msg_type, payload)
-            fn = getattr(self, f"_apply_{msg_type}")
-            fn(index, payload)
-            self.time_table.witness(index)
-            if self.persistence is not None:
-                self.persistence.maybe_snapshot(self.store)
-            # change events fan out AFTER the commit (stream/event_broker
-            # subscribers see only applied state); WAL replay bypasses
-            # raft_apply so restores don't replay the event history
-            try:
-                self.events.publish(events_from_apply(msg_type, payload,
-                                                      index))
-            except Exception:
-                LOG.exception("event publish for %s", msg_type)
-        return index
+                return self._raft_index, None
+            return self.raft.forward_apply(msg_type, payload), None
+        tl = self._apply_tl
+        tl.depth = getattr(tl, "depth", 0) + 1
+        try:
+            with self._raft_l:
+                index = self._raft_index + 1
+                if self.raft is not None:
+                    # raises "not the leader" on a deposed leader —
+                    # nothing recorded, nothing applied
+                    tl.apply_term = self.raft.record_entry(
+                        index, msg_type, payload)
+                self._raft_index = index
+                if self.persistence is not None:
+                    self.persistence.record(index, msg_type, payload)
+                fn = getattr(self, f"_apply_{msg_type}")
+                fn(index, payload)
+                self.time_table.witness(index)
+                if self.persistence is not None:
+                    self.persistence.maybe_snapshot(self.store)
+                # change events fan out after the LOCAL apply (followers:
+                # after the replicated apply). On a quorum-commit leader
+                # this precedes the durable ack — in-proc subscribers can
+                # observe a write whose ack later fails; external readers
+                # see it only once /v1/event/stream serves applied state.
+                # WAL replay bypasses raft_apply so restores don't replay
+                # the event history.
+                try:
+                    self.events.publish(events_from_apply(
+                        msg_type, payload, index))
+                except Exception:
+                    LOG.exception("event publish for %s", msg_type)
+            tl.max_index = max(getattr(tl, "max_index", 0), index)
+        finally:
+            tl.depth -= 1
+        if self.raft is not None and tl.depth == 0:
+            wait_idx, tl.max_index = tl.max_index, 0
+            wait_term = getattr(tl, "apply_term", None)
+            raft = self.raft
+            return index, lambda: raft.wait_for_commit(wait_idx, wait_term)
+        return index, None
+
+    def _apply_noop(self, index: int, p: dict) -> None:
+        """Leadership no-op (hashicorp/raft LogNoop): commits the new
+        term without mutating state."""
 
     # -- FSM appliers --------------------------------------------------
     def _apply_job_register(self, index: int, p: dict) -> None:

@@ -3,11 +3,13 @@
 Reference semantics: nomad/plan_apply.go — planApply:71 single goroutine,
 evaluatePlan:400 (per-node feasibility against the freshest snapshot),
 partial commits set RefreshIndex to force worker state refresh,
-preemption follow-up evals:287-310. The reference overlaps Raft-apply of
-plan N with verification of plan N+1; here commit is a fast in-memory
-state-store apply so the overlap is unnecessary, but the verification
-batches all touched nodes at once (the EvaluatePool:NumCPU/2 goroutines
-become one vectorized pass).
+preemption follow-up evals:287-310. Like the reference (optimistic
+pipelining, big comment plan_apply.go:44-70), plan N's quorum
+replication overlaps plan N+1's verification: the local FSM apply is
+synchronous (so N+1 verifies against state that already includes N),
+but the majority-ack wait is handed to a committer thread that resolves
+plan futures in commit order. Verification batches all touched nodes at
+once (the EvaluatePool:NumCPU/2 goroutines become one vectorized pass).
 """
 
 from __future__ import annotations
@@ -29,16 +31,54 @@ class PlanApplier:
         self.server = server      # provides .store and .raft_apply()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._committer: Optional[threading.Thread] = None
+        # (future, result, waiter) handed from the verify/apply loop to
+        # the committer. maxsize=1 bounds the pipeline to ONE in-flight
+        # commit, matching the reference's overlap of exactly plan N's
+        # raft apply with plan N+1's verification (plan_apply.go:56-70);
+        # without the bound a partitioned leader would stack local-only
+        # applies and serve each submitter its 10s failure in series
+        self._commit_q = None
 
     def start(self) -> None:
+        import queue as queue_mod
+        self._commit_q = queue_mod.Queue(maxsize=1)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="plan-applier")
         self._thread.start()
+        self._committer = threading.Thread(target=self._commit_loop,
+                                           daemon=True,
+                                           name="plan-committer")
+        self._committer.start()
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=2)
+            self._thread.join(timeout=5)
+        # the applier thread is dead (or wedged past the join timeout):
+        # send the committer its shutdown sentinel, which it processes
+        # after any in-flight commit, then fail whatever remains
+        if self._commit_q is not None:
+            for _ in range(25):
+                try:
+                    self._commit_q.put(None, timeout=0.2)
+                    break
+                except Exception:
+                    continue
+        if self._committer:
+            self._committer.join(timeout=5)
+        if self._commit_q is not None:
+            while True:
+                try:
+                    item = self._commit_q.get_nowait()
+                except Exception:
+                    break
+                if item is None:
+                    continue
+                future, _r, _w = item
+                if not future.done():
+                    future.set_exception(
+                        RuntimeError("plan applier stopped"))
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -46,13 +86,51 @@ class PlanApplier:
             if pending is None:
                 continue
             try:
-                result = self.apply(pending.plan)
-                pending.future.set_result(result)
+                result, waiter = self.apply(pending.plan)
             except Exception as e:      # pragma: no cover - defensive
                 pending.future.set_exception(e)
+                continue
+            # hand the quorum wait to the committer and move on to
+            # verifying the next plan (pipelined commit); blocks while
+            # one commit is already in flight (bounded pipeline)
+            placed = False
+            while not self._stop.is_set():
+                try:
+                    self._commit_q.put((pending.future, result, waiter),
+                                       timeout=0.2)
+                    placed = True
+                    break
+                except Exception:
+                    continue
+            if not placed and not pending.future.done():
+                pending.future.set_exception(
+                    RuntimeError("plan applier stopped"))
+
+    def _commit_loop(self) -> None:
+        while True:
+            try:
+                item = self._commit_q.get(timeout=0.2)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                continue
+            if item is None:            # shutdown sentinel
+                return
+            future, result, waiter = item
+            try:
+                if waiter is not None:
+                    waiter()
+                future.set_result(result)
+            except Exception as e:
+                # quorum unreachable / leadership lost: the submitting
+                # worker sees the failure and nacks its eval
+                future.set_exception(e)
 
     # -- the core ------------------------------------------------------
-    def apply(self, plan: Plan) -> PlanResult:
+    def apply(self, plan: Plan):
+        """Verify + locally apply one plan. Returns (result, waiter);
+        waiter is None or a callable blocking until quorum commit. The
+        synchronous test/tool entry `apply_sync` folds the wait in."""
         import time as _time
         from ..utils import metrics
         _t0 = _time.monotonic()
@@ -62,7 +140,13 @@ class PlanApplier:
             metrics.measure_since("nomad.plan.evaluate", _t0)
             metrics.incr_counter("nomad.plan.apply")
 
-    def _apply(self, plan: Plan) -> PlanResult:
+    def apply_sync(self, plan: Plan) -> PlanResult:
+        result, waiter = self.apply(plan)
+        if waiter is not None:
+            waiter()
+        return result
+
+    def _apply(self, plan: Plan):
         store = self.server.store
         snapshot = store.snapshot()
 
@@ -75,6 +159,14 @@ class PlanApplier:
                 result.node_allocation[node_id] = placements
             else:
                 rejected = True
+
+        # CSI write-claim capacity against the freshest state: two
+        # optimistic plans (or two groups in one plan) must not commit
+        # more write claimants than the volume's access mode admits
+        # (csi.go WriteFreeClaims:385; claims apply per-placement)
+        csi_rejected = self._enforce_csi_write_caps(
+            snapshot, plan, result.node_allocation)
+        rejected = rejected or csi_rejected
         # stops are always committable; preemptions commit only when the
         # placement they made room for was accepted — otherwise victims
         # would be evicted for an alloc that never enters state
@@ -89,7 +181,7 @@ class PlanApplier:
         if rejected:
             result.refresh_index = snapshot.latest_index()
         if result.is_no_op():
-            return result
+            return result, None
 
         # commit through the raft shim (FSM ApplyPlanResults)
         stopped = [a for allocs in result.node_update.values() for a in allocs]
@@ -121,7 +213,7 @@ class PlanApplier:
                 type=job.type, triggered_by=TRIGGER_PREEMPTION,
                 job_id=job.id, status=EVAL_STATUS_PENDING))
 
-        index = self.server.raft_apply(
+        index, waiter = self.server.raft_apply_async(
             "plan_results",
             dict(allocs_stopped=stopped, allocs_placed=placed,
                  allocs_preempted=preempted, deployment=result.deployment,
@@ -129,7 +221,55 @@ class PlanApplier:
         result.alloc_index = index
         for ev in evals:
             self.server.enqueue_eval(ev)
-        return result
+        return result, waiter
+
+    def _enforce_csi_write_caps(self, snapshot, plan: Plan,
+                                node_allocation: Dict[str, List]) -> bool:
+        """Drop placements whose CSI write claims would exceed the
+        volume's access mode, budgeting across the whole plan. Mutates
+        node_allocation in place; returns True if anything was dropped
+        (partial commit => refresh index)."""
+        from ..models.csi import (ACCESS_MULTI_NODE_SINGLE_WRITER,
+                                  ACCESS_SINGLE_NODE_WRITER)
+        budgets: Dict = {}          # (ns, vol_id) -> free write slots
+        dropped = False
+        for node_id in list(node_allocation):
+            kept = []
+            for a in node_allocation[node_id]:
+                job = a.job or plan.job or \
+                    snapshot.job_by_id(a.namespace, a.job_id)
+                tg = job.lookup_task_group(a.task_group) if job else None
+                reqs = [r for r in (tg.volumes or {}).values()
+                        if getattr(r, "type", "host") == "csi"
+                        and not getattr(r, "read_only", False)] if tg else []
+                ok = True
+                touched = []
+                for req in reqs:
+                    vol = snapshot.csi_volume(a.namespace, req.source)
+                    if vol is None or vol.access_mode not in (
+                            ACCESS_SINGLE_NODE_WRITER,
+                            ACCESS_MULTI_NODE_SINGLE_WRITER):
+                        continue
+                    if a.id in vol.write_allocs:
+                        continue    # in-place update keeps its claim
+                    key = (a.namespace, req.source)
+                    if key not in budgets:
+                        budgets[key] = 0 if vol.write_allocs else 1
+                    if budgets[key] <= 0:
+                        ok = False
+                        break
+                    touched.append(key)
+                if ok:
+                    for key in touched:
+                        budgets[key] -= 1
+                    kept.append(a)
+                else:
+                    dropped = True
+            if kept:
+                node_allocation[node_id] = kept
+            elif node_id in node_allocation:
+                del node_allocation[node_id]
+        return dropped
 
     def _evaluate_node(self, snapshot, plan: Plan, node_id: str) -> bool:
         """evaluateNodePlan (plan_apply.go:629): would this node's

@@ -14,10 +14,16 @@ the leader). SURVEY §7.2 step 7 blesses a "single-leader Raft-lite":
     entries in order over AppendEntries and apply them with nested
     side-effect applies suppressed (the leader's equivalents arrive as
     their own entries)
-  - commit acknowledgement is therefore leader-local with asynchronous
-    quorum replication (primary/backup): a leader failing before its
-    tail replicates can lose that tail on failover — weaker than full
-    Raft commit, stated here explicitly
+  - **commit means commit**: the leader acks a write only once a
+    majority of the cluster holds the entry (match-index quorum over
+    per-peer replication threads, Raft §5.3/§5.4), with the
+    current-term commit rule (§5.4.2, figure 8) enforced via a no-op
+    entry appended on election (the hashicorp/raft noop). A leader that
+    cannot reach a majority times out the ack instead of claiming
+    durability
+  - replication runs in one dedicated thread per peer (hashicorp/raft
+    replication.go shape) so a dead peer or an in-flight snapshot
+    install can never starve heartbeats to healthy followers
   - a follower whose applied state diverges from the new leader's log
     (e.g. a deposed leader with an unreplicated applied tail) cannot
     truncate applied state; it is reseeded with a full snapshot install
@@ -79,7 +85,14 @@ class RaftNode:
         self._threads: List[threading.Thread] = []
         # per-peer replication state (leader)
         self._next_index: Dict[str, int] = {}
+        self._match_index: Dict[str, int] = {}
         self._clients: Dict[str, object] = {}
+        # quorum commit tracking: an entry is committed once a majority
+        # of match indexes cover it and it belongs to the current term
+        self.commit_index = self.base_index
+        self._commit_cv = threading.Condition(self._lock)
+        self._repl_gen = 0            # invalidates stale repl threads
+        self._repl_events: Dict[str, threading.Event] = {}
         self._load_vote_state()
 
     # -- persistence of (term, votedFor) — Raft §5.1 -------------------
@@ -117,6 +130,11 @@ class RaftNode:
 
     def stop(self) -> None:
         self._stop.set()
+        with self._lock:
+            self._repl_gen += 1
+            for ev in self._repl_events.values():
+                ev.set()
+            self._commit_cv.notify_all()
         for c in self._clients.values():
             try:
                 c.close()
@@ -148,14 +166,84 @@ class RaftNode:
 
     # -- the leader append hook (called from Server.raft_apply) --------
     def record_entry(self, index: int, msg_type: str,
-                     payload: dict) -> None:
+                     payload: dict) -> int:
+        """Append a leader log entry; returns the term it was stamped
+        with. Raises if this node is no longer the leader — a deposed
+        leader must NOT append (the entry would carry the new term, so a
+        follower would treat the real leader's entry at that index as
+        already present and silently diverge)."""
         with self._lock:
-            self.log.append((index, self.term, msg_type,
+            if self.role != LEADER:
+                raise RuntimeError("not the leader")
+            term = self.term
+            self.log.append((index, term, msg_type,
                              encode_payload(msg_type, payload)))
+            if not self.peers:
+                self._advance_commit()
+            for ev in self._repl_events.values():
+                ev.set()
+            return term
+
+    # -- quorum commit -------------------------------------------------
+    def _advance_commit(self) -> None:
+        """Advance the commit index to the highest entry a majority
+        holds, restricted to current-term entries (Raft §5.4.2). Called
+        with self._lock held."""
+        if self.role != LEADER:
+            return
+        last, _ = (self.log[-1][0], self.log[-1][1]) if self.log \
+            else (self.base_index, self.base_term)
+        matches = sorted(
+            [self._match_index.get(p, 0) for p in self.peers] + [last],
+            reverse=True)
+        n = matches[self.cluster_size // 2]
+        if n <= self.commit_index:
+            return
+        if n > self.base_index:
+            pos = n - self.base_index - 1
+            if pos < len(self.log) and self.log[pos][1] != self.term:
+                return          # figure-8 guard: never count replicas
+                                # to commit a prior-term entry
+        self.commit_index = n
+        self._commit_cv.notify_all()
+
+    def wait_for_commit(self, index: int, term: Optional[int] = None,
+                        timeout_s: float = 10.0) -> None:
+        """Block until `index` is replicated to a majority. Raises if
+        leadership is lost, the quorum is unreachable, or (when `term`
+        is given) the node's term has moved past the one the entry was
+        stamped with — a stepdown + reseed + re-election in between
+        means the entry may no longer exist even though commit_index
+        eventually passes it. The caller must not treat the write as
+        durable on any raise."""
+        if not self.peers:
+            return
+        deadline = time.monotonic() + timeout_s
+        with self._commit_cv:
+            while self.commit_index < index:
+                if self._stop.is_set():
+                    raise RuntimeError("raft node stopped")
+                if self.role != LEADER:
+                    raise RuntimeError(
+                        f"leadership lost before commit of {index}")
+                if term is not None and self.term != term:
+                    raise RuntimeError(
+                        f"term moved ({term} -> {self.term}) before "
+                        f"commit of {index}")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"no quorum: commit of {index} timed out "
+                        f"after {timeout_s}s")
+                self._commit_cv.wait(remaining)
+            if term is not None and self.term != term:
+                raise RuntimeError(
+                    f"term moved ({term} -> {self.term}); entry {index} "
+                    "may have been superseded")
 
     # -- follower write forwarding ------------------------------------
     def forward_apply(self, msg_type: str, payload: dict,
-                      timeout_s: float = 10.0) -> int:
+                      timeout_s: float = 15.0) -> int:
         leader = self.leader_addr
         if not leader:
             raise RuntimeError("no cluster leader")
@@ -176,6 +264,8 @@ class RaftNode:
     def _become_follower(self, term: int, leader: Optional[str]) -> None:
         was_leader = self.role == LEADER
         self.role = FOLLOWER
+        self._repl_gen += 1            # retire replication threads
+        self._repl_events.clear()
         if term > self.term:
             self.term = term
             self.voted_for = None
@@ -183,6 +273,7 @@ class RaftNode:
         if leader:
             self.leader_addr = leader
         self._election_deadline = self._new_deadline()
+        self._commit_cv.notify_all()   # fail pending acks fast
         if was_leader:
             LOG.warning("stepping down (term %d)", self.term)
             self.server.revoke_leadership()
@@ -192,18 +283,41 @@ class RaftNode:
         self.leader_addr = self.self_addr
         last, _ = self.last_log()
         self._next_index = {p: last + 1 for p in self.peers}
+        self._match_index = {p: 0 for p in self.peers}
+        self._repl_gen += 1
+        gen = self._repl_gen
+        self._repl_events = {}
+        for peer in self.peers:
+            ev = threading.Event()
+            ev.set()
+            self._repl_events[peer] = ev
+            # not retained: retired generations exit via the gen check,
+            # and retaining them would grow without bound under flapping
+            threading.Thread(target=self._repl_loop,
+                             args=(peer, gen, ev), daemon=True,
+                             name=f"raft-repl-{peer}").start()
         LOG.warning("elected leader (term %d)", self.term)
         self.server.establish_leadership()
+        if self.peers:
+            # current-term no-op so prior-term entries become
+            # committable (§5.4.2; hashicorp/raft appends LogNoop)
+            threading.Thread(target=self._append_noop, daemon=True,
+                             name="raft-noop").start()
 
-    # -- ticker: elections + leader heartbeats -------------------------
+    def _append_noop(self) -> None:
+        try:
+            self.server.raft_apply("noop", {})
+        except Exception as e:      # stepped down again before commit
+            LOG.debug("noop append failed: %s", e)
+
+    # -- ticker: election timeouts (replication is per-peer threads) ---
     def _ticker(self) -> None:
         while not self._stop.is_set():
             time.sleep(HEARTBEAT_S / 2)
             with self._lock:
                 role = self.role
-            if role == LEADER:
-                self._replicate_all()
-            elif time.monotonic() > self._election_deadline:
+            if role != LEADER and \
+                    time.monotonic() > self._election_deadline:
                 self._run_election()
 
     def _run_election(self) -> None:
@@ -237,23 +351,37 @@ class RaftNode:
                     votes * 2 > self.cluster_size:
                 self._become_leader()
 
-    # -- leader replication -------------------------------------------
-    def _replicate_all(self) -> None:
-        for peer in self.peers:
+    # -- leader replication: one thread per peer ----------------------
+    def _repl_loop(self, peer: str, gen: int,
+                   wake: threading.Event) -> None:
+        """Dedicated replication pump for one peer (hashicorp/raft
+        replication.go). Wakes on new entries or every heartbeat
+        interval; keeps draining while the peer is behind. A stuck or
+        snapshotting peer only ever blocks its own thread."""
+        while not self._stop.is_set():
+            with self._lock:
+                if self.role != LEADER or self._repl_gen != gen:
+                    return
+            wake.wait(HEARTBEAT_S)
+            wake.clear()
             try:
-                self._replicate_peer(peer)
+                while self._replicate_peer(peer):
+                    pass
             except Exception as e:
                 LOG.debug("replicate to %s failed: %s", peer, e)
+                time.sleep(HEARTBEAT_S / 2)     # redial backoff
 
-    def _replicate_peer(self, peer: str) -> None:
+    def _replicate_peer(self, peer: str) -> bool:
+        """One AppendEntries (or snapshot) round trip. Returns True if
+        the peer still has a backlog and the caller should continue."""
         with self._lock:
             if self.role != LEADER:
-                return
+                return False
             term = self.term
             next_idx = self._next_index.get(peer, self.base_index + 1)
             if next_idx <= self.base_index:
                 self._send_snapshot(peer, term)
-                return
+                return False
             offset = next_idx - self.base_index - 1
             entries = self.log[offset:offset + MAX_BATCH]
             if offset > len(self.log):
@@ -267,7 +395,8 @@ class RaftNode:
                 last = self.log[-1] if self.log else None
                 prev_index = last[0] if last else self.base_index
                 prev_term = last[1] if last else self.base_term
-            commit = self.log[-1][0] if self.log else self.base_index
+            commit = min(self.commit_index,
+                         self.log[-1][0] if self.log else self.base_index)
         res = self._client(peer).call(
             "Raft.AppendEntries",
             {"term": term, "leader": self.self_addr,
@@ -278,32 +407,71 @@ class RaftNode:
         with self._lock:
             if res["term"] > self.term:
                 self._become_follower(res["term"], None)
-                return
+                return False
+            if self.role != LEADER or self.term != term:
+                return False
             if res.get("needs_snapshot"):
                 self._send_snapshot(peer, term)
-            elif res.get("success"):
+                return False
+            if res.get("success"):
+                matched = entries[-1][0] if entries else prev_index
+                if matched > self._match_index.get(peer, 0):
+                    self._match_index[peer] = matched
+                    self._advance_commit()
                 if entries:
-                    self._next_index[peer] = entries[-1][0] + 1
-            else:
-                self._next_index[peer] = max(
-                    self.base_index + 1,
-                    min(self._next_index.get(peer, 1) - 1,
-                        int(res.get("hint", 0)) + 1))
+                    self._next_index[peer] = matched + 1
+                last = self.log[-1][0] if self.log else self.base_index
+                return self._next_index.get(peer, last + 1) <= last
+            self._next_index[peer] = max(
+                self.base_index + 1,
+                min(self._next_index.get(peer, 1) - 1,
+                    int(res.get("hint", 0)) + 1))
+            return True
+
+    def _term_of(self, index: int) -> int:
+        """Term of a log entry by index (lock held); base_term for the
+        compaction base or anything at/below it."""
+        pos = index - self.base_index - 1
+        if 0 <= pos < len(self.log):
+            return self.log[pos][1]
+        return self.base_term
 
     def _send_snapshot(self, peer: str, term: int) -> None:
-        data = self.server.store.dump()
-        last_index, last_term = self.last_log()
-        res = self._client(peer).call(
-            "Raft.InstallSnapshot",
-            {"term": term, "leader": self.self_addr,
-             "snapshot": data, "base_index": last_index,
-             "base_term": last_term},
-            timeout_s=30.0)
-        with self._lock:
-            if res["term"] > self.term:
-                self._become_follower(res["term"], None)
-                return
-            self._next_index[peer] = last_index + 1
+        """Full-state reseed of a lagging peer. The serialization + long
+        transfer run with the raft lock RELEASED — only this peer's
+        replication thread blocks on it. The snapshot's base index is
+        captured atomically with an O(1) MVCC store snapshot under the
+        server's apply lock (no apply in flight => applied state ==
+        raft index == log tail), so the label can never run ahead of
+        the state it describes — a too-high base would make followers
+        skip committed entries forever."""
+        self._lock.release()
+        try:
+            with self.server._raft_l:
+                snap = self.server.store.snapshot()
+                snap_index = self.server._raft_index
+            with self._lock:
+                if self.role != LEADER or self.term != term:
+                    return
+                snap_term = self._term_of(snap_index)
+            data = snap.dump()
+            res = self._client(peer).call(
+                "Raft.InstallSnapshot",
+                {"term": term, "leader": self.self_addr,
+                 "snapshot": data, "base_index": snap_index,
+                 "base_term": snap_term},
+                timeout_s=30.0)
+        finally:
+            self._lock.acquire()
+        if res["term"] > self.term:
+            self._become_follower(res["term"], None)
+            return
+        if self.role != LEADER or self.term != term:
+            return
+        self._next_index[peer] = snap_index + 1
+        if snap_index > self._match_index.get(peer, 0):
+            self._match_index[peer] = snap_index
+            self._advance_commit()
 
     # -- compaction ----------------------------------------------------
     def compact(self, keep: int = 4096) -> None:

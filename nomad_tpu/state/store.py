@@ -14,6 +14,7 @@ HAMTs maintained in the same transaction.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field, replace
@@ -30,6 +31,8 @@ from ..models import (
 )
 from ..models.deployment import DeploymentStatusUpdate
 from ..utils.hamt import EditContext, Hamt
+
+LOG = logging.getLogger("nomad_tpu.state")
 
 
 @dataclass
@@ -268,6 +271,44 @@ class StateSnapshot:
     def scheduler_config(self) -> SchedulerConfiguration:
         return (self._root.table("scheduler_config").get("config")
                 or SchedulerConfiguration())
+
+    # -- checkpoint (fsm.go Snapshot:1360) -----------------------------
+    def dump(self) -> dict:
+        """Wire-encode the full database for a snapshot file. Defined on
+        the snapshot view so a raft leader can capture an O(1) MVCC root
+        under the apply lock and serialize it afterwards without
+        blocking writers (raft.py _send_snapshot)."""
+        from ..utils.codec import to_wire
+        root = self._root
+        out = {"indexes": dict(root.indexes.items()), "tables": {}}
+        plain = out["tables"]
+        plain["nodes"] = [to_wire(n) for n in root.table("nodes").values()]
+        plain["jobs"] = [to_wire(j) for j in root.table("jobs").values()]
+        plain["job_versions"] = [
+            {"key": list(k), "versions": {str(v): to_wire(j)
+                                          for v, j in versions.items()}}
+            for k, versions in root.table("job_versions").items()]
+        plain["evals"] = [to_wire(e) for e in root.table("evals").values()]
+        plain["allocs"] = [to_wire(a) for a in root.table("allocs").values()]
+        plain["deployments"] = [to_wire(d)
+                                for d in root.table("deployments").values()]
+        plain["job_summaries"] = [to_wire(s) for s in
+                                  root.table("job_summaries").values()]
+        cfg = root.table("scheduler_config").get("config")
+        plain["scheduler_config"] = to_wire(cfg) if cfg else None
+        plain["periodic_launches"] = [
+            {"key": list(k), "launch_time": v}
+            for k, v in root.table("periodic_launches").items()]
+        plain["scaling_events"] = [
+            {"key": list(k), "events": v}
+            for k, v in root.table("scaling_events").items()]
+        plain["acl_policies"] = [to_wire(p) for p in
+                                 root.table("acl_policies").values()]
+        plain["acl_tokens"] = [to_wire(t) for t in
+                               root.table("acl_tokens").values()]
+        plain["csi_volumes"] = [to_wire(v) for v in
+                                root.table("csi_volumes").values()]
+        return out
 
 
 class StateStore(StateSnapshot):
@@ -562,6 +603,72 @@ class StateStore(StateSnapshot):
             for a in allocs:
                 root = self._upsert_alloc_impl(root, index, a)
             root = root.with_index("allocs", index)
+            self._publish(root)
+
+    def bulk_load_allocs(self, index: int, allocs: List[Allocation]) -> None:
+        """Replay/restore-grade bulk insert — the C2M seed path and the
+        columnar analog of fsm.go's snapshot Restore:1374. Semantics
+        match repeated upsert_allocs for brand-new allocs, but the work
+        is batched: one transient pass over the alloc table, grouped
+        secondary-index updates (one sub-HAMT rebuild per key instead of
+        one per member), a single job-summary aggregation, and a
+        changelog floor bump so resident node tables rebuild once
+        instead of replaying millions of row deltas."""
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("allocs")
+            pairs: List[Tuple[str, Allocation]] = []
+            by_node: Dict[str, List[str]] = {}
+            by_job: Dict[Tuple[str, str], List[str]] = {}
+            by_eval: Dict[str, List[str]] = {}
+            summary_delta: Dict[Tuple[str, str], Dict[str, Dict[str, int]]] = {}
+            for a in allocs:
+                a.create_index = index
+                a.modify_index = index
+                a.alloc_modify_index = index
+                pairs.append((a.id, a))
+                by_node.setdefault(a.node_id, []).append(a.id)
+                by_job.setdefault((a.namespace, a.job_id), []).append(a.id)
+                by_eval.setdefault(a.eval_id, []).append(a.id)
+                b = _client_status_bucket(a)
+                if b is not None:
+                    tgs = summary_delta.setdefault((a.namespace, a.job_id), {})
+                    counts = tgs.setdefault(a.task_group, {})
+                    counts[b] = counts.get(b, 0) + 1
+            root = root.with_table("allocs", t.update(pairs))
+            for name, groups in (("allocs_by_node", by_node),
+                                 ("allocs_by_job", by_job),
+                                 ("allocs_by_eval", by_eval)):
+                it = root.table(name)
+                for key, ids in groups.items():
+                    sub = (it.get(key) or Hamt()).with_ctx(root._ctx)
+                    sub = sub.update([(i, True) for i in ids])
+                    it = it.set(key, sub.frozen())
+                root = root.with_table(name, it)
+            summaries = root.table("job_summaries")
+            changed_summaries = False
+            for key, tgs in summary_delta.items():
+                s: Optional[JobSummary] = summaries.get(key)
+                if s is None:
+                    continue
+                new_sum = dict(s.summary)
+                for tg, buckets in tgs.items():
+                    counts = dict(new_sum.get(tg, {}))
+                    for b, n in buckets.items():
+                        counts[b] = counts.get(b, 0) + n
+                    new_sum[tg] = counts
+                summaries = summaries.set(
+                    key, replace(s, summary=new_sum, modify_index=index))
+                changed_summaries = True
+            if changed_summaries:
+                root = root.with_table("job_summaries", summaries) \
+                           .with_index("job_summaries", index)
+            root = root.with_index("allocs", index)
+            # invalidate the delta path wholesale: one rebuild beats
+            # replaying a multi-million-row changelog
+            self._changes.clear()
+            self._change_indexes.clear()
+            self._change_floor = index
             self._publish(root)
 
     def _upsert_alloc_impl(self, root: _Root, index: int, a: Allocation) -> _Root:
@@ -1162,10 +1269,23 @@ class StateStore(StateSnapshot):
                 v = t.get((a.namespace, req.source))
                 if v is None:
                     continue
+                # re-check capacity PER placement against the claims
+                # already applied in this batch: a count>1 group (or two
+                # groups in one plan) must not exceed a single-writer
+                # access mode (csi.go WriteFreeClaims:385 is per-claim)
+                read_only = bool(req.read_only)
+                if not v.claimable(read_only) and \
+                        a.id not in v.write_allocs and \
+                        a.id not in v.read_allocs:
+                    LOG.warning(
+                        "csi claim for alloc %s on volume %s/%s exceeds "
+                        "access mode %s; skipping claim", a.id,
+                        a.namespace, req.source, v.access_mode)
+                    continue
                 v = _replace(v, read_allocs=dict(v.read_allocs),
                              write_allocs=dict(v.write_allocs),
                              modify_index=index)
-                v.claim(a.id, a.node_id, bool(req.read_only))
+                v.claim(a.id, a.node_id, read_only)
                 root = root.with_table(
                     "csi_volumes", t.set((a.namespace, req.source), v))
                 root = root.with_index("csi_volumes", index)
@@ -1210,40 +1330,6 @@ class StateStore(StateSnapshot):
             self._publish(root)
 
     # -- checkpoint / restore (fsm.go Snapshot:1360 / Restore:1374) ----
-    def dump(self) -> dict:
-        """Wire-encode the full database for a snapshot file."""
-        from ..utils.codec import to_wire
-        root = self._root
-        out = {"indexes": dict(root.indexes.items()), "tables": {}}
-        plain = out["tables"]
-        plain["nodes"] = [to_wire(n) for n in root.table("nodes").values()]
-        plain["jobs"] = [to_wire(j) for j in root.table("jobs").values()]
-        plain["job_versions"] = [
-            {"key": list(k), "versions": {str(v): to_wire(j)
-                                          for v, j in versions.items()}}
-            for k, versions in root.table("job_versions").items()]
-        plain["evals"] = [to_wire(e) for e in root.table("evals").values()]
-        plain["allocs"] = [to_wire(a) for a in root.table("allocs").values()]
-        plain["deployments"] = [to_wire(d)
-                                for d in root.table("deployments").values()]
-        plain["job_summaries"] = [to_wire(s) for s in
-                                  root.table("job_summaries").values()]
-        cfg = root.table("scheduler_config").get("config")
-        plain["scheduler_config"] = to_wire(cfg) if cfg else None
-        plain["periodic_launches"] = [
-            {"key": list(k), "launch_time": v}
-            for k, v in root.table("periodic_launches").items()]
-        plain["scaling_events"] = [
-            {"key": list(k), "events": v}
-            for k, v in root.table("scaling_events").items()]
-        plain["acl_policies"] = [to_wire(p) for p in
-                                 root.table("acl_policies").values()]
-        plain["acl_tokens"] = [to_wire(t) for t in
-                               root.table("acl_tokens").values()]
-        plain["csi_volumes"] = [to_wire(v) for v in
-                                root.table("csi_volumes").values()]
-        return out
-
     def restore(self, data: dict) -> None:
         """Rebuild the database from a dump. Replaces all state."""
         from ..models import SchedulerConfiguration

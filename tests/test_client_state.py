@@ -264,9 +264,15 @@ def test_heartbeatstop_stops_marked_allocs():
         transport.fail = True
         stop_alloc = server.store.allocs_by_job("default", "stops")[0]
         stay_alloc = server.store.allocs_by_job("default", "stays")[0]
-        assert _wait_for(
-            lambda: client.runners[stop_alloc.id].destroyed, timeout=10)
-        assert not client.runners[stay_alloc.id].destroyed
+
+        def _stopped():
+            # the runner may already be GC'd out of the dict once
+            # destroyed — both count as stopped
+            r = client.runners.get(stop_alloc.id)
+            return r is None or r.destroyed
+        assert _wait_for(_stopped, timeout=10)
+        stay = client.runners.get(stay_alloc.id)
+        assert stay is not None and not stay.destroyed
     finally:
         client.shutdown()
         server.shutdown()

@@ -105,6 +105,37 @@ def test_csi_feasibility_and_claim_on_placement():
     assert h.store.allocs_by_job("default", job3.id) == []
 
 
+def test_single_writer_enforced_per_placement_within_batch():
+    """A count>1 group on a single-node-writer volume must not end up
+    with multiple write claims from one plan: capacity is re-checked
+    per placement inside the batch claim (csi.go WriteFreeClaims:385
+    is per-claim, not per-plan)."""
+    h = Harness()
+    for _ in range(3):
+        h.store.upsert_node(h.next_index(), mock.node())
+    vol = CSIVolume(id="solo-vol", plugin_id="p1",
+                    access_mode=ACCESS_SINGLE_NODE_WRITER)
+    h.store.upsert_csi_volumes(h.next_index(), [vol])
+    job = _csi_job("solo-vol", count=3, name="csi-multi")
+    h.store.upsert_job(h.next_index(), job)
+    h.process("service", _eval_for(job))
+    v = h.store.csi_volume("default", "solo-vol")
+    assert len(v.write_allocs) <= 1, \
+        f"single-writer volume got {len(v.write_allocs)} write claims"
+
+
+def test_reads_never_claim_limited():
+    """csi.go ReadSchedulable:361 checks only volume health — reads are
+    allowed regardless of existing claims, in every access mode."""
+    v = CSIVolume(id="v", access_mode=ACCESS_SINGLE_NODE_WRITER)
+    v.claim("w1", "n1", read_only=False)
+    assert v.claimable(read_only=True)
+    v.claim("r1", "n1", read_only=True)
+    assert v.claimable(read_only=True)
+    unsched = CSIVolume(id="u", schedulable=False)
+    assert not unsched.claimable(read_only=True)
+
+
 def test_csi_topology_restricts_nodes():
     h = Harness()
     n1, n2 = mock.node(), mock.node()

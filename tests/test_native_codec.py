@@ -54,6 +54,34 @@ def test_errors():
         native.packb(object())
 
 
+def test_hostile_frames_rejected():
+    """Wire hardening: crafted frames on the RPC port must error, not
+    crash or allocate unboundedly (codec.cpp kMaxDepth / plausible())."""
+    # deeply nested arrays: would C-stack-overflow without a depth cap
+    deep = b"\x91" * 100_000 + b"\xc0"
+    with pytest.raises(ValueError, match="nesting"):
+        native.unpackb(deep)
+    # a legitimate 512-deep... stays under the cap at 511
+    ok = b"\x91" * 500 + b"\xc0"
+    v = native.unpackb(ok)
+    for _ in range(500):
+        assert isinstance(v, list) and len(v) == 1
+        v = v[0]
+    assert v is None
+    # 4-byte array header promising 2^32-1 elements with no payload:
+    # must not preallocate a multi-GB list
+    with pytest.raises(ValueError, match="length exceeds input"):
+        native.unpackb(b"\xdd\xff\xff\xff\xff")
+    # same for maps
+    with pytest.raises(ValueError, match="length exceeds input"):
+        native.unpackb(b"\xdf\xff\xff\xff\xff")
+    # str/bin headers larger than the input
+    with pytest.raises(ValueError):
+        native.unpackb(b"\xdb\xff\xff\xff\xff" + b"x")
+    with pytest.raises(ValueError):
+        native.unpackb(b"\xc6\xff\xff\xff\xff" + b"x")
+
+
 def test_fuzzed_roundtrips():
     import random
     rng = random.Random(42)

@@ -39,7 +39,7 @@ def test_inplace_update_same_id_not_double_counted():
     plan.node_allocation = {node.id: [updated]}
     plan.snapshot_index = srv.store.latest_index()
 
-    result = srv.plan_applier.apply(plan)
+    result = srv.plan_applier.apply_sync(plan)
     full, expected, actual = result.full_commit(plan)
     assert full, (
         f"in-place update rejected: committed {actual}/{expected}; "
@@ -65,7 +65,7 @@ def test_true_port_collision_still_rejected():
     plan.node_allocation = {node.id: [clash]}
     plan.snapshot_index = srv.store.latest_index()
 
-    result = srv.plan_applier.apply(plan)
+    result = srv.plan_applier.apply_sync(plan)
     full, _, _ = result.full_commit(plan)
     assert not full
     assert result.refresh_index > 0

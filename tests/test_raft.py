@@ -126,6 +126,83 @@ def test_failover_elects_new_leader_and_serves_writes(cluster):
 
 
 @pytest.mark.slow
+def test_acked_write_survives_immediate_leader_kill(cluster):
+    """Quorum commit: raft_apply acks only after a majority holds the
+    entry, so a write acked just before the leader dies MUST survive
+    failover (Raft §5.4; the round-2 primary/backup semantics lost
+    exactly this tail)."""
+    servers, rpcs, _addrs = cluster
+    assert _wait_for(lambda: len(_leaders(servers)) == 1, timeout=10)
+    leader = _leaders(servers)[0]
+    li = servers.index(leader)
+
+    node = mock.node()
+    leader.register_node(node)          # returns only after quorum ack
+    rpcs[li].shutdown()                 # kill immediately after the ack
+    leader.shutdown()
+
+    rest = [s for s in servers if s is not leader]
+    assert _wait_for(lambda: len(_leaders(rest)) == 1, timeout=10), \
+        [s.raft.role for s in rest]
+    new_leader = _leaders(rest)[0]
+    assert new_leader.store.node_by_id(node.id) is not None, \
+        "acked write lost on failover"
+
+
+@pytest.mark.slow
+def test_dead_peer_does_not_destabilize_leader(cluster):
+    """Per-peer replication threads: one unreachable peer must not
+    starve heartbeats to the healthy follower (which would trigger
+    continual elections). Writes keep committing on the 2/3 quorum."""
+    servers, rpcs, _addrs = cluster
+    assert _wait_for(lambda: len(_leaders(servers)) == 1, timeout=10)
+    leader = _leaders(servers)[0]
+    followers = [s for s in servers if s is not leader]
+    dead = followers[0]
+    di = servers.index(dead)
+    rpcs[di].shutdown()
+    dead.shutdown()
+
+    term_before = leader.raft.term
+    # writes must still ack via leader + surviving follower
+    for i in range(3):
+        node = mock.node()
+        node.name = f"alive-{i}"
+        leader.register_node(node)
+        time.sleep(0.3)
+    assert leader.raft.is_leader(), "leader lost leadership"
+    assert leader.raft.term == term_before, \
+        "election churn while a peer was down"
+    assert len([n for n in followers[1].store.nodes()
+                if n.name.startswith("alive-")]) == 3
+
+
+def test_deposed_leader_refuses_append_and_term_pins_waits():
+    """record_entry on a non-leader must raise (a deposed leader
+    appending with the new term would make the real leader's entry at
+    that index look already-present on a follower), and wait_for_commit
+    pinned to a term must fail once the term moves — the entry may have
+    been erased by a reseed in between."""
+    from nomad_tpu.server.raft import FOLLOWER, LEADER, RaftNode
+
+    s = Server(ServerConfig(num_schedulers=0, heartbeat_ttl_s=30.0))
+    node = RaftNode(s, "127.0.0.1:1", ["127.0.0.1:1", "127.0.0.1:2"])
+    node.role = FOLLOWER
+    with pytest.raises(RuntimeError, match="not the leader"):
+        node.record_entry(11, "noop", {})
+    assert node.log == []
+
+    node.role = LEADER
+    node.term = 3
+    term = node.record_entry(11, "noop", {})
+    assert term == 3
+    node.term = 4                       # deposed + re-elected elsewhere
+    with pytest.raises(RuntimeError, match="term moved"):
+        node.wait_for_commit(11, term=3, timeout_s=0.5)
+    s.shutdown()
+
+
+@pytest.mark.slow
 def test_snapshot_reseed_of_fresh_follower():
     """A server joining with empty state catches up via snapshot
     install when the leader's log has been compacted past its needs."""

@@ -87,6 +87,56 @@ def test_allocs_indexes():
     assert len(s.allocs_by_node_terminal(n.id, False)) == 2
 
 
+def test_bulk_load_allocs_matches_upsert_semantics():
+    """bulk_load_allocs (the C2M replay seed) must leave the store in
+    the same observable state as repeated upsert_allocs: tables,
+    secondary indexes, job summaries, retrievability — plus a changelog
+    floor bump that forces resident tables to rebuild."""
+    from nomad_tpu.models import ALLOC_CLIENT_RUNNING
+
+    def seed(store, loader):
+        nodes = [mock.node() for _ in range(4)]
+        for i, n in enumerate(nodes):
+            s_idx = store.latest_index() + 1
+            store.upsert_node(s_idx, n)
+        j = mock.job()
+        j.id = "bulk-job"
+        store.upsert_job(store.latest_index() + 1, j)
+        allocs = []
+        for i in range(40):
+            a = mock.alloc()
+            a.job_id = j.id
+            a.job = j
+            a.node_id = nodes[i % 4].id
+            a.name = f"{j.id}.web[{i}]"
+            a.client_status = ALLOC_CLIENT_RUNNING
+            allocs.append(a)
+        loader(store, store.latest_index() + 1, allocs)
+        return j, nodes, allocs
+
+    ref = StateStore()
+    j1, nodes1, _ = seed(ref, lambda s, i, al: s.upsert_allocs(i, al))
+    bulk = StateStore()
+    j2, nodes2, allocs2 = seed(bulk, lambda s, i, al: s.bulk_load_allocs(i, al))
+
+    assert len(bulk.allocs_by_job("default", j2.id)) == \
+        len(ref.allocs_by_job("default", j1.id)) == 40
+    for n in nodes2:
+        assert len(bulk.allocs_by_node(n.id)) == 10
+    a = allocs2[7]
+    got = bulk.alloc_by_id(a.id)
+    assert got is not None and got.modify_index == got.create_index
+    # summaries aggregated identically
+    s_ref = ref.job_summary("default", j1.id).summary["web"]
+    s_bulk = bulk.job_summary("default", j2.id).summary["web"]
+    assert s_bulk == s_ref == {"running": 40}
+    # delta path invalidated: a reader from before the bulk load must
+    # be told to rebuild (changes_since -> None)
+    assert bulk.changes_since(0, bulk.latest_index()) is None
+    # eval index present
+    assert len(bulk.allocs_by_eval(allocs2[0].eval_id)) >= 1
+
+
 def test_update_allocs_from_client_and_summary():
     s = StateStore()
     j = mock.job()
