@@ -39,6 +39,9 @@ C_MAX = 64      # max distinct attribute values per spread/property axis
 NEG_INF = -1e30
 TOP_K = 5       # ScoreMetaData entries kept (reference kheap topK)
 CHUNK_J = 256   # max instances placed on one node per chunked step
+KWAY_W = 32     # winners placed per phase in the k-way chunked kernel
+KWAY_STEPS = 256  # phases per dispatch: ~56 cover a 10k batch, and the
+                  # out buffers ride the tunnel — small beats roomy
 
 
 def _pad_n(n: int) -> int:
@@ -499,6 +502,191 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
              remaining, steps))
 
 
+def _kway_core(capacity, used0, feasible, ask, k_valid,
+               tg_coll0, penalty, affinity_norm, desired_count,
+               port_need, free_ports, port_ok,
+               dev_slots0, dev_score, dev_fires, pre_score,
+               *, max_steps: int, spread_alg: bool):
+    """K-way chunked greedy placement for node-local scoring: each phase
+    takes the top-W nodes and gives EACH the number of sub-placements
+    that keep its own score above the (W+1)-th node's score (the
+    waterline), under the scan's argmax tie rule. Greedy only ever picks
+    the current argmax, and scores are node-local, so until every winner
+    falls below the waterline the argmax stays inside the winner set —
+    the multiset of placements per phase is exactly the greedy one (the
+    host reconstructs the exact order with a heap merge,
+    _expand_kway). A phase whose winner chunks would overshoot the
+    remaining count degenerates to placing only on the single best node,
+    preserving exactness for the tail.
+
+    Phases ~ count/(W * avg-chunk) instead of the 2-way kernel's
+    count/avg-chunk steps — an order of magnitude fewer sequential
+    device steps for big batches, and out buffers to match."""
+    n = capacity.shape[0]
+    cap_cpu = jnp.maximum(capacity[:, 0], 1e-9)
+    cap_mem = jnp.maximum(capacity[:, 1], 1e-9)
+    arange_j = jnp.arange(CHUNK_J, dtype=jnp.float32)
+
+    def cond(state):
+        (_used, _coll, _freep, _dev, remaining, step, alive, *_o) = state
+        return (remaining > 0) & alive & (step < max_steps)
+
+    def body(state):
+        (used, coll, free_p, dev_slots, remaining, step, _alive,
+         out_widx, out_chunk, out_ti, out_ts, out_exh, out_feas) = state
+
+        feas = feasible & (free_p >= port_need) & port_ok & \
+            (dev_slots >= 1.0)
+        after = used + ask[None, :]
+        fit_dims = after <= capacity + 1e-6
+        fit = jnp.all(fit_dims, axis=1)
+        final, _b, _a, _p = _local_final_score(
+            after, cap_cpu, cap_mem, coll, penalty, affinity_norm,
+            desired_count, spread_alg, dev_score, dev_fires, pre_score)
+        ok = feas & fit
+        masked = jnp.where(ok, final, NEG_INF)
+
+        tv, ti = jax.lax.top_k(masked, KWAY_W + 1)
+        wl_val = tv[KWAY_W]
+        wl_idx = ti[KWAY_W]
+        widx = ti[:KWAY_W]
+        wvalid = tv[:KWAY_W] > NEG_INF / 2
+        valid = wvalid[0]
+
+        # diagnostics on the first and failing phases only
+        def _meta(_):
+            top_scores, top_idx = jax.lax.top_k(masked, TOP_K)
+            prefix_ok = jnp.cumprod(fit_dims.astype(jnp.int32), axis=1)
+            earlier_ok = jnp.concatenate(
+                [jnp.ones((n, 1), dtype=bool),
+                 prefix_ok[:, :-1].astype(bool)], axis=1)
+            first_fail = feas[:, None] & earlier_ok & ~fit_dims
+            return (top_idx.astype(jnp.int32), top_scores,
+                    first_fail.sum(axis=0).astype(jnp.int32),
+                    ok.sum().astype(jnp.int32))
+
+        def _no_meta(_):
+            return (jnp.full((TOP_K,), -1, jnp.int32),
+                    jnp.full((TOP_K,), NEG_INF, jnp.float32),
+                    jnp.full((capacity.shape[1],), -1, jnp.int32),
+                    jnp.int32(-1))
+
+        top_idx, top_scores, exhausted, feas_count = jax.lax.cond(
+            (step == 0) | ~valid, _meta, _no_meta, operand=None)
+
+        # physical capacity per winner
+        free_dims = capacity[widx] - used[widx]                 # [W, D]
+        per_dim = jnp.where(ask[None, :] > 0,
+                            jnp.floor((free_dims + 1e-6) / ask[None, :]),
+                            1e9)
+        m_fit = jnp.min(per_dim, axis=1)
+        m_port = jnp.where(port_need > 0,
+                           jnp.floor(free_p[widx] / port_need), 1e9)
+        a_max = jnp.minimum(jnp.minimum(m_fit, m_port), dev_slots[widx])
+        a_max = jnp.minimum(a_max, jnp.float32(CHUNK_J))
+
+        # per-winner scores after each sub-placement  [W, CHUNK_J]
+        after_j = used[widx][:, None, :] \
+            + (arange_j[None, :, None] + 1.0) * ask[None, None, :]
+        coll_j = coll[widx].astype(jnp.float32)[:, None] + arange_j[None, :]
+        final_j, _, _, _ = _local_final_score(
+            after_j, cap_cpu[widx][:, None], cap_mem[widx][:, None],
+            coll_j, penalty[widx][:, None], affinity_norm[widx][:, None],
+            desired_count, spread_alg, dev_score[widx][:, None], dev_fires,
+            pre_score[widx][:, None])
+        wins = (final_j > wl_val) | \
+               ((final_j == wl_val) & (widx[:, None] < wl_idx))
+        prefix = jnp.cumprod(wins.astype(jnp.int32), axis=1)
+        chunk = jnp.minimum(
+            jnp.maximum(prefix.sum(axis=1).astype(jnp.float32), 1.0),
+            a_max)
+        chunk = jnp.where(wvalid, chunk, 0.0)
+
+        # overshoot fallback: the tail phase degenerates to the 2-way
+        # rule — place on the best node only, chunked against the
+        # RUNNER-UP's score (not the waterline: with W winners zeroed
+        # out, the runner-up is the true greedy competitor)
+        total = chunk.sum()
+        runner_val = tv[1]
+        runner_idx = ti[1]
+        wins0 = (final_j[0] > runner_val) | \
+                ((final_j[0] == runner_val) & (widx[0] < runner_idx))
+        chunk0 = jnp.minimum(
+            jnp.maximum(jnp.cumprod(wins0.astype(jnp.int32)).sum()
+                        .astype(jnp.float32), 1.0), a_max[0])
+        first_only = jnp.zeros_like(chunk).at[0].set(
+            jnp.minimum(chunk0, remaining.astype(jnp.float32)))
+        chunk = jnp.where(total > remaining.astype(jnp.float32),
+                          first_only, chunk)
+        chunk = jnp.where(valid, chunk, jnp.zeros_like(chunk))
+        chunk_i = chunk.astype(jnp.int32)
+
+        # winner indices are distinct, so scatter-add is well-defined;
+        # invalid lanes carry chunk 0 (no-op adds on a real node row)
+        safe_w = jnp.maximum(widx, 0)
+        used = used.at[safe_w].add(chunk[:, None] * ask[None, :])
+        coll = coll.at[safe_w].add(chunk_i)
+        free_p = free_p.at[safe_w].add(-chunk * port_need)
+        dev_slots = dev_slots.at[safe_w].add(-chunk)
+
+        out_widx = out_widx.at[step].set(
+            jnp.where(chunk_i > 0, widx, -1).astype(jnp.int32))
+        out_chunk = out_chunk.at[step].set(chunk_i)
+        out_ti = out_ti.at[step].set(top_idx)
+        out_ts = out_ts.at[step].set(top_scores)
+        out_exh = out_exh.at[step].set(exhausted)
+        out_feas = out_feas.at[step].set(feas_count)
+
+        return (used, coll, free_p, dev_slots,
+                remaining - chunk_i.sum(), step + 1, valid,
+                out_widx, out_chunk, out_ti, out_ts, out_exh, out_feas)
+
+    d = capacity.shape[1]
+    state0 = (used0, tg_coll0, free_ports, dev_slots0, k_valid,
+              jnp.int32(0), jnp.bool_(True),
+              jnp.full((max_steps, KWAY_W), -1, jnp.int32),
+              jnp.zeros((max_steps, KWAY_W), jnp.int32),
+              jnp.full((max_steps, TOP_K), -1, jnp.int32),
+              jnp.full((max_steps, TOP_K), NEG_INF, jnp.float32),
+              jnp.zeros((max_steps, d), jnp.int32),
+              jnp.zeros(max_steps, jnp.int32))
+    out = jax.lax.while_loop(cond, body, state0)
+    (used, coll, free_p, dev_slots, remaining, steps, _alive,
+     out_widx, out_chunk, out_ti, out_ts, out_exh, out_feas) = out
+    # ONE int payload + one float payload crosses the tunnel: per-array
+    # device->host copies each cost a tunnel op, which dwarfs the bytes
+    packed_i = jnp.concatenate(
+        [out_widx, out_chunk, out_ti, out_exh, out_feas[:, None],
+         jnp.broadcast_to(remaining[None, None], (max_steps, 1)),
+         jnp.broadcast_to(steps[None, None], (max_steps, 1))], axis=1)
+    return ((used, coll, free_p, dev_slots), (packed_i, out_ts))
+
+
+_select_kway = partial(jax.jit, static_argnames=("max_steps",
+                                                 "spread_alg"))(_kway_core)
+
+# Multi-eval batching (SURVEY §2.6 row 1: "batch multiple evals per
+# device dispatch"): B independent placement problems over ONE shared
+# node-capacity table run as a single dispatch — over a tunneled device
+# this amortizes the per-op latency across the whole eval batch, and on
+# a local chip it raises utilization the same way.
+_KWAY_BATCH_AXES = (None,) + (0,) * 15
+
+
+@partial(jax.jit, static_argnames=("max_steps", "spread_alg"))
+def _select_kway_batched(capacity, used0, feasible, ask, k_valid,
+                         tg_coll0, penalty, affinity_norm, desired_count,
+                         port_need, free_ports, port_ok,
+                         dev_slots0, dev_score, dev_fires, pre_score,
+                         *, max_steps: int, spread_alg: bool):
+    fn = partial(_kway_core, max_steps=max_steps, spread_alg=spread_alg)
+    return jax.vmap(fn, in_axes=_KWAY_BATCH_AXES)(
+        capacity, used0, feasible, ask, k_valid,
+        tg_coll0, penalty, affinity_norm, desired_count,
+        port_need, free_ports, port_ok,
+        dev_slots0, dev_score, dev_fires, pre_score)
+
+
 # Kinds for each packed argument: how its leading axis shards over a
 # node-axis mesh (parallel/sharded.py). "node"=[N], "node2"=[N,d],
 # "code"=[S,N] style, "rep"=replicated small state, "scalar"=0-d.
@@ -671,6 +859,170 @@ _CHUNKED_ARGS = ("capacity", "used0", "feasible", "ask", "k_valid",
                  "port_need", "free_ports", "port_ok",
                  "dev_slots0", "dev_score", "dev_fires", "pre_score")
 
+
+def _node_local_scores_np(req: SelectRequest, c: int, start: int,
+                          m: int):
+    """Scores of sub-placements start..start+m-1 on node c, float32,
+    identical math to the kernels (_local_final_score)."""
+    ask = np.asarray(req.ask, np.float32)
+    a = np.arange(m, dtype=np.float32)
+    after = (req.used[c].astype(np.float32)[None, :]
+             + (start + a[:, None] + 1.0) * ask)
+    cap_cpu = np.float32(max(req.capacity[c, 0], 1e-9))
+    cap_mem = np.float32(max(req.capacity[c, 1], 1e-9))
+    free_cpu = np.float32(1.0) - after[:, 0] / cap_cpu
+    free_mem = np.float32(1.0) - after[:, 1] / cap_mem
+    total = (np.power(np.float32(10.0), free_cpu)
+             + np.power(np.float32(10.0), free_mem))
+    if req.algorithm == "spread":
+        fit_score = np.clip(total - 2.0, 0.0, 18.0)
+    else:
+        fit_score = np.clip(20.0 - total, 0.0, 18.0)
+    binp = (fit_score / np.float32(18.0)).astype(np.float32)
+    desired = np.float32(max(req.desired_count, 1.0))
+    coll = np.float32(req.tg_collisions[c]) + np.float32(start) + a
+    anti_fires = coll > 0
+    anti = np.where(anti_fires, -(coll + 1.0) / desired,
+                    0.0).astype(np.float32)
+    pen_f = bool(req.penalty[c]) if req.penalty is not None else False
+    pen = np.float32(-1.0 if pen_f else 0.0)
+    if req.affinity is not None and req.affinity_sum_weights > 0:
+        aff = np.float32(req.affinity[c] / req.affinity_sum_weights)
+    else:
+        aff = np.float32(0.0)
+    dev = np.float32(req.dev_score[c]) if req.dev_fires \
+        and req.dev_score is not None else np.float32(0.0)
+    pre = np.float32(req.pre_score[c]) if req.pre_score is not None \
+        else np.float32(0.0)
+    fired = (1.0 + anti_fires.astype(np.float32)
+             + np.float32(1.0 if pen_f else 0.0)
+             + np.float32(1.0 if aff != 0.0 else 0.0)
+             + np.float32(1.0 if req.dev_fires else 0.0)
+             + np.float32(1.0 if pre != 0.0 else 0.0))
+    fin = ((binp + anti + pen + aff + dev + pre) / fired).astype(np.float32)
+    return fin, binp, anti, pen, aff, dev, pre
+
+
+def _expand_kway(req: SelectRequest, rounds) -> SelectResult:
+    """Expand per-phase (winners, chunks) into the exact per-instance
+    greedy sequence: within a phase every winner's next-score beats the
+    waterline, so true greedy order is the heap merge of the winners'
+    score streams (max score first, ties to the lowest node index) —
+    identical to the scan's argmax sequence."""
+    import heapq
+
+    n = len(req.feasible)
+    k_total = req.count
+    d = req.capacity.shape[1]
+
+    node_idx = np.full(k_total, -1, np.int32)
+    final = np.zeros(k_total, np.float32)
+    comp = {name: np.zeros(k_total, np.float32)
+            for name in ("binpack", "job-anti-affinity",
+                         "node-reschedule-penalty", "node-affinity",
+                         "devices", "preemption")}
+    top_i = np.full((k_total, TOP_K), -1, np.int32)
+    top_s = np.full((k_total, TOP_K), NEG_INF, np.float32)
+    exh_out = np.zeros((k_total, d), np.int32)
+
+    pos = 0
+    extra: Dict[int, int] = {}          # node -> placed so far overall
+    last_meta = None
+    fail = None
+    for (widx, chunk, ti, ts, exh, _feas) in rounds:
+        for s in range(len(widx)):
+            if exh[s][0] >= 0:
+                last_meta = (ti[s], ts[s], exh[s])
+            winners = [(int(widx[s][w]), int(chunk[s][w]))
+                       for w in range(widx.shape[1])
+                       if chunk[s][w] > 0 and widx[s][w] >= 0]
+            if not winners:
+                fail = last_meta
+                continue
+            # per-winner score streams for this phase
+            streams = {}
+            for c, m in winners:
+                start = extra.get(c, 0)
+                streams[c] = _node_local_scores_np(req, c, start, m)
+                extra[c] = start + m
+            # heap merge emits only the (node, j) order; array fills are
+            # batched per phase (per-instance numpy writes dominate
+            # multi-batch expansion otherwise)
+            heap = []
+            for c, _m in winners:
+                heapq.heappush(heap, (-float(streams[c][0][0]), c, 0))
+            order_c: List[int] = []
+            order_j: List[int] = []
+            while heap and pos + len(order_c) < k_total:
+                _negs, c, j = heapq.heappop(heap)
+                order_c.append(c)
+                order_j.append(j)
+                fin = streams[c][0]
+                if j + 1 < len(fin):
+                    heapq.heappush(heap, (-float(fin[j + 1]), c, j + 1))
+            m = len(order_c)
+            if m == 0:
+                continue
+            sl = slice(pos, pos + m)
+            oc = np.asarray(order_c, np.int32)
+            oj = np.asarray(order_j, np.int64)
+            node_idx[sl] = oc
+            # gather per-instance scores from the streams: stack into a
+            # ragged-safe [winner, CHUNK] matrix addressed by (c, j)
+            cmap = {c: k for k, (c, _m) in enumerate(winners)}
+            max_m = max(mm for _c, mm in winners)
+            fin_m = np.zeros((len(winners), max_m), np.float32)
+            bin_m = np.zeros_like(fin_m)
+            anti_m = np.zeros_like(fin_m)
+            pen_v = np.zeros(len(winners), np.float32)
+            aff_v = np.zeros(len(winners), np.float32)
+            dev_v = np.zeros(len(winners), np.float32)
+            pre_v = np.zeros(len(winners), np.float32)
+            for c, mm in winners:
+                k = cmap[c]
+                fin, binp, anti, pen, aff, dev, pre = streams[c]
+                fin_m[k, :mm] = fin
+                bin_m[k, :mm] = binp
+                anti_m[k, :mm] = anti
+                pen_v[k] = pen
+                aff_v[k] = aff
+                dev_v[k] = dev
+                pre_v[k] = pre
+            ok = np.asarray([cmap[c] for c in order_c], np.int64)
+            final[sl] = fin_m[ok, oj]
+            comp["binpack"][sl] = bin_m[ok, oj]
+            comp["job-anti-affinity"][sl] = anti_m[ok, oj]
+            comp["node-reschedule-penalty"][sl] = pen_v[ok]
+            comp["node-affinity"][sl] = aff_v[ok]
+            comp["devices"][sl] = dev_v[ok]
+            comp["preemption"][sl] = pre_v[ok]
+            m_ti, m_ts, m_exh = last_meta if last_meta is not None else \
+                (np.full(TOP_K, -1, np.int64), np.full(TOP_K, NEG_INF),
+                 np.zeros(d, np.int64))
+            top_i[sl] = np.where(np.asarray(m_ti) >= n, -1,
+                                 np.asarray(m_ti))[None, :]
+            top_s[sl] = np.asarray(m_ts)[None, :]
+            exh_out[sl] = np.maximum(np.asarray(m_exh), 0)[None, :]
+            pos += m
+    if fail is not None and pos < k_total:
+        ti_f, ts_f, exh_f = fail
+        top_i[pos:] = np.where(np.asarray(ti_f) >= n, -1, np.asarray(ti_f))
+        top_s[pos:] = ts_f
+        exh_out[pos:] = exh_f
+
+    considered = req.n_considered if req.n_considered is not None else n
+    comp["allocation-spread"] = np.zeros(k_total, np.float32)
+    return SelectResult(
+        node_idx=node_idx,
+        final_score=final,
+        scores=comp,
+        top_idx=top_i, top_scores=top_s,
+        nodes_evaluated=considered,
+        nodes_filtered=int(considered - np.count_nonzero(req.feasible)),
+        exhausted_dim=exh_out,
+        placed=pos,
+    )
+
 _accel_rtt_cache: List[float] = []
 
 
@@ -725,6 +1077,33 @@ class SelectKernel:
         import os
         self.backend = backend or os.environ.get(
             "NOMAD_TPU_SELECT_BACKEND", "auto")
+        self._mesh_tried = False
+        self._sharded = None
+
+    def _mesh_sharded(self):
+        """The production multi-chip path (SURVEY §2.6: shard the node
+        axis instead of sampling it): when more than one device is
+        visible on an accelerator backend — or NOMAD_TPU_MESH=1 forces
+        it (tests/dryrun on the virtual CPU mesh) — dispatches route
+        through a jax.sharding.Mesh over all devices."""
+        if self._mesh_tried:
+            return self._sharded
+        self._mesh_tried = True
+        import os
+        want = os.environ.get("NOMAD_TPU_MESH", "auto")
+        if want in ("0", "off", "no"):
+            return None
+        try:
+            n_dev = len(jax.devices())
+        except Exception:
+            return None
+        force = want in ("1", "on", "force")
+        auto = (want == "auto" and n_dev > 1
+                and jax.default_backend() != "cpu")
+        if n_dev > 1 and (force or auto):
+            from ..parallel.sharded import ShardedSelect, make_mesh
+            self._sharded = ShardedSelect(make_mesh())
+        return self._sharded
 
     # -- routing -------------------------------------------------------
     def _pick_device(self, n: int, est_steps: int):
@@ -754,6 +1133,25 @@ class SelectKernel:
 
     # -- entry ---------------------------------------------------------
     def select(self, req: SelectRequest) -> SelectResult:
+        sharded = self._mesh_sharded()
+        if sharded is not None:
+            chunk_ok = (not req.spreads and not req.distinct_props
+                        and not req.distinct_hosts
+                        and not req.scan_exclusive)
+            n_pad_sh = sharded.pad_to_shards(len(req.feasible))
+            if chunk_ok and req.count > 512 and n_pad_sh > KWAY_W:
+                # big batches keep the K-way kernel on the mesh: the
+                # same SPMD program, node axis sharded, top-k/gather
+                # collectives inserted by XLA
+                args, _statics = pack_request(req, n_pad_sh)
+                cargs = sharded.place_chunked_args(
+                    {k: args[k] for k in _CHUNKED_ARGS})
+                spread_alg = req.algorithm == "spread"
+                with sharded.mesh:
+                    pending = _select_kway(**cargs, max_steps=KWAY_STEPS,
+                                           spread_alg=spread_alg)
+                return self._finish_kway(req, cargs, spread_alg, pending)
+            return sharded.select(req)
         n = len(req.feasible)
         n_pad = _pad_n(n)
         chunk_ok = (not req.spreads and not req.distinct_props
@@ -762,6 +1160,10 @@ class SelectKernel:
             # chunked steps ~ nodes touched + overtakes, bounded by count
             est_steps = min(req.count, 2 * n)
             dev = self._pick_device(n_pad, est_steps)
+            if req.count > 512 and n_pad > KWAY_W:
+                # big batches: K-way phases place on the top-32 nodes at
+                # once — an order of magnitude fewer sequential steps
+                return self._run_kway(req, n_pad, dev)
             return self._run_chunked(req, n_pad, dev)
         dev = self._pick_device(n_pad, req.count)
         k = _bucket_k(max(req.count, 1))
@@ -770,6 +1172,137 @@ class SelectKernel:
         _carry, outs = _select_scan(**args, k_steps=k, **statics)
         return unpack_result(req, outs)
 
+    # -- k-way chunked path --------------------------------------------
+    def _dispatch_kway(self, req: SelectRequest, n_pad: int, dev):
+        """Issue the first K-way dispatch without waiting; returns the
+        (cargs, spread_alg, pending) state for _finish_kway."""
+        args, _statics = pack_request(req, n_pad)
+        cargs = {k: args[k] for k in _CHUNKED_ARGS}
+        cargs = self._place_args(cargs, dev)
+        spread_alg = req.algorithm == "spread"
+        pending = _select_kway(**cargs, max_steps=KWAY_STEPS,
+                               spread_alg=spread_alg)
+        return cargs, spread_alg, pending
+
+    def _finish_kway(self, req: SelectRequest, cargs, spread_alg,
+                     pending) -> SelectResult:
+        return _expand_kway(req, self._finish_kway_rounds(
+            req, cargs, spread_alg, pending))
+
+    def _run_kway(self, req: SelectRequest, n_pad: int,
+                  dev) -> SelectResult:
+        cargs, spread_alg, pending = self._dispatch_kway(req, n_pad, dev)
+        return self._finish_kway(req, cargs, spread_alg, pending)
+
+    def select_many(self, reqs: List[SelectRequest]) -> List[SelectResult]:
+        """Place B independent requests over the SAME node table in one
+        device dispatch (vmapped K-way kernel) — multi-eval batching per
+        SURVEY §2.6. Falls back to sequential select() for shapes the
+        K-way kernel doesn't cover. Results are bit-identical to
+        per-request select()."""
+        if not reqs:
+            return []
+        if self._mesh_sharded() is not None:
+            return [self.select(r) for r in reqs]
+        n = len(reqs[0].feasible)
+        n_pad = _pad_n(n)
+
+        def _chunk_ok(r):
+            return (not r.spreads and not r.distinct_props
+                    and not r.distinct_hosts and not r.scan_exclusive)
+
+        eligible = (len(reqs) > 1 and n_pad > KWAY_W
+                    and all(_chunk_ok(r) and len(r.feasible) == n
+                            and r.algorithm == reqs[0].algorithm
+                            for r in reqs))
+        if not eligible:
+            return [self.select(r) for r in reqs]
+
+        b = len(reqs)
+        bp = 1
+        while bp < b:
+            bp *= 2
+        packs = [pack_request(r, n_pad)[0] for r in reqs]
+        if bp > b:
+            dummy = dict(packs[0])
+            dummy["k_valid"] = np.int32(0)      # padding lane: places 0
+            packs += [dummy] * (bp - b)
+        cargs = {}
+        for k in _CHUNKED_ARGS:
+            if k == "capacity":
+                cargs[k] = packs[0][k]
+            else:
+                cargs[k] = np.stack([p[k] for p in packs])
+        dev = self._pick_device(n_pad, sum(min(r.count, 2 * n)
+                                           for r in reqs))
+        cargs = self._place_args(cargs, dev)
+        spread_alg = reqs[0].algorithm == "spread"
+        carry, outs = _select_kway_batched(**cargs,
+                                           max_steps=KWAY_STEPS,
+                                           spread_alg=spread_alg)
+        packed_i, ts = jax.device_get(outs)
+        w = KWAY_W
+        d = reqs[0].capacity.shape[1]
+        results = []
+        for i, req in enumerate(reqs):
+            pi = packed_i[i]
+            widx = pi[:, :w]
+            chunk = pi[:, w:2 * w]
+            ti = pi[:, 2 * w:2 * w + TOP_K]
+            exh = pi[:, 2 * w + TOP_K:2 * w + TOP_K + d]
+            feas = pi[:, -3]
+            rem = int(pi[0, -2])
+            steps = int(pi[0, -1])
+            rounds = [(widx[:steps], chunk[:steps], ti[:steps],
+                       ts[i][:steps], exh[:steps], feas[:steps])]
+            if rem > 0 and steps > 0 and chunk[steps - 1].sum() > 0:
+                # rare overflow of the phase budget: continue this lane
+                # on the single-request kernel from its carry state
+                lane = {k: (cargs[k] if k == "capacity"
+                            else np.asarray(jax.device_get(cargs[k][i])))
+                        for k in _CHUNKED_ARGS}
+                lane.update(
+                    used0=np.asarray(jax.device_get(carry[0][i])),
+                    tg_coll0=np.asarray(jax.device_get(carry[1][i])),
+                    free_ports=np.asarray(jax.device_get(carry[2][i])),
+                    dev_slots0=np.asarray(jax.device_get(carry[3][i])),
+                    k_valid=np.int32(rem))
+                pending = _select_kway(**lane, max_steps=KWAY_STEPS,
+                                       spread_alg=spread_alg)
+                cont = self._finish_kway_rounds(req, lane, spread_alg,
+                                                pending)
+                rounds.extend(cont)
+            results.append(_expand_kway(req, rounds))
+        return results
+
+    def _finish_kway_rounds(self, req, cargs, spread_alg, pending):
+        """Continuation rounds only (no expansion) — shared by the
+        batched path's per-lane overflow handling."""
+        w = KWAY_W
+        d = req.capacity.shape[1]
+        rounds = []
+        while True:
+            (used, coll, freep, devs), outs = pending
+            packed_i, ts = jax.device_get(outs)
+            widx = packed_i[:, :w]
+            chunk = packed_i[:, w:2 * w]
+            ti = packed_i[:, 2 * w:2 * w + TOP_K]
+            exh = packed_i[:, 2 * w + TOP_K:2 * w + TOP_K + d]
+            feas = packed_i[:, -3]
+            rem = int(packed_i[0, -2])
+            steps = int(packed_i[0, -1])
+            rounds.append((widx[:steps], chunk[:steps], ti[:steps],
+                           ts[:steps], exh[:steps], feas[:steps]))
+            if rem <= 0 or steps == 0:
+                break
+            if chunk[steps - 1].sum() == 0:
+                break
+            cargs.update(used0=used, tg_coll0=coll, free_ports=freep,
+                         dev_slots0=devs, k_valid=np.int32(rem))
+            pending = _select_kway(**cargs, max_steps=KWAY_STEPS,
+                                   spread_alg=spread_alg)
+        return rounds
+
     # -- chunked path --------------------------------------------------
     def _run_chunked(self, req: SelectRequest, n_pad: int,
                      dev) -> SelectResult:
@@ -777,7 +1310,20 @@ class SelectKernel:
         cargs = {k: args[k] for k in _CHUNKED_ARGS}
         cargs = self._place_args(cargs, dev)
         spread_alg = req.algorithm == "spread"
-        max_steps = 64 if req.count <= 64 else 512
+        # near-equal node scores make chunks short (each placement is
+        # overtaken after 1-2 instances), so a big count can need
+        # thousands of steps — every continuation round is a full
+        # host<->device round trip over the tunnel, so size the on-device
+        # step budget to finish big batches in ONE dispatch
+        if req.count <= 64:
+            max_steps = 64
+        elif req.count <= 512:
+            max_steps = 512
+        elif req.count <= 4096:
+            max_steps = 4096
+        else:
+            max_steps = 16384       # covers count<=16384 in one dispatch
+                                    # (a step always places >=1 or stops)
         rounds = []
         while True:
             (used, coll, freep, devs), outs = _select_chunked(

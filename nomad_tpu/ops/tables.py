@@ -27,13 +27,33 @@ RES_DIMS = 4  # cpu_shares, memory_mb, disk_mb, network_mbits
 DIM_NAMES = ("cpu", "memory", "disk", "network")
 
 
+# usage rows memoized by the identity of the alloc's resources object:
+# fleets share identical AllocatedResources shapes (and the C2M replay
+# seed shares ONE flyweight row across millions of allocs), so a 2M-row
+# table build becomes 2M dict hits instead of 2M ComparableResources
+# constructions. Values are immutable once allocated; holding the key
+# object in the memo pins its id() against reuse.
+_usage_memo: Dict[int, Tuple[object, Tuple[float, float, float, float]]] = {}
+_port_bits_memo: Dict[int, Tuple[object, int]] = {}
+
+
 def _alloc_usage(alloc) -> Tuple[float, float, float, float]:
+    res = alloc.allocated_resources
+    if res is not None:
+        hit = _usage_memo.get(id(res))
+        if hit is not None and hit[0] is res:
+            return hit[1]
     c = alloc.comparable_resources()
     if c is None:
         return (0.0, 0.0, 0.0, 0.0)
     mbits = sum(nw.mbits for nw in c.networks)
-    return (float(c.cpu_shares), float(c.memory_mb), float(c.disk_mb),
-            float(mbits))
+    out = (float(c.cpu_shares), float(c.memory_mb), float(c.disk_mb),
+           float(mbits))
+    if res is not None:
+        if len(_usage_memo) > 100_000:
+            _usage_memo.clear()
+        _usage_memo[id(res)] = (res, out)
+    return out
 
 
 class NodeTable:
@@ -62,6 +82,12 @@ class NodeTable:
         # node-class memoization, feasible.go:1026-1118); valid for this
         # table version — node attribute columns are immutable here
         self.mask_cache: Dict[Tuple, List] = {}
+        # cross-eval preemption victim cache keyed on the node's
+        # live-alloc ROW IDENTITY (rows are replaced copy-on-write, so
+        # an unchanged row means unchanged candidates) + the asking
+        # shape; entries pin their row so id() can't be recycled
+        # (scheduler/preemption.py PreemptionRound)
+        self.preempt_cache: Dict[Tuple, tuple] = {}
 
         self.capacity = np.zeros((self.n, RES_DIMS), dtype=np.float32)
         self.ready = np.zeros(self.n, dtype=bool)
@@ -163,6 +189,7 @@ class NodeTable:
         self._seal()
         t.alloc_by_id = self.alloc_by_id  # persistent map: O(1) share
         t.mask_cache = self.mask_cache  # node columns shared => masks too
+        t.preempt_cache = self.preempt_cache  # row identity keys the entries
         t._attr_codes_cache = self._attr_codes_cache
         t._sealed = True
         t._pending_allocs = []
@@ -171,19 +198,26 @@ class NodeTable:
     @staticmethod
     def _alloc_port_bits(alloc) -> int:
         res = alloc.allocated_resources
+        if res is None:
+            return 0
+        hit = _port_bits_memo.get(id(res))
+        if hit is not None and hit[0] is res:
+            return hit[1]
         bits = 0
-        if res is not None:
-            for nw in res.shared.networks:
+        for nw in res.shared.networks:
+            for ports in (nw.reserved_ports, nw.dynamic_ports):
+                for p in ports:
+                    if p.value > 0:
+                        bits |= 1 << p.value
+        for task in res.tasks.values():
+            for nw in task.networks:
                 for ports in (nw.reserved_ports, nw.dynamic_ports):
                     for p in ports:
                         if p.value > 0:
                             bits |= 1 << p.value
-            for task in res.tasks.values():
-                for nw in task.networks:
-                    for ports in (nw.reserved_ports, nw.dynamic_ports):
-                        for p in ports:
-                            if p.value > 0:
-                                bits |= 1 << p.value
+        if len(_port_bits_memo) > 100_000:
+            _port_bits_memo.clear()
+        _port_bits_memo[id(res)] = (res, bits)
         return bits
 
     def add_alloc_usage(self, i: int, alloc) -> None:

@@ -330,6 +330,28 @@ class PreemptionRound:
                                        memory_mb=float(ask_vec[1]),
                                        disk_mb=float(ask_vec[2]))
         n = len(table.nodes)
+        # cross-eval cache key parts: the tg's port/device shape and the
+        # ask vector (victims depend on both); the per-node row identity
+        # completes the key at lookup time
+        reserved: Tuple = ()
+        devs: Tuple = ()
+        if tg is not None:
+            from .stack import PlacementEngine
+            dyn, rs = PlacementEngine._port_asks(tg)
+            reserved = (dyn, tuple(sorted(rs)))
+            from .devices import combined_device_asks
+            # constraints/affinities change the victim set
+            # (group_satisfies evaluates them), so they are part of the
+            # cache identity
+            devs = tuple(
+                (r.name, r.count,
+                 tuple((c.ltarget, c.rtarget, c.operand)
+                       for c in (r.constraints or [])),
+                 tuple((a.ltarget, a.rtarget, a.operand, a.weight)
+                       for a in (r.affinities or [])))
+                for r in combined_device_asks(tg))
+        self._cache_sig = (job.priority, tuple(float(x) for x in ask_vec),
+                          reserved, devs)
         # computed state: known[i] -> score[i] (-1 = infeasible) and
         # victim lists; invalidation is *dirty-tracked* from the plan's
         # per-node entry counts instead of re-hashed per call
@@ -385,6 +407,22 @@ class PreemptionRound:
                     self._known[idx] = False
 
     # -- per-node evaluation (exact one-shot semantics) ----------------
+    def _cacheable(self, i: int) -> bool:
+        """A node's victim entry can cross evals when nothing specific
+        to THIS eval touches it: no plan entries on the node, and no
+        allocs of the placing job among its candidates (the own-job
+        exclusion makes victims job-relative)."""
+        node_id = self.table.ids[i]
+        p = self.plan
+        if node_id in p.node_allocation or node_id in p.node_update \
+                or node_id in p.node_preemptions:
+            return False
+        ns, jid = self.job.namespace, self.job.id
+        for a in self.table.live_allocs[i]:
+            if a.job_id == jid and a.namespace == ns:
+                return False
+        return True
+
     def _evaluate_node(self, i: int, used_row,
                        current: List[Allocation],
                        stopped_ids: set) -> Tuple[Optional[List[Allocation]],
@@ -392,6 +430,24 @@ class PreemptionRound:
         from ..models.funcs import ScoreFitBinPack
 
         import numpy as np
+
+        # cross-eval fast path: an unchanged live-alloc row (identity —
+        # rows are replaced copy-on-write) under the same priority/ask/
+        # port/device signature yields the same victims; entries with
+        # max_parallel-bearing candidates are never cached because their
+        # penalty couples to the eval's running preemption counts
+        cacheable = self._cacheable(i)
+        row = self.table.live_allocs[i]
+        key = (id(row), self._cache_sig)
+        if cacheable:
+            hit = self.table.preempt_cache.get(key)
+            if hit is not None and hit[0] is row:
+                _row, victims, score, logistic, freed = hit
+                self._logistic[i] = logistic
+                self._freed[i] = freed
+                self._mp_groups[i] = frozenset()
+                return (list(victims) if victims is not None else None,
+                        score)
 
         node = self.table.nodes[i]
         proposed = [a for a in self.snapshot.allocs_by_node(node.id)
@@ -408,6 +464,21 @@ class PreemptionRound:
                 mp.add((a.namespace, a.job_id, a.task_group))
         self._mp_groups[i] = frozenset(mp)
 
+        def memo(victims_out, score, logistic=0.0, freed=None):
+            """Record the result in the cross-eval cache when safe: the
+            node wasn't eval-specific (_cacheable) and no candidate
+            carries max_parallel (whose penalty couples to the running
+            preemption counts of this eval)."""
+            if cacheable and not mp:
+                if len(self.table.preempt_cache) > 200_000:
+                    self.table.preempt_cache.clear()
+                self.table.preempt_cache[key] = (
+                    row,
+                    list(victims_out) if victims_out is not None else None,
+                    score, logistic,
+                    freed if freed is not None else np.zeros(4, np.float64))
+            return victims_out, score
+
         # resource-dimension victims (skipped when the node already
         # fits on cpu/mem/disk and is a candidate only for device/port
         # reasons)
@@ -419,7 +490,7 @@ class PreemptionRound:
         else:
             victims = p.preempt_for_task_group(self.ask)
             if not victims:
-                return None, 0.0
+                return memo(None, 0.0)
             victims = list(victims)
         victim_ids = {v.id for v in victims}
 
@@ -429,7 +500,7 @@ class PreemptionRound:
             for reqd in combined_device_asks(self.tg):
                 dvict = p.preempt_for_device(reqd, node)
                 if dvict is None:
-                    return None, 0.0
+                    return memo(None, 0.0)
                 for v in dvict:
                     if v.id not in victim_ids:
                         victims.append(v)
@@ -454,13 +525,13 @@ class PreemptionRound:
                                           already_freed_mbits=freed_mbits,
                                           skip_ids=victim_ids)
             if nvict is None:
-                return None, 0.0
+                return memo(None, 0.0)
             for v in nvict:
                 if v.id not in victim_ids:
                     victims.append(v)
                     victim_ids.add(v.id)
         if not victims:
-            return None, 0.0
+            return memo(None, 0.0)
         # score: binpack fit after eviction + logistic preemption score
         util = ComparableResources()
         victim_ids = {v.id for v in victims}
@@ -484,7 +555,7 @@ class PreemptionRound:
             freed[3] += sum(nw.mbits for nw in cr.networks)
         self._logistic[i] = pscore
         self._freed[i] = freed
-        return victims, (binpack + pscore) / 2.0
+        return memo(victims, (binpack + pscore) / 2.0, pscore, freed)
 
     # -- entry ---------------------------------------------------------
     def find_placement(self, used) -> Optional[Tuple[int, List[Allocation],

@@ -916,13 +916,14 @@ class StateStore(StateSnapshot):
         if not allocs:
             return root
         t = root.table("allocs")
+        pairs = []
         for a in allocs:
             a.create_index = index
             a.modify_index = index
             a.alloc_modify_index = index
-            t = t.set(a.id, a)
+            pairs.append((a.id, a))
             self._log_change(index, "alloc", a.id)
-        root = root.with_table("allocs", t)
+        root = root.with_table("allocs", t.update(pairs))
 
         for table, keyfn in (
                 ("allocs_by_node", lambda a: a.node_id),
@@ -934,8 +935,7 @@ class StateStore(StateSnapshot):
             tt = root.table(table)
             for key, ids in groups.items():
                 members = (tt.get(key) or Hamt()).with_ctx(root._ctx)
-                for aid in ids:
-                    members = members.set(aid, True)
+                members = members.update([(aid, True) for aid in ids])
                 tt = tt.set(key, members.frozen())
             root = root.with_table(table, tt)
 

@@ -172,7 +172,16 @@ class Hamt:
 
     def update(self, pairs) -> "Hamt":
         """Batch set; runs through one EditContext so the whole batch
-        path-copies each trie node at most once."""
+        path-copies each trie node at most once. Updating an EMPTY map
+        takes the bottom-up radix builder instead — one bucket pass per
+        trie level beats per-insert path traversal ~5x, which is what
+        makes a 2M-row bulk load (store.bulk_load_allocs) tractable."""
+        if self._size == 0:
+            items = pairs if isinstance(pairs, dict) else dict(pairs)
+            if not items:
+                return self
+            hkv = [(hash(k), k, v) for k, v in items.items()]
+            return Hamt(_build_node(hkv, 0), len(items), self._ctx)
         items = pairs.items() if isinstance(pairs, dict) else pairs
         ctx = self._ctx or EditContext()
         root = self._root
@@ -181,6 +190,37 @@ class Hamt:
             root, added = _set_t(root, 0, hash(k), k, v, ctx)
             size += 1 if added else 0
         return Hamt(root, size, self._ctx)
+
+
+def _build_node(hkv, shift: int):
+    """Bottom-up construction of a trie node from [(hash, key, value)]
+    with DISTINCT keys: radix-bucket on this level's 5-bit slice, recurse
+    only into multi-entry buckets. O(n · levels) with one dict pass per
+    level instead of per-insert path walks."""
+    buckets = {}
+    for item in hkv:
+        idx = (item[0] >> shift) & _MASK
+        b = buckets.get(idx)
+        if b is None:
+            buckets[idx] = [item]
+        else:
+            b.append(item)
+    bitmap = 0
+    entries = []
+    for idx in sorted(buckets):
+        bitmap |= 1 << idx
+        b = buckets[idx]
+        if len(b) == 1:
+            _h, k, v = b[0]
+            entries.append((k, v))
+        else:
+            h0 = b[0][0]
+            if all(it[0] == h0 for it in b):
+                entries.append(_Collision(
+                    h0, tuple((k, v) for _h, k, v in b)))
+            else:
+                entries.append(_build_node(b, shift + _BITS))
+    return _Node(bitmap, tuple(entries))
 
 
 def _set(node, shift: int, h: int, key, value):
