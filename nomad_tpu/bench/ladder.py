@@ -253,11 +253,13 @@ def seed_c2m_allocs(h, nodes, seed_allocs: int,
         tg.tasks[0].resources.networks = []
         tg.networks = []
         tg.count = bulk_n
+        tgn = tg.name
+        task_name = tg.tasks[0].name
         h.store.upsert_job(h.next_index(), seed_job)
         # one shared flyweight resource row: the table builder only
         # reads it, and 2M private copies would cost GBs for nothing
         res = AllocatedResources(
-            tasks={"web": AllocatedTaskResources(
+            tasks={task_name: AllocatedTaskResources(
                 cpu=AllocatedCpuResources(cpu_shares=50),
                 memory=AllocatedMemoryResources(memory_mb=64))},
             shared=AllocatedSharedResources(disk_mb=10))
@@ -267,8 +269,8 @@ def seed_c2m_allocs(h, nodes, seed_allocs: int,
         for i in range(bulk_n):
             allocs.append(Allocation(
                 id=f"c2m-{i:08d}", namespace="default",
-                job_id=seed_job.id, task_group="web",
-                name=f"c2m-seed.web[{i}]",
+                job_id=seed_job.id, task_group=tgn,
+                name=f"c2m-seed.{tgn}[{i}]",
                 node_id=nodes[i % n_nodes].id, eval_id=eval_id,
                 client_status="running", desired_status="run",
                 allocated_resources=res))

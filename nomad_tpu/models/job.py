@@ -106,6 +106,11 @@ class UpdateStrategy:
     def rolling(self) -> bool:
         return self.stagger_s > 0 and self.max_parallel > 0
 
+    def is_empty(self) -> bool:
+        """structs.go UpdateStrategy.IsEmpty:4644 — max_parallel == 0
+        means no rolling updates at all (no deployments, no limits)."""
+        return self.max_parallel == 0
+
 
 @dataclass
 class MigrateStrategy:

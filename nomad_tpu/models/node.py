@@ -84,7 +84,11 @@ class Node:
     modify_index: int = 0
 
     def ready(self) -> bool:
+        """structs.go Node.Ready: status ready, NOT draining, eligible —
+        a draining node whose eligibility was set before the drain began
+        must still refuse new placements."""
         return (self.status == NODE_STATUS_READY
+                and not self.drain
                 and self.scheduling_eligibility == NODE_SCHED_ELIGIBLE)
 
     def canonicalize(self) -> None:

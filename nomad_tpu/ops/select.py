@@ -708,6 +708,9 @@ PACK_SHARD_KINDS = {
 
 MAX_SCAN_STEPS = 65536
 
+# process-wide sharded dispatcher (see SelectKernel._mesh_sharded)
+_SHARED_SHARDED = None
+
 
 def pack_request(req: SelectRequest, n_pad: int):
     """Pad/pack a SelectRequest into the _select_scan argument dict
@@ -1101,8 +1104,15 @@ class SelectKernel:
         auto = (want == "auto" and n_dev > 1
                 and jax.default_backend() != "cpu")
         if n_dev > 1 and (force or auto):
-            from ..parallel.sharded import ShardedSelect, make_mesh
-            self._sharded = ShardedSelect(make_mesh())
+            # ONE process-wide ShardedSelect: PlacementEngines (and
+            # their kernels) are rebuilt per eval, so the mesh and the
+            # resident device-side capacity cache must outlive them or
+            # the 'resident across evals' property is fiction
+            global _SHARED_SHARDED
+            if _SHARED_SHARDED is None:
+                from ..parallel.sharded import ShardedSelect, make_mesh
+                _SHARED_SHARDED = ShardedSelect(make_mesh())
+            self._sharded = _SHARED_SHARDED
         return self._sharded
 
     # -- routing -------------------------------------------------------
@@ -1145,7 +1155,8 @@ class SelectKernel:
                 # collectives inserted by XLA
                 args, _statics = pack_request(req, n_pad_sh)
                 cargs = sharded.place_chunked_args(
-                    {k: args[k] for k in _CHUNKED_ARGS})
+                    {k: args[k] for k in _CHUNKED_ARGS},
+                    capacity_src=req.capacity)
                 spread_alg = req.algorithm == "spread"
                 with sharded.mesh:
                     pending = _select_kway(**cargs, max_steps=KWAY_STEPS,
@@ -1213,6 +1224,7 @@ class SelectKernel:
 
         eligible = (len(reqs) > 1 and n_pad > KWAY_W
                     and all(_chunk_ok(r) and len(r.feasible) == n
+                            and r.capacity is reqs[0].capacity
                             and r.algorithm == reqs[0].algorithm
                             for r in reqs))
         if not eligible:

@@ -75,16 +75,8 @@ class ShardedSelect:
         placed_args = {}
         for name, value in args.items():
             if name == "capacity":
-                key = (id(req.capacity), n_pad)
-                hit = self._resident.get(key)
-                if hit is not None and hit[0] is req.capacity:
-                    placed_args[name] = hit[1]
-                    continue
-                arr = jax.device_put(value, self.node2_sharding)
-                if len(self._resident) > 16:
-                    self._resident.clear()
-                self._resident[key] = (req.capacity, arr)
-                placed_args[name] = arr
+                placed_args[name] = self._resident_capacity(req.capacity,
+                                                            value)
                 continue
             sharding = self._sharding_for(PACK_SHARD_KINDS[name])
             placed_args[name] = (value if sharding is None
@@ -93,13 +85,32 @@ class ShardedSelect:
             _carry, outs = _select_scan(**placed_args, k_steps=k, **statics)
         return unpack_result(req, outs)
 
-    def place_chunked_args(self, cargs: dict) -> dict:
+    def _resident_capacity(self, src, padded):
+        """Device-put the padded capacity once per (source array, pad)
+        and keep it sharded on the mesh across evals — the resident
+        node-table property (SURVEY §7.2 step 8). `src` is the host
+        NodeTable's capacity array whose identity keys the cache."""
+        key = (id(src), padded.shape[0])
+        hit = self._resident.get(key)
+        if hit is not None and hit[0] is src:
+            return hit[1]
+        arr = jax.device_put(padded, self.node2_sharding)
+        if len(self._resident) > 16:
+            self._resident.clear()
+        self._resident[key] = (src, arr)
+        return arr
+
+    def place_chunked_args(self, cargs: dict,
+                           capacity_src=None) -> dict:
         """Shard the K-way kernel's argument dict over the mesh (same
-        kind table as the scan; capacity rides the resident cache via
-        select(), but the padded per-call array is placed directly
-        here)."""
+        kind table as the scan). When capacity_src (the host table's
+        array) is given, capacity rides the cross-eval resident cache."""
         placed = {}
         for name, value in cargs.items():
+            if name == "capacity" and capacity_src is not None:
+                placed[name] = self._resident_capacity(capacity_src,
+                                                       value)
+                continue
             sharding = self._sharding_for(PACK_SHARD_KINDS[name])
             placed[name] = (value if sharding is None
                             else jax.device_put(value, sharding))

@@ -140,7 +140,9 @@ class AllocReconciler:
         m = new_alloc_matrix(self.job, self.existing_allocs)
         self._cancel_deployments()
 
-        if self.job.stopped():
+        # a nil job behaves as stopped (structs.go Job.Stopped treats a
+        # nil receiver as stopped; the GC path reconciles deleted jobs)
+        if self.job is None or self.job.stopped():
             self._handle_stop(m)
             return self.result
 
@@ -167,7 +169,7 @@ class AllocReconciler:
         return self.result
 
     def _cancel_deployments(self) -> None:
-        if self.job.stopped():
+        if self.job is None or self.job.stopped():
             if self.deployment is not None and self.deployment.active():
                 self.result.deployment_updates.append(DeploymentStatusUpdate(
                     deployment_id=self.deployment.id,
@@ -272,7 +274,11 @@ class AllocReconciler:
         if canary_state:
             untainted = difference(untainted, canaries)
 
-        strategy = tg.update
+        # an empty strategy (max_parallel == 0) behaves like no update
+        # stanza: no deployment, no rolling limit (UpdateStrategy
+        # .IsEmpty, structs.go:4644)
+        strategy = tg.update if (tg.update is not None
+                                 and not tg.update.is_empty()) else None
         canaries_promoted = dstate is not None and dstate.promoted
         require_canary = (len(destructive) != 0 and strategy is not None
                           and len(canaries) < strategy.canary
@@ -427,7 +433,8 @@ class AllocReconciler:
     def _compute_limit(self, tg: TaskGroup, untainted: AllocSet,
                        destructive: AllocSet, migrate: AllocSet,
                        canary_state: bool) -> int:
-        if tg.update is None or len(destructive) + len(migrate) == 0:
+        if (tg.update is None or tg.update.is_empty()
+                or len(destructive) + len(migrate) == 0):
             return tg.count
         if self.deployment_paused or self.deployment_failed:
             return 0
