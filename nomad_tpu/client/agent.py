@@ -140,13 +140,39 @@ class TaskRunner:
         ctx = {"task_dir": task_path or None,
                "log_dir": log_dir,
                "log_max_files": lc.max_files if lc else 10,
-               "log_max_file_size_mb": lc.max_file_size_mb if lc else 10}
+               "log_max_file_size_mb": lc.max_file_size_mb if lc else 10,
+               "alloc_id": self.alloc.id,
+               "resources": {"cpu": self.task.resources.cpu,
+                             "memory_mb": self.task.resources.memory_mb}}
         return config, env, ctx
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.run, daemon=True,
                                         name=f"task-{self.task.name}")
         self._thread.start()
+
+    def _start_stats_poll(self, handle) -> None:
+        """Task resource gauges while the task runs (task_runner.go
+        :1297-1370 emitStats -> nomad.client.allocs.* gauges), fed by
+        the driver's executor stats when it has one."""
+        stats_fn = getattr(self.driver, "stats", None)
+        if stats_fn is None:
+            return
+
+        def poll():
+            from ..utils import metrics
+            prefix = f"nomad.client.allocs.{self.alloc.id[:8]}." \
+                     f"{self.task.name}"
+            while not handle.done():
+                try:
+                    for k, v in (stats_fn(handle) or {}).items():
+                        metrics.set_gauge(f"{prefix}.{k}", v)
+                except Exception:
+                    pass
+                time.sleep(1.0)
+
+        threading.Thread(target=poll, daemon=True,
+                         name=f"stats-{self.task.name}").start()
 
     def kill(self) -> None:
         self._kill.set()
@@ -169,9 +195,12 @@ class TaskRunner:
                     config, env, ctx = self._prestart()
                     self.handle = self.driver.start_task(
                         self.task.name, config, env, ctx=ctx)
-                except (RuntimeError, HookError) as e:
-                    kind = "Setup Failure" if not isinstance(
-                        e, RuntimeError) else "Driver Failure"
+                except (RuntimeError, OSError, HookError) as e:
+                    # OSError: isolation setup (cgroupfs writes) can
+                    # fail at start; it must surface as a failed task,
+                    # not a dead runner thread stuck in PENDING
+                    kind = "Setup Failure" if isinstance(
+                        e, HookError) else "Driver Failure"
                     self.state = TaskState(
                         state=TASK_STATE_DEAD, failed=True,
                         finished_at=time.time(),
@@ -186,6 +215,7 @@ class TaskRunner:
                                    started_at=started_at,
                                    restarts=restarts)
             self.on_update()
+            self._start_stats_poll(self.handle)
             self.handle.wait()
             exit_code = self.handle.exit_code or 0
             failed = exit_code != 0

@@ -11,6 +11,7 @@ in the reference) arrives with the gRPC layer.
 
 from __future__ import annotations
 
+import os as _os
 import subprocess
 import threading
 import time
@@ -49,9 +50,13 @@ class TaskHandle:
         pid = None
         if self.proc is not None:
             pid = getattr(self.proc, "pid", None)
-        return {"id": self.id, "task_name": self.task_name,
-                "driver": self.driver, "config": dict(self.config),
-                "pid": pid, "started_at": self.started_at}
+        out = {"id": self.id, "task_name": self.task_name,
+               "driver": self.driver, "config": dict(self.config),
+               "pid": pid, "started_at": self.started_at}
+        cg = getattr(self, "cgroup_name", None)
+        if cg:
+            out["cgroup"] = cg
+        return out
 
 
 def _parse_duration(val) -> float:
@@ -238,14 +243,142 @@ class RawExecDriver:
 
 
 class ExecDriver(RawExecDriver):
-    """drivers/exec: in the reference this adds chroot+cgroup isolation
-    via shared/executor; isolation is a later-round concern, the
-    execution contract is the same."""
+    """drivers/exec: fork/exec with cgroup resource limits and a
+    mount-namespace chroot when the host allows it (root + writable
+    cgroupfs), per drivers/shared/executor/executor_linux.go. Falls
+    back to raw fork/exec otherwise, and advertises which mode the
+    fingerprint detected (driver.exec.isolation)."""
 
     name = "exec"
 
     def fingerprint(self) -> Dict[str, str]:
-        return {"driver.exec": "1"}
+        from .executor import IsolatedExecutor
+        isolated = IsolatedExecutor.available()
+        return {"driver.exec": "1",
+                "driver.exec.isolation": "cgroups" if isolated else "none"}
+
+    def start_task(self, task_name: str, config: dict, env: dict,
+                   ctx: Optional[dict] = None) -> TaskHandle:
+        from .executor import IsolatedExecutor
+        ctx = ctx or {}
+        resources = ctx.get("resources") or {}
+        if not IsolatedExecutor.available() or \
+                config.get("no_isolation"):
+            return super().start_task(task_name, config, env, ctx=ctx)
+
+        command = config.get("command")
+        if not command:
+            raise RuntimeError("missing command")
+        cwd = ctx.get("task_dir") or None
+        cg_name = f"{ctx.get('alloc_id', 'anon')[:8]}-{task_name}"
+        chroot_dir = None
+        if cwd and not config.get("no_chroot"):
+            chroot_dir = cwd
+        executor = IsolatedExecutor(
+            cg_name,
+            cpu_shares=int(resources.get("cpu", 0)),
+            memory_mb=int(resources.get("memory_mb", 0)),
+            chroot_dir=chroot_dir)
+        log_dir = ctx.get("log_dir")
+        stdout = stderr = subprocess.DEVNULL
+        if log_dir:
+            stdout = stderr = subprocess.PIPE
+        # containment runs in a re-exec'd bootstrap (exec_helper), not
+        # a preexec_fn: forking the JAX-threaded client to run Python
+        # code risks deadlock in the child (the reference re-execs its
+        # own binary for the same reason, main.go:16). The spec travels
+        # over STDIN — it carries the task env (possibly VAULT_TOKEN),
+        # and argv is world-readable via /proc/*/cmdline
+        import json as _json
+        import sys as _sys
+        spec = _json.dumps({
+            "procs_files": executor.procs_files,
+            "chroot_dir": chroot_dir,
+            "chroot_dirs": list(executor.chroot_dirs),
+            "command": command,
+            "args": list(config.get("args", [])),
+            "env": {**env} if env else {},
+            "cwd": cwd,
+        })
+        repo_root = _os.path.dirname(_os.path.dirname(
+            _os.path.dirname(_os.path.abspath(__file__))))
+        helper_env = {"PYTHONPATH": repo_root,
+                      "PATH": _os.environ.get("PATH", "/usr/bin:/bin")}
+        try:
+            proc = subprocess.Popen(
+                [_sys.executable, "-m", "nomad_tpu.client.exec_helper"],
+                env=helper_env, stdin=subprocess.PIPE,
+                stdout=stdout, stderr=stderr)
+            proc.stdin.write(spec.encode())
+            proc.stdin.close()
+        except (OSError, subprocess.SubprocessError) as e:
+            executor.destroy()
+            raise RuntimeError(f"failed to exec {command}: {e}")
+        h = TaskHandle(task_name=task_name, driver=self.name,
+                       config=config, proc=proc, started_at=time.time())
+        h.executor = executor
+        h.cgroup_name = cg_name
+        if log_dir:
+            from .logmon import RotatingWriter, pump
+            max_files = int(ctx.get("log_max_files", 10))
+            max_mb = int(ctx.get("log_max_file_size_mb", 10))
+            pump(proc.stdout, RotatingWriter(
+                log_dir, f"{task_name}.stdout", max_files, max_mb))
+            pump(proc.stderr, RotatingWriter(
+                log_dir, f"{task_name}.stderr", max_files, max_mb))
+
+        def wait():
+            code = proc.wait()
+            h.exit_code = code
+            # an OOM kill surfaces as SIGKILL; annotate it so the task
+            # event says WHY (executor_linux.go wait -> OOMKilled)
+            if code == -9 or code == 137:
+                if executor.oom_killed():
+                    h.error = "OOM Killed: memory limit exceeded"
+                    h.exit_code = 137
+            h.finished_at = time.time()
+            executor.destroy()
+            h._done.set()
+
+        threading.Thread(target=wait, daemon=True).start()
+        return h
+
+    def stop_task(self, handle: TaskHandle, timeout_s: float = 5.0) -> None:
+        super().stop_task(handle, timeout_s)
+        executor = getattr(handle, "executor", None)
+        if executor is not None:
+            executor.destroy()
+
+    def recover_task(self, state: dict) -> Optional[TaskHandle]:
+        """Re-attach by pid like raw_exec, plus reconstruct the cgroup
+        owner from the persisted name so destroy() reaps stragglers and
+        the cgroup dir doesn't leak across client restarts."""
+        h = super().recover_task(state)
+        cg = state.get("cgroup")
+        if cg:
+            from .executor import IsolatedExecutor
+            executor = IsolatedExecutor.recover(cg)
+            if h is None:
+                # process already gone: reap the leftover cgroup now
+                executor.destroy()
+            else:
+                h.executor = executor
+                h.cgroup_name = cg
+
+                def cleanup():
+                    h.wait()
+                    executor.destroy()
+
+                threading.Thread(target=cleanup, daemon=True).start()
+        return h
+
+    def stats(self, handle: TaskHandle) -> Dict[str, float]:
+        """Resource usage for a running task (executor Stats() ->
+        client task gauges)."""
+        executor = getattr(handle, "executor", None)
+        if executor is None:
+            return {}
+        return executor.stats()
 
 
 DRIVER_CATALOG = {

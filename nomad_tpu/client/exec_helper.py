@@ -1,0 +1,77 @@
+"""Containment bootstrap, run as a FRESH interpreter between the client
+and the task: joins the cgroup, builds the mount-ns chroot, then
+execve()s the task command.
+
+The reference re-execs its own binary for exactly this job (main.go:16
+logmon/executor re-exec): running containment code via preexec_fn would
+fork a multithreaded parent (the client embeds JAX), which risks
+deadlocking in the child on locks held by other threads at fork time.
+A spawned helper has no such baggage.
+
+Invoked as: python -m nomad_tpu.client.exec_helper  (spec JSON on
+STDIN — argv is world-readable via /proc/*/cmdline and the spec can
+carry secrets like VAULT_TOKEN)
+spec: {procs_files: [..], chroot_dir: str|null, chroot_dirs: [..],
+       command: str, args: [..], env: {..}, cwd: str|null}
+
+NOTE: the interpreter briefly occupies the task's cgroup before
+execve replaces it — memory limits below ~16MB can OOM the bootstrap
+itself.
+"""
+
+import json
+import os
+import sys
+
+
+def contain(spec: dict) -> None:
+    os.setsid()
+    for pf in spec.get("procs_files", []):
+        with open(pf, "w") as f:
+            f.write("0")            # 0 == the calling process
+    chroot_dir = spec.get("chroot_dir")
+    if chroot_dir:
+        from nomad_tpu.client.executor import (
+            CLONE_NEWNS, MS_BIND, MS_PRIVATE, MS_RDONLY, MS_REC,
+            MS_REMOUNT, _get_libc)
+        import ctypes
+        libc = _get_libc()
+        if libc.unshare(CLONE_NEWNS) != 0:
+            raise OSError(ctypes.get_errno(), "unshare(CLONE_NEWNS)")
+        if libc.mount(b"none", b"/", None, MS_REC | MS_PRIVATE,
+                      None) != 0:
+            raise OSError(ctypes.get_errno(), "make-rprivate /")
+        for src in spec.get("chroot_dirs", []):
+            if not os.path.isdir(src):
+                continue
+            dst = chroot_dir + src
+            os.makedirs(dst, exist_ok=True)
+            if libc.mount(src.encode(), dst.encode(), None,
+                          MS_BIND | MS_REC, None) != 0:
+                raise OSError(ctypes.get_errno(), f"bind {src}")
+            libc.mount(src.encode(), dst.encode(), None,
+                       MS_BIND | MS_REMOUNT | MS_RDONLY, None)
+        os.makedirs(chroot_dir + "/tmp", exist_ok=True)
+        os.makedirs(chroot_dir + "/dev", exist_ok=True)
+        for dev in ("null", "zero", "urandom"):
+            src = "/dev/" + dev
+            dst = chroot_dir + src
+            if not os.path.exists(dst):
+                open(dst, "a").close()
+            libc.mount(src.encode(), dst.encode(), None, MS_BIND, None)
+        os.chroot(chroot_dir)
+        os.chdir("/")
+    elif spec.get("cwd"):
+        os.chdir(spec["cwd"])
+
+
+def main() -> None:
+    spec = json.loads(sys.stdin.read())
+    contain(spec)
+    env = spec.get("env") or {}
+    cmd = spec["command"]
+    os.execvpe(cmd, [cmd] + list(spec.get("args", [])), env)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""Executor isolation: cgroup limits, OOM kill reporting, chroot
+containment, stats, graceful fallback (reference:
+drivers/shared/executor/executor_linux.go).
+
+Tests requiring root + writable cgroupfs skip elsewhere.
+"""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu.client.drivers import ExecDriver
+from nomad_tpu.client.executor import CgroupBackend, IsolatedExecutor
+
+isolation = pytest.mark.skipif(
+    not IsolatedExecutor.available(),
+    reason="requires root + writable cgroupfs")
+
+
+def _wait(handle, timeout=30.0):
+    assert handle.wait(timeout), "task did not finish"
+
+
+@isolation
+def test_memory_limit_kills_task(tmp_path):
+    """The contract VERDICT asked for: a task exceeding memory_mb is
+    killed by the kernel and reported as OOM."""
+    d = ExecDriver()
+    h = d.start_task(
+        "hog",
+        {"command": "/usr/bin/python3", "no_chroot": True,
+         "args": ["-c", "x = bytearray(256 * 1024 * 1024); "
+                        "import time; time.sleep(30)"]},
+        {"PATH": "/usr/bin:/bin"},
+        ctx={"alloc_id": "oomtest1", "task_dir": str(tmp_path),
+             "resources": {"cpu": 500, "memory_mb": 32}})
+    _wait(h)
+    assert h.exit_code not in (0, None), f"exit={h.exit_code}"
+    assert h.exit_code == 137 or h.exit_code < 0
+    assert "OOM" in (h.error or ""), h.error
+
+
+@isolation
+def test_within_limit_runs_and_reports_stats(tmp_path):
+    d = ExecDriver()
+    h = d.start_task(
+        "ok",
+        {"command": "/usr/bin/python3", "no_chroot": True,
+         "args": ["-c", "x = bytearray(8 * 1024 * 1024); "
+                        "import time; time.sleep(2)"]},
+        {"PATH": "/usr/bin:/bin"},
+        ctx={"alloc_id": "oktest01", "task_dir": str(tmp_path),
+             "resources": {"cpu": 500, "memory_mb": 256}})
+    time.sleep(1.0)
+    stats = d.stats(h)
+    assert stats.get("memory_bytes", 0) > 1024 * 1024, stats
+    _wait(h)
+    assert h.exit_code == 0
+
+
+@isolation
+def test_cgroup_cleaned_up_after_exit(tmp_path):
+    d = ExecDriver()
+    h = d.start_task(
+        "gone",
+        {"command": "/bin/true", "no_chroot": True},
+        {},
+        ctx={"alloc_id": "cleanup1", "task_dir": str(tmp_path),
+             "resources": {"cpu": 100, "memory_mb": 64}})
+    _wait(h)
+    time.sleep(0.3)
+    be = CgroupBackend()
+    for base in be.paths_for("cleanup1-gone"):
+        assert not os.path.exists(base), f"cgroup leaked: {base}"
+
+
+@isolation
+def test_chroot_containment(tmp_path):
+    """The task sees the task dir as its root: host paths outside the
+    bind allowlist are invisible."""
+    task_dir = tmp_path / "task"
+    task_dir.mkdir()
+    marker = tmp_path / "host-secret.txt"
+    marker.write_text("host data")
+    d = ExecDriver()
+    h = d.start_task(
+        "jailed",
+        {"command": "/bin/sh",
+         "args": ["-c",
+                  f"test -e /{marker.name} && exit 3; "
+                  "test -d /bin || exit 4; "
+                  "echo jailed > /inside.txt; exit 0"]},
+        {"PATH": "/usr/bin:/bin"},
+        ctx={"alloc_id": "jail0001", "task_dir": str(task_dir),
+             "resources": {"cpu": 100, "memory_mb": 64}})
+    _wait(h)
+    assert h.exit_code == 0, f"exit={h.exit_code} err={h.error}"
+    # the file the task wrote at its / landed in the task dir
+    assert (task_dir / "inside.txt").read_text().strip() == "jailed"
+    # and the bind mounts did not leak into the host namespace
+    assert not os.path.ismount(str(task_dir / "bin"))
+
+
+@isolation
+def test_stop_task_tears_down_cgroup(tmp_path):
+    d = ExecDriver()
+    h = d.start_task(
+        "stopme",
+        {"command": "/bin/sleep", "args": ["30"], "no_chroot": True},
+        {},
+        ctx={"alloc_id": "stopit01", "task_dir": str(tmp_path),
+             "resources": {"cpu": 100, "memory_mb": 64}})
+    time.sleep(0.3)
+    d.stop_task(h, timeout_s=3.0)
+    _wait(h, 5.0)
+    be = CgroupBackend()
+    for base in be.paths_for("stopit01-stopme"):
+        assert not os.path.exists(base), f"cgroup leaked: {base}"
+
+
+@isolation
+def test_recover_task_reclaims_cgroup(tmp_path):
+    """After a client restart, RecoverTask rebuilds the cgroup owner
+    from persisted state so the dir is reaped instead of leaking."""
+    d = ExecDriver()
+    h = d.start_task(
+        "recov",
+        {"command": "/bin/sleep", "args": ["20"], "no_chroot": True},
+        {},
+        ctx={"alloc_id": "recov001", "task_dir": str(tmp_path),
+             "resources": {"cpu": 100, "memory_mb": 64}})
+    time.sleep(0.3)
+    state = h.recoverable_state()
+    assert state.get("cgroup") == "recov001-recov"
+    # simulate a restarted client: a fresh driver re-attaches by state
+    d2 = ExecDriver()
+    h2 = d2.recover_task(state)
+    assert h2 is not None
+    d2.stop_task(h2, timeout_s=3.0)
+    h2.wait(5.0)
+    time.sleep(0.5)
+    be = CgroupBackend()
+    for base in be.paths_for("recov001-recov"):
+        assert not os.path.exists(base), f"cgroup leaked: {base}"
+    # the original handle's waiter also cleans up; no crash on double
+    d.stop_task(h, timeout_s=1.0)
+
+
+def test_fingerprint_reports_isolation_mode():
+    d = ExecDriver()
+    fp = d.fingerprint()
+    assert fp["driver.exec"] == "1"
+    assert fp["driver.exec.isolation"] in ("cgroups", "none")
+
+
+def test_no_isolation_falls_back(tmp_path):
+    """Explicit opt-out (and non-root hosts) run the plain path."""
+    d = ExecDriver()
+    h = d.start_task(
+        "plain",
+        {"command": "/bin/true", "no_isolation": True},
+        {}, ctx={"task_dir": str(tmp_path)})
+    _wait(h)
+    assert h.exit_code == 0
+    assert getattr(h, "executor", None) is None
