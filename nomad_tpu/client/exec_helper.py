@@ -23,6 +23,28 @@ import json
 import os
 import sys
 
+MS_NOSUID = 0x2
+MS_NODEV = 0x4
+MS_NOEXEC = 0x8
+
+
+def _statvfs_ms_flags(path: str) -> int:
+    """Current nosuid/nodev/noexec flags of the mount at `path`, as
+    MS_* bits (a remount must carry locked flags forward or the kernel
+    refuses it with EPERM)."""
+    try:
+        st = os.statvfs(path)
+    except OSError:
+        return 0
+    out = 0
+    if st.f_flag & os.ST_NOSUID:
+        out |= MS_NOSUID
+    if st.f_flag & os.ST_NODEV:
+        out |= MS_NODEV
+    if st.f_flag & os.ST_NOEXEC:
+        out |= MS_NOEXEC
+    return out
+
 
 def contain(spec: dict) -> None:
     os.setsid()
@@ -49,8 +71,15 @@ def contain(spec: dict) -> None:
             if libc.mount(src.encode(), dst.encode(), None,
                           MS_BIND | MS_REC, None) != 0:
                 raise OSError(ctypes.get_errno(), f"bind {src}")
-            libc.mount(src.encode(), dst.encode(), None,
-                       MS_BIND | MS_REMOUNT | MS_RDONLY, None)
+            # the RO downgrade must not fail silently: a writable /etc
+            # or /usr inside the chroot defeats the allowlist's point.
+            # The kernel rejects a bind-remount that would CLEAR locked
+            # flags (user namespaces, locked nosuid/nodev/noexec), so
+            # re-assert the source mount's current flags alongside RO
+            flags = MS_BIND | MS_REMOUNT | MS_RDONLY | _statvfs_ms_flags(dst)
+            if libc.mount(src.encode(), dst.encode(), None,
+                          flags, None) != 0:
+                raise OSError(ctypes.get_errno(), f"remount-ro {src}")
         os.makedirs(chroot_dir + "/tmp", exist_ok=True)
         os.makedirs(chroot_dir + "/dev", exist_ok=True)
         for dev in ("null", "zero", "urandom"):
@@ -65,10 +94,18 @@ def contain(spec: dict) -> None:
         os.chdir(spec["cwd"])
 
 
+DEFAULT_PATH = "/usr/local/bin:/usr/bin:/bin:/usr/sbin:/sbin"
+
+
 def main() -> None:
     spec = json.loads(sys.stdin.read())
     contain(spec)
-    env = spec.get("env") or {}
+    env = dict(spec.get("env") or {})
+    # execvpe resolves the command via the TASK env's PATH; a jobspec
+    # that omits PATH would fail to launch here while the raw_exec
+    # fallback (which inherits the client env) would succeed — resolve
+    # against a sane default instead
+    env.setdefault("PATH", DEFAULT_PATH)
     cmd = spec["command"]
     os.execvpe(cmd, [cmd] + list(spec.get("args", [])), env)
 

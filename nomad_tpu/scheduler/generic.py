@@ -339,6 +339,7 @@ class GenericScheduler:
 
         now = time.time()
 
+        empty_options = SelectOptions()
         for results in (destructive, place):
             # group placements by (tg, penalty/preferred signature)
             groups: Dict[Tuple, List] = {}
@@ -348,9 +349,16 @@ class GenericScheduler:
                     else missing.place_task_group
                 if tg is None:
                     continue
-                options = self._get_select_options(missing)
-                sig = (tg.name, options.penalty_node_ids,
-                       tuple(nd.id for nd in options.preferred_nodes))
+                if missing.previous_alloc is None:
+                    # fresh placement: no penalty/preferred signature —
+                    # skip per-instance option construction (a 10k-count
+                    # job walks this loop 10k times)
+                    options = empty_options
+                    sig = (tg.name, None, None)
+                else:
+                    options = self._get_select_options(missing)
+                    sig = (tg.name, options.penalty_node_ids,
+                           tuple(nd.id for nd in options.preferred_nodes))
                 if sig not in groups:
                     groups[sig] = []
                     order.append(sig)
@@ -405,7 +413,10 @@ class GenericScheduler:
                         # coalesce later failures of the same group
                         self.failed_tg_allocs[tg.name].coalesced_failures += 1
                     else:
-                        self.failed_tg_allocs[tg.name] = metrics
+                        # private copy: `metrics` may be the batch's
+                        # shared flyweight, and coalesced_failures
+                        # mutates on later failures
+                        self.failed_tg_allocs[tg.name] = metrics.copy()
                     # back out the staged stop: a failed placement must not
                     # leave its previous alloc stopping with no replacement
                     stop_prev, _ = missing.stop_previous()
@@ -498,10 +509,22 @@ class GenericScheduler:
 
     def _append_placement(self, missing, tg, option, deployment_id: str,
                           now: float) -> None:
-        resources = AllocatedResources(
-            tasks=option.task_resources,
-            shared=option.alloc_resources or AllocatedSharedResources(
-                disk_mb=tg.ephemeral_disk.size_mb if tg.ephemeral_disk else 0))
+        # flyweight-aware: winners of one batch share task_resources
+        # when no ports/devices are at stake (stack.py select_batch), so
+        # the wrapping AllocatedResources can be shared too — these are
+        # read-only downstream (in-place updates build fresh objects)
+        cached = getattr(self, "_res_fly", None)
+        if cached is not None and cached[0] is option.task_resources \
+                and cached[1] is option.alloc_resources:
+            resources = cached[2]
+        else:
+            resources = AllocatedResources(
+                tasks=option.task_resources,
+                shared=option.alloc_resources or AllocatedSharedResources(
+                    disk_mb=tg.ephemeral_disk.size_mb
+                    if tg.ephemeral_disk else 0))
+            self._res_fly = (option.task_resources,
+                             option.alloc_resources, resources)
         alloc = Allocation(
             id=generate_uuid(),
             namespace=self.job.namespace,

@@ -69,7 +69,6 @@ class PlacementEngine:
         self._dev_cache: Dict[str, object] = {}
         self._shared_by_dc: Dict[str, int] = {}
         self._shared_filtered: Dict[str, int] = {}
-        self._prev_meta: Tuple = (None, None)
 
     # -- setup ---------------------------------------------------------
     def set_job(self, job: Job) -> None:
@@ -443,12 +442,59 @@ class PlacementEngine:
         out: List[Tuple[Optional[RankedNode], AllocMetric]] = []
         self._shared_by_dc = dict(self.by_dc)
         self._shared_filtered = dict(filtered_counts)
-        self._prev_meta = (None, None)
         staged_victims = set()
+        # winner materialization is the per-placement host loop — a
+        # 10k-instance batch walks it 10k times, so everything step-
+        # invariant is hoisted: numpy rows become Python lists once,
+        # metric top-k change points are detected in one vectorized
+        # pass, and steps with identical metric content share ONE
+        # AllocMetric flyweight (nothing mutates a success metric after
+        # placement; failure paths always copy first)
+        node_idx_l = np.asarray(res.node_idx[:count]).tolist()
+        score_l = np.asarray(res.final_score[:count]).tolist()
+        ti_arr = np.asarray(res.top_idx[:count])
+        ts_arr = np.asarray(res.top_scores[:count])
+        ex_arr = np.asarray(res.exhausted_dim[:count])
+        ex_any = ex_arr.any(axis=1) if count else ex_arr
+        if count > 1:
+            same_prev = np.concatenate((
+                np.zeros(1, bool),
+                np.all(ti_arr[1:] == ti_arr[:-1], axis=1)
+                & np.all(ts_arr[1:] == ts_arr[:-1], axis=1)
+                & (ex_any[1:] == ex_any[:-1])
+                & np.all(ex_arr[1:] == ex_arr[:-1], axis=1))).tolist()
+        else:
+            same_prev = [False] * count
+        per_step_ns = int(elapsed // max(count, 1))
+        shared_metric: Optional[AllocMetric] = None
+        # flyweight resources: with no ports and no devices every
+        # winner of this batch gets identical AllocatedTaskResources —
+        # build them once (the reference builds per RankedNode, but
+        # those objects are read-only downstream; in-place updates
+        # always construct fresh ones)
+        simple_resources = (not tg.networks and not dev_asks
+                            and not any(task.resources.networks
+                                        for task in tg.tasks))
+        fly_tr = fly_shared = None
+        if simple_resources:
+            fly_tr = {
+                task.name: AllocatedTaskResources(
+                    cpu=AllocatedCpuResources(task.resources.cpu),
+                    memory=AllocatedMemoryResources(
+                        task.resources.memory_mb))
+                for task in tg.tasks}
+            fly_shared = AllocatedSharedResources(
+                disk_mb=tg.ephemeral_disk.size_mb
+                if tg.ephemeral_disk else 0)
         for step in range(count):
-            idx = int(res.node_idx[step])
-            metrics = self._metrics_for_step(res, step, filtered_counts,
-                                             elapsed // max(count, 1))
+            idx = node_idx_l[step]
+            if same_prev[step] and shared_metric is not None:
+                metrics = shared_metric
+            else:
+                metrics = self._metrics_for_row(
+                    res, ti_arr[step], ts_arr[step],
+                    ex_arr[step] if ex_any[step] else None, per_step_ns)
+                shared_metric = metrics
             if idx < 0:
                 out.append((None, metrics))
                 continue
@@ -466,8 +512,11 @@ class PlacementEngine:
                         proposed.plan.append_preempted_alloc(v, "")
                     saved_net = self._net_cache.pop(node.id, None)
                     saved_dev = self._dev_cache.pop(node.id, None)
-            task_resources, shared, ok = self._assign_resources(
-                node, tg, proposed.plan)
+            if simple_resources:
+                task_resources, shared, ok = fly_tr, fly_shared, True
+            else:
+                task_resources, shared, ok = self._assign_resources(
+                    node, tg, proposed.plan)
             if not ok:
                 # roll the staged victims back: an eviction without a
                 # replacement placement must not reach the plan
@@ -491,12 +540,15 @@ class PlacementEngine:
                         self._net_cache[node.id] = saved_net
                     if saved_dev is not None:
                         self._dev_cache[node.id] = saved_dev
+                # never mutate the shared flyweight: failing steps get
+                # their own metric copy
+                metrics = metrics.copy()
                 metrics.exhausted_node(node, "network: port assignment failed")
                 out.append((None, metrics))
                 continue
             out.append((RankedNode(
                 node=node,
-                final_score=float(res.final_score[step]),
+                final_score=score_l[step],
                 task_resources=task_resources,
                 alloc_resources=shared,
                 metrics=metrics,
@@ -513,9 +565,12 @@ class PlacementEngine:
             out.append((None, m))
         return out
 
-    def _metrics_for_step(self, res, step: int,
-                          filtered_counts: Dict[str, int],
-                          elapsed_ns: int) -> AllocMetric:
+    def _metrics_for_row(self, res, top_idx_row, top_scores_row,
+                         ex_row, elapsed_ns: int) -> AllocMetric:
+        """AllocMetric for one placement step from precomputed numpy
+        rows (select_batch hoists the per-step slicing; identical
+        consecutive steps share the returned instance as a read-only
+        flyweight)."""
         m = AllocMetric()
         m.nodes_evaluated = res.nodes_evaluated
         m.nodes_filtered = res.nodes_filtered
@@ -523,29 +578,18 @@ class PlacementEngine:
         # copy these per instance
         m.nodes_available = self._shared_by_dc
         m.constraint_filtered = self._shared_filtered
-        ex = res.exhausted_dim[step]
-        m.nodes_exhausted = int(ex.sum())
-        for d, name in enumerate(DIM_NAMES):
-            if int(ex[d]):
-                m.dimension_exhausted[name] = int(ex[d])
-        m.allocation_time_ns = int(elapsed_ns)
-        # chunked placements repeat identical top-k rows; reuse the
-        # previous step's NodeScoreMeta list when unchanged
-        prev_step, prev_list = self._prev_meta
-        if prev_step is not None and \
-                np.array_equal(res.top_idx[step], res.top_idx[prev_step]) and \
-                np.array_equal(res.top_scores[step], res.top_scores[prev_step]):
-            m.score_meta_data = prev_list
-            return m
-        for k in range(TOP_K):
-            ni = int(res.top_idx[step][k])
-            sc = float(res.top_scores[step][k])
+        if ex_row is not None:
+            m.nodes_exhausted = int(ex_row.sum())
+            for d, name in enumerate(DIM_NAMES):
+                if int(ex_row[d]):
+                    m.dimension_exhausted[name] = int(ex_row[d])
+        m.allocation_time_ns = elapsed_ns
+        ids = self.table.ids
+        for ni, sc in zip(top_idx_row.tolist(), top_scores_row.tolist()):
             if ni < 0 or sc < -1e29:
                 continue
             m.score_meta_data.append(NodeScoreMeta(
-                node_id=self.table.ids[ni],
-                scores={"final": sc}, norm_score=sc))
-        self._prev_meta = (step, m.score_meta_data)
+                node_id=ids[ni], scores={"final": sc}, norm_score=sc))
         return m
 
     def _proposed_allocs_on(self, node_id: str, plan) -> list:

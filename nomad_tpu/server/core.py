@@ -94,8 +94,12 @@ class Server:
         self._leader = False
         self._acl_cache: Dict = {}      # (policies, index) -> compiled ACL
         self.raft = None                # multi-server consensus (raft.py)
-        self._in_replicated_apply = False
-        self._apply_tl = threading.local()   # nested-apply depth/max idx
+        # thread-local: set on the FSM applier thread while an applier
+        # runs, so nested raft_apply side effects are detected per
+        # thread — an instance-wide flag would make a concurrent client
+        # write on another thread look nested and silently drop it
+        # (r3 advisor, medium)
+        self._apply_tl = threading.local()
 
         # restore persisted state AFTER all subsystems exist: WAL replay
         # drives the same FSM appliers (broker/blocked are disabled until
@@ -207,14 +211,21 @@ class Server:
 
     def apply_replicated(self, index: int, msg_type: str,
                          enc_payload: dict) -> None:
-        """Apply a replicated log entry on a follower. Nested
-        raft_apply calls from FSM side effects are suppressed — the
-        leader ran the same appliers and its nested writes arrive as
-        their own log entries."""
+        """Apply a COMMITTED log entry — leaders and followers share
+        this path (raft.py _fsm_loop calls it in log order once the
+        commit index covers the entry). Nested raft_apply calls from
+        FSM side effects append their own log entries on the leader and
+        are suppressed on followers — either way the effect arrives as
+        its own committed entry, so replicas converge. Change events
+        publish here, i.e. only for committed writes (the r3 advisor's
+        follower-dirty-read finding)."""
         from .persistence import decode_payload
         payload = decode_payload(msg_type, enc_payload)
+        tl = self._apply_tl
         with self._raft_l:
-            self._in_replicated_apply = True
+            if index <= self._raft_index:
+                return              # duplicate delivery (batch overlap)
+            tl.in_fsm_apply = True
             try:
                 self._raft_index = index
                 if self.persistence is not None:
@@ -225,19 +236,27 @@ class Server:
                 if self.persistence is not None:
                     self.persistence.maybe_snapshot(self.store)
             finally:
-                self._in_replicated_apply = False
+                tl.in_fsm_apply = False
             try:
                 self.events.publish(events_from_apply(msg_type, payload,
                                                       index))
             except Exception:
                 LOG.exception("event publish for %s", msg_type)
 
-    def install_snapshot(self, data: dict) -> None:
-        """Full-state reseed from the leader (fsm.go Restore:1374)."""
+    def install_snapshot(self, data: dict,
+                         base_index: Optional[int] = None) -> None:
+        """Full-state reseed from the leader (fsm.go Restore:1374). The
+        snapshot's raft base index is authoritative for the applied
+        index: store.latest_index() undercounts whenever the tail holds
+        entries that touch no table (election no-ops), and an applied
+        index below the log base would let this node reissue
+        already-used log indexes after winning an election (r3 advisor,
+        high)."""
         with self._raft_l:
             self.store.restore(data)
-            self._raft_index = max(self._raft_index,
-                                   self.store.latest_index())
+            floor = self.store.latest_index() if base_index is None \
+                else base_index
+            self._raft_index = max(floor, self.store.latest_index())
             if self.persistence is not None:
                 self.persistence.snapshot(self.store)
 
@@ -338,76 +357,70 @@ class Server:
 
     # -- raft apply ----------------------------------------------------
     def raft_apply(self, msg_type: str, payload: dict) -> int:
-        """Serialized FSM apply (fsm.go Apply:210-300). Returns the index.
-        The whole record+apply+snapshot sequence runs under the raft lock
-        so WAL order == apply order and a snapshot can never truncate an
-        entry whose effects it doesn't contain. In a multi-server
-        cluster, non-leaders forward the write to the leader (rpc.go
-        forward()); the leader appends the entry to the replication log
-        and — once the outermost apply of the call chain finishes —
-        blocks until a majority holds it before acking (quorum commit;
-        nested FSM side-effect applies produce higher indexes, so the
-        outermost waits for the chain's max index)."""
+        """Serialized FSM apply (fsm.go Apply:210-300). Returns the
+        index. Dev mode (no raft): record+apply+snapshot run inline
+        under the raft lock so WAL order == apply order. Clustered: the
+        leader appends the entry to the replication log and blocks
+        until a majority holds it AND the local FSM has applied it
+        (apply-at-commit — hashicorp/raft runs the FSM only up to the
+        commit index, nomad/server.go:1214); non-leaders forward the
+        write to the leader (rpc.go forward())."""
         index, waiter = self.raft_apply_async(msg_type, payload)
         if waiter is not None:
             waiter()
         return index
 
     def raft_apply_async(self, msg_type: str, payload: dict):
-        """The non-blocking half of raft_apply: local apply + log append
-        now, quorum ack deferred. Returns (index, waiter) where waiter
-        is None (nested/forwarded/no-raft: nothing to wait for at this
-        frame) or a callable that blocks until the call chain's highest
-        index is majority-replicated in the term it was stamped with,
-        raising otherwise. The plan applier uses this to overlap plan
-        N's replication with plan N+1's verification (plan_apply.go:44-70
-        pipelining). The log append runs FIRST and refuses on a deposed
-        leader, so losing leadership mid-flight aborts before any WAL
-        write or local state mutation."""
-        if self.raft is not None and not self.raft.is_leader():
-            if self._in_replicated_apply:
-                # FSM side effect during a replicated apply: the
-                # leader's equivalent entry arrives via the log
+        """The non-blocking half of raft_apply: log append now, commit
+        + FSM apply deferred. Returns (index, waiter) where waiter is
+        None (nested/forwarded/no-raft: nothing to wait for at this
+        frame) or a callable that blocks until the entry is
+        majority-replicated in the term it was stamped with and applied
+        locally, raising otherwise. The plan applier uses this to
+        overlap plan N's replication with plan N+1's verification
+        (plan_apply.go:44-70 pipelining). On a clustered leader NOTHING
+        is applied at this point — a caller that needs to read its own
+        write must invoke the waiter (raft_apply does); this is what
+        closes the uncommitted-read window on a partitioned leader."""
+        if self.raft is not None:
+            if getattr(self._apply_tl, "in_fsm_apply", False):
+                # nested FSM side effect during a committed apply: on
+                # the leader it becomes its own log entry (applied when
+                # it commits); on a follower the leader's equivalent
+                # entry arrives via the log — suppress
+                if self.raft.is_leader():
+                    try:
+                        idx, _term = self.raft.append_entry(
+                            msg_type, payload)
+                        return idx, None
+                    except RuntimeError:
+                        return self._raft_index, None
                 return self._raft_index, None
-            return self.raft.forward_apply(msg_type, payload), None
-        tl = self._apply_tl
-        tl.depth = getattr(tl, "depth", 0) + 1
-        try:
-            with self._raft_l:
-                index = self._raft_index + 1
-                if self.raft is not None:
-                    # raises "not the leader" on a deposed leader —
-                    # nothing recorded, nothing applied
-                    tl.apply_term = self.raft.record_entry(
-                        index, msg_type, payload)
-                self._raft_index = index
-                if self.persistence is not None:
-                    self.persistence.record(index, msg_type, payload)
-                fn = getattr(self, f"_apply_{msg_type}")
-                fn(index, payload)
-                self.time_table.witness(index)
-                if self.persistence is not None:
-                    self.persistence.maybe_snapshot(self.store)
-                # change events fan out after the LOCAL apply (followers:
-                # after the replicated apply). On a quorum-commit leader
-                # this precedes the durable ack — in-proc subscribers can
-                # observe a write whose ack later fails; external readers
-                # see it only once /v1/event/stream serves applied state.
-                # WAL replay bypasses raft_apply so restores don't replay
-                # the event history.
-                try:
-                    self.events.publish(events_from_apply(
-                        msg_type, payload, index))
-                except Exception:
-                    LOG.exception("event publish for %s", msg_type)
-            tl.max_index = max(getattr(tl, "max_index", 0), index)
-        finally:
-            tl.depth -= 1
-        if self.raft is not None and tl.depth == 0:
-            wait_idx, tl.max_index = tl.max_index, 0
-            wait_term = getattr(tl, "apply_term", None)
+            if not self.raft.is_leader():
+                return self.raft.forward_apply(msg_type, payload), None
+            # raises "not the leader" on a deposed leader — nothing
+            # recorded, nothing applied
+            index, term = self.raft.append_entry(msg_type, payload)
             raft = self.raft
-            return index, lambda: raft.wait_for_commit(wait_idx, wait_term)
+            return index, lambda: raft.wait_for_applied(index, term)
+        # dev / single-node: inline serialized apply. Change events fan
+        # out inside the lock; WAL replay bypasses raft_apply so
+        # restores don't replay the event history.
+        with self._raft_l:
+            index = self._raft_index + 1
+            self._raft_index = index
+            if self.persistence is not None:
+                self.persistence.record(index, msg_type, payload)
+            fn = getattr(self, f"_apply_{msg_type}")
+            fn(index, payload)
+            self.time_table.witness(index)
+            if self.persistence is not None:
+                self.persistence.maybe_snapshot(self.store)
+            try:
+                self.events.publish(events_from_apply(
+                    msg_type, payload, index))
+            except Exception:
+                LOG.exception("event publish for %s", msg_type)
         return index, None
 
     def _apply_noop(self, index: int, p: dict) -> None:

@@ -5,11 +5,14 @@ evaluatePlan:400 (per-node feasibility against the freshest snapshot),
 partial commits set RefreshIndex to force worker state refresh,
 preemption follow-up evals:287-310. Like the reference (optimistic
 pipelining, big comment plan_apply.go:44-70), plan N's quorum
-replication overlaps plan N+1's verification: the local FSM apply is
-synchronous (so N+1 verifies against state that already includes N),
-but the majority-ack wait is handed to a committer thread that resolves
-plan futures in commit order. Verification batches all touched nodes at
-once (the EvaluatePool:NumCPU/2 goroutines become one vectorized pass).
+replication overlaps plan N+1's verification: the majority-ack wait is
+handed to a committer thread that resolves plan futures in commit
+order, and — because the FSM applies only at commit on a clustered
+leader — plan N's results are overlaid onto the snapshot when
+verifying N+1 (the reference applies the result to its private
+snapshot for exactly this reason). Verification batches all touched
+nodes at once (the EvaluatePool:NumCPU/2 goroutines become one
+vectorized pass).
 """
 
 from __future__ import annotations
@@ -39,6 +42,16 @@ class PlanApplier:
         # without the bound a partitioned leader would stack local-only
         # applies and serve each submitter its 10s failure in series
         self._commit_q = None
+        # submitted-but-not-yet-applied plan results (applier thread
+        # only): with apply-at-commit the store lags the log, so N+1's
+        # verification must see N's placements or two optimistic plans
+        # could double-book one node's capacity
+        self._pending: List = []        # [(raft index, PlanResult)]
+        # indexes of submitted plans whose commit FAILED — only those
+        # leave the overlay early; sibling in-flight plans may still
+        # commit and must keep occupying capacity until applied
+        self._failed_pending: set = set()
+        self._failed_l = threading.Lock()
 
     def start(self) -> None:
         import queue as queue_mod
@@ -123,7 +136,11 @@ class PlanApplier:
                 future.set_result(result)
             except Exception as e:
                 # quorum unreachable / leadership lost: the submitting
-                # worker sees the failure and nacks its eval
+                # worker sees the failure and nacks its eval; THIS
+                # plan's overlay must not keep rejecting capacity
+                # forever (siblings may still commit — they stay)
+                with self._failed_l:
+                    self._failed_pending.add(result.alloc_index)
                 future.set_exception(e)
 
     # -- the core ------------------------------------------------------
@@ -149,6 +166,13 @@ class PlanApplier:
     def _apply(self, plan: Plan):
         store = self.server.store
         snapshot = store.snapshot()
+        # retire overlay entries the FSM has applied (visible in the
+        # snapshot now) or whose commit failed
+        with self._failed_l:
+            failed, self._failed_pending = self._failed_pending, set()
+        latest = snapshot.latest_index()
+        self._pending = [(i, r) for (i, r) in self._pending
+                         if i > latest and i not in failed]
 
         result = PlanResult()
         rejected = False
@@ -219,6 +243,11 @@ class PlanApplier:
                  allocs_preempted=preempted, deployment=result.deployment,
                  deployment_updates=result.deployment_updates, evals=evals))
         result.alloc_index = index
+        if waiter is not None:
+            # apply-at-commit: the store won't show this plan until the
+            # committer's waiter resolves — overlay it for the next
+            # verification round
+            self._pending.append((index, result))
         for ev in evals:
             self.server.enqueue_eval(ev)
         return result, waiter
@@ -232,6 +261,29 @@ class PlanApplier:
         from ..models.csi import (ACCESS_MULTI_NODE_SINGLE_WRITER,
                                   ACCESS_SINGLE_NODE_WRITER)
         budgets: Dict = {}          # (ns, vol_id) -> free write slots
+        # submitted-but-unapplied plans already hold their write slots
+        for _idx, pres in self._pending:
+            for allocs in pres.node_allocation.values():
+                for pa in allocs:
+                    pjob = pa.job or snapshot.job_by_id(pa.namespace,
+                                                        pa.job_id)
+                    ptg = pjob.lookup_task_group(pa.task_group) \
+                        if pjob else None
+                    for r in (ptg.volumes or {}).values() if ptg else []:
+                        if getattr(r, "type", "host") != "csi" or \
+                                getattr(r, "read_only", False):
+                            continue
+                        vol = snapshot.csi_volume(pa.namespace, r.source)
+                        if vol is None or vol.access_mode not in (
+                                ACCESS_SINGLE_NODE_WRITER,
+                                ACCESS_MULTI_NODE_SINGLE_WRITER):
+                            continue
+                        if pa.id in vol.write_allocs:
+                            continue
+                        key = (pa.namespace, r.source)
+                        if key not in budgets:
+                            budgets[key] = 0 if vol.write_allocs else 1
+                        budgets[key] -= 1
         dropped = False
         for node_id in list(node_allocation):
             kept = []
@@ -291,8 +343,20 @@ class PlanApplier:
         # node double-counts its resources (plan_apply.go:674-678).
         placements = plan.node_allocation.get(node_id, [])
         remove_ids |= {a.id for a in placements}
+        # overlay submitted-but-unapplied plans (pipelined commit):
+        # their placements occupy capacity, their stops/preemptions
+        # free it
+        overlay_add = []
+        for _idx, pres in self._pending:
+            remove_ids |= {a.id for a in pres.node_update.get(node_id, [])}
+            remove_ids |= {a.id
+                           for a in pres.node_preemptions.get(node_id, [])}
+            overlay_add.extend(pres.node_allocation.get(node_id, []))
+        placed_ids = {p.id for p in placements}
         proposed = [a for a in snapshot.allocs_by_node(node_id)
                     if not a.terminal_status() and a.id not in remove_ids]
+        proposed.extend(a for a in overlay_add
+                        if a.id not in placed_ids)
         proposed.extend(placements)
         fit, _dim, _used = AllocsFit(
             node, proposed,

@@ -7,27 +7,29 @@ nomad/fsm.go Snapshot/Restore:1360-1374, rpc.go forward() (writes go to
 the leader). SURVEY §7.2 step 7 blesses a "single-leader Raft-lite":
 
   - terms + randomized election timeouts + majority votes with the
-    log-up-to-date check (Raft §5.2/§5.4.1)
-  - the leader assigns log indexes and applies entries to its FSM
-    immediately (the pre-existing single-node raft_apply semantics are
-    preserved bit-for-bit, including nested applies); followers receive
-    entries in order over AppendEntries and apply them with nested
-    side-effect applies suppressed (the leader's equivalents arrive as
-    their own entries)
+    log-up-to-date check (Raft §5.2/§5.4.1); vote RPCs are issued in
+    parallel (hashicorp/raft electSelf) so unreachable peers cannot
+    stretch one election round past the election timeout
+  - **apply-at-commit**: the leader appends entries to its log but the
+    FSM applies them only once the commit index covers them — leader
+    and follower share one applier loop (_fsm_loop), so neither role
+    can ever serve reads or publish change events for a write that a
+    majority does not hold (hashicorp/raft processLogs runs the FSM
+    only up to commitIndex)
   - **commit means commit**: the leader acks a write only once a
     majority of the cluster holds the entry (match-index quorum over
-    per-peer replication threads, Raft §5.3/§5.4), with the
-    current-term commit rule (§5.4.2, figure 8) enforced via a no-op
-    entry appended on election (the hashicorp/raft noop). A leader that
-    cannot reach a majority times out the ack instead of claiming
-    durability
+    per-peer replication threads, Raft §5.3/§5.4) AND the local FSM has
+    applied it, with the current-term commit rule (§5.4.2, figure 8)
+    enforced via a no-op entry appended on election (the hashicorp/raft
+    noop). A leader that cannot reach a majority times out the ack
+    instead of claiming durability
   - replication runs in one dedicated thread per peer (hashicorp/raft
     replication.go shape) so a dead peer or an in-flight snapshot
     install can never starve heartbeats to healthy followers
-  - a follower whose applied state diverges from the new leader's log
-    (e.g. a deposed leader with an unreplicated applied tail) cannot
-    truncate applied state; it is reseeded with a full snapshot install
-    (store.dump()/restore()), the FSM-snapshot analog
+  - because only committed entries reach the FSM, a follower's
+    conflicting uncommitted suffix truncates freely (Raft §5.3); a
+    full snapshot reseed (store.dump()/restore()) is needed only when
+    the leader's log has been compacted past what the follower needs
   - membership is static configuration (no serf/autopilot)
 
 Write forwarding: a non-leader server forwards (msg_type, payload)
@@ -93,6 +95,7 @@ class RaftNode:
         self._commit_cv = threading.Condition(self._lock)
         self._repl_gen = 0            # invalidates stale repl threads
         self._repl_events: Dict[str, threading.Event] = {}
+        self._snap_gen = 0            # invalidates an in-flight FSM batch
         self._load_vote_state()
 
     # -- persistence of (term, votedFor) — Raft §5.1 -------------------
@@ -125,6 +128,10 @@ class RaftNode:
     def start(self) -> None:
         t = threading.Thread(target=self._ticker, daemon=True,
                              name="raft-ticker")
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._fsm_loop, daemon=True,
+                             name="raft-fsm")
         t.start()
         self._threads.append(t)
 
@@ -165,16 +172,20 @@ class RaftNode:
         return c
 
     # -- the leader append hook (called from Server.raft_apply) --------
-    def record_entry(self, index: int, msg_type: str,
-                     payload: dict) -> int:
-        """Append a leader log entry; returns the term it was stamped
-        with. Raises if this node is no longer the leader — a deposed
-        leader must NOT append (the entry would carry the new term, so a
-        follower would treat the real leader's entry at that index as
-        already present and silently diverge)."""
+    def append_entry(self, msg_type: str, payload: dict) -> Tuple[int, int]:
+        """Append a leader log entry; assigns the next log index and
+        returns (index, term). The FSM does NOT run here — _fsm_loop
+        applies the entry once it commits. Raises if this node is no
+        longer the leader — a deposed leader must NOT append (the entry
+        would carry the new term, so a follower would treat the real
+        leader's entry at that index as already present and silently
+        diverge)."""
         with self._lock:
             if self.role != LEADER:
                 raise RuntimeError("not the leader")
+            last, _ = (self.log[-1][0], self.log[-1][1]) if self.log \
+                else (self.base_index, self.base_term)
+            index = last + 1
             term = self.term
             self.log.append((index, term, msg_type,
                              encode_payload(msg_type, payload)))
@@ -182,7 +193,7 @@ class RaftNode:
                 self._advance_commit()
             for ev in self._repl_events.values():
                 ev.set()
-            return term
+            return index, term
 
     # -- quorum commit -------------------------------------------------
     def _advance_commit(self) -> None:
@@ -207,17 +218,50 @@ class RaftNode:
         self.commit_index = n
         self._commit_cv.notify_all()
 
-    def wait_for_commit(self, index: int, term: Optional[int] = None,
-                        timeout_s: float = 10.0) -> None:
-        """Block until `index` is replicated to a majority. Raises if
-        leadership is lost, the quorum is unreachable, or (when `term`
-        is given) the node's term has moved past the one the entry was
-        stamped with — a stepdown + reseed + re-election in between
-        means the entry may no longer exist even though commit_index
-        eventually passes it. The caller must not treat the write as
-        durable on any raise."""
-        if not self.peers:
-            return
+    # -- committed-entry FSM applier (leader and follower) -------------
+    def _fsm_loop(self) -> None:
+        """Single applier: runs the FSM over entries in log order as the
+        commit index advances — hashicorp/raft runFSM/processLogs. The
+        apply itself runs with the raft lock RELEASED (the FSM has its
+        own serialization and may re-enter append_entry for side-effect
+        writes); a snapshot install invalidates the in-flight batch via
+        _snap_gen."""
+        while not self._stop.is_set():
+            with self._commit_cv:
+                while (not self._stop.is_set()
+                       and self.commit_index <= self.server._raft_index):
+                    self._commit_cv.wait(0.5)
+                if self._stop.is_set():
+                    return
+                gen = self._snap_gen
+                applied = max(self.server._raft_index, self.base_index)
+                start = applied - self.base_index
+                stop = min(self.commit_index - self.base_index,
+                           len(self.log))
+                batch = list(self.log[start:stop])
+            if not batch:
+                # committed entries we don't hold yet (post-reseed gap);
+                # replication refills the log shortly
+                time.sleep(HEARTBEAT_S / 4)
+                continue
+            for idx, _eterm, mtype, enc in batch:
+                with self._lock:
+                    if self._snap_gen != gen:
+                        break
+                self.server.apply_replicated(idx, mtype, enc)
+            with self._commit_cv:
+                self._commit_cv.notify_all()   # wake wait_for_applied
+
+    def wait_for_applied(self, index: int, term: Optional[int] = None,
+                         timeout_s: float = 10.0) -> None:
+        """Block until `index` is replicated to a majority AND applied
+        by the local FSM. Raises if leadership is lost or the term moves
+        before the entry commits (a stepdown + truncation in between
+        means the entry may no longer exist), or on quorum timeout. The
+        caller must not treat the write as durable on any raise. Once
+        the entry is committed in the term it was stamped with, it is
+        durable — the remaining wait is only for the local applier to
+        catch up, and survives role changes."""
         deadline = time.monotonic() + timeout_s
         with self._commit_cv:
             while self.commit_index < index:
@@ -236,10 +280,35 @@ class RaftNode:
                         f"no quorum: commit of {index} timed out "
                         f"after {timeout_s}s")
                 self._commit_cv.wait(remaining)
-            if term is not None and self.term != term:
-                raise RuntimeError(
-                    f"term moved ({term} -> {self.term}); entry {index} "
-                    "may have been superseded")
+            # committed: verify it is still OUR entry (the log cannot
+            # have been truncated below the commit index, but a
+            # stepdown + reseed may have replaced and compacted it —
+            # base_index == index with a different base_term means the
+            # NEW leader's entry took our index)
+            if term is not None:
+                if index <= self.base_index:
+                    if self.role == LEADER and self.term == term:
+                        pass    # a leader never loses its own entry
+                                # while it stays leader in that term
+                    elif index == self.base_index and \
+                            self.base_term == term:
+                        pass
+                    else:
+                        raise RuntimeError(
+                            f"entry {index} compacted/superseded; "
+                            f"cannot verify term {term}")
+                elif self._term_of(index) != term:
+                    raise RuntimeError(
+                        f"entry {index} superseded (term {term} -> "
+                        f"{self._term_of(index)})")
+            while self.server._raft_index < index:
+                if self._stop.is_set():
+                    raise RuntimeError("raft node stopped")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"apply of committed entry {index} timed out")
+                self._commit_cv.wait(remaining)
 
     # -- follower write forwarding ------------------------------------
     def forward_apply(self, msg_type: str, payload: dict,
@@ -321,6 +390,9 @@ class RaftNode:
                 self._run_election()
 
     def _run_election(self) -> None:
+        """One election round with PARALLEL vote RPCs (hashicorp/raft
+        electSelf): unreachable peers cost nothing extra — the round
+        lasts at most one vote-RPC timeout, not one per dead peer."""
         with self._lock:
             self.role = CANDIDATE
             self.term += 1
@@ -329,8 +401,12 @@ class RaftNode:
             term = self.term
             self._election_deadline = self._new_deadline()
         last_index, last_term = self.last_log()
-        votes = 1
-        for peer in self.peers:
+        tally_l = threading.Lock()
+        votes = [1]                       # self-vote
+        higher_term = [0]
+        outcome = threading.Event()       # majority reached or must step down
+
+        def ask(peer: str) -> None:
             try:
                 res = self._client(peer).call(
                     "Raft.RequestVote",
@@ -339,16 +415,29 @@ class RaftNode:
                      "last_log_term": last_term},
                     timeout_s=0.5)
             except Exception:
-                continue
-            with self._lock:
-                if res["term"] > self.term:
-                    self._become_follower(res["term"], None)
+                return
+            with tally_l:
+                if res["term"] > term:
+                    higher_term[0] = max(higher_term[0], res["term"])
+                    outcome.set()
                     return
-            if res.get("granted"):
-                votes += 1
+                if res.get("granted"):
+                    votes[0] += 1
+                    if votes[0] * 2 > self.cluster_size:
+                        outcome.set()
+
+        for peer in self.peers:
+            threading.Thread(target=ask, args=(peer,), daemon=True,
+                             name=f"raft-vote-{peer}").start()
+        outcome.wait(0.6)
         with self._lock:
+            with tally_l:
+                bumped, got = higher_term[0], votes[0]
+            if bumped > self.term:
+                self._become_follower(bumped, None)
+                return
             if self.role == CANDIDATE and self.term == term and \
-                    votes * 2 > self.cluster_size:
+                    got * 2 > self.cluster_size:
                 self._become_leader()
 
     # -- leader replication: one thread per peer ----------------------
@@ -440,11 +529,12 @@ class RaftNode:
         """Full-state reseed of a lagging peer. The serialization + long
         transfer run with the raft lock RELEASED — only this peer's
         replication thread blocks on it. The snapshot's base index is
-        captured atomically with an O(1) MVCC store snapshot under the
-        server's apply lock (no apply in flight => applied state ==
-        raft index == log tail), so the label can never run ahead of
-        the state it describes — a too-high base would make followers
-        skip committed entries forever."""
+        the APPLIED index captured atomically with an O(1) MVCC store
+        snapshot under the server's apply lock, so the label can never
+        run ahead of the state it describes — a too-high base would
+        make followers skip committed entries forever. (With
+        apply-at-commit the applied index never exceeds the commit
+        index, so the label also never covers an uncommitted entry.)"""
         self._lock.release()
         try:
             with self.server._raft_l:
@@ -475,10 +565,19 @@ class RaftNode:
 
     # -- compaction ----------------------------------------------------
     def compact(self, keep: int = 4096) -> None:
+        """Drop applied log prefix. Never compacts past the locally
+        APPLIED index — the _fsm_loop still needs committed-but-
+        unapplied entries, and a reseeded base above the applied state
+        would reissue already-used indexes (the r3 advisor's
+        index-below-base corruption)."""
         with self._lock:
+            limit = min(self.server._raft_index, self.commit_index)
             if len(self.log) <= keep:
                 return
             drop = len(self.log) - keep
+            drop = min(drop, max(0, limit - self.base_index))
+            if drop <= 0:
+                return
             e = self.log[drop - 1]
             self.base_index, self.base_term = e[0], e[1]
             self.log = self.log[drop:]
@@ -499,7 +598,9 @@ class RaftNode:
             return {"role": self.role, "term": self.term,
                     "leader": self.leader_addr,
                     "last_log_index": last_index,
-                    "last_log_term": last_term}
+                    "last_log_term": last_term,
+                    "commit_index": self.commit_index,
+                    "applied_index": self.server._raft_index}
 
     def _handle_request_vote(self, args: dict) -> dict:
         term = int(args["term"])
@@ -535,7 +636,7 @@ class RaftNode:
             prev_index = int(args["prev_index"])
             prev_term = int(args["prev_term"])
             last_index, _ = self.last_log()
-            applied = self.server._raft_index
+            committed = max(self.commit_index, self.server._raft_index)
             # consistency check at prev_index
             if prev_index > last_index:
                 return {"term": self.term, "success": False,
@@ -543,12 +644,15 @@ class RaftNode:
             if prev_index > self.base_index:
                 e = self.log[prev_index - self.base_index - 1]
                 if e[1] != prev_term:
-                    # conflicting suffix: applied state cannot be
-                    # unwound -> full reseed
-                    if prev_index <= applied:
+                    if prev_index <= committed:
+                        # a committed entry can never conflict (leader
+                        # completeness, §5.4.3) — if it appears to, our
+                        # commit accounting is damaged: full reseed
                         self.needs_snapshot = True
                         return {"term": self.term, "success": False,
                                 "needs_snapshot": True}
+                    # uncommitted conflicting suffix truncates freely —
+                    # nothing was applied (§5.3)
                     del self.log[prev_index - self.base_index - 1:]
                     return {"term": self.term, "success": False,
                             "hint": prev_index - 1}
@@ -556,24 +660,28 @@ class RaftNode:
                 return {"term": self.term, "success": False,
                         "needs_snapshot": True}
 
-            to_apply = []
             for idx, eterm, mtype, enc in args.get("entries", []):
                 idx = int(idx)
+                if idx <= self.base_index:
+                    continue        # covered by the installed snapshot
                 pos = idx - self.base_index - 1
                 if pos < len(self.log):
                     if self.log[pos][1] == eterm:
                         continue                  # already have it
-                    if idx <= applied:
+                    if idx <= committed:
                         self.needs_snapshot = True
                         return {"term": self.term, "success": False,
                                 "needs_snapshot": True}
                     del self.log[pos:]
                 self.log.append((idx, int(eterm), mtype, enc))
-                to_apply.append((idx, mtype, enc))
-        # apply outside the raft lock (FSM has its own serialization)
-        for idx, mtype, enc in to_apply:
-            if idx > self.server._raft_index:
-                self.server.apply_replicated(idx, mtype, enc)
+            # follower commit rule (§5.3): commit up to the leader's
+            # commit index, bounded by what we actually hold; _fsm_loop
+            # applies from there — never before
+            last_index, _ = self.last_log()
+            new_commit = min(int(args.get("leader_commit", 0)), last_index)
+            if new_commit > self.commit_index:
+                self.commit_index = new_commit
+                self._commit_cv.notify_all()
         return {"term": self.term, "success": True}
 
     def _handle_install_snapshot(self, args: dict) -> dict:
@@ -583,12 +691,20 @@ class RaftNode:
                 return {"term": self.term}
             self._become_follower(term, args["leader"])
             self._election_deadline = self._new_deadline()
-        self.server.install_snapshot(args["snapshot"])
+        base_index = int(args["base_index"])
+        # restore the store AND pin the applied index to the snapshot's
+        # base — store.latest_index() alone undercounts (no-op entries
+        # touch no table), which would reissue already-used log indexes
+        # if this node later won an election (r3 advisor, high)
+        self.server.install_snapshot(args["snapshot"], base_index)
         with self._lock:
-            self.base_index = int(args["base_index"])
+            self.base_index = base_index
             self.base_term = int(args["base_term"])
             self.log = []
+            self.commit_index = base_index
             self.needs_snapshot = False
+            self._snap_gen += 1       # invalidate in-flight FSM batch
+            self._commit_cv.notify_all()
         LOG.warning("installed snapshot at index %d", self.base_index)
         return {"term": self.term}
 
