@@ -26,7 +26,7 @@ Shapes are padded to buckets to bound recompilation:
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -123,8 +123,7 @@ class SelectResult:
     placed: int
 
 
-@partial(jax.jit, static_argnames=("k_steps", "spread_alg", "s_live", "p_live"))
-def _select_scan(capacity, used0, feasible, ask, k_valid,
+def _select_scan_fn(capacity, used0, feasible, ask, k_valid,
                  tg_coll0, job_count0, distinct_hosts_flag, scan_exclusive,
                  penalty, affinity_norm, desired_count,
                  port_need, free_ports, port_ok,
@@ -321,6 +320,40 @@ def _select_scan(capacity, used0, feasible, ask, k_valid,
     return carry, outs
 
 
+_select_scan = partial(
+    jax.jit, static_argnames=("k_steps", "spread_alg", "s_live",
+                              "p_live"))(_select_scan_fn)
+
+# positional order of _select_scan_fn's array arguments (the batched
+# dispatcher calls it positionally under vmap)
+_SCAN_ARGS = (
+    "capacity", "used0", "feasible", "ask", "k_valid",
+    "tg_coll0", "job_count0", "distinct_hosts_flag", "scan_exclusive",
+    "penalty", "affinity_norm", "desired_count",
+    "port_need", "free_ports", "port_ok",
+    "dev_slots0", "dev_score", "dev_fires", "pre_score",
+    "sp_codes", "sp_counts0", "sp_present0", "sp_desired",
+    "sp_weight", "sp_has_targets", "sp_valid", "sum_spread_w",
+    "dp_codes", "dp_counts0", "dp_limit", "dp_valid")
+
+
+@lru_cache(maxsize=None)
+def _scan_batched_jit(k_steps: int, spread_alg: bool, s_live: int,
+                      p_live: int):
+    """The vmapped scan: B independent lanes over ONE shared capacity
+    table (in_axes=None keeps it unstacked/resident) — the small-count
+    arm of multi-eval batching. Covers the FULL scoring surface
+    (spreads, distinct-property, reserved ports) unlike the K-way arm,
+    because it is literally the scan kernel with a lane axis."""
+    def fn(*args):
+        return _select_scan_fn(*args, k_steps=k_steps,
+                               spread_alg=spread_alg,
+                               s_live=s_live, p_live=p_live)
+    in_axes = tuple(None if name == "capacity" else 0
+                    for name in _SCAN_ARGS)
+    return jax.jit(jax.vmap(fn, in_axes=in_axes))
+
+
 def _local_final_score(after, cap_cpu, cap_mem, coll, penalty, affinity,
                        desired_count, spread_alg: bool,
                        dev_score=0.0, dev_fires=0.0, pre_score=0.0):
@@ -356,8 +389,7 @@ def _local_final_score(after, cap_cpu, cap_mem, coll, penalty, affinity,
     return final, binpack, anti, pen
 
 
-@partial(jax.jit, static_argnames=("max_steps", "spread_alg"))
-def _select_chunked(capacity, used0, feasible, ask, k_valid,
+def _select_chunked_fn(capacity, used0, feasible, ask, k_valid,
                     tg_coll0, penalty, affinity_norm, desired_count,
                     port_need, free_ports, port_ok,
                     dev_slots0, dev_score, dev_fires, pre_score,
@@ -500,6 +532,27 @@ def _select_chunked(capacity, used0, feasible, ask, k_valid,
     return ((used, coll, free_p, dev_slots),
             (out_choice, out_chunk, out_ti, out_ts, out_exh, out_feas,
              remaining, steps))
+
+
+_select_chunked = partial(
+    jax.jit, static_argnames=("max_steps", "spread_alg"))(
+        _select_chunked_fn)
+
+
+@lru_cache(maxsize=None)
+def _chunked_batched_jit(max_steps: int, spread_alg: bool):
+    """The vmapped chunked kernel: B node-local lanes over ONE shared
+    capacity table in a single dispatch. The while_loop batches to
+    max-steps-over-lanes iterations, so a batch of small-count evals
+    costs about as many node passes as its slowest lane — the chunk-ok
+    arm of multi-eval batching (the scan arm covers spread/distinct
+    lanes)."""
+    def fn(*args):
+        return _select_chunked_fn(*args, max_steps=max_steps,
+                                  spread_alg=spread_alg)
+    in_axes = tuple(None if name == "capacity" else 0
+                    for name in _CHUNKED_ARGS)
+    return jax.jit(jax.vmap(fn, in_axes=in_axes))
 
 
 def _kway_core(capacity, used0, feasible, ask, k_valid,
@@ -707,6 +760,8 @@ PACK_SHARD_KINDS = {
 }
 
 MAX_SCAN_STEPS = 65536
+# counts at or below this take the vmapped-scan arm of select_many
+SCAN_BATCH_MAX = 256
 
 # process-wide sharded dispatcher (see SelectKernel._mesh_sharded)
 _SHARED_SHARDED = None
@@ -1208,50 +1263,70 @@ class SelectKernel:
     def select_many(self, reqs: List[SelectRequest]) -> List[SelectResult]:
         """Place B independent requests over the SAME node table in one
         device dispatch (vmapped K-way kernel) — multi-eval batching per
-        SURVEY §2.6. Falls back to sequential select() for shapes the
-        K-way kernel doesn't cover. Results are bit-identical to
+        SURVEY §2.6; the production caller is the worker's batched eval
+        drain (server/worker.py process_eval_batch). Under mesh routing
+        the batched kernel runs SPMD with the node axis sharded and the
+        batch axis replicated. Falls back to sequential select() for
+        shapes the K-way kernel doesn't cover — mixed capacity tables
+        (evals against different snapshots) are counted on the
+        nomad.select.batch_fallback metric so a silent serialization
+        regression stays visible. Results are bit-identical to
         per-request select()."""
         if not reqs:
             return []
-        if self._mesh_sharded() is not None:
-            return [self.select(r) for r in reqs]
+        from ..utils import metrics
+        sharded = self._mesh_sharded()
         n = len(reqs[0].feasible)
-        n_pad = _pad_n(n)
-
+        n_pad = sharded.pad_to_shards(n) if sharded is not None \
+            else _pad_n(n)
+        shared_table = all(len(r.feasible) == n
+                           and r.capacity is reqs[0].capacity
+                           and r.algorithm == reqs[0].algorithm
+                           for r in reqs)
         def _chunk_ok(r):
             return (not r.spreads and not r.distinct_props
                     and not r.distinct_hosts and not r.scan_exclusive)
 
-        eligible = (len(reqs) > 1 and n_pad > KWAY_W
-                    and all(_chunk_ok(r) and len(r.feasible) == n
-                            and r.capacity is reqs[0].capacity
-                            and r.algorithm == reqs[0].algorithm
-                            for r in reqs))
-        if not eligible:
-            return [self.select(r) for r in reqs]
+        # small/medium chunk-eligible batches take the vmapped CHUNKED
+        # kernel: steps ~ slowest lane's nodes-touched, the same
+        # algorithm the solo path uses — batched without paying the
+        # K-way phase machinery
+        if len(reqs) > 1 and shared_table and \
+                all(_chunk_ok(r) and r.count <= 512 for r in reqs):
+            metrics.incr_counter("nomad.select.batch_dispatch")
+            return self._run_chunked_batched(reqs, n_pad, sharded)
 
-        b = len(reqs)
-        bp = 1
-        while bp < b:
-            bp *= 2
+        # small-count batches needing the full scoring surface
+        # (spreads, distinct-property, reserved ports) take the vmapped
+        # SCAN — count is the step bound, so this stays cheap only for
+        # small counts
+        if len(reqs) > 1 and shared_table and \
+                all(r.count <= SCAN_BATCH_MAX for r in reqs):
+            metrics.incr_counter("nomad.select.batch_dispatch")
+            return self._run_scan_batched(reqs, n_pad, sharded)
+
+        eligible = (len(reqs) > 1 and n_pad > KWAY_W and shared_table
+                    and all(_chunk_ok(r) for r in reqs))
+        if not eligible:
+            if len(reqs) > 1:
+                # ANY multi-request batch that serializes is the
+                # regression this counter exists to expose — mixed
+                # snapshots (not shared_table) and shapes no batched
+                # arm covers both count
+                metrics.incr_counter("nomad.select.batch_fallback")
+            return [self.select(r) for r in reqs]
+        metrics.incr_counter("nomad.select.batch_dispatch")
+
         packs = [pack_request(r, n_pad)[0] for r in reqs]
-        if bp > b:
-            dummy = dict(packs[0])
-            dummy["k_valid"] = np.int32(0)      # padding lane: places 0
-            packs += [dummy] * (bp - b)
-        cargs = {}
-        for k in _CHUNKED_ARGS:
-            if k == "capacity":
-                cargs[k] = packs[0][k]
-            else:
-                cargs[k] = np.stack([p[k] for p in packs])
-        dev = self._pick_device(n_pad, sum(min(r.count, 2 * n)
-                                           for r in reqs))
-        cargs = self._place_args(cargs, dev)
+        cargs = self._pad_and_stack(packs, _CHUNKED_ARGS)
         spread_alg = reqs[0].algorithm == "spread"
-        carry, outs = _select_kway_batched(**cargs,
-                                           max_steps=KWAY_STEPS,
-                                           spread_alg=spread_alg)
+        cargs, mesh_ctx = self._place_batched(
+            cargs, sharded, reqs[0].capacity, n_pad,
+            sum(min(r.count, 2 * n) for r in reqs))
+        with mesh_ctx:
+            carry, outs = _select_kway_batched(**cargs,
+                                               max_steps=KWAY_STEPS,
+                                               spread_alg=spread_alg)
         packed_i, ts = jax.device_get(outs)
         w = KWAY_W
         d = reqs[0].capacity.shape[1]
@@ -1270,7 +1345,10 @@ class SelectKernel:
             if rem > 0 and steps > 0 and chunk[steps - 1].sum() > 0:
                 # rare overflow of the phase budget: continue this lane
                 # on the single-request kernel from its carry state
-                lane = {k: (cargs[k] if k == "capacity"
+                # host copies: the continuation runs on the default
+                # single-device path even when the batch ran sharded
+                lane = {k: (np.asarray(jax.device_get(cargs[k]))
+                            if k == "capacity"
                             else np.asarray(jax.device_get(cargs[k][i])))
                         for k in _CHUNKED_ARGS}
                 lane.update(
@@ -1286,6 +1364,153 @@ class SelectKernel:
                 rounds.extend(cont)
             results.append(_expand_kway(req, rounds))
         return results
+
+    @staticmethod
+    def _pad_and_stack(packs: List[Dict], arg_names) -> Dict:
+        """Shared lane assembly for every batched arm: pad the lane
+        axis to a power of two (each distinct B is its own XLA
+        compile, remote over the tunnel — widths must land on warmable
+        buckets; padding lanes carry k_valid=0 and place nothing) and
+        stack per-lane arrays. Capacity stays unstacked — all lanes
+        share one table, which is the batching precondition."""
+        bp = 1
+        while bp < len(packs):
+            bp *= 2
+        if bp > len(packs):
+            dummy = dict(packs[0])
+            dummy["k_valid"] = np.int32(0)
+            packs = packs + [dummy] * (bp - len(packs))
+        cargs = {}
+        for name in arg_names:
+            if name == "capacity":
+                cargs[name] = packs[0][name]
+            else:
+                cargs[name] = np.stack([p[name] for p in packs])
+        return cargs
+
+    def _place_batched(self, cargs: Dict, sharded, capacity_src,
+                       n_pad: int, est_steps: int):
+        """Device placement for a stacked batch: mesh shardings when
+        sharded (node axis split, lane axis replicated, capacity on the
+        resident cache), else the host/accel cost-model pick. Returns
+        (placed_cargs, mesh_context)."""
+        import contextlib
+        if sharded is not None:
+            placed = sharded.place_batched_chunked_args(
+                cargs, capacity_src=capacity_src)
+            return placed, sharded.mesh
+        dev = self._pick_device(n_pad, est_steps)
+        return self._place_args(cargs, dev), contextlib.nullcontext()
+
+    def batch_dispatch_profitable(self, n: int,
+                                  count_hint: int = 16) -> bool:
+        """Should the worker coalesce evals into gateway lanes? Only
+        when a batched dispatch would route to the accelerator (mesh
+        counts): on host-routed shapes B solo chunked dispatches beat
+        one vmapped dispatch and the GIL serializes lane host work, so
+        sequential processing of the drained queue wins. Overridable
+        with NOMAD_TPU_EVAL_BATCH=force|off (tests force lanes on CPU
+        hosts)."""
+        import os
+        mode = os.environ.get("NOMAD_TPU_EVAL_BATCH", "auto")
+        if mode == "force":
+            return True
+        if mode == "off":
+            return False
+        if self._mesh_sharded() is not None:
+            return True
+        if jax.default_backend() == "cpu":
+            return False
+        n_pad = _pad_n(n)
+        return self._pick_device(
+            n_pad, _bucket_k(max(count_hint, 1))) is None
+
+    def _run_chunked_batched(self, reqs: List[SelectRequest], n_pad: int,
+                             sharded) -> List[SelectResult]:
+        """B chunk-eligible lanes through the vmapped chunked kernel in
+        one dispatch; per-lane overflow continues on the solo kernel.
+        Bit-identical to per-request select()."""
+        packs = [pack_request(r, n_pad)[0] for r in reqs]
+        spread_alg = reqs[0].algorithm == "spread"
+        maxc = max(r.count for r in reqs)
+        max_steps = 64 if maxc <= 64 else 512
+        cargs = self._pad_and_stack(packs, _CHUNKED_ARGS)
+        fn = _chunked_batched_jit(max_steps, spread_alg)
+        cargs, mesh_ctx = self._place_batched(
+            cargs, sharded, reqs[0].capacity, n_pad, min(maxc, 2 * n_pad))
+        with mesh_ctx:
+            carry, outs = fn(*[cargs[nm] for nm in _CHUNKED_ARGS])
+        outs_np = jax.device_get(outs)
+        results = []
+        for i, req in enumerate(reqs):
+            (choice, chunk, ti, ts, exh, feas, rem, steps) = \
+                (a[i] for a in outs_np)
+            steps = int(steps)
+            rem = int(rem)
+            rounds = [(choice[:steps], chunk[:steps], ti[:steps],
+                       ts[:steps], exh[:steps], feas[:steps])]
+            if rem > 0 and steps > 0 and chunk[steps - 1] != 0:
+                # step-budget overflow: continue this lane solo from
+                # its carry (host copies; the default device path)
+                lane = {nm: (np.asarray(jax.device_get(cargs[nm]))
+                             if nm == "capacity"
+                             else np.asarray(jax.device_get(cargs[nm][i])))
+                        for nm in _CHUNKED_ARGS}
+                lane.update(
+                    used0=np.asarray(jax.device_get(carry[0][i])),
+                    tg_coll0=np.asarray(jax.device_get(carry[1][i])),
+                    free_ports=np.asarray(jax.device_get(carry[2][i])),
+                    dev_slots0=np.asarray(jax.device_get(carry[3][i])),
+                    k_valid=np.int32(rem))
+                rounds.extend(self._chunked_rounds(lane, spread_alg))
+            results.append(_expand_chunks(req, rounds))
+        return results
+
+    @staticmethod
+    def _chunked_rounds(cargs: Dict, spread_alg: bool,
+                        max_steps: int = 4096) -> List:
+        """Continuation rounds on the solo chunked kernel until the
+        remaining count drains (shared by the batched arm's overflow
+        path)."""
+        rounds = []
+        while True:
+            (used, coll, freep, devs), outs = _select_chunked(
+                **cargs, max_steps=max_steps, spread_alg=spread_alg)
+            (choice, chunk, ti, ts, exh, feas,
+             rem, steps) = jax.device_get(outs)
+            steps = int(steps)
+            rem = int(rem)
+            rounds.append((choice[:steps], chunk[:steps], ti[:steps],
+                           ts[:steps], exh[:steps], feas[:steps]))
+            if rem <= 0 or steps == 0 or chunk[steps - 1] == 0:
+                break
+            cargs.update(used0=used, tg_coll0=coll, free_ports=freep,
+                         dev_slots0=devs, k_valid=np.int32(rem))
+        return rounds
+
+    def _run_scan_batched(self, reqs: List[SelectRequest], n_pad: int,
+                          sharded) -> List[SelectResult]:
+        """B lanes through the vmapped scan kernel in one dispatch;
+        results are bit-identical to per-request select() (the chunked
+        and K-way solo paths are proven scan-equivalent)."""
+        packs = []
+        s_live = p_live = 0
+        for r in reqs:
+            args, st = pack_request(r, n_pad)
+            packs.append(args)
+            s_live = max(s_live, st["s_live"])
+            p_live = max(p_live, st["p_live"])
+        spread_alg = reqs[0].algorithm == "spread"
+        k = _bucket_k(max(max(r.count, 1) for r in reqs))
+        cargs = self._pad_and_stack(packs, _SCAN_ARGS)
+        fn = _scan_batched_jit(k, spread_alg, s_live, p_live)
+        cargs, mesh_ctx = self._place_batched(
+            cargs, sharded, reqs[0].capacity, n_pad, k)
+        with mesh_ctx:
+            _carry, outs = fn(*[cargs[nm] for nm in _SCAN_ARGS])
+        outs_np = jax.device_get(outs)
+        return [unpack_result(r, tuple(a[i] for a in outs_np))
+                for i, r in enumerate(reqs)]
 
     def _finish_kway_rounds(self, req, cargs, spread_alg, pending):
         """Continuation rounds only (no expansion) — shared by the

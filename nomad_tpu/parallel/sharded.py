@@ -100,6 +100,34 @@ class ShardedSelect:
         self._resident[key] = (src, arr)
         return arr
 
+    def place_batched_chunked_args(self, cargs: dict,
+                                   capacity_src=None) -> dict:
+        """Shard the BATCHED K-way kernel's argument dict: per-lane
+        arrays carry a leading batch axis (B, ...) that stays
+        replicated while the node axis shards — the multi-eval batch
+        (select_many) runs as one SPMD program over the mesh. Capacity
+        is unstacked (all lanes share one table; that's the batching
+        precondition) and rides the cross-eval resident cache."""
+        batched = {
+            "node": NamedSharding(self.mesh, P(None, "nodes")),
+            "node2": NamedSharding(self.mesh, P(None, "nodes", None)),
+            "code": NamedSharding(self.mesh, P(None, None, "nodes")),
+            "rep": self.replicated,
+            "scalar": self.replicated,      # scalars stack to (B,)
+        }
+        placed = {}
+        for name, value in cargs.items():
+            if name == "capacity":
+                placed[name] = (self._resident_capacity(capacity_src,
+                                                        value)
+                                if capacity_src is not None
+                                else jax.device_put(
+                                    value, self.node2_sharding))
+                continue
+            sharding = batched[PACK_SHARD_KINDS[name]]
+            placed[name] = jax.device_put(np.asarray(value), sharding)
+        return placed
+
     def place_chunked_args(self, cargs: dict,
                            capacity_src=None) -> dict:
         """Shard the K-way kernel's argument dict over the mesh (same

@@ -63,14 +63,23 @@ class GenericScheduler:
         self.failed_tg_allocs: Dict[str, AllocMetric] = {}
         self.queued_allocs: Dict[str, int] = {}
         self.followup_evals: List[Evaluation] = []
+        # set by the batched worker: routes kernel dispatches through
+        # the multi-eval gateway (one select_many per lane barrier)
+        self.kernel_dispatch = None
 
     # -- entry ---------------------------------------------------------
     def process(self, evaluation: Evaluation) -> None:
         self.eval = evaluation
         limit = MAX_BATCH_ATTEMPTS if self.batch else MAX_SERVICE_ATTEMPTS
 
+        # retryMax + progressMade (scheduler/util.go:277-310): a round
+        # that committed ANYTHING resets the attempt budget — under
+        # optimistic concurrency a storm of plan conflicts burns rounds
+        # while still converging, and only zero-progress rounds may
+        # exhaust the limit
         progress = [False]
-        for _ in range(limit):
+        attempts = 0
+        while True:
             progress[0] = False
             try:
                 done = self._process_once(progress)
@@ -80,7 +89,11 @@ class GenericScheduler:
             if done:
                 self._set_status(EVAL_STATUS_COMPLETE, "")
                 return
-            if not progress[0]:
+            if progress[0]:
+                attempts = 0
+                continue
+            attempts += 1
+            if attempts >= limit:
                 break
         # retries exhausted on placement conflicts: block so the remaining
         # work is retried when capacity frees (generic_sched.go:150-160)
@@ -111,6 +124,8 @@ class GenericScheduler:
         self.blocked = None
         self.ctx = EvalContext(snapshot, ev, self.plan)
         self.engine = PlacementEngine(snapshot)
+        if self.kernel_dispatch is not None:
+            self.engine.dispatch = self.kernel_dispatch
         if self.job is not None:
             self.engine.set_job(self.job)
             self.ctx.eligibility.set_job(self.job)

@@ -61,6 +61,10 @@ class PlacementEngine:
         self.table: Optional[NodeTable] = None
         self.by_dc: Dict[str, int] = {}
         self.kernel = SelectKernel()
+        # dispatch hook: the batched worker swaps this for a gateway
+        # that coalesces concurrent evals into one select_many call
+        # (server/worker.py BatchGateway)
+        self.dispatch = self.kernel.select
         self._mask_cache: Dict[Tuple, np.ndarray] = {}
         # per-eval NetworkIndex cache: shared across select_batch calls so
         # port offers stay consistent between task groups of one plan
@@ -435,7 +439,7 @@ class PlacementEngine:
             distinct_props=distinct_props,
             n_considered=int(self._base_mask.sum()),
         )
-        res = self.kernel.select(req)
+        res = self.dispatch(req)
         elapsed = time.monotonic_ns() - start
 
         # host-side port assignment for winners, plan-consistent

@@ -56,6 +56,9 @@ LOG = logging.getLogger("nomad_tpu.server")
 class ServerConfig:
     num_schedulers: int = 2
     enabled_schedulers: tuple = ("service", "batch", "system")
+    # max READY evals one worker drains into a single batched dispatch
+    # (SURVEY §2.6 row 1; 1 disables batching)
+    eval_batch_size: int = 4
     heartbeat_ttl_s: float = 10.0
     failed_eval_unblock_delay_s: float = 60.0
     dev_mode: bool = True
@@ -387,13 +390,25 @@ class Server:
                 # nested FSM side effect during a committed apply: on
                 # the leader it becomes its own log entry (applied when
                 # it commits); on a follower the leader's equivalent
-                # entry arrives via the log — suppress
+                # entry arrives via the log — suppress. Narrow window:
+                # if leadership changes between an entry's commit and
+                # its apply, NO node re-emits the nested write (every
+                # replica applies it as a non-leader). The only such
+                # write is the blocked-eval wake (_unblock_enqueue),
+                # and the woken eval stays in state as blocked — the
+                # new leader re-tracks it on establish_leadership, the
+                # same stall-until-next-capacity-change the reference
+                # accepts across failovers (blocked_evals.go:316).
                 if self.raft.is_leader():
                     try:
                         idx, _term = self.raft.append_entry(
                             msg_type, payload)
                         return idx, None
                     except RuntimeError:
+                        LOG.warning(
+                            "nested %s write dropped: deposed during "
+                            "FSM apply; state-derived recovery applies",
+                            msg_type)
                         return self._raft_index, None
                 return self._raft_index, None
             if not self.raft.is_leader():

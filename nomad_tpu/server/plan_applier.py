@@ -164,6 +164,21 @@ class PlanApplier:
         return result
 
     def _apply(self, plan: Plan):
+        # token fence (plan_queue admission in the reference): a plan
+        # whose eval has been re-delivered (nack timeout mid-process)
+        # carries a stale token — committing it would double-place the
+        # job alongside the new holder's plan. Plans from test harness
+        # paths carry no outstanding eval and pass through.
+        if plan.eval_id and plan.eval_token:
+            # tokens come only from worker dequeues, so a tokened plan
+            # must still hold the delivery: token mismatch OR a no-
+            # longer-outstanding eval (already re-delivered and acked
+            # by the new holder) both mean stale
+            current = self.server.eval_broker.outstanding(plan.eval_id)
+            if current != plan.eval_token:
+                raise RuntimeError(
+                    f"plan for eval {plan.eval_id} submitted with stale "
+                    "token; evaluation was re-delivered")
         store = self.server.store
         snapshot = store.snapshot()
         # retire overlay entries the FSM has applied (visible in the

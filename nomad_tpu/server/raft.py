@@ -248,7 +248,20 @@ class RaftNode:
                 with self._lock:
                     if self._snap_gen != gen:
                         break
-                self.server.apply_replicated(idx, mtype, enc)
+                try:
+                    self.server.apply_replicated(idx, mtype, enc)
+                except Exception:
+                    # an applier error must not kill the ONLY applier
+                    # thread (that would wedge the node forever while
+                    # the commit index keeps advancing). The entry is
+                    # counted applied — the reference FSM logs apply
+                    # errors and moves on too (a deterministic error
+                    # fails identically on every replica)
+                    LOG.exception("FSM apply of entry %d (%s) failed",
+                                  idx, mtype)
+                    with self.server._raft_l:
+                        if self.server._raft_index < idx:
+                            self.server._raft_index = idx
             with self._commit_cv:
                 self._commit_cv.notify_all()   # wake wait_for_applied
 

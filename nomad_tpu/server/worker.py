@@ -6,6 +6,15 @@ Reference semantics: nomad/worker.go — run:105-138, dequeueEvaluation:142,
 snapshotMinIndex:228, invokeScheduler:244, SubmitPlan:277-343 (snapshot
 index fencing + RefreshIndex handling), exponential backoff, pause
 during leadership transitions.
+
+Multi-eval batching (SURVEY §2.6 row 1: "batch multiple evals per
+device dispatch"): after a blocking dequeue lands one eval, the worker
+drains up to eval_batch_size-1 more READY evals without waiting and
+processes them as concurrent lanes whose kernel dispatches meet at a
+BatchGateway barrier — one vmapped select_many per rendezvous instead
+of one device round trip per eval. The broker's one-outstanding-per-job
+invariant guarantees the lanes are distinct jobs; plans still serialize
+through the plan applier.
 """
 
 from __future__ import annotations
@@ -26,19 +35,187 @@ DEQUEUE_TIMEOUT_S = 0.5
 RAFT_SYNC_LIMIT = 10.0
 
 
+class BatchGateway:
+    """Rendezvous point turning concurrent per-lane kernel dispatches
+    into one multi-eval device dispatch (ops/select.py select_many).
+
+    Each lane is one in-flight eval. A lane interacts in exactly two
+    ways: dispatch(req) — block until the coalesced result is ready —
+    and lane_finished() when its eval completes. A batch fires when
+    every still-active lane is parked in dispatch() (maximum width), or
+    when the oldest parked request has waited out a short window —
+    adaptive behavior: host-bound runs degrade toward per-eval
+    dispatches instead of serializing behind stragglers, device-bound
+    runs (short host phases) reach full width. Firing a partial batch
+    is always safe: late lanes simply form the next batch."""
+
+    WINDOW_S = 0.02
+
+    def __init__(self, kernel, lanes: int):
+        self._kernel = kernel
+        self._cv = threading.Condition()
+        self._active = lanes
+        self._waiting: List = []        # [(req, slot_dict)]
+        self._open_t = 0.0              # arrival of the oldest waiter
+        self._part_cache = (None, None)  # (n, lanes) -> lane ids per node
+
+    def dispatch(self, req):
+        slot = {}
+        with self._cv:
+            if not self._waiting:
+                self._open_t = time.monotonic()
+            self._waiting.append((req, slot))
+            self._fire_if_ready()
+            while "out" not in slot:
+                if self._waiting:
+                    remaining = self.WINDOW_S - (time.monotonic()
+                                                 - self._open_t)
+                    if remaining <= 0:
+                        self._fire()
+                        continue
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait(0.5)
+        out = slot["out"]
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    def lane_finished(self) -> None:
+        with self._cv:
+            self._active -= 1
+            self._fire_if_ready()
+
+    def _fire_if_ready(self) -> None:
+        # cv held. Full width: every active lane is parked here, so no
+        # later request can join this batch anyway.
+        if not self._waiting or len(self._waiting) < self._active:
+            return
+        self._fire()
+
+    def _fire(self) -> None:
+        # cv held on entry; the kernel work runs with it RELEASED so
+        # lanes that arrive mid-dispatch can enqueue (and other lanes'
+        # host phases overlap the device round trip). Concurrent fires
+        # are safe — each pops its own batch.
+        batch, self._waiting = self._waiting, []
+        if not batch:
+            return
+        reqs = [r for r, _ in batch]
+        self._cv.release()
+        try:
+            try:
+                originals = self._partition(reqs) if len(reqs) > 1 \
+                    else None
+                results = self._kernel.select_many(reqs)
+                if originals is not None:
+                    # a lane that could not fill its slice retries solo
+                    # on the FULL node set — partitioning is a
+                    # throughput heuristic and must never change
+                    # failure semantics
+                    for i, (req, res) in enumerate(zip(reqs, results)):
+                        if originals[i] is not None and \
+                                res.placed < req.count:
+                            req.feasible = originals[i]
+                            results[i] = self._kernel.select(req)
+                outs = results
+            except Exception as e:  # pragma: no cover - defensive
+                outs = [e] * len(batch)
+        finally:
+            self._cv.acquire()
+        for (_r, slot), res in zip(batch, outs):
+            slot["out"] = res
+        self._cv.notify_all()
+
+    def _partition(self, reqs):
+        """Decorrelate concurrent lanes: identical argmax sequences
+        would make every lane place on the same winners and collide in
+        the plan applier (optimistic concurrency). The reference
+        decorrelates workers by shuffling the node list per eval
+        (stack.go:70-90); the columnar analog restricts each lane to a
+        hash-partitioned slice of the feasible set — only when the
+        slice still leaves generous headroom over the lane's ask.
+        Returns the original feasible masks (None where untouched) so
+        unlucky lanes can retry unpartitioned."""
+        import numpy as np
+        lanes = len(reqs)
+        originals = [None] * lanes
+        n = len(reqs[0].feasible)
+        cache_key, lane_ids = self._part_cache
+        if cache_key != (n, lanes):
+            mix = (np.arange(n, dtype=np.uint64) * 2654435761) \
+                & np.uint64(0xffffffff)
+            lane_ids = ((mix >> np.uint64(7)) % np.uint64(lanes)) \
+                .astype(np.int32)
+            self._part_cache = ((n, lanes), lane_ids)
+        for i, req in enumerate(reqs):
+            if len(req.feasible) != n:
+                continue
+            pool = int(req.feasible.sum())
+            if pool < lanes * max(4 * req.count, 32):
+                continue
+            originals[i] = req.feasible
+            req.feasible = req.feasible & (lane_ids == i)
+        return originals
+
+
+class EvalLane:
+    """Planner bound to ONE in-flight eval (worker.go binds this state
+    to the worker itself; concurrent batch lanes each need their own
+    token/snapshot-index)."""
+
+    def __init__(self, server, ev: Evaluation, token: str):
+        self.server = server
+        self.eval = ev
+        self.token = token
+        self.snapshot_index = 0
+
+    # -- Planner interface --------------------------------------------
+    def submit_plan(self, plan: Plan) -> Optional[PlanResult]:
+        from ..utils import metrics
+        t0 = time.monotonic()
+        plan.eval_token = self.token
+        plan.snapshot_index = self.snapshot_index
+        future = self.server.plan_queue.enqueue(plan)
+        result: PlanResult = future.result(timeout=30)
+        metrics.measure_since("nomad.worker.submit_plan", t0)
+        # if some placements were rejected, wait for the refresh index so
+        # the next attempt sees why (worker.go:318-340)
+        if result.refresh_index:
+            self.server.store.block_min_index(result.refresh_index - 1,
+                                              timeout_s=RAFT_SYNC_LIMIT)
+        return result
+
+    def refreshed_state(self, index: int):
+        return self.server.store.snapshot_min_index(index,
+                                                    timeout_s=RAFT_SYNC_LIMIT)
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.raft_apply("eval_update", dict(evals=[ev]))
+
+    def create_eval(self, ev: Evaluation) -> None:
+        ev.snapshot_index = self.snapshot_index
+        self.server.raft_apply("eval_update", dict(evals=[ev]))
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self.server.blocked_evals.block(ev)
+
+
 class Worker:
     def __init__(self, server, enabled_schedulers: List[str], wid: int = 0):
         self.server = server
         self.schedulers = list(enabled_schedulers)
         self.id = wid
+        self.batch_size = max(1, getattr(server.config,
+                                         "eval_batch_size", 1))
         self._stop = threading.Event()
         self._paused = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # per-eval state while processing
-        self._eval: Optional[Evaluation] = None
-        self._token: str = ""
-        self._snapshot_index = 0
-        self.stats = {"processed": 0, "failed": 0}
+        self.stats = {"processed": 0, "failed": 0, "batches": 0}
+        # one kernel shared by this worker's gateways (jit caches warm
+        # across batches)
+        from ..ops import SelectKernel
+        self._kernel = SelectKernel()
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -69,27 +246,48 @@ class Worker:
                 self.schedulers, DEQUEUE_TIMEOUT_S)
             if ev is None:
                 continue
-            self.process_eval(ev, token)
+            batch = [(ev, token)]
+            if self.batch_size > 1 and ev.type != JOB_TYPE_CORE:
+                # drain already-READY compatible evals without waiting
+                # (eval_broker.go:329 Dequeue; the queue depth IS the
+                # batching opportunity)
+                while len(batch) < self.batch_size:
+                    ev2, tok2 = self.server.eval_broker.dequeue(
+                        self.schedulers, timeout_s=0)
+                    if ev2 is None:
+                        break
+                    if ev2.type == JOB_TYPE_CORE:
+                        # core evals don't place; run solo afterwards
+                        self.process_eval(ev2, tok2)
+                        continue
+                    batch.append((ev2, tok2))
+            if len(batch) == 1:
+                self.process_eval(ev, token)
+            else:
+                self.process_eval_batch(batch)
 
     # -- single eval ---------------------------------------------------
-    def process_eval(self, ev: Evaluation, token: str) -> None:
+    def process_eval(self, ev: Evaluation, token: str,
+                     dispatch=None) -> None:
         from ..utils import metrics
-        self._eval = ev
-        self._token = token
+        lane = EvalLane(self.server, ev, token)
         try:
             # wait for the state store to catch up to the eval
             t0 = time.monotonic()
             snap = self.server.store.snapshot_min_index(
                 ev.modify_index, timeout_s=RAFT_SYNC_LIMIT)
             metrics.measure_since("nomad.worker.wait_for_index", t0)
-            self._snapshot_index = snap.latest_index()
+            lane.snapshot_index = snap.latest_index()
             if ev.type == JOB_TYPE_CORE:
                 # worker.go invokeScheduler: _core evals get the GC
                 # pseudo-scheduler, not a placement scheduler
                 from .core_sched import CoreScheduler
                 sched = CoreScheduler(snap, self.server)
             else:
-                sched = new_scheduler(self._scheduler_for(ev), snap, self)
+                sched = new_scheduler(self._scheduler_for(ev), snap, lane)
+                if dispatch is not None and \
+                        hasattr(sched, "kernel_dispatch"):
+                    sched.kernel_dispatch = dispatch
             t0 = time.monotonic()
             sched.process(ev)
             metrics.measure_since(
@@ -105,40 +303,40 @@ class Worker:
                 self.server.eval_broker.nack(ev.id, token)
             except Exception:
                 pass
-        finally:
-            self._eval = None
-            self._token = ""
+
+    # -- batched evals -------------------------------------------------
+    def process_eval_batch(self, batch: List) -> None:
+        """Process B dequeued evals as concurrent lanes sharing one
+        BatchGateway: their kernel dispatches coalesce into select_many
+        calls. Host-side work (reconcile, plan build) interleaves under
+        the GIL; the device sees whole batches. When the kernel's cost
+        model says these shapes route to the host CPU anyway, the
+        drained evals are processed sequentially instead — lanes would
+        only add thread overhead there."""
+        if not self._kernel.batch_dispatch_profitable(
+                self.server.store.node_count()):
+            for ev, token in batch:
+                self.process_eval(ev, token)
+            return
+        gateway = BatchGateway(self._kernel, lanes=len(batch))
+        threads = []
+
+        def lane_run(ev, token):
+            try:
+                self.process_eval(ev, token, dispatch=gateway.dispatch)
+            finally:
+                gateway.lane_finished()
+
+        for ev, token in batch:
+            t = threading.Thread(target=lane_run, args=(ev, token),
+                                 daemon=True,
+                                 name=f"worker-{self.id}-lane-{ev.id[:8]}")
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join()
+        self.stats["batches"] += 1
 
     @staticmethod
     def _scheduler_for(ev: Evaluation) -> str:
         return ev.type if ev.type in ("service", "batch", "system") else "batch"
-
-    # -- Planner interface --------------------------------------------
-    def submit_plan(self, plan: Plan) -> Optional[PlanResult]:
-        from ..utils import metrics
-        t0 = time.monotonic()
-        plan.eval_token = self._token
-        plan.snapshot_index = self._snapshot_index
-        future = self.server.plan_queue.enqueue(plan)
-        result: PlanResult = future.result(timeout=30)
-        metrics.measure_since("nomad.worker.submit_plan", t0)
-        # if some placements were rejected, wait for the refresh index so
-        # the next attempt sees why (worker.go:318-340)
-        if result.refresh_index:
-            self.server.store.block_min_index(result.refresh_index - 1,
-                                              timeout_s=RAFT_SYNC_LIMIT)
-        return result
-
-    def refreshed_state(self, index: int):
-        return self.server.store.snapshot_min_index(index,
-                                                    timeout_s=RAFT_SYNC_LIMIT)
-
-    def update_eval(self, ev: Evaluation) -> None:
-        self.server.raft_apply("eval_update", dict(evals=[ev]))
-
-    def create_eval(self, ev: Evaluation) -> None:
-        ev.snapshot_index = self._snapshot_index
-        self.server.raft_apply("eval_update", dict(evals=[ev]))
-
-    def reblock_eval(self, ev: Evaluation) -> None:
-        self.server.blocked_evals.block(ev)

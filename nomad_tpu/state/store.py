@@ -162,6 +162,11 @@ class StateSnapshot:
     def nodes(self) -> List[Node]:
         return list(self._root.table("nodes").values())
 
+    def node_count(self) -> int:
+        """O(1) node-table cardinality (the worker's batching heuristic
+        reads this per drained batch)."""
+        return len(self._root.table("nodes"))
+
     def node_by_prefix(self, prefix: str) -> List[Node]:
         return [n for n in self.nodes() if n.id.startswith(prefix)]
 
