@@ -103,6 +103,20 @@ class ApiClient:
                              {"Count": count, "Target": {"Group": group},
                               "Message": message})
 
+    def list_scaling_policies(self, job: str = "",
+                              policy_type: str = "") -> list:
+        """GET /v1/scaling/policies (nomad/scaling_endpoint.go:24)."""
+        params = {}
+        if job:
+            params["job"] = job
+        if policy_type:
+            params["type"] = policy_type
+        return self._request("GET", "/v1/scaling/policies", params=params)
+
+    def get_scaling_policy(self, policy_id: str) -> dict:
+        """GET /v1/scaling/policy/:id (nomad/scaling_endpoint.go:90)."""
+        return self._request("GET", f"/v1/scaling/policy/{policy_id}")
+
     def job_scale_status(self, job_id: str) -> dict:
         return self._request("GET", f"/v1/job/{job_id}/scale")
 

@@ -190,6 +190,15 @@ class HTTPApiServer:
             need(acl.allow_namespace_operation(
                 ns, "csi-write-volume" if write else "csi-read-volume"))
             return
+        if path == "/v1/scaling/policies" or \
+                path.startswith("/v1/scaling/policy/"):
+            # the autoscaler's read surface needs only job-read
+            # capabilities (nomad/scaling_endpoint.go aclObj checks:
+            # ListPolicies list-jobs, GetPolicy read-job)
+            need(acl.allow_namespace_operation(
+                ns, "list-jobs" if path == "/v1/scaling/policies"
+                else "read-job"))
+            return
         if path == "/v1/search":
             need(acl.allow_namespace(ns) or acl.allow_node_read())
             return
@@ -339,6 +348,21 @@ class HTTPApiServer:
             if sub == "deployments":
                 return [to_wire(d)
                         for d in store.deployments_by_job(ns, job_id)], idx
+
+        # autoscaling API: the external autoscaler's read surface
+        # (nomad/scaling_endpoint.go:24 ListPolicies, :90 GetPolicy)
+        if path == "/v1/scaling/policies" and method == "GET":
+            pols = store.scaling_policies(
+                namespace=ns, job_id=q.get("job") or None,
+                policy_type=q.get("type") or None)
+            return [p.stub() for p in pols], idx
+
+        m = re.match(r"^/v1/scaling/policy/([^/]+)$", path)
+        if m and method == "GET":
+            pol = store.scaling_policy_by_id(m.group(1))
+            if pol is None:
+                return None
+            return to_wire(pol), idx
 
         if path == "/v1/nodes" and method == "GET":
             prefix = q.get("prefix", "")

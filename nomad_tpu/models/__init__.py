@@ -21,6 +21,7 @@ from .resources import (
 from .networks import NetworkResource, Port, NetworkIndex
 from .job import (
     Job,
+    ScalingPolicy,
     TaskGroup,
     Task,
     Constraint,

@@ -276,6 +276,40 @@ class Scaling:
 
 
 @dataclass
+class ScalingPolicy:
+    """structs.ScalingPolicy — the external autoscaler's unit of
+    consumption (nomad/scaling_endpoint.go:24,90). Derived from task
+    groups' scaling blocks at job registration and stored in the
+    scaling_policies table (nomad/state/schema.go:36-62). The id is a
+    UUIDv5 of the target so every replica's FSM derives the SAME id
+    (the reference assigns ids server-side pre-raft; here derivation
+    happens inside the apply, which must stay deterministic)."""
+    id: str = ""
+    namespace: str = "default"
+    # Target: {"Namespace": ns, "Job": job, "Group": group}
+    target: Dict[str, str] = field(default_factory=dict)
+    min: int = 0
+    max: int = 0
+    policy: Dict[str, object] = field(default_factory=dict)
+    type: str = "horizontal"
+    enabled: bool = True
+    create_index: int = 0
+    modify_index: int = 0
+
+    @staticmethod
+    def id_for(namespace: str, job_id: str, group: str) -> str:
+        import uuid
+        return str(uuid.uuid5(uuid.NAMESPACE_URL,
+                              f"nomad-scaling/{namespace}/{job_id}/{group}"))
+
+    def stub(self) -> Dict:
+        return {"ID": self.id, "Enabled": self.enabled,
+                "Type": self.type, "Target": dict(self.target),
+                "CreateIndex": self.create_index,
+                "ModifyIndex": self.modify_index}
+
+
+@dataclass
 class TaskGroup:
     """A co-scheduled set of tasks (structs.go TaskGroup:5780)."""
     name: str = ""

@@ -56,6 +56,58 @@ def _alloc_usage(alloc) -> Tuple[float, float, float, float]:
     return out
 
 
+class ShardedCowMap:
+    """alloc-id -> alloc registry with O(1) snapshot clones: 256 hash
+    shards; clones share shard dicts and copy one lazily on first
+    write. The delta path touches a handful of shards per refresh,
+    while building a persistent-trie registry at 2M rows costs the
+    better part of a minute of pure Python — this is the resident
+    table's answer to the C2M cold-build budget."""
+
+    __slots__ = ("_shards", "_own")
+    N = 256
+
+    def __init__(self, shards=None, own=None):
+        self._shards = shards if shards is not None \
+            else [None] * self.N          # None == empty shard
+        self._own = own if own is not None else set(range(self.N))
+
+    def get(self, key, default=None):
+        s = self._shards[hash(key) & 0xff]
+        return default if s is None else s.get(key, default)
+
+    def _writable(self, i: int):
+        s = self._shards[i]
+        if i in self._own:
+            if s is None:
+                s = {}
+                self._shards[i] = s
+            return s
+        s = dict(s) if s else {}
+        self._shards[i] = s
+        self._own.add(i)
+        return s
+
+    def put(self, key, value) -> None:
+        self._writable(hash(key) & 0xff)[key] = value
+
+    def discard(self, key) -> None:
+        i = hash(key) & 0xff
+        s = self._shards[i]
+        if s is None or key not in s:
+            return
+        self._writable(i).pop(key, None)
+
+    def clone(self) -> "ShardedCowMap":
+        # both sides go copy-on-write: the parent must not keep
+        # mutating dicts the clone now shares
+        self._own = set()
+        return ShardedCowMap(list(self._shards), set())
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self._shards if s)
+
+
 class NodeTable:
     """Columnar view of the ready node set + live allocation usage."""
 
@@ -66,12 +118,14 @@ class NodeTable:
         self.id_to_idx = {nid: i for i, nid in enumerate(self.ids)}
         self.cols = TargetColumns(nodes)
         # applied-alloc registry for the delta path (alloc id -> the
-        # object version whose usage is currently accounted); a Hamt so
-        # clone_for_deltas is O(1) even at 2M allocs
-        from ..utils.hamt import Hamt
-        self.alloc_by_id: Hamt = Hamt()
+        # object version whose usage is currently accounted); sharded
+        # CoW map so clone_for_deltas is O(shards) even at 2M allocs
+        # and the cold build is plain dict inserts
+        self.alloc_by_id = ShardedCowMap()
         # attribute dictionary-encodings, valid per table version
         self._attr_codes_cache: Dict[str, Tuple[np.ndarray, List[str]]] = {}
+        # ready-in-datacenters masks, valid per table version
+        self._ready_dc_cache: Dict[Tuple, Tuple] = {}
         # until finalize() seals the table it is private to its builder:
         # bulk loads append rows in place and batch the registry, avoiding
         # O(allocs-per-node^2) copy-on-write during build
@@ -145,13 +199,44 @@ class NodeTable:
             nodes.append(node)
         nodes.sort(key=lambda n: n.id)
         t = cls(nodes)
+        # bulk accumulation: per-alloc numpy scalar adds cost ~4 ops x
+        # 2M rows; instead collect (node idx, usage-code) pairs in one
+        # tight pass and land them with a single np.add.at (usage rows
+        # dedupe heavily — fleets share identical resource shapes).
+        # Float adds stay elementwise-sequential, so results match the
+        # incremental path bit for bit.
+        id_to_idx = t.id_to_idx
+        rows = t.live_allocs
+        pend = t._pending_allocs
+        net_bits = t._net_bits
+        idx_list: List[int] = []
+        code_list: List[int] = []
+        code_of: Dict[Tuple, int] = {}
+        lut: List[Tuple] = []
         for alloc in snapshot.allocs():
             if alloc.terminal_status():
                 continue
-            i = t.id_to_idx.get(alloc.node_id)
+            i = id_to_idx.get(alloc.node_id)
             if i is None:
                 continue
-            t.add_alloc_usage(i, alloc)
+            u = _alloc_usage(alloc)
+            c = code_of.get(u)
+            if c is None:
+                c = len(lut)
+                code_of[u] = c
+                lut.append(u)
+            idx_list.append(i)
+            code_list.append(c)
+            rows[i].append(alloc)
+            pend.append((alloc.id, alloc))
+            bits = t._alloc_port_bits(alloc)
+            if bits:
+                net_bits[i] |= bits
+        if idx_list:
+            ii = np.fromiter(idx_list, np.int64, len(idx_list))
+            cc = np.fromiter(code_list, np.int64, len(code_list))
+            np.add.at(t.base_used, ii,
+                      np.asarray(lut, np.float32)[cc])
         t.finalize()
         return t
 
@@ -187,10 +272,11 @@ class NodeTable:
         t._free_ports_dirty = (None if self._free_ports_dirty is None
                                else set(self._free_ports_dirty))
         self._seal()
-        t.alloc_by_id = self.alloc_by_id  # persistent map: O(1) share
+        t.alloc_by_id = self.alloc_by_id.clone()  # CoW share, O(shards)
         t.mask_cache = self.mask_cache  # node columns shared => masks too
         t.preempt_cache = self.preempt_cache  # row identity keys the entries
         t._attr_codes_cache = self._attr_codes_cache
+        t._ready_dc_cache = self._ready_dc_cache  # status cols shared
         t._sealed = True
         t._pending_allocs = []
         return t
@@ -228,7 +314,7 @@ class NodeTable:
         self.base_used[i, 3] += u[3]
         if self._sealed:
             self.live_allocs[i] = self.live_allocs[i] + [alloc]  # row CoW
-            self.alloc_by_id = self.alloc_by_id.set(alloc.id, alloc)
+            self.alloc_by_id.put(alloc.id, alloc)
         else:
             self.live_allocs[i].append(alloc)
             self._pending_allocs.append((alloc.id, alloc))
@@ -247,7 +333,7 @@ class NodeTable:
         self._seal()
         self.live_allocs[i] = [a for a in self.live_allocs[i]
                                if a.id != alloc.id]
-        self.alloc_by_id = self.alloc_by_id.delete(alloc.id)
+        self.alloc_by_id.discard(alloc.id)
         bits = self._alloc_port_bits(alloc)
         # keep ports that the node itself reserves (reserved_host_ports)
         node_bits = 0
@@ -285,7 +371,9 @@ class NodeTable:
             return
         self._sealed = True
         if self._pending_allocs:
-            self.alloc_by_id = self.alloc_by_id.update(self._pending_allocs)
+            put = self.alloc_by_id.put
+            for aid, alloc in self._pending_allocs:
+                put(aid, alloc)
             self._pending_allocs = []
 
     def finalize(self) -> None:
@@ -341,6 +429,23 @@ class NodeTable:
         dcs = set(datacenters)
         return np.fromiter((d in dcs for d in self.datacenters),
                            dtype=bool, count=self.n)
+
+    def ready_in_dcs(self, datacenters: List[str]):
+        """(mask bool[N], n_ready, {dc: count}) of ready nodes in the
+        eval's datacenters — readyNodesInDCs (scheduler/util.go:233) as
+        cached columns. Node status and DC membership are immutable per
+        table version, so one 50k-row pass serves every eval against
+        this version instead of a python scan per eval."""
+        key = tuple(sorted(set(datacenters)))
+        hit = self._ready_dc_cache.get(key)
+        if hit is None:
+            import collections
+            mask = self.ready & self.dc_mask(list(key))
+            by_dc = dict(collections.Counter(
+                self.datacenters[mask].tolist()))
+            hit = (mask, int(mask.sum()), by_dc)
+            self._ready_dc_cache[key] = hit
+        return hit
 
     def host_volume_mask(self, volumes: Dict[str, object]) -> np.ndarray:
         """HostVolumeChecker (feasible.go:117)."""
@@ -529,12 +634,20 @@ class ProposedIndex:
         c = len(values)
         counts = np.zeros(c + 1, dtype=np.float32)
         present = np.zeros(c + 1, dtype=bool)
-        code_of = {v: i for i, v in enumerate(values)}
-        vals, found = self.table.cols.resolve(attribute)
+        # ride the table's cached dictionary encoding — a cols.resolve
+        # here would re-scan all N nodes per spread per eval
+        tcodes, tvals = self.table.attr_codes(attribute)
+        missing = len(tvals)
+        if tvals is values:
+            remap = None
+        else:
+            code_of = {v: i for i, v in enumerate(values)}
+            remap = [code_of.get(v) for v in tvals]
         for i, allocs in self.job_allocs_by_node.items():
-            if not found[i]:
+            tcode = int(tcodes[i])
+            if tcode == missing:
                 continue
-            code = code_of.get(vals[i])
+            code = tcode if remap is None else remap[tcode]
             if code is None:
                 continue
             for a in allocs:

@@ -83,15 +83,11 @@ class PlacementEngine:
         """Point at the snapshot's resident node table; readiness and
         datacenter membership become per-eval mask components instead of
         a table rebuild (readyNodesInDCs, scheduler/util.go:233, as a
-        column filter). Returns the ready-in-DC node count."""
-        import collections
-
+        cached column filter). Returns the ready-in-DC node count."""
         self.table = self.snapshot.node_table()
-        t = self.table
-        self._base_mask = t.ready & t.dc_mask(datacenters)
-        n_ready = int(self._base_mask.sum())
-        self.by_dc = dict(collections.Counter(
-            t.datacenters[self._base_mask].tolist()))
+        mask, n_ready, by_dc = self.table.ready_in_dcs(datacenters)
+        self._base_mask = mask
+        self.by_dc = dict(by_dc)
         return n_ready
 
     def eligible_node_ids(self) -> set:

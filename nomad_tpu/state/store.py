@@ -21,7 +21,8 @@ from dataclasses import dataclass, field, replace
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..models import (
-    Allocation, Deployment, Evaluation, Job, Node, SchedulerConfiguration,
+    Allocation, Deployment, Evaluation, Job, Node, ScalingPolicy,
+    SchedulerConfiguration,
     ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
     ALLOC_CLIENT_RUNNING, ALLOC_CLIENT_PENDING,
     ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT,
@@ -307,6 +308,8 @@ class StateSnapshot:
         plain["scaling_events"] = [
             {"key": list(k), "events": v}
             for k, v in root.table("scaling_events").items()]
+        plain["scaling_policies"] = [
+            to_wire(p) for p in root.table("scaling_policies").values()]
         plain["acl_policies"] = [to_wire(p) for p in
                                  root.table("acl_policies").values()]
         plain["acl_tokens"] = [to_wire(t) for t in
@@ -518,6 +521,7 @@ class StateStore(StateSnapshot):
             root = root.with_table("job_versions",
                                    root.table("job_versions").set(key, versions))
             root = self._ensure_job_summary(root, index, job)
+            root = self._sync_scaling_policies(root, index, job)
             if job.parent_id:
                 root = self._bump_parent_children(
                     root, index, (job.namespace, job.parent_id),
@@ -525,6 +529,85 @@ class StateStore(StateSnapshot):
                     job.status)
             root = root.with_index("jobs", index)
             self._publish(root)
+
+    def _sync_scaling_policies(self, root: _Root, index: int,
+                               job: Job) -> _Root:
+        """Derive scaling policies from the job's task-group scaling
+        blocks (state_store.go updateJobScalingPolicies; CRUD surface
+        nomad/scaling_endpoint.go:24,90). Policies keep their id across
+        re-registrations; groups that drop their scaling block lose
+        their policy."""
+        key = (job.namespace, job.id)
+        members = root.table("scaling_policies_by_job").get(key)
+        if members is None and not any(tg.scaling is not None
+                                       for tg in job.task_groups):
+            return root         # common case: no policies either side
+        t = root.table("scaling_policies")      # id -> ScalingPolicy
+        changed = False
+        live_ids = set()
+        for tg in job.task_groups:
+            if tg.scaling is None:
+                continue
+            pid = ScalingPolicy.id_for(job.namespace, job.id, tg.name)
+            live_ids.add(pid)
+            existing = t.get(pid)
+            enabled = tg.scaling.enabled and not job.stop
+            if existing is None:
+                root = self._index_add(root, "scaling_policies_by_job",
+                                       key, pid)
+            elif (existing.min, existing.max, existing.policy,
+                  existing.enabled) == (tg.scaling.min, tg.scaling.max,
+                                        tg.scaling.policy, enabled):
+                continue        # unchanged: keep its modify_index
+            t = t.set(pid, ScalingPolicy(
+                id=pid, namespace=job.namespace,
+                target={"Namespace": job.namespace, "Job": job.id,
+                        "Group": tg.name},
+                min=tg.scaling.min, max=tg.scaling.max,
+                policy=dict(tg.scaling.policy),
+                enabled=enabled,
+                create_index=(existing.create_index
+                              if existing is not None else index),
+                modify_index=index))
+            changed = True
+        # stale sweep via the per-job member index — never the whole
+        # table (this runs inside every job-register FSM apply)
+        for pid in list(members.keys()) if members is not None else []:
+            if pid not in live_ids:
+                t = t.delete(pid)
+                root = self._index_del(root, "scaling_policies_by_job",
+                                       key, pid)
+                changed = True
+        if changed:
+            root = root.with_table("scaling_policies", t) \
+                       .with_index("scaling_policies", index)
+        return root
+
+    # -- scaling policies (nomad/scaling_endpoint.go) ------------------
+    def scaling_policies(self, namespace: Optional[str] = None,
+                         job_id: Optional[str] = None,
+                         policy_type: Optional[str] = None
+                         ) -> List[ScalingPolicy]:
+        out = []
+        for pol in self._root.table("scaling_policies").values():
+            if namespace is not None and pol.namespace != namespace:
+                continue
+            if job_id is not None and pol.target.get("Job") != job_id:
+                continue
+            if policy_type is not None and pol.type != policy_type:
+                continue
+            out.append(pol)
+        out.sort(key=lambda p: p.id)
+        return out
+
+    def scaling_policy_by_id(self, policy_id: str
+                             ) -> Optional[ScalingPolicy]:
+        return self._root.table("scaling_policies").get(policy_id)
+
+    def scaling_policy_by_target(self, namespace: str, job_id: str,
+                                 group: str) -> Optional[ScalingPolicy]:
+        return self.scaling_policy_by_id(
+            ScalingPolicy.id_for(namespace, job_id, group))
 
     def delete_job(self, index: int, namespace: str, job_id: str) -> None:
         with self._lock:
@@ -542,6 +625,19 @@ class StateStore(StateSnapshot):
                                    root.table("job_versions").delete(key))
             root = root.with_table("job_summaries",
                                    root.table("job_summaries").delete(key))
+            # deregistration drops the job's scaling policies
+            # (state_store.go deleteJobScalingPolicies)
+            members = root.table("scaling_policies_by_job").get(key)
+            if members is not None:
+                sp = root.table("scaling_policies")
+                for pid in members.keys():
+                    sp = sp.delete(pid)
+                root = root.with_table("scaling_policies", sp) \
+                           .with_table(
+                               "scaling_policies_by_job",
+                               root.table("scaling_policies_by_job")
+                                   .delete(key)) \
+                           .with_index("scaling_policies", index)
             root = root.with_index("jobs", index).with_index("job_summaries", index)
             self._publish(root)
 
@@ -1410,6 +1506,18 @@ class StateStore(StateSnapshot):
             for entry in data["tables"].get("periodic_launches", []):
                 t = t.set(tuple(entry["key"]), entry["launch_time"])
             root = root.with_table("periodic_launches", t)
+
+            t = root.table("scaling_policies")
+            for w in data["tables"].get("scaling_policies", []):
+                p = from_wire(ScalingPolicy, w)
+                t = t.set(p.id, p)
+                root = root.with_table("scaling_policies", t)
+                root = self._index_add(
+                    root, "scaling_policies_by_job",
+                    (p.target.get("Namespace", p.namespace),
+                     p.target.get("Job", "")), p.id)
+                t = root.table("scaling_policies")
+            root = root.with_table("scaling_policies", t)
 
             t = root.table("scaling_events")
             for entry in data["tables"].get("scaling_events", []):

@@ -1,0 +1,177 @@
+"""ScalingPolicy CRUD: policies derived from jobspec scaling blocks,
+stored in the scaling_policies table, served over the autoscaler read
+API (reference: nomad/scaling_endpoint.go:24 ListPolicies / :90
+GetPolicy; nomad/state/schema.go scaling_policy table; policy sync in
+state_store.go on job upsert/delete)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import ApiClient, ApiError, HTTPApiServer
+from nomad_tpu.models import ScalingPolicy
+from nomad_tpu.models.job import Scaling
+from nomad_tpu.server import Server, ServerConfig
+
+
+def _scaled_job(job_id="scaled", min_=1, max_=20, enabled=True):
+    job = mock.job()
+    job.id = job_id
+    tg = job.task_groups[0]
+    tg.count = 3
+    for t in tg.tasks:
+        t.resources.networks = []
+    tg.networks = []
+    tg.scaling = Scaling(enabled=enabled, min=min_, max=max_,
+                         policy={"cooldown": "1m",
+                                 "check": {"source": "prometheus"}})
+    return job
+
+
+@pytest.fixture
+def server():
+    s = Server(ServerConfig(num_schedulers=0, heartbeat_ttl_s=60.0))
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def test_policy_derived_on_register_and_stable_across_updates(server):
+    job = _scaled_job()
+    server.register_job(job)
+    pols = server.store.scaling_policies()
+    assert len(pols) == 1
+    p = pols[0]
+    assert p.target == {"Namespace": "default", "Job": "scaled",
+                        "Group": job.task_groups[0].name}
+    assert (p.min, p.max, p.enabled, p.type) == (1, 20, True, "horizontal")
+    assert p.policy["check"]["source"] == "prometheus"
+    first_id, first_create = p.id, p.create_index
+
+    # re-register with new bounds: same id, create_index preserved,
+    # modify_index advances
+    job2 = _scaled_job(min_=2, max_=50)
+    server.register_job(job2)
+    p2 = server.store.scaling_policy_by_id(first_id)
+    assert p2 is not None
+    assert (p2.min, p2.max) == (2, 50)
+    assert p2.create_index == first_create
+    assert p2.modify_index > p.modify_index
+
+
+def test_policy_disabled_on_stopped_job_and_dropped_on_purge(server):
+    job = _scaled_job("stopme")
+    server.register_job(job)
+    pid = server.store.scaling_policies(job_id="stopme")[0].id
+
+    server.deregister_job("default", "stopme", purge=False)
+    p = server.store.scaling_policy_by_id(pid)
+    assert p is not None and p.enabled is False
+
+    server.deregister_job("default", "stopme", purge=True)
+    assert server.store.scaling_policy_by_id(pid) is None
+    assert server.store.scaling_policies(job_id="stopme") == []
+
+
+def test_policy_removed_when_group_drops_scaling_block(server):
+    job = _scaled_job("dropping")
+    server.register_job(job)
+    assert len(server.store.scaling_policies(job_id="dropping")) == 1
+    job2 = _scaled_job("dropping")
+    job2.task_groups[0].scaling = None
+    server.register_job(job2)
+    assert server.store.scaling_policies(job_id="dropping") == []
+
+
+def test_policy_survives_snapshot_restore(server):
+    job = _scaled_job("persisted")
+    server.register_job(job)
+    pid = server.store.scaling_policies(job_id="persisted")[0].id
+    dump = server.store.snapshot().dump()
+
+    other = Server(ServerConfig(num_schedulers=0, heartbeat_ttl_s=60.0))
+    try:
+        other.store.restore(dump)
+        p = other.store.scaling_policy_by_id(pid)
+        assert p is not None and p.target["Job"] == "persisted"
+    finally:
+        other.shutdown()
+
+
+def test_scaling_api_list_get_and_job_filter(server):
+    api = HTTPApiServer(server, port=0)
+    api.start()
+    try:
+        c = ApiClient(f"http://127.0.0.1:{api.port}")
+        server.register_job(_scaled_job("api-a"))
+        server.register_job(_scaled_job("api-b", min_=5, max_=9))
+
+        stubs = c.list_scaling_policies()
+        assert len(stubs) == 2
+        assert {s["Target"]["Job"] for s in stubs} == {"api-a", "api-b"}
+        # stub shape matches the reference list stub: no Min/Max/Policy
+        assert set(stubs[0]) == {"ID", "Enabled", "Type", "Target",
+                                 "CreateIndex", "ModifyIndex"}
+
+        only_b = c.list_scaling_policies(job="api-b")
+        assert [s["Target"]["Job"] for s in only_b] == ["api-b"]
+
+        full = c.get_scaling_policy(only_b[0]["ID"])
+        assert (full["min"], full["max"]) == (5, 9)
+        assert full["policy"]["cooldown"] == "1m"
+
+        with pytest.raises(ApiError) as e:
+            c.get_scaling_policy("00000000-0000-0000-0000-000000000000")
+        assert e.value.status == 404
+    finally:
+        api.shutdown()
+
+
+def test_deterministic_policy_ids_across_replicas():
+    """FSM-derived ids must be identical on every replica: uuid5 of
+    the target."""
+    a = ScalingPolicy.id_for("default", "web", "api")
+    b = ScalingPolicy.id_for("default", "web", "api")
+    assert a == b
+    assert a != ScalingPolicy.id_for("default", "web", "other")
+
+
+def test_scaling_endpoints_honor_read_job_acl():
+    """A least-privilege autoscaler token (list-jobs/read-job) must be
+    able to read scaling policies; a token without those capabilities
+    must be denied (nomad/scaling_endpoint.go aclObj checks)."""
+    s = Server(ServerConfig(num_schedulers=0, heartbeat_ttl_s=60.0,
+                            acl_enabled=True))
+    s.start()
+    api = HTTPApiServer(s, port=0)
+    api.start()
+    try:
+        boot = ApiClient(f"http://127.0.0.1:{api.port}")
+        root_tok = boot.acl_bootstrap()["secret_id"]
+        mgmt = ApiClient(f"http://127.0.0.1:{api.port}", token=root_tok)
+        mgmt.acl_upsert_policy(
+            "autoscaler",
+            'namespace "default" { capabilities = '
+            '["list-jobs", "read-job", "submit-job"] }')
+        mgmt.acl_upsert_policy("nothing", 'node { policy = "read" }')
+        t_scaler = mgmt.acl_create_token("scaler",
+                                         policies=["autoscaler"])
+        t_nothing = mgmt.acl_create_token("blind", policies=["nothing"])
+
+        scaler = ApiClient(f"http://127.0.0.1:{api.port}",
+                           token=t_scaler["secret_id"])
+        s.register_job(_scaled_job("acl-job"))
+        pols = scaler.list_scaling_policies(job="acl-job")
+        assert len(pols) == 1
+        full = scaler.get_scaling_policy(pols[0]["ID"])
+        assert full["max"] == 20
+
+        blind = ApiClient(f"http://127.0.0.1:{api.port}",
+                          token=t_nothing["secret_id"])
+        with pytest.raises(ApiError) as e:
+            blind.list_scaling_policies()
+        assert e.value.status == 403
+    finally:
+        api.shutdown()
+        s.shutdown()
