@@ -156,6 +156,62 @@ class ApiClient:
                              {"Pause": pause})
 
     # -- allocs / evals ------------------------------------------------
+    def alloc_fs_stream(self, alloc_id: str, path: str = "",
+                        offset: int = 0, task: str = "",
+                        log_type: str = "", wait_s: float = 0.0) -> list:
+        """GET /v1/client/fs/stream/:alloc — framed file/log stream
+        (client/lib/streamframer shape over poll round trips). Returns
+        decoded frames [{File, Offset, Data(bytes), Heartbeat?,
+        FileEvent?}]; resume from the last frame's Offset+len(Data)."""
+        import base64
+        params = {"offset": offset, "wait_s": wait_s}
+        if path:
+            params["path"] = path
+        if task:
+            params["task"] = task
+        if log_type:
+            params["log_type"] = log_type
+        r = self._request("GET", f"/v1/client/fs/stream/{alloc_id}",
+                          params=params)
+        frames = []
+        for f in r.get("Frames", []):
+            f = dict(f)
+            f["Data"] = base64.b64decode(f.get("Data") or "")
+            frames.append(f)
+        return frames
+
+    def alloc_exec_start(self, alloc_id: str, cmd: list,
+                         task: str = "") -> str:
+        """POST /v1/client/allocation/:alloc/exec → session id
+        (AllocExecRequest, client/alloc_endpoint.go:163)."""
+        r = self._request("POST", f"/v1/client/allocation/{alloc_id}/exec",
+                          {"Task": task, "Cmd": list(cmd)})
+        return r["SessionID"]
+
+    def alloc_exec_io(self, alloc_id: str, session_id: str,
+                      stdin: bytes = b"", close_stdin: bool = False,
+                      wait_s: float = 0.0, signal: int = 0) -> dict:
+        """One stdin/stdout round trip of an exec session. Returns
+        {stdout: bytes, stderr: bytes, exited: bool, exit_code: int}."""
+        import base64
+        body = {"Stdin": base64.b64encode(stdin).decode()
+                if stdin else "",
+                "CloseStdin": close_stdin, "WaitS": wait_s}
+        if signal:
+            body["Signal"] = signal
+        r = self._request(
+            "POST", f"/v1/client/allocation/{alloc_id}/exec/{session_id}",
+            body)
+        return {"stdout": base64.b64decode(r.get("Stdout") or ""),
+                "stderr": base64.b64decode(r.get("Stderr") or ""),
+                "exited": bool(r.get("Exited")),
+                "exit_code": int(r.get("ExitCode", -1))}
+
+    def alloc_exec_stop(self, alloc_id: str, session_id: str) -> None:
+        self._request(
+            "DELETE",
+            f"/v1/client/allocation/{alloc_id}/exec/{session_id}")
+
     def get_allocation(self, alloc_id: str) -> dict:
         return self._request("GET", f"/v1/allocation/{alloc_id}")
 

@@ -186,6 +186,11 @@ class HTTPApiServer:
             else:
                 need(acl.allow_namespace_operation(ns, "read-fs"))
             return
+        if path.startswith("/v1/client/allocation/"):
+            # remote command execution is its own capability
+            # (acl.NamespaceCapabilityAllocExec)
+            need(acl.allow_namespace_operation(ns, "alloc-exec"))
+            return
         if path == "/v1/volumes" or path.startswith("/v1/volume/"):
             need(acl.allow_namespace_operation(
                 ns, "csi-write-volume" if write else "csi-read-volume"))
@@ -527,9 +532,27 @@ class HTTPApiServer:
             rpc = getattr(s, "rpc_server", None)
             return (rpc.addr if rpc is not None else "127.0.0.1:4647"), idx
 
-        m = re.match(r"^/v1/client/fs/(logs|ls|cat)/([^/]+)$", path)
+        m = re.match(r"^/v1/client/fs/(logs|ls|cat|stream)/([^/]+)$", path)
         if m and method == "GET":
             return self._client_fs(m.group(1), m.group(2), q, ns, idx)
+
+        # alloc exec sessions (client/alloc_endpoint.go:163): start
+        # returns a session id; io round-trips stdin/stdout frames
+        m = re.match(r"^/v1/client/allocation/([^/]+)/exec$", path)
+        if m and method in ("PUT", "POST"):
+            return self._client_exec_start(m.group(1), body_fn(), ns, idx)
+        m = re.match(r"^/v1/client/allocation/([^/]+)/exec/([^/]+)$", path)
+        if m:
+            if method in ("PUT", "POST"):
+                return self._client_exec_io(m.group(1), m.group(2),
+                                            body_fn(), ns, idx)
+            if method == "DELETE":
+                alloc = self._alloc_in_ns(m.group(1), ns)
+                if alloc is None:
+                    return None
+                self._forward_client(alloc, "ClientExec.Stop",
+                                     {"session_id": m.group(2)})
+                return {}, idx
 
         if path == "/v1/volumes" and method == "GET":
             vols = store.csi_volumes(ns)
@@ -666,80 +689,160 @@ class HTTPApiServer:
                 return p
         return None
 
-    def _client_fs(self, op: str, alloc_prefix: str, q: dict, ns: str,
-                   idx: int):
-        """/v1/client/fs/{logs,ls,cat} (client/fs_endpoint.go): serve a
-        co-located alloc's log files and directory tree. The alloc must
-        live in the request's (ACL-checked) namespace."""
-        alloc = self._unique_prefix(
+    def _alloc_in_ns(self, alloc_prefix: str, ns: str):
+        return self._unique_prefix(
             [a for a in self.server.store.allocs() if a.namespace == ns],
             alloc_prefix, "allocation")
+
+    def _forward_client(self, alloc, method: str, args: dict):
+        """Forward a logs/fs/exec request to the OWNING client's RPC
+        listener (nomad/client_fs_endpoint.go: servers proxy these to
+        the node; the client advertises its address on the Node
+        record). Connections are cached per address."""
+        node = self.server.store.node_by_id(alloc.node_id)
+        addr = node.attributes.get("nomad.client.rpc") if node else None
+        if not addr:
+            raise KeyError(
+                f"alloc {alloc.id[:8]}'s node has no reachable client "
+                "RPC address")
+        from ..rpc.client import RpcClient
+        cache = getattr(self, "_client_rpc_cache", None)
+        if cache is None:
+            cache = self._client_rpc_cache = {}
+        # keyed by node id: a restarted client re-advertises on a new
+        # ephemeral port, and the stale connection must be closed and
+        # replaced instead of accumulating per historical address
+        hit = cache.get(alloc.node_id)
+        if hit is None or hit[0] != addr:
+            if hit is not None:
+                try:
+                    hit[1].close()
+                except Exception:
+                    pass
+            hit = (addr, RpcClient(addr, dial_timeout_s=2.0))
+            cache[alloc.node_id] = hit
+        args = dict(args)
+        args["alloc_id"] = alloc.id
+        return hit[1].call(method, args, timeout_s=60.0)
+
+    def _default_task(self, alloc, task: str) -> str:
+        if task:
+            return task
+        tg = alloc.job.lookup_task_group(alloc.task_group) \
+            if alloc.job else None
+        if tg and len(tg.tasks) == 1:
+            return tg.tasks[0].name
+        raise ValueError("task parameter required")
+
+    def _client_fs(self, op: str, alloc_prefix: str, q: dict, ns: str,
+                   idx: int):
+        """/v1/client/fs/{logs,ls,cat,stream} (client/fs_endpoint.go):
+        serve an alloc's log files and directory tree — from the local
+        alloc dir when co-located, else forwarded to the owning client
+        over RPC. The alloc must live in the request's (ACL-checked)
+        namespace."""
+        import base64
+
+        from ..client import fs_service
+        alloc = self._alloc_in_ns(alloc_prefix, ns)
         if alloc is None:
             return None
         base = self._alloc_base(alloc.id)
-        if base is None:
-            raise KeyError(f"alloc dir for {alloc.id[:8]} not found "
-                           f"on this agent")
+        offset = int(q.get("offset", 0))
         if op == "logs":
-            task = q.get("task", "")
-            if not task:
-                tg = alloc.job.lookup_task_group(alloc.task_group) \
-                    if alloc.job else None
-                if tg and len(tg.tasks) == 1:
-                    task = tg.tasks[0].name
-                else:
-                    raise ValueError("task parameter required")
+            task = self._default_task(alloc, q.get("task", ""))
             stream = q.get("type", "stdout")
-            log_dir = os.path.join(base, "alloc", "logs")
-            try:
-                names = sorted(
-                    (f for f in os.listdir(log_dir)
-                     if f.startswith(f"{task}.{stream}.")),
-                    key=lambda f: int(f.rsplit(".", 1)[1]))
-            except (FileNotFoundError, ValueError):
-                names = []
-            # offset-aware: stat sizes, open/seek only the tail files
-            # instead of joining every rotated file per poll
-            offset = int(q.get("offset", 0))
-            paths = [os.path.join(log_dir, f) for f in names]
-            sizes = [os.path.getsize(p) for p in paths]
-            total = sum(sizes)
-            chunks = []
-            skip = offset
-            for p, size in zip(paths, sizes):
-                if skip >= size:
-                    skip -= size
-                    continue
-                with open(p, "rb") as f:
-                    if skip:
-                        f.seek(skip)
-                        skip = 0
-                    chunks.append(f.read())
-            data = b"".join(chunks)
+            if base is not None:
+                data, total = fs_service.read_logs(base, task, stream,
+                                                   offset)
+            else:
+                r = self._forward_client(
+                    alloc, "ClientFS.Logs",
+                    {"task": task, "type": stream, "offset": offset})
+                data, total = bytes(r.get("Data") or b""), r["Offset"]
             return {"Data": data.decode("utf-8", "replace"),
                     "Offset": total}, idx
-        rel = q.get("path", "/").lstrip("/")
-        target = os.path.realpath(os.path.join(base, rel))
-        real_base = os.path.realpath(base)
-        if target != real_base and \
-                not target.startswith(real_base + os.sep):
-            raise ValueError("path escapes the alloc dir")
-        if op == "ls":
-            if not os.path.isdir(target):
-                return None
+        if op == "stream":
+            log_type = q.get("log_type", "")
+            task = self._default_task(alloc, q.get("task", "")) \
+                if log_type else q.get("task", "")
+            wait_s = min(float(q.get("wait_s", 0.0)), 30.0)
+            if base is not None:
+                frames = fs_service.stream_frames(
+                    base, q.get("path"), offset, task=task,
+                    log_type=log_type, wait_s=wait_s)
+            else:
+                r = self._forward_client(
+                    alloc, "ClientFS.Stream",
+                    {"path": q.get("path"), "offset": offset,
+                     "task": task, "log_type": log_type,
+                     "wait_s": wait_s})
+                frames = r["Frames"]
             out = []
-            for name in sorted(os.listdir(target)):
-                p = os.path.join(target, name)
-                out.append({"Name": name,
-                            "IsDir": os.path.isdir(p),
-                            "Size": os.path.getsize(p)
-                            if os.path.isfile(p) else 0})
-            return out, idx
+            for f in frames:
+                f = dict(f)
+                f["Data"] = base64.b64encode(
+                    bytes(f.get("Data") or b"")).decode()
+                out.append(f)
+            return {"Frames": out}, idx
+        rel = q.get("path", "/")
+        if op == "ls":
+            if base is not None:
+                entries = fs_service.list_dir(base, rel)
+            else:
+                entries = self._forward_client(
+                    alloc, "ClientFS.List", {"path": rel})["Entries"]
+            return (entries, idx) if entries is not None else None
         # cat
-        if not os.path.isfile(target):
+        if base is not None:
+            data = fs_service.cat_file(base, rel)
+        else:
+            data = self._forward_client(
+                alloc, "ClientFS.Cat", {"path": rel})["Data"]
+        if data is None:
             return None
-        with open(target, "rb") as f:
-            return {"Data": f.read().decode("utf-8", "replace")}, idx
+        return {"Data": bytes(data).decode("utf-8", "replace")}, idx
+
+    def _client_exec_start(self, alloc_prefix: str, body: dict, ns: str,
+                           idx: int):
+        """POST /v1/client/allocation/:alloc/exec — start a command in
+        the task environment (AllocExecRequest,
+        client/alloc_endpoint.go:163). Always routed through the
+        owning client's RPC listener (co-located included) so one code
+        path serves every topology."""
+        alloc = self._alloc_in_ns(alloc_prefix, ns)
+        if alloc is None:
+            return None
+        task = self._default_task(alloc, body.get("Task")
+                                  or body.get("task") or "")
+        cmd = body.get("Cmd") or body.get("cmd") or []
+        r = self._forward_client(alloc, "ClientExec.Start",
+                                 {"task": task, "cmd": list(cmd)})
+        return {"SessionID": r["session_id"]}, idx
+
+    def _client_exec_io(self, alloc_prefix: str, sid: str, body: dict,
+                        ns: str, idx: int):
+        import base64
+        alloc = self._alloc_in_ns(alloc_prefix, ns)
+        if alloc is None:
+            return None
+        stdin_b64 = body.get("Stdin") or body.get("stdin") or ""
+        args = {"session_id": sid,
+                "stdin": base64.b64decode(stdin_b64) if stdin_b64 else b"",
+                "close_stdin": bool(body.get("CloseStdin")
+                                    or body.get("close_stdin")),
+                "wait_s": min(float(body.get("WaitS")
+                                    or body.get("wait_s") or 0.0), 30.0)}
+        sig = body.get("Signal") or body.get("signal")
+        if sig:
+            args["signal"] = int(sig)
+        r = self._forward_client(alloc, "ClientExec.Io", args)
+        return {"Stdout": base64.b64encode(
+                    bytes(r.get("stdout") or b"")).decode(),
+                "Stderr": base64.b64encode(
+                    bytes(r.get("stderr") or b"")).decode(),
+                "Exited": bool(r.get("exited")),
+                "ExitCode": int(r.get("exit_code", -1))}, idx
 
     def stream_monitor(self, handler, q: dict):
         """/v1/agent/monitor (agent_endpoint.go monitor): stream agent

@@ -624,6 +624,46 @@ def cmd_alloc_fs(args) -> int:
     return 0
 
 
+def cmd_alloc_exec(args) -> int:
+    """`nomad alloc exec` (command/alloc_exec.go): run a command inside
+    the task environment, stdin piped through, stdout/stderr relayed
+    until exit."""
+    c = _client(args)
+    cmd = [a for a in args.cmd if a != "--"]
+    if not cmd:
+        print("Error: a command is required", file=sys.stderr)
+        return 1
+    try:
+        sid = c.alloc_exec_start(args.alloc_id, cmd, task=args.task)
+    except ApiError as e:
+        print(f"Error starting exec: {e}", file=sys.stderr)
+        return 1
+    stdin = b""
+    if not sys.stdin.isatty():
+        stdin = sys.stdin.buffer.read()
+    sent = False
+    code = 1
+    try:
+        while True:
+            out = c.alloc_exec_io(args.alloc_id, sid,
+                                  stdin=stdin if not sent else b"",
+                                  close_stdin=not sent, wait_s=1.0)
+            sent = True
+            if out["stdout"]:
+                sys.stdout.buffer.write(out["stdout"])
+                sys.stdout.buffer.flush()
+            if out["stderr"]:
+                sys.stderr.buffer.write(out["stderr"])
+                sys.stderr.buffer.flush()
+            if out["exited"]:
+                code = out["exit_code"]
+                break
+    except KeyboardInterrupt:
+        c.alloc_exec_stop(args.alloc_id, sid)
+        return 130
+    return code
+
+
 def cmd_operator_raft(args) -> int:
     c = _client(args)
     out = c._request("GET", "/v1/operator/raft/configuration")
@@ -805,6 +845,13 @@ def build_parser() -> argparse.ArgumentParser:
     afs.add_argument("alloc_id")
     afs.add_argument("path", nargs="?", default="/")
     afs.set_defaults(fn=cmd_alloc_fs)
+    aexec = alloc.add_parser("exec")
+    aexec.add_argument("-task", default="")
+    aexec.add_argument("alloc_id")
+    # REMAINDER: flag-bearing commands (`alloc exec <id> ls -l`) must
+    # pass through untouched
+    aexec.add_argument("cmd", nargs=argparse.REMAINDER)
+    aexec.set_defaults(fn=cmd_alloc_exec)
 
     ev = sub.add_parser("eval").add_subparsers(dest="sub")
     estatus = ev.add_parser("status")

@@ -58,6 +58,15 @@ class ClientConfig:
     # drivers to run behind the plugin PROCESS boundary
     # (plugins/driver_client.py; go-plugin analog) instead of in-proc
     plugin_drivers: tuple = ()
+    # client RPC listener serving logs/fs/exec to forwarding servers
+    # (client/fs_endpoint.go, client/alloc_endpoint.go); port 0 picks
+    # an ephemeral port, None disables the listener. rpc_host is the
+    # bind address; rpc_advertise is what goes on the node record for
+    # servers to dial (cross-host deployments must set it to a
+    # reachable address — loopback only works single-machine)
+    rpc_port: Optional[int] = 0
+    rpc_host: str = "127.0.0.1"
+    rpc_advertise: str = ""
 
 
 def fingerprint_accelerator_devices():
@@ -466,6 +475,22 @@ class Client:
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
         self.node.status = NODE_STATUS_READY
+        # the logs/fs/exec service: servers forward remote requests to
+        # this listener; its address rides the node record so any
+        # server can find the owning client (the reference advertises
+        # client ports on the Node the same way)
+        if self.config.rpc_port is not None:
+            from ..rpc.server import RpcServer
+            from .remote import ClientRpcService
+            self.rpc_service = ClientRpcService(self)
+            self.rpc_server = RpcServer(
+                host=self.config.rpc_host,
+                port=self.config.rpc_port,
+                methods=self.rpc_service.rpc_methods())
+            self.rpc_server.start()
+            advertise = self.config.rpc_advertise or \
+                f"{self.config.rpc_host}:{self.rpc_server.port}"
+            self.node.attributes["nomad.client.rpc"] = advertise
         self.transport.register_node(self.node)
         self.transport.update_node_status(self.node.id, NODE_STATUS_READY)
         self._restore_state()
@@ -474,6 +499,16 @@ class Client:
         self._threads = [t1, t2]
         t1.start()
         t2.start()
+
+    def alloc_base(self, alloc_id: str) -> Optional[str]:
+        """Filesystem base of one alloc's dir tree on this node, or
+        None when the alloc doesn't live here."""
+        runner = self.runners.get(alloc_id)
+        if runner is not None:
+            return runner.alloc_dir.base
+        from .allocdir import AllocDir
+        base = AllocDir(self.config.alloc_dir, alloc_id).base
+        return base if os.path.isdir(base) else None
 
     def _restore_state(self) -> None:
         """Rebuild alloc runners from the state DB, re-attaching to live
@@ -538,6 +573,9 @@ class Client:
                 r.stop()
         for t in self._threads:
             t.join(timeout=2)
+        rpc = getattr(self, "rpc_server", None)
+        if rpc is not None:
+            rpc.shutdown()
         close = getattr(self.transport, "close", None)
         if close is not None:
             close()

@@ -58,12 +58,18 @@ class RotatingWriter:
 
 
 def pump(stream, writer: RotatingWriter) -> threading.Thread:
-    """Read a subprocess pipe into the rotating writer until EOF."""
+    """Read a subprocess pipe into the rotating writer until EOF.
+    Uses read1 so partial output lands in the log file as the task
+    produces it — a buffered read(4096) would sit on a live pipe until
+    4KB accumulate or the task exits, making `alloc logs -f` blind to
+    everything a long-running task has printed so far."""
+    read1 = getattr(stream, "read1", None)
 
     def run():
         try:
             while True:
-                chunk = stream.read(4096)
+                chunk = read1(4096) if read1 is not None \
+                    else stream.read(4096)
                 if not chunk:
                     break
                 writer.write(chunk)
