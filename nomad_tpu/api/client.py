@@ -286,5 +286,20 @@ class ApiClient:
     def acl_token_self(self) -> dict:
         return self._request("GET", "/v1/acl/token/self")
 
+    def list_event_sinks(self) -> list:
+        return self._request("GET", "/v1/event/sinks")
+
+    def upsert_event_sink(self, address: str, sink_id: str = "",
+                          topics: Optional[dict] = None,
+                          type_: str = "webhook") -> dict:
+        body = {"Address": address, "Type": type_,
+                "Topics": topics or {}}
+        if sink_id:
+            body["ID"] = sink_id
+        return self._request("PUT", "/v1/event/sink", body)
+
+    def delete_event_sink(self, sink_id: str) -> dict:
+        return self._request("DELETE", f"/v1/event/sink/{sink_id}")
+
     def scheduler_config(self) -> dict:
         return self._request("GET", "/v1/operator/scheduler/configuration")

@@ -210,7 +210,8 @@ class HTTPApiServer:
         if path.startswith("/v1/agent") or path == "/v1/metrics":
             need(acl.allow_agent_write() if write else acl.allow_agent_read())
             return
-        if path.startswith("/v1/operator"):
+        if path.startswith(("/v1/operator", "/v1/event/sink")):
+            # sink CRUD is an operator surface (event_sink_manager.go)
             need(acl.allow_operator_write() if write
                  else acl.allow_operator_read())
             return
@@ -353,6 +354,38 @@ class HTTPApiServer:
             if sub == "deployments":
                 return [to_wire(d)
                         for d in store.deployments_by_job(ns, job_id)], idx
+
+        # durable event sinks (nomad/stream/sink.go CRUD)
+        if path == "/v1/event/sinks" and method == "GET":
+            return [sk.stub() for sk in store.event_sinks()], idx
+        if path == "/v1/event/sink" and method in ("PUT", "POST"):
+            from ..server.event_sink import EventSink
+            from ..utils.ids import generate_uuid
+            data = body_fn()
+            sink = EventSink(
+                id=data.get("ID") or data.get("id") or generate_uuid(),
+                type=data.get("Type") or data.get("type") or "webhook",
+                address=data.get("Address") or data.get("address") or "",
+                topics=data.get("Topics") or data.get("topics") or {},
+                latest_index=int(data.get("LatestIndex")
+                                 or data.get("latest_index") or 0))
+            if not sink.address:
+                raise ValueError("event sink requires an address")
+            from ..server.event_sink import SINK_WEBHOOK
+            if sink.type != SINK_WEBHOOK:
+                raise ValueError(
+                    f"unsupported sink type {sink.type!r}; "
+                    f"supported: {SINK_WEBHOOK}")
+            s.upsert_event_sink(sink)
+            return {"ID": sink.id}, store.latest_index()
+        m = re.match(r"^/v1/event/sink/([^/]+)$", path)
+        if m:
+            if method == "GET":
+                sink = store.event_sink(m.group(1))
+                return (sink.stub(), idx) if sink else None
+            if method == "DELETE":
+                s.delete_event_sink(m.group(1))
+                return {}, store.latest_index()
 
         # autoscaling API: the external autoscaler's read surface
         # (nomad/scaling_endpoint.go:24 ListPolicies, :90 GetPolicy)

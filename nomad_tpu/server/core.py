@@ -91,6 +91,8 @@ class Server:
         self.deployments_watcher = DeploymentsWatcher(self)
         self.node_drainer = NodeDrainer(self)
         self.events = EventBroker()
+        from .event_sink import EventSinkManager
+        self.event_sinks = EventSinkManager(self)
         self.workers: List[Worker] = []
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._hb_lock = threading.Lock()
@@ -207,6 +209,7 @@ class Server:
         self.periodic.set_enabled(False)
         self.deployments_watcher.set_enabled(False)
         self.node_drainer.set_enabled(False)
+        self.event_sinks.set_enabled(False)
         with self._hb_lock:
             for t in self._heartbeat_timers.values():
                 t.cancel()
@@ -268,6 +271,7 @@ class Server:
         if self.raft is not None:
             self.raft.stop()
         self._leader = False
+        self.event_sinks.set_enabled(False)
         self.deployments_watcher.set_enabled(False)
         self.node_drainer.set_enabled(False)
         self.periodic.stop()
@@ -301,6 +305,9 @@ class Server:
                 self.periodic.add(job)
         self.deployments_watcher.set_enabled(True)
         self.node_drainer.set_enabled(True)
+        # durable event sinks are a leader duty: workers resume from
+        # each sink's raft-committed progress (event_sink_manager.go)
+        self.event_sinks.set_enabled(True)
 
     def _reap_failed_evals(self) -> None:
         """Drain the broker's failed queue: mark the eval failed and
@@ -800,6 +807,23 @@ class Server:
                        error=error, eval_id=ev.id if ev else "",
                        time=int(time.time()))))
         return ev
+
+    # -- event sinks (nomad/stream/sink.go + event_sink_manager.go) ----
+    def upsert_event_sink(self, sink) -> int:
+        return self.raft_apply("event_sink_upsert", dict(sink=sink))
+
+    def delete_event_sink(self, sink_id: str) -> int:
+        return self.raft_apply("event_sink_delete", dict(sink_id=sink_id))
+
+    def _apply_event_sink_upsert(self, index: int, p: dict) -> None:
+        self.store.upsert_event_sink(index, p["sink"])
+
+    def _apply_event_sink_delete(self, index: int, p: dict) -> None:
+        self.store.delete_event_sink(index, p["sink_id"])
+
+    def _apply_event_sink_progress(self, index: int, p: dict) -> None:
+        self.store.update_event_sink_progress(index, p["sink_id"],
+                                              int(p["index"]))
 
     def _apply_scaling_event(self, index: int, p: dict) -> None:
         self.store.add_scaling_event(index, p["namespace"], p["job_id"],

@@ -56,6 +56,9 @@ class Subscription:
         self._queue: List[Event] = []
         self._max = max_queued
         self.closed = False
+        # set when the slow-consumer drop fires: consumers that promise
+        # at-least-once (event sinks) must see overflow, not silence
+        self.overflowed = False
 
     def deliver(self, events: List[Event]) -> None:
         matched = [e for e in events if e.matches(self.topics)]
@@ -66,6 +69,7 @@ class Subscription:
             if len(self._queue) > self._max:
                 # drop oldest — a slow consumer must not block the broker
                 del self._queue[:len(self._queue) - self._max]
+                self.overflowed = True
             self._cond.notify_all()
 
     def next_events(self, timeout_s: float = 10.0) -> List[Event]:
@@ -90,6 +94,9 @@ class EventBroker:
         self._size = size
         self._subs: List[Subscription] = []
         self.latest_index = 0
+        # highest index ever dropped off the ring: a consumer resuming
+        # from progress <= trimmed_through has a PROVEN replay gap
+        self.trimmed_through = 0
 
     def publish(self, events: List[Event]) -> None:
         if not events:
@@ -97,7 +104,10 @@ class EventBroker:
         with self._l:
             self._buffer.extend(events)
             if len(self._buffer) > self._size:
-                del self._buffer[:len(self._buffer) - self._size]
+                drop = len(self._buffer) - self._size
+                self.trimmed_through = max(self.trimmed_through,
+                                           self._buffer[drop - 1].index)
+                del self._buffer[:drop]
             self.latest_index = max(self.latest_index,
                                     max(e.index for e in events))
             subs = list(self._subs)
@@ -105,16 +115,18 @@ class EventBroker:
             s.deliver(events)
 
     def subscribe(self, topics: Optional[Dict[str, List[str]]] = None,
-                  from_index: int = 0) -> Tuple[Subscription, List[Event]]:
+                  from_index: int = 0,
+                  max_queued: int = 1024) -> Tuple[Subscription, List[Event]]:
         """Returns the subscription plus any buffered events newer than
         from_index (replay for late joiners)."""
         topics = topics or {TOPIC_ALL: [ALL_KEYS]}
-        sub = Subscription(self, topics)
+        sub = Subscription(self, topics, max_queued=max_queued)
         with self._l:
             backlog = [e for e in self._buffer
                        if e.index > from_index and e.matches(topics)]
             self._subs.append(sub)
         return sub, backlog
+
 
     def _remove(self, sub: Subscription) -> None:
         with self._l:

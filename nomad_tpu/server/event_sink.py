@@ -1,0 +1,238 @@
+"""Durable event sinks: at-least-once webhook delivery of the event
+stream with raft-committed progress.
+
+Reference semantics: nomad/stream/sink.go (SinkWriter + progress),
+nomad/stream/webhook_sink.go (NDJSON POST), nomad/event_sink_manager.go
+(the leader runs one managed writer per registered sink; progress is
+periodically committed through raft so a new leader resumes where the
+old one stopped — redelivery of the tail is allowed, loss is not).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+LOG = logging.getLogger("nomad_tpu.event_sink")
+
+SINK_WEBHOOK = "webhook"
+
+PROGRESS_COMMIT_EVERY_S = 2.0
+RETRY_BASE_S = 0.5
+RETRY_MAX_S = 15.0
+
+
+@dataclass
+class EventSink:
+    """structs.EventSink (nomad/stream/sink.go)."""
+    id: str = ""
+    type: str = SINK_WEBHOOK
+    address: str = ""               # webhook URL
+    # topic -> keys filter, same shape the broker's subscriptions use
+    topics: Dict[str, List[str]] = field(default_factory=dict)
+    latest_index: int = 0           # committed delivery progress
+    create_index: int = 0
+    modify_index: int = 0
+
+    def stub(self) -> Dict:
+        return {"ID": self.id, "Type": self.type, "Address": self.address,
+                "Topics": dict(self.topics),
+                "LatestIndex": self.latest_index,
+                "CreateIndex": self.create_index,
+                "ModifyIndex": self.modify_index}
+
+
+def _post_ndjson(address: str, events: List, timeout_s: float) -> None:
+    from ..utils.codec import to_wire
+    body = "".join(json.dumps(to_wire(e)) + "\n"
+                   for e in events).encode()
+    req = urllib.request.Request(
+        address, data=body, method="POST",
+        headers={"Content-Type": "application/x-ndjson"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        if resp.status >= 300:
+            raise RuntimeError(f"webhook returned {resp.status}")
+
+
+class _SinkWorker:
+    """One managed writer: broker subscription from the sink's
+    committed progress, delivery with retry/backoff, periodic progress
+    commits through raft."""
+
+    def __init__(self, manager: "EventSinkManager", sink: EventSink):
+        self.manager = manager
+        self.sink = sink
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"event-sink-{sink.id[:8]}")
+        self._delivered_index = sink.latest_index
+        self._committed_index = sink.latest_index
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _lost_marker(self, reason: str):
+        """A synthetic frame telling the consumer events were
+        unrecoverable — loss past the broker's replay horizon can
+        happen (restart with cold buffer, consumer slower than the
+        ring), but it must never happen SILENTLY."""
+        from .event_broker import Event
+        from ..utils import metrics
+        metrics.incr_counter("nomad.event_sink.events_lost")
+        LOG.warning("sink %s: events lost (%s)", self.sink.id[:8], reason)
+        return Event(topic="_sink", type="EventsLost", key=self.sink.id,
+                     index=self._delivered_index,
+                     payload={"reason": reason})
+
+    def _subscribe(self, server):
+        """(sub, initial_pending) from the committed progress, with
+        replay-gap detection: trimmed_through is the highest index the
+        broker has PROVABLY dropped, so progress at or below it means
+        events are unrecoverable. A fresh broker whose event history
+        starts after our progress (server restarted; replay does not
+        republish events) is flagged once too."""
+        topics = self.sink.topics or None
+        sub, backlog = server.events.subscribe(
+            topics, from_index=self._delivered_index, max_queued=8192)
+        pending: List = []
+        if self._delivered_index > 0:
+            trimmed = server.events.trimmed_through
+            if trimmed > self._delivered_index:
+                pending.append(self._lost_marker(
+                    f"ring buffer trimmed through index {trimmed}, "
+                    f"progress was {self._delivered_index}"))
+            elif server.events.latest_index == 0 and \
+                    server.store.latest_index() > self._delivered_index:
+                pending.append(self._lost_marker(
+                    "progress predates this server's event history"))
+        pending.extend(backlog)
+        return sub, pending
+
+    def _run(self) -> None:
+        server = self.manager.server
+        sub, pending = self._subscribe(server)
+        try:
+            last_commit = time.monotonic()
+            backoff = RETRY_BASE_S
+            while not self._stop.is_set():
+                if sub.overflowed:
+                    # slow-consumer drop: resubscribe from delivered
+                    # progress — the ring usually still covers it, and
+                    # _subscribe marks the loss if it doesn't
+                    sub.unsubscribe()
+                    sub, replay = self._subscribe(server)
+                    pending.extend(e for e in replay
+                                   if e.index > self._delivered_index
+                                   or e.type == "EventsLost")
+                if not pending:
+                    fresh = sub.next_events(timeout_s=0.5)
+                    pending = [e for e in fresh
+                               if e.index > self._delivered_index]
+                if pending:
+                    try:
+                        _post_ndjson(self.sink.address, pending,
+                                     timeout_s=10.0)
+                        self._delivered_index = max(
+                            self._delivered_index,
+                            max(e.index for e in pending))
+                        pending = []
+                        backoff = RETRY_BASE_S
+                    except Exception as e:
+                        LOG.warning("sink %s delivery failed: %s "
+                                    "(retrying)", self.sink.id[:8], e)
+                        if self._stop.wait(backoff):
+                            break
+                        backoff = min(backoff * 2, RETRY_MAX_S)
+                        continue
+                now = time.monotonic()
+                if self._delivered_index > self._committed_index and \
+                        now - last_commit >= PROGRESS_COMMIT_EVERY_S:
+                    last_commit = now
+                    if self._commit_progress():
+                        self._committed_index = self._delivered_index
+        finally:
+            sub.unsubscribe()
+            # best-effort final progress commit on clean shutdown
+            if self._delivered_index > self._committed_index:
+                self._commit_progress()
+
+    def _commit_progress(self) -> bool:
+        try:
+            self.manager.server.raft_apply(
+                "event_sink_progress",
+                dict(sink_id=self.sink.id,
+                     index=self._delivered_index))
+            return True
+        except Exception as e:
+            LOG.warning("sink %s progress commit failed: %s",
+                        self.sink.id[:8], e)
+            return False
+
+
+class EventSinkManager:
+    """Leader-only lifecycle of sink workers (event_sink_manager.go):
+    enabled on establishLeadership, disabled on revoke; watches the
+    sink set and reconciles workers."""
+
+    def __init__(self, server):
+        self.server = server
+        self._l = threading.Lock()
+        self._enabled = False
+        self._gen = 0               # retires stale watcher threads
+        self._workers: Dict[str, _SinkWorker] = {}
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._l:
+            if enabled == self._enabled:
+                return
+            self._enabled = enabled
+            self._gen += 1
+            if not enabled:
+                for w in self._workers.values():
+                    w.stop()
+                self._workers.clear()
+                return
+            threading.Thread(target=self._watch, args=(self._gen,),
+                             daemon=True,
+                             name="event-sink-mgr").start()
+
+    def _watch(self, gen: int) -> None:
+        # generation guard (the drainer's pattern): a leadership flap
+        # inside our sleep must retire THIS thread, or every flap
+        # leaks one reconciler forever
+        while True:
+            with self._l:
+                if not self._enabled or self._gen != gen:
+                    return
+            try:
+                self.reconcile()
+            except Exception:       # pragma: no cover - defensive
+                LOG.exception("sink reconcile failed")
+            time.sleep(1.0)
+
+    def reconcile(self) -> None:
+        sinks = {s.id: s for s in self.server.store.event_sinks()}
+        with self._l:
+            if not self._enabled:
+                return
+            for sid in list(self._workers):
+                w = self._workers[sid]
+                cur = sinks.get(sid)
+                if cur is None or cur.address != w.sink.address or \
+                        cur.topics != w.sink.topics:
+                    w.stop()
+                    del self._workers[sid]
+            for sid, sink in sinks.items():
+                if sid not in self._workers:
+                    w = _SinkWorker(self, sink)
+                    self._workers[sid] = w
+                    w.start()

@@ -52,6 +52,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
                                  "evals": [Evaluation]},
     "job_stability": {},
     "scaling_event": {},
+    "noop": {},
     "deployment_delete": {},
     "periodic_launch": {},
 }
@@ -71,6 +72,12 @@ def _register_acl_schemas() -> None:
         "csi_volume_deregister": {},
         "csi_volume_claim": {},
         "csi_volume_release": {},
+    })
+    from .event_sink import EventSink
+    SCHEMAS.update({
+        "event_sink_upsert": {"sink": EventSink},
+        "event_sink_delete": {},
+        "event_sink_progress": {},
     })
 
 

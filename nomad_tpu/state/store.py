@@ -310,6 +310,8 @@ class StateSnapshot:
             for k, v in root.table("scaling_events").items()]
         plain["scaling_policies"] = [
             to_wire(p) for p in root.table("scaling_policies").values()]
+        plain["event_sinks"] = [
+            to_wire(s) for s in root.table("event_sinks").values()]
         plain["acl_policies"] = [to_wire(p) for p in
                                  root.table("acl_policies").values()]
         plain["acl_tokens"] = [to_wire(t) for t in
@@ -608,6 +610,55 @@ class StateStore(StateSnapshot):
                                  group: str) -> Optional[ScalingPolicy]:
         return self.scaling_policy_by_id(
             ScalingPolicy.id_for(namespace, job_id, group))
+
+    # -- event sinks (nomad/stream/sink.go; event_sinks table) ---------
+    def upsert_event_sink(self, index: int, sink) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("event_sinks")
+            existing = t.get(sink.id)
+            if existing is not None:
+                sink.create_index = existing.create_index
+                # progress survives reconfiguration
+                sink.latest_index = max(sink.latest_index,
+                                        existing.latest_index)
+            else:
+                sink.create_index = index
+            sink.modify_index = index
+            root = root.with_table("event_sinks", t.set(sink.id, sink)) \
+                       .with_index("event_sinks", index)
+            self._publish(root)
+
+    def delete_event_sink(self, index: int, sink_id: str) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("event_sinks")
+            if t.get(sink_id) is None:
+                return
+            root = root.with_table("event_sinks", t.delete(sink_id)) \
+                       .with_index("event_sinks", index)
+            self._publish(root)
+
+    def update_event_sink_progress(self, index: int, sink_id: str,
+                                   latest: int) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("event_sinks")
+            sink = t.get(sink_id)
+            if sink is None or sink.latest_index >= latest:
+                return
+            from dataclasses import replace as _replace
+            sink = _replace(sink, latest_index=latest, modify_index=index)
+            root = root.with_table("event_sinks", t.set(sink_id, sink)) \
+                       .with_index("event_sinks", index)
+            self._publish(root)
+
+    def event_sinks(self) -> List:
+        return sorted(self._root.table("event_sinks").values(),
+                      key=lambda s: s.id)
+
+    def event_sink(self, sink_id: str):
+        return self._root.table("event_sinks").get(sink_id)
 
     def delete_job(self, index: int, namespace: str, job_id: str) -> None:
         with self._lock:
@@ -1518,6 +1569,13 @@ class StateStore(StateSnapshot):
                      p.target.get("Job", "")), p.id)
                 t = root.table("scaling_policies")
             root = root.with_table("scaling_policies", t)
+
+            from ..server.event_sink import EventSink
+            t = root.table("event_sinks")
+            for w in data["tables"].get("event_sinks", []):
+                s = from_wire(EventSink, w)
+                t = t.set(s.id, s)
+            root = root.with_table("event_sinks", t)
 
             t = root.table("scaling_events")
             for entry in data["tables"].get("scaling_events", []):
