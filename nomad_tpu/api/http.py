@@ -376,6 +376,15 @@ class HTTPApiServer:
                 raise ValueError(
                     f"unsupported sink type {sink.type!r}; "
                     f"supported: {SINK_WEBHOOK}")
+            # a malformed topics filter must be rejected here — a
+            # non-dict filter raises inside the broker's publish loop
+            # and would break delivery for every OTHER subscriber
+            if not isinstance(sink.topics, dict) or not all(
+                    isinstance(k, str) and isinstance(v, (list, tuple))
+                    and all(isinstance(x, str) for x in v)
+                    for k, v in sink.topics.items()):
+                raise ValueError(
+                    "Topics must map topic names to lists of keys")
             s.upsert_event_sink(sink)
             return {"ID": sink.id}, store.latest_index()
         m = re.match(r"^/v1/event/sink/([^/]+)$", path)

@@ -58,6 +58,9 @@ class ClientConfig:
     # drivers to run behind the plugin PROCESS boundary
     # (plugins/driver_client.py; go-plugin analog) instead of in-proc
     plugin_drivers: tuple = ()
+    # accelerator fingerprint via the out-of-proc device plugin
+    # (plugins/device_client.py) instead of in-proc probing
+    plugin_device_fingerprint: bool = False
     # client RPC listener serving logs/fs/exec to forwarding servers
     # (client/fs_endpoint.go, client/alloc_endpoint.go); port 0 picks
     # an ephemeral port, None disables the listener. rpc_host is the
@@ -145,12 +148,33 @@ class TaskRunner:
             fetch_artifacts(self.task, task_path, env, self.node)
             render_templates(self.task, task_path, env, self.node)
         config = interpolate_config(self.task.config, env, self.node)
+        # typed config validation against the driver's declared schema
+        # (plugins/shared/hclspec): unknown keys and type mismatches
+        # fail the task at prestart with a spec error instead of deep
+        # inside the driver; defaults fill in
+        spec = None
+        spec_getter = getattr(self.driver, "config_spec", None)
+        if spec_getter is not None:
+            try:
+                spec = spec_getter()
+            except Exception:
+                spec = None
+        else:
+            spec = getattr(self.driver, "CONFIG_SPEC", None)
+        if spec:
+            from ..plugins.hclspec import SpecError, decode
+            from .hooks import HookError
+            try:
+                config = decode(spec, config)
+            except SpecError as e:
+                raise HookError(f"driver config invalid: {e}")
         lc = self.task.log_config
         ctx = {"task_dir": task_path or None,
                "log_dir": log_dir,
                "log_max_files": lc.max_files if lc else 10,
                "log_max_file_size_mb": lc.max_file_size_mb if lc else 10,
                "alloc_id": self.alloc.id,
+               "user": self.task.user,
                "resources": {"cpu": self.task.resources.cpu,
                              "memory_mb": self.task.resources.memory_mb}}
         return config, env, ctx
@@ -463,8 +487,24 @@ class Client:
             node.drivers[name] = DI(detected=True, healthy=True)
         node.node_resources.devices = list(self.config.devices)
         if self.config.fingerprint_accelerators:
-            node.node_resources.devices.extend(
-                fingerprint_accelerator_devices())
+            if self.config.plugin_device_fingerprint:
+                # out-of-proc device plugin (plugins/device/device.go
+                # behind the go-plugin boundary): fingerprint crosses
+                # the process line, and a crashing device plugin can't
+                # take the agent down
+                from ..plugins.device_client import ExternalDevicePlugin
+                self.device_plugin = ExternalDevicePlugin()
+                try:
+                    node.node_resources.devices.extend(
+                        self.device_plugin.fingerprint())
+                except Exception:
+                    # same contract as the in-proc probe: a broken
+                    # device plugin means no devices, not a dead agent
+                    LOG.exception("device plugin fingerprint failed; "
+                                  "continuing without devices")
+            else:
+                node.node_resources.devices.extend(
+                    fingerprint_accelerator_devices())
         for g in node.node_resources.devices:
             node.attributes[f"device.{g.type}"] = str(len(g.instances))
         node.compute_class()
@@ -576,6 +616,9 @@ class Client:
         rpc = getattr(self, "rpc_server", None)
         if rpc is not None:
             rpc.shutdown()
+        devp = getattr(self, "device_plugin", None)
+        if devp is not None:
+            devp.shutdown()
         close = getattr(self.transport, "close", None)
         if close is not None:
             close()

@@ -81,6 +81,16 @@ class MockDriver:
     config['start_error'] fails the start."""
 
     name = "mock_driver"
+    # typed config schema (plugins/shared/hclspec; drivers/mock
+    # driver.go:113-226 declares the same knobs via hclspec)
+    from ..plugins.hclspec import Attr as _A
+    CONFIG_SPEC = {
+        "run_for": _A("string", default="0s"),
+        "exit_code": _A("number", default=0),
+        "start_error": _A("string"),
+        "recover_error": _A("string"),
+        "stdout_string": _A("string"),
+    }
 
     def fingerprint(self) -> Dict[str, str]:
         return {"driver.mock_driver": "1"}
@@ -142,6 +152,11 @@ class RawExecDriver:
     """drivers/rawexec: plain fork/exec, no isolation."""
 
     name = "raw_exec"
+    from ..plugins.hclspec import Attr as _A
+    CONFIG_SPEC = {
+        "command": _A("string", required=True),
+        "args": _A("list(string)", default=[]),
+    }
 
     def fingerprint(self) -> Dict[str, str]:
         return {"driver.raw_exec": "1"}
@@ -249,6 +264,15 @@ class ExecDriver(RawExecDriver):
     back to raw fork/exec otherwise, and advertises which mode the
     fingerprint detected (driver.exec.isolation)."""
 
+    from ..plugins.hclspec import Attr as _A
+    CONFIG_SPEC = {
+        "command": _A("string", required=True),
+        "args": _A("list(string)", default=[]),
+        "user": _A("string"),
+        "no_chroot": _A("bool", default=False),
+        "no_isolation": _A("bool", default=False),
+    }
+
     name = "exec"
 
     def fingerprint(self) -> Dict[str, str]:
@@ -291,6 +315,11 @@ class ExecDriver(RawExecDriver):
         # and argv is world-readable via /proc/*/cmdline
         import json as _json
         import sys as _sys
+        # the jobspec `user` (Task.user / config user), defaulting to
+        # an unprivileged account when the agent runs as root — an
+        # isolated task must never silently inherit root
+        # (drivers/shared/executor/executor.go user switch)
+        run_as = config.get("user") or (ctx.get("user") or "") or "nobody"
         spec = _json.dumps({
             "procs_files": executor.procs_files,
             "chroot_dir": chroot_dir,
@@ -299,6 +328,8 @@ class ExecDriver(RawExecDriver):
             "args": list(config.get("args", [])),
             "env": {**env} if env else {},
             "cwd": cwd,
+            "user": run_as,
+            "chown_dirs": [cwd] if cwd else [],
         })
         repo_root = _os.path.dirname(_os.path.dirname(
             _os.path.dirname(_os.path.abspath(__file__))))

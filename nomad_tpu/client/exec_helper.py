@@ -97,8 +97,41 @@ def contain(spec: dict) -> None:
 DEFAULT_PATH = "/usr/local/bin:/usr/bin:/bin:/usr/sbin:/sbin"
 
 
+def resolve_user(name: str):
+    """(uid, gid, home) for the task's `user` stanza. Resolved BEFORE
+    the chroot so the host's passwd database answers."""
+    import pwd
+    rec = pwd.getpwnam(name)
+    return rec.pw_uid, rec.pw_gid, rec.pw_dir
+
+
+def chown_tree(path: str, uid: int, gid: int) -> None:
+    # lchown ONLY: this runs as root, and a task artifact can smuggle a
+    # symlink to any host file — following it would chown /etc/shadow
+    # to the task user
+    os.lchown(path, uid, gid)
+    for root, dirs, files in os.walk(path):
+        for name in dirs + files:
+            try:
+                os.lchown(os.path.join(root, name), uid, gid)
+            except OSError:
+                pass
+
+
 def main() -> None:
     spec = json.loads(sys.stdin.read())
+    # user switching (drivers/shared/executor/executor.go: the task
+    # runs as the jobspec `user`, default unprivileged — an isolated
+    # task must not inherit the agent's root): resolve before the
+    # chroot, chown the task's writable tree, drop after containment
+    user = spec.get("user") or ""
+    creds = None
+    if user and hasattr(os, "geteuid") and os.geteuid() == 0:
+        uid, gid, _home = resolve_user(user)
+        creds = (uid, gid)
+        for d in spec.get("chown_dirs") or []:
+            if os.path.isdir(d):
+                chown_tree(d, uid, gid)
     contain(spec)
     env = dict(spec.get("env") or {})
     # execvpe resolves the command via the TASK env's PATH; a jobspec
@@ -106,6 +139,13 @@ def main() -> None:
     # fallback (which inherits the client env) would succeed — resolve
     # against a sane default instead
     env.setdefault("PATH", DEFAULT_PATH)
+    if creds is not None:
+        uid, gid = creds
+        os.initgroups(user, gid)
+        os.setgid(gid)
+        os.setuid(uid)
+        env.setdefault("USER", user)
+        env.setdefault("LOGNAME", user)
     cmd = spec["command"]
     os.execvpe(cmd, [cmd] + list(spec.get("args", [])), env)
 

@@ -77,7 +77,16 @@ def build_driver_methods(driver) -> Dict:
         handles.pop(args["handle_id"], None)
         return {}
 
+    def config_schema(_args):
+        # hclspec over the boundary (plugins/base/plugin.go
+        # ConfigSchema): the host decodes user config against the
+        # plugin's declared schema
+        from .hclspec import describe
+        spec = getattr(driver, "CONFIG_SPEC", None)
+        return {"schema": describe(spec) if spec else None}
+
     return {
+        "Driver.ConfigSchema": config_schema,
         "Driver.Fingerprint": fingerprint,
         "Driver.StartTask": start_task,
         "Driver.WaitTask": wait_task,
@@ -88,17 +97,19 @@ def build_driver_methods(driver) -> Dict:
     }
 
 
-def serve_plugin(driver, out=None) -> None:
+def serve_plugin(driver, out=None, methods: Optional[Dict] = None) -> None:
     """Plugin-side main: verify the handshake cookie, listen, print the
     handshake line, serve until stdin closes (the host's death closes
     our stdin, so orphaned plugins exit — go-plugin's supervision
-    contract)."""
+    contract). `methods` overrides the driver method table (device
+    plugins serve Device.* instead)."""
     if os.environ.get(HANDSHAKE_COOKIE_KEY) != HANDSHAKE_COOKIE_VALUE:
         print("This binary is a plugin and must be launched by the "
               "nomad-tpu client agent", file=sys.stderr)
         sys.exit(1)
     from ..rpc.server import RpcServer
-    rpc = RpcServer(methods=build_driver_methods(driver))
+    rpc = RpcServer(methods=methods if methods is not None
+                    else build_driver_methods(driver))
     rpc.start()
     out = out or sys.stdout
     out.write(HANDSHAKE_PREFIX + rpc.addr + "\n")

@@ -148,6 +148,17 @@ class ExternalDriver:
     def fingerprint(self) -> Dict[str, str]:
         return self.call("Driver.Fingerprint", {})["attributes"]
 
+    def config_spec(self):
+        """The plugin's declared config schema, fetched once over the
+        boundary (plugins/base ConfigSchema) and cached."""
+        cached = getattr(self, "_config_spec", None)
+        if cached is not None:
+            return cached
+        from .hclspec import spec_from_wire
+        wire = self.call("Driver.ConfigSchema", {}).get("schema")
+        self._config_spec = spec_from_wire(wire) if wire else {}
+        return self._config_spec
+
     def start_task(self, task_name: str, config: dict, env: dict,
                    ctx: Optional[dict] = None):
         try:

@@ -131,6 +131,9 @@ class Server:
                 except Exception:
                     LOG.exception("WAL replay failed at %d/%s",
                                   index, msg_type)
+        # event history starts HERE: restore/replay publish no events,
+        # so sink progress at or below this floor has a proven gap
+        self.events.epoch_floor = self._raft_index
 
     # -- lifecycle -----------------------------------------------------
     def attach_raft(self, rpc_server, peers, self_addr: str = "") -> None:

@@ -97,6 +97,11 @@ class EventBroker:
         # highest index ever dropped off the ring: a consumer resuming
         # from progress <= trimmed_through has a PROVEN replay gap
         self.trimmed_through = 0
+        # indexes at or below this floor predate this broker's life
+        # (set to the store's index once boot restore finishes; WAL
+        # replay publishes no events) — progress below it cannot be
+        # proven continuous either
+        self.epoch_floor = 0
 
     def publish(self, events: List[Event]) -> None:
         if not events:
@@ -112,7 +117,13 @@ class EventBroker:
                                     max(e.index for e in events))
             subs = list(self._subs)
         for s in subs:
-            s.deliver(events)
+            try:
+                s.deliver(events)
+            except Exception:       # one bad filter must not starve
+                import logging      # every later subscriber
+                logging.getLogger("nomad_tpu.events").exception(
+                    "subscriber delivery failed; unsubscribing it")
+                self._remove(s)
 
     def subscribe(self, topics: Optional[Dict[str, List[str]]] = None,
                   from_index: int = 0,

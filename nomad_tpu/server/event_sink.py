@@ -93,16 +93,21 @@ class _SinkWorker:
                      index=self._delivered_index,
                      payload={"reason": reason})
 
-    def _subscribe(self, server):
+    def _subscribe(self, server, inclusive: bool = False):
         """(sub, initial_pending) from the committed progress, with
         replay-gap detection: trimmed_through is the highest index the
-        broker has PROVABLY dropped, so progress at or below it means
-        events are unrecoverable. A fresh broker whose event history
-        starts after our progress (server restarted; replay does not
-        republish events) is flagged once too."""
+        broker has PROVABLY dropped, and epoch_floor marks where this
+        broker's event history begins (restarts don't republish) —
+        progress at or below either means unrecoverable events, which
+        must surface as an EventsLost frame, never a silent skip.
+        `inclusive` replays events AT the progress index too (overflow
+        recovery: a same-index batch can split, and redelivery is the
+        at-least-once answer)."""
         topics = self.sink.topics or None
+        from_idx = self._delivered_index - 1 if inclusive \
+            else self._delivered_index
         sub, backlog = server.events.subscribe(
-            topics, from_index=self._delivered_index, max_queued=8192)
+            topics, from_index=max(from_idx, 0), max_queued=8192)
         pending: List = []
         if self._delivered_index > 0:
             trimmed = server.events.trimmed_through
@@ -110,8 +115,7 @@ class _SinkWorker:
                 pending.append(self._lost_marker(
                     f"ring buffer trimmed through index {trimmed}, "
                     f"progress was {self._delivered_index}"))
-            elif server.events.latest_index == 0 and \
-                    server.store.latest_index() > self._delivered_index:
+            elif server.events.epoch_floor > self._delivered_index:
                 pending.append(self._lost_marker(
                     "progress predates this server's event history"))
         pending.extend(backlog)
@@ -125,13 +129,16 @@ class _SinkWorker:
             backoff = RETRY_BASE_S
             while not self._stop.is_set():
                 if sub.overflowed:
-                    # slow-consumer drop: resubscribe from delivered
-                    # progress — the ring usually still covers it, and
+                    # slow-consumer drop: resubscribe INCLUSIVE of the
+                    # delivered index — a same-index batch may have
+                    # split across the drop, and redelivering already-
+                    # sent events is what at-least-once permits; the
+                    # ring usually still covers the gap, and
                     # _subscribe marks the loss if it doesn't
                     sub.unsubscribe()
-                    sub, replay = self._subscribe(server)
+                    sub, replay = self._subscribe(server, inclusive=True)
                     pending.extend(e for e in replay
-                                   if e.index > self._delivered_index
+                                   if e.index >= self._delivered_index
                                    or e.type == "EventsLost")
                 if not pending:
                     fresh = sub.next_events(timeout_s=0.5)

@@ -164,3 +164,47 @@ def test_no_isolation_falls_back(tmp_path):
     _wait(h)
     assert h.exit_code == 0
     assert getattr(h, "executor", None) is None
+
+
+@isolation
+def test_isolated_task_runs_as_unprivileged_user(tmp_path):
+    """User switching (drivers/shared/executor/executor.go): with no
+    `user` stanza an isolated task drops to an unprivileged account —
+    running workloads as the agent's root silently is not acceptable —
+    and its task dir is chowned so it stays writable."""
+    d = ExecDriver()
+    out = tmp_path / "who"
+    out.mkdir()
+    h = d.start_task(
+        "whoami",
+        {"command": "/bin/sh", "no_chroot": True,
+         "args": ["-c", "id -u > uid.txt; touch proof.txt"]},
+        {"PATH": "/usr/bin:/bin"},
+        ctx={"alloc_id": "usertst1", "task_dir": str(out),
+             "resources": {"cpu": 200, "memory_mb": 64}})
+    _wait(h)
+    assert h.exit_code == 0, h.error
+    uid = int((out / "uid.txt").read_text().strip())
+    assert uid != 0, "isolated task ran as root"
+    import pwd
+    assert uid == pwd.getpwnam("nobody").pw_uid
+    # the task could write its own dir because the helper chowned it
+    assert (out / "proof.txt").exists()
+    assert (out / "proof.txt").stat().st_uid == uid
+
+
+@isolation
+def test_user_stanza_overrides_default(tmp_path):
+    d = ExecDriver()
+    out = tmp_path / "asroot"
+    out.mkdir()
+    h = d.start_task(
+        "asroot",
+        {"command": "/bin/sh", "no_chroot": True, "user": "root",
+         "args": ["-c", "id -u > uid.txt"]},
+        {"PATH": "/usr/bin:/bin"},
+        ctx={"alloc_id": "usertst2", "task_dir": str(out),
+             "resources": {"cpu": 200, "memory_mb": 64}})
+    _wait(h)
+    assert h.exit_code == 0, h.error
+    assert int((out / "uid.txt").read_text().strip()) == 0
