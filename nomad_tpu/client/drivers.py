@@ -18,6 +18,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
+from ..plugins.hclspec import Attr as _SpecAttr
+
 
 @dataclass
 class TaskHandle:
@@ -83,13 +85,12 @@ class MockDriver:
     name = "mock_driver"
     # typed config schema (plugins/shared/hclspec; drivers/mock
     # driver.go:113-226 declares the same knobs via hclspec)
-    from ..plugins.hclspec import Attr as _A
     CONFIG_SPEC = {
-        "run_for": _A("string", default="0s"),
-        "exit_code": _A("number", default=0),
-        "start_error": _A("string"),
-        "recover_error": _A("string"),
-        "stdout_string": _A("string"),
+        "run_for": _SpecAttr("string", default="0s"),
+        "exit_code": _SpecAttr("number", default=0),
+        "start_error": _SpecAttr("string"),
+        "recover_error": _SpecAttr("string"),
+        "stdout_string": _SpecAttr("string"),
     }
 
     def fingerprint(self) -> Dict[str, str]:
@@ -152,10 +153,9 @@ class RawExecDriver:
     """drivers/rawexec: plain fork/exec, no isolation."""
 
     name = "raw_exec"
-    from ..plugins.hclspec import Attr as _A
     CONFIG_SPEC = {
-        "command": _A("string", required=True),
-        "args": _A("list(string)", default=[]),
+        "command": _SpecAttr("string", required=True),
+        "args": _SpecAttr("list(string)", default=[]),
     }
 
     def fingerprint(self) -> Dict[str, str]:
@@ -264,13 +264,12 @@ class ExecDriver(RawExecDriver):
     back to raw fork/exec otherwise, and advertises which mode the
     fingerprint detected (driver.exec.isolation)."""
 
-    from ..plugins.hclspec import Attr as _A
     CONFIG_SPEC = {
-        "command": _A("string", required=True),
-        "args": _A("list(string)", default=[]),
-        "user": _A("string"),
-        "no_chroot": _A("bool", default=False),
-        "no_isolation": _A("bool", default=False),
+        "command": _SpecAttr("string", required=True),
+        "args": _SpecAttr("list(string)", default=[]),
+        "user": _SpecAttr("string"),
+        "no_chroot": _SpecAttr("bool", default=False),
+        "no_isolation": _SpecAttr("bool", default=False),
     }
 
     name = "exec"

@@ -132,6 +132,10 @@ def main() -> None:
         for d in spec.get("chown_dirs") or []:
             if os.path.isdir(d):
                 chown_tree(d, uid, gid)
+        # supplementary groups from the HOST group database — after
+        # the chroot, a task-shipped etc/group could grant itself
+        # gid 0 through this lookup
+        os.initgroups(user, gid)
     contain(spec)
     env = dict(spec.get("env") or {})
     # execvpe resolves the command via the TASK env's PATH; a jobspec
@@ -141,7 +145,6 @@ def main() -> None:
     env.setdefault("PATH", DEFAULT_PATH)
     if creds is not None:
         uid, gid = creds
-        os.initgroups(user, gid)
         os.setgid(gid)
         os.setuid(uid)
         env.setdefault("USER", user)

@@ -131,6 +131,11 @@ class ExternalDevicePlugin:
                     os.path.abspath(__file__)))))
             line = self._proc.stdout.readline().strip()
             if not line.startswith(HANDSHAKE_PREFIX):
+                # kill the half-started process or every retry leaks a
+                # live orphan
+                self._proc.kill()
+                self._proc.wait()
+                self._proc = None
                 raise RuntimeError(
                     f"device plugin {self.name} bad handshake: {line!r}")
             self._rpc = RpcClient(line[len(HANDSHAKE_PREFIX):])

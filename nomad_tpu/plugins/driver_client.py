@@ -106,6 +106,11 @@ class ExternalDriver:
                     os.path.abspath(__file__)))))
             line = self._proc.stdout.readline().strip()
             if not line.startswith(HANDSHAKE_PREFIX):
+                # kill the half-started process or every retry leaks a
+                # live orphan
+                self._proc.kill()
+                self._proc.wait()
+                self._proc = None
                 raise RuntimeError(
                     f"driver plugin {self.name} bad handshake: {line!r}")
             addr = line[len(HANDSHAKE_PREFIX):]

@@ -71,6 +71,15 @@ def build_method_table(server) -> Dict[str, Any]:
         return {"status": "ok", "leader": True,
                 "index": server.store.latest_index()}
 
+    def server_join(args):
+        return {"members": server.join_member(args["addr"])}
+
+    def server_leave(args):
+        return {"members": server.leave_member(args["addr"])}
+
+    def server_members(_args):
+        return {"members": server.store.server_members()}
+
     return {
         "Node.Register": node_register,
         "Node.UpdateStatus": node_update_status,
@@ -79,12 +88,16 @@ def build_method_table(server) -> Dict[str, Any]:
         "Node.GetClientAllocs": node_get_client_allocs,
         "Node.DeriveVaultToken": node_derive_vault_token,
         "Status.Ping": status_ping,
+        "Server.Join": server_join,
+        "Server.Leave": server_leave,
+        "Server.Members": server_members,
     }
 
 
 # client-facing writes that must run on the leader (rpc.go forward())
 WRITE_METHODS = frozenset({"Node.Register", "Node.UpdateStatus",
-                           "Node.Heartbeat", "Node.UpdateAlloc"})
+                           "Node.Heartbeat", "Node.UpdateAlloc",
+                           "Server.Join", "Server.Leave"})
 
 
 class RpcServer:

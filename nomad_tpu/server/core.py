@@ -72,6 +72,10 @@ class ServerConfig:
     deployment_gc_threshold_s: float = 3600.0
     # ACL subsystem (nomad/config.go ACLEnabled)
     acl_enabled: bool = False
+    # autopilot dead-server cleanup (nomad/autopilot.go): a voter with
+    # no replication contact for this long is removed from the member
+    # set; 0 disables
+    dead_server_cleanup_s: float = 60.0
 
 
 class Server:
@@ -97,6 +101,7 @@ class Server:
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._hb_lock = threading.Lock()
         self._leader = False
+        self._member_l = threading.Lock()   # join/leave RMW serialization
         self._acl_cache: Dict = {}      # (policies, index) -> compiled ACL
         self.raft = None                # multi-server consensus (raft.py)
         # thread-local: set on the FSM applier thread while an applier
@@ -144,6 +149,13 @@ class Server:
                              list(peers), data_dir=self.config.data_dir)
         rpc_server.methods.update(self.raft.rpc_methods())
         rpc_server.raft = self.raft
+        # reconcile REPLICATED membership over the static boot config:
+        # a restarted server must adopt the grown/shrunk voter set its
+        # WAL/snapshot recorded (and an evicted server must come back
+        # inert), or its quorum math is wrong from the first election
+        members = self.store.server_members()
+        if members:
+            self.raft.update_members(members)
 
     def start(self) -> None:
         if self.raft is None:
@@ -266,8 +278,17 @@ class Server:
             floor = self.store.latest_index() if base_index is None \
                 else base_index
             self._raft_index = max(floor, self.store.latest_index())
+            # snapshot-covered indexes were never published as events
+            # on this node: raise the sink gap floor accordingly
+            self.events.epoch_floor = max(self.events.epoch_floor,
+                                          self._raft_index)
             if self.persistence is not None:
                 self.persistence.snapshot(self.store)
+        # adopt the snapshot's replicated membership
+        if self.raft is not None:
+            members = self.store.server_members()
+            if members:
+                self.raft.update_members(members)
 
     def shutdown(self) -> None:
         self._shutdown = True
@@ -311,6 +332,25 @@ class Server:
         # durable event sinks are a leader duty: workers resume from
         # each sink's raft-committed progress (event_sink_manager.go)
         self.event_sinks.set_enabled(True)
+        if self.raft is not None:
+            # seed the replicated member set from static boot config on
+            # first leadership (later joins/leaves mutate it), then run
+            # the autopilot reaper. Threaded: establish_leadership runs
+            # under the raft lock (same reason the election no-op is)
+            def _seed():
+                try:
+                    if not self.store.server_members():
+                        self.raft_apply(
+                            "server_membership",
+                            dict(members=[self.raft.self_addr]
+                                 + list(self.raft.peers)))
+                except Exception:
+                    LOG.exception("membership seed failed")
+            threading.Thread(target=_seed, daemon=True,
+                             name="member-seed").start()
+            if self.config.dead_server_cleanup_s > 0:
+                threading.Thread(target=self._autopilot_loop,
+                                 daemon=True, name="autopilot").start()
 
     def _reap_failed_evals(self) -> None:
         """Drain the broker's failed queue: mark the eval failed and
@@ -810,6 +850,91 @@ class Server:
                        error=error, eval_id=ev.id if ev else "",
                        time=int(time.time()))))
         return ev
+
+    # -- dynamic membership (nomad/serf.go + nomad/autopilot.go) -------
+    def _apply_server_membership(self, index: int, p: dict) -> None:
+        members = list(p.get("members") or [])
+        self.store.set_server_members(index, members)
+        if self.raft is not None:
+            self.raft.update_members(members)
+
+    def join_member(self, addr: str) -> List[str]:
+        """Add a server to the voter set (Server.Join; the joiner calls
+        this through any member — writes forward to the leader).
+        Returns the post-join member list. The read-modify-write of
+        the full list is serialized per leader so concurrent joins
+        cannot overwrite each other's membership."""
+        if self.raft is None:
+            raise RuntimeError("not a clustered server")
+        with self._member_l:
+            current = self.store.server_members() or \
+                [self.raft.self_addr] + list(self.raft.peers)
+            if addr not in current:
+                self.raft_apply("server_membership",
+                                dict(members=current + [addr]))
+        return self.store.server_members()
+
+    def leave_member(self, addr: str) -> List[str]:
+        """Remove a server from the voter set (operator leave or
+        autopilot dead-server cleanup)."""
+        if self.raft is None:
+            raise RuntimeError("not a clustered server")
+        with self._member_l:
+            current = self.store.server_members() or \
+                [self.raft.self_addr] + list(self.raft.peers)
+            if addr in current:
+                self.raft_apply(
+                    "server_membership",
+                    dict(members=[m for m in current if m != addr]))
+        return self.store.server_members()
+
+    def join_cluster(self, via_addr: str) -> None:
+        """Joiner side: ask an existing member to add us, then adopt
+        the returned member list (the serf-join analog)."""
+        if self.raft is None:
+            raise RuntimeError("attach_raft first")
+        from ..rpc.client import RpcClient
+        c = RpcClient(via_addr, dial_timeout_s=3.0)
+        try:
+            res = c.call("Server.Join",
+                         {"addr": self.raft.self_addr}, timeout_s=30.0)
+        finally:
+            c.close()
+        members = list(res.get("members") or [])
+        if members:
+            self.raft.update_members(members)
+
+    def _autopilot_loop(self) -> None:
+        """Leader-side dead-server cleanup (nomad/autopilot.go): a
+        voter with no successful replication contact past the cleanup
+        threshold is removed from the member set, as long as a quorum
+        of the REMAINING members is intact."""
+        import time as _time
+        threshold = self.config.dead_server_cleanup_s
+        while self._leader and not getattr(self, "_shutdown", False):
+            _time.sleep(max(min(threshold / 4.0, 2.0), 0.5))
+            raft = self.raft
+            if raft is None or not raft.is_leader() or threshold <= 0:
+                continue
+            now = _time.monotonic()
+            peers = list(raft.peers)
+            dead = [p for p in peers
+                    if now - raft.last_contact.get(p, now) > threshold]
+            if not dead:
+                continue
+            alive = len(peers) - len(dead) + 1
+            for p in dead:
+                # never cleanup below a functioning majority of the
+                # shrunken cluster (autopilot's quorum guard)
+                if alive * 2 <= len(peers):     # post-removal size - 1
+                    break
+                try:
+                    LOG.warning("autopilot: removing dead server %s "
+                                "(no contact for %.0fs)", p,
+                                now - raft.last_contact.get(p, now))
+                    self.leave_member(p)
+                except Exception:
+                    LOG.exception("autopilot cleanup of %s failed", p)
 
     # -- event sinks (nomad/stream/sink.go + event_sink_manager.go) ----
     def upsert_event_sink(self, sink) -> int:

@@ -52,6 +52,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
                                  "evals": [Evaluation]},
     "job_stability": {},
     "scaling_event": {},
+    "server_membership": {},
     "noop": {},
     "deployment_delete": {},
     "periodic_launch": {},

@@ -80,6 +80,7 @@ class RaftNode:
         self.base_index = server._raft_index
         self.base_term = 0
         self.needs_snapshot = False
+        self.removed = False        # kicked from membership -> inert
 
         self._last_heartbeat = time.monotonic()
         self._election_deadline = self._new_deadline()
@@ -96,6 +97,10 @@ class RaftNode:
         self._repl_gen = 0            # invalidates stale repl threads
         self._repl_events: Dict[str, threading.Event] = {}
         self._snap_gen = 0            # invalidates an in-flight FSM batch
+        # last successful replication round trip per peer (leader):
+        # the autopilot's liveness signal (nomad/autopilot.go reads
+        # serf health; here replication contact plays that role)
+        self.last_contact: Dict[str, float] = {}
         self._load_vote_state()
 
     # -- persistence of (term, votedFor) — Raft §5.1 -------------------
@@ -366,6 +371,12 @@ class RaftNode:
         last, _ = self.last_log()
         self._next_index = {p: last + 1 for p in self.peers}
         self._match_index = {p: 0 for p in self.peers}
+        # autopilot's contact clock starts at election for EVERY peer:
+        # a server that died before this term would otherwise default
+        # to age 0 forever and never be reaped
+        now = time.monotonic()
+        for p in self.peers:
+            self.last_contact.setdefault(p, now)
         self._repl_gen += 1
         gen = self._repl_gen
         self._repl_events = {}
@@ -398,6 +409,8 @@ class RaftNode:
             time.sleep(HEARTBEAT_S / 2)
             with self._lock:
                 role = self.role
+                if self.removed:
+                    continue        # inert: never campaign
             if role != LEADER and \
                     time.monotonic() > self._election_deadline:
                 self._run_election()
@@ -462,8 +475,9 @@ class RaftNode:
         snapshotting peer only ever blocks its own thread."""
         while not self._stop.is_set():
             with self._lock:
-                if self.role != LEADER or self._repl_gen != gen:
-                    return
+                if self.role != LEADER or self._repl_gen != gen \
+                        or self._repl_events.get(peer) is not wake:
+                    return          # retired or membership removed us
             wake.wait(HEARTBEAT_S)
             wake.clear()
             try:
@@ -516,6 +530,7 @@ class RaftNode:
                 self._send_snapshot(peer, term)
                 return False
             if res.get("success"):
+                self.last_contact[peer] = time.monotonic()
                 matched = entries[-1][0] if entries else prev_index
                 if matched > self._match_index.get(peer, 0):
                     self._match_index[peer] = matched
@@ -576,6 +591,55 @@ class RaftNode:
             self._match_index[peer] = snap_index
             self._advance_commit()
 
+    # -- dynamic membership (nomad/serf.go + setupSerf; membership
+    # itself rides the replicated log, liveness is leader-local) ------
+    def update_members(self, members: List[str]) -> None:
+        """Adopt a new replicated member list. New peers get
+        replication threads (when leader); removed peers' pumps retire;
+        quorum math follows the new cluster size. Called from the FSM
+        applier, so every replica converges on the same view."""
+        with self._lock:
+            members = list(dict.fromkeys(members))
+            if self.self_addr not in members:
+                # we were removed (autopilot dead-server cleanup or an
+                # operator leave): go INERT — no elections, no
+                # self-cluster takeover (a left nomad server shuts its
+                # raft down the same way)
+                LOG.warning("removed from cluster membership; isolating")
+                self.peers = []
+                self.cluster_size = 1
+                self.removed = True
+                if self.role == LEADER:
+                    self._become_follower(self.term, None)
+                return
+            self.removed = False
+            new_peers = [m for m in members if m != self.self_addr]
+            added = [p for p in new_peers if p not in self.peers]
+            removed = [p for p in self.peers if p not in new_peers]
+            self.peers = new_peers
+            self.cluster_size = len(new_peers) + 1
+            for peer in removed:
+                self._repl_events.pop(peer, None)
+                self._next_index.pop(peer, None)
+                self._match_index.pop(peer, None)
+                self.last_contact.pop(peer, None)
+            if self.role == LEADER:
+                last, _ = (self.log[-1][0], self.log[-1][1]) if self.log \
+                    else (self.base_index, self.base_term)
+                gen = self._repl_gen
+                for peer in added:
+                    self._next_index[peer] = last + 1
+                    self._match_index[peer] = 0
+                    self.last_contact[peer] = time.monotonic()
+                    ev = threading.Event()
+                    ev.set()
+                    self._repl_events[peer] = ev
+                    threading.Thread(target=self._repl_loop,
+                                     args=(peer, gen, ev), daemon=True,
+                                     name=f"raft-repl-{peer}").start()
+                if removed:
+                    self._advance_commit()
+
     # -- compaction ----------------------------------------------------
     def compact(self, keep: int = 4096) -> None:
         """Drop applied log prefix. Never compacts past the locally
@@ -597,11 +661,22 @@ class RaftNode:
 
     # -- RPC handlers --------------------------------------------------
     def rpc_methods(self) -> Dict:
+        def gated(fn):
+            # a stopped raft node must refuse RPCs: established
+            # connections outlive the listener, and answering
+            # AppendEntries after shutdown makes a "dead" server look
+            # alive to the leader's contact clock (and to autopilot)
+            def handler(args):
+                if self._stop.is_set():
+                    raise RuntimeError("raft node stopped")
+                return fn(args)
+            return handler
+
         return {
-            "Raft.RequestVote": self._handle_request_vote,
-            "Raft.AppendEntries": self._handle_append_entries,
-            "Raft.InstallSnapshot": self._handle_install_snapshot,
-            "Raft.Forward": self._handle_forward,
+            "Raft.RequestVote": gated(self._handle_request_vote),
+            "Raft.AppendEntries": gated(self._handle_append_entries),
+            "Raft.InstallSnapshot": gated(self._handle_install_snapshot),
+            "Raft.Forward": gated(self._handle_forward),
             "Raft.Status": self._handle_status,
         }
 

@@ -312,6 +312,8 @@ class StateSnapshot:
             to_wire(p) for p in root.table("scaling_policies").values()]
         plain["event_sinks"] = [
             to_wire(s) for s in root.table("event_sinks").values()]
+        plain["server_members"] = list(
+            root.table("server_members").get("members") or [])
         plain["acl_policies"] = [to_wire(p) for p in
                                  root.table("acl_policies").values()]
         plain["acl_tokens"] = [to_wire(t) for t in
@@ -610,6 +612,22 @@ class StateStore(StateSnapshot):
                                  group: str) -> Optional[ScalingPolicy]:
         return self.scaling_policy_by_id(
             ScalingPolicy.id_for(namespace, job_id, group))
+
+    # -- server membership (nomad/serf.go; the voter set rides the
+    # replicated log instead of gossip) --------------------------------
+    def set_server_members(self, index: int, members: List[str]) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("server_members")
+            root = root.with_table(
+                "server_members",
+                t.set("members", list(dict.fromkeys(members)))) \
+                .with_index("server_members", index)
+            self._publish(root)
+
+    def server_members(self) -> List[str]:
+        return list(self._root.table("server_members")
+                    .get("members") or [])
 
     # -- event sinks (nomad/stream/sink.go; event_sinks table) ---------
     def upsert_event_sink(self, index: int, sink) -> None:
@@ -1569,6 +1587,13 @@ class StateStore(StateSnapshot):
                      p.target.get("Job", "")), p.id)
                 t = root.table("scaling_policies")
             root = root.with_table("scaling_policies", t)
+
+            members = data["tables"].get("server_members") or []
+            if members:
+                root = root.with_table(
+                    "server_members",
+                    root.table("server_members").set("members",
+                                                     list(members)))
 
             from ..server.event_sink import EventSink
             t = root.table("event_sinks")
