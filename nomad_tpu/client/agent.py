@@ -39,7 +39,9 @@ class ClientConfig:
     cpu_shares: int = 4000
     memory_mb: int = 8192
     disk_mb: int = 100 * 1024
-    drivers: tuple = ("mock_driver", "raw_exec", "exec")
+    # docker registers only when a reachable dockerd answers /version;
+    # hosts without it drop the driver (and its node attribute) cleanly
+    drivers: tuple = ("mock_driver", "raw_exec", "exec", "docker")
     meta: dict = field(default_factory=dict)
     poll_interval_s: float = 0.2
     heartbeat_interval_s: float = 3.0
@@ -169,12 +171,27 @@ class TaskRunner:
             except SpecError as e:
                 raise HookError(f"driver config invalid: {e}")
         lc = self.task.log_config
+        # the alloc's port offers ride into the driver ctx so port_map
+        # can bind container ports to the scheduler-assigned host
+        # ports (drivers/docker port_map)
+        from ..utils.codec import to_wire as _to_wire
+        alloc_networks = []
+        if self.alloc.allocated_resources is not None:
+            ar = self.alloc.allocated_resources
+            # wire-shaped: ctx crosses the plugin msgpack boundary
+            alloc_networks.extend(
+                _to_wire(nw) for nw in (ar.shared.networks or []))
+            tr = ar.tasks.get(self.task.name)
+            if tr is not None:
+                alloc_networks.extend(
+                    _to_wire(nw) for nw in (tr.networks or []))
         ctx = {"task_dir": task_path or None,
                "log_dir": log_dir,
                "log_max_files": lc.max_files if lc else 10,
                "log_max_file_size_mb": lc.max_file_size_mb if lc else 10,
                "alloc_id": self.alloc.id,
                "user": self.task.user,
+               "alloc_networks": alloc_networks,
                "resources": {"cpu": self.task.resources.cpu,
                              "memory_mb": self.task.resources.memory_mb}}
         return config, env, ctx
@@ -443,6 +460,26 @@ class Client:
                 self.drivers[name] = ExternalDriver(name)
             else:
                 self.drivers[name] = DRIVER_CATALOG[name]()
+        # CONDITIONAL drivers (docker): only drivers that declare an
+        # availability probe get filtered — calling fingerprint() on a
+        # plugin driver here would spawn its subprocess at construction
+        # and permanently drop it on one transient handshake failure,
+        # defeating the relaunch supervision
+        for name, drv in list(self.drivers.items()):
+            probe = getattr(drv, "available", None)
+            if probe is None:
+                continue
+            try:
+                ok = probe()
+                fp = drv.fingerprint() if ok else {}
+            except Exception:
+                ok, fp = False, {}
+            if not ok or not fp:
+                del self.drivers[name]
+                self.node.attributes.pop(f"driver.{name}", None)
+                self.node.drivers.pop(name, None)
+            else:
+                self.node.attributes.update(fp)
         self.runners: Dict[str, AllocRunner] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -534,6 +571,10 @@ class Client:
         self.transport.register_node(self.node)
         self.transport.update_node_status(self.node.id, NODE_STATUS_READY)
         self._restore_state()
+        docker = self.drivers.get("docker")
+        if docker is not None and hasattr(docker, "start_reconciler"):
+            # orphan-container sweep (drivers/docker/reconciler.go)
+            docker.start_reconciler(lambda: set(self.runners))
         t1 = threading.Thread(target=self._heartbeat_loop, daemon=True)
         t2 = threading.Thread(target=self._watch_allocs, daemon=True)
         self._threads = [t1, t2]

@@ -58,6 +58,9 @@ class TaskHandle:
         cg = getattr(self, "cgroup_name", None)
         if cg:
             out["cgroup"] = cg
+        cid = getattr(self, "container_id", None)
+        if cid:
+            out["container_id"] = cid
         return out
 
 
@@ -411,8 +414,15 @@ class ExecDriver(RawExecDriver):
         return executor.stats()
 
 
+def _docker_driver():
+    # deferred: docker_driver imports TaskHandle from this module
+    from .docker_driver import DockerDriver
+    return DockerDriver()
+
+
 DRIVER_CATALOG = {
     "mock_driver": MockDriver,
     "raw_exec": RawExecDriver,
     "exec": ExecDriver,
+    "docker": _docker_driver,
 }

@@ -924,9 +924,10 @@ class Server:
                 continue
             alive = len(peers) - len(dead) + 1
             for p in dead:
-                # never cleanup below a functioning majority of the
-                # shrunken cluster (autopilot's quorum guard)
-                if alive * 2 <= len(peers):     # post-removal size - 1
+                # quorum guard: committing the removal itself needs a
+                # majority of the CURRENT cluster — without it the
+                # leave write just times out and blocks join/leave
+                if alive * 2 <= len(peers) + 1:
                     break
                 try:
                     LOG.warning("autopilot: removing dead server %s "
