@@ -21,7 +21,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..plugins.hclspec import Attr as _SpecAttr, Block as _SpecBlock
+from ..plugins.hclspec import Attr as _SpecAttr
 from .drivers import TaskHandle
 
 LOG = logging.getLogger("nomad_tpu.docker")
@@ -102,11 +102,27 @@ class DockerAPI:
 
     def pull(self, image: str, timeout: float = 600.0) -> None:
         image = self.normalize_image(image)
-        # the create-image endpoint streams progress JSON; drain it
+        # the create-image endpoint answers 200 immediately and streams
+        # progress JSON; FAILURES arrive as error messages inside the
+        # stream, not as an HTTP status
         status, payload = self._request(
             "POST", f"/images/create?fromImage={image}", timeout=timeout)
         if status >= 400:
             raise DockerAPIError(status, payload.decode("utf-8", "replace"))
+        for line in payload.decode("utf-8", "replace").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(msg, dict) and ("error" in msg
+                                          or "errorDetail" in msg):
+                detail = msg.get("error") or \
+                    (msg.get("errorDetail") or {}).get("message", "")
+                raise DockerAPIError(500, f"pull of {image} failed: "
+                                          f"{detail}")
 
     def image_exists(self, image: str) -> bool:
         try:
@@ -191,10 +207,11 @@ class DockerDriver:
         "image": _SpecAttr("string", required=True),
         "command": _SpecAttr("string"),
         "args": _SpecAttr("list(string)", default=[]),
-        "port_map": _SpecBlock({}, required=False),
+        # open maps: user-chosen keys (a Block would reject them all)
+        "port_map": _SpecAttr("any"),
         "network_mode": _SpecAttr("string"),
         "force_pull": _SpecAttr("bool", default=False),
-        "labels": _SpecBlock({}, required=False),
+        "labels": _SpecAttr("any"),
     }
 
     def __init__(self, socket_path: str = DEFAULT_SOCKET):

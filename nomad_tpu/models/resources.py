@@ -34,11 +34,14 @@ class RequestedDevice:
 
     def id_tuple(self):
         parts = self.name.split("/")
-        # (vendor, type, model) with empty wildcards
+        # (vendor, type, model) with empty wildcards; the 2-part form
+        # is <vendor>/<type> (structs.go RequestedDevice.Name docs,
+        # exercised by feasible_test.go TestDeviceChecker
+        # "gpu devices by vendor/type")
         if len(parts) >= 3:
             return (parts[0], parts[1], "/".join(parts[2:]))
         if len(parts) == 2:
-            return ("", parts[0], parts[1])
+            return (parts[0], parts[1], "")
         return ("", self.name, "")
 
 

@@ -119,9 +119,14 @@ def version_matches(version_str, constraint_str: str,
         return False
     if not strict_semver and v.prerelease:
         # go-version: a prerelease version only matches constraint
-        # parts whose own version carries a prerelease (the "version"
-        # operand excludes prereleases from ordinary ranges;
+        # parts whose own version carries a prerelease AND shares the
+        # same Major.Minor.Patch core ("Prerelease X.Y.Z must match",
         # feasible_test.go:917 table)
-        if any(want.prerelease == "" for _op, want in constraints):
-            return False
+        def core(x):
+            return (tuple(x.segments[:3]) + (0, 0, 0))[:3]
+        for _op, want in constraints:
+            if want.prerelease == "":
+                return False
+            if core(v) != core(want):
+                return False
     return all(_check_one(op, v, want) for op, want in constraints)

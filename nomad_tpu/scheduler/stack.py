@@ -486,6 +486,13 @@ class PlacementEngine:
             fly_shared = AllocatedSharedResources(
                 disk_mb=tg.ephemeral_disk.size_mb
                 if tg.ephemeral_disk else 0)
+        # port-free networks (mbits-only asks): the kernel's network
+        # column already gates bandwidth fit, and the offer depends
+        # only on the node — one (task_resources, shared) flyweight
+        # per node serves every step landing there
+        simple_networks = (not simple_resources and not dev_asks
+                           and dyn_ports == 0 and not reserved_ports)
+        node_fly: Dict[int, Tuple] = {}
         for step in range(count):
             idx = node_idx_l[step]
             if same_prev[step] and shared_metric is not None:
@@ -514,9 +521,24 @@ class PlacementEngine:
                     saved_dev = self._dev_cache.pop(node.id, None)
             if simple_resources:
                 task_resources, shared, ok = fly_tr, fly_shared, True
+            elif simple_networks and idx in node_fly:
+                task_resources, shared, ok = node_fly[idx]
+                # the offer objects are shared, but bandwidth must
+                # still ACCUMULATE in the per-eval NetworkIndex — a
+                # later task group's assignment on this node checks it
+                nidx = self._net_cache.get(node.id)
+                if nidx is not None:
+                    if shared is not None:
+                        for off in shared.networks:
+                            nidx.add_reserved(off)
+                    for tr_ in task_resources.values():
+                        for off in (tr_.networks or []):
+                            nidx.add_reserved(off)
             else:
                 task_resources, shared, ok = self._assign_resources(
                     node, tg, proposed.plan)
+                if simple_networks and ok:
+                    node_fly[idx] = (task_resources, shared, ok)
             if not ok:
                 # roll the staged victims back: an eviction without a
                 # replacement placement must not reach the plan

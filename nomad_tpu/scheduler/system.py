@@ -125,6 +125,28 @@ class SystemScheduler:
                     entry = live_by_node_tg.get((alloc.node_id, alloc.task_group))
                     if entry and alloc in entry:
                         entry.remove(alloc)
+                elif engine.feasibility(tg)[0][
+                        table.id_to_idx[alloc.node_id]]:
+                    # in-place: same tasks under a new job version —
+                    # the alloc keeps its id/node/resources and adopts
+                    # the updated job (inplaceUpdate, util.go:633;
+                    # feasibility re-checked first, like the generic
+                    # scheduler's _alloc_update_fn)
+                    updated = alloc.copy_skip_job()
+                    updated.job = None      # plan attaches plan.job
+                    updated.eval_id = self.eval.id
+                    self.plan.append_alloc(updated)
+                else:
+                    # the new job version's constraints exclude this
+                    # node: destructive stop (no replacement lands
+                    # here — the placement loop below respects the
+                    # same mask)
+                    self.plan.append_stopped_alloc(
+                        alloc, "alloc is being updated due to job update")
+                    entry = live_by_node_tg.get(
+                        (alloc.node_id, alloc.task_group))
+                    if entry and alloc in entry:
+                        entry.remove(alloc)
 
         # place each task group on every feasible node lacking an alloc
         for tg in self.job.task_groups:

@@ -1103,11 +1103,15 @@ class StateStore(StateSnapshot):
             for a in allocs:
                 groups.setdefault(keyfn(a), []).append(a.id)
             tt = root.table(table)
+            pairs = []
             for key, ids in groups.items():
                 members = (tt.get(key) or Hamt()).with_ctx(root._ctx)
                 members = members.update([(aid, True) for aid in ids])
-                tt = tt.set(key, members.frozen())
-            root = root.with_table(table, tt)
+                pairs.append((key, members.frozen()))
+            # ONE outer batch write per index table: per-key .set walks
+            # the trie path each time (a 10k-alloc plan touches ~1k
+            # nodes)
+            root = root.with_table(table, tt.update(pairs))
 
         # job summaries: aggregate bucket deltas per job
         per_job: Dict = {}

@@ -46,10 +46,14 @@ def _gpu_job(count=1, dev_count=1, name="gpu", constraints=(),
 # -- unit: matching & masks --------------------------------------------
 def test_group_satisfies_name_forms():
     g = mock.nvidia_node().node_resources.devices[0]
-    for name in ("gpu", "nvidia/gpu/1080ti", "gpu/1080ti"):
+    # name forms are <type>, <vendor>/<type>, <vendor>/<type>/<model>
+    # (structs.go RequestedDevice.Name; feasible_test.go TestDeviceChecker)
+    for name in ("gpu", "nvidia/gpu", "nvidia/gpu/1080ti"):
         assert group_satisfies(g, RequestedDevice(name=name, count=1)), name
     assert not group_satisfies(g, RequestedDevice(name="tpu", count=1))
     assert not group_satisfies(g, RequestedDevice(name="amd/gpu", count=1))
+    assert not group_satisfies(g, RequestedDevice(name="nvidia/fpga",
+                                                  count=1))
 
 
 def test_group_satisfies_constraints():
