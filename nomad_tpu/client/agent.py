@@ -306,13 +306,15 @@ class AllocRunner:
 
     def __init__(self, alloc: Allocation, drivers: Dict[str, object],
                  push_update, persist=None, node=None,
-                 alloc_dir_base: str = "", derive_vault=None):
+                 alloc_dir_base: str = "", derive_vault=None,
+                 client=None):
         self.alloc = alloc
         self.drivers = drivers
         self.push_update = push_update
         self.persist = persist            # (alloc_id, task, state, handle)
         self.derive_vault = derive_vault
         self.node = node
+        self.client = client              # alloc-watcher context
         self.task_runners: List[TaskRunner] = []
         self.client_status = ALLOC_CLIENT_PENDING
         self.deployment_status = alloc.deployment_status
@@ -342,12 +344,53 @@ class AllocRunner:
                             node=self.node, alloc_dir=self.alloc_dir,
                             derive_vault=self.derive_vault)
             self.task_runners.append(tr)
-        for tr in self.task_runners:
-            tr.start()
-        if self.alloc.deployment_id and tg.update is not None:
-            threading.Thread(target=self._watch_health, args=(tg.update,),
-                             daemon=True,
-                             name=f"health-{self.alloc.id[:8]}").start()
+        # previous-alloc watcher (client/allocwatcher): a replacement
+        # with a sticky/migrating ephemeral disk waits for its
+        # predecessor and pulls the disk before tasks start — on its
+        # own thread so other allocs keep flowing
+        needs_watch = (
+            self.client is not None and not attached
+            and self.alloc.previous_allocation
+            and tg.ephemeral_disk is not None
+            and (tg.ephemeral_disk.sticky or tg.ephemeral_disk.migrate))
+
+        def _start_tasks_and_health():
+            for tr in self.task_runners:
+                tr.start()
+            # the deployment health clock starts only once tasks are
+            # actually released — ticking through the migration wait
+            # would expire healthy_deadline before tasks ever ran
+            if self.alloc.deployment_id and tg.update is not None:
+                threading.Thread(target=self._watch_health,
+                                 args=(tg.update,), daemon=True,
+                                 name=f"health-{self.alloc.id[:8]}"
+                                 ).start()
+
+        if needs_watch:
+            def _watch_then_start():
+                from .allocwatcher import migrate_previous
+                try:
+                    if not self.destroyed:
+                        migrate_previous(self.client, self)
+                except Exception:
+                    LOG.exception("alloc watcher for %s failed; "
+                                  "starting with a fresh disk",
+                                  self.alloc.id[:8])
+                if self.destroyed:
+                    # the server stopped this alloc mid-wait: the
+                    # tasks must land terminal, not PENDING forever,
+                    # and nothing may write into the destroyed dir
+                    for tr in self.task_runners:
+                        tr.state = TaskState(state=TASK_STATE_DEAD,
+                                             finished_at=time.time())
+                    self._on_task_update()
+                    return
+                _start_tasks_and_health()
+            threading.Thread(target=_watch_then_start, daemon=True,
+                             name=f"allocwatch-{self.alloc.id[:8]}"
+                             ).start()
+        else:
+            _start_tasks_and_health()
 
     def _watch_health(self, update) -> None:
         """Deployment health monitor (allocrunner/health_hook.go +
@@ -630,7 +673,8 @@ class Client:
                                  node=self.node,
                                  alloc_dir_base=self.config.alloc_dir,
                                  derive_vault=self.transport
-                                 .derive_vault_token)
+                                 .derive_vault_token,
+                                 client=self)
             self.runners[aid] = runner
             runner.run(attached=attached)
 
@@ -743,7 +787,8 @@ class Client:
                                  node=self.node,
                                  alloc_dir_base=self.config.alloc_dir,
                                  derive_vault=self.transport
-                                 .derive_vault_token)
+                                 .derive_vault_token,
+                                 client=self)
             self.runners[aid] = runner
             if self.state_db is not None:
                 self.state_db.put_alloc(alloc)

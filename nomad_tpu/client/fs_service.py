@@ -44,9 +44,15 @@ def list_dir(base: str, rel: str) -> Optional[List[Dict]]:
     out = []
     for name in sorted(os.listdir(target)):
         p = os.path.join(target, name)
-        out.append({"Name": name, "IsDir": os.path.isdir(p),
-                    "Size": os.path.getsize(p)
-                    if os.path.isfile(p) else 0})
+        is_file = os.path.isfile(p)
+        entry = {"Name": name, "IsDir": os.path.isdir(p),
+                 "Size": os.path.getsize(p) if is_file else 0}
+        if is_file:
+            # permission bits ride along so migrated executables keep
+            # their +x (allocwatcher migrateRemoteAllocDir preserves
+            # FileInfo modes)
+            entry["FileMode"] = os.stat(p).st_mode & 0o7777
+        out.append(entry)
     return out
 
 

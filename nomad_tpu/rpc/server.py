@@ -80,6 +80,10 @@ def build_method_table(server) -> Dict[str, Any]:
     def server_members(_args):
         return {"members": server.store.server_members()}
 
+    def alloc_get(args):
+        from .transport import _alloc_with_node
+        return _alloc_with_node(server, args["alloc_id"])
+
     return {
         "Node.Register": node_register,
         "Node.UpdateStatus": node_update_status,
@@ -91,6 +95,7 @@ def build_method_table(server) -> Dict[str, Any]:
         "Server.Join": server_join,
         "Server.Leave": server_leave,
         "Server.Members": server_members,
+        "Alloc.GetAlloc": alloc_get,
     }
 
 

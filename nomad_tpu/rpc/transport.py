@@ -40,6 +40,23 @@ class ServerTransport:
         raise NotImplementedError
 
 
+def _alloc_with_node(server, alloc_id: str):
+    """{alloc: wire, node_rpc: addr} or None — the alloc-watcher's
+    view of a predecessor (status + where to pull its disk from)."""
+    from ..utils.codec import to_wire
+    alloc = server.store.alloc_by_id(alloc_id)
+    if alloc is None:
+        return None
+    node = server.store.node_by_id(alloc.node_id)
+    node_rpc = ""
+    if node is not None:
+        node_rpc = node.attributes.get("nomad.client.rpc", "")
+    return {"alloc": {"client_status": alloc.client_status,
+                      "desired_status": alloc.desired_status,
+                      "node_id": alloc.node_id},
+            "node_rpc": node_rpc}
+
+
 class InProcTransport(ServerTransport):
     def __init__(self, server):
         self.server = server
@@ -68,6 +85,9 @@ class InProcTransport(ServerTransport):
 
     def derive_vault_token(self, alloc_id: str, tasks) -> dict:
         return self.server.derive_vault_token(alloc_id, list(tasks))
+
+    def get_alloc(self, alloc_id: str):
+        return _alloc_with_node(self.server, alloc_id)
 
 
 class RemoteTransport(ServerTransport):
@@ -109,3 +129,8 @@ class RemoteTransport(ServerTransport):
         return self.rpc.call("Node.DeriveVaultToken",
                              {"alloc_id": alloc_id,
                               "tasks": list(tasks)})["tokens"]
+
+    def get_alloc(self, alloc_id: str):
+        """Status + owning-node info of any alloc (the alloc-watcher's
+        predecessor probe, client/allocwatcher)."""
+        return self.rpc.call("Alloc.GetAlloc", {"alloc_id": alloc_id})

@@ -525,15 +525,16 @@ class PlacementEngine:
                 task_resources, shared, ok = node_fly[idx]
                 # the offer objects are shared, but bandwidth must
                 # still ACCUMULATE in the per-eval NetworkIndex — a
-                # later task group's assignment on this node checks it
-                nidx = self._net_cache.get(node.id)
-                if nidx is not None:
-                    if shared is not None:
-                        for off in shared.networks:
-                            nidx.add_reserved(off)
-                    for tr_ in task_resources.values():
-                        for off in (tr_.networks or []):
-                            nidx.add_reserved(off)
+                # later task group's assignment on this node checks
+                # it. Rebuild the index when preemption staging popped
+                # the cache entry; skipping would under-count.
+                nidx = self._net_index_for(node, proposed.plan)
+                if shared is not None:
+                    for off in shared.networks:
+                        nidx.add_reserved(off)
+                for tr_ in task_resources.values():
+                    for off in (tr_.networks or []):
+                        nidx.add_reserved(off)
             else:
                 task_resources, shared, ok = self._assign_resources(
                     node, tg, proposed.plan)

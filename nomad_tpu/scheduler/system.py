@@ -118,29 +118,23 @@ class SystemScheduler:
                 continue
             if alloc.job is not None and \
                     alloc.job.job_modify_index != self.job.job_modify_index:
-                if tasks_updated(self.job, alloc.job, tg.name):
-                    # destructive: stop; replacement placed below
-                    self.plan.append_stopped_alloc(
-                        alloc, "alloc is being updated due to job update")
-                    entry = live_by_node_tg.get((alloc.node_id, alloc.task_group))
-                    if entry and alloc in entry:
-                        entry.remove(alloc)
-                elif engine.feasibility(tg)[0][
-                        table.id_to_idx[alloc.node_id]]:
-                    # in-place: same tasks under a new job version —
-                    # the alloc keeps its id/node/resources and adopts
-                    # the updated job (inplaceUpdate, util.go:633;
-                    # feasibility re-checked first, like the generic
-                    # scheduler's _alloc_update_fn)
+                in_place = (
+                    not tasks_updated(self.job, alloc.job, tg.name)
+                    and bool(engine.feasibility(tg)[0][
+                        table.id_to_idx[alloc.node_id]]))
+                if in_place:
+                    # same tasks under a new job version on a still-
+                    # feasible node: the alloc keeps its id/node/
+                    # resources and adopts the updated job
+                    # (inplaceUpdate, util.go:633; feasibility
+                    # re-checked like the generic _alloc_update_fn)
                     updated = alloc.copy_skip_job()
                     updated.job = None      # plan attaches plan.job
                     updated.eval_id = self.eval.id
                     self.plan.append_alloc(updated)
                 else:
-                    # the new job version's constraints exclude this
-                    # node: destructive stop (no replacement lands
-                    # here — the placement loop below respects the
-                    # same mask)
+                    # destructive: stop; a replacement lands below
+                    # only where the new version's mask allows
                     self.plan.append_stopped_alloc(
                         alloc, "alloc is being updated due to job update")
                     entry = live_by_node_tg.get(
