@@ -187,9 +187,13 @@ class HTTPApiServer:
                 need(acl.allow_namespace_operation(ns, "read-fs"))
             return
         if path.startswith("/v1/client/allocation/"):
-            # remote command execution is its own capability
-            # (acl.NamespaceCapabilityAllocExec)
-            need(acl.allow_namespace_operation(ns, "alloc-exec"))
+            # restart/signal are lifecycle control; exec is its own,
+            # stronger capability (acl.NamespaceCapabilityAllocExec /
+            # AllocLifecycle)
+            if path.endswith(("/restart", "/signal")):
+                need(acl.allow_namespace_operation(ns, "alloc-lifecycle"))
+            else:
+                need(acl.allow_namespace_operation(ns, "alloc-exec"))
             return
         if path == "/v1/volumes" or path.startswith("/v1/volume/"):
             need(acl.allow_namespace_operation(
@@ -354,6 +358,41 @@ class HTTPApiServer:
             if sub == "deployments":
                 return [to_wire(d)
                         for d in store.deployments_by_job(ns, job_id)], idx
+            if sub == "dispatch" and method in ("PUT", "POST"):
+                import base64 as _b64
+                data = body_fn()
+                payload = data.get("Payload") or data.get("payload") or ""
+                ev = s.dispatch_job(
+                    ns, job_id,
+                    payload=_b64.b64decode(payload) if payload else b"",
+                    meta=data.get("Meta") or data.get("meta") or {})
+                return {"DispatchedJobID": ev.job_id,
+                        "EvalID": ev.id}, store.latest_index()
+            if sub == "evaluate" and method in ("PUT", "POST"):
+                # force a fresh evaluation (job_endpoint.go Evaluate)
+                ev = s.evaluate_job(ns, job_id)
+                return {"EvalID": ev.id}, store.latest_index()
+            if sub == "scaling-events":
+                return {"ScalingEvents":
+                        store.scaling_events(ns, job_id)}, idx
+
+        m = re.match(r"^/v1/job/([^/]+)/periodic/force$", path)
+        if m and method in ("PUT", "POST"):
+            # launch a periodic job's child NOW (periodic_endpoint.go)
+            ev = s.periodic.force_run(ns, m.group(1))
+            if ev is None:
+                return {"EvalID": "", "Skipped": True}, \
+                    store.latest_index()
+            return {"EvalID": ev.id,
+                    "DispatchedJobID": ev.job_id}, store.latest_index()
+
+        if path == "/v1/operator/members" and method == "GET":
+            # the replicated voter set (agent_endpoint.go Members /
+            # serf members, minus gossip metadata)
+            raft = getattr(s, "raft", None)
+            return {"Members": store.server_members(),
+                    "Leader": raft.leader_addr if raft else "",
+                    "ClusterSize": raft.cluster_size if raft else 1}, idx
 
         # durable event sinks (nomad/stream/sink.go CRUD)
         if path == "/v1/event/sinks" and method == "GET":
@@ -458,6 +497,14 @@ class HTTPApiServer:
             prefix = q.get("prefix", "")
             return [a.stub() for a in store.allocs()
                     if a.id.startswith(prefix)], idx
+
+        m = re.match(r"^/v1/allocation/([^/]+)/stop$", path)
+        if m and method in ("PUT", "POST"):
+            alloc = self._alloc_in_ns(m.group(1), ns)
+            if alloc is None:
+                return None
+            ev = s.stop_alloc(alloc.id)
+            return {"EvalID": ev.id}, store.latest_index()
 
         m = re.match(r"^/v1/allocation/([^/]+)$", path)
         if m and method == "GET":
@@ -580,6 +627,21 @@ class HTTPApiServer:
 
         # alloc exec sessions (client/alloc_endpoint.go:163): start
         # returns a session id; io round-trips stdin/stdout frames
+        m = re.match(r"^/v1/client/allocation/([^/]+)/(restart|signal)$",
+                     path)
+        if m and method in ("PUT", "POST"):
+            alloc = self._alloc_in_ns(m.group(1), ns)
+            if alloc is None:
+                return None
+            data = body_fn()
+            args = {"task": data.get("Task") or data.get("task") or ""}
+            if m.group(2) == "signal":
+                args["signal"] = data.get("Signal") or data.get("signal")
+            out = self._forward_client(
+                alloc, "ClientAlloc.Restart" if m.group(2) == "restart"
+                else "ClientAlloc.Signal", args)
+            return out, idx
+
         m = re.match(r"^/v1/client/allocation/([^/]+)/exec$", path)
         if m and method in ("PUT", "POST"):
             return self._client_exec_start(m.group(1), body_fn(), ns, idx)

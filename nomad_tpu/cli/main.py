@@ -664,6 +664,249 @@ def cmd_alloc_exec(args) -> int:
     return code
 
 
+def cmd_job_dispatch(args) -> int:
+    """`nomad job dispatch` (command/job_dispatch.go)."""
+    import base64
+    c = _client(args)
+    meta = {}
+    for kv in (args.meta or []):
+        if "=" not in kv:
+            print(f"Error: -meta expects key=value, got {kv!r}",
+                  file=sys.stderr)
+            return 1
+        k, v = kv.split("=", 1)
+        meta[k] = v
+    body = {"Meta": meta}
+    if args.payload:
+        with open(args.payload, "rb") as f:
+            body["Payload"] = base64.b64encode(f.read()).decode()
+    try:
+        out = c._request("POST", f"/v1/job/{args.job_id}/dispatch", body)
+    except ApiError as e:
+        print(f"Error dispatching: {e}", file=sys.stderr)
+        return 1
+    print(f"Dispatched Job ID = {out['DispatchedJobID']}")
+    print(f"Evaluation ID     = {short_id(out['EvalID'])}")
+    return 0
+
+
+def cmd_job_inspect(args) -> int:
+    """`nomad job inspect` — the stored job as JSON."""
+    c = _client(args)
+    try:
+        job = c.get_job(args.job_id)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(job, indent=2, sort_keys=True, default=str))
+    return 0
+
+
+def cmd_job_validate(args) -> int:
+    """`nomad job validate` — parse + server-side validation via the
+    plan endpoint (command/job_validate.go)."""
+    c = _client(args)
+    try:
+        with open(args.path) as f:
+            spec = f.read()
+        out = c._request("POST", "/v1/jobs/parse", {"JobHCL": spec})
+    except (OSError, ApiError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Job \"{out.get('id', '?')}\" is valid")
+    return 0
+
+
+def cmd_job_eval(args) -> int:
+    """`nomad job eval` — force a new evaluation."""
+    c = _client(args)
+    try:
+        out = c._request("POST", f"/v1/job/{args.job_id}/evaluate", {})
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Created eval {short_id(out['EvalID'])}")
+    return 0
+
+
+def cmd_job_periodic_force(args) -> int:
+    """`nomad job periodic force`."""
+    c = _client(args)
+    try:
+        out = c._request("POST",
+                         f"/v1/job/{args.job_id}/periodic/force", {})
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    if out.get("Skipped"):
+        print("Launch skipped (overlap prohibited or already launched)")
+        return 0
+    print(f"Dispatched {out.get('DispatchedJobID', '')} "
+          f"(eval {short_id(out.get('EvalID', ''))})")
+    return 0
+
+
+def cmd_job_scaling_events(args) -> int:
+    c = _client(args)
+    out = c._request("GET", f"/v1/job/{args.job_id}/scaling-events")
+    rows = [[str(ev.get("time", ""))[:19], str(ev.get("count", "")),
+             ev.get("message", "")]
+            for ev in out.get("ScalingEvents", [])]
+    _print_rows(rows, ["Time", "Count", "Message"])
+    return 0
+
+
+def cmd_alloc_stop(args) -> int:
+    """`nomad alloc stop` — stop and reschedule one allocation."""
+    c = _client(args)
+    try:
+        out = c._request("POST", f"/v1/allocation/{args.alloc_id}/stop",
+                         {})
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Created eval {short_id(out['EvalID'])}")
+    return 0
+
+
+def cmd_alloc_restart(args) -> int:
+    c = _client(args)
+    try:
+        out = c._request(
+            "POST", f"/v1/client/allocation/{args.alloc_id}/restart",
+            {"Task": args.task})
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Restarted {out.get('restarted', 0)} task(s)")
+    return 0
+
+
+def cmd_alloc_signal(args) -> int:
+    c = _client(args)
+    try:
+        out = c._request(
+            "POST", f"/v1/client/allocation/{args.alloc_id}/signal",
+            {"Task": args.task, "Signal": args.signal})
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Signalled {out.get('delivered', 0)} task(s)")
+    return 0
+
+
+def cmd_eval_list(args) -> int:
+    c = _client(args)
+    evals = c._request("GET", "/v1/evaluations")
+    rows = [[short_id(e["id"]), e.get("type", ""),
+             e.get("triggered_by", ""), e.get("job_id", "")[:24],
+             e.get("status", "")] for e in evals]
+    _print_rows(rows, ["ID", "Type", "Triggered By", "Job", "Status"])
+    return 0
+
+
+def cmd_scaling_policy_list(args) -> int:
+    c = _client(args)
+    pols = c.list_scaling_policies()
+    rows = [[short_id(p["ID"]), p["Target"].get("Job", ""),
+             p["Target"].get("Group", ""),
+             "yes" if p["Enabled"] else "no", p["Type"]]
+            for p in pols]
+    _print_rows(rows, ["ID", "Job", "Group", "Enabled", "Type"])
+    return 0
+
+
+def cmd_scaling_policy_info(args) -> int:
+    c = _client(args)
+    try:
+        p = c.get_scaling_policy(args.policy_id)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(p, indent=2, sort_keys=True, default=str))
+    return 0
+
+
+def cmd_event_sink_register(args) -> int:
+    c = _client(args)
+    out = c.upsert_event_sink(args.sink_address, sink_id=args.id or "")
+    print(f"Registered sink {out['ID']}")
+    return 0
+
+
+def cmd_event_sink_list(args) -> int:
+    c = _client(args)
+    rows = [[s["ID"], s["Type"], s["Address"],
+             str(s["LatestIndex"])] for s in c.list_event_sinks()]
+    _print_rows(rows, ["ID", "Type", "Address", "Progress"])
+    return 0
+
+
+def cmd_event_sink_deregister(args) -> int:
+    _client(args).delete_event_sink(args.id)
+    print(f"Deregistered sink {args.id}")
+    return 0
+
+
+def cmd_server_members(args) -> int:
+    """`nomad server members` (command/server_members.go shape)."""
+    c = _client(args)
+    out = c._request("GET", "/v1/operator/members")
+    leader = out.get("Leader", "")
+    rows = [[m, "leader" if m == leader else "follower"]
+            for m in out.get("Members", [])]
+    if not rows:
+        print("single-server (dev) agent; no cluster membership")
+        return 0
+    _print_rows(rows, ["Address", "Role"])
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    c = _client(args)
+    print(json.dumps(c.metrics(), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_agent_info(args) -> int:
+    c = _client(args)
+    print(json.dumps(c.agent_self(), indent=2, sort_keys=True,
+                     default=str))
+    return 0
+
+
+def cmd_acl_token_self(args) -> int:
+    c = _client(args)
+    try:
+        print(json.dumps(c.acl_token_self(), indent=2, default=str))
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_acl_policy_info(args) -> int:
+    c = _client(args)
+    try:
+        print(json.dumps(c.acl_policy(args.name), indent=2, default=str))
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_acl_policy_delete(args) -> int:
+    _client(args).acl_delete_policy(args.name)
+    print(f"Deleted policy {args.name}")
+    return 0
+
+
+def cmd_acl_token_delete(args) -> int:
+    _client(args).acl_delete_token(args.accessor_id)
+    print(f"Deleted token {args.accessor_id}")
+    return 0
+
+
 def cmd_operator_raft(args) -> int:
     c = _client(args)
     out = c._request("GET", "/v1/operator/raft/configuration")
@@ -786,6 +1029,26 @@ def build_parser() -> argparse.ArgumentParser:
     plan = job.add_parser("plan")
     plan.add_argument("jobfile")
     plan.set_defaults(fn=cmd_job_plan)
+    jdisp = job.add_parser("dispatch")
+    jdisp.add_argument("job_id")
+    jdisp.add_argument("-meta", action="append")
+    jdisp.add_argument("-payload", default="")
+    jdisp.set_defaults(fn=cmd_job_dispatch)
+    jinspect = job.add_parser("inspect")
+    jinspect.add_argument("job_id")
+    jinspect.set_defaults(fn=cmd_job_inspect)
+    jvalidate = job.add_parser("validate")
+    jvalidate.add_argument("path")
+    jvalidate.set_defaults(fn=cmd_job_validate)
+    jeval = job.add_parser("eval")
+    jeval.add_argument("job_id")
+    jeval.set_defaults(fn=cmd_job_eval)
+    jpf = job.add_parser("periodic-force")
+    jpf.add_argument("job_id")
+    jpf.set_defaults(fn=cmd_job_periodic_force)
+    jse = job.add_parser("scaling-events")
+    jse.add_argument("job_id")
+    jse.set_defaults(fn=cmd_job_scaling_events)
     scale = job.add_parser("scale")
     scale.add_argument("job_id")
     scale.add_argument("group")
@@ -852,19 +1115,58 @@ def build_parser() -> argparse.ArgumentParser:
     # pass through untouched
     aexec.add_argument("cmd", nargs=argparse.REMAINDER)
     aexec.set_defaults(fn=cmd_alloc_exec)
+    astop = alloc.add_parser("stop")
+    astop.add_argument("alloc_id")
+    astop.set_defaults(fn=cmd_alloc_stop)
+    arst = alloc.add_parser("restart")
+    arst.add_argument("alloc_id")
+    arst.add_argument("task", nargs="?", default="")
+    arst.set_defaults(fn=cmd_alloc_restart)
+    asig = alloc.add_parser("signal")
+    asig.add_argument("-s", dest="signal", default="SIGUSR1")
+    asig.add_argument("alloc_id")
+    asig.add_argument("task", nargs="?", default="")
+    asig.set_defaults(fn=cmd_alloc_signal)
 
     ev = sub.add_parser("eval").add_subparsers(dest="sub")
     estatus = ev.add_parser("status")
     estatus.add_argument("eval_id")
     estatus.set_defaults(fn=cmd_eval_status)
+    elist = ev.add_parser("list")
+    elist.set_defaults(fn=cmd_eval_list)
 
     srv = sub.add_parser("server").add_subparsers(dest="sub")
     sinfo = srv.add_parser("info")
     sinfo.set_defaults(fn=cmd_server_info)
+    smembers = srv.add_parser("members")
+    smembers.set_defaults(fn=cmd_server_members)
 
     op = sub.add_parser("operator").add_subparsers(dest="sub")
     oraft = op.add_parser("raft-status")
     oraft.set_defaults(fn=cmd_operator_raft)
+
+    scaling = sub.add_parser("scaling").add_subparsers(dest="sub")
+    spl = scaling.add_parser("policy-list")
+    spl.set_defaults(fn=cmd_scaling_policy_list)
+    spi = scaling.add_parser("policy-info")
+    spi.add_argument("policy_id")
+    spi.set_defaults(fn=cmd_scaling_policy_info)
+
+    event = sub.add_parser("event").add_subparsers(dest="sub")
+    esr = event.add_parser("sink-register")
+    esr.add_argument("sink_address")
+    esr.add_argument("-id", default="")
+    esr.set_defaults(fn=cmd_event_sink_register)
+    esl = event.add_parser("sink-list")
+    esl.set_defaults(fn=cmd_event_sink_list)
+    esd = event.add_parser("sink-deregister")
+    esd.add_argument("id")
+    esd.set_defaults(fn=cmd_event_sink_deregister)
+
+    metrics_p = sub.add_parser("metrics")
+    metrics_p.set_defaults(fn=cmd_metrics)
+    ainfo = sub.add_parser("agent-info")
+    ainfo.set_defaults(fn=cmd_agent_info)
 
     system = sub.add_parser("system").add_subparsers(dest="sub")
     sgc = system.add_parser("gc")

@@ -118,6 +118,7 @@ class TaskRunner:
         self.handle: Optional[TaskHandle] = None
         self._attached = attached
         self._kill = threading.Event()
+        self._force_restart = False     # `alloc restart` (no budget)
         self._thread: Optional[threading.Thread] = None
 
     def _prestart(self):
@@ -276,6 +277,18 @@ class TaskRunner:
                                        finished_at=time.time())
                 self.on_update()
                 return
+            # a user-requested restart (`nomad alloc restart`) loops
+            # unconditionally — any exit code, no attempt consumed
+            # (the reference restarts outside the policy budget)
+            if self._force_restart:
+                self._force_restart = False
+                self.state = TaskState(
+                    state=TASK_STATE_PENDING, restarts=restarts,
+                    events=[TaskEvent(type="Restart Signaled",
+                                      exit_code=exit_code,
+                                      time=int(time.time()))])
+                self.on_update()
+                continue
             # restart within the attempt budget regardless of mode; mode
             # only governs post-exhaustion behavior (restarts/restarts.go:
             # "delay" waits out the interval, "fail" marks the task dead)

@@ -40,7 +40,11 @@ def wait_for_previous(get_alloc, prev_id: str,
         try:
             last = get_alloc(prev_id)
         except Exception:
-            last = None
+            # transient server unreachability must NOT read as "GC'd"
+            # — that would silently skip a migration whose data still
+            # exists. Keep retrying until the deadline.
+            time.sleep(POLL_S)
+            continue
         if last is None:
             return "gone", None             # GC'd: nothing to wait on
         status = (last.get("alloc") or {}).get("client_status", "")
@@ -85,11 +89,25 @@ def _fetch_remote_tree(rpc_call, prev_id: str, rel: str,
         if e.get("IsDir"):
             _fetch_remote_tree(rpc_call, prev_id, sub_rel, sub_dst)
         else:
-            data = rpc_call("ClientFS.Cat",
-                            {"alloc_id": prev_id,
-                             "path": sub_rel})["Data"]
+            # CHUNKED pull via the frame stream: a whole-file Cat
+            # would buffer multi-GB files in RAM on both ends and
+            # blow the RPC timeout exactly when migration matters
+            offset = 0
             with open(sub_dst, "wb") as f:
-                f.write(bytes(data or b""))
+                while True:
+                    frames = rpc_call(
+                        "ClientFS.Stream",
+                        {"alloc_id": prev_id, "path": sub_rel,
+                         "offset": offset})["Frames"]
+                    progressed = False
+                    for fr in frames:
+                        data = bytes(fr.get("Data") or b"")
+                        if data:
+                            f.write(data)
+                            offset = fr["Offset"] + len(data)
+                            progressed = True
+                    if not progressed:
+                        break
             mode = e.get("FileMode")
             if mode:
                 os.chmod(sub_dst, int(mode))

@@ -121,6 +121,61 @@ class ClientRpcService:
         self.exec_sessions.remove(args["session_id"])
         return {}
 
+    # -- alloc lifecycle (client/alloc_endpoint.go Restart/Signal) -----
+    def _task_runners_for(self, alloc_id: str, task: str):
+        runner = self.client.runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(f"alloc {alloc_id[:8]} not on this node")
+        out = [tr for tr in runner.task_runners
+               if not task or tr.task.name == task]
+        if not out:
+            raise KeyError(f"unknown task {task!r}")
+        return out
+
+    def alloc_signal(self, args: Dict) -> Dict:
+        """Deliver a signal to the task process(es). Unknown signal
+        names are an ERROR — silently substituting a default would
+        deliver the wrong signal while reporting success."""
+        import signal as _signal
+        sig = args.get("signal") or _signal.SIGUSR1
+        if isinstance(sig, str):
+            name = sig.upper()
+            if not name.startswith("SIG"):
+                name = f"SIG{name}"
+            resolved = getattr(_signal, name, None)
+            if resolved is None:
+                raise ValueError(f"unknown signal {sig!r}")
+            sig = resolved
+        delivered = 0
+        for tr in self._task_runners_for(args["alloc_id"],
+                                         args.get("task", "")):
+            proc = getattr(tr.handle, "proc", None) if tr.handle else None
+            if proc is not None:
+                try:
+                    proc.send_signal(int(sig))
+                    delivered += 1
+                except (ProcessLookupError, OSError):
+                    pass
+        return {"delivered": delivered}
+
+    def alloc_restart(self, args: Dict) -> Dict:
+        """Restart the task(s): flag the runner for an unconditional
+        restart (any exit code, outside the policy budget) and stop
+        the process; the run loop brings it straight back."""
+        restarted = 0
+        for tr in self._task_runners_for(args["alloc_id"],
+                                         args.get("task", "")):
+            h = tr.handle
+            if h is None:
+                continue
+            tr._force_restart = True
+            try:
+                tr.driver.stop_task(h, 5.0)
+                restarted += 1
+            except Exception:
+                tr._force_restart = False
+        return {"restarted": restarted}
+
     # -- the method table ---------------------------------------------
     def rpc_methods(self) -> Dict:
         return {
@@ -131,4 +186,6 @@ class ClientRpcService:
             "ClientExec.Start": self.exec_start,
             "ClientExec.Io": self.exec_io,
             "ClientExec.Stop": self.exec_stop,
+            "ClientAlloc.Signal": self.alloc_signal,
+            "ClientAlloc.Restart": self.alloc_restart,
         }

@@ -712,6 +712,41 @@ class Server:
         self.raft_apply("eval_update", dict(evals=[ev]))
         return ev
 
+    def evaluate_job(self, namespace: str, job_id: str) -> Evaluation:
+        """Force a fresh evaluation of a job (job_endpoint.go
+        Evaluate) — `nomad job eval`."""
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            raise KeyError(f"job {job_id} not found")
+        ev = Evaluation(
+            namespace=namespace, priority=job.priority, type=job.type,
+            triggered_by=TRIGGER_JOB_REGISTER, job_id=job_id,
+            status=EVAL_STATUS_PENDING)
+        self.raft_apply("eval_update", dict(evals=[ev]))
+        return ev
+
+    def stop_alloc(self, alloc_id: str) -> Evaluation:
+        """Stop one allocation and evaluate its job for a replacement
+        (alloc_endpoint.go Stop: a desired transition plus an eval)."""
+        from ..models.alloc import DesiredTransition
+        alloc = self.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc {alloc_id[:8]} not found")
+        job = alloc.job or self.store.job_by_id(alloc.namespace,
+                                                alloc.job_id)
+        ev = Evaluation(
+            namespace=alloc.namespace,
+            priority=job.priority if job else 50,
+            type=job.type if job else "service",
+            triggered_by="alloc-stop", job_id=alloc.job_id,
+            status=EVAL_STATUS_PENDING)
+        self.raft_apply(
+            "alloc_desired_transition",
+            dict(alloc_ids=[alloc_id],
+                 transition=DesiredTransition(migrate=True),
+                 evals=[ev]))
+        return ev
+
     def dispatch_job(self, namespace: str, job_id: str,
                      payload: bytes = b"",
                      meta: Optional[Dict[str, str]] = None) -> Evaluation:

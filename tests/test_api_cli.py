@@ -172,3 +172,76 @@ def test_cli_flow(cluster, tmp_path, capsys):
 
     # stop it
     assert main(["-address", addr, "job", "stop", "-detach", "example"]) == 0
+
+
+def test_cli_tranche_round4(cluster, tmp_path, capsys):
+    """The round-4 command tranche against a live agent: job
+    inspect/eval/dispatch wiring, alloc stop, eval list, scaling
+    policy list, event sink CRUD, server members, metrics
+    (command/{job_*,alloc_stop,eval_status,scaling,event,server_members,
+    metrics}.go surfaces)."""
+    import io
+    import sys as _sys
+    from nomad_tpu.cli.main import main as cli_main
+    from nomad_tpu.models.job import Scaling
+
+    server, client, c = cluster
+    addr = c.address
+
+    def run_cli(*argv):
+        old = _sys.argv
+        _sys.argv = ["nomad", "-address", addr, *argv]
+        try:
+            rc = cli_main()
+        except SystemExit as e:
+            rc = int(e.code or 0)
+        finally:
+            _sys.argv = old
+        out = capsys.readouterr().out
+        return rc, out
+
+    job = mock.batch_job()
+    job.id = "cli-tranche"
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.scaling = Scaling(enabled=True, min=1, max=5)
+    tg.tasks[0].config = {"run_for": "30s"}
+    tg.tasks[0].resources.networks = []
+    tg.networks = []
+    server.register_job(job)
+    assert _wait_for(lambda: any(
+        a.client_status == "running"
+        for a in server.store.allocs_by_job("default", "cli-tranche")))
+
+    rc, out = run_cli("job", "inspect", "cli-tranche")
+    assert rc == 0 and '"cli-tranche"' in out
+
+    rc, out = run_cli("job", "eval", "cli-tranche")
+    assert rc == 0 and "Created eval" in out
+
+    rc, out = run_cli("eval", "list")
+    assert rc == 0 and "cli-tranche" in out
+
+    rc, out = run_cli("scaling", "policy-list")
+    assert rc == 0 and "cli-tranche" in out
+
+    rc, out = run_cli("server", "members")
+    assert rc == 0
+
+    rc, out = run_cli("metrics")
+    assert rc == 0 and "Counters" in out
+
+    rc, out = run_cli("event", "sink-register", "http://127.0.0.1:1/x",
+                      "-id", "cli-sink")
+    assert rc == 0
+    rc, out = run_cli("event", "sink-list")
+    assert rc == 0 and "cli-sink" in out
+    rc, out = run_cli("event", "sink-deregister", "cli-sink")
+    assert rc == 0
+
+    alloc = server.store.allocs_by_job("default", "cli-tranche")[0]
+    rc, out = run_cli("alloc", "stop", alloc.id)
+    assert rc == 0 and "Created eval" in out
+    assert _wait_for(lambda: any(
+        a.id != alloc.id
+        for a in server.store.allocs_by_job("default", "cli-tranche")))
