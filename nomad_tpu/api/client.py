@@ -17,13 +17,20 @@ class ApiError(RuntimeError):
 
 class ApiClient:
     def __init__(self, address: str = "http://127.0.0.1:4646",
+                 region: str = "",
                  token: str = ""):
         self.address = address.rstrip("/")
         self.token = token
+        # foreign region: every request carries ?region= so the local
+        # agent forwards it (nomad/rpc.go forwardRegion)
+        self.region = region
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
                  params: Optional[dict] = None) -> Any:
         url = self.address + path
+        if self.region:
+            params = dict(params or {})
+            params.setdefault("region", self.region)
         if params:
             from urllib.parse import urlencode
             url += "?" + urlencode(params)
@@ -231,6 +238,8 @@ class ApiClient:
         topics: ["Job:my-job", "Node:*"]-style filters."""
         from urllib.parse import urlencode
         params = [("topic", t) for t in (topics or [])] + [("index", index)]
+        if self.region:
+            params.append(("region", self.region))
         url = f"{self.address}/v1/event/stream?{urlencode(params)}"
         req = urllib.request.Request(url)
         with urllib.request.urlopen(req, timeout=310) as resp:

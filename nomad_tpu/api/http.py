@@ -16,6 +16,7 @@ per-domain handlers in command/agent/*_endpoint.go. Routes:
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import re
@@ -31,9 +32,16 @@ from ..models.node import DrainSpec, DrainStrategy
 from ..utils.codec import from_wire, to_wire
 
 
+def _write_chunk(wfile, data: bytes) -> None:
+    """One chunked-transfer-encoding frame (shared by the streaming
+    endpoints and the federation proxy)."""
+    wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+    wfile.flush()
+
+
 class HTTPApiServer:
     def __init__(self, server, host: str = "127.0.0.1", port: int = 4646,
-                 alloc_dir_bases=None):
+                 alloc_dir_bases=None, region_peers=None):
         self.server = server
         # where co-located clients keep alloc dirs — lets the agent
         # serve fs/logs endpoints directly (the reference forwards
@@ -41,6 +49,11 @@ class HTTPApiServer:
         import tempfile
         self.alloc_dir_bases = list(alloc_dir_bases or []) + [
             os.path.join(tempfile.gettempdir(), "nomad-tpu-allocs")]
+        # multi-region federation (nomad/rpc.go forwardRegion): other
+        # regions' agent addresses; a request stamped with a foreign
+        # region proxies there wholesale, and the remote region
+        # enforces its own ACLs
+        self.region_peers: dict = dict(region_peers or {})
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -73,6 +86,15 @@ class HTTPApiServer:
                     url = urlparse(self.path)
                     q = {k: v[0] for k, v in parse_qs(url.query).items()}
                     token = self.headers.get("X-Nomad-Token", "")
+                    # region-keyed forwarding (nomad/rpc.go forward:502
+                    # -> forwardRegion:638): a foreign-region stamp
+                    # proxies the request WHOLESALE before any local
+                    # work — local blocking-query indexes, ACLs, and
+                    # stream dispatch all belong to the owning region
+                    region = q.get("region", "")
+                    if region and region != getattr(
+                            api.server.config, "region", "global"):
+                        return api.proxy_region(self, region, method, url)
                     if url.path == "/v1/agent/monitor" and method == "GET":
                         acl = api.server.resolve_token(token)
                         if not (acl.is_management() or acl.allow_agent_read()):
@@ -239,6 +261,78 @@ class HTTPApiServer:
             return self._route_acl(method, path, body_fn, acl, token)
 
         return self._route_main(method, path, q, body_fn, ns, idx)
+
+    def proxy_region(self, handler, region: str, method: str, url) -> None:
+        """Proxy one request raw to the named region's agent
+        (forwardRegion) and relay the response verbatim — remote status
+        codes pass through untouched, and chunked bodies (event/monitor
+        streams, blocking queries) relay frame-by-frame. Writes the
+        response on `handler` directly."""
+        import urllib.error
+        import urllib.request
+        from urllib.parse import urlencode
+        peer = self.region_peers.get(region)
+        if not peer:
+            raise KeyError(f"no path to region {region!r}")
+        # rebuild the query preserving repeated params (?topic=a&topic=b)
+        pairs = [(k, v) for k, vs in parse_qs(url.query).items()
+                 if k != "region" for v in vs]
+        target = f"http://{peer}{url.path}"
+        if pairs:
+            target += "?" + urlencode(pairs)
+        data = None
+        if method in ("PUT", "POST"):
+            length = int(handler.headers.get("Content-Length", 0))
+            data = handler.rfile.read(length) if length else b"{}"
+        headers = {"Content-Type": "application/json"}
+        token = handler.headers.get("X-Nomad-Token", "")
+        if token:
+            headers["X-Nomad-Token"] = token
+        req = urllib.request.Request(target, data=data, method=method,
+                                     headers=headers)
+        # read timeout must outlive the remote's 300 s blocking-query
+        # cap; streams heartbeat every <=5 s so reads never idle long
+        try:
+            resp = urllib.request.urlopen(req, timeout=330)
+        except urllib.error.HTTPError as e:
+            resp = e                     # file-like; relay code + body
+        except urllib.error.URLError as e:
+            raise RuntimeError(f"no route to region {region!r}: {e.reason}")
+        with resp:
+            try:
+                self._relay_response(handler, resp)
+            except (BrokenPipeError, ConnectionResetError, OSError,
+                    http.client.HTTPException):
+                # either side went away mid-body (HTTPException covers
+                # IncompleteRead from a dying remote); headers are
+                # already sent, so there's nothing valid left to write
+                pass
+
+    @staticmethod
+    def _relay_response(handler, resp) -> None:
+        code = getattr(resp, "status", None) or resp.code
+        handler.send_response(code)
+        handler.send_header("Content-Type", resp.headers.get(
+            "Content-Type", "application/json"))
+        ridx = resp.headers.get("X-Nomad-Index")
+        if ridx:
+            handler.send_header("X-Nomad-Index", ridx)
+        clen = resp.headers.get("Content-Length")
+        if clen is not None:
+            handler.send_header("Content-Length", clen)
+            handler.end_headers()
+            handler.wfile.write(resp.read(int(clen)))
+            return
+        # chunked stream: relay each piece as it arrives (read1 returns
+        # what's buffered instead of blocking for a full read)
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            _write_chunk(handler.wfile, chunk)
+        handler.wfile.write(b"0\r\n\r\n")
 
     def _route_acl(self, method: str, path: str, body_fn, acl, token: str):
         """ACL endpoints (nomad/acl_endpoint.go): bootstrap once without
@@ -961,9 +1055,7 @@ class HTTPApiServer:
             handler.end_headers()
 
             def write_chunk(data: bytes):
-                handler.wfile.write(f"{len(data):x}\r\n".encode()
-                                    + data + b"\r\n")
-                handler.wfile.flush()
+                _write_chunk(handler.wfile, data)
 
             seq = 0
             while True:
@@ -993,9 +1085,7 @@ class HTTPApiServer:
             handler.end_headers()
 
             def write_chunk(data: bytes):
-                handler.wfile.write(f"{len(data):x}\r\n".encode()
-                                    + data + b"\r\n")
-                handler.wfile.flush()
+                _write_chunk(handler.wfile, data)
 
             def emit(events):
                 if not events:

@@ -32,6 +32,8 @@ from typing import List, Optional
 class AgentFileConfig:
     data_dir: str = ""
     datacenter: str = ""
+    region: str = ""
+    region_peers: dict = field(default_factory=dict)
     http_port: Optional[int] = None
     rpc_port: Optional[int] = None
     server_enabled: bool = False
@@ -53,6 +55,13 @@ def load_agent_config(path: str) -> AgentFileConfig:
     cfg = AgentFileConfig()
     cfg.data_dir = data.get("data_dir", "")
     cfg.datacenter = data.get("datacenter", "")
+    cfg.region = data.get("region", "")
+    # federation peers (the reference discovers these via WAN serf;
+    # here they're configured): region_peers { west = "host:4646" }
+    peers = data.get("region_peers") or {}
+    if isinstance(peers, list):
+        peers = peers[0]
+    cfg.region_peers = {str(k): str(v) for k, v in peers.items()}
     ports = data.get("ports") or {}
     if isinstance(ports, list):
         ports = ports[0]
@@ -113,5 +122,10 @@ def apply_to_args(cfg: AgentFileConfig, args) -> None:
         args.data_dir = cfg.data_dir
     if cfg.datacenter and not getattr(args, "datacenter", ""):
         args.datacenter = cfg.datacenter
+    if cfg.region and not getattr(args, "region", ""):
+        args.region = cfg.region
+    if cfg.region_peers and not getattr(args, "region_peers", None):
+        args.region_peers = [f"{k}={v}" for k, v in
+                             cfg.region_peers.items()]
     if cfg.meta:
         args.client_meta = cfg.meta

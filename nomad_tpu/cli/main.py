@@ -20,7 +20,8 @@ from ..utils.ids import short_id
 
 
 def _client(args) -> ApiClient:
-    return ApiClient(args.address, token=getattr(args, "token", ""))
+    return ApiClient(args.address, token=getattr(args, "token", ""),
+                     region=getattr(args, "region", "") or "")
 
 
 def _print_rows(rows: List[List[str]], header: List[str]) -> None:
@@ -31,6 +32,18 @@ def _print_rows(rows: List[List[str]], header: List[str]) -> None:
 
 
 # -- agent -------------------------------------------------------------
+def parse_region_peers(specs) -> dict:
+    """-region-peer west=10.0.0.5:4646 (repeatable) -> {name: addr}."""
+    peers = {}
+    for spec in specs:
+        name, _, addr = spec.partition("=")
+        if not name or not addr:
+            raise ValueError(
+                f"bad -region-peer {spec!r} (want name=host:port)")
+        peers[name] = addr
+    return peers
+
+
 def cmd_agent(args) -> int:
     from ..client import Client, ClientConfig
 
@@ -77,6 +90,8 @@ def cmd_agent(args) -> int:
             print("    WARNING: TPU backend unavailable; scheduling on CPU")
         server = Server(ServerConfig(num_schedulers=args.num_schedulers,
                                      acl_enabled=args.acl_enabled,
+                                     region=getattr(args, "region", "")
+                                     or "global",
                                      data_dir=getattr(args, "data_dir",
                                                       "")))
         rpc = RpcServer(server, port=args.rpc_port)
@@ -87,9 +102,16 @@ def cmd_agent(args) -> int:
             server.attach_raft(rpc, peers)
         server.start()
         rpc.start()
+        try:
+            peers = parse_region_peers(
+                getattr(args, "region_peers", None) or [])
+        except ValueError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
         api = HTTPApiServer(server, port=args.http_port,
                             alloc_dir_bases=[args.alloc_dir_base]
-                            if args.alloc_dir_base else None)
+                            if args.alloc_dir_base else None,
+                            region_peers=peers)
         api.start()
 
     n_local_clients = args.clients if is_client else 0
@@ -975,6 +997,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-address", default="http://127.0.0.1:4646")
     p.add_argument("-token", default=os.environ.get("NOMAD_TOKEN", ""),
                    help="ACL token secret (env NOMAD_TOKEN)")
+    p.add_argument("-region", default=os.environ.get("NOMAD_REGION", ""),
+                   help="target federation region (env NOMAD_REGION)")
     sub = p.add_subparsers(dest="cmd")
 
     agent = sub.add_parser("agent", help="run the agent")
@@ -993,6 +1017,14 @@ def build_parser() -> argparse.ArgumentParser:
                             "(incl. this one) to form a raft cluster")
     agent.add_argument("-alloc-dir", dest="alloc_dir_base", default="",
                        help="base directory for alloc dirs (fs/logs)")
+    # explicit -region on the subparser: without it argparse would
+    # abbreviation-match `agent ... -region X` onto -region-peer
+    agent.add_argument("-region", default=argparse.SUPPRESS,
+                       help="this agent's federation region")
+    agent.add_argument("-region-peer", dest="region_peers",
+                       action="append", default=None, metavar="NAME=ADDR",
+                       help="federation peer agent, repeatable "
+                            "(west=10.0.0.5:4646)")
     agent.add_argument("-config", default="",
                        help="HCL agent config file (flags win on merge)")
     agent.add_argument("-clients", type=int, default=1)

@@ -56,6 +56,9 @@ LOG = logging.getLogger("nomad_tpu.server")
 class ServerConfig:
     num_schedulers: int = 2
     enabled_schedulers: tuple = ("service", "batch", "system")
+    # this server's federation region (nomad/config.go Region); requests
+    # stamped with a foreign region forward to that region's agent
+    region: str = "global"
     # max READY evals one worker drains into a single batched dispatch
     # (SURVEY §2.6 row 1; 1 disables batching)
     eval_batch_size: int = 4

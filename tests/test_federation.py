@@ -1,0 +1,202 @@
+"""Multi-region federation (scoped): region-keyed request forwarding.
+
+Reference: nomad/rpc.go forward:502 — a request stamped with a foreign
+region forwards to that region's servers (forwardRegion:638); each
+region is its own raft domain with its own state and ACLs. Here the
+agent's HTTP layer proxies foreign-region requests to the peer
+region's agent wholesale.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import ApiClient, ApiError, HTTPApiServer
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.server import Server, ServerConfig
+
+
+def _wait(pred, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture
+def federation():
+    """Two regions, each a dev server+client+agent, cross-wired."""
+    east_srv = Server(ServerConfig(num_schedulers=2, region="east",
+                                   heartbeat_ttl_s=60.0))
+    west_srv = Server(ServerConfig(num_schedulers=2, region="west",
+                                   heartbeat_ttl_s=60.0))
+    east_srv.start()
+    west_srv.start()
+    east_cl = Client(east_srv, ClientConfig(node_name="east-node"))
+    west_cl = Client(west_srv, ClientConfig(node_name="west-node"))
+    east_cl.start()
+    west_cl.start()
+    east_api = HTTPApiServer(east_srv, port=0)
+    west_api = HTTPApiServer(west_srv, port=0)
+    east_api.start()
+    west_api.start()
+    east_api.region_peers["west"] = f"127.0.0.1:{west_api.port}"
+    west_api.region_peers["east"] = f"127.0.0.1:{east_api.port}"
+    yield east_srv, west_srv, east_api, west_api
+    for x in (east_api, west_api):
+        x.shutdown()
+    for x in (east_cl, west_cl):
+        x.shutdown()
+    for x in (east_srv, west_srv):
+        x.shutdown()
+
+
+def _job(job_id):
+    job = mock.batch_job()
+    job.id = job_id
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].config = {"run_for": "30s"}
+    tg.tasks[0].resources.networks = []
+    tg.networks = []
+    return job
+
+
+def test_foreign_region_requests_forward(federation):
+    east_srv, west_srv, east_api, west_api = federation
+    east = ApiClient(f"http://127.0.0.1:{east_api.port}")
+    # the same agent, addressed at the OTHER region
+    east_to_west = ApiClient(f"http://127.0.0.1:{east_api.port}",
+                             region="west")
+
+    from nomad_tpu.utils.codec import to_wire
+    east.register_job(to_wire(_job("east-job")))
+    east_to_west.register_job(to_wire(_job("west-job")))
+
+    # each job landed in ITS region's state, scheduled by that region
+    assert east_srv.store.job_by_id("default", "east-job") is not None
+    assert east_srv.store.job_by_id("default", "west-job") is None
+    assert west_srv.store.job_by_id("default", "west-job") is not None
+    assert _wait(lambda: len(
+        west_srv.store.allocs_by_job("default", "west-job")) == 1)
+
+    # reads forward too: the east agent serves west's job list
+    west_jobs = {j["ID"] for j in east_to_west.list_jobs()}
+    assert west_jobs == {"west-job"}
+    got = east_to_west.get_job("west-job")
+    assert got["id"] == "west-job"
+
+    # node listings are per-region
+    east_nodes = {n["name"] for n in east.list_nodes()}
+    west_nodes = {n["name"] for n in east_to_west.list_nodes()}
+    assert east_nodes == {"east-node"}
+    assert west_nodes == {"west-node"}
+
+
+def test_unknown_region_errors(federation):
+    _e, _w, east_api, _wa = federation
+    c = ApiClient(f"http://127.0.0.1:{east_api.port}", region="mars")
+    with pytest.raises(ApiError) as e:
+        c.list_jobs()
+    assert "mars" in str(e.value)
+
+
+def test_remote_status_codes_pass_through(federation):
+    """A 4xx from the owning region must reach the caller as that 4xx,
+    not be laundered into a local 500 (forwardRegion relays the remote
+    response verbatim)."""
+    _e, _w, east_api, _wa = federation
+    c = ApiClient(f"http://127.0.0.1:{east_api.port}", region="west")
+    with pytest.raises(ApiError) as e:
+        c.register_job({"id": ""})      # fails the remote's validation
+    assert e.value.status == 400
+
+
+def test_blocking_query_forwards_to_owning_region(federation):
+    """A foreign-region blocking query must block on the OWNING
+    region's index, not stall on the local store (whose raft domain is
+    unrelated)."""
+    east_srv, west_srv, east_api, _wa = federation
+    from nomad_tpu.utils.codec import to_wire
+    west = ApiClient(f"http://127.0.0.1:{east_api.port}", region="west")
+    west.register_job(to_wire(_job("w-block")))
+    widx = west_srv.store.latest_index()
+    # east's index is far below widx; the buggy path would block the
+    # full wait locally before forwarding
+    assert widx > east_srv.store.latest_index()
+    t0 = time.time()
+    jobs = west._request("GET", "/v1/jobs",
+                         params={"index": widx - 1, "wait": "10s"})
+    assert time.time() - t0 < 5.0
+    assert any(j["ID"] == "w-block" for j in jobs)
+
+
+def test_event_stream_forwards_across_regions(federation):
+    """The chunked event stream relays frame-by-frame through the
+    foreign agent (stream dispatch happens after the region check)."""
+    _e, west_srv, east_api, _wa = federation
+    import queue
+    import threading
+    got: "queue.Queue" = queue.Queue()
+    c = ApiClient(f"http://127.0.0.1:{east_api.port}", region="west")
+
+    def pull():
+        try:
+            for batch in c.stream_events(topics=["Job:stream-job"]):
+                got.put(batch)
+                return
+        except Exception as e:      # surfaced via the queue timeout
+            got.put(e)
+
+    th = threading.Thread(target=pull, daemon=True)
+    th.start()
+    time.sleep(0.5)                 # let the subscription register
+    from nomad_tpu.utils.codec import to_wire
+    c.register_job(to_wire(_job("stream-job")))
+    batch = got.get(timeout=15)
+    assert isinstance(batch, dict), batch
+    assert any(ev["type"] == "JobRegistered" for ev in batch["Events"])
+
+
+def test_agent_region_flags_and_config(tmp_path):
+    """The agent half of federation is configurable: -region /
+    -region-peer flags and their HCL config equivalents reach
+    ServerConfig.region and HTTPApiServer.region_peers."""
+    from nomad_tpu.cli.agent_config import apply_to_args, load_agent_config
+    from nomad_tpu.cli.main import build_parser, parse_region_peers
+
+    p = build_parser()
+    args = p.parse_args(["-region", "east", "agent", "-dev",
+                         "-region-peer", "west=10.0.0.5:4646",
+                         "-region-peer", "eu=10.0.1.5:4646"])
+    assert args.region == "east"
+    assert parse_region_peers(args.region_peers) == {
+        "west": "10.0.0.5:4646", "eu": "10.0.1.5:4646"}
+    with pytest.raises(ValueError):
+        parse_region_peers(["oops"])
+
+    cfg_file = tmp_path / "agent.hcl"
+    cfg_file.write_text('''
+region = "west"
+region_peers { east = "10.0.0.1:4646" }
+server { enabled = true }
+''')
+    cfg = load_agent_config(str(cfg_file))
+    assert cfg.region == "west"
+    assert cfg.region_peers == {"east": "10.0.0.1:4646"}
+    args2 = p.parse_args(["agent", "-config", str(cfg_file)])
+    apply_to_args(cfg, args2)
+    assert args2.region == "west"
+    assert parse_region_peers(args2.region_peers) == {
+        "east": "10.0.0.1:4646"}
+
+
+def test_local_region_stamp_is_served_locally(federation):
+    east_srv, _w, east_api, _wa = federation
+    c = ApiClient(f"http://127.0.0.1:{east_api.port}", region="east")
+    from nomad_tpu.utils.codec import to_wire
+    c.register_job(to_wire(_job("stamped-local")))
+    assert east_srv.store.job_by_id("default", "stamped-local") is not None

@@ -55,6 +55,20 @@ def _leader(servers):
     return next(s for s in servers if s.raft.is_leader())
 
 
+def _on_leader(servers, fn, timeout=20.0):
+    """Run fn(leader), re-resolving the leader on stepdown — under
+    full-suite load an election timeout can fire between resolving the
+    leader and issuing the call."""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return fn(_leader(servers))
+        except (RuntimeError, StopIteration):
+            if time.time() > deadline:
+                raise
+            time.sleep(0.1)
+
+
 @pytest.mark.slow
 def test_server_joins_live_cluster_and_replicates():
     servers, rpcs, addrs = _mk(3)
@@ -104,7 +118,7 @@ def test_operator_leave_shrinks_the_voter_set():
                      == set(addrs))
         victim = next(s for s in servers if not s.raft.is_leader())
         vaddr = rpcs[servers.index(victim)].addr
-        leader.leave_member(vaddr)
+        _on_leader(servers, lambda l: l.leave_member(vaddr))
         rest = [s for s in servers if s is not victim]
         assert _wait(lambda: all(
             vaddr not in s.store.server_members() for s in rest))
@@ -113,7 +127,7 @@ def test_operator_leave_shrinks_the_voter_set():
         assert _wait(lambda: victim.raft.cluster_size == 1)
         # writes still commit on the 2-server quorum
         node = mock.node()
-        leader.register_node(node)
+        _on_leader(rest, lambda l: l.register_node(node))
         assert _wait(lambda: all(
             s.store.node_by_id(node.id) is not None for s in rest))
     finally:
