@@ -249,6 +249,18 @@ class ApiClient:
                     continue
                 yield json.loads(line)
 
+    # -- service catalog ------------------------------------------------
+    def list_services(self, namespace: str = "default") -> list:
+        return self._request("GET", "/v1/services",
+                             params={"namespace": namespace})
+
+    def get_service(self, name: str, namespace: str = "default") -> list:
+        return self._request("GET", f"/v1/service/{name}",
+                             params={"namespace": namespace})
+
+    def delete_service_registration(self, name: str, reg_id: str) -> dict:
+        return self._request("DELETE", f"/v1/service/{name}/{reg_id}")
+
     def agent_self(self) -> dict:
         return self._request("GET", "/v1/agent/self")
 

@@ -230,6 +230,12 @@ class HTTPApiServer:
                 ns, "list-jobs" if path == "/v1/scaling/policies"
                 else "read-job"))
             return
+        if path == "/v1/services" or path.startswith("/v1/service/"):
+            # service discovery reads ride read-job; deregistration is
+            # a job-write-shaped operation
+            need(acl.allow_namespace_operation(
+                ns, "submit-job" if write else "read-job"))
+            return
         if path == "/v1/search":
             need(acl.allow_namespace(ns) or acl.allow_node_read())
             return
@@ -543,6 +549,30 @@ class HTTPApiServer:
             if pol is None:
                 return None
             return to_wire(pol), idx
+
+        # built-in service catalog (nomad service list/info; the
+        # reference's equivalent discovery surface lives in Consul)
+        if path == "/v1/services" and method == "GET":
+            return s.list_services(namespace=ns), idx
+
+        m = re.match(r"^/v1/service/([^/]+)$", path)
+        if m and method == "GET":
+            regs = s.get_service(ns, m.group(1))
+            if not regs:
+                return None
+            return [to_wire(r) for r in regs], idx
+
+        m = re.match(r"^/v1/service/([^/]+)/([^/]+)$", path)
+        if m and method == "DELETE":
+            # the id must belong to the named service in the token's
+            # namespace — a bare id would let a caller deregister
+            # across namespace boundaries
+            name, rid = m.group(1), m.group(2)
+            if not any(r.id == rid
+                       for r in store.service_by_name(ns, name)):
+                return None
+            s.update_service_registrations(delete_ids=[rid])
+            return {}, idx
 
         if path == "/v1/nodes" and method == "GET":
             prefix = q.get("prefix", "")

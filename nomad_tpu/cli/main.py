@@ -849,6 +849,29 @@ def cmd_scaling_policy_info(args) -> int:
     return 0
 
 
+def cmd_service_list(args) -> int:
+    """nomad service list (the built-in catalog's discovery surface)."""
+    c = _client(args)
+    rows = [[s["ServiceName"], ",".join(s["Tags"]), str(s["Instances"])]
+            for s in c.list_services(namespace=args.namespace)]
+    _print_rows(rows, ["Service", "Tags", "Instances"])
+    return 0
+
+
+def cmd_service_info(args) -> int:
+    c = _client(args)
+    try:
+        regs = c.get_service(args.service_name, namespace=args.namespace)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    rows = [[short_id(r["alloc_id"]), r["task_name"] or "(group)",
+             f"{r['address']}:{r['port']}", r["status"]]
+            for r in regs]
+    _print_rows(rows, ["Alloc", "Task", "Address", "Status"])
+    return 0
+
+
 def cmd_event_sink_register(args) -> int:
     c = _client(args)
     out = c.upsert_event_sink(args.sink_address, sink_id=args.id or "")
@@ -1183,6 +1206,15 @@ def build_parser() -> argparse.ArgumentParser:
     spi = scaling.add_parser("policy-info")
     spi.add_argument("policy_id")
     spi.set_defaults(fn=cmd_scaling_policy_info)
+
+    service = sub.add_parser("service").add_subparsers(dest="sub")
+    svl = service.add_parser("list")
+    svl.add_argument("-namespace", default="default")
+    svl.set_defaults(fn=cmd_service_list)
+    svi = service.add_parser("info")
+    svi.add_argument("service_name")
+    svi.add_argument("-namespace", default="default")
+    svi.set_defaults(fn=cmd_service_info)
 
     event = sub.add_parser("event").add_subparsers(dest="sub")
     esr = event.add_parser("sink-register")

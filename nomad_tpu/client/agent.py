@@ -335,6 +335,11 @@ class AllocRunner:
         self.destroyed = False
         from .allocdir import AllocDir
         self.alloc_dir = AllocDir(alloc_dir_base, alloc.id)
+        self.services = None
+        transport = getattr(client, "transport", None)
+        if transport is not None:
+            from .services_hook import AllocServices
+            self.services = AllocServices(self, transport)
 
     def run(self, attached: Optional[Dict[str, TaskHandle]] = None) -> None:
         """Start (or, with `attached` handles from driver recovery,
@@ -370,6 +375,11 @@ class AllocRunner:
         def _start_tasks_and_health():
             for tr in self.task_runners:
                 tr.start()
+            # service registration + health checking (groupservice_hook
+            # + taskrunner service_hook): registrations go to the
+            # built-in catalog through the client transport
+            if self.services is not None:
+                self.services.start()
             # the deployment health clock starts only once tasks are
             # actually released — ticking through the migration wait
             # would expire healthy_deadline before tasks ever ran
@@ -450,6 +460,8 @@ class AllocRunner:
 
     def stop(self) -> None:
         self.destroyed = True
+        if self.services is not None:
+            self.services.stop()
         for tr in self.task_runners:
             tr.kill()
 
@@ -478,6 +490,11 @@ class AllocRunner:
             else:
                 status = ALLOC_CLIENT_PENDING
             self.client_status = status
+        # terminal allocs leave the catalog even without an explicit
+        # stop (batch tasks finishing; groupservice_hook Postrun)
+        if status in (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED) \
+                and self.services is not None:
+            self.services.stop()
         self._push()
 
     def _push(self) -> None:

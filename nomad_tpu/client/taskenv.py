@@ -76,6 +76,23 @@ def build_task_env(alloc, task, node=None,
                     env[f"NOMAD_PORT_{p.label}"] = str(p.value)
                     env[f"NOMAD_ADDR_{p.label}"] = f"{nw.ip}:{p.value}"
 
+    # connect upstream bindings (env.go AddUpstreams:
+    # NOMAD_UPSTREAM_{IP,PORT,ADDR}_<service>): the sidecar proxy
+    # listens on localhost:<local_bind_port> for each upstream
+    if job is not None:
+        tg = job.lookup_task_group(alloc.task_group)
+        for svc in (tg.services if tg is not None else []):
+            cn = svc.connect
+            if cn is None or cn.sidecar_service is None or \
+                    cn.sidecar_service.proxy is None:
+                continue
+            for up in cn.sidecar_service.proxy.upstreams:
+                key = up.destination_name.replace("-", "_")
+                env[f"NOMAD_UPSTREAM_IP_{key}"] = "127.0.0.1"
+                env[f"NOMAD_UPSTREAM_PORT_{key}"] = str(up.local_bind_port)
+                env[f"NOMAD_UPSTREAM_ADDR_{key}"] = \
+                    f"127.0.0.1:{up.local_bind_port}"
+
     # user-declared env LAST so it can reference nothing but wins keys
     for k, v in (task.env or {}).items():
         env[k] = interpolate(str(v), env, node)

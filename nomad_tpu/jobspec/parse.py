@@ -57,6 +57,13 @@ def _as_list(v) -> list:
     return [v]
 
 
+def _one(v):
+    """HCL single-block access: a block parses as dict or [dict]."""
+    if isinstance(v, list):
+        return v[0] if v else None
+    return v
+
+
 def _labeled(v: Optional[dict]) -> List[tuple]:
     """{'name1': {...}, 'name2': {...}} or bare {...} -> [(label, body)]."""
     if v is None:
@@ -177,22 +184,113 @@ def _resources(body: Optional[dict]) -> Resources:
     return r
 
 
+def _connect(body: dict):
+    """connect { sidecar_service { proxy { upstreams ... } } } /
+    connect { native = true } / connect { gateway { ingress {...} } }
+    (jobspec/parse_service.go parseConnect)."""
+    from ..models.services import (
+        ConsulConnect, ConsulExposeConfig, ConsulExposePath,
+        ConsulGateway, ConsulIngressListener, ConsulIngressService,
+        ConsulProxy, ConsulSidecarService, ConsulUpstream, SidecarTask)
+    raw = body.get("connect")
+    if not raw:
+        return None
+    cn = _one(raw)
+    connect = ConsulConnect(native=bool(cn.get("native", False)))
+    if "sidecar_service" in cn:
+        ss = _one(cn["sidecar_service"]) or {}
+        proxy = None
+        if "proxy" in ss:
+            pr = _one(ss["proxy"]) or {}
+            upstreams = [ConsulUpstream(
+                destination_name=u.get("destination_name", ""),
+                local_bind_port=int(u.get("local_bind_port", 0)))
+                for u in _as_list(pr.get("upstreams"))]
+            expose = None
+            if "expose" in pr:
+                ex = _one(pr["expose"]) or {}
+                expose = ConsulExposeConfig(paths=[ConsulExposePath(
+                    path=p.get("path", ""),
+                    protocol=p.get("protocol", ""),
+                    local_path_port=int(p.get("local_path_port", 0)),
+                    listener_port=p.get("listener_port", ""))
+                    for p in _as_list(ex.get("path"))])
+            proxy = ConsulProxy(
+                local_service_address=pr.get("local_service_address", ""),
+                local_service_port=int(pr.get("local_service_port", 0)),
+                upstreams=upstreams, expose=expose,
+                config=dict(pr.get("config", {})))
+        connect.sidecar_service = ConsulSidecarService(
+            tags=list(ss.get("tags", [])), port=ss.get("port", ""),
+            proxy=proxy)
+    if "sidecar_task" in cn:
+        st = _one(cn["sidecar_task"]) or {}
+        resources = None
+        if "resources" in st:
+            r = _one(st["resources"]) or {}
+            from ..models import Resources
+            resources = Resources(cpu=int(r.get("cpu", 250)),
+                                  memory_mb=int(r.get("memory", 128)))
+        connect.sidecar_task = SidecarTask(
+            name=st.get("name", ""), driver=st.get("driver", ""),
+            user=st.get("user", ""), config=dict(_one(st.get("config"))
+                                                 or {}),
+            env=dict(_one(st.get("env")) or {}), resources=resources,
+            meta=dict(_one(st.get("meta")) or {}),
+            kill_timeout_s=parse_duration_s(st["kill_timeout"], 5.0)
+            if "kill_timeout" in st else None,
+            shutdown_delay_s=parse_duration_s(st["shutdown_delay"], 0.0)
+            if "shutdown_delay" in st else None,
+            kill_signal=st.get("kill_signal", ""))
+    if "gateway" in cn:
+        gw = _one(cn["gateway"]) or {}
+        listeners = []
+        ing = _one(gw.get("ingress")) or {}
+        for lst in _as_list(ing.get("listener")):
+            listeners.append(ConsulIngressListener(
+                port=int(lst.get("port", 0)),
+                protocol=lst.get("protocol", "tcp"),
+                services=[ConsulIngressService(
+                    name=sv.get("name", ""),
+                    hosts=list(sv.get("hosts", [])))
+                    for sv in _as_list(lst.get("service"))]))
+        connect.gateway = ConsulGateway(ingress_listeners=listeners)
+    return connect
+
+
 def _services(body: dict) -> List[Service]:
+    from ..models import CheckRestart
     out = []
     for s in _as_list(body.get("service")):
         if not isinstance(s, dict):
             continue
         checks = []
         for c in _as_list(s.get("check")):
+            cr = None
+            if "check_restart" in c:
+                crb = _one(c["check_restart"]) or {}
+                cr = CheckRestart(
+                    limit=int(crb.get("limit", 0)),
+                    grace_s=parse_duration_s(crb.get("grace"), 1.0),
+                    ignore_warnings=bool(crb.get("ignore_warnings",
+                                                 False)))
             checks.append(ServiceCheck(
                 name=c.get("name", ""), type=c.get("type", ""),
                 path=c.get("path", ""),
                 interval_s=parse_duration_s(c.get("interval"), 10.0),
                 timeout_s=parse_duration_s(c.get("timeout"), 2.0),
-                port_label=c.get("port", "")))
+                port_label=c.get("port", ""),
+                method=c.get("method", ""),
+                protocol=c.get("protocol", ""),
+                expose=bool(c.get("expose", False)),
+                task_name=c.get("task", ""),
+                check_restart=cr))
         out.append(Service(
             name=s.get("name", ""), port_label=s.get("port", ""),
-            tags=list(s.get("tags", [])), checks=checks))
+            tags=list(s.get("tags", [])), checks=checks,
+            task_name=s.get("task", ""),
+            meta=dict(_one(s.get("meta")) or {}),
+            connect=_connect(s)))
     return out
 
 

@@ -7,12 +7,18 @@ canonicalize/validate behaviors the schedulers depend on.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..utils.ids import generate_uuid
 from .resources import Resources
 from .networks import NetworkResource
+from .services import CheckRestart, ConsulConnect  # noqa: F401 -- re-exported
+
+# services.go validateServiceNameRe
+_SERVICE_NAME_RE = re.compile(
+    r"^(?i:[a-z0-9]|[a-z0-9][a-z0-9\-]{0,61}[a-z0-9])$")
 from .constraints import (  # noqa: F401 -- re-exported
     Affinity, Constraint, Spread, SpreadTarget,
     COMPARISON_OPERANDS,
@@ -158,21 +164,85 @@ class LogConfig:
 
 @dataclass
 class ServiceCheck:
+    """Health check spec (services.go ServiceCheck:42)."""
     name: str = ""
     type: str = ""          # http | tcp | script | grpc
     path: str = ""
     interval_s: float = 10.0
     timeout_s: float = 2.0
     port_label: str = ""
+    method: str = ""                        # http method, GET default
+    protocol: str = ""                      # http|https for http checks
+    address_mode: str = ""
+    initial_status: str = ""
+    expose: bool = False
+    success_before_passing: int = 0
+    failures_before_critical: int = 0
+    task_name: str = ""
+    check_restart: Optional["CheckRestart"] = None
+
+    def validate(self) -> List[str]:
+        """services.go ServiceCheck.validate: known type, http checks
+        need a path, intervals/timeouts have 1 s floors."""
+        errs = []
+        kind = self.type.lower()
+        if kind not in ("http", "tcp", "script", "grpc"):
+            errs.append(f"invalid check type {self.type!r}")
+        if kind == "http" and not self.path:
+            errs.append(f"http check {self.name or '(unnamed)'} requires "
+                        "a path")
+        if self.interval_s < 1.0:
+            errs.append(f"check interval {self.interval_s}s below 1s "
+                        "minimum")
+        if self.timeout_s < 1.0:
+            errs.append(f"check timeout {self.timeout_s}s below 1s "
+                        "minimum")
+        if self.check_restart is not None and self.check_restart.limit < 0:
+            errs.append("check_restart limit can't be negative")
+        return errs
 
 
 @dataclass
 class Service:
+    """services.go Service:~380 (group- or task-level)."""
     name: str = ""
     port_label: str = ""
     tags: List[str] = field(default_factory=list)
     checks: List[ServiceCheck] = field(default_factory=list)
     address_mode: str = "auto"
+    task_name: str = ""                     # which task backs it
+    meta: Dict[str, str] = field(default_factory=dict)
+    connect: Optional["ConsulConnect"] = None
+
+    def canonicalize(self, job: str, group: str, task: str) -> None:
+        """services.go Service.Canonicalize:450 — resolve the
+        JOB/TASKGROUP/TASK/BASE name variables so validation sees the
+        real name."""
+        base = f"{job}-{group}-{task}" if task else f"{job}-{group}"
+        if not self.name:
+            self.name = base
+        for var, val in (("JOB", job), ("TASKGROUP", group),
+                         ("TASK", task), ("BASE", base)):
+            self.name = self.name.replace("${" + var + "}", val)
+        for c in self.checks:
+            if not c.name:
+                c.name = f"service: {self.name!r} check"
+
+    def validate(self) -> List[str]:
+        """services.go Service.Validate: RFC-1123-ish name + checks +
+        connect exclusivity (the group-shape connect rules live in the
+        admission hook, job_endpoint_hook_connect.go)."""
+        errs = []
+        if not _SERVICE_NAME_RE.match(self.name or ""):
+            errs.append(
+                f"service name {self.name!r} must be 1-63 characters, "
+                "alphanumeric or -, and start/end alphanumeric")
+        for c in self.checks:
+            errs.extend(f"check {c.name or c.type}: {e}"
+                        for e in c.validate())
+        if self.connect is not None:
+            errs.extend(self.connect.validate())
+        return errs
 
 
 @dataclass
@@ -220,6 +290,9 @@ class Task:
     name: str = ""
     driver: str = ""
     user: str = ""
+    # "connect-proxy:<svc>" / "connect-native:<svc>" /
+    # "connect-ingress:<svc>" (structs.go TaskKind)
+    kind: str = ""
     config: Dict[str, object] = field(default_factory=dict)
     env: Dict[str, str] = field(default_factory=dict)
     services: List[Service] = field(default_factory=list)
@@ -243,6 +316,8 @@ class Task:
         if self.resources is None:
             self.resources = Resources()
         self.resources.canonicalize()
+        for s in self.services:
+            s.canonicalize(job.name, tg.name, self.name)
 
     def validate(self) -> List[str]:
         errs = []
@@ -261,6 +336,15 @@ class Task:
             errs.extend(c.validate())
         for a in self.affinities:
             errs.extend(a.validate())
+        for s in self.services:
+            errs.extend(f"service {s.name}: {e}" for e in s.validate())
+            for c in s.checks:
+                if c.type.lower() in ("tcp", "http") and \
+                        not c.port_label and not s.port_label:
+                    errs.append(
+                        f"service {s.name}: check "
+                        f"{c.name or c.type} requires a port but the "
+                        "service doesn't have one")
         return errs
 
     def is_prestart(self) -> bool:
@@ -348,6 +432,8 @@ class TaskGroup:
         # behavior in the reference (api/tasks.go), not structs canonicalize;
         # defaulting it at this layer would create deployments for every
         # bare service job.
+        for s in self.services:
+            s.canonicalize(job.name, self.name, "")
         for t in self.tasks:
             t.canonicalize(job, self)
 
@@ -365,6 +451,19 @@ class TaskGroup:
                 errs.append(f"task {t.name} defined multiple times")
             names.add(t.name)
             errs.extend(f"task {t.name}: {e}" for e in t.validate())
+        for s in self.services:
+            errs.extend(f"service {s.name}: {e}" for e in s.validate())
+            # tcp/http checks probe a real socket: without a port label
+            # on the check or service they'd probe port 0 forever (the
+            # reference rejects these at submit, services.go
+            # validateCheckPort)
+            for c in s.checks:
+                if c.type.lower() in ("tcp", "http") and \
+                        not c.port_label and not s.port_label:
+                    errs.append(
+                        f"service {s.name}: check "
+                        f"{c.name or c.type} requires a port but the "
+                        "service doesn't have one")
         for c in self.constraints:
             errs.extend(c.validate())
         for s in self.spreads:

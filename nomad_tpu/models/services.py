@@ -1,0 +1,238 @@
+"""Consul Connect service-mesh model + the built-in service registry.
+
+Reference: nomad/structs/services.go — ConsulConnect:672,
+ConsulSidecarService:781, SidecarTask:830, ConsulProxy:1024,
+ConsulUpstream:1121, ConsulExposeConfig:1169, ConsulGateway:1221 —
+plus CheckRestart (structs.go:6378). The reference registers services
+into an external Consul agent; here registrations land in the
+framework's own replicated state store (a built-in catalog), so
+service discovery works with no external dependency while the job-spec
+surface stays the same.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+CONNECT_PROXY_PREFIX = "connect-proxy"
+CONNECT_NATIVE_PREFIX = "connect-native"
+CONNECT_INGRESS_PREFIX = "connect-ingress"
+
+
+@dataclass
+class CheckRestart:
+    """Restart a task when its check stays unhealthy (structs.go
+    CheckRestart:6378): `limit` consecutive unhealthy intervals after a
+    `grace` warm-up restarts the task."""
+    limit: int = 0
+    grace_s: float = 1.0
+    ignore_warnings: bool = False
+
+
+@dataclass
+class ConsulUpstream:
+    """services.go ConsulUpstream:1121."""
+    destination_name: str = ""
+    local_bind_port: int = 0
+
+    def validate(self) -> List[str]:
+        errs = []
+        if not self.destination_name:
+            errs.append("upstream destination name is required")
+        if self.local_bind_port <= 0:
+            errs.append(f"upstream local bind port {self.local_bind_port} "
+                        "must be > 0")
+        return errs
+
+
+@dataclass
+class ConsulExposePath:
+    """services.go ConsulExposePath:1174."""
+    path: str = ""
+    protocol: str = ""
+    local_path_port: int = 0
+    listener_port: str = ""
+
+
+@dataclass
+class ConsulExposeConfig:
+    """services.go ConsulExposeConfig:1169."""
+    paths: List[ConsulExposePath] = field(default_factory=list)
+
+
+@dataclass
+class ConsulProxy:
+    """services.go ConsulProxy:1024."""
+    local_service_address: str = ""
+    local_service_port: int = 0
+    upstreams: List[ConsulUpstream] = field(default_factory=list)
+    expose: Optional[ConsulExposeConfig] = None
+    config: Dict[str, object] = field(default_factory=dict)
+
+    def validate(self) -> List[str]:
+        errs = []
+        seen = set()
+        for u in self.upstreams:
+            errs.extend(u.validate())
+            key = (u.destination_name, u.local_bind_port)
+            if key in seen:
+                errs.append(f"duplicate upstream {u.destination_name}")
+            seen.add(key)
+        return errs
+
+
+@dataclass
+class ConsulSidecarService:
+    """services.go ConsulSidecarService:781."""
+    tags: List[str] = field(default_factory=list)
+    port: str = ""
+    proxy: Optional[ConsulProxy] = None
+
+    def has_upstreams(self) -> bool:
+        return self.proxy is not None and bool(self.proxy.upstreams)
+
+
+@dataclass
+class SidecarTask:
+    """Operator overrides merged onto the injected proxy task
+    (services.go SidecarTask:830 MergeIntoTask)."""
+    name: str = ""
+    driver: str = ""
+    user: str = ""
+    config: Dict[str, object] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    resources: Optional[object] = None          # models.Resources
+    meta: Dict[str, str] = field(default_factory=dict)
+    kill_timeout_s: Optional[float] = None
+    shutdown_delay_s: Optional[float] = None
+    kill_signal: str = ""
+
+    def merge_into(self, task) -> None:
+        """services.go MergeIntoTask:905 — non-zero fields override."""
+        if self.name:
+            task.name = self.name
+        if self.driver:
+            task.driver = self.driver
+        if self.user:
+            task.user = self.user
+        if self.config:
+            task.config = dict(self.config)
+        if self.env:
+            task.env.update(self.env)
+        if self.resources is not None:
+            task.resources = self.resources
+        if self.meta:
+            task.meta = dict(self.meta)
+        if self.kill_timeout_s is not None:
+            task.kill_timeout_s = self.kill_timeout_s
+        if self.shutdown_delay_s is not None:
+            task.shutdown_delay_s = self.shutdown_delay_s
+        if self.kill_signal:
+            task.kill_signal = self.kill_signal
+
+
+@dataclass
+class ConsulIngressService:
+    """services.go ConsulIngressService:~"""
+    name: str = ""
+    hosts: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ConsulIngressListener:
+    """services.go ConsulIngressListener."""
+    port: int = 0
+    protocol: str = "tcp"
+    services: List[ConsulIngressService] = field(default_factory=list)
+
+    def validate(self) -> List[str]:
+        errs = []
+        if self.port <= 0:
+            errs.append("ingress listener requires a port")
+        if self.protocol not in ("tcp", "http"):
+            errs.append(f"invalid listener protocol {self.protocol!r}")
+        if not self.services:
+            errs.append("ingress listener requires one or more services")
+        return errs
+
+
+@dataclass
+class ConsulGateway:
+    """services.go ConsulGateway:1221 (ingress subset)."""
+    ingress_listeners: List[ConsulIngressListener] = field(
+        default_factory=list)
+
+    def validate(self) -> List[str]:
+        errs = []
+        if not self.ingress_listeners:
+            errs.append("gateway requires an ingress block")
+        for lst in self.ingress_listeners:
+            errs.extend(lst.validate())
+        return errs
+
+
+@dataclass
+class ConsulConnect:
+    """services.go ConsulConnect:672 — exactly one of native, sidecar,
+    gateway."""
+    native: bool = False
+    sidecar_service: Optional[ConsulSidecarService] = None
+    sidecar_task: Optional[SidecarTask] = None
+    gateway: Optional[ConsulGateway] = None
+
+    def has_sidecar(self) -> bool:
+        return self.sidecar_service is not None
+
+    def is_native(self) -> bool:
+        return self.native
+
+    def is_gateway(self) -> bool:
+        return self.gateway is not None
+
+    def validate(self) -> List[str]:
+        count = sum((self.has_sidecar(), self.is_native(),
+                     self.is_gateway()))
+        if count != 1:
+            return ["Consul Connect must be exclusively native, make use "
+                    "of a sidecar, or represent a Gateway"]
+        errs = []
+        if self.is_gateway():
+            errs.extend(self.gateway.validate())
+        if self.has_sidecar() and self.sidecar_service.proxy is not None:
+            errs.extend(self.sidecar_service.proxy.validate())
+        return errs
+
+
+# -- the built-in catalog ---------------------------------------------
+SERVICE_STATUS_PASSING = "passing"
+SERVICE_STATUS_CRITICAL = "critical"
+SERVICE_STATUS_PENDING = "pending"
+
+
+@dataclass
+class ServiceRegistration:
+    """One live instance of a service in the built-in catalog. The
+    reference delegates this row to Consul's agent
+    (command/agent/consul/service_client.go serviceRegistration); here
+    it is first-class replicated state keyed
+    `<alloc_id>-<group|task>-<service>`."""
+    id: str = ""
+    service_name: str = ""
+    namespace: str = "default"
+    node_id: str = ""
+    job_id: str = ""
+    alloc_id: str = ""
+    task_name: str = ""                 # "" for group services
+    tags: List[str] = field(default_factory=list)
+    address: str = ""
+    port: int = 0
+    status: str = SERVICE_STATUS_PENDING   # aggregate check status
+    checks: Dict[str, str] = field(default_factory=dict)  # name->status
+    create_index: int = 0
+    modify_index: int = 0
+
+
+def registration_id(alloc_id: str, owner: str, service_name: str) -> str:
+    """Stable catalog row key: owner is the group or task name."""
+    return f"_nomad-{alloc_id}-{owner}-{service_name}"

@@ -84,6 +84,16 @@ def build_method_table(server) -> Dict[str, Any]:
         from .transport import _alloc_with_node
         return _alloc_with_node(server, args["alloc_id"])
 
+    def service_update(args):
+        from ..models.services import ServiceRegistration
+        upserts = [from_wire(ServiceRegistration, s)
+                   for s in args.get("upserts") or []]
+        server.update_service_registrations(
+            upserts=upserts,
+            delete_alloc_ids=args.get("delete_alloc_ids"),
+            delete_ids=args.get("delete_ids"))
+        return {}
+
     return {
         "Node.Register": node_register,
         "Node.UpdateStatus": node_update_status,
@@ -96,13 +106,15 @@ def build_method_table(server) -> Dict[str, Any]:
         "Server.Leave": server_leave,
         "Server.Members": server_members,
         "Alloc.GetAlloc": alloc_get,
+        "Service.Update": service_update,
     }
 
 
 # client-facing writes that must run on the leader (rpc.go forward())
 WRITE_METHODS = frozenset({"Node.Register", "Node.UpdateStatus",
                            "Node.Heartbeat", "Node.UpdateAlloc",
-                           "Server.Join", "Server.Leave"})
+                           "Server.Join", "Server.Leave",
+                           "Service.Update"})
 
 
 class RpcServer:

@@ -39,6 +39,12 @@ class ServerTransport:
     def derive_vault_token(self, alloc_id: str, tasks) -> dict:
         raise NotImplementedError
 
+    def update_services(self, upserts=None, delete_alloc_ids=None,
+                        delete_ids=None) -> None:
+        """Sync this client's service registrations into the catalog
+        (the reference's Consul sync, command/agent/consul)."""
+        raise NotImplementedError
+
 
 def _alloc_with_node(server, alloc_id: str):
     """{alloc: wire, node_rpc: addr} or None — the alloc-watcher's
@@ -86,6 +92,12 @@ class InProcTransport(ServerTransport):
     def derive_vault_token(self, alloc_id: str, tasks) -> dict:
         return self.server.derive_vault_token(alloc_id, list(tasks))
 
+    def update_services(self, upserts=None, delete_alloc_ids=None,
+                        delete_ids=None) -> None:
+        self.server.update_service_registrations(
+            upserts=upserts, delete_alloc_ids=delete_alloc_ids,
+            delete_ids=delete_ids)
+
     def get_alloc(self, alloc_id: str):
         return _alloc_with_node(self.server, alloc_id)
 
@@ -129,6 +141,13 @@ class RemoteTransport(ServerTransport):
         return self.rpc.call("Node.DeriveVaultToken",
                              {"alloc_id": alloc_id,
                               "tasks": list(tasks)})["tokens"]
+
+    def update_services(self, upserts=None, delete_alloc_ids=None,
+                        delete_ids=None) -> None:
+        self.rpc.call("Service.Update",
+                      {"upserts": [to_wire(s) for s in upserts or []],
+                       "delete_alloc_ids": list(delete_alloc_ids or []),
+                       "delete_ids": list(delete_ids or [])})
 
     def get_alloc(self, alloc_id: str):
         """Status + owning-node info of any alloc (the alloc-watcher's

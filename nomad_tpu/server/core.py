@@ -62,6 +62,10 @@ class ServerConfig:
     # max READY evals one worker drains into a single batched dispatch
     # (SURVEY §2.6 row 1; 1 disables batching)
     eval_batch_size: int = 4
+    # driver/config for injected connect proxy tasks (the reference
+    # hardcodes docker+envoy, job_endpoint_hook_connect.go:23)
+    connect_sidecar_driver: str = "docker"
+    connect_sidecar_config: Optional[dict] = None
     heartbeat_ttl_s: float = 10.0
     failed_eval_unblock_delay_s: float = 60.0
     dev_mode: bool = True
@@ -618,6 +622,17 @@ class Server:
     def _apply_acl_token_delete(self, index: int, p: dict) -> None:
         self.store.delete_acl_tokens(index, p["accessor_ids"])
 
+    # service registry appliers (built-in catalog; the reference sends
+    # these to Consul, command/agent/consul/service_client.go)
+    def _apply_service_registration_upsert(self, index: int,
+                                           p: dict) -> None:
+        self.store.upsert_service_registrations(index, p["services"])
+
+    def _apply_service_registration_delete(self, index: int,
+                                           p: dict) -> None:
+        self.store.delete_service_registrations(
+            index, ids=p.get("ids"), alloc_ids=p.get("alloc_ids"))
+
     # CSI volume appliers (fsm.go applyCSIVolume*)
     def _apply_csi_volume_register(self, index: int, p: dict) -> None:
         self.store.upsert_csi_volumes(index, p["volumes"])
@@ -699,8 +714,13 @@ class Server:
         get no eval — the dispatcher / Job.Dispatch creates child jobs
         which do (job_endpoint.go:236-247)."""
         job.canonicalize()
+        # connect hook (job_endpoint_hook_connect.go): inject sidecar /
+        # gateway proxy tasks before implied constraints and validation
+        from .connect_hook import connect_mutate, connect_validate
+        connect_mutate(job, self.config.connect_sidecar_driver,
+                       self.config.connect_sidecar_config)
         self._implied_constraints(job)
-        errs = job.validate()
+        errs = connect_validate(job) + job.validate()
         if errs:
             raise ValueError("; ".join(errs))
         index = self.raft_apply("job_register", dict(job=job, evals=[]))
@@ -1199,6 +1219,45 @@ class Server:
                     ltarget="${attr.os.signals}",
                     rtarget=",".join(sorted(signals)),
                     operand="set_contains"))
+
+    # -- service registry (built-in catalog) ---------------------------
+    def update_service_registrations(self, upserts=None,
+                                     delete_alloc_ids=None,
+                                     delete_ids=None) -> int:
+        """Client-driven catalog sync: register live services, drop the
+        rows of stopped allocs (the reference's Consul sync loop,
+        command/agent/consul/service_client.go sync)."""
+        index = 0
+        if upserts:
+            index = self.raft_apply("service_registration_upsert",
+                                    dict(services=list(upserts)))
+        if delete_alloc_ids or delete_ids:
+            index = self.raft_apply(
+                "service_registration_delete",
+                dict(ids=list(delete_ids or []),
+                     alloc_ids=list(delete_alloc_ids or [])))
+        return index
+
+    def list_services(self, namespace: str = "default") -> list:
+        """Per-service summary (nomad service list analog): name, tags,
+        live instance count."""
+        summary: Dict[str, dict] = {}
+        for s in self.store.service_registrations(namespace):
+            row = summary.setdefault(
+                s.service_name,
+                {"ServiceName": s.service_name, "Namespace": s.namespace,
+                 "Tags": set(), "Instances": 0})
+            row["Tags"].update(s.tags)
+            row["Instances"] += 1
+        out = []
+        for name in sorted(summary):
+            row = summary[name]
+            row["Tags"] = sorted(row["Tags"])
+            out.append(row)
+        return out
+
+    def get_service(self, namespace: str, name: str) -> list:
+        return self.store.service_by_name(namespace, name)
 
     # -- CSI volumes (nomad/csi_endpoint.go; volumewatcher/) -----------
     def register_csi_volume(self, volume) -> int:

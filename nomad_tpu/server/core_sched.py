@@ -38,6 +38,7 @@ class CoreScheduler:
         job = ev.job_id
         if job == CORE_JOB_EVAL_GC:
             self._eval_gc(self._cutoff(self.srv.config.eval_gc_threshold_s))
+            self._service_gc()
         elif job == CORE_JOB_JOB_GC:
             self._job_gc(self._cutoff(self.srv.config.job_gc_threshold_s))
         elif job == CORE_JOB_NODE_GC:
@@ -51,6 +52,7 @@ class CoreScheduler:
             self._eval_gc(cutoff)
             self._job_gc(cutoff)
             self._node_gc(cutoff)
+            self._service_gc()
         else:
             LOG.warning("unknown core gc job %r", job)
 
@@ -137,6 +139,25 @@ class CoreScheduler:
             self.srv.raft_apply(
                 "job_deregister", dict(namespace=job.namespace, job_id=job.id,
                                        purge=True, evals=[]))
+
+    def _service_gc(self) -> None:
+        """Catalog sweep: a crashed node's client never sends its
+        deregistrations, so drop registrations whose alloc is gone or
+        terminal, or whose node is down (the reference's equivalent is
+        Consul's anti-entropy against the dead agent)."""
+        doomed = []
+        for reg in self.snap.service_registrations():
+            alloc = self.snap.alloc_by_id(reg.alloc_id)
+            if alloc is None or alloc.terminal_status():
+                doomed.append(reg.id)
+                continue
+            node = self.snap.node_by_id(reg.node_id)
+            if node is None or node.terminal_status():
+                doomed.append(reg.id)
+        if doomed:
+            LOG.info("service GC: %d registrations", len(doomed))
+            self.srv.raft_apply("service_registration_delete",
+                                dict(ids=doomed))
 
     def _node_gc(self, cutoff: int) -> None:
         """core_sched.go nodeGC: down nodes past the threshold with no

@@ -22,6 +22,7 @@ TOPIC_EVAL = "Evaluation"
 TOPIC_ALLOC = "Allocation"
 TOPIC_DEPLOYMENT = "Deployment"
 TOPIC_NODE = "Node"
+TOPIC_SERVICE = "Service"
 TOPIC_ALL = "*"
 
 ALL_KEYS = "*"
@@ -206,4 +207,13 @@ def events_from_apply(msg_type: str, payload: dict, index: int) -> List[Event]:
     elif msg_type == "deployment_promotion":
         add(TOPIC_DEPLOYMENT, "DeploymentPromotion",
             payload["deployment_id"])
+    elif msg_type == "service_registration_upsert":
+        for s in payload.get("services", []):
+            add(TOPIC_SERVICE, "ServiceRegistration", s.service_name,
+                s.namespace, s)
+    elif msg_type == "service_registration_delete":
+        for rid in payload.get("ids", []):
+            add(TOPIC_SERVICE, "ServiceDeregistration", rid)
+        for aid in payload.get("alloc_ids", []):
+            add(TOPIC_SERVICE, "ServiceDeregistration", aid)
     return out

@@ -80,6 +80,11 @@ def _register_acl_schemas() -> None:
         "event_sink_delete": {},
         "event_sink_progress": {},
     })
+    from ..models.services import ServiceRegistration
+    SCHEMAS.update({
+        "service_registration_upsert": {"services": [ServiceRegistration]},
+        "service_registration_delete": {},
+    })
 
 
 _register_acl_schemas()

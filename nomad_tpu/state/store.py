@@ -101,10 +101,10 @@ class _Root:
 TABLES = (
     "nodes", "jobs", "job_versions", "evals", "allocs", "deployments",
     "job_summaries", "scheduler_config", "periodic_launches",
-    "acl_policies", "acl_tokens", "csi_volumes",
+    "acl_policies", "acl_tokens", "csi_volumes", "service_registrations",
     # secondary indexes
     "allocs_by_node", "allocs_by_job", "allocs_by_eval", "evals_by_job",
-    "deployments_by_job",
+    "deployments_by_job", "services_by_name", "services_by_alloc",
 )
 
 JOB_TRACKED_VERSIONS = 6  # structs.go JobTrackedVersions
@@ -278,6 +278,33 @@ class StateSnapshot:
         return (self._root.table("scheduler_config").get("config")
                 or SchedulerConfiguration())
 
+    # -- service registry reads (built-in catalog) ---------------------
+    def service_registrations(self, namespace: Optional[str] = None
+                              ) -> List:
+        out = [s for s in
+               self._root.table("service_registrations").values()
+               if namespace is None or s.namespace == namespace]
+        out.sort(key=lambda s: (s.service_name, s.id))
+        return out
+
+    def service_by_name(self, namespace: str, name: str) -> List:
+        members = self._root.table("services_by_name").get(
+            (namespace, name))
+        if members is None:
+            return []
+        t = self._root.table("service_registrations")
+        out = [t.get(rid) for rid in members.keys()]
+        return sorted((s for s in out if s is not None),
+                      key=lambda s: s.id)
+
+    def services_by_alloc(self, alloc_id: str) -> List:
+        members = self._root.table("services_by_alloc").get(alloc_id)
+        if members is None:
+            return []
+        t = self._root.table("service_registrations")
+        return sorted((s for s in (t.get(rid) for rid in members.keys())
+                       if s is not None), key=lambda s: s.id)
+
     # -- checkpoint (fsm.go Snapshot:1360) -----------------------------
     def dump(self) -> dict:
         """Wire-encode the full database for a snapshot file. Defined on
@@ -320,6 +347,9 @@ class StateSnapshot:
                                root.table("acl_tokens").values()]
         plain["csi_volumes"] = [to_wire(v) for v in
                                 root.table("csi_volumes").values()]
+        plain["service_registrations"] = [
+            to_wire(s) for s in
+            root.table("service_registrations").values()]
         return out
 
 
@@ -1342,6 +1372,68 @@ class StateStore(StateSnapshot):
                        .with_index("acl_policies", index)
             self._publish(root)
 
+    # -- service registry (built-in catalog; the reference delegates
+    # -- to Consul via command/agent/consul/service_client.go) ---------
+    def upsert_service_registrations(self, index: int,
+                                     services: List) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("service_registrations")
+            for s in services:
+                existing = t.get(s.id)
+                # own the row: in-proc transports hand us the client's
+                # LIVE objects, and its check threads keep mutating them
+                s = replace(s, tags=list(s.tags), checks=dict(s.checks))
+                s.create_index = existing.create_index if existing \
+                    else index
+                s.modify_index = index
+                if existing is not None and \
+                        (existing.namespace, existing.service_name) != \
+                        (s.namespace, s.service_name):
+                    root = self._index_del(
+                        root, "services_by_name",
+                        (existing.namespace, existing.service_name),
+                        s.id)
+                t = t.set(s.id, s)
+                root = self._index_add(root, "services_by_name",
+                                       (s.namespace, s.service_name),
+                                       s.id)
+                root = self._index_add(root, "services_by_alloc",
+                                       s.alloc_id, s.id)
+            root = root.with_table("service_registrations", t) \
+                       .with_index("service_registrations", index)
+            self._publish(root)
+
+    def delete_service_registrations(self, index: int,
+                                     ids: Optional[List[str]] = None,
+                                     alloc_ids: Optional[List[str]] = None
+                                     ) -> None:
+        """Remove catalog rows by id and/or every row an alloc owns."""
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("service_registrations")
+            doomed = list(ids or [])
+            for alloc_id in alloc_ids or []:
+                members = root.table("services_by_alloc").get(alloc_id)
+                if members is not None:
+                    doomed.extend(members.keys())
+            changed = False
+            for rid in doomed:
+                s = t.get(rid)
+                if s is None:
+                    continue
+                t = t.delete(rid)
+                root = self._index_del(root, "services_by_name",
+                                       (s.namespace, s.service_name),
+                                       rid)
+                root = self._index_del(root, "services_by_alloc",
+                                       s.alloc_id, rid)
+                changed = True
+            if changed:
+                root = root.with_table("service_registrations", t) \
+                           .with_index("service_registrations", index)
+                self._publish(root)
+
     def acl_policy(self, name: str):
         return self._root.table("acl_policies").get(name)
 
@@ -1624,6 +1716,20 @@ class StateStore(StateSnapshot):
                 v = from_wire(CSIVolume, w)
                 t = t.set((v.namespace, v.id), v)
             root = root.with_table("csi_volumes", t)
+
+            from ..models.services import ServiceRegistration
+            t = root.table("service_registrations")
+            for w in data["tables"].get("service_registrations", []):
+                s = from_wire(ServiceRegistration, w)
+                t = t.set(s.id, s)
+                root = root.with_table("service_registrations", t)
+                root = self._index_add(root, "services_by_name",
+                                       (s.namespace, s.service_name),
+                                       s.id)
+                root = self._index_add(root, "services_by_alloc",
+                                       s.alloc_id, s.id)
+                t = root.table("service_registrations")
+            root = root.with_table("service_registrations", t)
 
             from ..acl import AclPolicy, AclToken
             t = root.table("acl_policies")
