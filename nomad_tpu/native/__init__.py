@@ -28,33 +28,47 @@ _loaded = None
 _attempted = False
 
 
-def _cache_path() -> str:
-    with open(_SRC, "rb") as f:
+def _cache_path(src: str, name: str) -> str:
+    with open(src, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
     abi = sysconfig.get_config_var("SOABI") or "abi3"
     cache_dir = os.environ.get(
         "NOMAD_TPU_NATIVE_CACHE",
         os.path.join(os.path.expanduser("~"), ".cache", "nomad-tpu"))
     os.makedirs(cache_dir, exist_ok=True)
-    return os.path.join(cache_dir,
-                        f"nomad_tpu_native_codec-{digest}.{abi}.so")
+    return os.path.join(cache_dir, f"{name}-{digest}.{abi}.so")
 
 
-def _build(so_path: str) -> bool:
+def _build(src: str, so_path: str) -> bool:
     include = sysconfig.get_path("include")
     cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
-           f"-I{include}", _SRC, "-o", so_path + ".tmp"]
+           f"-I{include}", src, "-o", so_path + ".tmp"]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
-        LOG.warning("native codec build failed to run: %s", e)
+        LOG.warning("native build failed to run: %s", e)
         return False
     if out.returncode != 0:
-        LOG.warning("native codec build failed:\n%s", out.stderr[-2000:])
+        LOG.warning("native build failed:\n%s", out.stderr[-2000:])
         return False
     os.replace(so_path + ".tmp", so_path)
     return True
+
+
+def _load_module(src: str, name: str):
+    """Build (cached by source hash) and import one native module, or
+    None on any failure — callers keep their pure-python fallback."""
+    if os.environ.get("NOMAD_TPU_NATIVE", "1") == "0":
+        return None
+    so = _cache_path(src, name)
+    if not os.path.exists(so) and not _build(src, so):
+        return None
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(name, so)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def load_codec():
@@ -64,17 +78,10 @@ def load_codec():
     if _loaded is not None or _attempted:
         return _loaded
     _attempted = True
-    if os.environ.get("NOMAD_TPU_NATIVE", "1") == "0":
-        return None
     try:
-        so = _cache_path()
-        if not os.path.exists(so) and not _build(so):
+        mod = _load_module(_SRC, "nomad_tpu_native_codec")
+        if mod is None:
             return None
-        import importlib.util
-        spec = importlib.util.spec_from_file_location(
-            "nomad_tpu_native_codec", so)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
         # self-check before trusting it on the wire
         probe = {"a": [1, -7, 2.5, "x", b"\x00\xff", None, True],
                  "nested": {"k": [list(range(40))]}}
@@ -88,4 +95,40 @@ def load_codec():
         return mod
     except Exception as e:       # pragma: no cover — env-dependent
         LOG.warning("native codec unavailable: %s", e)
+        return None
+
+
+_kway_loaded = None
+_kway_attempted = False
+
+
+def load_kway():
+    """The native k-way stream merge (kway.cpp) used by the placement
+    kernel's host expansion, or None (python-heap fallback)."""
+    global _kway_loaded, _kway_attempted
+    if _kway_loaded is not None or _kway_attempted:
+        return _kway_loaded
+    _kway_attempted = True
+    try:
+        mod = _load_module(os.path.join(_HERE, "kway.cpp"),
+                           "nomad_tpu_native_kway")
+        if mod is None:
+            return None
+        # self-check: two streams, scores [3,1] on node 5 and [2,4] on
+        # node 9 -> pop order (row,j): (0,0) s=3, (1,0) s=2 ... heads
+        # compared, stream 1 advances to 4 -> (1,1), then (0,1)
+        import struct
+        scores = struct.pack("4f", 3.0, 1.0, 2.0, 4.0)
+        nodes = struct.pack("2i", 5, 9)
+        lens = struct.pack("2i", 2, 2)
+        out = mod.merge(scores, nodes, lens, 2, 100)
+        got = struct.unpack("8i", out)
+        if got != (0, 1, 1, 0, 0, 0, 1, 1):
+            LOG.warning("native kway self-check failed; falling back "
+                        "(%r)", got)
+            return None
+        _kway_loaded = mod
+        return mod
+    except Exception as e:       # pragma: no cover — env-dependent
+        LOG.warning("native kway unavailable: %s", e)
         return None

@@ -1,0 +1,131 @@
+// Native k-way stream merge for the placement kernel's host expansion.
+//
+// The K-way device kernel (ops/select.py _select_kway*) returns per-phase
+// winner chunks; the host reconstructs the exact greedy per-instance
+// order by merging the winners' score streams: pop the stream whose
+// CURRENT head has the max score (ties -> lowest node id), then advance
+// that stream (streams are not monotonic — binpack scores rise as a node
+// fills — so this is a streaming merge, not a sort). In Python this heap
+// loop costs ~3-5us per instance and dominates multi-batch expansion;
+// here it is a std::priority_queue over raw float32 rows.
+//
+// merge(scores: buffer f32[W*max_m], nodes: buffer i32[W],
+//       lens: buffer i32[W], max_m: int, limit: int) -> bytes
+// Returns int32[2*P]: P winner-row indexes then P stream positions,
+// P = min(sum(lens), limit).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <queue>
+#include <vector>
+
+namespace {
+
+struct Head {
+    float score;
+    int32_t node;
+    int32_t row;
+    int32_t j;
+};
+
+struct HeadLess {
+    // priority_queue keeps the LARGEST by this order on top:
+    // max score first, then lowest node id
+    bool operator()(const Head &a, const Head &b) const {
+        if (a.score != b.score) return a.score < b.score;
+        return a.node > b.node;
+    }
+};
+
+PyObject *merge(PyObject *, PyObject *args) {
+    Py_buffer scores_b, nodes_b, lens_b;
+    Py_ssize_t max_m, limit;
+    if (!PyArg_ParseTuple(args, "y*y*y*nn", &scores_b, &nodes_b, &lens_b,
+                          &max_m, &limit)) {
+        return nullptr;
+    }
+    const float *scores = static_cast<const float *>(scores_b.buf);
+    const int32_t *nodes = static_cast<const int32_t *>(nodes_b.buf);
+    const int32_t *lens = static_cast<const int32_t *>(lens_b.buf);
+    const Py_ssize_t w = nodes_b.len / static_cast<Py_ssize_t>(sizeof(int32_t));
+
+    // mutually-consistent buffers or a clean ValueError — a silent
+    // overread would corrupt placement order or crash the scheduler
+    if (lens_b.len != nodes_b.len ||
+        scores_b.len < static_cast<Py_ssize_t>(w * max_m * sizeof(float))) {
+        PyBuffer_Release(&scores_b);
+        PyBuffer_Release(&nodes_b);
+        PyBuffer_Release(&lens_b);
+        PyErr_SetString(PyExc_ValueError, "kway.merge: buffer size mismatch");
+        return nullptr;
+    }
+    Py_ssize_t total = 0;
+    for (Py_ssize_t k = 0; k < w; k++) {
+        if (lens[k] < 0 || lens[k] > max_m) {
+            PyBuffer_Release(&scores_b);
+            PyBuffer_Release(&nodes_b);
+            PyBuffer_Release(&lens_b);
+            PyErr_SetString(PyExc_ValueError, "kway.merge: len out of range");
+            return nullptr;
+        }
+        total += lens[k];
+    }
+    if (total > limit) total = limit;
+    if (total < 0) total = 0;
+
+    PyObject *out = PyBytes_FromStringAndSize(
+        nullptr, static_cast<Py_ssize_t>(2 * total * sizeof(int32_t)));
+    if (out == nullptr) {
+        PyBuffer_Release(&scores_b);
+        PyBuffer_Release(&nodes_b);
+        PyBuffer_Release(&lens_b);
+        return nullptr;
+    }
+    int32_t *ok = reinterpret_cast<int32_t *>(PyBytes_AS_STRING(out));
+    int32_t *oj = ok + total;
+
+    std::priority_queue<Head, std::vector<Head>, HeadLess> heap;
+    for (Py_ssize_t k = 0; k < w; k++) {
+        if (lens[k] > 0) {
+            heap.push(Head{scores[k * max_m], nodes[k],
+                           static_cast<int32_t>(k), 0});
+        }
+    }
+    Py_ssize_t pos = 0;
+    while (!heap.empty() && pos < total) {
+        Head h = heap.top();
+        heap.pop();
+        ok[pos] = h.row;
+        oj[pos] = h.j;
+        pos++;
+        int32_t nj = h.j + 1;
+        if (nj < lens[h.row]) {
+            heap.push(Head{scores[h.row * max_m + nj], h.node, h.row, nj});
+        }
+    }
+
+    PyBuffer_Release(&scores_b);
+    PyBuffer_Release(&nodes_b);
+    PyBuffer_Release(&lens_b);
+    return out;
+}
+
+PyMethodDef methods[] = {
+    {"merge", merge, METH_VARARGS,
+     "k-way greedy stream merge -> int32 (rows, positions) bytes"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "nomad_tpu_native_kway",
+    "native k-way stream merge", -1, methods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_nomad_tpu_native_kway(void) {
+    return PyModule_Create(&module);
+}

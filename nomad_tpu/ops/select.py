@@ -961,14 +961,107 @@ def _node_local_scores_np(req: SelectRequest, c: int, start: int,
     return fin, binp, anti, pen, aff, dev, pre
 
 
+def _node_local_scores_batch(req: SelectRequest, cs, starts, ms):
+    """All winners of a phase at once: float32 score streams shaped
+    [W, max_m] with the SAME op order and dtypes as
+    _node_local_scores_np, so results stay bit-identical — the
+    per-winner call overhead (30 tiny numpy ops each) dominated
+    multi-batch expansion."""
+    cs = np.asarray(cs, np.int64)
+    starts = np.asarray(starts, np.float32)
+    ms = np.asarray(ms, np.int64)
+    max_m = int(ms.max()) if len(ms) else 0
+    ask = np.asarray(req.ask, np.float32)
+    a = np.arange(max_m, dtype=np.float32)
+    # [W, max_m, D]
+    after = (req.used[cs].astype(np.float32)[:, None, :]
+             + (starts[:, None] + a[None, :] + 1.0)[:, :, None] * ask)
+    cap = np.maximum(req.capacity[cs].astype(np.float32), 1e-9)
+    free_cpu = np.float32(1.0) - after[:, :, 0] / cap[:, None, 0]
+    free_mem = np.float32(1.0) - after[:, :, 1] / cap[:, None, 1]
+    total = (np.power(np.float32(10.0), free_cpu)
+             + np.power(np.float32(10.0), free_mem))
+    if req.algorithm == "spread":
+        fit_score = np.clip(total - 2.0, 0.0, 18.0)
+    else:
+        fit_score = np.clip(20.0 - total, 0.0, 18.0)
+    binp = (fit_score / np.float32(18.0)).astype(np.float32)
+    desired = np.float32(max(req.desired_count, 1.0))
+    coll = (req.tg_collisions[cs].astype(np.float32)[:, None]
+            + starts[:, None] + a[None, :])
+    anti_fires = coll > 0
+    anti = np.where(anti_fires, -(coll + 1.0) / desired,
+                    0.0).astype(np.float32)
+    pen_f = req.penalty[cs].astype(bool) if req.penalty is not None \
+        else np.zeros(len(cs), bool)
+    pen_v = np.where(pen_f, np.float32(-1.0), np.float32(0.0))
+    if req.affinity is not None and req.affinity_sum_weights > 0:
+        aff_v = (req.affinity[cs] / req.affinity_sum_weights
+                 ).astype(np.float32)
+    else:
+        aff_v = np.zeros(len(cs), np.float32)
+    if req.dev_fires and req.dev_score is not None:
+        dev_v = req.dev_score[cs].astype(np.float32)
+    else:
+        dev_v = np.zeros(len(cs), np.float32)
+    pre_v = req.pre_score[cs].astype(np.float32) \
+        if req.pre_score is not None else np.zeros(len(cs), np.float32)
+    fired = (1.0 + anti_fires.astype(np.float32)
+             + pen_f.astype(np.float32)[:, None]
+             + (aff_v != 0.0).astype(np.float32)[:, None]
+             + np.float32(1.0 if req.dev_fires else 0.0)
+             + (pre_v != 0.0).astype(np.float32)[:, None])
+    fin = ((binp + anti + pen_v[:, None] + aff_v[:, None]
+            + dev_v[:, None] + pre_v[:, None]) / fired).astype(np.float32)
+    return fin, binp, anti, pen_v, aff_v, dev_v, pre_v
+
+
+def _kway_merge_py(fin_m, nodes_v, len_v, limit):
+    """Streaming k-way merge, python fallback: pop the stream whose
+    CURRENT head score is max (ties -> lowest node id), advance that
+    stream. Streams are NOT monotonic (binpack scores rise as a node
+    fills), so this is a true merge, not a sort."""
+    import heapq
+    heap = []
+    for k in range(len(nodes_v)):
+        if len_v[k] > 0:
+            heapq.heappush(heap, (-float(fin_m[k, 0]),
+                                  int(nodes_v[k]), k, 0))
+    ok: List[int] = []
+    oj: List[int] = []
+    while heap and len(ok) < limit:
+        _negs, node, k, j = heapq.heappop(heap)
+        ok.append(k)
+        oj.append(j)
+        if j + 1 < len_v[k]:
+            heapq.heappush(heap, (-float(fin_m[k, j + 1]), node,
+                                  k, j + 1))
+    return np.asarray(ok, np.int64), np.asarray(oj, np.int64)
+
+
+def _kway_merge(fin_m, nodes_v, len_v, limit):
+    """The per-phase greedy merge; native (native/kway.cpp) when
+    available — the python heap costs ~3-5us/instance and dominated
+    multi-batch expansion."""
+    from ..native import load_kway
+    mod = load_kway()
+    if mod is None:
+        return _kway_merge_py(fin_m, nodes_v, len_v, limit)
+    out = mod.merge(np.ascontiguousarray(fin_m, np.float32).tobytes(),
+                    nodes_v.astype(np.int32).tobytes(),
+                    len_v.astype(np.int32).tobytes(),
+                    fin_m.shape[1], int(limit))
+    pairs = np.frombuffer(out, np.int32)
+    p = len(pairs) // 2
+    return pairs[:p].astype(np.int64), pairs[p:].astype(np.int64)
+
+
 def _expand_kway(req: SelectRequest, rounds) -> SelectResult:
     """Expand per-phase (winners, chunks) into the exact per-instance
     greedy sequence: within a phase every winner's next-score beats the
-    waterline, so true greedy order is the heap merge of the winners'
-    score streams (max score first, ties to the lowest node index) —
-    identical to the scan's argmax sequence."""
-    import heapq
-
+    waterline, so true greedy order is the streaming merge of the
+    winners' score streams (max CURRENT head first, ties to the lowest
+    node index) — identical to the scan's argmax sequence."""
     n = len(req.feasible)
     k_total = req.count
     d = req.capacity.shape[1]
@@ -997,56 +1090,23 @@ def _expand_kway(req: SelectRequest, rounds) -> SelectResult:
             if not winners:
                 fail = last_meta
                 continue
-            # per-winner score streams for this phase
-            streams = {}
-            for c, m in winners:
-                start = extra.get(c, 0)
-                streams[c] = _node_local_scores_np(req, c, start, m)
-                extra[c] = start + m
-            # heap merge emits only the (node, j) order; array fills are
-            # batched per phase (per-instance numpy writes dominate
-            # multi-batch expansion otherwise)
-            heap = []
-            for c, _m in winners:
-                heapq.heappush(heap, (-float(streams[c][0][0]), c, 0))
-            order_c: List[int] = []
-            order_j: List[int] = []
-            while heap and pos + len(order_c) < k_total:
-                _negs, c, j = heapq.heappop(heap)
-                order_c.append(c)
-                order_j.append(j)
-                fin = streams[c][0]
-                if j + 1 < len(fin):
-                    heapq.heappush(heap, (-float(fin[j + 1]), c, j + 1))
-            m = len(order_c)
+            # score streams for ALL winners of this phase in one
+            # vectorized shot ([W, max_m]; rows past each winner's m
+            # are garbage the merge never reads)
+            nodes_v = np.asarray([c for c, _m in winners], np.int32)
+            len_v = np.asarray([mm for _c, mm in winners], np.int64)
+            starts_v = np.asarray([extra.get(c, 0)
+                                   for c, _m in winners], np.float32)
+            for c, mm in winners:
+                extra[c] = extra.get(c, 0) + mm
+            fin_m, bin_m, anti_m, pen_v, aff_v, dev_v, pre_v = \
+                _node_local_scores_batch(req, nodes_v, starts_v, len_v)
+            ok, oj = _kway_merge(fin_m, nodes_v, len_v, k_total - pos)
+            m = len(ok)
             if m == 0:
                 continue
             sl = slice(pos, pos + m)
-            oc = np.asarray(order_c, np.int32)
-            oj = np.asarray(order_j, np.int64)
-            node_idx[sl] = oc
-            # gather per-instance scores from the streams: stack into a
-            # ragged-safe [winner, CHUNK] matrix addressed by (c, j)
-            cmap = {c: k for k, (c, _m) in enumerate(winners)}
-            max_m = max(mm for _c, mm in winners)
-            fin_m = np.zeros((len(winners), max_m), np.float32)
-            bin_m = np.zeros_like(fin_m)
-            anti_m = np.zeros_like(fin_m)
-            pen_v = np.zeros(len(winners), np.float32)
-            aff_v = np.zeros(len(winners), np.float32)
-            dev_v = np.zeros(len(winners), np.float32)
-            pre_v = np.zeros(len(winners), np.float32)
-            for c, mm in winners:
-                k = cmap[c]
-                fin, binp, anti, pen, aff, dev, pre = streams[c]
-                fin_m[k, :mm] = fin
-                bin_m[k, :mm] = binp
-                anti_m[k, :mm] = anti
-                pen_v[k] = pen
-                aff_v[k] = aff
-                dev_v[k] = dev
-                pre_v[k] = pre
-            ok = np.asarray([cmap[c] for c in order_c], np.int64)
+            node_idx[sl] = nodes_v[ok]
             final[sl] = fin_m[ok, oj]
             comp["binpack"][sl] = bin_m[ok, oj]
             comp["job-anti-affinity"][sl] = anti_m[ok, oj]

@@ -243,3 +243,72 @@ def test_n_considered_metrics():
     res = sel.SelectKernel().select(req)
     assert res.nodes_evaluated == 4
     assert res.nodes_filtered == 2
+
+
+def test_native_kway_merge_matches_python():
+    """native/kway.cpp merge == the python heap merge on random
+    non-monotonic streams (incl. score ties across streams)."""
+    from nomad_tpu.native import load_kway
+    from nomad_tpu.ops.select import _kway_merge_py
+
+    mod = load_kway()
+    if mod is None:
+        import pytest
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.RandomState(7)
+    for trial in range(20):
+        w = rng.randint(1, 33)
+        max_m = rng.randint(1, 65)
+        fin = rng.uniform(0, 1, size=(w, max_m)).astype(np.float32)
+        # force ties sometimes
+        if trial % 3 == 0:
+            fin = np.round(fin * 4) / 4
+        nodes = rng.permutation(1000)[:w].astype(np.int32)
+        lens = rng.randint(0, max_m + 1, size=w).astype(np.int64)
+        limit = int(rng.randint(1, int(lens.sum()) + 2))
+        ok_py, oj_py = _kway_merge_py(fin, nodes, lens, limit)
+        out = mod.merge(np.ascontiguousarray(fin).tobytes(),
+                        nodes.tobytes(),
+                        lens.astype(np.int32).tobytes(), max_m, limit)
+        pairs = np.frombuffer(out, np.int32)
+        p = len(pairs) // 2
+        ok_c, oj_c = pairs[:p], pairs[p:]
+        assert np.array_equal(ok_py, ok_c), (trial, ok_py, ok_c)
+        assert np.array_equal(oj_py, oj_c), trial
+
+
+def test_batch_scores_match_scalar():
+    """_node_local_scores_batch is bit-identical to the per-winner
+    _node_local_scores_np (the scan kernels' host-side score math)."""
+    from nomad_tpu.ops.select import (_node_local_scores_batch,
+                                      _node_local_scores_np)
+    rng = np.random.RandomState(11)
+    n = 64
+    for trial in range(10):
+        cap = np.tile(np.array([[4000.0, 8192.0, 102400.0, 1000.0]],
+                               np.float32), (n, 1))
+        req = sel.SelectRequest(
+            ask=np.array([100.0, 150.0, 10.0, 0.0], np.float32),
+            count=100,
+            feasible=np.ones(n, bool), capacity=cap,
+            used=(cap * rng.uniform(0, 0.5, (n, 4))).astype(np.float32),
+            desired_count=float(rng.randint(1, 200)),
+            tg_collisions=rng.randint(0, 3, n).astype(np.int32),
+            job_count=np.zeros(n, np.int32),
+            penalty=(rng.rand(n) < 0.3),
+            algorithm="spread" if trial % 2 else "binpack")
+        w = rng.randint(1, 9)
+        cs = rng.permutation(n)[:w]
+        starts = rng.randint(0, 5, w)
+        ms = rng.randint(1, 12, w)
+        fin_m, bin_m, anti_m, pen_v, aff_v, dev_v, pre_v = \
+            _node_local_scores_batch(req, cs, starts, ms)
+        for k in range(w):
+            fin, binp, anti, pen, aff, dev, pre = _node_local_scores_np(
+                req, int(cs[k]), int(starts[k]), int(ms[k]))
+            m = ms[k]
+            assert np.array_equal(fin_m[k, :m], fin), trial
+            assert np.array_equal(bin_m[k, :m], binp)
+            assert np.array_equal(anti_m[k, :m], anti)
+            assert pen_v[k] == pen and aff_v[k] == aff
+            assert dev_v[k] == dev and pre_v[k] == pre
