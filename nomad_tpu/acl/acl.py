@@ -88,7 +88,9 @@ class AclToken:
     def stub(self) -> dict:
         return {"accessor_id": self.accessor_id, "name": self.name,
                 "type": self.type, "policies": list(self.policies),
-                "create_index": self.create_index}
+                "global": self.global_,
+                "create_index": self.create_index,
+                "modify_index": self.modify_index}
 
 
 def parse_policy_rules(rules) -> dict:

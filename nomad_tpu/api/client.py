@@ -249,6 +249,22 @@ class ApiClient:
                     continue
                 yield json.loads(line)
 
+    # -- namespaces ------------------------------------------------------
+    def list_namespaces(self) -> list:
+        return self._request("GET", "/v1/namespaces")
+
+    def get_namespace(self, name: str) -> dict:
+        return self._request("GET", f"/v1/namespace/{name}")
+
+    def apply_namespace(self, name: str, description: str = "",
+                        meta: Optional[dict] = None) -> dict:
+        return self._request("PUT", f"/v1/namespace/{name}",
+                             {"name": name, "description": description,
+                              "meta": meta or {}})
+
+    def delete_namespace(self, name: str) -> dict:
+        return self._request("DELETE", f"/v1/namespace/{name}")
+
     # -- service catalog ------------------------------------------------
     def list_services(self, namespace: str = "default") -> list:
         return self._request("GET", "/v1/services",

@@ -52,8 +52,11 @@ class HTTPApiServer:
         # multi-region federation (nomad/rpc.go forwardRegion): other
         # regions' agent addresses; a request stamped with a foreign
         # region proxies there wholesale, and the remote region
-        # enforces its own ACLs
-        self.region_peers: dict = dict(region_peers or {})
+        # enforces its own ACLs. Defaults to the server's configured
+        # peers (the same map replication uses).
+        self.region_peers: dict = dict(
+            region_peers if region_peers is not None
+            else getattr(server.config, "region_peers", None) or {})
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -75,11 +78,21 @@ class HTTPApiServer:
             def _error(self, code: int, msg: str):
                 self._respond(code, {"error": msg})
 
+            def _read_body_bytes(self) -> bytes:
+                """Read (and cache) the raw request body — callers that
+                peek at it before routing must not consume it twice."""
+                cached = getattr(self, "_body_cache", None)
+                if cached is None:
+                    length = int(self.headers.get("Content-Length", 0))
+                    cached = self.rfile.read(length) if length else b""
+                    self._body_cache = cached
+                return cached
+
             def _body(self):
-                length = int(self.headers.get("Content-Length", 0))
-                if not length:
+                raw = self._read_body_bytes()
+                if not raw:
                     return {}
-                return json.loads(self.rfile.read(length))
+                return json.loads(raw)
 
             def _handle(self, method: str):
                 try:
@@ -92,9 +105,22 @@ class HTTPApiServer:
                     # work — local blocking-query indexes, ACLs, and
                     # stream dispatch all belong to the owning region
                     region = q.get("region", "")
-                    if region and region != getattr(
-                            api.server.config, "region", "global"):
+                    local_region = getattr(api.server.config, "region",
+                                           "global")
+                    if region and region != local_region:
                         return api.proxy_region(self, region, method, url)
+                    # ACL/namespace WRITES belong to the authoritative
+                    # region (the reference forwards them,
+                    # acl_endpoint.go/namespace_endpoint.go); accepting
+                    # them locally would let the replicator silently
+                    # delete them on its next sync
+                    auth = getattr(api.server.config,
+                                   "authoritative_region", "")
+                    if auth and auth != local_region and \
+                            method in ("PUT", "POST", "DELETE") and \
+                            api._forwards_to_authoritative(self, method,
+                                                           url.path):
+                        return api.proxy_region(self, auth, method, url)
                     if url.path == "/v1/agent/monitor" and method == "GET":
                         acl = api.server.resolve_token(token)
                         if not (acl.is_management() or acl.allow_agent_read()):
@@ -230,6 +256,22 @@ class HTTPApiServer:
                 ns, "list-jobs" if path == "/v1/scaling/policies"
                 else "read-job"))
             return
+        if path == "/v1/namespaces":
+            # list is allowed for any namespace capability; the route
+            # filters the result to namespaces the token can read
+            need(not write and (acl.allow_namespace(ns)
+                                or acl.allow_node_read()
+                                or acl.allow_operator_read()))
+            return
+        m_ns = re.match(r"^/v1/namespace/([^/]+)$", path)
+        if m_ns:
+            # reads authorize against the namespace NAMED IN THE PATH
+            # (not the caller-chosen ?namespace= param); writes are an
+            # operator surface (namespace_endpoint.go aclObj checks)
+            need(acl.allow_operator_write() if write
+                 else (acl.allow_namespace(m_ns.group(1))
+                       or acl.allow_operator_read()))
+            return
         if path == "/v1/services" or path.startswith("/v1/service/"):
             # service discovery reads ride read-job; deregistration is
             # a job-write-shaped operation
@@ -266,9 +308,32 @@ class HTTPApiServer:
         if path.startswith("/v1/acl/"):
             return self._route_acl(method, path, body_fn, acl, token)
 
-        return self._route_main(method, path, q, body_fn, ns, idx)
+        return self._route_main(method, path, q, body_fn, ns, idx,
+                                acl=acl)
 
-    def proxy_region(self, handler, region: str, method: str, url) -> None:
+    def _forwards_to_authoritative(self, handler, method: str,
+                                   path: str) -> bool:
+        """Which writes belong to the authoritative region: namespace
+        CRUD, ACL policy CRUD, and GLOBAL token operations (local
+        tokens stay regional — acl_endpoint.go UpsertTokens)."""
+        if path.startswith("/v1/namespace/"):
+            return True
+        if path.startswith("/v1/acl/policy"):
+            return True
+        if path == "/v1/acl/token" and method in ("PUT", "POST"):
+            try:
+                body = json.loads(handler._read_body_bytes() or b"{}")
+            except ValueError:
+                return False
+            return bool(body.get("global") or body.get("global_"))
+        m = re.match(r"^/v1/acl/token/([^/]+)$", path)
+        if m:
+            tok = self.server.store.acl_token_by_accessor(m.group(1))
+            return tok is not None and tok.global_
+        return False
+
+    def proxy_region(self, handler, region: str, method: str, url,
+                     body: Optional[bytes] = None) -> None:
         """Proxy one request raw to the named region's agent
         (forwardRegion) and relay the response verbatim — remote status
         codes pass through untouched, and chunked bodies (event/monitor
@@ -286,10 +351,9 @@ class HTTPApiServer:
         target = f"http://{peer}{url.path}"
         if pairs:
             target += "?" + urlencode(pairs)
-        data = None
-        if method in ("PUT", "POST"):
-            length = int(handler.headers.get("Content-Length", 0))
-            data = handler.rfile.read(length) if length else b"{}"
+        data = body
+        if data is None and method in ("PUT", "POST"):
+            data = handler._read_body_bytes() or b"{}"
         headers = {"Content-Type": "application/json"}
         token = handler.headers.get("X-Nomad-Token", "")
         if token:
@@ -404,7 +468,7 @@ class HTTPApiServer:
         return None
 
     def _route_main(self, method: str, path: str, q: dict, body_fn,
-                    ns: str, idx: int):
+                    ns: str, idx: int, acl=None):
         s = self.server
         store = s.store
 
@@ -440,7 +504,12 @@ class HTTPApiServer:
                 return to_wire(job), idx
             if method == "DELETE":
                 purge = q.get("purge", "").lower() == "true"
-                ev = s.deregister_job(ns, job_id, purge=purge)
+                if q.get("global", "").lower() == "true":
+                    # multiregion stop fans to every region in the
+                    # job's multiregion block (nomad job stop -global)
+                    ev = s.deregister_job_global(ns, job_id, purge=purge)
+                else:
+                    ev = s.deregister_job(ns, job_id, purge=purge)
                 return {"EvalID": ev.id}, store.latest_index()
 
         m = re.match(r"^/v1/job/([^/]+)/(\w+)$", path)
@@ -549,6 +618,34 @@ class HTTPApiServer:
             if pol is None:
                 return None
             return to_wire(pol), idx
+
+        # namespaces (nomad/namespace_endpoint.go — the list is
+        # filtered to namespaces the token can read)
+        if path == "/v1/namespaces" and method == "GET":
+            out = [to_wire(n) for n in store.namespaces()
+                   if acl is None or not s.config.acl_enabled
+                   or acl.is_management() or acl.allow_operator_read()
+                   or acl.allow_namespace(n.name)]
+            return out, idx
+
+        m = re.match(r"^/v1/namespace/([^/]+)$", path)
+        if m:
+            name = m.group(1)
+            if method == "GET":
+                got = store.namespace_by_name(name)
+                return (to_wire(got), idx) if got else None
+            if method in ("PUT", "POST"):
+                from ..models.namespace import Namespace
+                data = body_fn() or {}
+                ns_obj = Namespace(
+                    name=data.get("name", name) or name,
+                    description=data.get("description", ""),
+                    meta=dict(data.get("meta") or {}))
+                s.upsert_namespaces([ns_obj])
+                return {"ok": True}, store.latest_index()
+            if method == "DELETE":
+                s.delete_namespaces([name])
+                return {"ok": True}, store.latest_index()
 
         # built-in service catalog (nomad service list/info; the
         # reference's equivalent discovery surface lives in Consul)

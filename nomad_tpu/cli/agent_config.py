@@ -41,6 +41,8 @@ class AgentFileConfig:
     num_schedulers: Optional[int] = None
     acl_enabled: Optional[bool] = None
     server_peers: List[str] = field(default_factory=list)
+    authoritative_region: str = ""
+    replication_token: str = ""
     servers: List[str] = field(default_factory=list)
     node_name: str = ""
     alloc_dir: str = ""
@@ -79,6 +81,8 @@ def load_agent_config(path: str) -> AgentFileConfig:
         if "acl_enabled" in srv:
             cfg.acl_enabled = bool(srv["acl_enabled"])
         cfg.server_peers = list(srv.get("server_peers", []))
+        cfg.authoritative_region = srv.get("authoritative_region", "")
+        cfg.replication_token = srv.get("replication_token", "")
     cli = data.get("client") or {}
     if isinstance(cli, list):
         cli = cli[0]
@@ -127,5 +131,11 @@ def apply_to_args(cfg: AgentFileConfig, args) -> None:
     if cfg.region_peers and not getattr(args, "region_peers", None):
         args.region_peers = [f"{k}={v}" for k, v in
                              cfg.region_peers.items()]
+    if cfg.authoritative_region and \
+            not getattr(args, "authoritative_region", ""):
+        args.authoritative_region = cfg.authoritative_region
+    if cfg.replication_token and \
+            not getattr(args, "replication_token", ""):
+        args.replication_token = cfg.replication_token
     if cfg.meta:
         args.client_meta = cfg.meta

@@ -88,10 +88,23 @@ def cmd_agent(args) -> int:
         elif probe_accelerator(timeout_s=60.0) is None:
             force_cpu_platform(1)
             print("    WARNING: TPU backend unavailable; scheduling on CPU")
+        try:
+            region_peers = parse_region_peers(
+                getattr(args, "region_peers", None) or [])
+        except ValueError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
         server = Server(ServerConfig(num_schedulers=args.num_schedulers,
                                      acl_enabled=args.acl_enabled,
                                      region=getattr(args, "region", "")
                                      or "global",
+                                     region_peers=region_peers,
+                                     authoritative_region=getattr(
+                                         args, "authoritative_region",
+                                         "") or "",
+                                     replication_token=getattr(
+                                         args, "replication_token",
+                                         "") or "",
                                      data_dir=getattr(args, "data_dir",
                                                       "")))
         rpc = RpcServer(server, port=args.rpc_port)
@@ -102,16 +115,10 @@ def cmd_agent(args) -> int:
             server.attach_raft(rpc, peers)
         server.start()
         rpc.start()
-        try:
-            peers = parse_region_peers(
-                getattr(args, "region_peers", None) or [])
-        except ValueError as e:
-            print(f"Error: {e}", file=sys.stderr)
-            return 1
+        # region_peers defaults from server.config inside HTTPApiServer
         api = HTTPApiServer(server, port=args.http_port,
                             alloc_dir_bases=[args.alloc_dir_base]
-                            if args.alloc_dir_base else None,
-                            region_peers=peers)
+                            if args.alloc_dir_base else None)
         api.start()
 
     n_local_clients = args.clients if is_client else 0
@@ -849,6 +856,47 @@ def cmd_scaling_policy_info(args) -> int:
     return 0
 
 
+def cmd_namespace_list(args) -> int:
+    c = _client(args)
+    rows = [[n["name"], n["description"]]
+            for n in c.list_namespaces()]
+    _print_rows(rows, ["Name", "Description"])
+    return 0
+
+
+def cmd_namespace_apply(args) -> int:
+    c = _client(args)
+    try:
+        c.apply_namespace(args.name, description=args.description)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f'Successfully applied namespace "{args.name}"!')
+    return 0
+
+
+def cmd_namespace_delete(args) -> int:
+    c = _client(args)
+    try:
+        c.delete_namespace(args.name)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f'Successfully deleted namespace "{args.name}"!')
+    return 0
+
+
+def cmd_namespace_status(args) -> int:
+    c = _client(args)
+    try:
+        ns = c.get_namespace(args.name)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(ns, indent=2, sort_keys=True, default=str))
+    return 0
+
+
 def cmd_service_list(args) -> int:
     """nomad service list (the built-in catalog's discovery surface)."""
     c = _client(args)
@@ -1048,6 +1096,13 @@ def build_parser() -> argparse.ArgumentParser:
                        action="append", default=None, metavar="NAME=ADDR",
                        help="federation peer agent, repeatable "
                             "(west=10.0.0.5:4646)")
+    agent.add_argument("-authoritative-region",
+                       dest="authoritative_region", default="",
+                       help="region to replicate ACLs/namespaces from")
+    agent.add_argument("-replication-token", dest="replication_token",
+                       default="", help="ACL token used for replication "
+                                        "reads in the authoritative "
+                                        "region")
     agent.add_argument("-config", default="",
                        help="HCL agent config file (flags win on merge)")
     agent.add_argument("-clients", type=int, default=1)
@@ -1206,6 +1261,20 @@ def build_parser() -> argparse.ArgumentParser:
     spi = scaling.add_parser("policy-info")
     spi.add_argument("policy_id")
     spi.set_defaults(fn=cmd_scaling_policy_info)
+
+    namespace = sub.add_parser("namespace").add_subparsers(dest="sub")
+    nsl = namespace.add_parser("list")
+    nsl.set_defaults(fn=cmd_namespace_list)
+    nsa = namespace.add_parser("apply")
+    nsa.add_argument("name")
+    nsa.add_argument("-description", default="")
+    nsa.set_defaults(fn=cmd_namespace_apply)
+    nsd = namespace.add_parser("delete")
+    nsd.add_argument("name")
+    nsd.set_defaults(fn=cmd_namespace_delete)
+    nss = namespace.add_parser("status")
+    nss.add_argument("name")
+    nss.set_defaults(fn=cmd_namespace_status)
 
     service = sub.add_parser("service").add_subparsers(dest="sub")
     svl = service.add_parser("list")

@@ -469,10 +469,29 @@ def parse_job(src: str, variables: dict = None) -> Job:
             meta_required=list(pz.get("meta_required", [])),
             meta_optional=list(pz.get("meta_optional", [])))
 
+    multiregion = None
+    mr = _one(body.get("multiregion"))
+    if isinstance(mr, dict):
+        from ..models.job import (Multiregion, MultiregionRegion,
+                                  MultiregionStrategy)
+        strategy = None
+        st = _one(mr.get("strategy"))
+        if isinstance(st, dict):
+            strategy = MultiregionStrategy(
+                max_parallel=int(st.get("max_parallel", 0)),
+                on_failure=st.get("on_failure", ""))
+        regions = [MultiregionRegion(
+            name=label, count=int(b.get("count", 0)),
+            datacenters=list(b.get("datacenters", [])),
+            meta=dict(_one(b.get("meta")) or {}))
+            for label, b in _labeled(mr.get("region"))]
+        multiregion = Multiregion(strategy=strategy, regions=regions)
+
     job = Job(
         id=job_id,
         name=body.get("name", job_id),
         region=body.get("region", "global"),
+        multiregion=multiregion,
         namespace=body.get("namespace", "default"),
         type=body.get("type", "service"),
         priority=int(body.get("priority", 50)),

@@ -480,9 +480,57 @@ class TaskGroup:
 
 
 @dataclass
+class MultiregionStrategy:
+    """structs.go MultiregionStrategy:4706."""
+    max_parallel: int = 0
+    on_failure: str = ""    # "" | "fail_all" | "fail_local"
+
+
+@dataclass
+class MultiregionRegion:
+    """structs.go MultiregionRegion:4711."""
+    name: str = ""
+    count: int = 0
+    datacenters: List[str] = field(default_factory=list)
+    meta: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class Multiregion:
-    strategy: Optional[dict] = None
-    regions: List[dict] = field(default_factory=list)
+    """structs.go Multiregion:4658. The reference gates the fan-out
+    behind its enterprise build (structs_oss.go:12 rejects outright);
+    here the register fan-out is implemented over federation peers,
+    while cross-region deployment PACING (blocked deployments unblocked
+    region by region) remains a gap."""
+    strategy: Optional[MultiregionStrategy] = None
+    regions: List[MultiregionRegion] = field(default_factory=list)
+
+    def canonicalize(self) -> None:
+        if self.strategy is None:
+            self.strategy = MultiregionStrategy()
+
+    def validate(self) -> List[str]:
+        errs = []
+        if not self.regions:
+            errs.append("multiregion requires at least one region")
+        seen = set()
+        for r in self.regions:
+            if not r.name:
+                errs.append("multiregion region requires a name")
+            elif r.name in seen:
+                errs.append(f"multiregion region {r.name!r} declared "
+                            "twice")
+            seen.add(r.name)
+            if r.count < 0:
+                errs.append(f"region {r.name}: count can't be negative")
+        if self.strategy is not None:
+            if self.strategy.max_parallel < 0:
+                errs.append("max_parallel can't be negative")
+            if self.strategy.on_failure not in ("", "fail_all",
+                                                "fail_local"):
+                errs.append(f"invalid on_failure "
+                            f"{self.strategy.on_failure!r}")
+        return errs
 
 
 @dataclass
@@ -530,6 +578,8 @@ class Job:
             self.namespace = DEFAULT_NAMESPACE
         if self.priority == 0:
             self.priority = JOB_DEFAULT_PRIORITY
+        if self.multiregion is not None:
+            self.multiregion.canonicalize()
         for tg in self.task_groups:
             tg.canonicalize(self)
 
@@ -545,8 +595,12 @@ class Job:
             errs.append(f"invalid job type: {self.type}")
         if self.priority < JOB_MIN_PRIORITY or self.priority > JOB_MAX_PRIORITY:
             errs.append(f"job priority must be between [{JOB_MIN_PRIORITY}, {JOB_MAX_PRIORITY}]")
-        if not self.datacenters:
+        # multiregion jobs may omit datacenters — each region entry
+        # supplies its own (structs.go:4039)
+        if not self.datacenters and self.multiregion is None:
             errs.append("missing job datacenters")
+        if self.multiregion is not None:
+            errs.extend(self.multiregion.validate())
         if not self.task_groups:
             errs.append("missing job task groups")
         names = set()

@@ -1,0 +1,36 @@
+"""Namespaces (structs.go Namespace:4719).
+
+Logical grouping for jobs and their objects; replicated from the
+authoritative region by non-authoritative leaders
+(nomad/leader.go replicateNamespaces:352).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+# structs.go validNamespaceName:188
+_VALID_NAME = re.compile(r"^[a-zA-Z0-9-]{1,128}$")
+MAX_DESCRIPTION = 256
+DEFAULT_NAMESPACE = "default"
+
+
+@dataclass
+class Namespace:
+    name: str = ""
+    description: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+    def validate(self) -> List[str]:
+        """structs.go Namespace.Validate:4739."""
+        errs = []
+        if not _VALID_NAME.match(self.name or ""):
+            errs.append(f"invalid name {self.name!r}. Must match regex "
+                        f"{_VALID_NAME.pattern}")
+        if len(self.description) > MAX_DESCRIPTION:
+            errs.append(f"description longer than {MAX_DESCRIPTION}")
+        return errs

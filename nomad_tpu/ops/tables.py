@@ -18,6 +18,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from functools import lru_cache
+
 from ..models import NetworkIndex
 from ..models.job import (CONSTRAINT_DISTINCT_HOSTS,
                           CONSTRAINT_DISTINCT_PROPERTY)
@@ -35,6 +37,33 @@ DIM_NAMES = ("cpu", "memory", "disk", "network")
 # object in the memo pins its id() against reuse.
 _usage_memo: Dict[int, Tuple[object, Tuple[float, float, float, float]]] = {}
 _port_bits_memo: Dict[int, Tuple[object, int]] = {}
+
+# inlined Allocation.terminal_status for the 2M-row build loop
+from ..models.alloc import (  # noqa: E402
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
+    ALLOC_DESIRED_EVICT, ALLOC_DESIRED_STOP)
+
+TERMINAL_DESIRED = frozenset((ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT))
+TERMINAL_CLIENT = frozenset((ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED,
+                             ALLOC_CLIENT_LOST))
+
+
+@lru_cache(maxsize=4096)
+def _reserved_port_bits(spec: str) -> int:
+    """A node's reserved-host-port bitmask. Equivalent to
+    NetworkIndex.set_node + merging used_ports (the reserved range is
+    applied to every IP identically, so the merge IS the range);
+    memoized because fleets share a handful of reserved-port configs
+    and a 50k-node table init was re-parsing each one."""
+    from ..models.networks import parse_port_ranges
+    try:
+        ports = parse_port_ranges(spec)
+    except ValueError:
+        return 0
+    bits = 0
+    for p in ports:
+        bits |= 1 << p
+    return bits
 
 
 def _alloc_usage(alloc) -> Tuple[float, float, float, float]:
@@ -172,9 +201,10 @@ class NodeTable:
         self._port_col_cache: Dict[int, np.ndarray] = {}
 
         for i, node in enumerate(nodes):
-            idx = NetworkIndex()
-            idx.set_node(node)
-            self._net_bits[i] = self._merge_bits(idx)
+            reserved = node.reserved_resources
+            spec = reserved.reserved_host_ports if reserved else ""
+            if spec:
+                self._net_bits[i] = _reserved_port_bits(spec)
 
         self._free_ports_dirty = None  # None == all rows dirty
 
@@ -207,31 +237,54 @@ class NodeTable:
         # incremental path bit for bit.
         id_to_idx = t.id_to_idx
         rows = t.live_allocs
-        pend = t._pending_allocs
         net_bits = t._net_bits
         idx_list: List[int] = []
         code_list: List[int] = []
         code_of: Dict[Tuple, int] = {}
         lut: List[Tuple] = []
+        # hot loop: at C2M scale this visits 2M allocs, so every name
+        # is a local, the terminal check is inlined attr reads, and the
+        # usage-code + port-bits lookups are ONE fused memo keyed by
+        # the resources object's identity (bulk-loaded fleets share a
+        # flyweight row, so the memo hits ~100%)
+        idx_append = idx_list.append
+        code_append = code_list.append
+        idx_get = id_to_idx.get
+        memo: Dict[int, tuple] = {}
+        memo_get = memo.get
+        term_desired = TERMINAL_DESIRED
+        term_client = TERMINAL_CLIENT
         for alloc in snapshot.allocs():
-            if alloc.terminal_status():
+            if alloc.desired_status in term_desired or \
+                    alloc.client_status in term_client:
                 continue
-            i = id_to_idx.get(alloc.node_id)
+            i = idx_get(alloc.node_id)
             if i is None:
                 continue
-            u = _alloc_usage(alloc)
-            c = code_of.get(u)
-            if c is None:
-                c = len(lut)
-                code_of[u] = c
-                lut.append(u)
-            idx_list.append(i)
-            code_list.append(c)
+            res = alloc.allocated_resources
+            hit = memo_get(id(res))
+            if hit is None or hit[2] is not res:
+                u = _alloc_usage(alloc)
+                c = code_of.get(u)
+                if c is None:
+                    c = len(lut)
+                    code_of[u] = c
+                    lut.append(u)
+                bits = t._alloc_port_bits(alloc)
+                if res is not None:
+                    memo[id(res)] = hit = (c, bits, res)
+                else:
+                    hit = (c, bits, None)
+            c = hit[0]
+            bits = hit[1]
+            idx_append(i)
+            code_append(c)
             rows[i].append(alloc)
-            pend.append((alloc.id, alloc))
-            bits = t._alloc_port_bits(alloc)
             if bits:
                 net_bits[i] |= bits
+        # the alloc-id registry is derived from the row lists at seal
+        # time (one pass there beats 2M tuple appends here)
+        t._bulk_rows_pending = True
         if idx_list:
             ii = np.fromiter(idx_list, np.int64, len(idx_list))
             cc = np.fromiter(code_list, np.int64, len(code_list))
@@ -370,6 +423,18 @@ class NodeTable:
         if self._sealed:
             return
         self._sealed = True
+        if getattr(self, "_bulk_rows_pending", False):
+            # cold build: derive the alloc-id registry from the row
+            # lists in one pass, resolving each shard dict once —
+            # put() per alloc (hash + _writable + tuple append in the
+            # hot loop) costs ~1.5us x 2M rows
+            self._bulk_rows_pending = False
+            shards = [self.alloc_by_id._writable(i)
+                      for i in range(ShardedCowMap.N)]
+            for row in self.live_allocs:
+                for alloc in row:
+                    aid = alloc.id
+                    shards[hash(aid) & 0xff][aid] = alloc
         if self._pending_allocs:
             put = self.alloc_by_id.put
             for aid, alloc in self._pending_allocs:

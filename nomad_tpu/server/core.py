@@ -14,9 +14,12 @@ can replace `raft_apply` without touching callers.
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
+import urllib.error
+import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -59,6 +62,14 @@ class ServerConfig:
     # this server's federation region (nomad/config.go Region); requests
     # stamped with a foreign region forward to that region's agent
     region: str = "global"
+    # federation peers: region name -> that region's agent HTTP address
+    # (the reference discovers via WAN serf; here configured)
+    region_peers: dict = field(default_factory=dict)
+    # ACL/namespace replication source (nomad/config.go
+    # AuthoritativeRegion + ReplicationToken): non-authoritative
+    # leaders replicate policies, GLOBAL tokens, and namespaces from it
+    authoritative_region: str = ""
+    replication_token: str = ""
     # max READY evals one worker drains into a single batched dispatch
     # (SURVEY §2.6 row 1; 1 disables batching)
     eval_batch_size: int = 4
@@ -225,6 +236,10 @@ class Server:
         """leader.go revokeLeadership:1038 — disable leader-only
         services; workers stay up, parked on the disabled broker."""
         self._leader = False
+        rep = getattr(self, "_replication", None)
+        if rep is not None:
+            rep.stop()
+            self._replication = None
         self.eval_broker.set_enabled(False)
         self.blocked_evals.set_enabled(False)
         self.plan_queue.set_enabled(False)
@@ -339,6 +354,14 @@ class Server:
         # durable event sinks are a leader duty: workers resume from
         # each sink's raft-committed progress (event_sink_manager.go)
         self.event_sinks.set_enabled(True)
+        # non-authoritative regions replicate ACL policies, global
+        # tokens, and namespaces from the authoritative region
+        # (leader.go:327-331)
+        if self.config.authoritative_region and \
+                self.config.authoritative_region != self.config.region:
+            from .replication import ReplicationManager
+            self._replication = ReplicationManager(self)
+            self._replication.start()
         if self.raft is not None:
             # seed the replicated member set from static boot config on
             # first leadership (later joins/leaves mutate it), then run
@@ -622,6 +645,13 @@ class Server:
     def _apply_acl_token_delete(self, index: int, p: dict) -> None:
         self.store.delete_acl_tokens(index, p["accessor_ids"])
 
+    # namespace appliers (fsm.go applyNamespace*)
+    def _apply_namespace_upsert(self, index: int, p: dict) -> None:
+        self.store.upsert_namespaces(index, p["namespaces"])
+
+    def _apply_namespace_delete(self, index: int, p: dict) -> None:
+        self.store.delete_namespaces(index, p["names"])
+
     # service registry appliers (built-in catalog; the reference sends
     # these to Consul, command/agent/consul/service_client.go)
     def _apply_service_registration_upsert(self, index: int,
@@ -714,6 +744,20 @@ class Server:
         get no eval — the dispatcher / Job.Dispatch creates child jobs
         which do (job_endpoint.go:236-247)."""
         job.canonicalize()
+        # multiregion fan-out (job_endpoint.go:328 multiregionRegister
+        # — enterprise in the reference, implemented here over the
+        # federation peers): an unpinned multiregion job localizes one
+        # copy per region entry; copies are region-pinned so they never
+        # re-fan when they arrive at the peer
+        if job.multiregion is not None and \
+                job.region in ("", "global"):
+            return self._multiregion_register(job, triggered_by)
+        # the requested namespace must exist (job_endpoint.go Register:
+        # "non-existent namespace"); "default" exists implicitly
+        if self.store.namespace_by_name(job.namespace) is None:
+            raise ValueError(
+                f"job {job.id!r} is in nonexistent namespace "
+                f"{job.namespace!r}")
         # connect hook (job_endpoint_hook_connect.go): inject sidecar /
         # gateway proxy tasks before implied constraints and validation
         from .connect_hook import connect_mutate, connect_validate
@@ -734,6 +778,107 @@ class Server:
         ev.modify_index = index
         self.raft_apply("eval_update", dict(evals=[ev]))
         return ev
+
+    def deregister_job_global(self, namespace: str, job_id: str,
+                              purge: bool = False):
+        """Multiregion stop (job_endpoint_oss.go multiregionStop):
+        fan the deregister to every region in the stored job's
+        multiregion block, then stop locally."""
+        job = self.store.job_by_id(namespace, job_id)
+        failed = []
+        if job is not None and job.multiregion is not None:
+            for entry in job.multiregion.regions:
+                if entry.name == self.config.region:
+                    continue
+                peer = self.config.region_peers.get(entry.name)
+                if not peer:
+                    failed.append(f"{entry.name} (no federation peer)")
+                    continue
+                req = urllib.request.Request(
+                    f"http://{peer}/v1/job/{job_id}?region={entry.name}"
+                    f"&purge={str(purge).lower()}"
+                    f"&namespace={namespace}",
+                    method="DELETE")
+                if self.config.replication_token:
+                    req.add_header("X-Nomad-Token",
+                                   self.config.replication_token)
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        r.read()
+                except Exception as e:
+                    LOG.exception("multiregion stop in %s failed",
+                                  entry.name)
+                    failed.append(f"{entry.name} ({e})")
+        ev = self.deregister_job(namespace, job_id, purge=purge)
+        if failed:
+            # the local stop stuck, but the operator must hear that
+            # other regions did NOT stop
+            raise RuntimeError(
+                f"job stopped in {self.config.region!r} but deregister "
+                f"failed in: {', '.join(failed)}")
+        return ev
+
+    def _multiregion_register(self, job: Job, triggered_by: str):
+        """Localize one copy per multiregion region entry and land it
+        in that region: the local region registers directly, remote
+        regions get an HTTP push through their federation peer. Region
+        entries override datacenters, fill zero group counts, and merge
+        meta (the documented enterprise semantics). Cross-region
+        deployment pacing (max_parallel/on_failure) is not enforced —
+        regions roll independently."""
+        import copy
+        errs = job.validate()
+        if errs:
+            raise ValueError("; ".join(errs))
+        mr = job.multiregion
+        missing = [r.name for r in mr.regions
+                   if r.name != self.config.region
+                   and r.name not in self.config.region_peers]
+        if missing:
+            raise ValueError(
+                f"no federation peer for multiregion regions {missing}")
+        local_eval = None
+        for entry in mr.regions:
+            local = copy.deepcopy(job)
+            local.region = entry.name
+            if entry.datacenters:
+                local.datacenters = list(entry.datacenters)
+            if entry.meta:
+                local.meta = {**local.meta, **entry.meta}
+            if entry.count > 0:
+                for tg in local.task_groups:
+                    if tg.count == 0:
+                        tg.count = entry.count
+            if entry.name == self.config.region:
+                local_eval = self.register_job(local, triggered_by)
+            else:
+                self._push_job_to_region(entry.name, local)
+        return local_eval
+
+    def _push_job_to_region(self, region: str, job: Job) -> None:
+        import urllib.request
+        from ..utils.codec import to_wire
+        peer = self.config.region_peers[region]
+        body = json.dumps({"Job": to_wire(job)}).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.config.replication_token:
+            headers["X-Nomad-Token"] = self.config.replication_token
+        req = urllib.request.Request(
+            f"http://{peer}/v1/jobs?region={region}", data=body,
+            method="PUT", headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                resp.read()
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                msg = str(e)
+            raise ValueError(f"multiregion register in {region!r} "
+                             f"failed: {msg}")
+        except urllib.error.URLError as e:
+            raise RuntimeError(f"multiregion register: no route to "
+                               f"region {region!r}: {e.reason}")
 
     def evaluate_job(self, namespace: str, job_id: str) -> Evaluation:
         """Force a fresh evaluation of a job (job_endpoint.go
@@ -1219,6 +1364,34 @@ class Server:
                     ltarget="${attr.os.signals}",
                     rtarget=",".join(sorted(signals)),
                     operand="set_contains"))
+
+    # -- namespaces (nomad/namespace_endpoint.go) ----------------------
+    def upsert_namespaces(self, namespaces: list) -> int:
+        errs = []
+        for ns in namespaces:
+            errs.extend(ns.validate())
+        if errs:
+            raise ValueError("; ".join(errs))
+        return self.raft_apply("namespace_upsert",
+                               dict(namespaces=list(namespaces)))
+
+    def delete_namespaces(self, names: list) -> int:
+        """DeleteNamespaces:66 — "default" is undeletable and occupied
+        namespaces (non-terminal jobs) refuse deletion."""
+        from ..models.namespace import DEFAULT_NAMESPACE
+        for name in names:
+            if name == DEFAULT_NAMESPACE:
+                raise ValueError("default namespace can not be deleted")
+            if self.store.namespace_by_name(name) is None:
+                raise KeyError(f"namespace {name} not found")
+            occupied = [j.id for j in self.store.jobs()
+                        if j.namespace == name
+                        and j.status != "dead"]
+            if occupied:
+                raise ValueError(
+                    f"namespace {name!r} has non-terminal jobs: "
+                    f"{sorted(occupied)[:5]}")
+        return self.raft_apply("namespace_delete", dict(names=names))
 
     # -- service registry (built-in catalog) ---------------------------
     def update_service_registrations(self, upserts=None,

@@ -85,6 +85,11 @@ def _register_acl_schemas() -> None:
         "service_registration_upsert": {"services": [ServiceRegistration]},
         "service_registration_delete": {},
     })
+    from ..models.namespace import Namespace
+    SCHEMAS.update({
+        "namespace_upsert": {"namespaces": [Namespace]},
+        "namespace_delete": {},
+    })
 
 
 _register_acl_schemas()

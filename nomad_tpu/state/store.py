@@ -278,6 +278,27 @@ class StateSnapshot:
         return (self._root.table("scheduler_config").get("config")
                 or SchedulerConfiguration())
 
+    # -- namespaces (state_store.go UpsertNamespaces:5565) -------------
+    def namespaces(self) -> List:
+        """All namespaces; "default" exists implicitly (the reference
+        seeds it at bootstrap)."""
+        from ..models.namespace import DEFAULT_NAMESPACE, Namespace
+        t = self._root.table("namespaces")
+        out = list(t.values())
+        if t.get(DEFAULT_NAMESPACE) is None:
+            out.append(Namespace(name=DEFAULT_NAMESPACE,
+                                 description="Default shared namespace"))
+        out.sort(key=lambda n: n.name)
+        return out
+
+    def namespace_by_name(self, name: str):
+        from ..models.namespace import DEFAULT_NAMESPACE, Namespace
+        got = self._root.table("namespaces").get(name)
+        if got is None and name == DEFAULT_NAMESPACE:
+            return Namespace(name=DEFAULT_NAMESPACE,
+                             description="Default shared namespace")
+        return got
+
     # -- service registry reads (built-in catalog) ---------------------
     def service_registrations(self, namespace: Optional[str] = None
                               ) -> List:
@@ -350,6 +371,8 @@ class StateSnapshot:
         plain["service_registrations"] = [
             to_wire(s) for s in
             root.table("service_registrations").values()]
+        plain["namespaces"] = [to_wire(n) for n in
+                               root.table("namespaces").values()]
         return out
 
 
@@ -1372,6 +1395,31 @@ class StateStore(StateSnapshot):
                        .with_index("acl_policies", index)
             self._publish(root)
 
+    # -- namespaces (state_store.go:5565) ------------------------------
+    def upsert_namespaces(self, index: int, namespaces: List) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("namespaces")
+            for ns in namespaces:
+                existing = t.get(ns.name)
+                ns.create_index = existing.create_index if existing \
+                    else index
+                ns.modify_index = index
+                t = t.set(ns.name, ns)
+            root = root.with_table("namespaces", t) \
+                       .with_index("namespaces", index)
+            self._publish(root)
+
+    def delete_namespaces(self, index: int, names: List[str]) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("namespaces")
+            for name in names:
+                t = t.delete(name)
+            root = root.with_table("namespaces", t) \
+                       .with_index("namespaces", index)
+            self._publish(root)
+
     # -- service registry (built-in catalog; the reference delegates
     # -- to Consul via command/agent/consul/service_client.go) ---------
     def upsert_service_registrations(self, index: int,
@@ -1716,6 +1764,13 @@ class StateStore(StateSnapshot):
                 v = from_wire(CSIVolume, w)
                 t = t.set((v.namespace, v.id), v)
             root = root.with_table("csi_volumes", t)
+
+            from ..models.namespace import Namespace
+            t = root.table("namespaces")
+            for w in data["tables"].get("namespaces", []):
+                ns = from_wire(Namespace, w)
+                t = t.set(ns.name, ns)
+            root = root.with_table("namespaces", t)
 
             from ..models.services import ServiceRegistration
             t = root.table("service_registrations")

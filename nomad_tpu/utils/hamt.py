@@ -144,8 +144,21 @@ class Hamt:
             yield k
 
     def values(self) -> Iterator[Any]:
-        for _, v in self.items():
-            yield v
+        # direct walk (not via items()): at C2M scale the resident
+        # table build iterates 2M entries, and the extra generator
+        # frame + tuple unpack per entry is measurable
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _Collision):
+                for _k, v in node.pairs:
+                    yield v
+            else:
+                for entry in node.entries:
+                    if isinstance(entry, (_Node, _Collision)):
+                        stack.append(entry)
+                    else:
+                        yield entry[1]
 
     def __iter__(self) -> Iterator[Any]:
         return self.keys()

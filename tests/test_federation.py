@@ -194,6 +194,158 @@ server { enabled = true }
         "east": "10.0.0.1:4646"}
 
 
+def test_acl_and_namespace_replication():
+    """leader.go replicateACLPolicies:1285 / replicateNamespaces:352:
+    a non-authoritative region's leader replicates policies, GLOBAL
+    tokens, and namespaces from the authoritative region; local tokens
+    stay regional; deletions propagate."""
+    from nomad_tpu.acl import AclPolicy
+    from nomad_tpu.models.namespace import Namespace
+
+    east_srv = Server(ServerConfig(num_schedulers=0, region="east",
+                                   heartbeat_ttl_s=60.0))
+    east_srv.start()
+    east_api = HTTPApiServer(east_srv, port=0)
+    east_api.start()
+    west_srv = Server(ServerConfig(
+        num_schedulers=0, region="west", heartbeat_ttl_s=60.0,
+        authoritative_region="east",
+        region_peers={"east": f"127.0.0.1:{east_api.port}"}))
+    west_srv.start()
+    try:
+        east_srv.upsert_acl_policies([AclPolicy(
+            name="readonly", rules='namespace "default" '
+                                   '{ policy = "read" }')])
+        east_srv.upsert_namespaces([Namespace(name="shared",
+                                              description="everywhere")])
+        gtok = east_srv.create_acl_token(name="global-tok",
+                                         policies=["readonly"],
+                                         global_=True)
+        east_srv.create_acl_token(name="local-tok",
+                                  policies=["readonly"])
+
+        assert _wait(lambda: west_srv.store.acl_policy("readonly")
+                     is not None)
+        assert _wait(lambda: west_srv.store.namespace_by_name("shared")
+                     is not None)
+        assert _wait(lambda: west_srv.store.acl_token_by_accessor(
+            gtok.accessor_id) is not None)
+        # the replicated global token carries its secret (tokens work
+        # in every region)
+        assert west_srv.store.acl_token_by_accessor(
+            gtok.accessor_id).secret_id == gtok.secret_id
+        # local tokens do NOT replicate
+        time.sleep(0.5)
+        locals_in_west = [t for t in west_srv.store.acl_tokens()
+                          if t.name == "local-tok"]
+        assert not locals_in_west
+
+        # updates + deletions propagate
+        east_srv.upsert_acl_policies([AclPolicy(
+            name="readonly", rules='namespace "default" '
+                                   '{ policy = "write" }')])
+        assert _wait(lambda: "write" in
+                     west_srv.store.acl_policy("readonly").rules)
+        east_srv.delete_acl_policies(["readonly"])
+        assert _wait(lambda: west_srv.store.acl_policy("readonly")
+                     is None)
+        east_srv.delete_namespaces(["shared"])
+        assert _wait(lambda: west_srv.store.namespace_by_name("shared")
+                     is None)
+    finally:
+        east_api.shutdown()
+        for s in (east_srv, west_srv):
+            s.shutdown()
+
+
+def test_nonauthoritative_writes_forward_to_authoritative():
+    """Namespace/ACL-policy writes against a NON-authoritative region's
+    agent are proxied to the authoritative region (the reference
+    forwards these RPCs) — otherwise the replicator would silently
+    delete locally-created objects on its next sync."""
+    east_srv = Server(ServerConfig(num_schedulers=0, region="east",
+                                   heartbeat_ttl_s=60.0))
+    east_srv.start()
+    east_api = HTTPApiServer(east_srv, port=0)
+    east_api.start()
+    west_srv = Server(ServerConfig(
+        num_schedulers=0, region="west", heartbeat_ttl_s=60.0,
+        authoritative_region="east",
+        region_peers={"east": f"127.0.0.1:{east_api.port}"}))
+    west_srv.start()
+    west_api = HTTPApiServer(west_srv, port=0)
+    west_api.start()
+    try:
+        west = ApiClient(f"http://127.0.0.1:{west_api.port}")
+        west.apply_namespace("team-z", description="made via west")
+        # the write landed in EAST (authoritative), not west's store
+        assert east_srv.store.namespace_by_name("team-z") is not None
+        # ... and replication brings it back to west
+        assert _wait(lambda: west_srv.store.namespace_by_name("team-z")
+                     is not None)
+        # ACL policy writes forward the same way
+        west._request("PUT", "/v1/acl/policy/shared-pol",
+                      {"rules": 'namespace "default" '
+                                '{ policy = "read" }'})
+        assert east_srv.store.acl_policy("shared-pol") is not None
+        assert _wait(lambda: west_srv.store.acl_policy("shared-pol")
+                     is not None)
+    finally:
+        for x in (east_api, west_api):
+            x.shutdown()
+        for x in (east_srv, west_srv):
+            x.shutdown()
+
+
+def test_multiregion_job_fans_out(federation):
+    """Multiregion register (enterprise-only in the reference,
+    job_endpoint.go:328): an unpinned multiregion job localizes one
+    region-pinned copy per region entry; stop -global fans the
+    deregister."""
+    from nomad_tpu.models.job import (Multiregion, MultiregionRegion,
+                                      MultiregionStrategy)
+    east_srv, west_srv, east_api, _wa = federation
+    # the servers need each other's agent addresses for the fan-out
+    east_srv.config.region_peers["west"] = \
+        east_api.region_peers["west"]
+
+    job = _job("mr-job")
+    job.region = "global"
+    job.datacenters = []
+    job.multiregion = Multiregion(
+        strategy=MultiregionStrategy(max_parallel=1),
+        regions=[
+            MultiregionRegion(name="east", datacenters=["dc1"],
+                              meta={"reg": "e"}),
+            MultiregionRegion(name="west", datacenters=["dc1"],
+                              meta={"reg": "w"}),
+        ])
+    east_srv.register_job(job)
+
+    assert _wait(lambda: east_srv.store.job_by_id("default", "mr-job")
+                 is not None)
+    assert _wait(lambda: west_srv.store.job_by_id("default", "mr-job")
+                 is not None)
+    je = east_srv.store.job_by_id("default", "mr-job")
+    jw = west_srv.store.job_by_id("default", "mr-job")
+    assert je.region == "east" and jw.region == "west"
+    assert je.meta["reg"] == "e" and jw.meta["reg"] == "w"
+    # both regions actually run it
+    assert _wait(lambda: len(east_srv.store.allocs_by_job(
+        "default", "mr-job")) == 1)
+    assert _wait(lambda: len(west_srv.store.allocs_by_job(
+        "default", "mr-job")) == 1)
+
+    # stop -global fans the deregister to every region in the block
+    east = ApiClient(f"http://127.0.0.1:{east_api.port}")
+    east._request("DELETE", "/v1/job/mr-job",
+                  params={"global": "true", "purge": "true"})
+    assert _wait(lambda: east_srv.store.job_by_id("default", "mr-job")
+                 is None)
+    assert _wait(lambda: west_srv.store.job_by_id("default", "mr-job")
+                 is None)
+
+
 def test_local_region_stamp_is_served_locally(federation):
     east_srv, _w, east_api, _wa = federation
     c = ApiClient(f"http://127.0.0.1:{east_api.port}", region="east")

@@ -1,0 +1,92 @@
+"""Namespace registry (nomad/namespace_endpoint.go, structs.go
+Namespace:4719): CRUD, validation, delete gates, job admission."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import ApiClient, ApiError, HTTPApiServer
+from nomad_tpu.models.namespace import Namespace
+from nomad_tpu.server import Server, ServerConfig
+
+
+@pytest.fixture
+def server():
+    s = Server(ServerConfig(num_schedulers=0))
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def test_validation():
+    # TestNamespace_Validate
+    assert not Namespace(name="web-prod-1").validate()
+    assert Namespace(name="").validate()
+    assert Namespace(name="has space").validate()
+    assert Namespace(name="x" * 129).validate()
+    assert Namespace(name="ok", description="d" * 257).validate()
+
+
+def test_default_exists_implicitly(server):
+    names = [n.name for n in server.store.namespaces()]
+    assert names == ["default"]
+    assert server.store.namespace_by_name("default") is not None
+
+
+def test_crud_roundtrip(server):
+    server.upsert_namespaces([Namespace(name="api",
+                                        description="apis")])
+    got = server.store.namespace_by_name("api")
+    assert got is not None and got.description == "apis"
+    assert [n.name for n in server.store.namespaces()] == \
+        ["api", "default"]
+    # update keeps create_index
+    ci = got.create_index
+    server.upsert_namespaces([Namespace(name="api", description="v2")])
+    got = server.store.namespace_by_name("api")
+    assert got.description == "v2" and got.create_index == ci
+    server.delete_namespaces(["api"])
+    assert server.store.namespace_by_name("api") is None
+
+
+def test_delete_gates(server):
+    # default is undeletable (DeleteNamespaces:66)
+    with pytest.raises(ValueError, match="default"):
+        server.delete_namespaces(["default"])
+    with pytest.raises(KeyError):
+        server.delete_namespaces(["ghost"])
+    # a namespace with a live job refuses deletion
+    server.upsert_namespaces([Namespace(name="busy")])
+    job = mock.batch_job()
+    job.namespace = "busy"
+    server.register_job(job)
+    with pytest.raises(ValueError, match="non-terminal"):
+        server.delete_namespaces(["busy"])
+
+
+def test_job_in_nonexistent_namespace_rejected(server):
+    job = mock.batch_job()
+    job.namespace = "nope"
+    with pytest.raises(ValueError, match="nonexistent namespace"):
+        server.register_job(job)
+
+
+def test_http_surface(server):
+    api = HTTPApiServer(server, port=0)
+    api.start()
+    try:
+        c = ApiClient(f"http://127.0.0.1:{api.port}")
+        c.apply_namespace("team-a", description="team a")
+        assert {n["name"] for n in c.list_namespaces()} == \
+            {"default", "team-a"}
+        got = c.get_namespace("team-a")
+        assert got["description"] == "team a"
+        c.delete_namespace("team-a")
+        with pytest.raises(ApiError):
+            c.get_namespace("team-a")
+        with pytest.raises(ApiError) as e:
+            c.delete_namespace("default")
+        assert e.value.status == 400
+    finally:
+        api.shutdown()
