@@ -41,7 +41,10 @@ class ClientConfig:
     disk_mb: int = 100 * 1024
     # docker registers only when a reachable dockerd answers /version;
     # hosts without it drop the driver (and its node attribute) cleanly
-    drivers: tuple = ("mock_driver", "raw_exec", "exec", "docker")
+    # conditional drivers (docker/java/qemu) drop out cleanly when
+    # their binary/daemon is absent (the available() probe)
+    drivers: tuple = ("mock_driver", "raw_exec", "exec", "docker",
+                      "java", "qemu")
     meta: dict = field(default_factory=dict)
     poll_interval_s: float = 0.2
     heartbeat_interval_s: float = 3.0

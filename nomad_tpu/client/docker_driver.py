@@ -238,21 +238,10 @@ class DockerDriver:
         (drivers/docker port_map semantics: port_map maps LABEL ->
         container port; the alloc network supplies the host port for
         that label)."""
-        def field(obj, name, default=None):
-            # networks arrive as model objects (in-proc drivers) or
-            # wire dicts (across the plugin boundary)
-            if isinstance(obj, dict):
-                return obj.get(name, default)
-            return getattr(obj, name, default)
-
+        from .drivers import resolve_host_ports
         exposed: Dict[str, dict] = {}
         bindings: Dict[str, list] = {}
-        host_ports = {}
-        for nw in alloc_networks or []:
-            for p in list(field(nw, "reserved_ports") or []) + \
-                    list(field(nw, "dynamic_ports") or []):
-                host_ports[field(p, "label")] = (
-                    field(p, "value"), field(nw, "ip", "") or "0.0.0.0")
+        host_ports = resolve_host_ports(alloc_networks)
         for label, container_port in (port_map or {}).items():
             hp = host_ports.get(label)
             if hp is None:

@@ -61,7 +61,29 @@ class TaskHandle:
         cid = getattr(self, "container_id", None)
         if cid:
             out["container_id"] = cid
+        mon = getattr(self, "monitor_path", None)
+        if mon:
+            out["monitor_path"] = mon
         return out
+
+
+def resolve_host_ports(alloc_networks) -> Dict[str, tuple]:
+    """label -> (host_port, host_ip) from the alloc's allocated
+    networks, which arrive as model objects (in-proc drivers) or wire
+    dicts (across the plugin boundary). Shared by the docker and qemu
+    port_map paths."""
+    def field(obj, name, default=None):
+        if isinstance(obj, dict):
+            return obj.get(name, default)
+        return getattr(obj, name, default)
+
+    host_ports: Dict[str, tuple] = {}
+    for nw in alloc_networks or []:
+        for p in list(field(nw, "reserved_ports") or []) + \
+                list(field(nw, "dynamic_ports") or []):
+            host_ports[field(p, "label")] = (
+                field(p, "value"), field(nw, "ip", "") or "0.0.0.0")
+    return host_ports
 
 
 def _parse_duration(val) -> float:
@@ -414,6 +436,213 @@ class ExecDriver(RawExecDriver):
         return executor.stats()
 
 
+class JavaDriver(RawExecDriver):
+    """drivers/java/driver.go: run a jar or class on the host JVM.
+    Conditional on a working `java` binary (the availability probe
+    drops the driver cleanly on hosts without one, like docker)."""
+
+    name = "java"
+    CONFIG_SPEC = {
+        "jar_path": _SpecAttr("string"),
+        "class": _SpecAttr("string"),
+        "class_path": _SpecAttr("string"),
+        "args": _SpecAttr("list(string)", default=[]),
+        "jvm_options": _SpecAttr("list(string)", default=[]),
+    }
+
+    def available(self) -> bool:
+        import shutil
+        return shutil.which("java") is not None
+
+    def fingerprint(self) -> Dict[str, str]:
+        """javaVersionInfo (driver.go:239): `java -version` writes to
+        STDERR; parse version/runtime/vm lines."""
+        try:
+            out = subprocess.run(["java", "-version"],
+                                 capture_output=True, text=True,
+                                 timeout=10)
+        except (OSError, subprocess.TimeoutExpired):
+            return {}
+        text = out.stderr or out.stdout or ""
+        attrs = {"driver.java": "1"}
+        import re as _re
+        m = _re.search(r'version "([^"]+)"', text)
+        if m:
+            attrs["driver.java.version"] = m.group(1)
+        # JAVA_TOOL_OPTIONS prepends "Picked up ..." lines to stderr;
+        # skip them or runtime/vm land one line off
+        lines = [line.strip() for line in text.splitlines()
+                 if line.strip()
+                 and not line.startswith("Picked up ")]
+        if len(lines) > 1:
+            attrs["driver.java.runtime"] = lines[1]
+        if len(lines) > 2:
+            attrs["driver.java.vm"] = lines[2]
+        return attrs
+
+    def start_task(self, task_name: str, config: dict, env: dict,
+                   ctx: Optional[dict] = None) -> TaskHandle:
+        """driver.go StartTask:311 — `jar_path or class must be
+        specified`; argv = java [jvm_options] [-cp class_path]
+        (-jar jar | class) [args]."""
+        jar = config.get("jar_path") or ""
+        cls = config.get("class") or ""
+        if not jar and not cls:
+            raise RuntimeError("jar_path or class must be specified")
+        # absolute binary path (driver.go GetAbsolutePath): the task's
+        # env map usually has no PATH, so exec must not depend on it
+        import shutil
+        java_bin = shutil.which("java") or "java"
+        argv = [java_bin] + list(config.get("jvm_options") or [])
+        if config.get("class_path"):
+            argv += ["-cp", str(config["class_path"])]
+        if jar:
+            task_dir = (ctx or {}).get("task_dir") or ""
+            if task_dir and not _os.path.isabs(jar):
+                jar = _os.path.join(task_dir, jar)
+            argv += ["-jar", jar]
+        else:
+            argv.append(cls)
+        argv += [str(a) for a in config.get("args") or []]
+        sub = dict(config)
+        sub["command"], sub["args"] = argv[0], argv[1:]
+        return super().start_task(task_name, sub, env, ctx=ctx)
+
+
+class QemuDriver(RawExecDriver):
+    """drivers/qemu/driver.go: boot a VM image under qemu-system.
+    Conditional on the qemu binary; graceful shutdown rides a unix
+    monitor socket (system_powerdown) with SIGTERM fallback."""
+
+    name = "qemu"
+    BINARY = "qemu-system-x86_64"
+    CONFIG_SPEC = {
+        "image_path": _SpecAttr("string", required=True),
+        "accelerator": _SpecAttr("string", default="tcg"),
+        "graceful_shutdown": _SpecAttr("bool", default=False),
+        "args": _SpecAttr("list(string)", default=[]),
+        "port_map": _SpecAttr("map(number)", default={}),
+    }
+
+    def available(self) -> bool:
+        import shutil
+        return shutil.which(self.BINARY) is not None
+
+    def fingerprint(self) -> Dict[str, str]:
+        try:
+            out = subprocess.run([self.BINARY, "--version"],
+                                 capture_output=True, text=True,
+                                 timeout=10)
+        except (OSError, subprocess.TimeoutExpired):
+            return {}
+        attrs = {"driver.qemu": "1"}
+        import re as _re
+        m = _re.search(r"version ([\d.]+)", out.stdout or "")
+        if m:
+            attrs["driver.qemu.version"] = m.group(1)
+        return attrs
+
+    def start_task(self, task_name: str, config: dict, env: dict,
+                   ctx: Optional[dict] = None) -> TaskHandle:
+        """driver.go StartTask:402: -machine accel, -m from resources,
+        -drive the image, -nographic; port_map becomes user-net
+        hostfwd entries mapping scheduler-assigned host ports to guest
+        ports."""
+        ctx = ctx or {}
+        image = str(config.get("image_path") or "")
+        if not image:
+            raise RuntimeError("image_path is required")
+        task_dir = ctx.get("task_dir") or ""
+        if task_dir and not _os.path.isabs(image):
+            image = _os.path.join(task_dir, image)
+        mem_mb = int((ctx.get("resources") or {}).get("memory_mb")
+                     or 512)
+        import shutil
+        qemu_bin = shutil.which(self.BINARY) or self.BINARY
+        argv = [qemu_bin,
+                "-machine", "type=pc,accel="
+                + str(config.get("accelerator") or "tcg"),
+                "-name", f"nomad-{task_name}",
+                "-m", f"{mem_mb}M",
+                "-drive", f"file={image}",
+                "-nographic"]
+        monitor = ""
+        if config.get("graceful_shutdown"):
+            import tempfile
+            from ..utils.ids import generate_uuid
+            monitor = _os.path.join(
+                task_dir or tempfile.gettempdir(),
+                f"qmon-{generate_uuid()[:8]}.sock")
+            # AF_UNIX sun_path limit — the reference rejects over-long
+            # monitor paths up front (qemuLegacyMaxMonitorPathLen)
+            # instead of letting qemu die with an opaque bind error
+            if len(monitor.encode()) > 104:
+                raise RuntimeError(
+                    f"qemu monitor path {monitor!r} exceeds the unix "
+                    "socket path limit; use a shorter alloc dir")
+            argv += ["-monitor", f"unix:{monitor},server,nowait"]
+        port_map = config.get("port_map") or {}
+        if port_map:
+            # hostfwd=tcp::<host>-:<guest> per mapped label
+            # (driver.go:438-449); host ports come from the
+            # scheduler's allocated networks
+            host_ports = resolve_host_ports(ctx.get("alloc_networks"))
+            fwds = []
+            for label, guest in port_map.items():
+                hp = host_ports.get(label)
+                if not hp or not hp[0]:
+                    raise RuntimeError(
+                        f"unknown port label {label!r} in port_map")
+                fwds.append(f"hostfwd=tcp::{int(hp[0])}-:{int(guest)}")
+            argv += ["-netdev",
+                     "user,id=user.0," + ",".join(fwds),
+                     "-device", "virtio-net,netdev=user.0"]
+        argv += [str(a) for a in config.get("args") or []]
+        sub = dict(config)
+        sub["command"], sub["args"] = argv[0], argv[1:]
+        h = super().start_task(task_name, sub, env, ctx=ctx)
+        h.monitor_path = monitor
+        return h
+
+    def stop_task(self, handle: TaskHandle, timeout_s: float = 5.0
+                  ) -> None:
+        """Graceful shutdown via the monitor socket
+        (qemuGracefulShutdownMsg driver.go:41), then the SIGTERM/kill
+        escalation."""
+        monitor = getattr(handle, "monitor_path", "")
+        if monitor:
+            import socket
+            try:
+                with socket.socket(socket.AF_UNIX,
+                                   socket.SOCK_STREAM) as sk:
+                    sk.settimeout(2.0)
+                    sk.connect(monitor)
+                    sk.sendall(b"system_powerdown\n")
+                # wait for a clean exit before escalating; works for
+                # both child procs and restart-recovered handles
+                # (whose liveness poller sets _done)
+                if handle.proc is not None:
+                    try:
+                        handle.proc.wait(timeout_s)
+                        handle.wait(1.0)
+                        return
+                    except subprocess.TimeoutExpired:
+                        pass
+                elif handle.wait(timeout_s):
+                    return
+            except OSError:
+                pass
+        super().stop_task(handle, timeout_s)
+
+    def recover_task(self, state: dict) -> Optional[TaskHandle]:
+        """Re-attach keeps the monitor socket path so graceful
+        shutdown survives a client restart."""
+        h = super().recover_task(state)
+        if h is not None and state.get("monitor_path"):
+            h.monitor_path = state["monitor_path"]
+        return h
+
+
 def _docker_driver():
     # deferred: docker_driver imports TaskHandle from this module
     from .docker_driver import DockerDriver
@@ -425,4 +654,6 @@ DRIVER_CATALOG = {
     "raw_exec": RawExecDriver,
     "exec": ExecDriver,
     "docker": _docker_driver,
+    "java": JavaDriver,
+    "qemu": QemuDriver,
 }

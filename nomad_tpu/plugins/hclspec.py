@@ -71,6 +71,23 @@ def _coerce(path: str, typ: str, value: Any) -> Any:
                             f"{type(value).__name__}")
         return [_coerce(f"{path}[{i}]", inner, v)
                 for i, v in enumerate(value)]
+    if typ.startswith("map(") and typ.endswith(")"):
+        # hclspec map(T): string keys, T values (e.g. qemu port_map)
+        inner = typ[4:-1]
+        if isinstance(value, (list, tuple)):
+            # HCL's repeated-block shape: [{k: v}, ...] flattens
+            merged: Dict[str, Any] = {}
+            for entry in value:
+                if not isinstance(entry, dict):
+                    raise SpecError(f"{path}: expected {typ}, got list "
+                                    f"of {type(entry).__name__}")
+                merged.update(entry)
+            value = merged
+        if not isinstance(value, dict):
+            raise SpecError(f"{path}: expected {typ}, got "
+                            f"{type(value).__name__}")
+        return {str(k): _coerce(f"{path}[{k}]", inner, v)
+                for k, v in value.items()}
     raise SpecError(f"{path}: unknown spec type {typ!r}")
 
 
