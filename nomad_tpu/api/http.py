@@ -97,6 +97,22 @@ class HTTPApiServer:
             def _handle(self, method: str):
                 try:
                     url = urlparse(self.path)
+                    # embedded web UI (the reference serves its Ember
+                    # build the same way); data requests out of the
+                    # page carry the ACL token themselves
+                    if method == "GET" and (
+                            url.path == "/" or url.path == "/ui"
+                            or url.path.startswith("/ui/")):
+                        from .ui import INDEX_HTML
+                        body = INDEX_HTML.encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/html; charset=utf-8")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                     q = {k: v[0] for k, v in parse_qs(url.query).items()}
                     token = self.headers.get("X-Nomad-Token", "")
                     # region-keyed forwarding (nomad/rpc.go forward:502
