@@ -249,6 +249,49 @@ class ApiClient:
                     continue
                 yield json.loads(line)
 
+    # -- volumes ---------------------------------------------------------
+    def list_volumes(self, namespace: str = "default") -> list:
+        return self._request("GET", "/v1/volumes",
+                             params={"namespace": namespace})
+
+    def get_volume(self, volume_id: str,
+                   namespace: str = "default") -> dict:
+        return self._request("GET", f"/v1/volume/csi/{volume_id}",
+                             params={"namespace": namespace})
+
+    def register_volume(self, spec: dict,
+                        namespace: str = "default") -> dict:
+        vol_id = spec.get("id", spec.get("ID", ""))
+        if not vol_id:
+            raise ApiError(400, "volume spec requires an id")
+        return self._request("PUT", f"/v1/volume/csi/{vol_id}",
+                             {"Volume": spec},
+                             params={"namespace": namespace})
+
+    def deregister_volume(self, volume_id: str, force: bool = False,
+                          namespace: str = "default") -> dict:
+        return self._request(
+            "DELETE", f"/v1/volume/csi/{volume_id}",
+            params={"namespace": namespace,
+                    "force": str(force).lower()})
+
+    # -- operator --------------------------------------------------------
+    def snapshot_save(self) -> dict:
+        return self._request("GET", "/v1/operator/snapshot")
+
+    def snapshot_restore(self, snapshot: dict) -> dict:
+        return self._request("PUT", "/v1/operator/snapshot",
+                             {"snapshot": snapshot})
+
+    def autopilot_config(self) -> dict:
+        return self._request("GET",
+                             "/v1/operator/autopilot/configuration")
+
+    def set_autopilot_config(self, config: dict) -> dict:
+        return self._request("PUT",
+                             "/v1/operator/autopilot/configuration",
+                             config)
+
     # -- namespaces ------------------------------------------------------
     def list_namespaces(self) -> list:
         return self._request("GET", "/v1/namespaces")

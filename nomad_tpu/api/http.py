@@ -841,6 +841,50 @@ class HTTPApiServer:
                 return None
             return to_wire(ev), idx
 
+        # operator snapshot (nomad operator snapshot save/restore;
+        # nomad/operator_endpoint.go SnapshotSave): the full store dump.
+        # Restore is allowed only outside raft mode — reseeding one
+        # server's FSM under a live replicated log would desync
+        # followers (they reseed via raft snapshot install instead).
+        if path == "/v1/operator/snapshot":
+            if method == "GET":
+                snap = store.snapshot()
+                return {"index": snap.latest_index(),
+                        "snapshot": snap.dump()}, idx
+            if method in ("PUT", "POST"):
+                if getattr(s, "raft", None) is not None:
+                    raise ValueError(
+                        "snapshot restore over HTTP is only supported "
+                        "on single-server (dev) mode; clustered "
+                        "servers reseed via raft")
+                data = body_fn() or {}
+                payload = data.get("snapshot")
+                if not isinstance(payload, dict):
+                    raise ValueError("missing snapshot body")
+                s.install_snapshot(payload)
+                return {"index": store.latest_index()}, \
+                    store.latest_index()
+
+        # operator autopilot configuration (nomad/operator_endpoint.go
+        # AutopilotGetConfiguration / AutopilotSetConfiguration)
+        if path == "/v1/operator/autopilot/configuration":
+            if method == "GET":
+                return {"CleanupDeadServers":
+                        s.config.dead_server_cleanup_s > 0,
+                        "DeadServerCleanupSecs":
+                        s.config.dead_server_cleanup_s}, idx
+            if method in ("PUT", "POST"):
+                data = body_fn() or {}
+                if "DeadServerCleanupSecs" in data:
+                    s.config.dead_server_cleanup_s = float(
+                        data["DeadServerCleanupSecs"])
+                elif data.get("CleanupDeadServers") is False:
+                    s.config.dead_server_cleanup_s = 0.0
+                elif data.get("CleanupDeadServers") is True and \
+                        s.config.dead_server_cleanup_s <= 0:
+                    s.config.dead_server_cleanup_s = 30.0  # default
+                return {"Updated": True}, idx
+
         if path == "/v1/search" and method in ("PUT", "POST"):
             data = body_fn()
             return self._search(data.get("Prefix", ""),

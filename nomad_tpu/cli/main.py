@@ -856,6 +856,199 @@ def cmd_scaling_policy_info(args) -> int:
     return 0
 
 
+def cmd_version(args) -> int:
+    from .. import __version__
+    print(f"nomad-tpu v{__version__}")
+    return 0
+
+
+def cmd_ui(args) -> int:
+    print(f"Web UI: {args.address}/ui")
+    return 0
+
+
+def cmd_status(args) -> int:
+    """`nomad status [prefix]` — no-prefix lists jobs; a prefix
+    searches every context (command/status.go)."""
+    c = _client(args)
+    if not args.prefix:
+        args.job_id = ""
+        return cmd_job_status(args)
+    res = c.search(args.prefix)
+    hits = [(ctx, m) for ctx, matches in
+            (res.get("Matches") or {}).items() for m in matches]
+    if not hits:
+        print(f"No matches found for {args.prefix!r}")
+        return 1
+    rows = [[ctx, short_id(m) if len(m) > 30 else m]
+            for ctx, m in hits]
+    _print_rows(rows, ["Context", "ID"])
+    return 0
+
+
+def cmd_monitor(args) -> int:
+    """Stream agent logs (command/agent_monitor.go)."""
+    import urllib.request
+    url = f"{args.address}/v1/agent/monitor?log_level={args.log_level}"
+    req = urllib.request.Request(url)
+    if getattr(args, "token", ""):
+        req.add_header("X-Nomad-Token", args.token)
+    import urllib.error
+    try:
+        with urllib.request.urlopen(req, timeout=3600) as resp:
+            for line in resp:
+                line = line.strip()
+                if not line or line == b"{}":
+                    continue
+                print(json.loads(line).get("Data", ""))
+    except KeyboardInterrupt:
+        pass
+    except urllib.error.HTTPError as e:
+        try:
+            msg = json.loads(e.read()).get("error", str(e))
+        except Exception:
+            msg = str(e)
+        print(f"Error: {msg}", file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"Error: unable to reach agent: {e.reason}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_volume_status(args) -> int:
+    c = _client(args)
+    if args.volume_id:
+        try:
+            v = c.get_volume(args.volume_id, namespace=args.namespace)
+        except ApiError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(v, indent=2, sort_keys=True, default=str))
+        return 0
+    rows = [[v.get("id", ""), v.get("plugin_id", ""),
+             str(v.get("schedulable", "")),
+             v.get("access_mode", "")]
+            for v in c.list_volumes(namespace=args.namespace)]
+    _print_rows(rows, ["ID", "Plugin", "Schedulable", "Access mode"])
+    return 0
+
+
+def cmd_volume_register(args) -> int:
+    c = _client(args)
+    from ..jobspec.hcl import parse_hcl
+    try:
+        with open(args.file) as f:
+            raw = f.read()
+        spec = json.loads(raw) if raw.strip().startswith("{") \
+            else parse_hcl(raw)
+        body = spec.get("volume", spec)
+        if isinstance(body, dict) and len(body) == 1 and \
+                isinstance(next(iter(body.values())), dict):
+            vol_id, body = next(iter(body.items()))
+            body.setdefault("id", vol_id)
+        c.register_volume(body, namespace=args.namespace)
+    except (OSError, ValueError, ApiError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Volume {body.get('id', '')} registered")
+    return 0
+
+
+def cmd_volume_deregister(args) -> int:
+    c = _client(args)
+    try:
+        c.deregister_volume(args.volume_id, force=args.force,
+                            namespace=args.namespace)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Volume {args.volume_id} deregistered")
+    return 0
+
+
+def cmd_operator_snapshot_save(args) -> int:
+    c = _client(args)
+    try:
+        out = c.snapshot_save()
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    with open(args.file, "w") as f:
+        json.dump(out, f, default=str)
+    print(f"State snapshot written to {args.file} "
+          f"(index {out['index']})")
+    return 0
+
+
+def cmd_operator_snapshot_inspect(args) -> int:
+    try:
+        with open(args.file) as f:
+            out = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    tables = out.get("snapshot", {}).get("tables", {})
+    rows = [[name, str(len(rows_)) if isinstance(rows_, list) else "1"]
+            for name, rows_ in sorted(tables.items()) if rows_]
+    print(f"Index: {out.get('index')}")
+    _print_rows(rows, ["Table", "Rows"])
+    return 0
+
+
+def cmd_operator_snapshot_restore(args) -> int:
+    c = _client(args)
+    try:
+        with open(args.file) as f:
+            out = json.load(f)
+        res = c.snapshot_restore(out["snapshot"])
+    except (OSError, ValueError, KeyError, ApiError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Snapshot restored (index {res['index']})")
+    return 0
+
+
+def cmd_operator_autopilot_get(args) -> int:
+    c = _client(args)
+    print(json.dumps(c.autopilot_config(), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_operator_autopilot_set(args) -> int:
+    c = _client(args)
+    cfg = {}
+    if args.cleanup_dead_servers is not None:
+        cfg["CleanupDeadServers"] = \
+            args.cleanup_dead_servers.lower() == "true"
+    if args.dead_server_cleanup_secs is not None:
+        cfg["DeadServerCleanupSecs"] = args.dead_server_cleanup_secs
+    c.set_autopilot_config(cfg)
+    print("Configuration updated!")
+    return 0
+
+
+def cmd_job_promote(args) -> int:
+    """`nomad job promote` — promote the job's latest deployment
+    (command/job_promote.go)."""
+    c = _client(args)
+    try:
+        deps = c.job_deployments(args.job_id)
+        active = [d for d in deps
+                  if d.get("status") in ("running", "paused")]
+        if not active:
+            print(f"Error: no active deployment for job "
+                  f"{args.job_id}", file=sys.stderr)
+            return 1
+        c.promote_deployment(active[0]["id"])
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Deployment {short_id(active[0]['id'])} promoted")
+    return 0
+
+
 def cmd_namespace_list(args) -> int:
     c = _client(args)
     rows = [[n["name"], n["description"]]
@@ -1133,6 +1326,9 @@ def build_parser() -> argparse.ArgumentParser:
     revert.add_argument("version", type=int)
     revert.add_argument("-detach", action="store_true")
     revert.set_defaults(fn=cmd_job_revert)
+    jpromote = job.add_parser("promote")
+    jpromote.add_argument("job_id")
+    jpromote.set_defaults(fn=cmd_job_promote)
     history = job.add_parser("history")
     history.add_argument("job_id")
     history.set_defaults(fn=cmd_job_history)
@@ -1254,6 +1450,24 @@ def build_parser() -> argparse.ArgumentParser:
     op = sub.add_parser("operator").add_subparsers(dest="sub")
     oraft = op.add_parser("raft-status")
     oraft.set_defaults(fn=cmd_operator_raft)
+    osave = op.add_parser("snapshot-save")
+    osave.add_argument("file")
+    osave.set_defaults(fn=cmd_operator_snapshot_save)
+    oinspect = op.add_parser("snapshot-inspect")
+    oinspect.add_argument("file")
+    oinspect.set_defaults(fn=cmd_operator_snapshot_inspect)
+    orestore = op.add_parser("snapshot-restore")
+    orestore.add_argument("file")
+    orestore.set_defaults(fn=cmd_operator_snapshot_restore)
+    oaget = op.add_parser("autopilot-get-config")
+    oaget.set_defaults(fn=cmd_operator_autopilot_get)
+    oaset = op.add_parser("autopilot-set-config")
+    oaset.add_argument("-cleanup-dead-servers",
+                       dest="cleanup_dead_servers", default=None)
+    oaset.add_argument("-dead-server-cleanup-secs",
+                       dest="dead_server_cleanup_secs", type=float,
+                       default=None)
+    oaset.set_defaults(fn=cmd_operator_autopilot_set)
 
     scaling = sub.add_parser("scaling").add_subparsers(dest="sub")
     spl = scaling.add_parser("policy-list")
@@ -1261,6 +1475,36 @@ def build_parser() -> argparse.ArgumentParser:
     spi = scaling.add_parser("policy-info")
     spi.add_argument("policy_id")
     spi.set_defaults(fn=cmd_scaling_policy_info)
+
+    ver = sub.add_parser("version")
+    ver.set_defaults(fn=cmd_version)
+
+    uip = sub.add_parser("ui", help="print the web UI address")
+    uip.set_defaults(fn=cmd_ui)
+
+    st = sub.add_parser("status",
+                        help="cross-context id lookup (or job list)")
+    st.add_argument("prefix", nargs="?", default="")
+    st.set_defaults(fn=cmd_status)
+
+    mon = sub.add_parser("monitor", help="stream agent logs")
+    mon.add_argument("-log-level", dest="log_level", default="info")
+    mon.set_defaults(fn=cmd_monitor)
+
+    volume = sub.add_parser("volume").add_subparsers(dest="sub")
+    vst = volume.add_parser("status")
+    vst.add_argument("volume_id", nargs="?", default="")
+    vst.add_argument("-namespace", default="default")
+    vst.set_defaults(fn=cmd_volume_status)
+    vrg = volume.add_parser("register")
+    vrg.add_argument("file")
+    vrg.add_argument("-namespace", default="default")
+    vrg.set_defaults(fn=cmd_volume_register)
+    vdr = volume.add_parser("deregister")
+    vdr.add_argument("volume_id")
+    vdr.add_argument("-force", action="store_true")
+    vdr.add_argument("-namespace", default="default")
+    vdr.set_defaults(fn=cmd_volume_deregister)
 
     namespace = sub.add_parser("namespace").add_subparsers(dest="sub")
     nsl = namespace.add_parser("list")

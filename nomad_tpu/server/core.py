@@ -378,9 +378,11 @@ class Server:
                     LOG.exception("membership seed failed")
             threading.Thread(target=_seed, daemon=True,
                              name="member-seed").start()
-            if self.config.dead_server_cleanup_s > 0:
-                threading.Thread(target=self._autopilot_loop,
-                                 daemon=True, name="autopilot").start()
+            # always spawned: the loop idles when the threshold is 0,
+            # so `operator autopilot-set-config` can enable cleanup on
+            # a live leader
+            threading.Thread(target=self._autopilot_loop,
+                             daemon=True, name="autopilot").start()
 
     def _reap_failed_evals(self) -> None:
         """Drain the broker's failed queue: mark the eval failed and
@@ -1113,9 +1115,13 @@ class Server:
         threshold is removed from the member set, as long as a quorum
         of the REMAINING members is intact."""
         import time as _time
-        threshold = self.config.dead_server_cleanup_s
         while self._leader and not getattr(self, "_shutdown", False):
-            _time.sleep(max(min(threshold / 4.0, 2.0), 0.5))
+            # re-read per tick: `operator autopilot-set-config` mutates
+            # the threshold at runtime (0 disables without killing the
+            # loop, so re-enabling works too)
+            threshold = self.config.dead_server_cleanup_s
+            _time.sleep(max(min(threshold / 4.0, 2.0), 0.5)
+                        if threshold > 0 else 1.0)
             raft = self.raft
             if raft is None or not raft.is_leader() or threshold <= 0:
                 continue

@@ -1,0 +1,99 @@
+"""CLI tranche: version/ui/status/volume/operator-snapshot/autopilot/
+job-promote (vs command/status.go, command/volume_*.go,
+command/operator_snapshot_*.go, command/operator_autopilot_*.go)."""
+
+import contextlib
+import io
+import json
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import HTTPApiServer
+from nomad_tpu.cli.main import main as cli
+from nomad_tpu.models.csi import CSIVolume
+from nomad_tpu.server import Server, ServerConfig
+
+
+@pytest.fixture
+def cluster():
+    srv = Server(ServerConfig(num_schedulers=0))
+    srv.start()
+    api = HTTPApiServer(srv, port=0)
+    api.start()
+    job = mock.batch_job()
+    job.id = "smoke-job"
+    srv.register_job(job)
+    yield srv, f"http://127.0.0.1:{api.port}"
+    api.shutdown()
+    srv.shutdown()
+
+
+def run(addr, *argv):
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli(["-address", addr, *argv])
+    return rc, out.getvalue()
+
+
+def test_version_and_ui(cluster):
+    _s, addr = cluster
+    rc, out = run(addr, "version")
+    assert rc in (0, None) and "nomad-tpu v" in out
+    _rc, out = run(addr, "ui")
+    assert "/ui" in out
+
+
+def test_status_lookup(cluster):
+    _s, addr = cluster
+    _rc, out = run(addr, "status")
+    assert "smoke-job" in out
+    _rc, out = run(addr, "status", "smoke")
+    assert "jobs" in out and "smoke" in out
+    rc, _out = run(addr, "status", "zzz-no-such")
+    assert rc == 1
+
+
+def test_autopilot_config_roundtrip(cluster):
+    srv, addr = cluster
+    _rc, out = run(addr, "operator", "autopilot-get-config")
+    assert "CleanupDeadServers" in out
+    run(addr, "operator", "autopilot-set-config",
+        "-dead-server-cleanup-secs", "60")
+    assert srv.config.dead_server_cleanup_s == 60.0
+
+
+def test_volume_commands(cluster, tmp_path):
+    srv, addr = cluster
+    srv.register_csi_volume(CSIVolume(id="vol1", plugin_id="plug",
+                                      namespace="default"))
+    _rc, out = run(addr, "volume", "status")
+    assert "vol1" in out
+    _rc, out = run(addr, "volume", "status", "vol1")
+    assert "plug" in out
+    # register from a JSON spec file
+    spec = tmp_path / "vol.json"
+    spec.write_text(json.dumps(
+        {"id": "vol2", "plugin_id": "plug", "namespace": "default"}))
+    _rc, out = run(addr, "volume", "register", str(spec))
+    assert "registered" in out
+    assert srv.store.csi_volume("default", "vol2") is not None
+    _rc, out = run(addr, "volume", "deregister", "vol1")
+    assert "deregistered" in out
+    assert srv.store.csi_volume("default", "vol1") is None
+
+
+def test_snapshot_save_inspect_restore(cluster, tmp_path):
+    """operator snapshot round-trip brings a purged job back (dev
+    mode; clustered restore is refused — raft reseeds followers)."""
+    srv, addr = cluster
+    snap = tmp_path / "snap.json"
+    _rc, out = run(addr, "operator", "snapshot-save", str(snap))
+    assert "written" in out
+    _rc, out = run(addr, "operator", "snapshot-inspect", str(snap))
+    assert "jobs" in out
+    srv.deregister_job("default", "smoke-job", purge=True)
+    assert srv.store.job_by_id("default", "smoke-job") is None
+    _rc, out = run(addr, "operator", "snapshot-restore", str(snap))
+    assert "restored" in out
+    assert srv.store.job_by_id("default", "smoke-job") is not None
