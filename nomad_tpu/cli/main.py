@@ -361,19 +361,27 @@ def _print_job_diff(diff: dict, indent: str = "") -> None:
         print(f"{indent}  {_DIFF_MARK[f['Type']]} {f['Name']}: "
               f"{f['Old']!r} => {f['New']!r}")
     for tg in diff.get("TaskGroups", []):
+        updates = tg.get("Updates") or {}
+        counts = " (" + ", ".join(
+            f"{v} {k}" for k, v in sorted(updates.items())) + ")" \
+            if updates else ""
         print(f"{indent}{_DIFF_MARK[tg['Type']]} Task Group: "
-              f"{tg.get('Name', '')!r}")
+              f"{tg.get('Name', '')!r}{counts}")
         _print_object_diff(tg, indent + "  ")
         for task in tg.get("Tasks", []):
+            ann = task.get("Annotations") or []
+            suffix = f" ({', '.join(ann)})" if ann else ""
             print(f"{indent}  {_DIFF_MARK[task['Type']]} Task: "
-                  f"{task.get('Name', '')!r}")
+                  f"{task.get('Name', '')!r}{suffix}")
             _print_object_diff(task, indent + "    ")
 
 
 def _print_object_diff(obj: dict, indent: str) -> None:
     for f in obj.get("Fields", []):
+        ann = f.get("Annotations") or []
+        suffix = f" ({', '.join(ann)})" if ann else ""
         print(f"{indent}{_DIFF_MARK[f['Type']]} {f['Name']}: "
-              f"{f['Old']!r} => {f['New']!r}")
+              f"{f['Old']!r} => {f['New']!r}{suffix}")
     for o in obj.get("Objects", []):
         print(f"{indent}{_DIFF_MARK[o['Type']]} {o.get('Name', '')}")
         _print_object_diff(o, indent + "  ")

@@ -1006,11 +1006,20 @@ class Server:
         annotations = (to_wire(plan.annotations)
                        if plan is not None and plan.annotations else None)
         final_eval = h.evals[-1] if h.evals else ev
+        the_diff = None
+        if diff:
+            # the diff carries human-readable annotations (update
+            # counts, forces-* markers — scheduler/annotate.go)
+            from ..scheduler.annotate import annotate
+            the_diff = annotate(
+                job_diff(old_job, job),
+                {"DesiredTGUpdates": annotations["desired_tg_updates"]}
+                if annotations else None)
         return {
             "annotations": annotations,
             "failed_tg_allocs": {tg: to_wire(m) for tg, m in
                                  (final_eval.failed_tg_allocs or {}).items()},
-            "diff": job_diff(old_job, job) if diff else None,
+            "diff": the_diff,
             "job_modify_index": old_job.job_modify_index if old_job else 0,
             "next_version": (old_job.version + 1
                              if old_job is not None
