@@ -146,6 +146,119 @@ def _group_connect_mutate(job: Job, tg: TaskGroup, driver: str,
                 task.canonicalize(job, tg)
 
 
+def expose_check_mutate(job: Job) -> None:
+    """jobExposeCheckHook.Mutate (job_endpoint_hook_expose_check.go:22):
+    group-service checks with expose=true get an expose path on the
+    sidecar proxy, generating a dynamic listener port when the check
+    has no port label of its own."""
+    from ..models.services import ConsulExposeConfig, ConsulExposePath
+    import hashlib
+    for tg in job.task_groups:
+        for s in tg.services:
+            for check in s.checks:
+                # checkIsExposable: http/grpc with a rooted path only
+                if not check.expose or \
+                        check.type.lower() not in ("http", "grpc") or \
+                        not check.path.startswith("/"):
+                    continue
+                # only the BUILT-IN proxy serves expose paths; guard
+                # BEFORE any mutation or a sidecar-less service would
+                # be left with an orphan port + rewritten check label
+                if s.connect is None or \
+                        s.connect.sidecar_service is None:
+                    continue        # validate() rejects this shape
+                if len(tg.networks) != 1 or \
+                        tg.networks[0].mode != "bridge":
+                    raise ValueError(
+                        f"group {tg.name!r} must use bridge network "
+                        "for exposing service check(s)")
+                if not check.port_label:
+                    # DETERMINISTIC label: a random suffix would make
+                    # every re-register of an unchanged job look like
+                    # a destructive network change
+                    digest = hashlib.sha256(
+                        f"{s.name}\x00{check.name}".encode()
+                    ).hexdigest()[:6]
+                    label = f"svc_{s.name}_ck_{digest}"
+                    if not any(p.label == label
+                               for p in tg.networks[0].dynamic_ports):
+                        tg.networks[0].dynamic_ports.append(
+                            Port(label=label, to=-1))
+                    check.port_label = label
+                # local service port — what the service binds INSIDE
+                # the netns (structs Networks.Port: reserved ports use
+                # their value, dynamic ports their `to` mapping), else
+                # a literal port number
+                port = 0
+                for nw in tg.networks:
+                    for p in nw.reserved_ports:
+                        if p.label == s.port_label:
+                            port = p.value
+                    for p in nw.dynamic_ports:
+                        if p.label == s.port_label:
+                            port = p.to
+                    if port > 0:
+                        break
+                if port <= 0:
+                    try:
+                        port = int(s.port_label)
+                    except ValueError:
+                        port = 0
+                    if port <= 0:
+                        raise ValueError(
+                            f"unable to determine local service port "
+                            f"for service check {tg.name}->{s.name}->"
+                            f"{check.name}")
+                ss = s.connect.sidecar_service
+                if ss.proxy is None:
+                    from ..models.services import ConsulProxy
+                    ss.proxy = ConsulProxy()
+                if ss.proxy.expose is None:
+                    ss.proxy.expose = ConsulExposeConfig()
+                new = ConsulExposePath(
+                    path=check.path, protocol=check.type.lower(),
+                    local_path_port=port,
+                    listener_port=check.port_label)
+                if new not in ss.proxy.expose.paths:
+                    ss.proxy.expose.paths.append(new)
+
+
+def expose_check_validate(job: Job) -> List[str]:
+    """jobExposeCheckHook.Validate:50 — expose only on group services
+    with the BUILT-IN connect proxy, in a single bridge network."""
+    errs: List[str] = []
+    for tg in job.task_groups:
+        uses = any(c.expose for s in tg.services for c in s.checks)
+        if uses:
+            if len(tg.networks) != 1:
+                errs.append(
+                    f"group {tg.name!r} must specify one bridge "
+                    "network for exposing service check(s)")
+            elif tg.networks[0].mode != "bridge":
+                errs.append(
+                    f"group {tg.name!r} must use bridge network for "
+                    "exposing service check(s)")
+        for s in tg.services:
+            for c in s.checks:
+                if c.expose and (
+                        s.connect is None
+                        or s.connect.sidecar_service is None
+                        or s.connect.sidecar_task is not None):
+                    errs.append(
+                        f"exposed service check {tg.name}->{s.name}->"
+                        f"{c.name} requires use of the builtin "
+                        "Connect proxy")
+        for t in tg.tasks:
+            for s in t.services:
+                for c in s.checks:
+                    if c.expose:
+                        errs.append(
+                            f"exposed service check {tg.name}[{t.name}]"
+                            f"->{s.name}->{c.name} is not a task-group "
+                            "service")
+    return errs
+
+
 def connect_validate(job: Job) -> List[str]:
     """jobConnectHook.Validate:110 -> groupConnectValidate:367."""
     errs: List[str] = []

@@ -760,13 +760,20 @@ class Server:
             raise ValueError(
                 f"job {job.id!r} is in nonexistent namespace "
                 f"{job.namespace!r}")
-        # connect hook (job_endpoint_hook_connect.go): inject sidecar /
-        # gateway proxy tasks before implied constraints and validation
-        from .connect_hook import connect_mutate, connect_validate
+        # connect + expose-check hooks (job_endpoint_hook_connect.go,
+        # job_endpoint_hook_expose_check.go): inject sidecar/gateway
+        # proxy tasks and check expose paths before implied
+        # constraints and validation
+        from .connect_hook import (connect_mutate, connect_validate,
+                                   expose_check_mutate,
+                                   expose_check_validate)
         connect_mutate(job, self.config.connect_sidecar_driver,
                        self.config.connect_sidecar_config)
+        errs = expose_check_validate(job)
+        if not errs:
+            expose_check_mutate(job)
         self._implied_constraints(job)
-        errs = connect_validate(job) + job.validate()
+        errs = errs + connect_validate(job) + job.validate()
         if errs:
             raise ValueError("; ".join(errs))
         index = self.raft_apply("job_register", dict(job=job, evals=[]))

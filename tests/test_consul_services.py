@@ -479,3 +479,100 @@ def test_check_restart_restarts_task(cluster):
                    and id(tr.handle) != originals[tr.task.name]
                    for tr in cl.runners[aid].task_runners)
     assert _wait(restarted, timeout=30), "check_restart never fired"
+
+
+# -- expose-check hook (job_endpoint_hook_expose_check.go) ------------
+def _expose_job(check_kwargs=None, sidecar=True, mode="bridge"):
+    from nomad_tpu.models.services import ConsulSidecarService
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.networks = [NetworkResource(
+        mode=mode, dynamic_ports=[Port(label="web", to=8080)])]
+    tg.services = [Service(
+        name="exposed", port_label="web",
+        connect=ConsulConnect(
+            sidecar_service=ConsulSidecarService()) if sidecar else None,
+        checks=[ServiceCheck(name="api-hc", type="http", path="/health",
+                             interval_s=10.0, timeout_s=2.0,
+                             expose=True, **(check_kwargs or {}))])]
+    for t in tg.tasks:
+        t.services = []
+    return job
+
+
+def test_expose_check_generates_path_and_port():
+    # TestJobExposeCheckHook_Mutate (expose path extrapolated; a check
+    # without its own port gets a generated dynamic listener port)
+    from nomad_tpu.server.connect_hook import (connect_mutate,
+                                               expose_check_mutate)
+    job = _expose_job()
+    connect_mutate(job, sidecar_driver="mock", sidecar_config={})
+    expose_check_mutate(job)
+    tg = job.task_groups[0]
+    svc = tg.services[0]
+    paths = svc.connect.sidecar_service.proxy.expose.paths
+    assert len(paths) == 1
+    p = paths[0]
+    assert p.path == "/health" and p.protocol == "http"
+    # generated listener port label landed on the check AND the network
+    assert svc.checks[0].port_label.startswith("svc_exposed_ck_")
+    assert any(pt.label == svc.checks[0].port_label and pt.to == -1
+               for pt in tg.networks[0].dynamic_ports)
+    # DETERMINISTIC: a second build of the same spec generates the
+    # same label, so re-registering an unchanged job is not a
+    # destructive network change
+    job2 = _expose_job()
+    connect_mutate(job2, sidecar_driver="mock", sidecar_config={})
+    expose_check_mutate(job2)
+    assert job2.task_groups[0].services[0].checks[0].port_label == \
+        svc.checks[0].port_label
+    # idempotent on re-registration (containsExposePath)
+    expose_check_mutate(job)
+    assert len(svc.connect.sidecar_service.proxy.expose.paths) == 1
+    assert len([pt for pt in tg.networks[0].dynamic_ports
+                if pt.label == svc.checks[0].port_label]) == 1
+
+
+def test_expose_check_skips_unexposable_and_sidecarless():
+    # checkIsExposable: no rooted path -> skipped entirely; no
+    # sidecar -> no half-mutation (no orphan port, label untouched)
+    from nomad_tpu.server.connect_hook import expose_check_mutate
+    job = _expose_job()
+    job.task_groups[0].services[0].checks[0].path = ""
+    expose_check_mutate(job)
+    assert not job.task_groups[0].services[0].checks[0].port_label
+    assert all(p.label == "web"
+               for p in job.task_groups[0].networks[0].dynamic_ports)
+
+    job2 = _expose_job(sidecar=False)
+    n_ports = len(job2.task_groups[0].networks[0].dynamic_ports)
+    expose_check_mutate(job2)
+    assert not job2.task_groups[0].services[0].checks[0].port_label
+    assert len(job2.task_groups[0].networks[0].dynamic_ports) == n_ports
+
+
+def test_expose_check_requires_builtin_proxy():
+    # tgValidateUseOfCheckExpose: expose without connect is rejected
+    from nomad_tpu.server.connect_hook import expose_check_validate
+    errs = expose_check_validate(_expose_job(sidecar=False))
+    assert any("builtin Connect proxy" in e for e in errs)
+
+
+def test_expose_check_requires_bridge():
+    # tgValidateUseOfBridgeMode
+    from nomad_tpu.server.connect_hook import expose_check_validate
+    errs = expose_check_validate(_expose_job(mode="host"))
+    assert any("bridge network" in e for e in errs)
+
+
+def test_expose_check_rejected_on_task_services():
+    from nomad_tpu.server.connect_hook import expose_check_validate
+    job = _expose_job()
+    tg = job.task_groups[0]
+    tg.tasks[0].services = [Service(
+        name="tsvc", port_label="web",
+        checks=[ServiceCheck(name="t-hc", type="http", path="/x",
+                             interval_s=10.0, timeout_s=2.0,
+                             expose=True)])]
+    errs = expose_check_validate(job)
+    assert any("not a task-group service" in e for e in errs)
