@@ -1,0 +1,5 @@
+job "gc-job-1" {
+  datacenters = ["dc1"]
+  type = "batch"
+  group "g" { task "t" { driver = "mock_driver" config { run_for = "120s" } } }
+}

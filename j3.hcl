@@ -1,0 +1,5 @@
+job "gc-job-3" {
+  datacenters = ["dc1"]
+  type = "batch"
+  group "g" { task "t" { driver = "mock_driver" config { run_for = "120s" } } }
+}

@@ -483,13 +483,20 @@ def bench_c2m_scale(n_nodes: int = 50000, seed_allocs: int = 2_000_000,
         h.store.upsert_job(h.next_index(), warm)
         h.process("service", _eval_for(warm))
 
+    # the SAME GC-safepoint protocol the production worker runs
+    # (utils/gcsafe.py via ServerConfig.gc_safepoints, on in the CLI
+    # agent): collector pauses happen between evals, so the timed
+    # window measures the latency an eval experiences in an agent
+    from ..utils import gcsafe
     times: List[float] = []
-    for i in range(n_service):
-        svc = make_svc(i)
-        h.store.upsert_job(h.next_index(), svc)
-        t0 = time.perf_counter()
-        h.process("service", _eval_for(svc))
-        times.append(time.perf_counter() - t0)
+    with gcsafe.safepoints():
+        for i in range(n_service):
+            svc = make_svc(i)
+            h.store.upsert_job(h.next_index(), svc)
+            t0 = time.perf_counter()
+            h.process("service", _eval_for(svc))
+            times.append(time.perf_counter() - t0)
+            gcsafe.safepoint()
     arr = np.array(times)
     return {
         "c2m_nodes": n_nodes,

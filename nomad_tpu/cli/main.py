@@ -96,6 +96,7 @@ def cmd_agent(args) -> int:
             return 1
         server = Server(ServerConfig(num_schedulers=args.num_schedulers,
                                      acl_enabled=args.acl_enabled,
+                                     gc_safepoints=True,
                                      region=getattr(args, "region", "")
                                      or "global",
                                      region_peers=region_peers,

@@ -77,6 +77,11 @@ class ServerConfig:
     # hardcodes docker+envoy, job_endpoint_hook_connect.go:23)
     connect_sidecar_driver: str = "docker"
     connect_sidecar_config: Optional[dict] = None
+    # GC safepoints (server/worker.py): disable automatic CPython
+    # collection and collect young gens between evals, keeping
+    # collector pauses out of scheduling latency. Process-wide side
+    # effect, so off by default; the CLI agent turns it on.
+    gc_safepoints: bool = False
     heartbeat_ttl_s: float = 10.0
     failed_eval_unblock_delay_s: float = 60.0
     dev_mode: bool = True

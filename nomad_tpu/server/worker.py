@@ -22,6 +22,8 @@ from __future__ import annotations
 import logging
 import threading
 import time
+
+from ..utils import gcsafe
 from typing import List, Optional
 
 from ..models import Evaluation, JOB_TYPE_CORE, Plan, PlanResult
@@ -235,6 +237,21 @@ class Worker:
             self._paused.clear()
 
     def run(self) -> None:
+        # GC safepoints (utils/gcsafe.py): automatic collections on a
+        # C2M-sized heap land mid-eval and cost 30-60 ms of scheduling
+        # latency; when enabled, collection happens between evals
+        # instead — coordinated across workers, restored on exit
+        use_safepoints = getattr(self.server.config,
+                                 "gc_safepoints", False)
+        if use_safepoints:
+            gcsafe.enter()
+        try:
+            self._run_loop(use_safepoints)
+        finally:
+            if use_safepoints:
+                gcsafe.exit_()
+
+    def _run_loop(self, use_safepoints: bool) -> None:
         while not self._stop.is_set():
             if self._paused.is_set():
                 time.sleep(0.05)
@@ -265,6 +282,8 @@ class Worker:
                 self.process_eval(ev, token)
             else:
                 self.process_eval_batch(batch)
+            if use_safepoints:
+                gcsafe.safepoint()
 
     # -- single eval ---------------------------------------------------
     def process_eval(self, ev: Evaluation, token: str,

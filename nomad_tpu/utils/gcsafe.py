@@ -1,0 +1,86 @@
+"""GC safepoints: keep CPython collector pauses out of eval latency.
+
+With a multi-million-object resident state (C2M: 2M allocs), automatic
+collections land mid-eval and put 30-60 ms pauses into scheduling
+latency. This controller moves them to explicit safe points (between
+evals in the worker loop): automatic collection is disabled while any
+participant is registered, and participants call `safepoint()` after
+each unit of work — a young-generation collect that is process-level
+coordinated (one collector at a time, rate-limited) so N workers don't
+run N collections per eval. A collect still holds the GIL while
+sibling threads run — inherent to CPython — but rare, rate-limited
+collections of the young generations are tens of microseconds against
+the tens of milliseconds the automatic collector costs when it decides
+to walk a C2M-sized heap mid-eval.
+
+Used by server/worker.py (ServerConfig.gc_safepoints, on in the CLI
+agent) and mirrored by the C2M benchmark so it measures the regime the
+agent actually runs.
+"""
+
+from __future__ import annotations
+
+import gc
+import threading
+import time
+
+_lock = threading.Lock()
+_participants = 0
+_was_enabled = True
+_last_collect = 0.0
+
+# floor between coordinated young-gen collects; more frequent adds no
+# latency benefit and multiplies GIL stalls across workers
+MIN_COLLECT_INTERVAL_S = 0.05
+
+
+def enter() -> None:
+    """Register a participant; disables automatic collection on the
+    first one (remembering whether it was enabled)."""
+    global _participants, _was_enabled
+    with _lock:
+        _participants += 1
+        if _participants == 1:
+            _was_enabled = gc.isenabled()
+            gc.disable()
+
+
+def exit_() -> None:
+    """Deregister; the last one out restores the collector state."""
+    global _participants
+    with _lock:
+        if _participants > 0:
+            _participants -= 1
+            if _participants == 0 and _was_enabled:
+                gc.enable()
+
+
+def safepoint() -> None:
+    """Young-generation collect at a safe point — at most one
+    collector at a time, rate-limited process-wide. Callers that lose
+    the race simply skip (a sibling just collected)."""
+    global _last_collect
+    now = time.monotonic()
+    if now - _last_collect < MIN_COLLECT_INTERVAL_S:
+        return
+    if not _lock.acquire(blocking=False):
+        return
+    try:
+        if now - _last_collect < MIN_COLLECT_INTERVAL_S:
+            return
+        _last_collect = now
+        gc.collect(1)
+    finally:
+        _lock.release()
+
+
+class safepoints:
+    """Context manager: `with gcsafe.safepoints(): ... gcsafe.safepoint()`"""
+
+    def __enter__(self):
+        enter()
+        return self
+
+    def __exit__(self, *exc):
+        exit_()
+        return False

@@ -181,3 +181,35 @@ def test_batched_and_sequential_processing_place_identically(monkeypatch):
             s.shutdown()
 
     assert run(batch_size=6) == run(batch_size=1)
+
+
+def test_gc_safepoints_worker_still_schedules():
+    """ServerConfig.gc_safepoints moves CPython collections to the
+    worker's between-eval safe point (server/worker.py); scheduling
+    still works and gc is re-enabled for the rest of the process."""
+    import gc
+    import time as _time
+    from nomad_tpu import mock
+    from nomad_tpu.server import Server, ServerConfig
+
+    assert gc.isenabled()
+    srv = Server(ServerConfig(num_schedulers=1, gc_safepoints=True))
+    srv.start()
+    try:
+        srv.register_node(mock.node())
+        job = mock.batch_job()
+        job.task_groups[0].count = 2
+        srv.register_job(job)
+        deadline = _time.time() + 20
+        while _time.time() < deadline:
+            if len(srv.store.allocs_by_job("default", job.id)) == 2:
+                break
+            _time.sleep(0.05)
+        assert len(srv.store.allocs_by_job("default", job.id)) == 2
+        # workers restore collector state on shutdown (gcsafe refcount)
+    finally:
+        srv.shutdown()
+    deadline = _time.time() + 5
+    while _time.time() < deadline and not gc.isenabled():
+        _time.sleep(0.05)
+    assert gc.isenabled()
