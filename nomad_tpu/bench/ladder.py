@@ -108,15 +108,21 @@ def bench_service_p99(n_nodes: int = 10000, n_evals: int = 50,
     h.store.upsert_job(h.next_index(), wjob)
     h.process("service", _eval_for(wjob))
 
+    # the production worker's GC regime (utils/gcsafe.py; on in the
+    # CLI agent): collector pauses land between evals, not inside the
+    # timed Process() calls
+    from ..utils import gcsafe
     times: List[float] = []
     placed = 0
     t_all = time.perf_counter()
-    for i in range(n_evals):
-        job = make_job(i)
-        h.store.upsert_job(h.next_index(), job)
-        t0 = time.perf_counter()
-        h.process("service", _eval_for(job))
-        times.append(time.perf_counter() - t0)
+    with gcsafe.safepoints():
+        for i in range(n_evals):
+            job = make_job(i)
+            h.store.upsert_job(h.next_index(), job)
+            t0 = time.perf_counter()
+            h.process("service", _eval_for(job))
+            times.append(time.perf_counter() - t0)
+            gcsafe.safepoint()
     wall = time.perf_counter() - t_all
     for plan in h.plans[1:]:  # skip warm-up plan
         placed += sum(len(a) for a in plan.node_allocation.values())
@@ -306,15 +312,19 @@ def bench_preemption(n_nodes: int = 1000, n_evals: int = 10,
     h.process("service", _eval_for(warm))
     n_warm_plans = len(h.plans)
 
+    # same GC regime as the agent's workers (utils/gcsafe.py)
+    from ..utils import gcsafe
     times: List[float] = []
     placed = 0
     t_all = time.perf_counter()
-    for i in range(n_evals):
-        hi = make_hi(i)
-        h.store.upsert_job(h.next_index(), hi)
-        t0 = time.perf_counter()
-        h.process("service", _eval_for(hi))
-        times.append(time.perf_counter() - t0)
+    with gcsafe.safepoints():
+        for i in range(n_evals):
+            hi = make_hi(i)
+            h.store.upsert_job(h.next_index(), hi)
+            t0 = time.perf_counter()
+            h.process("service", _eval_for(hi))
+            times.append(time.perf_counter() - t0)
+            gcsafe.safepoint()
     wall = time.perf_counter() - t_all
     preempted = 0
     for plan in h.plans[n_warm_plans:]:
