@@ -39,9 +39,31 @@ C_MAX = 64      # max distinct attribute values per spread/property axis
 NEG_INF = -1e30
 TOP_K = 5       # ScoreMetaData entries kept (reference kheap topK)
 CHUNK_J = 256   # max instances placed on one node per chunked step
-KWAY_W = 32     # winners placed per phase in the k-way chunked kernel
+KWAY_W = 32     # winners per phase at small tables (floor for _kway_w)
 KWAY_STEPS = 256  # phases per dispatch: ~56 cover a 10k batch, and the
                   # out buffers ride the tunnel — small beats roomy
+
+
+def _kway_steps(w: int) -> int:
+    """Phase budget per dispatch. Wide phases need fewer steps for the
+    same count, and the out buffers ([steps, 2w+...] ints) ride the
+    tunnel on every dispatch — half the rows at w>=128 halves the
+    transfer; overflow continues from the device-resident carry."""
+    return KWAY_STEPS if w <= KWAY_W else 128
+
+
+def _kway_w(n_pad: int) -> int:
+    """Winners per K-way phase, scaled with the table. On a
+    near-homogeneous table the waterline rule yields chunk≈1 per
+    winner, so a batch takes ~count/W sequential phases; at 65536 rows
+    top_k(N, 257) costs barely more than top_k(N, 33) while cutting
+    phases 8x (round-5 profile: 10k placements @50k nodes spent 0.6 s
+    in ~320 phases at W=32)."""
+    if n_pad <= 4096:
+        return 32
+    return 128      # sweep @65536 rows: W 64-256 all ~0.21 s for a 10k
+                    # batch (steps scale down, per-phase cost up); 512+
+                    # regress on the [W, CHUNK_J] stream block
 
 
 def _pad_n(n: int) -> int:
@@ -559,7 +581,7 @@ def _kway_core(capacity, used0, feasible, ask, k_valid,
                tg_coll0, penalty, affinity_norm, desired_count,
                port_need, free_ports, port_ok,
                dev_slots0, dev_score, dev_fires, pre_score,
-               *, max_steps: int, spread_alg: bool):
+               *, max_steps: int, spread_alg: bool, w: int):
     """K-way chunked greedy placement for node-local scoring: each phase
     takes the top-W nodes and gives EACH the number of sub-placements
     that keep its own score above the (W+1)-th node's score (the
@@ -599,11 +621,11 @@ def _kway_core(capacity, used0, feasible, ask, k_valid,
         ok = feas & fit
         masked = jnp.where(ok, final, NEG_INF)
 
-        tv, ti = jax.lax.top_k(masked, KWAY_W + 1)
-        wl_val = tv[KWAY_W]
-        wl_idx = ti[KWAY_W]
-        widx = ti[:KWAY_W]
-        wvalid = tv[:KWAY_W] > NEG_INF / 2
+        tv, ti = jax.lax.top_k(masked, w + 1)
+        wl_val = tv[w]
+        wl_idx = ti[w]
+        widx = ti[:w]
+        wvalid = tv[:w] > NEG_INF / 2
         valid = wvalid[0]
 
         # diagnostics on the first and failing phases only
@@ -697,8 +719,8 @@ def _kway_core(capacity, used0, feasible, ask, k_valid,
     d = capacity.shape[1]
     state0 = (used0, tg_coll0, free_ports, dev_slots0, k_valid,
               jnp.int32(0), jnp.bool_(True),
-              jnp.full((max_steps, KWAY_W), -1, jnp.int32),
-              jnp.zeros((max_steps, KWAY_W), jnp.int32),
+              jnp.full((max_steps, w), -1, jnp.int32),
+              jnp.zeros((max_steps, w), jnp.int32),
               jnp.full((max_steps, TOP_K), -1, jnp.int32),
               jnp.full((max_steps, TOP_K), NEG_INF, jnp.float32),
               jnp.zeros((max_steps, d), jnp.int32),
@@ -716,7 +738,8 @@ def _kway_core(capacity, used0, feasible, ask, k_valid,
 
 
 _select_kway = partial(jax.jit, static_argnames=("max_steps",
-                                                 "spread_alg"))(_kway_core)
+                                                 "spread_alg",
+                                                 "w"))(_kway_core)
 
 # Multi-eval batching (SURVEY §2.6 row 1: "batch multiple evals per
 # device dispatch"): B independent placement problems over ONE shared
@@ -726,13 +749,14 @@ _select_kway = partial(jax.jit, static_argnames=("max_steps",
 _KWAY_BATCH_AXES = (None,) + (0,) * 15
 
 
-@partial(jax.jit, static_argnames=("max_steps", "spread_alg"))
+@partial(jax.jit, static_argnames=("max_steps", "spread_alg", "w"))
 def _select_kway_batched(capacity, used0, feasible, ask, k_valid,
                          tg_coll0, penalty, affinity_norm, desired_count,
                          port_need, free_ports, port_ok,
                          dev_slots0, dev_score, dev_fires, pre_score,
-                         *, max_steps: int, spread_alg: bool):
-    fn = partial(_kway_core, max_steps=max_steps, spread_alg=spread_alg)
+                         *, max_steps: int, spread_alg: bool, w: int):
+    fn = partial(_kway_core, max_steps=max_steps, spread_alg=spread_alg,
+                 w=w)
     return jax.vmap(fn, in_axes=_KWAY_BATCH_AXES)(
         capacity, used0, feasible, ask, k_valid,
         tg_coll0, penalty, affinity_norm, desired_count,
@@ -1273,10 +1297,13 @@ class SelectKernel:
                     {k: args[k] for k in _CHUNKED_ARGS},
                     capacity_src=req.capacity)
                 spread_alg = req.algorithm == "spread"
+                w = _kway_w(n_pad_sh)
                 with sharded.mesh:
-                    pending = _select_kway(**cargs, max_steps=KWAY_STEPS,
-                                           spread_alg=spread_alg)
-                return self._finish_kway(req, cargs, spread_alg, pending)
+                    pending = _select_kway(**cargs,
+                                           max_steps=_kway_steps(w),
+                                           spread_alg=spread_alg, w=w)
+                return self._finish_kway(req, cargs, spread_alg, pending,
+                                         w=w)
             return sharded.select(req)
         n = len(req.feasible)
         n_pad = _pad_n(n)
@@ -1301,24 +1328,26 @@ class SelectKernel:
     # -- k-way chunked path --------------------------------------------
     def _dispatch_kway(self, req: SelectRequest, n_pad: int, dev):
         """Issue the first K-way dispatch without waiting; returns the
-        (cargs, spread_alg, pending) state for _finish_kway."""
+        (cargs, spread_alg, pending, w) state for _finish_kway."""
         args, _statics = pack_request(req, n_pad)
         cargs = {k: args[k] for k in _CHUNKED_ARGS}
         cargs = self._place_args(cargs, dev)
         spread_alg = req.algorithm == "spread"
-        pending = _select_kway(**cargs, max_steps=KWAY_STEPS,
-                               spread_alg=spread_alg)
-        return cargs, spread_alg, pending
+        w = _kway_w(n_pad)
+        pending = _select_kway(**cargs, max_steps=_kway_steps(w),
+                               spread_alg=spread_alg, w=w)
+        return cargs, spread_alg, pending, w
 
     def _finish_kway(self, req: SelectRequest, cargs, spread_alg,
-                     pending) -> SelectResult:
+                     pending, w: int) -> SelectResult:
         return _expand_kway(req, self._finish_kway_rounds(
-            req, cargs, spread_alg, pending))
+            req, cargs, spread_alg, pending, w=w))
 
     def _run_kway(self, req: SelectRequest, n_pad: int,
                   dev) -> SelectResult:
-        cargs, spread_alg, pending = self._dispatch_kway(req, n_pad, dev)
-        return self._finish_kway(req, cargs, spread_alg, pending)
+        cargs, spread_alg, pending, w = self._dispatch_kway(req, n_pad,
+                                                            dev)
+        return self._finish_kway(req, cargs, spread_alg, pending, w=w)
 
     def select_many(self, reqs: List[SelectRequest]) -> List[SelectResult]:
         """Place B independent requests over the SAME node table in one
@@ -1383,12 +1412,13 @@ class SelectKernel:
         cargs, mesh_ctx = self._place_batched(
             cargs, sharded, reqs[0].capacity, n_pad,
             sum(min(r.count, 2 * n) for r in reqs))
+        w = _kway_w(n_pad)
         with mesh_ctx:
             carry, outs = _select_kway_batched(**cargs,
-                                               max_steps=KWAY_STEPS,
-                                               spread_alg=spread_alg)
+                                               max_steps=_kway_steps(w),
+                                               spread_alg=spread_alg,
+                                               w=w)
         packed_i, ts = jax.device_get(outs)
-        w = KWAY_W
         d = reqs[0].capacity.shape[1]
         results = []
         for i, req in enumerate(reqs):
@@ -1417,10 +1447,11 @@ class SelectKernel:
                     free_ports=np.asarray(jax.device_get(carry[2][i])),
                     dev_slots0=np.asarray(jax.device_get(carry[3][i])),
                     k_valid=np.int32(rem))
-                pending = _select_kway(**lane, max_steps=KWAY_STEPS,
-                                       spread_alg=spread_alg)
+                pending = _select_kway(**lane,
+                                       max_steps=_kway_steps(w),
+                                       spread_alg=spread_alg, w=w)
                 cont = self._finish_kway_rounds(req, lane, spread_alg,
-                                                pending)
+                                                pending, w=w)
                 rounds.extend(cont)
             results.append(_expand_kway(req, rounds))
         return results
@@ -1572,10 +1603,10 @@ class SelectKernel:
         return [unpack_result(r, tuple(a[i] for a in outs_np))
                 for i, r in enumerate(reqs)]
 
-    def _finish_kway_rounds(self, req, cargs, spread_alg, pending):
+    def _finish_kway_rounds(self, req, cargs, spread_alg, pending,
+                            w: int):
         """Continuation rounds only (no expansion) — shared by the
         batched path's per-lane overflow handling."""
-        w = KWAY_W
         d = req.capacity.shape[1]
         rounds = []
         while True:
@@ -1596,8 +1627,8 @@ class SelectKernel:
                 break
             cargs.update(used0=used, tg_coll0=coll, free_ports=freep,
                          dev_slots0=devs, k_valid=np.int32(rem))
-            pending = _select_kway(**cargs, max_steps=KWAY_STEPS,
-                                   spread_alg=spread_alg)
+            pending = _select_kway(**cargs, max_steps=_kway_steps(w),
+                                   spread_alg=spread_alg, w=w)
         return rounds
 
     # -- chunked path --------------------------------------------------

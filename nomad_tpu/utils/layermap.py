@@ -1,0 +1,199 @@
+"""A persistent map built from layered CPython dicts.
+
+Same contract as utils/hamt.Hamt (the MVCC substrate contract the state
+store needs: immutable values, O(1) snapshots, transient edit sessions),
+but tuned for how CPython actually performs: plain dicts are C-speed for
+get/set/iterate, so a copy-on-write *overlay* over an immutable base
+dict beats a pure-Python trie by 1-3 orders of magnitude on the store's
+real workloads (10k-alloc plan applies, 2M-row table scans — see
+round-5 profile: Hamt.update of 10k pairs into a 2M-row trie costs
+~150 ms and a full build ~13 s; the dict equivalents are ~0.1 ms and
+~5 s).
+
+Layout: `_base` (immutable-by-convention dict, structurally shared
+between versions) + `_tip` (small overlay dict; deletions are
+tombstones). Reads check tip then base. Writes produce a new LayerMap
+sharing `_base`; inside one EditContext transaction the tip is mutated
+in place (the transient trick — the tip is only reachable from the
+unpublished root). When the tip outgrows `max(1024, len(base)/8)` it is
+folded into a fresh base dict — O(n) amortized over at least n/8
+writes.
+
+Concurrency: published maps are frozen (no ctx), so tips of shared
+instances are never mutated; `_materialize()` may swap `_base`/`_tip`
+on a shared instance, but only to an equivalent mapping (merged base +
+empty tip), which concurrent readers tolerate: they hold local refs to
+the old dicts or see new-base+old-tip, whose overlay entries equal the
+merged values.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional, Tuple
+
+from .hamt import EditContext  # shared transaction-context type
+
+_TOMB = object()     # deletion marker in the tip overlay
+_SENTINEL = object()
+
+
+class LayerMap:
+    """Immutable hash map with the Hamt API. set/delete/update return
+    new maps sharing structure; `with_ctx(ctx)` enables transient
+    in-place tip writes for the duration of one store transaction."""
+
+    __slots__ = ("_base", "_tip", "_size", "_ctx", "_own")
+
+    def __init__(self, _base: Optional[dict] = None,
+                 _tip: Optional[dict] = None, _size: int = 0,
+                 _ctx: Optional[EditContext] = None,
+                 _own: Optional[EditContext] = None):
+        self._base = _base if _base is not None else {}
+        self._tip = _tip if _tip is not None else {}
+        self._size = _size
+        self._ctx = _ctx
+        self._own = _own        # ctx that may mutate _tip in place
+
+    def with_ctx(self, ctx: Optional[EditContext]) -> "LayerMap":
+        if ctx is self._ctx:
+            return self
+        # never inherit tip ownership: the tip may be shared
+        return LayerMap(self._base, self._tip, self._size, ctx, None)
+
+    def frozen(self) -> "LayerMap":
+        if self._ctx is None and self._own is None:
+            return self
+        return LayerMap(self._base, self._tip, self._size, None, None)
+
+    # -- reads ---------------------------------------------------------
+    def __len__(self) -> int:
+        return self._size
+
+    def __contains__(self, key) -> bool:
+        return self.get(key, _SENTINEL) is not _SENTINEL
+
+    def __getitem__(self, key):
+        v = self.get(key, _SENTINEL)
+        if v is _SENTINEL:
+            raise KeyError(key)
+        return v
+
+    def get(self, key, default=None):
+        v = self._tip.get(key, _SENTINEL)
+        if v is not _SENTINEL:
+            return default if v is _TOMB else v
+        return self._base.get(key, default)
+
+    def _materialize(self) -> dict:
+        """The effective mapping as ONE dict; folds the tip into a fresh
+        base and caches it on this instance (safe: the merged mapping is
+        equivalent, and tips of shared instances are never mutated)."""
+        tip = self._tip
+        if not tip:
+            return self._base
+        merged = dict(self._base)
+        for k, v in tip.items():
+            if v is _TOMB:
+                merged.pop(k, None)
+            else:
+                merged[k] = v
+        # swap order matters for racing readers: new base + old tip is
+        # an equivalent mapping; old base + empty tip would not be
+        self._base = merged
+        self._tip = {}
+        self._own = None
+        return merged
+
+    def items(self) -> Iterator[Tuple[Any, Any]]:
+        return iter(self._materialize().items())
+
+    def keys(self) -> Iterator[Any]:
+        return iter(self._materialize().keys())
+
+    def values(self) -> Iterator[Any]:
+        return iter(self._materialize().values())
+
+    def __iter__(self) -> Iterator[Any]:
+        return self.keys()
+
+    # -- writes --------------------------------------------------------
+    def set(self, key, value) -> "LayerMap":
+        ctx = self._ctx
+        existed = self.get(key, _SENTINEL) is not _SENTINEL
+        size = self._size + (0 if existed else 1)
+        if ctx is not None and self._own is ctx:
+            tip = self._tip
+            if len(tip) > 1024 and len(tip) > (len(self._base) >> 3):
+                self._materialize()
+                tip = self._tip = {}
+                self._own = ctx
+            tip[key] = value
+            self._size = size
+            return self
+        tip = dict(self._tip)
+        tip[key] = value
+        out = LayerMap(self._base, tip, size, ctx, ctx)
+        if len(tip) > 1024 and len(tip) > (len(out._base) >> 3):
+            out._materialize()
+            out._own = ctx
+        return out
+
+    def delete(self, key) -> "LayerMap":
+        if self.get(key, _SENTINEL) is _SENTINEL:
+            return self
+        ctx = self._ctx
+        size = self._size - 1
+        in_base = key in self._base
+        if ctx is not None and self._own is ctx:
+            if in_base:
+                self._tip[key] = _TOMB
+            else:
+                self._tip.pop(key, None)
+            self._size = size
+            return self
+        tip = dict(self._tip)
+        if in_base:
+            tip[key] = _TOMB
+        else:
+            tip.pop(key, None)
+        return LayerMap(self._base, tip, size, ctx, ctx)
+
+    def update(self, pairs) -> "LayerMap":
+        items = pairs.items() if isinstance(pairs, dict) else pairs
+        ctx = self._ctx
+        if self._size == 0 and not self._tip:
+            base = dict(items)
+            return LayerMap(base, None, len(base), ctx, None)
+        if ctx is not None and self._own is ctx:
+            tip = self._tip
+            size = self._size
+            get = self.get
+            for k, v in items:
+                if get(k, _SENTINEL) is _SENTINEL:
+                    size += 1
+                tip[k] = v
+            self._size = size
+            if len(tip) > 1024 and len(tip) > (len(self._base) >> 3):
+                self._materialize()
+                self._own = ctx
+            return self
+        tip = dict(self._tip)
+        size = self._size
+        base_get = self._base.get
+        tip_get = tip.get
+        for k, v in items:
+            # check the accumulating tip (covers the old tip AND keys
+            # already inserted by this batch, so duplicate keys in
+            # `pairs` don't double-count)
+            prior = tip_get(k, _SENTINEL)
+            if prior is _SENTINEL:
+                if base_get(k, _SENTINEL) is _SENTINEL:
+                    size += 1
+            elif prior is _TOMB:
+                size += 1
+            tip[k] = v
+        out = LayerMap(self._base, tip, size, ctx, ctx)
+        if len(tip) > 1024 and len(tip) > (len(out._base) >> 3):
+            out._materialize()
+            out._own = ctx
+        return out

@@ -130,6 +130,22 @@ def test_kway_matches_scan_identical_nodes_ties():
     assert kway.placed == scan.placed == count
 
 
+def test_kway_adaptive_w_matches_scan_large_table():
+    """Tables past 4096 padded rows route to a wider K-way phase
+    (_kway_w) — the waterline/exactness argument is W-agnostic, and
+    this pins it at the wide-W shape the C2M path uses."""
+    rng = np.random.RandomState(7)
+    n = 5000                      # n_pad 8192 -> w=128
+    count = 700
+    req1 = _random_request(rng, n, count, "binpack")
+    assert sel._kway_w(sel._pad_n(n)) > sel.KWAY_W
+    req2 = sel.SelectRequest(**{f.name: getattr(req1, f.name)
+                                for f in req1.__dataclass_fields__.values()})
+    kway = sel.SelectKernel().select(req1)
+    scan = _scan_reference(req2)
+    _assert_equivalent(kway, scan)
+
+
 @pytest.mark.parametrize("seed", range(3))
 def test_select_many_matches_individual(seed):
     """Multi-eval batching: one vmapped dispatch over B requests must
