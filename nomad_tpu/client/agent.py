@@ -109,7 +109,8 @@ class TaskRunner:
 
     def __init__(self, alloc: Allocation, task, driver, on_update,
                  attached: Optional[TaskHandle] = None,
-                 node=None, alloc_dir=None, derive_vault=None):
+                 node=None, alloc_dir=None, derive_vault=None,
+                 vault=None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -117,6 +118,11 @@ class TaskRunner:
         self.node = node
         self.alloc_dir = alloc_dir
         self.derive_vault = derive_vault
+        # VaultTokenRenewer (client/vaultclient.py): renewal loop +
+        # re-derive-on-expiry; derive_vault stays as the bare-derive
+        # fallback for harness callers without a renewer
+        self.vault = vault
+        self._secrets_path = ""
         self.state = TaskState(state=TASK_STATE_PENDING)
         self.handle: Optional[TaskHandle] = None
         self._attached = attached
@@ -140,13 +146,29 @@ class TaskRunner:
         env = build_task_env(self.alloc, self.task, self.node,
                              alloc_dir=alloc_path, task_dir=task_path,
                              secrets_dir=secrets_path)
-        # vault hook (taskrunner/vault_hook.go): derive a token and
-        # expose it as VAULT_TOKEN when the task carries a vault stanza
-        if self.task.vault is not None and self.derive_vault is not None \
-                and self.task.vault.env:
+        # vault hook (taskrunner/vault_hook.go): derive a TTL'd token,
+        # expose it as VAULT_TOKEN / secrets/vault_token, and register
+        # it with the renewal loop (client/vaultclient.py); on renewal
+        # failure the renewer re-derives and change_mode applies
+        self._secrets_path = secrets_path
+        if self.task.vault is not None and \
+                (self.vault is not None or self.derive_vault is not None):
             try:
-                tokens = self.derive_vault(self.alloc.id, [self.task.name])
-                env["VAULT_TOKEN"] = tokens.get(self.task.name, "")
+                if self.vault is not None:
+                    lease = self.vault.derive(self.alloc.id,
+                                              self.task.name)
+                    self.vault.track(self.alloc.id, self.task.name,
+                                     lease,
+                                     on_new_token=self._on_new_vault_token)
+                else:
+                    from .vaultclient import _normalize
+                    tokens = self.derive_vault(self.alloc.id,
+                                               [self.task.name])
+                    lease = _normalize(tokens.get(self.task.name))
+                token = lease.get("token", "")
+                if self.task.vault.env:
+                    env["VAULT_TOKEN"] = token
+                self._write_vault_token(token)
             except Exception as e:
                 from .hooks import HookError
                 raise HookError(f"vault token derivation failed: {e}")
@@ -200,6 +222,42 @@ class TaskRunner:
                              "memory_mb": self.task.resources.memory_mb}}
         return config, env, ctx
 
+    def _write_vault_token(self, token: str) -> None:
+        """secrets/vault_token (vault_hook.go writeToken)."""
+        if self._secrets_path and token:
+            import os
+            try:
+                path = os.path.join(self._secrets_path, "vault_token")
+                with open(path, "w") as f:
+                    f.write(token)
+                os.chmod(path, 0o600)
+            except OSError:
+                pass
+
+    def _on_new_vault_token(self, lease: dict) -> None:
+        """Renewal-failure re-derive landed a fresh token: persist it
+        and apply the task's change_mode (vault_hook.go updatedToken)."""
+        token = lease.get("token", "")
+        self._write_vault_token(token)
+        mode = self.task.vault.change_mode if self.task.vault else "noop"
+        if mode == "signal" and self.handle is not None:
+            sig = self.task.vault.change_signal or "SIGHUP"
+            signal_fn = getattr(self.driver, "signal_task", None)
+            if signal_fn is not None:
+                try:
+                    signal_fn(self.handle, sig)
+                    return
+                except Exception:
+                    pass
+            mode = "restart"    # signal unsupported: fall back
+        if mode == "restart" and self.handle is not None:
+            self._force_restart = True
+            try:
+                self.driver.stop_task(self.handle,
+                                      self.task.kill_timeout_s)
+            except Exception:
+                pass
+
     def start(self) -> None:
         self._thread = threading.Thread(target=self.run, daemon=True,
                                         name=f"task-{self.task.name}")
@@ -234,6 +292,15 @@ class TaskRunner:
             self.driver.stop_task(self.handle, self.task.kill_timeout_s)
 
     def run(self) -> None:
+        try:
+            self._run()
+        finally:
+            # stop renewing this task's vault lease; server-side
+            # revocation rides the alloc's terminal status update
+            if self.vault is not None:
+                self.vault.untrack(self.alloc.id, self.task.name)
+
+    def _run(self) -> None:
         tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
             if self.alloc.job else None
         policy = tg.restart_policy if tg else None
@@ -323,12 +390,13 @@ class AllocRunner:
     def __init__(self, alloc: Allocation, drivers: Dict[str, object],
                  push_update, persist=None, node=None,
                  alloc_dir_base: str = "", derive_vault=None,
-                 client=None):
+                 vault=None, client=None):
         self.alloc = alloc
         self.drivers = drivers
         self.push_update = push_update
         self.persist = persist            # (alloc_id, task, state, handle)
         self.derive_vault = derive_vault
+        self.vault = vault                # VaultTokenRenewer
         self.node = node
         self.client = client              # alloc-watcher context
         self.task_runners: List[TaskRunner] = []
@@ -363,7 +431,8 @@ class AllocRunner:
             tr = TaskRunner(self.alloc, task, driver, self._on_task_update,
                             attached=(attached or {}).get(task.name),
                             node=self.node, alloc_dir=self.alloc_dir,
-                            derive_vault=self.derive_vault)
+                            derive_vault=self.derive_vault,
+                            vault=self.vault)
             self.task_runners.append(tr)
         # previous-alloc watcher (client/allocwatcher): a replacement
         # with a sticky/migrating ephemeral disk waits for its
@@ -524,6 +593,8 @@ class Client:
             self.transport = InProcTransport(server)
             self.server = server
         self.config = config or ClientConfig()
+        from .vaultclient import VaultTokenRenewer
+        self.vault_renewer = VaultTokenRenewer(self.transport)
         self.state_db = None
         if self.config.state_dir:
             from .state_db import ClientStateDB
@@ -584,6 +655,12 @@ class Client:
                 "kernel.name": "linux",
                 "arch": "x86",
                 "nomad.version": "0.1.0",
+                # the embedded token authority makes every server
+                # vault-capable, so every client fingerprints it
+                # (fingerprint/vault.go; satisfies the implied
+                # ${attr.vault.version} constraint on vault jobs)
+                "vault.version": "1.0-embedded",
+                "vault.accessible": "true",
             },
             meta=dict(self.config.meta),
             node_resources=NodeResources(
@@ -707,6 +784,7 @@ class Client:
                                  alloc_dir_base=self.config.alloc_dir,
                                  derive_vault=self.transport
                                  .derive_vault_token,
+                                 vault=self.vault_renewer,
                                  client=self)
             self.runners[aid] = runner
             runner.run(attached=attached)
@@ -724,6 +802,7 @@ class Client:
         restart-without-killing-tasks path (the reference client leaves
         tasks running and re-attaches after restart)."""
         self._stop.set()
+        self.vault_renewer.stop()
         if kill_tasks:
             # copy: the alloc-watch thread may still mutate the dict
             # until it observes _stop
@@ -821,6 +900,7 @@ class Client:
                                  alloc_dir_base=self.config.alloc_dir,
                                  derive_vault=self.transport
                                  .derive_vault_token,
+                                 vault=self.vault_renewer,
                                  client=self)
             self.runners[aid] = runner
             if self.state_db is not None:

@@ -67,6 +67,10 @@ def build_method_table(server) -> Dict[str, Any]:
         return {"tokens": server.derive_vault_token(
             args["alloc_id"], list(args.get("tasks") or []))}
 
+    def node_renew_vault_token(args):
+        return {"lease_s": server.renew_vault_token(
+            args["accessor"], args["token"])}
+
     def status_ping(_args):
         return {"status": "ok", "leader": True,
                 "index": server.store.latest_index()}
@@ -101,6 +105,7 @@ def build_method_table(server) -> Dict[str, Any]:
         "Node.UpdateAlloc": node_update_alloc,
         "Node.GetClientAllocs": node_get_client_allocs,
         "Node.DeriveVaultToken": node_derive_vault_token,
+        "Node.RenewVaultToken": node_renew_vault_token,
         "Status.Ping": status_ping,
         "Server.Join": server_join,
         "Server.Leave": server_leave,
@@ -113,6 +118,8 @@ def build_method_table(server) -> Dict[str, Any]:
 # client-facing writes that must run on the leader (rpc.go forward())
 WRITE_METHODS = frozenset({"Node.Register", "Node.UpdateStatus",
                            "Node.Heartbeat", "Node.UpdateAlloc",
+                           "Node.DeriveVaultToken",
+                           "Node.RenewVaultToken",
                            "Server.Join", "Server.Leave",
                            "Service.Update"})
 

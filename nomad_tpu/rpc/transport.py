@@ -39,6 +39,11 @@ class ServerTransport:
     def derive_vault_token(self, alloc_id: str, tasks) -> dict:
         raise NotImplementedError
 
+    def renew_vault_token(self, accessor: str, token: str) -> float:
+        """Extend a derived token's lease; returns the new lease TTL.
+        Raises if the lease is unknown or expired (re-derive then)."""
+        raise NotImplementedError
+
     def update_services(self, upserts=None, delete_alloc_ids=None,
                         delete_ids=None) -> None:
         """Sync this client's service registrations into the catalog
@@ -92,6 +97,9 @@ class InProcTransport(ServerTransport):
     def derive_vault_token(self, alloc_id: str, tasks) -> dict:
         return self.server.derive_vault_token(alloc_id, list(tasks))
 
+    def renew_vault_token(self, accessor: str, token: str) -> float:
+        return self.server.renew_vault_token(accessor, token)
+
     def update_services(self, upserts=None, delete_alloc_ids=None,
                         delete_ids=None) -> None:
         self.server.update_service_registrations(
@@ -141,6 +149,11 @@ class RemoteTransport(ServerTransport):
         return self.rpc.call("Node.DeriveVaultToken",
                              {"alloc_id": alloc_id,
                               "tasks": list(tasks)})["tokens"]
+
+    def renew_vault_token(self, accessor: str, token: str) -> float:
+        return float(self.rpc.call(
+            "Node.RenewVaultToken",
+            {"accessor": accessor, "token": token})["lease_s"])
 
     def update_services(self, upserts=None, delete_alloc_ids=None,
                         delete_ids=None) -> None:

@@ -99,6 +99,9 @@ class ServerConfig:
     # no replication contact for this long is removed from the member
     # set; 0 disables
     dead_server_cleanup_s: float = 60.0
+    # lease TTL for derived vault tokens (vault.go ttl on CreateToken);
+    # clients renew at ttl/2 via Node.RenewVaultToken
+    vault_token_ttl_s: float = 3600.0
 
 
 class Server:
@@ -686,6 +689,22 @@ class Server:
         self.store.csi_volume_release(index, p["namespace"],
                                       p["volume_id"], p["alloc_id"])
 
+    def _apply_vault_accessor_upsert(self, index: int, p: dict) -> None:
+        from ..server.vault import VaultAccessor
+        from ..utils.codec import from_wire
+        self.store.upsert_vault_accessors(
+            index, [from_wire(VaultAccessor, w) for w in p["accessors"]])
+
+    def _apply_vault_accessor_renew(self, index: int, p: dict) -> None:
+        a = self.store.vault_accessor(p["accessor"])
+        if a is not None:
+            from dataclasses import replace
+            self.store.upsert_vault_accessors(
+                index, [replace(a, expire_time=p["expire_time"])])
+
+    def _apply_vault_accessor_delete(self, index: int, p: dict) -> None:
+        self.store.delete_vault_accessors(index, list(p["accessors"]))
+
     def _apply_periodic_launch(self, index: int, p: dict) -> None:
         self.store.upsert_periodic_launch(index, p["namespace"], p["job_id"],
                                           p["launch_time"])
@@ -1266,6 +1285,15 @@ class Server:
                         type=job.type, triggered_by="alloc-failure",
                         job_id=existing.job_id, status=EVAL_STATUS_PENDING))
         self.raft_apply("alloc_client_update", dict(allocs=allocs, evals=evals))
+        # revoke vault leases of allocs the client just reported
+        # terminal (node_endpoint.go UpdateAlloc -> revokeVaultAccessors);
+        # the reaper pass also catches these within its tick
+        terminal = {a.id for a in allocs
+                    if a.client_status in ("complete", "failed", "lost")}
+        if terminal:
+            doomed = [va.accessor for va in self.store.vault_accessors()
+                      if va.alloc_id in terminal]
+            self.revoke_vault_accessors(doomed)
 
     def _node_evals(self, node_id: str) -> List[Evaluation]:
         """One eval per job with allocs on the node + each system job
@@ -1497,18 +1525,95 @@ class Server:
                                      volume_id=v.id, alloc_id=aid))
             except Exception:     # pragma: no cover — best effort
                 LOG.exception("volume watcher pass failed")
+            try:
+                self._reap_vault_accessors()
+            except Exception:     # pragma: no cover — best effort
+                LOG.exception("vault accessor reap failed")
 
-    # -- Vault integration (nomad/vault.go DeriveVaultToken) -----------
-    def derive_vault_token(self, alloc_id: str, tasks) -> Dict[str, str]:
-        """Token derivation for tasks with a vault stanza. No real
-        Vault exists in this build: tokens are locally-minted opaque
-        ids, honoring the API contract (vault.go CreateToken) so the
-        client-side plumbing (env injection, renewal hooks) is real."""
+    # -- Vault integration (nomad/vault.go:176 vaultClient) ------------
+    def derive_vault_token(self, alloc_id: str, tasks) -> Dict[str, dict]:
+        """Token derivation for tasks with a vault stanza
+        (node_endpoint.go DeriveVaultToken + vault.go CreateToken).
+        The embedded authority mints a TTL'd token + accessor per task
+        and tracks the lease in the replicated store, so revocation and
+        renewal survive leader failover (see server/vault.py). Returns
+        {task: {token, accessor, ttl_s}}."""
         alloc = self.store.alloc_by_id(alloc_id)
         if alloc is None:
             raise KeyError(f"alloc {alloc_id} not found")
+        if alloc.terminal_status():
+            raise ValueError(f"alloc {alloc_id} is terminal")
+        from ..server.vault import VaultAccessor
+        from ..utils.codec import to_wire
         from ..utils.ids import generate_uuid
-        return {t: f"s.{generate_uuid()[:24]}" for t in tasks}
+        tg = alloc.job.lookup_task_group(alloc.task_group) \
+            if alloc.job else None
+        policies: Dict[str, list] = {}
+        if tg is not None:
+            for t in tg.tasks:
+                if t.vault is not None:
+                    policies[t.name] = list(t.vault.policies)
+        now = time.time()
+        ttl = self.config.vault_token_ttl_s
+        accessors, out = [], {}
+        for task in tasks:
+            tok = f"s.{generate_uuid()[:24]}"
+            acc = generate_uuid()
+            accessors.append(VaultAccessor(
+                accessor=acc, token=tok, alloc_id=alloc_id, task=task,
+                node_id=alloc.node_id, policies=policies.get(task, []),
+                ttl_s=ttl, create_time=now, expire_time=now + ttl))
+            out[task] = {"token": tok, "accessor": acc, "ttl_s": ttl}
+        self.raft_apply("vault_accessor_upsert",
+                        dict(accessors=[to_wire(a) for a in accessors]))
+        return out
+
+    def renew_vault_token(self, accessor: str, token: str) -> float:
+        """Extend a lease (vault.go RenewToken / client-side renewal
+        loop target). Raises on unknown/revoked/expired leases — the
+        client must re-derive then."""
+        a = self.store.vault_accessor(accessor)
+        if a is None or a.token != token:
+            raise KeyError("unknown vault accessor")
+        now = time.time()
+        if a.expired(now):
+            # reap lazily; the renewal failure tells the client to
+            # re-derive (vaultclient.go renewal error path)
+            self.raft_apply("vault_accessor_delete",
+                            dict(accessors=[accessor]))
+            raise ValueError("vault token lease expired")
+        self.raft_apply("vault_accessor_renew",
+                        dict(accessor=accessor,
+                             expire_time=now + a.ttl_s))
+        return a.ttl_s
+
+    def revoke_vault_accessors(self, accessors: List[str]) -> None:
+        """vault.go RevokeTokens: the embedded backend simply drops the
+        lease rows — a dropped row IS an invalid token here."""
+        if accessors:
+            from ..utils import metrics
+            metrics.incr_counter("nomad.vault.revoked", len(accessors))
+            self.raft_apply("vault_accessor_delete",
+                            dict(accessors=list(accessors)))
+
+    def lookup_vault_token(self, token: str) -> bool:
+        """Is this token currently valid? (vault TokenLookup analog,
+        used by tests and operator introspection)."""
+        a = self.store.vault_accessor_by_token(token)
+        return a is not None and not a.expired()
+
+    def _reap_vault_accessors(self) -> None:
+        """Leader-side revocation daemon (vault.go revokeDaemon +
+        nomad/node_endpoint.go revoking accessors of terminal allocs):
+        drop leases whose alloc is gone/terminal or whose TTL lapsed
+        without renewal."""
+        now = time.time()
+        doomed = []
+        for a in self.store.vault_accessors():
+            alloc = self.store.alloc_by_id(a.alloc_id)
+            if alloc is None or alloc.terminal_status() or a.expired(now):
+                doomed.append(a.accessor)
+        self.revoke_vault_accessors(doomed)
 
     # -- heartbeats (nomad/heartbeat.go) -------------------------------
     def reset_heartbeat_timer(self, node_id: str) -> None:

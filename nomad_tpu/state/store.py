@@ -109,6 +109,7 @@ TABLES = (
     "nodes", "jobs", "job_versions", "evals", "allocs", "deployments",
     "job_summaries", "scheduler_config", "periodic_launches",
     "acl_policies", "acl_tokens", "csi_volumes", "service_registrations",
+    "vault_accessors",
     # secondary indexes
     "allocs_by_node", "allocs_by_job", "allocs_by_eval", "evals_by_job",
     "deployments_by_job", "services_by_name", "services_by_alloc",
@@ -380,6 +381,8 @@ class StateSnapshot:
             root.table("service_registrations").values()]
         plain["namespaces"] = [to_wire(n) for n in
                                root.table("namespaces").values()]
+        plain["vault_accessors"] = [to_wire(a) for a in
+                                    root.table("vault_accessors").values()]
         return out
 
 
@@ -1543,6 +1546,44 @@ class StateStore(StateSnapshot):
         return sorted(self._root.table("acl_tokens").values(),
                       key=lambda t: t.accessor_id)
 
+    # -- Vault accessors (state_store.go UpsertVaultAccessor:5743) -----
+    def upsert_vault_accessors(self, index: int, accessors: List) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("vault_accessors")
+            for a in accessors:
+                existing = t.get(a.accessor)
+                a.create_index = existing.create_index if existing else index
+                a.modify_index = index
+                t = t.set(a.accessor, a)
+            root = root.with_table("vault_accessors", t) \
+                       .with_index("vault_accessors", index)
+            self._publish(root)
+
+    def delete_vault_accessors(self, index: int,
+                               accessor_ids: List[str]) -> None:
+        with self._lock:
+            root = self._root.edit()
+            t = root.table("vault_accessors")
+            for aid in accessor_ids:
+                t = t.delete(aid)
+            root = root.with_table("vault_accessors", t) \
+                       .with_index("vault_accessors", index)
+            self._publish(root)
+
+    def vault_accessor(self, accessor: str):
+        return self._root.table("vault_accessors").get(accessor)
+
+    def vault_accessors(self) -> List:
+        return sorted(self._root.table("vault_accessors").values(),
+                      key=lambda a: a.accessor)
+
+    def vault_accessor_by_token(self, token: str):
+        for a in self._root.table("vault_accessors").values():
+            if a.token == token:
+                return a
+        return None
+
     # -- CSI volumes (state_store.go CSIVolume*) -----------------------
     def upsert_csi_volumes(self, index: int, volumes: List) -> None:
         with self._lock:
@@ -1778,6 +1819,13 @@ class StateStore(StateSnapshot):
                 ns = from_wire(Namespace, w)
                 t = t.set(ns.name, ns)
             root = root.with_table("namespaces", t)
+
+            from ..server.vault import VaultAccessor
+            t = root.table("vault_accessors")
+            for w in data["tables"].get("vault_accessors", []):
+                a = from_wire(VaultAccessor, w)
+                t = t.set(a.accessor, a)
+            root = root.with_table("vault_accessors", t)
 
             from ..models.services import ServiceRegistration
             t = root.table("service_registrations")

@@ -228,7 +228,8 @@ def test_vault_token_derivation_and_env():
         server.store.upsert_allocs(server.raft_apply(
             "eval_update", dict(evals=[])) or 1, [alloc])
         tokens = server.derive_vault_token(alloc.id, ["web"])
-        assert tokens["web"].startswith("s.")
+        assert tokens["web"]["token"].startswith("s.")
+        assert tokens["web"]["accessor"]
         with pytest.raises(KeyError):
             server.derive_vault_token("nope", ["web"])
     finally:
