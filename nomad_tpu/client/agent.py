@@ -110,7 +110,7 @@ class TaskRunner:
     def __init__(self, alloc: Allocation, task, driver, on_update,
                  attached: Optional[TaskHandle] = None,
                  node=None, alloc_dir=None, derive_vault=None,
-                 vault=None):
+                 vault=None, attached_vault_lease: Optional[dict] = None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -123,6 +123,12 @@ class TaskRunner:
         # fallback for harness callers without a renewer
         self.vault = vault
         self._secrets_path = ""
+        # current lease, persisted with task state so a restarted
+        # client re-registers it with the fresh renewer (the reference
+        # persists the token in the task's local state —
+        # taskrunner/vault_hook.go + state DB)
+        self.vault_lease: Optional[dict] = None
+        self._attached_vault_lease = attached_vault_lease
         self.state = TaskState(state=TASK_STATE_PENDING)
         self.handle: Optional[TaskHandle] = None
         self._attached = attached
@@ -165,6 +171,7 @@ class TaskRunner:
                     tokens = self.derive_vault(self.alloc.id,
                                                [self.task.name])
                     lease = _normalize(tokens.get(self.task.name))
+                self.vault_lease = lease
                 token = lease.get("token", "")
                 if self.task.vault.env:
                     env["VAULT_TOKEN"] = token
@@ -223,40 +230,81 @@ class TaskRunner:
         return config, env, ctx
 
     def _write_vault_token(self, token: str) -> None:
-        """secrets/vault_token (vault_hook.go writeToken)."""
+        """secrets/vault_token (vault_hook.go writeToken). Raises on
+        write failure — for a task with vault.env=false this file is
+        the only token delivery channel, so prestart must fail loudly
+        (the hook wraps it in a HookError)."""
         if self._secrets_path and token:
             import os
-            try:
-                path = os.path.join(self._secrets_path, "vault_token")
-                with open(path, "w") as f:
-                    f.write(token)
-                os.chmod(path, 0o600)
-            except OSError:
-                pass
+            path = os.path.join(self._secrets_path, "vault_token")
+            with open(path, "w") as f:
+                f.write(token)
+            os.chmod(path, 0o600)
 
     def _on_new_vault_token(self, lease: dict) -> None:
         """Renewal-failure re-derive landed a fresh token: persist it
         and apply the task's change_mode (vault_hook.go updatedToken)."""
         token = lease.get("token", "")
-        self._write_vault_token(token)
+        self.vault_lease = dict(lease)
+        try:
+            self._write_vault_token(token)
+        except OSError:
+            LOG.exception("vault token write failed for %s",
+                          self.task.name)
+        self.on_update()        # persist the fresh lease
         mode = self.task.vault.change_mode if self.task.vault else "noop"
-        if mode == "signal" and self.handle is not None:
+        # a task that already exited must not be signalled or force-
+        # restarted outside its restart policy — the new token is on
+        # disk for whatever runs next. Act on the snapshotted handle
+        # throughout: self.handle may be swapped by the run loop
+        # mid-callback.
+        h = self.handle
+        if h is None or h.done():
+            return
+        if mode == "signal":
             sig = self.task.vault.change_signal or "SIGHUP"
             signal_fn = getattr(self.driver, "signal_task", None)
             if signal_fn is not None:
                 try:
-                    signal_fn(self.handle, sig)
+                    signal_fn(h, sig)
                     return
                 except Exception:
                     pass
             mode = "restart"    # signal unsupported: fall back
-        if mode == "restart" and self.handle is not None:
+        if mode == "restart":
             self._force_restart = True
             try:
-                self.driver.stop_task(self.handle,
-                                      self.task.kill_timeout_s)
+                self.driver.stop_task(h, self.task.kill_timeout_s)
             except Exception:
                 pass
+
+    def _revault_on_attach(self) -> None:
+        """A re-attached task's lease must keep renewing: the restarted
+        client's renewer is empty, so re-register the persisted lease
+        (renewing immediately — its remaining TTL is unknown) or, if
+        none survived, derive fresh (vault_hook restore path)."""
+        if self.task.vault is None or self.vault is None:
+            return
+        if self.alloc_dir is not None and not self._secrets_path:
+            _tp, _lc, self._secrets_path = \
+                self.alloc_dir.task_paths(self.task.name)
+        lease = self._attached_vault_lease
+        self._attached_vault_lease = None
+        try:
+            if lease and lease.get("accessor"):
+                self.vault_lease = dict(lease)
+                self.vault.track(self.alloc.id, self.task.name, lease,
+                                 on_new_token=self._on_new_vault_token,
+                                 renew_now=True)
+            else:
+                lease = self.vault.derive(self.alloc.id, self.task.name)
+                self.vault_lease = dict(lease)
+                self.vault.track(self.alloc.id, self.task.name, lease,
+                                 on_new_token=self._on_new_vault_token)
+                self._write_vault_token(lease.get("token", ""))
+        except Exception:
+            LOG.exception("vault lease re-registration failed for %s",
+                          self.task.name)
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.run, daemon=True,
@@ -310,6 +358,7 @@ class TaskRunner:
                 self.handle = self._attached
                 self._attached = None
                 started_at = self.handle.started_at or time.time()
+                self._revault_on_attach()
             else:
                 try:
                     from .hooks import HookError
@@ -412,7 +461,8 @@ class AllocRunner:
             from .services_hook import AllocServices
             self.services = AllocServices(self, transport)
 
-    def run(self, attached: Optional[Dict[str, TaskHandle]] = None) -> None:
+    def run(self, attached: Optional[Dict[str, TaskHandle]] = None,
+            attached_leases: Optional[Dict[str, dict]] = None) -> None:
         """Start (or, with `attached` handles from driver recovery,
         resume) the alloc's tasks."""
         tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
@@ -432,7 +482,9 @@ class AllocRunner:
                             attached=(attached or {}).get(task.name),
                             node=self.node, alloc_dir=self.alloc_dir,
                             derive_vault=self.derive_vault,
-                            vault=self.vault)
+                            vault=self.vault,
+                            attached_vault_lease=(attached_leases or {})
+                            .get(task.name))
             self.task_runners.append(tr)
         # previous-alloc watcher (client/allocwatcher): a replacement
         # with a sticky/migrating ephemeral disk waits for its
@@ -548,7 +600,8 @@ class AllocRunner:
             for tr in self.task_runners:
                 self.persist(
                     self.alloc.id, tr.task.name, tr.state,
-                    tr.handle.recoverable_state() if tr.handle else None)
+                    tr.handle.recoverable_state() if tr.handle else None,
+                    tr.vault_lease)
         with self._l:
             states = {tr.task.name: tr.state for tr in self.task_runners}
             # aggregate client status (alloc_runner.go getClientStatus)
@@ -761,7 +814,11 @@ class Client:
                 self.state_db.delete_alloc(aid)
                 continue
             attached: Dict[str, TaskHandle] = {}
+            attached_leases: Dict[str, dict] = {}
             for task_name, tstate in (rec.get("tasks") or {}).items():
+                lease = tstate.get("vault_lease")
+                if lease:
+                    attached_leases[task_name] = lease
                 hstate = tstate.get("handle")
                 if not hstate:
                     continue
@@ -787,13 +844,14 @@ class Client:
                                  vault=self.vault_renewer,
                                  client=self)
             self.runners[aid] = runner
-            runner.run(attached=attached)
+            runner.run(attached=attached, attached_leases=attached_leases)
 
-    def _persist_task(self, alloc_id, task_name, state, handle_state):
+    def _persist_task(self, alloc_id, task_name, state, handle_state,
+                      vault_lease=None):
         if self.state_db is not None:
             try:
                 self.state_db.put_task(alloc_id, task_name, state,
-                                       handle_state)
+                                       handle_state, vault_lease)
             except Exception:
                 LOG.exception("state persist failed")
 

@@ -83,6 +83,7 @@ class ClientStateDB:
             rec.setdefault("tasks", {})[entry["task"]] = {
                 "state": entry.get("state"),
                 "handle": entry.get("handle"),
+                "vault_lease": entry.get("vault_lease"),
             }
         elif op == "del_alloc":
             self.state.pop(aid, None)
@@ -121,11 +122,13 @@ class ClientStateDB:
                       "alloc": to_wire(alloc)})
 
     def put_task(self, alloc_id: str, task: str, state,
-                 handle_state: Optional[dict]) -> None:
+                 handle_state: Optional[dict],
+                 vault_lease: Optional[dict] = None) -> None:
         from ..utils.codec import to_wire
         self._append({"op": "put_task", "alloc_id": alloc_id,
                       "task": task, "state": to_wire(state),
-                      "handle": handle_state})
+                      "handle": handle_state,
+                      "vault_lease": vault_lease})
 
     def delete_alloc(self, alloc_id: str) -> None:
         self._append({"op": "del_alloc", "alloc_id": alloc_id})

@@ -51,15 +51,18 @@ class VaultTokenRenewer:
 
     # -- tracking ------------------------------------------------------
     def track(self, alloc_id: str, task: str, lease: dict,
-              on_new_token: Optional[Callable[[dict], None]] = None
-              ) -> None:
+              on_new_token: Optional[Callable[[dict], None]] = None,
+              renew_now: bool = False) -> None:
+        """`renew_now` schedules an immediate renewal — used for leases
+        restored from the client state DB, whose remaining TTL is
+        unknown (renewal either refreshes it or fails into re-derive)."""
         lease = _normalize(lease)
         ttl = float(lease.get("ttl_s") or 0.0)
         if ttl <= 0 or not lease.get("accessor"):
             return      # legacy/no-lease token: nothing to renew
         entry = {"alloc_id": alloc_id, "task": task, "lease": lease,
-                 "next_renew": time.monotonic()
-                 + ttl * self.renew_fraction,
+                 "next_renew": time.monotonic() if renew_now
+                 else time.monotonic() + ttl * self.renew_fraction,
                  "fails": 0,
                  "on_new_token": on_new_token}
         with self._lock:

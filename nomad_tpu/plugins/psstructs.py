@@ -1,0 +1,199 @@
+"""Typed attributes with units (psstructs).
+
+Re-derivation of the reference's plugin attribute algebra
+(plugins/shared/structs/attribute.go:58, units.go): device fingerprints
+and device-constraint operands parse into typed Attributes — int, float,
+bool, or string, with an optional unit suffix on numbers ("500 MiB",
+"1.250 GHz", "250 mW"). Two attributes compare only when their units
+share a base dimension (bytes, byte-rates, hertz, watts — or both
+unitless); comparison converts both sides to the base unit. Python's
+Fraction gives the exact arithmetic the reference gets from big.Float
+at 512-bit precision (attribute.go:400) without a precision knob.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from fractions import Fraction
+from typing import Optional, Tuple, Union
+
+# Base dimensions (units.go BaseUnit).
+SCALAR = "scalar"
+BYTE = "byte"
+BYTE_RATE = "byte/s"
+HERTZ = "hertz"
+WATT = "watt"
+
+
+@dataclass(frozen=True)
+class Unit:
+    """A named unit: `multiplier` over the dimension's base unit;
+    `inverse` means base = value / multiplier (e.g. mW = W/1000)."""
+    name: str
+    base: str
+    multiplier: int
+    inverse: bool = False
+
+    def comparable(self, other: "Unit") -> bool:
+        return self.base == other.base
+
+
+def _build_units() -> dict:
+    units = []
+    # Binary SI bytes / byte rates.
+    for i, p in enumerate(("Ki", "Mi", "Gi", "Ti", "Pi", "Ei"), start=1):
+        units.append(Unit(p + "B", BYTE, 1 << (10 * i)))
+        units.append(Unit(p + "B/s", BYTE_RATE, 1 << (10 * i)))
+    # Decimal SI bytes / byte rates ("kB" and "KB" are synonyms).
+    for i, p in enumerate(("k", "M", "G", "T", "P", "E"), start=1):
+        units.append(Unit(p + "B", BYTE, 1000 ** i))
+        units.append(Unit(p + "B/s", BYTE_RATE, 1000 ** i))
+    units.append(Unit("KB", BYTE, 1000))
+    units.append(Unit("KB/s", BYTE_RATE, 1000))
+    # Hertz.
+    units.append(Unit("MHz", HERTZ, 1000 ** 2))
+    units.append(Unit("GHz", HERTZ, 1000 ** 3))
+    # Watts.
+    units.append(Unit("mW", WATT, 1000, inverse=True))
+    units.append(Unit("W", WATT, 1))
+    units.append(Unit("kW", WATT, 10 ** 3))
+    units.append(Unit("MW", WATT, 10 ** 6))
+    units.append(Unit("GW", WATT, 10 ** 9))
+    return {u.name: u for u in units}
+
+
+UNIT_INDEX = _build_units()
+# Longest-first so "MiB/s" wins over "B/s" in suffix matching.
+_LENGTH_SORTED_UNITS = sorted(UNIT_INDEX, key=len, reverse=True)
+
+# strconv.ParseBool's accepted spellings.
+_BOOL_WORDS = {"1": True, "t": True, "T": True, "true": True,
+               "TRUE": True, "True": True,
+               "0": False, "f": False, "F": False, "false": False,
+               "FALSE": False, "False": False}
+
+
+@dataclass(frozen=True)
+class Attribute:
+    """One typed value. Exactly one of int_val/float_val/bool_val/
+    str_val is set; unit applies to the numeric variants only."""
+    int_val: Optional[int] = None
+    float_val: Optional[float] = None
+    bool_val: Optional[bool] = None
+    str_val: Optional[str] = None
+    unit: str = ""
+
+    # -- construction ------------------------------------------------
+
+    @staticmethod
+    def of(value: Union[int, float, bool, str, "Attribute", None],
+           unit: str = "") -> Optional["Attribute"]:
+        """Coerce a raw fingerprint value into an Attribute. Strings
+        run through parse(); numbers/bools wrap directly."""
+        if value is None:
+            return None
+        if isinstance(value, Attribute):
+            return value
+        if isinstance(value, bool):
+            return Attribute(bool_val=value)
+        if isinstance(value, int):
+            return Attribute(int_val=value, unit=unit)
+        if isinstance(value, float):
+            return Attribute(float_val=value, unit=unit)
+        return parse_attribute(str(value))
+
+    # -- algebra -----------------------------------------------------
+
+    def _typed_unit(self) -> Optional[Unit]:
+        return UNIT_INDEX.get(self.unit)
+
+    def comparable(self, other: "Attribute") -> bool:
+        au, bu = self._typed_unit(), other._typed_unit()
+        if au is not None or bu is not None:
+            return au is not None and bu is not None \
+                and au.comparable(bu)
+        if self.str_val is not None:
+            return other.str_val is not None
+        if self.bool_val is not None:
+            return other.bool_val is not None
+        return other.str_val is None and other.bool_val is None
+
+    def _base_value(self) -> Optional[Fraction]:
+        """Numeric value converted to the unit's base dimension."""
+        if self.int_val is not None:
+            v = Fraction(self.int_val)
+        elif self.float_val is not None:
+            # exact decimal semantics: "1.1 GHz" must equal "1100 MHz",
+            # so parse the decimal string, not the binary float
+            try:
+                v = Fraction(str(self.float_val))
+            except ValueError:
+                v = Fraction(self.float_val)
+        else:
+            return None
+        u = self._typed_unit()
+        if u is None:
+            return v
+        return v / u.multiplier if u.inverse else v * u.multiplier
+
+    def compare(self, other: "Attribute") -> Tuple[int, bool]:
+        """(-1|0|1, comparable). Bools compare only for (in)equality:
+        0 when equal, 1 when not (attribute.go:343)."""
+        if not self.comparable(other):
+            return 0, False
+        if self.bool_val is not None:
+            return (0 if self.bool_val == other.bool_val else 1), True
+        if self.str_val is not None:
+            a, b = self.str_val, other.str_val
+            return (a > b) - (a < b), True
+        av, bv = self._base_value(), other._base_value()
+        if av is None or bv is None:
+            return 0, False
+        return (av > bv) - (av < bv), True
+
+    def __str__(self) -> str:
+        if self.bool_val is not None:
+            return str(self.bool_val).lower()
+        if self.str_val is not None:
+            return self.str_val
+        num = self.int_val if self.int_val is not None else self.float_val
+        return f"{num}{self.unit}" if self.unit else str(num)
+
+
+def parse_attribute(input_str: str) -> Attribute:
+    """Parse "500 MiB" / "1.25GHz" / "true" / arbitrary strings into a
+    typed Attribute (attribute.go:58 ParseAttribute): longest-suffix
+    unit match when the string ends in a letter, then int → float →
+    bool → string."""
+    s = input_str
+    if not s:
+        return Attribute(str_val=s)
+    unit = ""
+    numeric = s
+    if s[-1].isalpha():
+        for u in _LENGTH_SORTED_UNITS:
+            if s.endswith(u):
+                unit = u
+                break
+        if unit:
+            numeric = s[: -len(unit)].strip()
+    try:
+        return Attribute(int_val=int(numeric, 10), unit=unit)
+    except ValueError:
+        pass
+    try:
+        return Attribute(float_val=float(numeric), unit=unit)
+    except ValueError:
+        pass
+    b = _BOOL_WORDS.get(s)
+    if b is not None:
+        return Attribute(bool_val=b)
+    return Attribute(str_val=s)
+
+
+def compare_values(lval, rval) -> Tuple[int, bool]:
+    """Compare two raw values through the typed-attribute algebra."""
+    a, b = Attribute.of(lval), Attribute.of(rval)
+    if a is None or b is None:
+        return 0, False
+    return a.compare(b)

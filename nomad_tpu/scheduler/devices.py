@@ -32,6 +32,9 @@ import numpy as np
 from ..models import AllocatedDeviceResource, Node, RequestedDevice
 from ..models.constraints import Constraint
 from ..models.device_accounting import DeviceAccounter
+from ..ops.targets import _check_set_contains_all, _check_set_contains_any
+from ..ops.versions import version_matches
+from ..plugins.psstructs import compare_values
 
 _DEV_TARGET = re.compile(r"^\$\{device\.(.+)\}$")
 
@@ -64,33 +67,42 @@ def resolve_device_target(target: str, group) -> Tuple[Optional[object], bool]:
 
 
 def _compare(op: str, lval, rval) -> bool:
-    """Attribute comparison: numeric when both sides parse as numbers,
-    else lexical (psstructs Attribute.Compare, simplified: no units)."""
-    if op in ("is_set",):
+    """Device-constraint comparison over typed attributes with units
+    (feasible.go:1297 checkAttributeConstraint + psstructs
+    Attribute.Compare): "500 MiB" vs "1 GiB" converts both sides to
+    base bytes; incomparable dimensions fail ordered operators."""
+    if op == "is_set":
         return lval is not None
-    if op in ("is_not_set",):
+    if op == "is_not_set":
         return lval is None
+    if op in ("!=", "not"):
+        # nil != nil is false; nil != some is true (handled by caller
+        # passing None through); both present -> typed inequality.
+        if lval is None and rval is None:
+            return False
+        if (lval is None) != (rval is None):
+            return True
+        v, ok = compare_values(lval, rval)
+        return ok and v != 0
     if lval is None or rval is None:
         return False
-    try:
-        ln, rn = float(lval), float(rval)
-        lval, rval = ln, rn
-    except (TypeError, ValueError):
-        lval, rval = str(lval), str(rval)
-    if op in ("=", "==", "is"):
-        return lval == rval
-    if op in ("!=", "not"):
-        return lval != rval
-    if op == "<":
-        return lval < rval
-    if op == "<=":
-        return lval <= rval
-    if op == ">":
-        return lval > rval
-    if op == ">=":
-        return lval >= rval
+    if op in ("<", "<=", ">", ">=", "=", "==", "is"):
+        v, ok = compare_values(lval, rval)
+        if not ok:
+            return False
+        return {"is": v == 0, "==": v == 0, "=": v == 0,
+                "<": v == -1, "<=": v != 1,
+                ">": v == 1, ">=": v != -1}[op]
+    if op == "version":
+        return version_matches(str(lval), str(rval))
+    if op == "semver":
+        return version_matches(str(lval), str(rval), strict_semver=True)
     if op == "regexp":
         return re.search(str(rval), str(lval)) is not None
+    if op in ("set_contains", "set_contains_all"):
+        return _check_set_contains_all(str(lval), str(rval))
+    if op == "set_contains_any":
+        return _check_set_contains_any(str(lval), str(rval))
     return False
 
 
@@ -107,6 +119,11 @@ def group_satisfies(group, req: RequestedDevice) -> bool:
             continue
         if c.operand == "is_not_set":
             if lok:
+                return False
+            continue
+        if c.operand in ("!=", "not"):
+            if not _compare(c.operand, lval if lok else None,
+                            rval if rok else None):
                 return False
             continue
         if not lok or not rok:

@@ -1291,8 +1291,8 @@ class Server:
         terminal = {a.id for a in allocs
                     if a.client_status in ("complete", "failed", "lost")}
         if terminal:
-            doomed = [va.accessor for va in self.store.vault_accessors()
-                      if va.alloc_id in terminal]
+            doomed = [va.accessor for aid in terminal
+                      for va in self.store.vault_accessors_by_alloc(aid)]
             self.revoke_vault_accessors(doomed)
 
     def _node_evals(self, node_id: str) -> List[Evaluation]:
@@ -1556,6 +1556,14 @@ class Server:
         now = time.time()
         ttl = self.config.vault_token_ttl_s
         accessors, out = [], {}
+        # node_endpoint.go DeriveVaultToken: reject tasks that don't
+        # exist in the alloc's group or carry no vault stanza — a
+        # client must not be able to mint tokens for arbitrary names
+        unknown = [t for t in tasks if t not in policies]
+        if unknown:
+            raise ValueError(
+                f"tasks {unknown} do not exist in alloc {alloc_id} "
+                "or have no vault stanza")
         for task in tasks:
             tok = f"s.{generate_uuid()[:24]}"
             acc = generate_uuid()

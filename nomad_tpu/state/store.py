@@ -113,6 +113,7 @@ TABLES = (
     # secondary indexes
     "allocs_by_node", "allocs_by_job", "allocs_by_eval", "evals_by_job",
     "deployments_by_job", "services_by_name", "services_by_alloc",
+    "vault_accessors_by_alloc", "vault_accessors_by_token",
 )
 
 JOB_TRACKED_VERSIONS = 6  # structs.go JobTrackedVersions
@@ -1556,6 +1557,11 @@ class StateStore(StateSnapshot):
                 a.create_index = existing.create_index if existing else index
                 a.modify_index = index
                 t = t.set(a.accessor, a)
+                if existing is None:
+                    root = self._index_add(root, "vault_accessors_by_alloc",
+                                           a.alloc_id, a.accessor)
+                    root = self._index_add(root, "vault_accessors_by_token",
+                                           a.token, a.accessor)
             root = root.with_table("vault_accessors", t) \
                        .with_index("vault_accessors", index)
             self._publish(root)
@@ -1566,7 +1572,14 @@ class StateStore(StateSnapshot):
             root = self._root.edit()
             t = root.table("vault_accessors")
             for aid in accessor_ids:
+                a = t.get(aid)
+                if a is None:
+                    continue
                 t = t.delete(aid)
+                root = self._index_del(root, "vault_accessors_by_alloc",
+                                       a.alloc_id, aid)
+                root = self._index_del(root, "vault_accessors_by_token",
+                                       a.token, aid)
             root = root.with_table("vault_accessors", t) \
                        .with_index("vault_accessors", index)
             self._publish(root)
@@ -1578,10 +1591,20 @@ class StateStore(StateSnapshot):
         return sorted(self._root.table("vault_accessors").values(),
                       key=lambda a: a.accessor)
 
+    def vault_accessors_by_alloc(self, alloc_id: str) -> List:
+        """Leases minted for one allocation (state_store.go
+        VaultTokenAccessorsByAlloc) — the terminal-alloc revocation
+        hot path must not scan the whole lease table."""
+        return self._by_index("vault_accessors_by_alloc", alloc_id,
+                              "vault_accessors")
+
     def vault_accessor_by_token(self, token: str):
-        for a in self._root.table("vault_accessors").values():
-            if a.token == token:
-                return a
+        ids = self._root.table("vault_accessors_by_token").get(token)
+        if not ids:
+            return None
+        t = self._root.table("vault_accessors")
+        for aid in ids.keys():
+            return t.get(aid)
         return None
 
     # -- CSI volumes (state_store.go CSIVolume*) -----------------------
@@ -1825,6 +1848,10 @@ class StateStore(StateSnapshot):
             for w in data["tables"].get("vault_accessors", []):
                 a = from_wire(VaultAccessor, w)
                 t = t.set(a.accessor, a)
+                root = self._index_add(root, "vault_accessors_by_alloc",
+                                       a.alloc_id, a.accessor)
+                root = self._index_add(root, "vault_accessors_by_token",
+                                       a.token, a.accessor)
             root = root.with_table("vault_accessors", t)
 
             from ..models.services import ServiceRegistration

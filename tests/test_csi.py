@@ -225,6 +225,10 @@ def test_vault_token_derivation_and_env():
     server = Server(ServerConfig(num_schedulers=0))
     try:
         alloc = mock.alloc()
+        # derive validates the task carries a vault stanza
+        from nomad_tpu.models.job import VaultConfig
+        alloc.job.task_groups[0].tasks[0].vault = \
+            VaultConfig(policies=["default"])
         server.store.upsert_allocs(server.raft_apply(
             "eval_update", dict(evals=[])) or 1, [alloc])
         tokens = server.derive_vault_token(alloc.id, ["web"])

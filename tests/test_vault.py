@@ -162,6 +162,151 @@ def test_expired_lease_renewal_fails_then_rederive():
         server.shutdown()
 
 
+def test_derive_rejects_unknown_or_vaultless_task():
+    """node_endpoint.go DeriveVaultToken: a client must not mint
+    tokens for task names outside the alloc's group or for tasks with
+    no vault stanza."""
+    server = Server(ServerConfig(num_schedulers=1))
+    server.start()
+    try:
+        node = mock.node()
+        node.attributes["vault.version"] = "1.0-embedded"
+        node.compute_class()
+        server.register_node(node)
+        job = _vault_job(run_for="10s")
+        server.register_job(job)
+        assert _wait_for(lambda: len(
+            server.store.allocs_by_job("default", job.id)) == 1)
+        alloc = server.store.allocs_by_job("default", job.id)[0]
+        with pytest.raises(ValueError):
+            server.derive_vault_token(alloc.id, ["no-such-task"])
+        # a real task without a vault stanza is rejected too
+        plain = mock.batch_job()
+        plain.id = "no-vault"
+        plain.task_groups[0].count = 1
+        plain.task_groups[0].tasks[0].config = {"run_for": "10s"}
+        plain.canonicalize()
+        server.register_job(plain)
+        assert _wait_for(lambda: len(
+            server.store.allocs_by_job("default", plain.id)) == 1)
+        palloc = server.store.allocs_by_job("default", plain.id)[0]
+        with pytest.raises(ValueError):
+            server.derive_vault_token(
+                palloc.id, [plain.task_groups[0].tasks[0].name])
+    finally:
+        server.shutdown()
+
+
+def test_accessors_indexed_by_alloc():
+    """Terminal-alloc revocation must not scan the lease table: the
+    by-alloc secondary index answers it directly."""
+    from nomad_tpu.server.vault import VaultAccessor
+    from nomad_tpu.state import StateStore
+    store = StateStore()
+    now = time.time()
+    accs = [VaultAccessor(
+        accessor=f"acc{i}", token=f"s.tok{i}", alloc_id=f"a{i % 3}",
+        task="t", node_id="n", policies=[], ttl_s=60.0,
+        create_time=now, expire_time=now + 60.0) for i in range(9)]
+    store.upsert_vault_accessors(5, accs)
+    got = sorted(a.accessor for a in store.vault_accessors_by_alloc("a1"))
+    assert got == ["acc1", "acc4", "acc7"]
+    assert store.vault_accessor_by_token("s.tok4").accessor == "acc4"
+    store.delete_vault_accessors(6, ["acc4"])
+    got = sorted(a.accessor for a in store.vault_accessors_by_alloc("a1"))
+    assert got == ["acc1", "acc7"]
+    assert store.vault_accessor_by_token("s.tok4") is None
+    # restore rebuilds both indexes
+    fresh = StateStore()
+    fresh.restore(store.snapshot().dump())
+    assert sorted(a.accessor
+                  for a in fresh.vault_accessors_by_alloc("a0")) == \
+        ["acc0", "acc3", "acc6"]
+    assert fresh.vault_accessor_by_token("s.tok8").accessor == "acc8"
+
+
+def test_lease_survives_client_restart(tmp_path):
+    """A re-attached task's lease keeps renewing after a client
+    restart: the restored renewer re-registers the persisted lease, so
+    the token stays valid past its original TTL (taskrunner vault_hook
+    restore + client/vaultclient re-registration)."""
+    state_dir = str(tmp_path / "client-state")
+    server = Server(ServerConfig(num_schedulers=1, heartbeat_ttl_s=30.0,
+                                 vault_token_ttl_s=0.5))
+    server.start()
+    c1 = Client(server, ClientConfig(node_name="vault-durable",
+                                     state_dir=state_dir,
+                                     alloc_dir=str(tmp_path / "allocs")))
+    c1.start()
+    try:
+        job = _vault_job(run_for="60s")
+        job.type = "service"
+        job.canonicalize()
+        server.register_job(job)
+        assert _wait_for(lambda: len(server.store.vault_accessors()) == 1)
+        acc = server.store.vault_accessors()[0]
+
+        # "crash" the client without killing the task
+        c1.shutdown(kill_tasks=False)
+
+        c2 = Client(server, ClientConfig(node_name="vault-durable",
+                                         state_dir=state_dir,
+                                         alloc_dir=str(tmp_path / "allocs")))
+        c2.start()
+        try:
+            assert len(c2.runners) == 1
+            alloc_id = next(iter(c2.runners))
+
+            # the task must hold a live lease well past the original
+            # 0.5 s TTL: either the restored lease kept renewing, or
+            # (if it lapsed during the restart window) the renewer
+            # re-derived a fresh one — both are recovery, a dead token
+            # with no replacement is the bug
+            def live_lease():
+                accs = server.store.vault_accessors_by_alloc(alloc_id)
+                return len(accs) == 1 and \
+                    server.lookup_vault_token(accs[0].token)
+            assert _wait_for(live_lease, timeout=3)
+            t_end = time.time() + 1.2
+            while time.time() < t_end:
+                assert live_lease(), "lease lapsed after client restart"
+                time.sleep(0.1)
+            st = c2.vault_renewer.stats
+            assert st["renewals"] + st["rederives"] >= 1
+        finally:
+            c2.shutdown()
+    finally:
+        server.shutdown()
+
+
+def test_rederive_skips_change_mode_on_finished_task(tmp_path):
+    """A persistent renewal failure on an already-exited task must not
+    force a restart outside the restart policy — the fresh token just
+    lands on disk."""
+    from nomad_tpu.client.agent import TaskRunner
+    from nomad_tpu.client.drivers import MockDriver
+
+    job = _vault_job(run_for="50ms")
+    job.task_groups[0].tasks[0].vault.change_mode = "restart"
+    alloc = mock.alloc()
+    alloc.job = job
+    alloc.task_group = job.task_groups[0].name
+    task = job.task_groups[0].tasks[0]
+    driver = MockDriver()
+    tr = TaskRunner(alloc, task, driver, on_update=lambda: None,
+                    derive_vault=lambda aid, ts: {
+                        t: {"token": "s.x", "accessor": "", "ttl_s": 0}
+                        for t in ts})
+    tr.run()        # synchronous: task runs 50ms and completes
+    assert tr.state.state == "dead" and not tr.state.failed
+    restarts_before = tr.state.restarts
+    tr._on_new_vault_token({"token": "s.new", "accessor": "a2",
+                            "ttl_s": 1.0})
+    assert tr._force_restart is False, \
+        "finished task must not be force-restarted by a token change"
+    assert tr.state.restarts == restarts_before
+
+
 def test_accessors_survive_snapshot_restore():
     """Leases ride the store dump/restore (failover: a new leader can
     still renew/revoke accessors it never minted)."""
