@@ -1,0 +1,183 @@
+"""Stock pull-iterator scheduler — the measured same-host baseline.
+
+This is a deliberate re-derivation of the reference's one-node-at-a-time
+scheduling pipeline (scheduler/stack.go GenericStack, scheduler/
+feasible.go FeasibilityWrapper, scheduler/rank.go BinPackIterator,
+scheduler/select.go LimitIterator), used ONLY as the baseline the
+columnar kernel path is benchmarked against on the same host, same
+state store, same plan-apply path (VERDICT r4 item 1's second arm:
+"a measured stock-iterator-scheduler baseline on the same host at C2M
+proving >=20x against it").
+
+Faithful reference semantics reproduced here:
+  - nodes shuffle once per eval; every placement re-walks the shuffled
+    order from the start (stack.go:71 shuffleNodes + iterator Reset)
+  - batch jobs score the first `limit = 2` feasible+fitting candidates
+    and take the better one — the power-of-two-choices rule
+    (stack.go:77-90)
+  - feasibility memoizes by computed node class
+    (feasible.go:994-1134 FeasibilityWrapper)
+  - BinPackIterator recomputes the node's proposed allocations from
+    the store + in-flight plan for every scored candidate
+    (rank.go:330 ProposedAllocs) and scores fit with the same
+    20 - 10^fcpu - 10^fmem curve (structs/funcs.go ScoreFit)
+
+It intentionally does NOT batch, vectorize, or cache across placements
+beyond what the reference caches — that is the point of the comparison.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..models import (ALLOC_CLIENT_PENDING, ALLOC_DESIRED_RUN,
+                      AllocatedResources, AllocatedSharedResources,
+                      Allocation, Plan)
+from ..ops.tables import _alloc_usage
+from ..utils.ids import generate_uuid
+
+
+def _comparable_ask(tg) -> Tuple[float, float, float]:
+    cpu = float(sum(t.resources.cpu for t in tg.tasks))
+    mem = float(sum(t.resources.memory_mb for t in tg.tasks))
+    disk = float(tg.ephemeral_disk.size_mb if tg.ephemeral_disk else 0)
+    return cpu, mem, disk
+
+
+class IterBaselineScheduler:
+    """One eval of a batch job through the stock iterator pipeline."""
+
+    def __init__(self, snapshot, seed: int = 0):
+        self.snapshot = snapshot
+        self.rng = random.Random(seed)
+
+    def process(self, job, count: int) -> Tuple[Plan, int]:
+        snap = self.snapshot
+        tg = job.task_groups[0]
+        dcs = set(job.datacenters)
+        drivers = {t.driver for t in tg.tasks if t.driver}
+
+        # node walk order: shuffle once per eval (stack.go SetNodes)
+        nodes = [n for n in snap.nodes() if n.ready()
+                 and n.datacenter in dcs]
+        self.rng.shuffle(nodes)
+        limit = 2                      # batch: power-of-two choices
+
+        # FeasibilityWrapper class memo
+        class_ok: Dict[str, bool] = {}
+
+        def feasible(node) -> bool:
+            cls = node.computed_class
+            hit = class_ok.get(cls)
+            if hit is not None:
+                return hit
+            ok = all(node.attributes.get(f"driver.{d}") for d in drivers)
+            class_ok[cls] = ok
+            return ok
+
+        ask_cpu, ask_mem, ask_disk = _comparable_ask(tg)
+        plan = Plan(job=job)
+        plan_rows: Dict[str, List[Allocation]] = plan.node_allocation
+        placed = 0
+        for _k in range(count):
+            best_node = None
+            best_score = -1e30
+            scored = 0
+            # every placement restarts the shuffled walk (iterator
+            # Reset); full nodes are re-scored and rejected each pass,
+            # exactly as BinPackIterator does
+            for node in nodes:
+                if not feasible(node):
+                    continue
+                # ProposedAllocs: live allocs from the store + the
+                # in-flight plan's placements on this node
+                res = node.comparable_resources()
+                reserved = node.comparable_reserved_resources()
+                cap_cpu = res.cpu_shares - reserved.cpu_shares
+                cap_mem = res.memory_mb - reserved.memory_mb
+                cap_disk = res.disk_mb - reserved.disk_mb
+                used_cpu = used_mem = used_disk = 0.0
+                for a in snap.allocs_by_node(node.id):
+                    if a.terminal_status():
+                        continue
+                    u = _alloc_usage(a)
+                    used_cpu += u[0]
+                    used_mem += u[1]
+                    used_disk += u[2]
+                for a in plan_rows.get(node.id, ()):
+                    u = _alloc_usage(a)
+                    used_cpu += u[0]
+                    used_mem += u[1]
+                    used_disk += u[2]
+                after_cpu = used_cpu + ask_cpu
+                after_mem = used_mem + ask_mem
+                after_disk = used_disk + ask_disk
+                if after_cpu > cap_cpu or after_mem > cap_mem or \
+                        after_disk > cap_disk:
+                    continue            # no fit: walk on (rank.go:415)
+                # ScoreFit (structs/funcs.go): 20 - 10^fcpu - 10^fmem
+                score = 20.0 - 10.0 ** (after_cpu / max(cap_cpu, 1e-9)) \
+                    - 10.0 ** (after_mem / max(cap_mem, 1e-9))
+                if score > best_score:
+                    best_score = score
+                    best_node = node
+                scored += 1
+                if scored >= limit:
+                    break
+            if best_node is None:
+                break
+            alloc = Allocation(
+                id=generate_uuid(),
+                namespace=job.namespace,
+                name=f"{job.id}.{tg.name}[{placed}]",
+                job_id=job.id,
+                task_group=tg.name,
+                node_id=best_node.id,
+                node_name=best_node.name,
+                allocated_resources=AllocatedResources(
+                    tasks={},
+                    shared=AllocatedSharedResources(disk_mb=int(ask_disk))),
+                desired_status=ALLOC_DESIRED_RUN,
+                client_status=ALLOC_CLIENT_PENDING,
+            )
+            # carry usage on the alloc the way the kernel path does, so
+            # downstream accounting (and this loop's own plan overlay)
+            # sees identical numbers
+            alloc.allocated_resources.tasks = {
+                t.name: _task_res(t) for t in tg.tasks}
+            plan_rows.setdefault(best_node.id, []).append(alloc)
+            placed += 1
+        return plan, placed
+
+
+def _task_res(task):
+    from ..models.resources import (AllocatedCpuResources,
+                                    AllocatedMemoryResources,
+                                    AllocatedTaskResources)
+    return AllocatedTaskResources(
+        cpu=AllocatedCpuResources(task.resources.cpu),
+        memory=AllocatedMemoryResources(task.resources.memory_mb))
+
+
+def bench_iter_baseline(h, job_proto, count: int = 1000,
+                        n_evals: int = 3) -> Dict:
+    """Measure the iterator baseline on an already-seeded harness: same
+    store, same plan-apply (harness submit_plan -> upsert_plan_results).
+    `count` stays modest because the iterator walk degrades
+    quadratically as prefix nodes fill — measuring it small is strictly
+    FAVORABLE to the baseline."""
+    rates = []
+    for i in range(n_evals):
+        job = job_proto(i)
+        h.store.upsert_job(h.next_index(), job)
+        snap = h.store.snapshot()
+        sched = IterBaselineScheduler(snap, seed=i)
+        t0 = time.perf_counter()
+        plan, placed = sched.process(job, count)
+        h.submit_plan(plan)
+        el = time.perf_counter() - t0
+        rates.append(placed / el if el > 0 else 0.0)
+    return {"iter_rate": max(rates), "iter_rates": rates,
+            "iter_count": count}

@@ -426,16 +426,31 @@ def seed_c2m_allocs(h, nodes, seed_allocs: int,
 
 
 def bench_c2m_scale(n_nodes: int = 50000, seed_allocs: int = 2_000_000,
-                    batch_count: int = 10000, n_service: int = 10) -> Dict:
+                    batch_count: int = 10000, n_service: int = 10,
+                    n_stream: int = 5) -> Dict:
     """Ladder #5 (C2M replay scale): a 50k-node cluster pre-loaded with
     2M running allocs (BASELINE config #5), then (a) a 10k-instance
-    batch job e2e and (b) service-eval p99 — all against the resident
-    delta-maintained node table (no per-eval rebuild) over the full
-    2M-row alloc table."""
+    batch job e2e, (a') the stock iterator baseline on the same store,
+    (b) service-eval p99, and (c) a STREAM of `n_stream` 10k-instance
+    batch jobs through the production control plane (eval broker ->
+    two workers -> plan queue -> pipelined applier), where one
+    worker's device wait overlaps the other's host work — compute
+    overlapping apply end-to-end, the plan_apply.go:44-70 shape."""
     from ..mock import fixtures as mock
     from ..scheduler.harness import Harness
+    from ..server import Server, ServerConfig
 
-    h = Harness()
+    # the store lives inside a real Server; the single-eval measures
+    # below drive it through a store-sharing harness while workers are
+    # paused, then the stream runs through the workers themselves
+    srv = Server(ServerConfig(num_schedulers=2, eval_batch_size=1,
+                              heartbeat_ttl_s=3600.0))
+    srv.start()
+    for w in srv.workers:
+        w.set_pause(True)
+
+    h = Harness(store=srv.store)
+    h._next_index = srv.store.latest_index() + 1000
     nodes = _seed_nodes(h, n_nodes)
     dcs = [f"dc{d}" for d in (1, 2, 3, 4)]
 
@@ -463,6 +478,26 @@ def bench_c2m_scale(n_nodes: int = 50000, seed_allocs: int = 2_000_000,
     h.process("batch", _eval_for(job))
     batch_s = time.perf_counter() - t0
     placed = sum(len(a) for a in h.plans[-1].node_allocation.values())
+
+    # (a') the stock pull-iterator scheduler on the SAME store, same
+    # plan-apply path — the same-host baseline the kernel path is
+    # proven against (bench/iterbaseline.py; measured at a smaller
+    # count, which favors the baseline: its walk degrades as prefix
+    # nodes fill)
+    from .iterbaseline import bench_iter_baseline
+
+    def _iter_proto(i):
+        j = mock.batch_job()
+        j.id = f"c2m-iterbase-{i}"
+        j.datacenters = dcs
+        tgp = j.task_groups[0]
+        tgp.count = 1000
+        tgp.tasks[0].resources.networks = []
+        tgp.networks = []
+        return j
+
+    iter_stats = bench_iter_baseline(h, _iter_proto, count=1000,
+                                     n_evals=2)
 
     # (b) service p99 at scale (spread + affinity live)
     from ..models import Affinity, Spread, SpreadTarget
@@ -508,6 +543,49 @@ def bench_c2m_scale(n_nodes: int = 50000, seed_allocs: int = 2_000_000,
             times.append(time.perf_counter() - t0)
             gcsafe.safepoint()
     arr = np.array(times)
+
+    # (c) streamed batch throughput through the production workers:
+    # two schedulers dequeue from the broker concurrently, so one's
+    # device dispatch wait (the tunnel RTT + kernel) overlaps the
+    # other's host-side reconcile/expand/plan work, and the plan queue
+    # + applier pipeline the commits (plan_apply.go:44-70 overlap).
+    srv._raft_index = h.store.latest_index()
+    stream_jobs = []
+    for i in range(n_stream):
+        sj = mock.batch_job()
+        sj.id = f"c2m-stream-{i}"
+        sj.datacenters = dcs
+        tgj = sj.task_groups[0]
+        tgj.count = batch_count
+        tgj.tasks[0].resources.networks = []
+        tgj.networks = []
+        stream_jobs.append(sj)
+    tg_names = {sj.id: sj.task_groups[0].name for sj in stream_jobs}
+
+    def _stream_placed() -> int:
+        total = 0
+        for sj in stream_jobs:
+            summ = srv.store.job_summary("default", sj.id)
+            if summ is None:
+                continue
+            total += sum(summ.summary.get(tg_names[sj.id], {}).values())
+        return total
+
+    for sj in stream_jobs:
+        srv.register_job(sj)
+    want = n_stream * batch_count
+    t0 = time.perf_counter()
+    for w in srv.workers:
+        w.set_pause(False)
+    deadline = time.perf_counter() + 600
+    while time.perf_counter() < deadline:
+        if _stream_placed() >= want:
+            break
+        time.sleep(0.05)
+    stream_wall = time.perf_counter() - t0
+    stream_placed = _stream_placed()
+    srv.shutdown()
+
     return {
         "c2m_nodes": n_nodes,
         "c2m_allocs": total_allocs,
@@ -516,8 +594,16 @@ def bench_c2m_scale(n_nodes: int = 50000, seed_allocs: int = 2_000_000,
         "c2m_table_build_s": round(table_build_s, 2),
         "c2m_batch_placements_per_sec": round(placed / batch_s, 1),
         "c2m_batch_placed": placed,
+        "c2m_iter_baseline_placements_per_sec": round(
+            iter_stats["iter_rate"], 1),
+        "c2m_vs_iter_baseline": round(
+            (placed / batch_s) / max(iter_stats["iter_rate"], 1e-9), 1),
         "c2m_service_p99_ms": round(float(np.percentile(arr, 99) * 1e3), 1),
         "c2m_service_p50_ms": round(float(np.percentile(arr, 50) * 1e3), 1),
+        "c2m_stream_placements_per_sec": round(
+            stream_placed / max(stream_wall, 1e-9), 1),
+        "c2m_stream_placed": stream_placed,
+        "c2m_stream_wall_s": round(stream_wall, 2),
     }
 
 

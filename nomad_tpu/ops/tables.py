@@ -85,58 +85,6 @@ def _alloc_usage(alloc) -> Tuple[float, float, float, float]:
     return out
 
 
-class ShardedCowMap:
-    """alloc-id -> alloc registry with O(1) snapshot clones: 256 hash
-    shards; clones share shard dicts and copy one lazily on first
-    write. The delta path touches a handful of shards per refresh,
-    while building a persistent-trie registry at 2M rows costs the
-    better part of a minute of pure Python — this is the resident
-    table's answer to the C2M cold-build budget."""
-
-    __slots__ = ("_shards", "_own")
-    N = 256
-
-    def __init__(self, shards=None, own=None):
-        self._shards = shards if shards is not None \
-            else [None] * self.N          # None == empty shard
-        self._own = own if own is not None else set(range(self.N))
-
-    def get(self, key, default=None):
-        s = self._shards[hash(key) & 0xff]
-        return default if s is None else s.get(key, default)
-
-    def _writable(self, i: int):
-        s = self._shards[i]
-        if i in self._own:
-            if s is None:
-                s = {}
-                self._shards[i] = s
-            return s
-        s = dict(s) if s else {}
-        self._shards[i] = s
-        self._own.add(i)
-        return s
-
-    def put(self, key, value) -> None:
-        self._writable(hash(key) & 0xff)[key] = value
-
-    def discard(self, key) -> None:
-        i = hash(key) & 0xff
-        s = self._shards[i]
-        if s is None or key not in s:
-            return
-        self._writable(i).pop(key, None)
-
-    def clone(self) -> "ShardedCowMap":
-        # both sides go copy-on-write: the parent must not keep
-        # mutating dicts the clone now shares
-        self._own = set()
-        return ShardedCowMap(list(self._shards), set())
-
-    def __len__(self) -> int:
-        return sum(len(s) for s in self._shards if s)
-
-
 class NodeTable:
     """Columnar view of the ready node set + live allocation usage."""
 
@@ -147,10 +95,14 @@ class NodeTable:
         self.id_to_idx = {nid: i for i, nid in enumerate(self.ids)}
         self.cols = TargetColumns(nodes)
         # applied-alloc registry for the delta path (alloc id -> the
-        # object version whose usage is currently accounted); sharded
-        # CoW map so clone_for_deltas is O(shards) even at 2M allocs
-        # and the cold build is plain dict inserts
-        self.alloc_by_id = ShardedCowMap()
+        # object version whose usage is currently accounted). ONE plain
+        # dict SHARED across clone_for_deltas generations: the registry
+        # is only ever read/written inside the serialized table-refresh
+        # path (NodeTableCache.get holds its lock), never by concurrent
+        # eval readers of older versions — so it needs no MVCC, and a
+        # 10k-alloc refresh costs 10k dict stores instead of a
+        # 2M-entry copy-on-write storm (round-5 profile: 111 ms/eval)
+        self.alloc_by_id: Dict[str, object] = {}
         # attribute dictionary-encodings, valid per table version
         self._attr_codes_cache: Dict[str, Tuple[np.ndarray, List[str]]] = {}
         # ready-in-datacenters masks, valid per table version
@@ -325,7 +277,8 @@ class NodeTable:
         t._free_ports_dirty = (None if self._free_ports_dirty is None
                                else set(self._free_ports_dirty))
         self._seal()
-        t.alloc_by_id = self.alloc_by_id.clone()  # CoW share, O(shards)
+        # shared on purpose — see the registry invariant in __init__
+        t.alloc_by_id = self.alloc_by_id
         t.mask_cache = self.mask_cache  # node columns shared => masks too
         t.preempt_cache = self.preempt_cache  # row identity keys the entries
         t._attr_codes_cache = self._attr_codes_cache
@@ -367,7 +320,7 @@ class NodeTable:
         self.base_used[i, 3] += u[3]
         if self._sealed:
             self.live_allocs[i] = self.live_allocs[i] + [alloc]  # row CoW
-            self.alloc_by_id.put(alloc.id, alloc)
+            self.alloc_by_id[alloc.id] = alloc
         else:
             self.live_allocs[i].append(alloc)
             self._pending_allocs.append((alloc.id, alloc))
@@ -386,7 +339,7 @@ class NodeTable:
         self._seal()
         self.live_allocs[i] = [a for a in self.live_allocs[i]
                                if a.id != alloc.id]
-        self.alloc_by_id.discard(alloc.id)
+        self.alloc_by_id.pop(alloc.id, None)
         bits = self._alloc_port_bits(alloc)
         # keep ports that the node itself reserves (reserved_host_ports)
         node_bits = 0
@@ -450,12 +403,12 @@ class NodeTable:
                 per_node[i] = [a]
             else:
                 lst.append(a)
-        put = self.alloc_by_id.put
+        by_id = self.alloc_by_id
         rows = self.live_allocs
         for i, lst in per_node.items():
             rows[i] = rows[i] + lst          # one row CoW per node
         for _i, a in adds:
-            put(a.id, a)
+            by_id[a.id] = a
         port_bits = self._alloc_port_bits
         for i, a in adds:
             bits = port_bits(a)
@@ -474,20 +427,16 @@ class NodeTable:
         self._sealed = True
         if getattr(self, "_bulk_rows_pending", False):
             # cold build: derive the alloc-id registry from the row
-            # lists in one pass, resolving each shard dict once —
-            # put() per alloc (hash + _writable + tuple append in the
-            # hot loop) costs ~1.5us x 2M rows
+            # lists in one pass
             self._bulk_rows_pending = False
-            shards = [self.alloc_by_id._writable(i)
-                      for i in range(ShardedCowMap.N)]
+            reg = self.alloc_by_id
             for row in self.live_allocs:
                 for alloc in row:
-                    aid = alloc.id
-                    shards[hash(aid) & 0xff][aid] = alloc
+                    reg[alloc.id] = alloc
         if self._pending_allocs:
-            put = self.alloc_by_id.put
+            reg = self.alloc_by_id
             for aid, alloc in self._pending_allocs:
-                put(aid, alloc)
+                reg[aid] = alloc
             self._pending_allocs = []
 
     def finalize(self) -> None:

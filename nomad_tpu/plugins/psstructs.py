@@ -13,6 +13,7 @@ at 512-bit precision (attribute.go:400) without a precision knob.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from fractions import Fraction
 from typing import Optional, Tuple, Union
@@ -124,11 +125,12 @@ class Attribute:
             v = Fraction(self.int_val)
         elif self.float_val is not None:
             # exact decimal semantics: "1.1 GHz" must equal "1100 MHz",
-            # so parse the decimal string, not the binary float
+            # so parse the decimal string, not the binary float;
+            # directly-constructed inf/nan attributes are incomparable
             try:
                 v = Fraction(str(self.float_val))
-            except ValueError:
-                v = Fraction(self.float_val)
+            except (ValueError, OverflowError):
+                return None
         else:
             return None
         u = self._typed_unit()
@@ -182,7 +184,11 @@ def parse_attribute(input_str: str) -> Attribute:
     except ValueError:
         pass
     try:
-        return Attribute(float_val=float(numeric), unit=unit)
+        f = float(numeric)
+        # inf/nan have no place in the comparison algebra — keep the
+        # raw string so they compare (only) as strings
+        if math.isfinite(f):
+            return Attribute(float_val=f, unit=unit)
     except ValueError:
         pass
     b = _BOOL_WORDS.get(s)

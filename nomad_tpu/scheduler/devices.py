@@ -32,7 +32,8 @@ import numpy as np
 from ..models import AllocatedDeviceResource, Node, RequestedDevice
 from ..models.constraints import Constraint
 from ..models.device_accounting import DeviceAccounter
-from ..ops.targets import _check_set_contains_all, _check_set_contains_any
+from ..ops.targets import (_check_set_contains_all,
+                           _check_set_contains_any, _regex)
 from ..ops.versions import version_matches
 from ..plugins.psstructs import compare_values
 
@@ -98,7 +99,10 @@ def _compare(op: str, lval, rval) -> bool:
     if op == "semver":
         return version_matches(str(lval), str(rval), strict_semver=True)
     if op == "regexp":
-        return re.search(str(rval), str(lval)) is not None
+        # cached compile; invalid user patterns mean "no match", not a
+        # crashed eval (same contract as the node-constraint engine)
+        pat = _regex(str(rval))
+        return pat is not None and pat.search(str(lval)) is not None
     if op in ("set_contains", "set_contains_all"):
         return _check_set_contains_all(str(lval), str(rval))
     if op == "set_contains_any":

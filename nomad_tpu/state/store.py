@@ -876,7 +876,13 @@ class StateStore(StateSnapshot):
                 it = root.table(name)
                 for key, ids in groups.items():
                     sub = (it.get(key) or _Table()).with_ctx(root._ctx)
-                    sub = sub.update([(i, True) for i in ids])
+                    # single-member adds dominate (a 10k batch touches
+                    # 10k distinct nodes): set() skips update()'s batch
+                    # machinery
+                    if len(ids) == 1:
+                        sub = sub.set(ids[0], True)
+                    else:
+                        sub = sub.update([(i, True) for i in ids])
                     it = it.set(key, sub.frozen())
                 root = root.with_table(name, it)
             summaries = root.table("job_summaries")
@@ -1169,9 +1175,17 @@ class StateStore(StateSnapshot):
             tt = root.table(table)
             pairs = []
             for key, ids in groups.items():
-                members = (tt.get(key) or _Table()).with_ctx(root._ctx)
-                members = members.update([(aid, True) for aid in ids])
-                pairs.append((key, members.frozen()))
+                members = tt.get(key)
+                if members is None:
+                    members = _Table()
+                # single-member adds dominate spread-out batches: a
+                # frozen set() skips the with_ctx/update/frozen dance
+                if len(ids) == 1:
+                    members = members.set(ids[0], True)
+                else:
+                    members = members.with_ctx(root._ctx).update(
+                        [(aid, True) for aid in ids]).frozen()
+                pairs.append((key, members))
             # ONE outer batch write per index table: per-key .set walks
             # the trie path each time (a 10k-alloc plan touches ~1k
             # nodes)
