@@ -14,7 +14,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..models import (
     Allocation, Node, NodeResources, TaskState, TaskEvent,
@@ -75,6 +75,11 @@ class ClientConfig:
     rpc_port: Optional[int] = 0
     rpc_host: str = "127.0.0.1"
     rpc_advertise: str = ""
+    # CSI plugins to launch behind the plugin process boundary
+    # (plugins/csi_client.py CSI_PLUGIN_CATALOG names); the client
+    # stages/publishes volumes through them (client/pluginmanager/
+    # csimanager)
+    csi_plugins: tuple = ()
 
 
 def fingerprint_accelerator_devices():
@@ -110,7 +115,8 @@ class TaskRunner:
     def __init__(self, alloc: Allocation, task, driver, on_update,
                  attached: Optional[TaskHandle] = None,
                  node=None, alloc_dir=None, derive_vault=None,
-                 vault=None, attached_vault_lease: Optional[dict] = None):
+                 vault=None, attached_vault_lease: Optional[dict] = None,
+                 volume_sources: Optional[Dict[str, str]] = None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -129,6 +135,9 @@ class TaskRunner:
         # taskrunner/vault_hook.go + state DB)
         self.vault_lease: Optional[dict] = None
         self._attached_vault_lease = attached_vault_lease
+        # group volume name -> host source path (csi publish target or
+        # host volume path), resolved by the alloc runner's volume hook
+        self.volume_sources = volume_sources or {}
         self.state = TaskState(state=TASK_STATE_PENDING)
         self.handle: Optional[TaskHandle] = None
         self._attached = attached
@@ -218,7 +227,23 @@ class TaskRunner:
             if tr is not None:
                 alloc_networks.extend(
                     _to_wire(nw) for nw in (tr.networks or []))
+        # volume_mount stanzas resolve against the alloc runner's
+        # mounted volume sources (csi publish targets / host volume
+        # paths) — drivers receive [{volume, source, destination,
+        # read_only}] (taskrunner/volume_hook.go)
+        volume_mounts = []
+        for vm in (self.task.volume_mounts or []):
+            src = self.volume_sources.get(vm.volume)
+            if src is None:
+                from .hooks import HookError
+                raise HookError(
+                    f"volume_mount references undefined volume "
+                    f"{vm.volume!r}")
+            volume_mounts.append({"volume": vm.volume, "source": src,
+                                  "destination": vm.destination,
+                                  "read_only": bool(vm.read_only)})
         ctx = {"task_dir": task_path or None,
+               "volume_mounts": volume_mounts,
                "log_dir": log_dir,
                "log_max_files": lc.max_files if lc else 10,
                "log_max_file_size_mb": lc.max_file_size_mb if lc else 10,
@@ -453,6 +478,10 @@ class AllocRunner:
         self.deployment_status = alloc.deployment_status
         self._l = threading.Lock()
         self.destroyed = False
+        # volume name -> host source path tasks mount from (filled by
+        # _mount_volumes: CSI publish targets + host volume paths)
+        self.volume_sources: Dict[str, str] = {}
+        self._csi_mounted: List[Tuple[str, str]] = []  # (plugin, vol)
         from .allocdir import AllocDir
         self.alloc_dir = AllocDir(alloc_dir_base, alloc.id)
         self.services = None
@@ -472,6 +501,25 @@ class AllocRunner:
             self._push()
             return
         self.alloc_dir.build([t.name for t in tg.tasks])
+        # csi_hook (allocrunner/csi_hook.go): stage + publish every CSI
+        # volume the group requests before any task starts; a mount
+        # failure fails the alloc at setup
+        try:
+            self._mount_volumes(tg)
+        except Exception as e:
+            LOG.exception("volume setup failed for %s", self.alloc.id[:8])
+            for task in tg.tasks:
+                tr = TaskRunner(self.alloc, task, self.drivers.get(
+                    task.driver), self._on_task_update)
+                tr.state = TaskState(
+                    state=TASK_STATE_DEAD, failed=True,
+                    finished_at=time.time(),
+                    events=[TaskEvent(type="Setup Failure",
+                                      message=f"volume mount: {e}",
+                                      failed=True, time=int(time.time()))])
+                self.task_runners.append(tr)
+            self._on_task_update()
+            return
         for task in tg.tasks:
             driver = self.drivers.get(task.driver)
             if driver is None:
@@ -484,7 +532,8 @@ class AllocRunner:
                             derive_vault=self.derive_vault,
                             vault=self.vault,
                             attached_vault_lease=(attached_leases or {})
-                            .get(task.name))
+                            .get(task.name),
+                            volume_sources=self.volume_sources)
             self.task_runners.append(tr)
         # previous-alloc watcher (client/allocwatcher): a replacement
         # with a sticky/migrating ephemeral disk waits for its
@@ -582,17 +631,73 @@ class AllocRunner:
             healthy=healthy, timestamp=time.time(), canary=canary)
         self._push()
 
+    def _mount_volumes(self, tg) -> None:
+        """Resolve the group's volume requests into task-mountable
+        source paths: host volumes from the node's host_volume config,
+        CSI volumes via stage/publish through the csimanager."""
+        if not tg.volumes:
+            return
+        csi = getattr(self.client, "csi_manager", None) \
+            if self.client is not None else None
+        transport = getattr(self.client, "transport", None) \
+            if self.client is not None else None
+        for name, req in tg.volumes.items():
+            vtype = getattr(req, "type", "host") or "host"
+            if vtype == "host":
+                hv = (self.node.host_volumes or {}).get(req.source) \
+                    if self.node is not None else None
+                if hv and hv.get("path"):
+                    self.volume_sources[name] = hv["path"]
+                elif self.node is not None and self.node.host_volumes:
+                    # the scheduler filtered on host volumes, so a miss
+                    # here is a real config error — fail setup loudly
+                    # instead of a misleading per-task mount error
+                    raise RuntimeError(
+                        f"host volume {req.source!r} not present on "
+                        "this node")
+                continue
+            if vtype != "csi":
+                continue
+            if csi is None or transport is None:
+                raise RuntimeError(
+                    f"csi volume {req.source}: no csi plugins configured")
+            info = transport.get_csi_volume(self.alloc.namespace,
+                                            req.source)
+            if not info:
+                raise RuntimeError(f"csi volume {req.source} not found")
+            plugin_id = info.get("plugin_id", "")
+            target = csi.mount_volume(plugin_id, req.source,
+                                      self.alloc.id,
+                                      bool(req.read_only))
+            if target is None:
+                raise RuntimeError(
+                    f"csi plugin {plugin_id!r} not available on node")
+            self._csi_mounted.append((plugin_id, req.source))
+            self.volume_sources[name] = target
+
+    def _unmount_volumes(self) -> None:
+        csi = getattr(self.client, "csi_manager", None) \
+            if self.client is not None else None
+        if csi is None:
+            self._csi_mounted = []
+            return
+        for plugin_id, vol_id in self._csi_mounted:
+            csi.unmount_volume(plugin_id, vol_id, self.alloc.id)
+        self._csi_mounted = []
+
     def stop(self) -> None:
         self.destroyed = True
         if self.services is not None:
             self.services.stop()
         for tr in self.task_runners:
             tr.kill()
+        self._unmount_volumes()
 
     def destroy(self) -> None:
         """Release the alloc's directory tree (client GC)."""
         if not self.destroyed:
             self.stop()
+        self._unmount_volumes()
         self.alloc_dir.destroy()
 
     def _on_task_update(self) -> None:
@@ -617,9 +722,18 @@ class AllocRunner:
             self.client_status = status
         # terminal allocs leave the catalog even without an explicit
         # stop (batch tasks finishing; groupservice_hook Postrun)
-        if status in (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED) \
-                and self.services is not None:
-            self.services.stop()
+        if status in (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED):
+            if self.services is not None:
+                self.services.stop()
+            # csi_hook Postrun: release this alloc's volume mounts —
+            # but only once EVERY task has exited. A failed sibling
+            # flips aggregate status to FAILED while other tasks still
+            # run; unmounting then would yank the volume out from
+            # under them (the reference's Postrun runs after all task
+            # runners exit).
+            if all(ts.state == TASK_STATE_DEAD
+                   for ts in states.values()):
+                self._unmount_volumes()
         self._push()
 
     def _push(self) -> None:
@@ -648,11 +762,30 @@ class Client:
         self.config = config or ClientConfig()
         from .vaultclient import VaultTokenRenewer
         self.vault_renewer = VaultTokenRenewer(self.transport)
+        # CSI plugins behind the process boundary + the stage/publish
+        # manager (client/pluginmanager/csimanager)
+        self.csi_manager = None
+        if self.config.csi_plugins:
+            from ..plugins.csi_client import ExternalCSIPlugin
+            from .csimanager import CSIManager
+            import tempfile
+            self.csi_manager = CSIManager(
+                node_id="", mount_root=self.config.alloc_dir
+                or os.path.join(tempfile.gettempdir(), "nomad-tpu"))
+            for pid in self.config.csi_plugins:
+                self.csi_manager.register_plugin(
+                    pid, ExternalCSIPlugin(pid))
         self.state_db = None
         if self.config.state_dir:
             from .state_db import ClientStateDB
             self.state_db = ClientStateDB(self.config.state_dir)
         self.node = self._fingerprint()
+        if self.csi_manager is not None:
+            # advertise healthy CSI plugins as node attributes
+            # (csimanager instance fingerprint -> CSIVolumeChecker)
+            self.csi_manager.node_id = self.node.id
+            self.node.attributes.update(
+                self.csi_manager.fingerprint_attrs())
         self.drivers = {}
         for name in self.config.drivers:
             if name in self.config.plugin_drivers:
@@ -861,6 +994,8 @@ class Client:
         tasks running and re-attaches after restart)."""
         self._stop.set()
         self.vault_renewer.stop()
+        if self.csi_manager is not None:
+            self.csi_manager.shutdown()
         if kill_tasks:
             # copy: the alloc-watch thread may still mutate the dict
             # until it observes _stop

@@ -1,0 +1,125 @@
+"""Client-side CSI volume manager.
+
+Reference: client/pluginmanager/csimanager/volume.go — the volumeManager
+drives MountVolume (ControllerPublish → NodeStage once per volume per
+node → NodePublish per allocation) and UnmountVolume (NodeUnpublish per
+allocation → NodeUnstage when the node's last claim goes away), with
+usage tracked per (volume, alloc). Here the plugin lives behind the
+repo's plugin process boundary (plugins/csi_client.ExternalCSIPlugin)
+and staging is refcounted in-process.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+LOG = logging.getLogger("nomad_tpu.client.csi")
+
+
+class CSIManager:
+    def __init__(self, node_id: str, mount_root: str):
+        self.node_id = node_id
+        # <mount_root>/staging/<plugin>/<vol> and
+        # <mount_root>/per-alloc/<alloc>/<vol> (csimanager mountRoot)
+        self.mount_root = mount_root
+        self.plugins: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        # (plugin_id, volume_id) -> set of alloc ids staged against it
+        self._stage_users: Dict[Tuple[str, str], set] = {}
+        # per-volume locks held ACROSS the plugin RPC sequence: a
+        # last-user unstage racing a new first-user stage must not
+        # interleave, and a failed mount must not leave a phantom user
+        self._key_locks: Dict[Tuple[str, str], threading.Lock] = {}
+
+    def _key_lock(self, key: Tuple[str, str]) -> threading.Lock:
+        with self._lock:
+            lock = self._key_locks.get(key)
+            if lock is None:
+                lock = self._key_locks[key] = threading.Lock()
+            return lock
+
+    def register_plugin(self, plugin_id: str, plugin) -> None:
+        self.plugins[plugin_id] = plugin
+
+    def fingerprint_attrs(self) -> Dict[str, str]:
+        """Node attributes advertising healthy plugins
+        (client/pluginmanager/csimanager instanceManager fingerprint)."""
+        out = {}
+        for pid, p in self.plugins.items():
+            try:
+                if p.probe():
+                    out[f"csi.plugin.{pid}"] = "1"
+            except Exception:
+                LOG.warning("csi plugin %s probe failed", pid)
+        return out
+
+    def _staging_path(self, plugin_id: str, volume_id: str) -> str:
+        return os.path.join(self.mount_root, "csi", "staging",
+                            plugin_id, volume_id)
+
+    def _target_path(self, alloc_id: str, volume_id: str) -> str:
+        return os.path.join(self.mount_root, "csi", "per-alloc",
+                            alloc_id, volume_id)
+
+    def mount_volume(self, plugin_id: str, volume_id: str,
+                     alloc_id: str, readonly: bool) -> Optional[str]:
+        """MountVolume (volume.go:46): controller-publish + stage (first
+        user on this node) + publish. Returns the per-alloc source path
+        tasks mount from, or None if the plugin is unknown."""
+        plugin = self.plugins.get(plugin_id)
+        if plugin is None:
+            return None
+        staging = self._staging_path(plugin_id, volume_id)
+        target = self._target_path(alloc_id, volume_id)
+        key = (plugin_id, volume_id)
+        with self._key_lock(key):
+            users = self._stage_users.setdefault(key, set())
+            plugin.controller_publish(volume_id, self.node_id)
+            if not users:
+                plugin.node_stage(volume_id, staging)
+            plugin.node_publish(volume_id, staging, target, readonly)
+            # the alloc becomes a stage user only once the full mount
+            # sequence succeeded — a failed RPC above must not leave a
+            # phantom user that suppresses re-stage/unstage
+            users.add(alloc_id)
+        return target
+
+    def unmount_volume(self, plugin_id: str, volume_id: str,
+                       alloc_id: str) -> None:
+        """UnmountVolume (volume.go:239): unpublish this alloc's target;
+        unstage + controller-unpublish when the node's last user left."""
+        plugin = self.plugins.get(plugin_id)
+        if plugin is None:
+            return
+        target = self._target_path(alloc_id, volume_id)
+        key = (plugin_id, volume_id)
+        with self._key_lock(key):
+            users = self._stage_users.get(key)
+            if users is None or alloc_id not in users:
+                return      # never mounted (or already unmounted)
+            try:
+                plugin.node_unpublish(volume_id, target)
+            except Exception:
+                LOG.exception("NodeUnpublishVolume failed for %s",
+                              volume_id)
+            users.discard(alloc_id)
+            if not users:
+                self._stage_users.pop(key, None)
+                try:
+                    plugin.node_unstage(
+                        volume_id,
+                        self._staging_path(plugin_id, volume_id))
+                    plugin.controller_unpublish(volume_id, self.node_id)
+                except Exception:
+                    LOG.exception("NodeUnstageVolume failed for %s",
+                                  volume_id)
+
+    def shutdown(self) -> None:
+        for p in self.plugins.values():
+            try:
+                p.shutdown()
+            except Exception:
+                pass

@@ -1221,6 +1221,16 @@ class SelectKernel:
             "NOMAD_TPU_SELECT_BACKEND", "auto")
         self._mesh_tried = False
         self._sharded = None
+        # cross-worker decorrelation (lane, lanes): concurrent workers
+        # running exact-greedy argmax over the SAME table pick the SAME
+        # winners and collide in the plan applier. When set (by the
+        # scheduling worker), large batch selects restrict themselves
+        # to a hash-partitioned slice of the feasible set — the
+        # columnar analog of the reference's per-eval node shuffle
+        # (stack.go:70-90) — retrying on the full set if the slice
+        # can't hold the ask.
+        self.decorrelate = None
+        self._decor_cache = (None, None)
 
     def _mesh_sharded(self):
         """The production multi-chip path (SURVEY §2.6: shard the node
@@ -1282,6 +1292,51 @@ class SelectKernel:
 
     # -- entry ---------------------------------------------------------
     def select(self, req: SelectRequest) -> SelectResult:
+        original = self._decorrelate_mask(req)
+        res = self._select(req)
+        if original is not None and res.placed < req.count:
+            # the slice couldn't hold the ask: decorrelation is a
+            # throughput heuristic and must never change failure
+            # semantics — retry on the full node set
+            req.feasible = original
+            res = self._select(req)
+        return res
+
+    def _decorrelate_mask(self, req: SelectRequest):
+        """Restrict a large batch select to this worker's hash slice of
+        the feasible set when the slice's aggregate headroom still
+        covers ~2x the ask. Returns the original feasible mask (caller
+        restores it on shortfall) or None when untouched."""
+        dec = self.decorrelate
+        if dec is None:
+            return None
+        lane, lanes = dec
+        if lanes <= 1 or req.count < 256:
+            return None
+        feas = req.feasible
+        n = len(feas)
+        cache_key, lane_ids = self._decor_cache
+        if cache_key != (n, lanes):
+            mix = (np.arange(n, dtype=np.uint64)
+                   * np.uint64(2654435761)) & np.uint64(0xffffffff)
+            lane_ids = ((mix >> np.uint64(7)) % np.uint64(lanes)) \
+                .astype(np.int32)
+            self._decor_cache = ((n, lanes), lane_ids)
+        slice_mask = feas & (lane_ids == (lane % lanes))
+        # capacity-aware headroom: per-node placements possible under
+        # the ask, summed over the slice
+        free = req.capacity - req.used
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(req.ask[None, :] > 0,
+                           free / np.maximum(req.ask[None, :], 1e-9),
+                           np.inf).min(axis=1)
+        headroom = float(np.floor(per[slice_mask]).clip(min=0).sum())
+        if headroom < 2.0 * req.count:
+            return None
+        req.feasible = slice_mask
+        return feas
+
+    def _select(self, req: SelectRequest) -> SelectResult:
         sharded = self._mesh_sharded()
         if sharded is not None:
             chunk_ok = (not req.spreads and not req.distinct_props

@@ -571,21 +571,27 @@ class NodeTableCache:
         self._table: Optional[NodeTable] = None
         self._index = -1
 
-    def get(self, snapshot) -> NodeTable:
+    def get(self, snapshot, build: bool = True) -> Optional[NodeTable]:
         store = snapshot._store
         target = snapshot.latest_index()
         with self._lock:
             if self._table is not None and self._index == target:
                 return self._table
             if self._table is not None and target < self._index:
-                # older snapshot than the cache: serve it a private build
-                return NodeTable.build_all(snapshot)
+                # older snapshot than the cache: serve it a private
+                # build — or nothing, for callers that would rather
+                # fall back than pay a full build
+                return NodeTable.build_all(snapshot) if build else None
             if self._table is None:
+                if not build:
+                    return None
                 self._table = NodeTable.build_all(snapshot)
                 self._index = target
                 return self._table
             changes = store.changes_since(self._index, target)
             if changes is None or any(k == "node" for k, _ in changes):
+                if not build:
+                    return None
                 self._table = NodeTable.build_all(snapshot)
                 self._index = target
                 return self._table

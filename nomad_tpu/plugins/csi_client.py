@@ -1,0 +1,266 @@
+"""CSI plugins behind the process boundary.
+
+Reference: plugins/csi/client.go — Nomad speaks the CSI gRPC spec
+(Identity/Controller/Node services) to external storage plugin
+processes; client/pluginmanager/csimanager/volume.go drives the
+stage → publish mount lifecycle per volume per node. Here the same
+verb surface rides the repo's plugin RPC boundary (plugins/base.py
+handshake + msgpack framing), and the built-in `hostpath` plugin is
+the in-tree reference implementation (the analog of
+kubernetes-csi/csi-driver-host-path): volumes are directories under a
+configurable root, staging records the volume on the node, publishing
+materializes the per-alloc target path.
+
+Verbs (csi spec names, client.go:
+  CSI.Probe                 -> {ready}
+  CSI.PluginInfo            -> {name, version}
+  CSI.ControllerPublishVolume / ControllerUnpublishVolume
+  CSI.NodeStageVolume   {volume_id, staging_path}
+  CSI.NodeUnstageVolume {volume_id, staging_path}
+  CSI.NodePublishVolume {volume_id, staging_path, target_path, readonly}
+  CSI.NodeUnpublishVolume {volume_id, target_path}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..rpc.client import RpcClient, RpcError
+from .base import (HANDSHAKE_COOKIE_KEY, HANDSHAKE_COOKIE_VALUE,
+                   HANDSHAKE_PREFIX)
+
+LOG = logging.getLogger("nomad_tpu.plugins.csi")
+
+
+class HostPathCSIPlugin:
+    """In-proc implementation served by the plugin process: a hostpath
+    storage backend. Every call is journaled to `NOMAD_TPU_CSI_JOURNAL`
+    (JSONL) when set, so tests and `operator debug` can audit the exact
+    RPC sequence the lifecycle produced."""
+
+    name = "hostpath"
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or os.environ.get(
+            "NOMAD_TPU_CSI_ROOT", "/tmp/nomad-tpu-csi")
+        self.journal = os.environ.get("NOMAD_TPU_CSI_JOURNAL", "")
+
+    def _log(self, verb: str, args: Dict) -> None:
+        if not self.journal:
+            return
+        try:
+            with open(self.journal, "a") as f:
+                f.write(json.dumps({"verb": verb, **args}) + "\n")
+        except OSError:
+            pass
+
+    def _vol_dir(self, volume_id: str) -> str:
+        d = os.path.join(self.root, volume_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # -- identity ------------------------------------------------------
+    def probe(self) -> bool:
+        return True
+
+    def plugin_info(self) -> Dict:
+        return {"name": "hostpath.csi.nomad-tpu", "version": "1.0"}
+
+    # -- controller ----------------------------------------------------
+    def controller_publish(self, volume_id: str, node_id: str) -> Dict:
+        """Attach the volume to a node (no-op for hostpath; returns the
+        publish context the node calls receive, client.go
+        ControllerPublishVolume)."""
+        self._log("ControllerPublishVolume",
+                  {"volume_id": volume_id, "node_id": node_id})
+        return {"publish_context": {"path": self._vol_dir(volume_id)}}
+
+    def controller_unpublish(self, volume_id: str, node_id: str) -> None:
+        self._log("ControllerUnpublishVolume",
+                  {"volume_id": volume_id, "node_id": node_id})
+
+    # -- node ----------------------------------------------------------
+    def node_stage(self, volume_id: str, staging_path: str) -> None:
+        """Make the volume available at the node-wide staging path
+        (volume.go stageVolume). For hostpath: a symlink to the backing
+        directory."""
+        self._log("NodeStageVolume",
+                  {"volume_id": volume_id, "staging_path": staging_path})
+        os.makedirs(os.path.dirname(staging_path), exist_ok=True)
+        src = self._vol_dir(volume_id)
+        if not os.path.islink(staging_path):
+            try:
+                os.symlink(src, staging_path)
+            except FileExistsError:
+                pass
+
+    def node_unstage(self, volume_id: str, staging_path: str) -> None:
+        self._log("NodeUnstageVolume",
+                  {"volume_id": volume_id, "staging_path": staging_path})
+        try:
+            os.unlink(staging_path)
+        except OSError:
+            pass
+
+    def node_publish(self, volume_id: str, staging_path: str,
+                     target_path: str, readonly: bool) -> None:
+        """Expose the staged volume at the per-alloc target path
+        (volume.go publishVolume)."""
+        self._log("NodePublishVolume",
+                  {"volume_id": volume_id, "staging_path": staging_path,
+                   "target_path": target_path, "readonly": readonly})
+        os.makedirs(os.path.dirname(target_path), exist_ok=True)
+        src = os.path.realpath(staging_path) if os.path.exists(
+            staging_path) else self._vol_dir(volume_id)
+        if not os.path.islink(target_path):
+            try:
+                os.symlink(src, target_path)
+            except FileExistsError:
+                pass
+
+    def node_unpublish(self, volume_id: str, target_path: str) -> None:
+        self._log("NodeUnpublishVolume",
+                  {"volume_id": volume_id, "target_path": target_path})
+        try:
+            os.unlink(target_path)
+        except OSError:
+            pass
+
+
+CSI_PLUGIN_CATALOG = {
+    "hostpath": HostPathCSIPlugin,
+}
+
+
+def build_csi_methods(plugin) -> Dict:
+    """RPC method table for a CSI plugin process."""
+    return {
+        "CSI.Probe": lambda _a: {"ready": bool(plugin.probe())},
+        "CSI.PluginInfo": lambda _a: plugin.plugin_info(),
+        "CSI.ControllerPublishVolume": lambda a: plugin.controller_publish(
+            a["volume_id"], a.get("node_id", "")),
+        "CSI.ControllerUnpublishVolume": lambda a: (
+            plugin.controller_unpublish(a["volume_id"],
+                                        a.get("node_id", "")) or {}),
+        "CSI.NodeStageVolume": lambda a: (
+            plugin.node_stage(a["volume_id"], a["staging_path"]) or {}),
+        "CSI.NodeUnstageVolume": lambda a: (
+            plugin.node_unstage(a["volume_id"], a["staging_path"]) or {}),
+        "CSI.NodePublishVolume": lambda a: (
+            plugin.node_publish(a["volume_id"], a["staging_path"],
+                                a["target_path"],
+                                bool(a.get("readonly"))) or {}),
+        "CSI.NodeUnpublishVolume": lambda a: (
+            plugin.node_unpublish(a["volume_id"], a["target_path"]) or {}),
+    }
+
+
+class ExternalCSIPlugin:
+    """Host side: launch + supervise one CSI plugin process and proxy
+    the verb surface (the csimanager's plugin client role)."""
+
+    def __init__(self, plugin_name: str = "hostpath",
+                 python: str = sys.executable,
+                 env_extra: Optional[Dict[str, str]] = None):
+        self.name = plugin_name
+        self.python = python
+        self.env_extra = dict(env_extra or {})
+        self._lock = threading.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+        self._rpc: Optional[RpcClient] = None
+
+    def _ensure_running(self) -> RpcClient:
+        with self._lock:
+            if self._rpc is not None and self._proc is not None \
+                    and self._proc.poll() is None:
+                return self._rpc
+            if self._proc is not None:
+                LOG.warning("csi plugin %s died (rc=%s); relaunching",
+                            self.name, self._proc.poll())
+            env = dict(os.environ)
+            env[HANDSHAKE_COOKIE_KEY] = HANDSHAKE_COOKIE_VALUE
+            env.update(self.env_extra)
+            self._proc = subprocess.Popen(
+                [self.python, "-m", "nomad_tpu.plugins.launcher",
+                 "--csi", self.name],
+                env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True,
+                cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))))
+            line = self._proc.stdout.readline().strip()
+            if not line.startswith(HANDSHAKE_PREFIX):
+                self._proc.kill()
+                self._proc.wait()
+                self._proc = None
+                raise RuntimeError(
+                    f"csi plugin {self.name} bad handshake: {line!r}")
+            self._rpc = RpcClient(line[len(HANDSHAKE_PREFIX):])
+            return self._rpc
+
+    def call(self, method: str, args: dict, timeout_s: float = 30.0):
+        try:
+            return self._ensure_running().call(method, args,
+                                               timeout_s=timeout_s)
+        except RpcError:
+            time.sleep(0.1)
+            with self._lock:
+                if self._proc is not None and \
+                        self._proc.poll() is not None and \
+                        self._rpc is not None:
+                    self._rpc.close()
+                    self._rpc = None
+            return self._ensure_running().call(method, args,
+                                               timeout_s=timeout_s)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._rpc is not None:
+                self._rpc.close()
+                self._rpc = None
+            if self._proc is not None and self._proc.poll() is None:
+                self._proc.terminate()
+                try:
+                    self._proc.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    self._proc.kill()
+            self._proc = None
+
+    # -- verb surface ---------------------------------------------------
+    def probe(self) -> bool:
+        return bool(self.call("CSI.Probe", {}).get("ready"))
+
+    def plugin_info(self) -> Dict:
+        return self.call("CSI.PluginInfo", {})
+
+    def controller_publish(self, volume_id: str, node_id: str) -> Dict:
+        return self.call("CSI.ControllerPublishVolume",
+                         {"volume_id": volume_id, "node_id": node_id})
+
+    def controller_unpublish(self, volume_id: str, node_id: str) -> None:
+        self.call("CSI.ControllerUnpublishVolume",
+                  {"volume_id": volume_id, "node_id": node_id})
+
+    def node_stage(self, volume_id: str, staging_path: str) -> None:
+        self.call("CSI.NodeStageVolume",
+                  {"volume_id": volume_id, "staging_path": staging_path})
+
+    def node_unstage(self, volume_id: str, staging_path: str) -> None:
+        self.call("CSI.NodeUnstageVolume",
+                  {"volume_id": volume_id, "staging_path": staging_path})
+
+    def node_publish(self, volume_id: str, staging_path: str,
+                     target_path: str, readonly: bool) -> None:
+        self.call("CSI.NodePublishVolume",
+                  {"volume_id": volume_id, "staging_path": staging_path,
+                   "target_path": target_path, "readonly": readonly})
+
+    def node_unpublish(self, volume_id: str, target_path: str) -> None:
+        self.call("CSI.NodeUnpublishVolume",
+                  {"volume_id": volume_id, "target_path": target_path})

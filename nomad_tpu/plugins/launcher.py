@@ -21,6 +21,15 @@ def main() -> int:
         plugin = DEVICE_PLUGIN_CATALOG[args[1]]()
         serve_plugin(plugin, methods=build_device_methods(plugin))
         return 0
+    if len(args) == 2 and args[0] == "--csi":
+        from .csi_client import CSI_PLUGIN_CATALOG, build_csi_methods
+        if args[1] not in CSI_PLUGIN_CATALOG:
+            print(f"usage: launcher --csi "
+                  f"<{'|'.join(CSI_PLUGIN_CATALOG)}>", file=sys.stderr)
+            return 1
+        plugin = CSI_PLUGIN_CATALOG[args[1]]()
+        serve_plugin(plugin, methods=build_csi_methods(plugin))
+        return 0
     if len(args) != 1 or args[0] not in DRIVER_CATALOG:
         print(f"usage: launcher <{'|'.join(DRIVER_CATALOG)}> | "
               f"--device <plugin>", file=sys.stderr)

@@ -88,6 +88,11 @@ def build_method_table(server) -> Dict[str, Any]:
         from .transport import _alloc_with_node
         return _alloc_with_node(server, args["alloc_id"])
 
+    def csi_volume_get(args):
+        v = server.store.csi_volume(args.get("namespace", "default"),
+                                    args["volume_id"])
+        return {"volume": v.stub() if v is not None else None}
+
     def service_update(args):
         from ..models.services import ServiceRegistration
         upserts = [from_wire(ServiceRegistration, s)
@@ -112,6 +117,7 @@ def build_method_table(server) -> Dict[str, Any]:
         "Server.Members": server_members,
         "Alloc.GetAlloc": alloc_get,
         "Service.Update": service_update,
+        "CSIVolume.Get": csi_volume_get,
     }
 
 

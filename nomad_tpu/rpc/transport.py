@@ -50,6 +50,11 @@ class ServerTransport:
         (the reference's Consul sync, command/agent/consul)."""
         raise NotImplementedError
 
+    def get_csi_volume(self, namespace: str, volume_id: str):
+        """Volume record stub (plugin_id + modes) for the client's CSI
+        mount hook (csi_endpoint.go CSIVolume.Get)."""
+        raise NotImplementedError
+
 
 def _alloc_with_node(server, alloc_id: str):
     """{alloc: wire, node_rpc: addr} or None — the alloc-watcher's
@@ -109,6 +114,10 @@ class InProcTransport(ServerTransport):
     def get_alloc(self, alloc_id: str):
         return _alloc_with_node(self.server, alloc_id)
 
+    def get_csi_volume(self, namespace: str, volume_id: str):
+        v = self.server.store.csi_volume(namespace, volume_id)
+        return v.stub() if v is not None else None
+
 
 class RemoteTransport(ServerTransport):
     def __init__(self, addr: str):
@@ -166,3 +175,9 @@ class RemoteTransport(ServerTransport):
         """Status + owning-node info of any alloc (the alloc-watcher's
         predecessor probe, client/allocwatcher)."""
         return self.rpc.call("Alloc.GetAlloc", {"alloc_id": alloc_id})
+
+    def get_csi_volume(self, namespace: str, volume_id: str):
+        res = self.rpc.call("CSIVolume.Get",
+                            {"namespace": namespace,
+                             "volume_id": volume_id})
+        return res.get("volume")

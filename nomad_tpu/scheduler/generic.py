@@ -66,6 +66,9 @@ class GenericScheduler:
         # set by the batched worker: routes kernel dispatches through
         # the multi-eval gateway (one select_many per lane barrier)
         self.kernel_dispatch = None
+        # set by concurrent workers: (lane, lanes) hash-slice
+        # decorrelation for big batch selects (SelectKernel.decorrelate)
+        self.kernel_decorrelate = None
 
     # -- entry ---------------------------------------------------------
     def process(self, evaluation: Evaluation) -> None:
@@ -126,6 +129,8 @@ class GenericScheduler:
         self.engine = PlacementEngine(snapshot)
         if self.kernel_dispatch is not None:
             self.engine.dispatch = self.kernel_dispatch
+        if self.kernel_decorrelate is not None:
+            self.engine.kernel.decorrelate = self.kernel_decorrelate
         if self.job is not None:
             self.engine.set_job(self.job)
             self.ctx.eligibility.set_job(self.job)

@@ -316,11 +316,29 @@ class PlacementEngine:
             before = int(mask.sum())
             if vol is None or not vol.claimable(bool(req.read_only)):
                 mask[:] = False
-            elif vol.topology_node_ids:
-                topo = set(vol.topology_node_ids)
-                topo_mask = np.fromiter((nid in topo for nid in t.ids),
-                                        dtype=bool, count=t.n)
-                mask &= topo_mask
+            else:
+                if vol.topology_node_ids:
+                    topo = set(vol.topology_node_ids)
+                    topo_mask = np.fromiter(
+                        (nid in topo for nid in t.ids),
+                        dtype=bool, count=t.n)
+                    mask &= topo_mask
+                # the node must run the volume's plugin (fingerprinted
+                # as csi.plugin.<id> by the client's csimanager;
+                # feasible.go CSIVolumeChecker requires a healthy node
+                # plugin) — without this, CSI workloads land on
+                # plugin-less nodes and fail at mount time. The mask
+                # depends only on node attributes, so it caches per
+                # table version like the other static columns.
+                attr = f"csi.plugin.{vol.plugin_id}"
+                cache_key = ("csi_plugin_attr", attr)
+                plug_mask = t.mask_cache.get(cache_key)
+                if plug_mask is None:
+                    plug_mask = np.fromiter(
+                        (n.attributes.get(attr) is not None
+                         for n in t.nodes), dtype=bool, count=t.n)
+                    t.mask_cache[cache_key] = plug_mask
+                mask &= plug_mask
             newly = before - int(mask.sum())
             if newly:
                 filtered_counts[f"missing CSI Volume {req.source}"] = \

@@ -307,6 +307,13 @@ class Worker:
                 if dispatch is not None and \
                         hasattr(sched, "kernel_dispatch"):
                     sched.kernel_dispatch = dispatch
+                # cross-worker decorrelation: concurrent workers must
+                # not all argmax onto the same winners (ops/select.py
+                # SelectKernel.decorrelate; propagated onto the
+                # engine's kernel by _process_once)
+                n_workers = len(getattr(self.server, "workers", []) or [])
+                if n_workers > 1:
+                    sched.kernel_decorrelate = (self.id, n_workers)
             t0 = time.monotonic()
             sched.process(ev)
             metrics.measure_since(

@@ -145,15 +145,18 @@ class StateSnapshot:
         self._root = root
         self._store = store
 
-    def node_table(self):
+    def node_table(self, build: bool = True):
         """The columnar node table for this snapshot. Snapshots taken
         from a live store share its resident delta-maintained table
         (ops/tables.py NodeTableCache — SURVEY §7.2 step 8: no per-eval
-        rebuild); detached snapshots build fresh."""
+        rebuild); detached snapshots build fresh. `build=False` returns
+        None instead of paying a full private build when the resident
+        table has already advanced past this snapshot (callers with a
+        cheap fallback, e.g. the plan applier's scalar verify)."""
         from ..ops.tables import NodeTable
         if self._store is None:
-            return NodeTable.build_all(self)
-        return self._store.table_cache.get(self)
+            return NodeTable.build_all(self) if build else None
+        return self._store.table_cache.get(self, build=build)
 
     # -- index bookkeeping --------------------------------------------
     def index(self, table: str) -> int:

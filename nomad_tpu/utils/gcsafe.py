@@ -28,10 +28,18 @@ _lock = threading.Lock()
 _participants = 0
 _was_enabled = True
 _last_collect = 0.0
+_last_full_collect = 0.0
 
 # floor between coordinated young-gen collects; more frequent adds no
 # latency benefit and multiplies GIL stalls across workers
 MIN_COLLECT_INTERVAL_S = 0.05
+
+# gen-2 budget: a FULL collection runs at a safepoint at least this
+# often, so unreachable cycles can't accumulate for the lifetime of
+# the regime (the young-gen-only policy deferred gen-2 indefinitely
+# while workers were busy). After freeze_steady_state() the full pass
+# skips the frozen substrate, so it stays cheap even at C2M scale.
+FULL_COLLECT_INTERVAL_S = 10.0
 
 
 def enter() -> None:
@@ -56,10 +64,12 @@ def exit_() -> None:
 
 
 def safepoint() -> None:
-    """Young-generation collect at a safe point — at most one
-    collector at a time, rate-limited process-wide. Callers that lose
-    the race simply skip (a sibling just collected)."""
-    global _last_collect
+    """Collect at a safe point — at most one collector at a time,
+    rate-limited process-wide. Young generations collect on the fast
+    cadence; a FULL collection runs on the FULL_COLLECT_INTERVAL_S
+    budget so gen-2 garbage stays bounded over long runs. Callers that
+    lose the race simply skip (a sibling just collected)."""
+    global _last_collect, _last_full_collect
     now = time.monotonic()
     if now - _last_collect < MIN_COLLECT_INTERVAL_S:
         return
@@ -69,9 +79,31 @@ def safepoint() -> None:
         if now - _last_collect < MIN_COLLECT_INTERVAL_S:
             return
         _last_collect = now
-        gc.collect(1)
+        if now - _last_full_collect >= FULL_COLLECT_INTERVAL_S:
+            _last_full_collect = now
+            gc.collect()
+        else:
+            gc.collect(1)
     finally:
         _lock.release()
+
+
+def unfreeze_steady_state() -> None:
+    """Return the frozen substrate to the collectable heap (gc.unfreeze)
+    — pair with freeze_steady_state when the substrate's lifetime ends
+    (e.g. a benchmark tearing down its server)."""
+    gc.unfreeze()
+
+
+def freeze_steady_state() -> None:
+    """Move the current live heap to the permanent generation
+    (gc.freeze) after reclaiming what's already dead. For a process
+    whose resident state is large and long-lived (a C2M server: 2M
+    alloc objects), this takes the substrate out of every future
+    collection — the gen-2 budget above then costs microseconds, not
+    seconds. Call once the steady-state substrate is loaded."""
+    gc.collect()
+    gc.freeze()
 
 
 class safepoints:

@@ -71,10 +71,19 @@ def test_claim_capacity_rules():
     assert not unsched.claimable(read_only=True)
 
 
+
+def _csi_node():
+    """A node advertising the p1 CSI plugin (the scheduler requires the
+    volume's plugin on the node — feasible.go CSIVolumeChecker)."""
+    n = mock.node()
+    n.attributes["csi.plugin.p1"] = "1"
+    n.compute_class()
+    return n
+
 # -- scheduling --------------------------------------------------------
 def test_csi_feasibility_and_claim_on_placement():
     h = Harness()
-    n = mock.node()
+    n = _csi_node()
     h.store.upsert_node(h.next_index(), n)
 
     # no volume registered: placement fails with the CSI reason
@@ -112,7 +121,7 @@ def test_single_writer_enforced_per_placement_within_batch():
     is per-claim, not per-plan)."""
     h = Harness()
     for _ in range(3):
-        h.store.upsert_node(h.next_index(), mock.node())
+        h.store.upsert_node(h.next_index(), _csi_node())
     vol = CSIVolume(id="solo-vol", plugin_id="p1",
                     access_mode=ACCESS_SINGLE_NODE_WRITER)
     h.store.upsert_csi_volumes(h.next_index(), [vol])
@@ -138,7 +147,7 @@ def test_reads_never_claim_limited():
 
 def test_csi_topology_restricts_nodes():
     h = Harness()
-    n1, n2 = mock.node(), mock.node()
+    n1, n2 = _csi_node(), _csi_node()
     h.store.upsert_node(h.next_index(), n1)
     h.store.upsert_node(h.next_index(), n2)
     vol = CSIVolume(id="topo-vol", plugin_id="p1",
@@ -158,10 +167,11 @@ def test_volume_watcher_releases_terminal_claims():
     from nomad_tpu.client import Client, ClientConfig
     server = Server(ServerConfig(num_schedulers=2, heartbeat_ttl_s=30.0))
     server.start()
-    client = Client(server, ClientConfig(node_name="csi-client"))
+    client = Client(server, ClientConfig(node_name="csi-client",
+                                         csi_plugins=("hostpath",)))
     client.start()
     try:
-        vol = CSIVolume(id="batch-vol", plugin_id="p1",
+        vol = CSIVolume(id="batch-vol", plugin_id="hostpath",
                         access_mode=ACCESS_SINGLE_NODE_WRITER)
         server.register_csi_volume(vol)
         job = _csi_job("batch-vol", name="csi-batch")

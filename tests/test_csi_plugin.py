@@ -1,0 +1,180 @@
+"""Out-of-proc CSI plugin + mount lifecycle e2e.
+
+Reference: plugins/csi/client.go (the CSI RPC surface),
+client/pluginmanager/csimanager/volume.go:46 (MountVolume: stage once
+per volume per node, publish per alloc; UnmountVolume: unpublish, then
+unstage when the last claim leaves), allocrunner/csi_hook.go.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.models import ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_RUNNING
+from nomad_tpu.models.csi import ACCESS_MULTI_NODE_MULTI_WRITER, CSIVolume
+from nomad_tpu.models.job import VolumeMount, VolumeRequest
+
+
+def _journal(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _wait_for(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def csi_cluster(tmp_path, monkeypatch):
+    journal = str(tmp_path / "csi-journal.jsonl")
+    monkeypatch.setenv("NOMAD_TPU_CSI_JOURNAL", journal)
+    monkeypatch.setenv("NOMAD_TPU_CSI_ROOT", str(tmp_path / "csi-root"))
+    from nomad_tpu.client import Client, ClientConfig
+    from nomad_tpu.server import Server, ServerConfig
+    server = Server(ServerConfig(num_schedulers=1, heartbeat_ttl_s=30.0))
+    server.start()
+    client = Client(server, ClientConfig(
+        node_name="csi-node", alloc_dir=str(tmp_path / "allocs"),
+        csi_plugins=("hostpath",)))
+    client.start()
+    yield server, client, journal, tmp_path
+    client.shutdown()
+    server.shutdown()
+
+
+def _csi_job(source, run_for="3s", mount_dest="/data"):
+    job = mock.batch_job()
+    job.id = f"csij-{source}"
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.config = {"run_for": run_for}
+    tg.volumes = {"vol": VolumeRequest(name="vol", type="csi",
+                                       source=source)}
+    task.volume_mounts = [VolumeMount(volume="vol",
+                                      destination=mount_dest)]
+    job.canonicalize()
+    return job
+
+
+def test_csi_mount_lifecycle_e2e(csi_cluster):
+    """register volume -> place job -> plugin records
+    ControllerPublish/NodeStage/NodePublish -> alloc finishes ->
+    NodeUnpublish (+ NodeUnstage as the last user) -> volume watcher
+    releases the claim."""
+    server, client, journal, tmp = csi_cluster
+    assert client.node.attributes.get("csi.plugin.hostpath") == "1", \
+        "healthy plugin must be fingerprinted"
+
+    server.register_csi_volume(CSIVolume(
+        id="data-vol", namespace="default", name="data",
+        plugin_id="hostpath"))
+    job = _csi_job("data-vol", run_for="2s")
+    server.register_job(job)
+
+    assert _wait_for(lambda: any(
+        e["verb"] == "NodePublishVolume" for e in _journal(journal)))
+    verbs = [e["verb"] for e in _journal(journal)]
+    assert "ControllerPublishVolume" in verbs
+    assert verbs.index("NodeStageVolume") < verbs.index(
+        "NodePublishVolume")
+
+    # the task's driver ctx received the mount; the publish target
+    # symlink exists and points into the plugin's backing root
+    alloc = server.store.allocs_by_job("default", job.id)[0]
+    runner = client.runners[alloc.id]
+    target = runner.volume_sources["vol"]
+    assert os.path.islink(target)
+    assert os.path.realpath(target).startswith(
+        os.path.realpath(str(tmp / "csi-root")))
+    # claim landed on the volume at plan apply
+    v = server.store.csi_volume("default", "data-vol")
+    assert alloc.id in v.write_allocs
+
+    # batch task completes -> unpublish + unstage; watcher releases
+    assert _wait_for(lambda: all(
+        a.client_status == ALLOC_CLIENT_COMPLETE
+        for a in server.store.allocs_by_job("default", job.id)))
+    assert _wait_for(lambda: any(
+        e["verb"] == "NodeUnpublishVolume" for e in _journal(journal)))
+    assert _wait_for(lambda: any(
+        e["verb"] == "NodeUnstageVolume" for e in _journal(journal)))
+    assert _wait_for(lambda: not server.store.csi_volume(
+        "default", "data-vol").write_allocs, timeout=10)
+
+
+def test_csi_stage_refcount_across_allocs(csi_cluster):
+    """Two allocs of a multi-writer volume on one node: stage happens
+    once, publish twice; unstage only after BOTH allocs are gone
+    (volume.go usage tracking)."""
+    server, client, journal, tmp = csi_cluster
+    server.register_csi_volume(CSIVolume(
+        id="shared-vol", namespace="default", name="shared",
+        plugin_id="hostpath",
+        access_mode=ACCESS_MULTI_NODE_MULTI_WRITER))
+    job = _csi_job("shared-vol", run_for="2s")
+    job.task_groups[0].count = 2
+    job.canonicalize()
+    server.register_job(job)
+
+    assert _wait_for(lambda: len([
+        e for e in _journal(journal)
+        if e["verb"] == "NodePublishVolume"]) == 2)
+    stages = [e for e in _journal(journal)
+              if e["verb"] == "NodeStageVolume"]
+    assert len(stages) == 1, "stage must happen once per volume per node"
+
+    assert _wait_for(lambda: all(
+        a.client_status == ALLOC_CLIENT_COMPLETE
+        for a in server.store.allocs_by_job("default", job.id)))
+    assert _wait_for(lambda: len([
+        e for e in _journal(journal)
+        if e["verb"] == "NodeUnpublishVolume"]) == 2)
+    assert _wait_for(lambda: len([
+        e for e in _journal(journal)
+        if e["verb"] == "NodeUnstageVolume"]) == 1)
+
+
+def test_csi_plugin_process_restart_recovers(csi_cluster):
+    """The supervised plugin process is relaunched after a crash and
+    keeps serving (ExternalCSIPlugin relaunch-on-RpcError)."""
+    server, client, journal, tmp = csi_cluster
+    plugin = client.csi_manager.plugins["hostpath"]
+    assert plugin.probe()
+    proc = plugin._proc
+    assert proc is not None
+    proc.kill()
+    proc.wait()
+    assert plugin.probe(), "plugin must relaunch after dying"
+    assert plugin._proc.pid != proc.pid
+
+
+def test_volume_with_absent_plugin_filtered_at_scheduling(csi_cluster):
+    """A volume whose plugin no node runs never places: the scheduler's
+    CSI check requires csi.plugin.<id> on the node (feasible.go
+    CSIVolumeChecker requires a healthy node plugin), so the failure
+    surfaces as an eval filter reason, not a doomed alloc."""
+    server, client, journal, tmp = csi_cluster
+    server.register_csi_volume(CSIVolume(
+        id="ghost-vol", namespace="default", name="ghost",
+        plugin_id="no-such-plugin"))
+    job = _csi_job("ghost-vol", run_for="2s")
+    server.register_job(job)
+
+    tg_name = job.task_groups[0].name
+
+    def _filtered():
+        evs = server.store.evals_by_job("default", job.id)
+        return any(tg_name in (e.failed_tg_allocs or {}) for e in evs)
+    assert _wait_for(_filtered)
+    assert server.store.allocs_by_job("default", job.id) == []
