@@ -58,6 +58,11 @@ class TaskHandle:
         cg = getattr(self, "cgroup_name", None)
         if cg:
             out["cgroup"] = cg
+        ea = getattr(self, "executor_addr", None)
+        if ea:
+            out["executor_addr"] = ea
+            out["executor_pid"] = getattr(self, "executor_pid", None)
+            out["executor_auth"] = getattr(self, "executor_auth", "")
         cid = getattr(self, "container_id", None)
         if cid:
             out["container_id"] = cid
@@ -305,6 +310,50 @@ class ExecDriver(RawExecDriver):
         return {"driver.exec": "1",
                 "driver.exec.isolation": "cgroups" if isolated else "none"}
 
+    @staticmethod
+    def _spawn_executor():
+        """Launch the supervising executor process (executor_plugin.go
+        analog) in its own session and dial its RPC handshake. A
+        per-executor auth token (handed over via the root-only child
+        env) gates every RPC — the listener is a localhost socket and
+        Exec/State expose the task's env and isolation."""
+        import secrets as _secrets
+        import sys as _sys
+
+        from ..plugins.base import (HANDSHAKE_COOKIE_KEY,
+                                    HANDSHAKE_COOKIE_VALUE,
+                                    HANDSHAKE_PREFIX)
+        from ..rpc.client import RpcClient
+        repo_root = _os.path.dirname(_os.path.dirname(
+            _os.path.dirname(_os.path.abspath(__file__))))
+        token = _secrets.token_hex(16)
+        env = {"PYTHONPATH": repo_root,
+               "PATH": _os.environ.get("PATH", "/usr/bin:/bin"),
+               HANDSHAKE_COOKIE_KEY: HANDSHAKE_COOKIE_VALUE,
+               "NOMAD_TPU_EXECUTOR_TOKEN": token}
+        eproc = subprocess.Popen(
+            [_sys.executable, "-m", "nomad_tpu.client.executor_server"],
+            env=env, stdin=subprocess.DEVNULL, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True,
+            start_new_session=True)
+        import select as _select
+        ready, _w, _x = _select.select([eproc.stdout], [], [], 15.0)
+        line = eproc.stdout.readline().strip() if ready else ""
+        if not line.startswith(HANDSHAKE_PREFIX):
+            eproc.kill()
+            eproc.wait()
+            raise RuntimeError(f"executor bad handshake: {line!r}")
+        addr = line[len(HANDSHAKE_PREFIX):]
+        return eproc, RpcClient(addr), addr, token
+
+    @staticmethod
+    def _ecall(h: TaskHandle, method: str, args: dict,
+               timeout_s: float = 30.0):
+        """Executor RPC with the handle's auth token injected."""
+        return h.executor_rpc.call(
+            method, {**args, "auth": getattr(h, "executor_auth", "")},
+            timeout_s=timeout_s)
+
     def start_task(self, task_name: str, config: dict, env: dict,
                    ctx: Optional[dict] = None) -> TaskHandle:
         from .executor import IsolatedExecutor
@@ -322,92 +371,218 @@ class ExecDriver(RawExecDriver):
         chroot_dir = None
         if cwd and not config.get("no_chroot"):
             chroot_dir = cwd
-        executor = IsolatedExecutor(
-            cg_name,
-            cpu_shares=int(resources.get("cpu", 0)),
-            memory_mb=int(resources.get("memory_mb", 0)),
-            chroot_dir=chroot_dir)
-        log_dir = ctx.get("log_dir")
-        stdout = stderr = subprocess.DEVNULL
-        if log_dir:
-            stdout = stderr = subprocess.PIPE
-        # containment runs in a re-exec'd bootstrap (exec_helper), not
-        # a preexec_fn: forking the JAX-threaded client to run Python
-        # code risks deadlock in the child (the reference re-execs its
-        # own binary for the same reason, main.go:16). The spec travels
-        # over STDIN — it carries the task env (possibly VAULT_TOKEN),
-        # and argv is world-readable via /proc/*/cmdline
-        import json as _json
-        import sys as _sys
         # the jobspec `user` (Task.user / config user), defaulting to
         # an unprivileged account when the agent runs as root — an
         # isolated task must never silently inherit root
         # (drivers/shared/executor/executor.go user switch)
         run_as = config.get("user") or (ctx.get("user") or "") or "nobody"
-        spec = _json.dumps({
-            "procs_files": executor.procs_files,
+        # spec is fully built BEFORE the executor spawns: an exception
+        # here must not leak a detached executor process
+        spec = {
+            "cgroup": cg_name,
+            "cpu_shares": int(resources.get("cpu", 0)),
+            "memory_mb": int(resources.get("memory_mb", 0)),
             "chroot_dir": chroot_dir,
-            "chroot_dirs": list(executor.chroot_dirs),
             "command": command,
             "args": list(config.get("args", [])),
             "env": {**env} if env else {},
             "cwd": cwd,
             "user": run_as,
             "chown_dirs": [cwd] if cwd else [],
-        })
-        repo_root = _os.path.dirname(_os.path.dirname(
-            _os.path.dirname(_os.path.abspath(__file__))))
-        helper_env = {"PYTHONPATH": repo_root,
-                      "PATH": _os.environ.get("PATH", "/usr/bin:/bin")}
+            "bind_mounts": list(ctx.get("volume_mounts") or []),
+            "log_dir": ctx.get("log_dir"),
+            "task_name": task_name,
+            "log_max_files": int(ctx.get("log_max_files", 10)),
+            "log_max_file_size_mb": int(
+                ctx.get("log_max_file_size_mb", 10)),
+        }
+        # the OUT-OF-PROC executor owns cgroup + containment + logs
+        # (drivers/shared/executor/executor_plugin.go): the client
+        # holds only an RPC handle, so supervision and log rotation
+        # survive a client restart, and `alloc exec` can enter the
+        # task's isolation through Executor.Exec
         try:
-            proc = subprocess.Popen(
-                [_sys.executable, "-m", "nomad_tpu.client.exec_helper"],
-                env=helper_env, stdin=subprocess.PIPE,
-                stdout=stdout, stderr=stderr)
-            proc.stdin.write(spec.encode())
-            proc.stdin.close()
-        except (OSError, subprocess.SubprocessError) as e:
-            executor.destroy()
+            eproc, rpc, addr, token = self._spawn_executor()
+        except (OSError, subprocess.SubprocessError, RuntimeError) as e:
+            raise RuntimeError(f"failed to start executor: {e}")
+        try:
+            res = rpc.call("Executor.Launch",
+                           {"spec": spec, "auth": token},
+                           timeout_s=30.0)
+        except Exception as e:
+            try:
+                eproc.kill()
+            except OSError:
+                pass
+            try:
+                eproc.wait(timeout=5)
+            except Exception:
+                pass
+            # the executor may have created the cgroup (and even the
+            # task) before dying/timing out: reap it so the workload
+            # can't keep running unsupervised while the scheduler
+            # replaces it
+            IsolatedExecutor.recover(cg_name).destroy()
             raise RuntimeError(f"failed to exec {command}: {e}")
         h = TaskHandle(task_name=task_name, driver=self.name,
-                       config=config, proc=proc, started_at=time.time())
-        h.executor = executor
+                       config=config, proc=eproc,
+                       started_at=res.get("started_at") or time.time())
+        h.executor_rpc = rpc
+        h.executor_addr = addr
+        h.executor_auth = token
+        h.executor_pid = eproc.pid
+        h.task_pid = res.get("pid")
         h.cgroup_name = cg_name
-        if log_dir:
-            from .logmon import RotatingWriter, pump
-            max_files = int(ctx.get("log_max_files", 10))
-            max_mb = int(ctx.get("log_max_file_size_mb", 10))
-            pump(proc.stdout, RotatingWriter(
-                log_dir, f"{task_name}.stdout", max_files, max_mb))
-            pump(proc.stderr, RotatingWriter(
-                log_dir, f"{task_name}.stderr", max_files, max_mb))
-
-        def wait():
-            code = proc.wait()
-            h.exit_code = code
-            # an OOM kill surfaces as SIGKILL; annotate it so the task
-            # event says WHY (executor_linux.go wait -> OOMKilled)
-            if code == -9 or code == 137:
-                if executor.oom_killed():
-                    h.error = "OOM Killed: memory limit exceeded"
-                    h.exit_code = 137
-            h.finished_at = time.time()
-            executor.destroy()
-            h._done.set()
-
-        threading.Thread(target=wait, daemon=True).start()
+        self._watch_executor(h)
         return h
 
+    @classmethod
+    def _watch_executor(cls, h: TaskHandle) -> None:
+        """Long-poll Executor.Wait until the task exits, then reflect
+        the result on the handle (WaitTask over the process boundary)."""
+
+        def wait():
+            while True:
+                try:
+                    res = cls._ecall(h, "Executor.Wait",
+                                     {"timeout_s": 60.0},
+                                     timeout_s=90.0)
+                except Exception:
+                    # executor gone (killed, host reboot): the task is
+                    # unsupervised — report a driver loss
+                    h.error = h.error or "executor process lost"
+                    h.exit_code = h.exit_code if h.exit_code is not None \
+                        else -1
+                    h.finished_at = time.time()
+                    break
+                if res.get("done"):
+                    h.exit_code = res.get("exit_code")
+                    if res.get("oom"):
+                        h.error = "OOM Killed: memory limit exceeded"
+                    h.finished_at = res.get("finished_at") or time.time()
+                    try:
+                        cls._ecall(h, "Executor.Quit", {},
+                                   timeout_s=5.0)
+                    except Exception:
+                        pass
+                    break
+            # reap the executor child so it doesn't linger as a zombie
+            # (recovered handles have no Popen to reap)
+            p = h.proc
+            if p is not None and hasattr(p, "wait"):
+                try:
+                    p.wait(timeout=15)
+                except Exception:
+                    pass
+            h._done.set()
+
+        threading.Thread(target=wait, daemon=True,
+                         name=f"exec-wait-{h.id[:8]}").start()
+
     def stop_task(self, handle: TaskHandle, timeout_s: float = 5.0) -> None:
-        super().stop_task(handle, timeout_s)
-        executor = getattr(handle, "executor", None)
-        if executor is not None:
-            executor.destroy()
+        rpc = getattr(handle, "executor_rpc", None)
+        if rpc is None:
+            super().stop_task(handle, timeout_s)
+            executor = getattr(handle, "executor", None)
+            if executor is not None:
+                executor.destroy()
+            return
+        try:
+            self._ecall(handle, "Executor.Shutdown",
+                        {"grace_s": timeout_s},
+                        timeout_s=timeout_s + 30.0)
+            self._ecall(handle, "Executor.Quit", {}, timeout_s=5.0)
+        except Exception:
+            # executor unresponsive: kill it and reap the cgroup (which
+            # terminates member processes)
+            pid = getattr(handle, "executor_pid", None)
+            if pid:
+                try:
+                    _os.kill(pid, 9)
+                except OSError:
+                    pass
+                p = handle.proc
+                if p is not None and hasattr(p, "wait"):
+                    try:
+                        p.wait(timeout=5)
+                    except Exception:
+                        pass
+            cg = getattr(handle, "cgroup_name", None)
+            if cg:
+                from .executor import IsolatedExecutor
+                IsolatedExecutor.recover(cg).destroy()
+            handle.exit_code = handle.exit_code \
+                if handle.exit_code is not None else -1
+            handle.finished_at = handle.finished_at or time.time()
+            handle._done.set()
+
+    def exec_in_task(self, handle: TaskHandle, argv, timeout_s: float
+                     = 30.0) -> Dict:
+        """Run a command inside the task's isolation (same cgroup +
+        chroot) through the executor — the `alloc exec` entry
+        (executor_linux.go Exec). Returns {exit_code, output,
+        timed_out}."""
+        rpc = getattr(handle, "executor_rpc", None)
+        if rpc is None:
+            raise RuntimeError("task has no out-of-proc executor")
+        return self._ecall(handle, "Executor.Exec",
+                           {"cmd": list(argv), "timeout_s": timeout_s},
+                           timeout_s=timeout_s + 30.0)
 
     def recover_task(self, state: dict) -> Optional[TaskHandle]:
-        """Re-attach by pid like raw_exec, plus reconstruct the cgroup
-        owner from the persisted name so destroy() reaps stragglers and
-        the cgroup dir doesn't leak across client restarts."""
+        """Re-dial the still-running executor process (RecoverTask over
+        the executor boundary, executor_plugin.go): supervision, logs,
+        and exec keep working after a client restart. Falls back to
+        pid adoption + cgroup reap for pre-executor states or a dead
+        executor."""
+        addr = state.get("executor_addr")
+        if addr:
+            from ..rpc.client import RpcClient
+            rpc = None
+            auth = state.get("executor_auth", "")
+            try:
+                rpc = RpcClient(addr)
+                st = rpc.call("Executor.State", {"auth": auth},
+                              timeout_s=5.0)
+            except Exception:
+                if rpc is not None:
+                    rpc.close()
+                rpc = None
+            if rpc is not None:
+                h = TaskHandle(task_name=state.get("task_name", ""),
+                               driver=self.name,
+                               config=state.get("config") or {},
+                               proc=None,
+                               started_at=st.get("started_at") or
+                               state.get("started_at") or 0.0,
+                               id=state.get("id") or "")
+                h.executor_rpc = rpc
+                h.executor_addr = addr
+                h.executor_auth = auth
+                h.executor_pid = state.get("executor_pid")
+                h.task_pid = st.get("pid")
+                h.cgroup_name = state.get("cgroup", "")
+                if st.get("done"):
+                    h.exit_code = st.get("exit_code")
+                    if st.get("oom"):
+                        h.error = "OOM Killed: memory limit exceeded"
+                    h.finished_at = st.get("finished_at") or time.time()
+                    h._done.set()
+                    try:
+                        self._ecall(h, "Executor.Quit", {},
+                                    timeout_s=5.0)
+                    except Exception:
+                        pass
+                else:
+                    self._watch_executor(h)
+                return h
+            # executor gone: the task group lives only in the cgroup —
+            # reap it so a fresh start doesn't double-run
+            cg = state.get("cgroup")
+            if cg:
+                from .executor import IsolatedExecutor
+                IsolatedExecutor.recover(cg).destroy()
+            return None
         h = super().recover_task(state)
         cg = state.get("cgroup")
         if cg:
@@ -430,6 +605,13 @@ class ExecDriver(RawExecDriver):
     def stats(self, handle: TaskHandle) -> Dict[str, float]:
         """Resource usage for a running task (executor Stats() ->
         client task gauges)."""
+        rpc = getattr(handle, "executor_rpc", None)
+        if rpc is not None:
+            try:
+                return self._ecall(handle, "Executor.Stats", {},
+                                   timeout_s=10.0).get("stats", {})
+            except Exception:
+                return {}
         executor = getattr(handle, "executor", None)
         if executor is None:
             return {}

@@ -80,6 +80,33 @@ def contain(spec: dict) -> None:
             if libc.mount(src.encode(), dst.encode(), None,
                           flags, None) != 0:
                 raise OSError(ctypes.get_errno(), f"remount-ro {src}")
+        # volume_mount stanzas: bind each resolved volume source
+        # (CSI publish target / host volume path) at its destination
+        # inside the chroot (taskrunner/volume_hook + executor mounts)
+        for vm in spec.get("bind_mounts") or []:
+            src = os.path.realpath(vm.get("source") or "")
+            dest = vm.get("destination") or ""
+            if not vm.get("source") or not dest:
+                continue            # malformed stanza
+            if not os.path.isdir(src):
+                # a missing volume source must FAIL the launch — a
+                # silently skipped mount means the task writes into a
+                # chroot-local stub dir and the data is lost on GC
+                raise OSError(2, f"volume source missing: "
+                                 f"{vm.get('source')} -> {dest}")
+            dst = chroot_dir + "/" + dest.lstrip("/")
+            os.makedirs(dst, exist_ok=True)
+            if libc.mount(src.encode(), dst.encode(), None,
+                          MS_BIND | MS_REC, None) != 0:
+                raise OSError(ctypes.get_errno(),
+                              f"bind volume {src} -> {dest}")
+            if vm.get("read_only"):
+                flags = MS_BIND | MS_REMOUNT | MS_RDONLY \
+                    | _statvfs_ms_flags(dst)
+                if libc.mount(src.encode(), dst.encode(), None,
+                              flags, None) != 0:
+                    raise OSError(ctypes.get_errno(),
+                                  f"remount-ro volume {dest}")
         os.makedirs(chroot_dir + "/tmp", exist_ok=True)
         os.makedirs(chroot_dir + "/dev", exist_ok=True)
         for dev in ("null", "zero", "urandom"):

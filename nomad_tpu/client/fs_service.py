@@ -251,6 +251,58 @@ class MockExecSession:
         self._closed = True
 
 
+class TaskExecSession:
+    """`alloc exec` backed by the out-of-proc executor's Exec verb: the
+    command runs INSIDE the task's isolation (same cgroup + chroot —
+    executor_linux.go Exec). One-shot: output is delivered when the
+    command completes; stdin is not streamed (the reference's non-tty
+    exec shape)."""
+
+    def __init__(self, driver, handle, argv: List[str],
+                 env: Optional[Dict[str, str]] = None,
+                 timeout_s: float = 300.0):
+        import threading as _threading
+        self.id = generate_uuid()
+        self._out = b""
+        self._exit: Optional[int] = None
+        self._done = _threading.Event()
+        self._l = _threading.Lock()
+
+        def run():
+            try:
+                res = driver.exec_in_task(handle, argv,
+                                          timeout_s=timeout_s)
+                with self._l:
+                    self._out = bytes(res.get("output") or b"")
+                    self._exit = int(res.get("exit_code", -1))
+            except Exception as e:
+                with self._l:
+                    self._out = f"exec failed: {e}\n".encode()
+                    self._exit = -1
+            self._done.set()
+
+        _threading.Thread(target=run, daemon=True,
+                          name=f"task-exec-{self.id[:8]}").start()
+
+    def write_stdin(self, data: bytes, close: bool = False) -> None:
+        pass        # non-interactive
+
+    def poll(self, wait_s: float = 0.0) -> Dict:
+        self._done.wait(wait_s)
+        with self._l:
+            out, self._out = self._out, b""
+            exited = self._done.is_set() and not out
+            return {"stdout": out, "stderr": b"", "exited": exited,
+                    "exit_code": self._exit if self._exit is not None
+                    else -1}
+
+    def signal(self, sig: int) -> None:
+        pass
+
+    def stop(self) -> None:
+        self._done.set()
+
+
 class ExecRegistry:
     """Session table for one client agent; sessions are garbage
     collected when stopped or after idle timeout."""

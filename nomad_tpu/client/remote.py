@@ -81,6 +81,13 @@ class ClientRpcService:
         runner, tr = self._task_runner(alloc_id, task)
         if tr.task.driver in ("mock", "mock_driver"):
             sess = fs_service.MockExecSession(argv)
+        elif hasattr(tr.driver, "exec_in_task") and \
+                getattr(tr.handle, "executor_rpc", None) is not None:
+            # exec INSIDE the task's isolation through the out-of-proc
+            # executor (same cgroup + chroot view — executor_linux.go
+            # Exec)
+            sess = fs_service.TaskExecSession(tr.driver, tr.handle,
+                                              argv)
         else:
             from .taskenv import build_task_env
             task_path, _local, secrets = \
@@ -92,9 +99,10 @@ class ClientRpcService:
             # SCRUBBED env, same stance as task launches: only the
             # task's own variables plus a sane PATH — merging the agent
             # process env would hand an alloc-exec caller the agent's
-            # credentials. (Known gap vs the reference: the session
-            # runs host-side in the task dir, not inside the exec
-            # driver's chroot/cgroup — see STATUS.md.)
+            # credentials. This branch is the fallback for drivers
+            # without an isolation boundary (raw_exec); isolated exec
+            # tasks take the TaskExecSession path above, inside the
+            # chroot/cgroup.
             env.setdefault(
                 "PATH", "/usr/local/bin:/usr/bin:/bin:/usr/sbin:/sbin")
             sess = fs_service.ExecSession(argv, cwd=task_path, env=env)

@@ -1193,6 +1193,40 @@ def _cpu_device():
         return None
 
 
+def decorrelation_slice(req, lane: int, total: int, cache):
+    """The one shared decorrelation rule (used by both the worker's
+    solo-select slicing and the BatchGateway's lane partition): a
+    Knuth-mix hash assigns each node to one of `total` lanes; the
+    request keeps its lane's slice only when the slice's aggregate
+    capacity headroom covers ~2x the ask (so slicing is a throughput
+    heuristic, never a feasibility change — callers retry on the full
+    set). Returns (slice_mask or None, new_cache); `cache` is the
+    caller's (key, lane_ids) memo."""
+    if total <= 1:
+        return None, cache
+    feas = req.feasible
+    n = len(feas)
+    cache_key, lane_ids = cache
+    if cache_key != (n, total):
+        mix = (np.arange(n, dtype=np.uint64)
+               * np.uint64(2654435761)) & np.uint64(0xffffffff)
+        lane_ids = ((mix >> np.uint64(7)) % np.uint64(total)) \
+            .astype(np.int32)
+        cache = ((n, total), lane_ids)
+    slice_mask = feas & (lane_ids == (lane % total))
+    if int(slice_mask.sum()) < 8:
+        return None, cache
+    free = req.capacity - req.used
+    with np.errstate(divide="ignore", invalid="ignore"):
+        per = np.where(req.ask[None, :] > 0,
+                       free / np.maximum(req.ask[None, :], 1e-9),
+                       np.inf).min(axis=1)
+    headroom = float(np.floor(per[slice_mask]).clip(min=0).sum())
+    if headroom < 2.0 * req.count:
+        return None, cache
+    return slice_mask, cache
+
+
 class SelectKernel:
     """Host wrapper: pads request arrays, routes the dispatch to the
     best backend, and unpacks results.
@@ -1311,28 +1345,14 @@ class SelectKernel:
         if dec is None:
             return None
         lane, lanes = dec
-        if lanes <= 1 or req.count < 256:
+        if req.count < 256:
+            return None
+        slice_mask, cache = decorrelation_slice(
+            req, lane, lanes, self._decor_cache)
+        self._decor_cache = cache
+        if slice_mask is None:
             return None
         feas = req.feasible
-        n = len(feas)
-        cache_key, lane_ids = self._decor_cache
-        if cache_key != (n, lanes):
-            mix = (np.arange(n, dtype=np.uint64)
-                   * np.uint64(2654435761)) & np.uint64(0xffffffff)
-            lane_ids = ((mix >> np.uint64(7)) % np.uint64(lanes)) \
-                .astype(np.int32)
-            self._decor_cache = ((n, lanes), lane_ids)
-        slice_mask = feas & (lane_ids == (lane % lanes))
-        # capacity-aware headroom: per-node placements possible under
-        # the ask, summed over the slice
-        free = req.capacity - req.used
-        with np.errstate(divide="ignore", invalid="ignore"):
-            per = np.where(req.ask[None, :] > 0,
-                           free / np.maximum(req.ask[None, :], 1e-9),
-                           np.inf).min(axis=1)
-        headroom = float(np.floor(per[slice_mask]).clip(min=0).sum())
-        if headroom < 2.0 * req.count:
-            return None
         req.feasible = slice_mask
         return feas
 

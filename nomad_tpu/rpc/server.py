@@ -88,6 +88,17 @@ def build_method_table(server) -> Dict[str, Any]:
         from .transport import _alloc_with_node
         return _alloc_with_node(server, args["alloc_id"])
 
+    def server_indirect_ping(args):
+        # SWIM ping-req: probe `target` on behalf of another member
+        swim = getattr(server, "swim", None)
+        if swim is None:
+            return {"ok": False}
+        return {"ok": swim.probe_for_peer(args["target"])}
+
+    def server_report_failed(args):
+        return {"removed": server.handle_peer_failure_report(
+            args["addr"], reporter=args.get("reporter", ""))}
+
     def csi_volume_get(args):
         v = server.store.csi_volume(args.get("namespace", "default"),
                                     args["volume_id"])
@@ -115,6 +126,8 @@ def build_method_table(server) -> Dict[str, Any]:
         "Server.Join": server_join,
         "Server.Leave": server_leave,
         "Server.Members": server_members,
+        "Server.IndirectPing": server_indirect_ping,
+        "Server.ReportFailed": server_report_failed,
         "Alloc.GetAlloc": alloc_get,
         "Service.Update": service_update,
         "CSIVolume.Get": csi_volume_get,

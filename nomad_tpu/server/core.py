@@ -130,6 +130,7 @@ class Server:
         self._member_l = threading.Lock()   # join/leave RMW serialization
         self._acl_cache: Dict = {}      # (policies, index) -> compiled ACL
         self.raft = None                # multi-server consensus (raft.py)
+        self.swim = None                # peer failure detection (swim.py)
         # thread-local: set on the FSM applier thread while an applier
         # runs, so nested raft_apply side effects are detected per
         # thread — an instance-wide flag would make a concurrent client
@@ -182,12 +183,18 @@ class Server:
         members = self.store.server_members()
         if members:
             self.raft.update_members(members)
+        # peer-to-peer failure detection (SWIM; nomad/serf.go): every
+        # member probes, not just the leader's replication threads
+        from .swim import SwimDetector
+        self.swim = SwimDetector(self)
 
     def start(self) -> None:
         if self.raft is None:
             self.establish_leadership()
         else:
             self.raft.start()
+            if self.swim is not None:
+                self.swim.start()
         self.plan_applier.start()
         for i in range(self.config.num_schedulers):
             w = Worker(self, list(self.config.enabled_schedulers)
@@ -322,6 +329,8 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        if getattr(self, "swim", None) is not None:
+            self.swim.stop()
         if self.raft is not None:
             self.raft.stop()
         self._leader = False
@@ -1148,6 +1157,35 @@ class Server:
         members = list(res.get("members") or [])
         if members:
             self.raft.update_members(members)
+
+    def handle_peer_failure_report(self, addr: str,
+                                   reporter: str = "") -> bool:
+        """A peer's SWIM verdict arrived (Server.ReportFailed). Leader
+        only: verify the target is unreachable from HERE too (implicit
+        refutation — a live server answers and the report is dropped),
+        then remove it under the same quorum guard autopilot uses.
+        Returns True when the member was removed."""
+        raft = self.raft
+        if raft is None or not raft.is_leader():
+            raise RuntimeError("not the leader")
+        if addr == raft.self_addr:
+            return False
+        members = self.store.server_members() or \
+            [raft.self_addr] + list(raft.peers)
+        if addr not in members:
+            return False                # already gone
+        if self.swim is not None and self.swim.probe_for_peer(addr):
+            LOG.info("swim report for %s from %s refuted by leader "
+                     "probe", addr, reporter)
+            return False
+        alive = len(members) - 1
+        if alive * 2 <= len(members):
+            LOG.warning("swim: not removing %s — quorum guard", addr)
+            return False
+        LOG.warning("swim: removing failed server %s (reported by %s)",
+                    addr, reporter)
+        self.leave_member(addr)
+        return True
 
     def _autopilot_loop(self) -> None:
         """Leader-side dead-server cleanup (nomad/autopilot.go): a
