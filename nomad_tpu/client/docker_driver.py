@@ -15,6 +15,7 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import os
 import socket
 import struct
 import threading
@@ -197,6 +198,16 @@ class DockerAPI:
         return out[0], out[1]
 
 
+def _pid_is_docklog(pid) -> bool:
+    """A recycled pid must not masquerade as a live docklog: verify
+    the process actually runs the docklog module."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"nomad_tpu.client.docklog" in f.read()
+    except OSError:
+        return False
+
+
 class DockerDriver:
     """drivers/docker as a nomad_tpu task driver. Registers only when
     dockerd answers /version (fingerprint absent otherwise — the
@@ -212,6 +223,8 @@ class DockerDriver:
         "network_mode": _SpecAttr("string"),
         "force_pull": _SpecAttr("bool", default=False),
         "labels": _SpecAttr("any"),
+        # "host:container[:ro]" bind specs (drivers/docker volumes)
+        "volumes": _SpecAttr("list(string)", default=[]),
     }
 
     def __init__(self, socket_path: str = DEFAULT_SOCKET):
@@ -264,8 +277,26 @@ class DockerDriver:
         resources = ctx.get("resources") or {}
         alloc_id = ctx.get("alloc_id", "anon")
         alloc_networks = ctx.get("alloc_networks") or []
-        exposed, bindings = self._port_bindings(
-            config.get("port_map") or {}, alloc_networks)
+        # network modes (drivers/docker/network.go): bridge (default)
+        # gets the label->container port bindings; host and
+        # container:<name> share another namespace's stack, where
+        # Docker rejects port bindings — ports ride the joined
+        # namespace instead
+        net_mode = (config.get("network_mode") or "").strip()
+        shares_netns = net_mode == "host" or \
+            net_mode.startswith("container:")
+        if shares_netns:
+            exposed, bindings = {}, {}
+        else:
+            exposed, bindings = self._port_bindings(
+                config.get("port_map") or {}, alloc_networks)
+        # volumes: jobspec "host:container[:ro]" specs plus the group's
+        # volume_mount stanzas resolved by the alloc runner (CSI publish
+        # targets / host volumes) — drivers/docker volumes + mounts
+        binds = [str(v) for v in (config.get("volumes") or [])]
+        for vm in (ctx.get("volume_mounts") or []):
+            mode = ":ro" if vm.get("read_only") else ""
+            binds.append(f"{vm['source']}:{vm['destination']}{mode}")
         spec = {
             "Image": image,
             "Env": [f"{k}={v}" for k, v in (env or {}).items()],
@@ -276,13 +307,14 @@ class DockerDriver:
                 "Memory": int(resources.get("memory_mb", 0)) * 1024 * 1024,
                 "CPUShares": int(resources.get("cpu", 0)),
                 "PortBindings": bindings,
+                "Binds": binds,
             },
         }
         if config.get("command"):
             spec["Cmd"] = [config["command"]] + \
                 list(config.get("args") or [])
-        if config.get("network_mode"):
-            spec["HostConfig"]["NetworkMode"] = config["network_mode"]
+        if net_mode:
+            spec["HostConfig"]["NetworkMode"] = net_mode
         cname = f"nomad-{alloc_id[:8]}-{task_name}-{int(time.time())}"
         cid = self.api.create_container(cname, spec)
         try:
@@ -298,10 +330,22 @@ class DockerDriver:
         h.container_id = cid
 
         log_dir = ctx.get("log_dir")
+        docklog_ok = False
+        if log_dir:
+            # external docklog process (drivers/docker/docklog): log
+            # streaming keeps running across client/driver restarts
+            try:
+                h.docklog_pid = self._spawn_docklog(
+                    cid, task_name, log_dir, ctx)
+                h.log_dir = log_dir
+                docklog_ok = True
+            except Exception:
+                LOG.exception("docklog spawn for %s failed; falling "
+                              "back to exit-time collection", cid[:12])
 
         def wait():
             code = self._wait_resilient(h.container_id)
-            if log_dir:
+            if log_dir and not docklog_ok:
                 try:
                     self._collect_logs(h.container_id, task_name, log_dir,
                                        ctx)
@@ -315,6 +359,50 @@ class DockerDriver:
         threading.Thread(target=wait, daemon=True,
                          name=f"docker-wait-{cid[:12]}").start()
         return h
+
+    def _spawn_docklog(self, cid: str, task_name: str, log_dir: str,
+                       ctx: dict, since: int = 0) -> int:
+        """Launch the detached docklog streamer (docklog.go analog).
+        Returns its pid; the process exits on its own when the
+        container stops."""
+        import json as _json
+        import subprocess
+        import sys as _sys
+
+        from .drivers import child_process_env
+        spec = {"socket_path": self.api.socket_path,
+                "container_id": cid,
+                "task_name": task_name,
+                "log_dir": log_dir,
+                "log_max_files": int(ctx.get("log_max_files", 10)),
+                "log_max_file_size_mb": int(
+                    ctx.get("log_max_file_size_mb", 10)),
+                "since": since}
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "nomad_tpu.client.docklog"],
+            env=child_process_env(),
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, start_new_session=True)
+        proc.stdin.write(_json.dumps(spec).encode())
+        proc.stdin.close()
+        # startup handshake: docklog prints OK once its first follow
+        # request succeeded — a docklog that dies during startup must
+        # not disable the exit-time collection fallback
+        import select as _select
+        ready, _w, _x = _select.select([proc.stdout], [], [], 10.0)
+        line = proc.stdout.readline() if ready else b""
+        if not line.startswith(b"OK"):
+            try:
+                proc.kill()
+                proc.wait(timeout=5)
+            except Exception:
+                pass
+            raise RuntimeError("docklog failed to start streaming")
+        # detached on purpose: nobody waits on it from here; the reap
+        # thread avoids zombies while the client is alive
+        threading.Thread(target=proc.wait, daemon=True,
+                         name=f"docklog-reap-{cid[:12]}").start()
+        return proc.pid
 
     def _collect_logs(self, cid: str, task_name: str, log_dir: str,
                       ctx: dict) -> None:
@@ -406,6 +494,25 @@ class DockerDriver:
                                         or time.time()),
                        id=state.get("id", ""))
         h.container_id = cid
+        # docklog normally survives the restart (own session); respawn
+        # only if it died while the container lives (docklog.go
+        # re-launch on recovery)
+        dl_pid = state.get("docklog_pid")
+        log_dir = state.get("log_dir") or ""
+        if dl_pid and log_dir:
+            alive = _pid_is_docklog(dl_pid)
+            if alive:
+                h.docklog_pid = dl_pid
+                h.log_dir = log_dir
+            else:
+                try:
+                    h.docklog_pid = self._spawn_docklog(
+                        cid, state.get("task_name", "task"), log_dir,
+                        {}, since=int(time.time()))
+                    h.log_dir = log_dir
+                except Exception:
+                    LOG.exception("docklog respawn for %s failed",
+                                  cid[:12])
 
         def wait():
             h.exit_code = self._wait_resilient(cid)

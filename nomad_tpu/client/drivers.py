@@ -66,6 +66,10 @@ class TaskHandle:
         cid = getattr(self, "container_id", None)
         if cid:
             out["container_id"] = cid
+            dp = getattr(self, "docklog_pid", None)
+            if dp:
+                out["docklog_pid"] = dp
+                out["log_dir"] = getattr(self, "log_dir", "")
         mon = getattr(self, "monitor_path", None)
         if mon:
             out["monitor_path"] = mon
@@ -89,6 +93,21 @@ def resolve_host_ports(alloc_networks) -> Dict[str, tuple]:
             host_ports[field(p, "label")] = (
                 field(p, "value"), field(nw, "ip", "") or "0.0.0.0")
     return host_ports
+
+
+def child_process_env(extra: Optional[Dict[str, str]] = None
+                      ) -> Dict[str, str]:
+    """Minimal env for spawned helper processes (executor, docklog,
+    plugin launchers): the repo on PYTHONPATH plus a sane PATH —
+    deliberately NOT the agent's env (credentials must not leak into
+    task-side processes)."""
+    repo_root = _os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__))))
+    env = {"PYTHONPATH": repo_root,
+           "PATH": _os.environ.get("PATH", "/usr/bin:/bin")}
+    if extra:
+        env.update(extra)
+    return env
 
 
 def _parse_duration(val) -> float:
@@ -324,13 +343,10 @@ class ExecDriver(RawExecDriver):
                                     HANDSHAKE_COOKIE_VALUE,
                                     HANDSHAKE_PREFIX)
         from ..rpc.client import RpcClient
-        repo_root = _os.path.dirname(_os.path.dirname(
-            _os.path.dirname(_os.path.abspath(__file__))))
         token = _secrets.token_hex(16)
-        env = {"PYTHONPATH": repo_root,
-               "PATH": _os.environ.get("PATH", "/usr/bin:/bin"),
-               HANDSHAKE_COOKIE_KEY: HANDSHAKE_COOKIE_VALUE,
-               "NOMAD_TPU_EXECUTOR_TOKEN": token}
+        env = child_process_env({
+            HANDSHAKE_COOKIE_KEY: HANDSHAKE_COOKIE_VALUE,
+            "NOMAD_TPU_EXECUTOR_TOKEN": token})
         eproc = subprocess.Popen(
             [_sys.executable, "-m", "nomad_tpu.client.executor_server"],
             env=env, stdin=subprocess.DEVNULL, stdout=subprocess.PIPE,
@@ -442,14 +458,25 @@ class ExecDriver(RawExecDriver):
         the result on the handle (WaitTask over the process boundary)."""
 
         def wait():
+            fails = 0
             while True:
                 try:
                     res = cls._ecall(h, "Executor.Wait",
                                      {"timeout_s": 60.0},
                                      timeout_s=90.0)
+                    fails = 0
                 except Exception:
-                    # executor gone (killed, host reboot): the task is
-                    # unsupervised — report a driver loss
+                    # transient RPC hiccups must not kill a live task:
+                    # only give up once the executor PROCESS is gone or
+                    # several consecutive calls failed (a dead executor
+                    # means the task is unsupervised either way)
+                    fails += 1
+                    pid = getattr(h, "executor_pid", None)
+                    alive = bool(pid) and \
+                        _os.path.isdir(f"/proc/{pid}")
+                    if alive and fails < 3:
+                        time.sleep(1.0)
+                        continue
                     h.error = h.error or "executor process lost"
                     h.exit_code = h.exit_code if h.exit_code is not None \
                         else -1

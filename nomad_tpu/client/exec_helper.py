@@ -117,8 +117,14 @@ def contain(spec: dict) -> None:
             libc.mount(src.encode(), dst.encode(), None, MS_BIND, None)
         os.chroot(chroot_dir)
         os.chdir("/")
-    elif spec.get("cwd"):
-        os.chdir(spec["cwd"])
+    else:
+        if spec.get("bind_mounts"):
+            # without a chroot there is nowhere to bind the volumes —
+            # starting anyway would silently write to raw host paths
+            raise RuntimeError(
+                "volume mounts require chroot isolation")
+        if spec.get("cwd"):
+            os.chdir(spec["cwd"])
 
 
 DEFAULT_PATH = "/usr/local/bin:/usr/bin:/bin:/usr/sbin:/sbin"

@@ -48,10 +48,8 @@ STATE = _ExecutorState()
 
 
 def _spawn_helper(spec: Dict, stdout, stderr) -> subprocess.Popen:
-    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    helper_env = {"PYTHONPATH": repo_root,
-                  "PATH": os.environ.get("PATH", "/usr/bin:/bin")}
+    from .drivers import child_process_env
+    helper_env = child_process_env()
     proc = subprocess.Popen(
         [sys.executable, "-m", "nomad_tpu.client.exec_helper"],
         env=helper_env, stdin=subprocess.PIPE,

@@ -71,8 +71,14 @@ class ServerConfig:
     authoritative_region: str = ""
     replication_token: str = ""
     # max READY evals one worker drains into a single batched dispatch
-    # (SURVEY §2.6 row 1; 1 disables batching)
-    eval_batch_size: int = 4
+    # (SURVEY §2.6 row 1; 1 disables batching). DEFAULT 1: measured on
+    # real TPU at C2M scale, concurrent workers overlapping device
+    # round trips (decorrelated solo dispatches) beat coalescing lanes
+    # into one vmapped dispatch (BENCH r5: stream 10.0k/s solo vs
+    # 6.5k/s batched — the mega-dispatch serializes lane host work
+    # under the GIL). The gateway stays available for queue-depth
+    # regimes where dispatch slots, not host time, are the bottleneck.
+    eval_batch_size: int = 1
     # driver/config for injected connect proxy tasks (the reference
     # hardcodes docker+envoy, job_endpoint_hook_connect.go:23)
     connect_sidecar_driver: str = "docker"

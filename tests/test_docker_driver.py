@@ -48,6 +48,10 @@ class FakeDockerd:
                             length = int(h.split(":")[1])
                     body = json.loads(self.rfile.read(length)) \
                         if length else None
+                    if method == "GET" and "/logs" in path and \
+                            "follow=1" in path:
+                        fake.stream_logs(path, self.wfile)
+                        return
                     status, payload = fake.route(method, path, body)
                     if not isinstance(payload, (bytes, bytearray)):
                         payload = json.dumps(payload).encode()
@@ -86,7 +90,9 @@ class FakeDockerd:
                 "Id": cid, "Name": q.get("name", ""),
                 "Spec": body, "State": {"Running": False},
                 "ExitCode": None,
-                "Labels": (body or {}).get("Labels") or {}}
+                "Labels": (body or {}).get("Labels") or {},
+                "LogBuf": [(1, b"hello out\n"), (2, b"oops\n")],
+                "LogCv": threading.Condition()}
             self._waiters[cid] = threading.Event()
             return 201, {"Id": cid}
         if u.path == "/containers/json":
@@ -118,7 +124,8 @@ class FakeDockerd:
             self._waiters[cid].wait(30)
             return 200, {"StatusCode": c["ExitCode"] or 0}
         if method == "GET" and action == "json":
-            return 200, c
+            return 200, {k: v for k, v in c.items()
+                         if k not in ("LogBuf", "LogCv")}
         if method == "GET" and action == "stats":
             return 200, {"memory_stats": {"usage": 7 * 1024 * 1024},
                          "cpu_stats": {"cpu_usage":
@@ -126,18 +133,51 @@ class FakeDockerd:
         if method == "GET" and action == "logs":
             def frame(stream, data):
                 return struct.pack(">BxxxL", stream, len(data)) + data
-            return 200, frame(1, b"hello out\n") + frame(2, b"oops\n")
+            return 200, b"".join(frame(s, d) for s, d in c["LogBuf"])
         if method == "DELETE":
             self.finish(cid, c["ExitCode"] or 137)
             del self.containers[cid]
             return 204, b""
         return 400, {"message": f"unhandled {method} {u.path}"}
 
+    def emit_log(self, cid, stream, data):
+        """Append a log frame; follow-mode readers wake up."""
+        c = self.containers[cid]
+        with c["LogCv"]:
+            c["LogBuf"].append((stream, data))
+            c["LogCv"].notify_all()
+
+    def stream_logs(self, path, wfile):
+        """follow=1: chunked-ish raw stream of frames until the
+        container stops (the docklog transport)."""
+        cid = path.strip("/").split("/")[1]
+        c = self.containers.get(cid)
+        if c is None:
+            wfile.write(b"HTTP/1.1 404 X\r\nContent-Length: 2\r\n\r\n{}")
+            return
+        wfile.write(b"HTTP/1.1 200 X\r\n\r\n")
+        wfile.flush()
+        sent = 0
+        while True:
+            with c["LogCv"]:
+                while sent >= len(c["LogBuf"]) and c["State"]["Running"]:
+                    c["LogCv"].wait(0.2)
+                frames = c["LogBuf"][sent:]
+                sent = len(c["LogBuf"])
+                running = c["State"]["Running"]
+            for s, d in frames:
+                wfile.write(struct.pack(">BxxxL", s, len(d)) + d)
+            wfile.flush()
+            if not running and sent >= len(c["LogBuf"]):
+                return
+
     def finish(self, cid, code):
         c = self.containers.get(cid)
         if c is not None and c["ExitCode"] is None:
             c["ExitCode"] = code
             c["State"]["Running"] = False
+            with c["LogCv"]:
+                c["LogCv"].notify_all()
         ev = self._waiters.get(cid)
         if ev:
             ev.set()
@@ -197,11 +237,20 @@ def test_lifecycle_ports_stats_and_logs(dockerd, tmp_path):
     assert stats["memory_bytes"] == 7 * 1024 * 1024
 
     # stop -> exit code propagates, logs demuxed into rotated files
+    # (docklog streams asynchronously — wait for its flush)
     d.stop_task(h, timeout_s=2.0)
     assert h.wait(10) and h.exit_code == 0
-    assert open(os.path.join(log_dir, "web.stdout.0")).read() == \
-        "hello out\n"
-    assert open(os.path.join(log_dir, "web.stderr.0")).read() == "oops\n"
+
+    def _read(name):
+        p = os.path.join(log_dir, name)
+        return open(p).read() if os.path.exists(p) else ""
+    deadline = time.time() + 10
+    while time.time() < deadline and (
+            _read("web.stdout.0") != "hello out\n"
+            or _read("web.stderr.0") != "oops\n"):
+        time.sleep(0.1)
+    assert _read("web.stdout.0") == "hello out\n"
+    assert _read("web.stderr.0") == "oops\n"
 
 
 def test_recover_reattaches_to_running_container(dockerd):
@@ -237,3 +286,119 @@ def test_orphan_reconciler_removes_unowned_containers(dockerd):
     assert removed == [h2.container_id]
     assert h1.container_id in fake.containers
     assert h2.container_id not in fake.containers
+
+
+def test_volume_binds_and_network_modes(dockerd, tmp_path):
+    """drivers/docker volumes + network.go modes: jobspec volume specs
+    and resolved volume_mounts land in HostConfig.Binds; host and
+    container: network modes share a namespace so port bindings are
+    omitted; bridge (default) binds the port_map."""
+    fake, sock = dockerd
+    d = DockerDriver(socket_path=sock)
+    h = d.start_task(
+        "web", {"image": "busybox:latest",
+                "volumes": ["/host/data:/data:ro"],
+                "network_mode": "host",
+                "port_map": {"http": 8080}},
+        {}, ctx={"alloc_id": "dockvol1",
+                 "volume_mounts": [{"volume": "v",
+                                    "source": str(tmp_path / "csi"),
+                                    "destination": "/mnt/vol",
+                                    "read_only": False}],
+                 "alloc_networks": [
+                     {"ip": "10.0.0.1",
+                      "reserved_ports": [],
+                      "dynamic_ports": [{"label": "http",
+                                         "value": 21000}]}],
+                 "resources": {"cpu": 100, "memory_mb": 64}})
+    spec = fake.containers[h.container_id]["Spec"]
+    binds = spec["HostConfig"]["Binds"]
+    assert "/host/data:/data:ro" in binds
+    assert f"{tmp_path / 'csi'}:/mnt/vol" in binds
+    # host networking: no port bindings, mode passed through
+    assert spec["HostConfig"]["NetworkMode"] == "host"
+    assert spec["HostConfig"]["PortBindings"] == {}
+    d.stop_task(h, timeout_s=2.0)
+    assert h.wait(10)
+
+    # container:<name> shares another container's namespace
+    h2 = d.start_task(
+        "side", {"image": "busybox:latest",
+                 "network_mode": f"container:{h.container_id}",
+                 "port_map": {"http": 9090}},
+        {}, ctx={"alloc_id": "dockvol2",
+                 "resources": {"cpu": 100, "memory_mb": 64}})
+    spec2 = fake.containers[h2.container_id]["Spec"]
+    assert spec2["HostConfig"]["NetworkMode"] == \
+        f"container:{h.container_id}"
+    assert spec2["HostConfig"]["PortBindings"] == {}
+    d.stop_task(h2, timeout_s=2.0)
+
+    # bridge (default) keeps the bindings
+    h3 = d.start_task(
+        "brid", {"image": "busybox:latest",
+                 "port_map": {"http": 8080}},
+        {}, ctx={"alloc_id": "dockvol3",
+                 "alloc_networks": [
+                     {"ip": "10.0.0.1",
+                      "reserved_ports": [],
+                      "dynamic_ports": [{"label": "http",
+                                         "value": 21001}]}],
+                 "resources": {"cpu": 100, "memory_mb": 64}})
+    spec3 = fake.containers[h3.container_id]["Spec"]
+    assert spec3["HostConfig"]["PortBindings"] == {
+        "8080/tcp": [{"HostIp": "10.0.0.1", "HostPort": "21001"}]}
+    d.stop_task(h3, timeout_s=2.0)
+
+
+def test_docklog_streams_and_survives_driver_restart(dockerd, tmp_path):
+    """drivers/docker/docklog: the external streamer keeps writing the
+    task's log files after the driver object (client) goes away, and a
+    NEW driver's RecoverTask finds it alive and does not respawn."""
+    fake, sock = dockerd
+    log_dir = tmp_path / "logs"
+    log_dir.mkdir()
+    d = DockerDriver(socket_path=sock)
+    h = d.start_task(
+        "logt", {"image": "busybox:latest"},
+        {}, ctx={"alloc_id": "docklog1", "log_dir": str(log_dir),
+                 "resources": {"cpu": 100, "memory_mb": 64}})
+    assert getattr(h, "docklog_pid", None)
+    state = h.recoverable_state()
+    cid = h.container_id
+
+    def stdout_content():
+        out = ""
+        for f in os.listdir(log_dir):
+            if "stdout" in f:
+                out += open(os.path.join(log_dir, f)).read()
+        return out
+
+    deadline = time.time() + 10
+    while time.time() < deadline and "hello out" not in stdout_content():
+        time.sleep(0.1)
+    assert "hello out" in stdout_content()
+
+    # the "client restart": drop the driver; the fake keeps emitting
+    del d
+    fake.emit_log(cid, 1, b"after-restart\n")
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            "after-restart" not in stdout_content():
+        time.sleep(0.1)
+    assert "after-restart" in stdout_content(), \
+        "docklog must keep streaming with no client attached"
+
+    # a fresh driver recovers and sees docklog alive (same pid)
+    d2 = DockerDriver(socket_path=sock)
+    h2 = d2.recover_task(state)
+    assert h2 is not None
+    assert h2.docklog_pid == state["docklog_pid"]
+    fake.finish(cid, 0)
+    assert h2.wait(15)
+    # docklog exits once the container stops
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            os.path.isdir(f"/proc/{h2.docklog_pid}"):
+        time.sleep(0.1)
+    assert not os.path.isdir(f"/proc/{h2.docklog_pid}")
