@@ -54,8 +54,13 @@ class ApiClient:
                               f"{e.reason}")
 
     # -- jobs ----------------------------------------------------------
-    def register_job(self, spec) -> dict:
-        return self._request("PUT", "/v1/jobs", {"Job": spec})
+    def register_job(self, spec, check_index: Optional[int] = None
+                     ) -> dict:
+        body = {"Job": spec}
+        if check_index is not None:
+            body["EnforceIndex"] = True
+            body["JobModifyIndex"] = int(check_index)
+        return self._request("PUT", "/v1/jobs", body)
 
     def list_jobs(self, prefix: str = "") -> list:
         return self._request("GET", "/v1/jobs",

@@ -499,10 +499,17 @@ class HTTPApiServer:
                 spec = data.get("Job", data.get("job", data))
                 job = from_wire(Job, spec) if isinstance(spec, dict) \
                     else parse_job(spec)
-                ev = s.register_job(job)
+                # `job run -check-index` CAS (job_endpoint.go Register
+                # EnforceIndex + JobModifyIndex)
+                ev = s.register_job(
+                    job,
+                    enforce_index=bool(data.get("EnforceIndex")),
+                    job_modify_index=int(data.get("JobModifyIndex")
+                                         or 0))
                 # periodic/parameterized registrations create no eval
                 return {"EvalID": ev.id if ev is not None else "",
-                        "JobModifyIndex": job.modify_index}, \
+                        "JobModifyIndex": job.job_modify_index
+                        or job.modify_index}, \
                     store.latest_index()
 
         if path == "/v1/jobs/parse" and method in ("PUT", "POST"):

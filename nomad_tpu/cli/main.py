@@ -216,7 +216,9 @@ def cmd_job_run(args) -> int:
         return 1
     c = _client(args)
     try:
-        resp = c.register_job(job_to_spec(job))
+        resp = c.register_job(job_to_spec(job),
+                              check_index=getattr(args, "check_index",
+                                                  None))
     except ApiError as e:
         print(f"Error submitting job: {e}", file=sys.stderr)
         return 1
@@ -581,7 +583,53 @@ def cmd_node_drain(args) -> int:
     except ApiError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
+    if args.enable and getattr(args, "monitor", False):
+        return _monitor_drain(c, args.node_id)
     return 0
+
+
+def _monitor_drain(c: ApiClient, node_id: str,
+                   timeout: float = 600.0) -> int:
+    """Block until the node finishes draining, reporting alloc
+    migrations as they happen (command/node_drain.go -monitor +
+    api/nodes.go MonitorDrain)."""
+    seen: dict = {}
+    deadline = time.time() + timeout
+    print(f"{time.strftime('%H:%M:%S')}: Monitoring node "
+          f"{short_id(node_id)}: Ctrl-C to detach monitoring")
+    while time.time() < deadline:
+        try:
+            node = c.get_node(node_id)
+            allocs = c.node_allocations(node_id)
+        except ApiError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+        remaining = 0
+        for a in allocs:
+            status = (a.get("desired_status", ""),
+                      a.get("client_status", ""))
+            if seen.get(a["id"]) != status:
+                seen[a["id"]] = status
+                print(f"{time.strftime('%H:%M:%S')}: Alloc "
+                      f"{short_id(a['id'])} status {status[1]} "
+                      f"(desired {status[0]})")
+            if a.get("desired_status") == "run" and \
+                    a.get("client_status") in ("running", "pending"):
+                remaining += 1
+        draining = bool(node.get("drain"))
+        if not draining and remaining == 0:
+            print(f"{time.strftime('%H:%M:%S')}: Drain complete for "
+                  f"node {short_id(node_id)}")
+            return 0
+        if not draining:
+            # drain strategy cleared (deadline hit / canceled) but
+            # allocs still present — report and finish
+            print(f"{time.strftime('%H:%M:%S')}: Node drain strategy "
+                  f"cleared; {remaining} alloc(s) still on node")
+            return 0
+        time.sleep(1.0)
+    print("Error: drain monitor timed out", file=sys.stderr)
+    return 1
 
 
 # -- alloc / eval ------------------------------------------------------
@@ -977,6 +1025,84 @@ def cmd_volume_deregister(args) -> int:
     return 0
 
 
+def cmd_operator_debug(args) -> int:
+    """Capture a debug archive (command/operator_debug.go): cluster
+    state, agent info, metrics sampled over -duration at -interval,
+    pprof analogs, and the monitor log — bundled as a .tar.gz the
+    operator attaches to a support ticket."""
+    import io
+    import tarfile
+    c = _client(args)
+    # a zero/negative interval would busy-loop metrics captures against
+    # the very agent being debugged
+    args.interval = max(args.interval, 0.2)
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    out_path = args.output or f"nomad-debug-{stamp}.tar.gz"
+    root = f"nomad-debug-{stamp}"
+    captures = 0
+
+    try:
+        tar = tarfile.open(out_path, "w:gz")
+    except OSError as e:
+        print(f"Error opening {out_path}: {e}", file=sys.stderr)
+        return 1
+
+    def add(name: str, payload) -> None:
+        nonlocal captures
+        if not isinstance(payload, (bytes, bytearray)):
+            payload = json.dumps(payload, indent=2,
+                                 default=str).encode()
+        info = tarfile.TarInfo(f"{root}/{name}")
+        info.size = len(payload)
+        info.mtime = int(time.time())
+        tar.addfile(info, io.BytesIO(bytes(payload)))
+        captures += 1
+
+    def try_add(name: str, fn) -> None:
+        try:
+            add(name, fn())
+        except Exception as e:
+            add(name + ".error", {"error": str(e)})
+
+    # one-shot cluster captures
+    try_add("agent-self.json", c.agent_self)
+    try_add("members.json",
+            lambda: c._request("GET", "/v1/operator/members"))
+    try_add("raft-status.json",
+            lambda: c._request("GET", "/v1/operator/raft/configuration"))
+    try_add("autopilot.json", c.autopilot_config)
+    try_add("scheduler-config.json", c.scheduler_config)
+    try_add("nomad/jobs.json", c.list_jobs)
+    try_add("nomad/nodes.json", c.list_nodes)
+    try_add("nomad/allocations.json", c.list_allocations)
+    try_add("nomad/deployments.json", c.list_deployments)
+    try_add("nomad/volumes.json", c.list_volumes)
+    try_add("pprof/threads.json", c.agent_threads)
+    try_add("pprof/profile.json",
+            lambda: c.agent_profile(seconds=min(args.duration, 2.0)))
+
+    # interval captures over the window (metrics time series)
+    end = time.time() + max(args.duration, 0.0)
+    i = 0
+    while True:
+        try_add(f"metrics/metrics_{i:03d}.json", c.metrics)
+        i += 1
+        if time.time() >= end:
+            break
+        time.sleep(min(args.interval, max(end - time.time(), 0.0)))
+
+    add("index.json", {
+        "timestamp": stamp,
+        "duration_s": args.duration,
+        "interval_s": args.interval,
+        "captures": captures,
+        "cli": "nomad-tpu operator debug",
+    })
+    tar.close()
+    print(f"Created debug archive: {out_path} ({captures} captures)")
+    return 0
+
+
 def cmd_operator_snapshot_save(args) -> int:
     c = _client(args)
     try:
@@ -1316,6 +1442,10 @@ def build_parser() -> argparse.ArgumentParser:
     run = job.add_parser("run")
     run.add_argument("jobfile")
     run.add_argument("-detach", action="store_true")
+    run.add_argument("-check-index", dest="check_index", type=int,
+                     default=None,
+                     help="enforce the job's modify index (CAS submit; "
+                          "0 = job must not exist)")
     run.add_argument("-var", action="append",
                      help="variable value key=value (repeatable)")
     run.set_defaults(fn=cmd_job_run)
@@ -1408,6 +1538,9 @@ def build_parser() -> argparse.ArgumentParser:
     ndrain.add_argument("-enable", action="store_true")
     ndrain.add_argument("-disable", action="store_true")
     ndrain.add_argument("-deadline", type=float, default=0.0)
+    ndrain.add_argument("-monitor", action="store_true",
+                        help="block and report until the drain "
+                             "completes")
     ndrain.set_defaults(fn=cmd_node_drain)
 
     alloc = sub.add_parser("alloc").add_subparsers(dest="sub")
@@ -1459,6 +1592,14 @@ def build_parser() -> argparse.ArgumentParser:
     op = sub.add_parser("operator").add_subparsers(dest="sub")
     oraft = op.add_parser("raft-status")
     oraft.set_defaults(fn=cmd_operator_raft)
+    odebug = op.add_parser("debug")
+    odebug.add_argument("-duration", type=float, default=2.0,
+                        help="seconds of interval captures")
+    odebug.add_argument("-interval", type=float, default=1.0)
+    odebug.add_argument("-output", default="",
+                        help="archive path (default "
+                             "nomad-debug-<ts>.tar.gz)")
+    odebug.set_defaults(fn=cmd_operator_debug)
     osave = op.add_parser("snapshot-save")
     osave.add_argument("file")
     osave.set_defaults(fn=cmd_operator_snapshot_save)

@@ -198,12 +198,17 @@ class DockerAPI:
         return out[0], out[1]
 
 
-def _pid_is_docklog(pid) -> bool:
+def _pid_is_docklog(pid, cid: str = "") -> bool:
     """A recycled pid must not masquerade as a live docklog: verify
-    the process actually runs the docklog module."""
+    the process runs the docklog module FOR THIS CONTAINER (the
+    container id rides argv precisely so this check can tell two
+    docklogs apart after pid reuse)."""
     try:
         with open(f"/proc/{pid}/cmdline", "rb") as f:
-            return b"nomad_tpu.client.docklog" in f.read()
+            cmdline = f.read()
+        if b"nomad_tpu.client.docklog" not in cmdline:
+            return False
+        return (cid[:12].encode() in cmdline) if cid else True
     except OSError:
         return False
 
@@ -338,6 +343,9 @@ class DockerDriver:
                 h.docklog_pid = self._spawn_docklog(
                     cid, task_name, log_dir, ctx)
                 h.log_dir = log_dir
+                h.log_max_files = int(ctx.get("log_max_files", 10))
+                h.log_max_file_size_mb = int(
+                    ctx.get("log_max_file_size_mb", 10))
                 docklog_ok = True
             except Exception:
                 LOG.exception("docklog spawn for %s failed; falling "
@@ -379,7 +387,8 @@ class DockerDriver:
                     ctx.get("log_max_file_size_mb", 10)),
                 "since": since}
         proc = subprocess.Popen(
-            [_sys.executable, "-m", "nomad_tpu.client.docklog"],
+            [_sys.executable, "-m", "nomad_tpu.client.docklog",
+             cid[:12]],
             env=child_process_env(),
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, start_new_session=True)
@@ -398,9 +407,29 @@ class DockerDriver:
             except Exception:
                 pass
             raise RuntimeError("docklog failed to start streaming")
-        # detached on purpose: nobody waits on it from here; the reap
-        # thread avoids zombies while the client is alive
-        threading.Thread(target=proc.wait, daemon=True,
+        # reap + watchdog: a docklog that dies while the container
+        # still runs is respawned (resuming from now) so log capture
+        # doesn't silently stop mid-task
+        def reap_and_respawn():
+            proc.wait()
+            for _attempt in range(3):
+                try:
+                    info = self.api.inspect(cid)
+                except (DockerAPIError, OSError):
+                    return
+                if not (info.get("State") or {}).get("Running"):
+                    return          # normal end-of-task exit
+                LOG.warning("docklog for %s died mid-task; respawning",
+                            cid[:12])
+                try:
+                    self._spawn_docklog(cid, task_name, log_dir, ctx,
+                                        since=int(time.time()))
+                    return          # the new spawn has its own watchdog
+                except Exception:
+                    LOG.exception("docklog respawn failed")
+                    time.sleep(1.0)
+
+        threading.Thread(target=reap_and_respawn, daemon=True,
                          name=f"docklog-reap-{cid[:12]}").start()
         return proc.pid
 
@@ -500,15 +529,17 @@ class DockerDriver:
         dl_pid = state.get("docklog_pid")
         log_dir = state.get("log_dir") or ""
         if dl_pid and log_dir:
-            alive = _pid_is_docklog(dl_pid)
-            if alive:
+            log_ctx = {"log_max_files": state.get("log_max_files", 10),
+                       "log_max_file_size_mb":
+                           state.get("log_max_file_size_mb", 10)}
+            if _pid_is_docklog(dl_pid, cid):
                 h.docklog_pid = dl_pid
                 h.log_dir = log_dir
             else:
                 try:
                     h.docklog_pid = self._spawn_docklog(
                         cid, state.get("task_name", "task"), log_dir,
-                        {}, since=int(time.time()))
+                        log_ctx, since=int(time.time()))
                     h.log_dir = log_dir
                 except Exception:
                     LOG.exception("docklog respawn for %s failed",

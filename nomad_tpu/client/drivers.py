@@ -70,6 +70,9 @@ class TaskHandle:
             if dp:
                 out["docklog_pid"] = dp
                 out["log_dir"] = getattr(self, "log_dir", "")
+                out["log_max_files"] = getattr(self, "log_max_files", 10)
+                out["log_max_file_size_mb"] = getattr(
+                    self, "log_max_file_size_mb", 10)
         mon = getattr(self, "monitor_path", None)
         if mon:
             out["monitor_path"] = mon

@@ -134,6 +134,9 @@ class Server:
         self._hb_lock = threading.Lock()
         self._leader = False
         self._member_l = threading.Lock()   # join/leave RMW serialization
+        # serializes enforced (-check-index) registrations: the CAS
+        # check and the apply must not interleave across HTTP threads
+        self._register_l = threading.Lock()
         self._acl_cache: Dict = {}      # (policies, index) -> compiled ACL
         self.raft = None                # multi-server consensus (raft.py)
         self.swim = None                # peer failure detection (swim.py)
@@ -777,13 +780,42 @@ class Server:
 
     # -- north-bound API (the RPC endpoint surface) --------------------
     def register_job(self, job: Job,
-                     triggered_by: str = TRIGGER_JOB_REGISTER
+                     triggered_by: str = TRIGGER_JOB_REGISTER,
+                     enforce_index: bool = False,
+                     job_modify_index: int = 0
                      ) -> Optional[Evaluation]:
         """Job.Register (nomad/job_endpoint.go:79): the admission
         pipeline — canonicalize, implied constraints, validate — then
         upsert and create an eval. Periodic and parameterized jobs
         get no eval — the dispatcher / Job.Dispatch creates child jobs
-        which do (job_endpoint.go:236-247)."""
+        which do (job_endpoint.go:236-247). With `enforce_index`, the
+        register is a compare-and-set against the job's current modify
+        index (`job run -check-index`; job_endpoint.go:175
+        RegisterEnforceIndexErrPrefix): 0 means "must not exist"."""
+        if enforce_index:
+            # check-and-apply must be atomic w.r.t. sibling enforced
+            # registrations (two HTTP threads both reading index 7 and
+            # both winning would be the lost update CAS exists to stop)
+            with self._register_l:
+                current = self.store.job_by_id(job.namespace, job.id)
+                cur_idx = current.job_modify_index \
+                    if current is not None else 0
+                if current is None and job_modify_index != 0:
+                    raise ValueError(
+                        "Enforcing job modify index "
+                        f"{job_modify_index}: job does not exist")
+                if current is not None and \
+                        job_modify_index != cur_idx:
+                    raise ValueError(
+                        "Enforcing job modify index "
+                        f"{job_modify_index}: job exists with "
+                        f"conflicting job modify index: {cur_idx}")
+                return self._register_job_validated(job, triggered_by)
+        return self._register_job_validated(job, triggered_by)
+
+    def _register_job_validated(self, job: Job,
+                                triggered_by: str
+                                ) -> Optional[Evaluation]:
         job.canonicalize()
         # multiregion fan-out (job_endpoint.go:328 multiregionRegister
         # — enterprise in the reference, implemented here over the

@@ -97,3 +97,95 @@ def test_snapshot_save_inspect_restore(cluster, tmp_path):
     _rc, out = run(addr, "operator", "snapshot-restore", str(snap))
     assert "restored" in out
     assert srv.store.job_by_id("default", "smoke-job") is not None
+
+
+def test_operator_debug_archive(cluster, tmp_path):
+    """operator debug bundles cluster state + interval metrics + pprof
+    into a tar.gz (command/operator_debug.go)."""
+    import tarfile
+    _s, addr = cluster
+    out_path = str(tmp_path / "debug.tar.gz")
+    rc, out = run(addr, "operator", "debug", "-duration", "1",
+                  "-interval", "0.5", "-output", out_path)
+    assert rc == 0, out
+    assert "Created debug archive" in out
+    with tarfile.open(out_path) as tar:
+        names = tar.getnames()
+        base = names[0].split("/")[0]
+        expect = ["agent-self.json", "members.json", "raft-status.json",
+                  "nomad/jobs.json", "nomad/nodes.json",
+                  "pprof/threads.json", "index.json",
+                  "metrics/metrics_000.json", "metrics/metrics_001.json"]
+        for n in expect:
+            assert f"{base}/{n}" in names, (n, names)
+        idx = json.load(tar.extractfile(f"{base}/index.json"))
+        assert idx["captures"] >= len(expect)
+        jobs = json.load(tar.extractfile(f"{base}/nomad/jobs.json"))
+        assert any(j["ID"] == "smoke-job" for j in jobs)
+
+
+def test_job_run_check_index(cluster, tmp_path):
+    """job run -check-index is a CAS submit (job_endpoint.go
+    EnforceIndex): stale indexes are rejected, the current one wins,
+    and 0 means the job must not exist."""
+    _s, addr = cluster
+    jobfile = tmp_path / "cas.nomad"
+    rc, _ = run(addr, "job", "init", str(jobfile))
+    assert rc == 0
+
+    # 0 = must not exist: first submit succeeds
+    rc, out = run(addr, "job", "run", "-detach", "-check-index", "0",
+                  str(jobfile))
+    assert rc == 0, out
+    # 0 again: now it exists -> rejected
+    rc, out = run(addr, "job", "run", "-detach", "-check-index", "0",
+                  str(jobfile))
+    assert rc != 0
+    # wrong index -> rejected with the current index in the error
+    rc, out = run(addr, "job", "run", "-detach", "-check-index",
+                  "999999", str(jobfile))
+    assert rc != 0
+    # the real index -> accepted
+    import urllib.request
+    data = json.load(urllib.request.urlopen(f"{addr}/v1/job/example"))
+    cur = data["job_modify_index"]
+    rc, out = run(addr, "job", "run", "-detach", "-check-index",
+                  str(cur), str(jobfile))
+    assert rc == 0, out
+
+
+def test_node_drain_monitor(tmp_path):
+    """node drain -monitor blocks until the node is drained
+    (command/node_drain.go -monitor)."""
+    from nomad_tpu.client import Client, ClientConfig
+    srv = Server(ServerConfig(num_schedulers=1, heartbeat_ttl_s=30.0))
+    srv.start()
+    api = HTTPApiServer(srv, port=0)
+    api.start()
+    client = Client(srv, ClientConfig(node_name="drainme",
+                                      alloc_dir=str(tmp_path)))
+    client.start()
+    addr = f"http://127.0.0.1:{api.port}"
+    try:
+        job = mock.batch_job()
+        job.id = "drain-job"
+        job.type = "service"
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0].config = {"run_for": "60s"}
+        job.canonicalize()
+        srv.register_job(job)
+        import time as _t
+        deadline = _t.time() + 20
+        while _t.time() < deadline and not any(
+                a.client_status == "running"
+                for a in srv.store.allocs_by_job("default", job.id)):
+            _t.sleep(0.1)
+        rc, out = run(addr, "node", "drain", client.node.id, "-enable",
+                      "-monitor")
+        assert rc == 0, out
+        assert "Drain complete" in out or "drain strategy cleared" in out
+    finally:
+        client.shutdown()
+        api.shutdown()
+        srv.shutdown()
