@@ -1,0 +1,149 @@
+"""C2M steady-state soak under the GC-safepoint regime.
+
+VERDICT r4 item 7: the latency numbers are conditioned on the
+safepoint regime (automatic collection off), and nothing demonstrated
+a long C2M run keeps RSS bounded while full collections are deferred.
+This soak runs continuous service scheduling against the 2M-alloc
+substrate for `minutes`, with the regime exactly as the agent runs it
+(gcsafe enter + steady-state freeze + the gen-2 full-collect budget),
+and records per-minute windows of eval latency, RSS, tracked-object
+count, and collection counters. The driver-committed artifact is
+SOAK_r05.json.
+
+Usage: python -m nomad_tpu.bench.soak [minutes] [n_nodes] [seed_allocs]
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+import time
+from typing import Dict, List
+
+
+def _rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+def run_soak(minutes: float = 25.0, n_nodes: int = 50000,
+             seed_allocs: int = 2_000_000) -> Dict:
+    from ..bench.ladder import _eval_for, _seed_nodes, seed_c2m_allocs
+    from ..mock import fixtures as mock
+    from ..models import Affinity, Spread, SpreadTarget
+    from ..scheduler.harness import Harness
+    from ..utils import gcsafe
+
+    out: Dict = {"minutes": minutes, "n_nodes": n_nodes,
+                 "seed_allocs": seed_allocs, "windows": []}
+    gcsafe.enter()
+    try:
+        h = Harness()
+        nodes = _seed_nodes(h, n_nodes)
+        seed_c2m_allocs(h, nodes, seed_allocs)
+        h.store.snapshot().node_table()
+        gcsafe.freeze_steady_state()
+        out["rss_after_seed_mb"] = round(_rss_mb(), 1)
+        out["frozen_objects"] = gc.get_freeze_count()
+
+        dcs = [f"dc{d}" for d in (1, 2, 3, 4)]
+
+        def make_svc(i):
+            svc = mock.job()
+            svc.id = f"soak-svc-{i}"
+            svc.datacenters = dcs
+            tg = svc.task_groups[0]
+            tg.count = 10
+            for t in tg.tasks:
+                t.resources.networks = []
+            tg.networks = []
+            tg.spreads = [Spread(attribute="${node.datacenter}",
+                                 weight=50,
+                                 spread_target=[SpreadTarget("dc1", 40),
+                                                SpreadTarget("dc2", 30)])]
+            tg.affinities = [Affinity(ltarget="${meta.rack}",
+                                      rtarget="r3", operand="=",
+                                      weight=50)]
+            return svc
+
+        # warm compiles outside the measured windows
+        for w in range(3):
+            warm = make_svc(10**6 + w)
+            h.store.upsert_job(h.next_index(), warm)
+            h.process("service", _eval_for(warm))
+
+        end = time.time() + minutes * 60.0
+        i = 0
+        window_end = time.time() + 60.0
+        lat: List[float] = []
+        evals_total = 0
+        while time.time() < end:
+            svc = make_svc(i)
+            # stop the previous wave's job so the substrate stays at
+            # steady state instead of monotonically accumulating
+            if i >= 50:
+                old = f"soak-svc-{i - 50}"
+                h.store.delete_job(h.next_index(), "default", old)
+            h.store.upsert_job(h.next_index(), svc)
+            t0 = time.perf_counter()
+            h.process("service", _eval_for(svc))
+            lat.append(time.perf_counter() - t0)
+            gcsafe.safepoint()
+            i += 1
+            evals_total += 1
+            if time.time() >= window_end:
+                import numpy as np
+                arr = np.array(lat) * 1e3
+                counts = gc.get_count()
+                out["windows"].append({
+                    "t_min": round((time.time() - (end - minutes * 60))
+                                   / 60.0, 1),
+                    "evals": len(lat),
+                    "p50_ms": round(float(np.percentile(arr, 50)), 1),
+                    "p99_ms": round(float(np.percentile(arr, 99)), 1),
+                    "rss_mb": round(_rss_mb(), 1),
+                    "gc_counts": list(counts),
+                    "tracked_objects": len(gc.get_objects()),
+                })
+                print(json.dumps(out["windows"][-1]), flush=True)
+                lat = []
+                window_end = time.time() + 60.0
+        out["evals_total"] = evals_total
+        rss = [w["rss_mb"] for w in out["windows"]]
+        objs = [w["tracked_objects"] for w in out["windows"]]
+        if len(rss) >= 2:
+            out["rss_growth_mb"] = round(rss[-1] - rss[0], 1)
+            out["rss_growth_mb_per_hour"] = round(
+                (rss[-1] - rss[0]) / max(minutes / 60.0, 1e-9), 1)
+            out["tracked_growth"] = objs[-1] - objs[0]
+        out["p99_ms_first_window"] = out["windows"][0]["p99_ms"] \
+            if out["windows"] else None
+        out["p99_ms_last_window"] = out["windows"][-1]["p99_ms"] \
+            if out["windows"] else None
+    finally:
+        gcsafe.exit_()
+        gcsafe.unfreeze_steady_state()
+    return out
+
+
+def main() -> int:
+    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 25.0
+    n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 50000
+    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 2_000_000
+    out = run_soak(minutes, n_nodes, seed)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "SOAK_r05.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items()
+                      if k != "windows"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
