@@ -292,6 +292,9 @@ class ApiClient:
         return self._request("GET",
                              "/v1/operator/autopilot/configuration")
 
+    def governor(self) -> dict:
+        return self._request("GET", "/v1/operator/governor")
+
     def set_autopilot_config(self, config: dict) -> dict:
         return self._request("PUT",
                              "/v1/operator/autopilot/configuration",

@@ -872,6 +872,15 @@ class HTTPApiServer:
                 return {"index": store.latest_index()}, \
                     store.latest_index()
 
+        # steady-state governor status (governor/): registered gauges
+        # with watermark state, backpressure, and the structured event
+        # log (watermark crossings, reclaims, drift findings)
+        if path == "/v1/operator/governor" and method == "GET":
+            gov = getattr(s, "governor", None)
+            if gov is None:
+                return {"enabled": False}, idx
+            return gov.status(), idx
+
         # operator autopilot configuration (nomad/operator_endpoint.go
         # AutopilotGetConfiguration / AutopilotSetConfiguration)
         if path == "/v1/operator/autopilot/configuration":

@@ -1,16 +1,22 @@
-"""C2M steady-state soak under the GC-safepoint regime.
+"""C2M steady-state soak under the GC-safepoint regime, with the
+governor engaged and a pass/fail flatness verdict.
 
-VERDICT r4 item 7: the latency numbers are conditioned on the
-safepoint regime (automatic collection off), and nothing demonstrated
-a long C2M run keeps RSS bounded while full collections are deferred.
-This soak runs continuous service scheduling against the 2M-alloc
-substrate for `minutes`, with the regime exactly as the agent runs it
-(gcsafe enter + steady-state freeze + the gen-2 full-collect budget),
-and records per-minute windows of eval latency, RSS, tracked-object
-count, and collection counters. The driver-committed artifact is
-SOAK_r05.json.
+VERDICT r4 item 7 created this soak; the round-5 artifact
+(SOAK_r05.json) then showed the system does NOT hold its numbers:
+p99 drifted 69.5 -> 208 ms, throughput decayed ~3.4x, RSS grew
+~875 MB/hour. Round 6 adds the steady-state governor (governor/) and
+this soak now (a) runs the leak-closing regime the agent runs —
+bounded harness history, eval/alloc reaping of dead waves (the
+core_sched GC analog; the bare Harness has no GC loop), periodic
+governor sampling with store layer compaction — and (b) emits a
+machine-checkable flatness verdict: max p99 drift ratio and max RSS
+slope, recorded in the JSON artifact so the driver (and
+tests/test_soak_smoke.py) can fail a regression instead of an
+operator eyeballing windows.
 
 Usage: python -m nomad_tpu.bench.soak [minutes] [n_nodes] [seed_allocs]
+Env:   NOMAD_TPU_SOAK_OUT overrides the artifact path
+       (default <repo>/SOAK_r06.json).
 """
 
 from __future__ import annotations
@@ -20,28 +26,95 @@ import json
 import os
 import sys
 import time
+from statistics import median
 from typing import Dict, List
 
+# acceptance thresholds (ISSUE r6): the soak passes when p99 in the
+# last window-half stays within this ratio of the first half and RSS
+# grows no faster than this slope
+MAX_P99_DRIFT_RATIO = 1.5
+MAX_RSS_SLOPE_MB_PER_HOUR = 100.0
 
-def _rss_mb() -> float:
-    with open("/proc/self/status") as f:
-        for line in f:
-            if line.startswith("VmRSS:"):
-                return int(line.split()[1]) / 1024.0
-    return 0.0
+
+# one RSS reader and one regression: shared with the governor
+from ..governor.drift import least_squares_slope
+from ..governor.governor import rss_mb as _rss_mb
+
+
+def _slope_per_hour(ts_min: List[float], values: List[float]) -> float:
+    """Least-squares slope in units/hour over (minutes, value) points —
+    robust to one noisy endpoint, unlike last-minus-first."""
+    return least_squares_slope(list(zip(ts_min, values))) * 60.0
+
+
+def flatness_verdict(windows: List[Dict],
+                     max_p99_ratio: float = MAX_P99_DRIFT_RATIO,
+                     max_rss_slope: float = MAX_RSS_SLOPE_MB_PER_HOUR,
+                     warmup_windows: int = 1) -> Dict:
+    """The machine-checkable steady-state verdict over per-window
+    samples. p99 drift is median-of-last-half over median-of-first-half
+    (single-window spikes don't flip the verdict); RSS slope is the
+    least-squares fit across the measured windows.
+
+    The first `warmup_windows` are excluded when enough windows remain
+    (>=3 measured): the run's BOUNDED structures (identity memos,
+    changelog ring, harness history, JIT caches) legitimately fill to
+    their plateau during the first window, and a steady-state verdict
+    judges the plateau, not the fill — the r6 6-min run measured
+    +29 MB in window 1-2 and then three windows of RSS flat to 0.1 MB.
+    The exclusion is recorded in the verdict."""
+    out: Dict = {"max_p99_drift_ratio": max_p99_ratio,
+                 "max_rss_slope_mb_per_hour": max_rss_slope}
+    if len(windows) - warmup_windows >= 3:
+        windows = windows[warmup_windows:]
+        out["warmup_windows_excluded"] = warmup_windows
+    else:
+        out["warmup_windows_excluded"] = 0
+    if len(windows) < 2:
+        out.update({"pass": False, "reason": "fewer than 2 windows"})
+        return out
+    p99 = [w["p99_ms"] for w in windows]
+    half = max(1, len(p99) // 2)
+    # median of each half: real drift raises every late window (and
+    # the median with it); one noisy-neighbor window must not flip a
+    # steady-state verdict the other five windows contradict
+    first = median(p99[:half])
+    last = median(p99[len(p99) - half:])
+    ratio = (last / first) if first > 0 else 1.0
+    rss_slope = _slope_per_hour([w["t_min"] for w in windows],
+                                [w["rss_mb"] for w in windows])
+    out["p99_drift_ratio"] = round(ratio, 3)
+    out["p99_first_half_ms"] = round(first, 1)
+    out["p99_last_half_ms"] = round(last, 1)
+    out["rss_slope_mb_per_hour"] = round(rss_slope, 1)
+    out["pass"] = bool(ratio <= max_p99_ratio
+                       and rss_slope <= max_rss_slope)
+    if not out["pass"]:
+        reasons = []
+        if ratio > max_p99_ratio:
+            reasons.append(f"p99 drift {ratio:.2f}x > {max_p99_ratio}x")
+        if rss_slope > max_rss_slope:
+            reasons.append(f"rss slope {rss_slope:.0f} MB/h > "
+                           f"{max_rss_slope:.0f} MB/h")
+        out["reason"] = "; ".join(reasons)
+    return out
 
 
 def run_soak(minutes: float = 25.0, n_nodes: int = 50000,
-             seed_allocs: int = 2_000_000) -> Dict:
+             seed_allocs: int = 2_000_000,
+             window_s: float = 60.0, wave_depth: int = 50) -> Dict:
     from ..bench.ladder import _eval_for, _seed_nodes, seed_c2m_allocs
+    from ..governor import Governor, WatermarkPolicy
     from ..mock import fixtures as mock
     from ..models import Affinity, Spread, SpreadTarget
     from ..scheduler.harness import Harness
     from ..utils import gcsafe
 
     out: Dict = {"minutes": minutes, "n_nodes": n_nodes,
-                 "seed_allocs": seed_allocs, "windows": []}
+                 "seed_allocs": seed_allocs, "window_s": window_s,
+                 "windows": []}
     gcsafe.enter()
+    gov = Governor()
     try:
         h = Harness()
         nodes = _seed_nodes(h, n_nodes)
@@ -50,6 +123,25 @@ def run_soak(minutes: float = 25.0, n_nodes: int = 50000,
         gcsafe.freeze_steady_state()
         out["rss_after_seed_mb"] = round(_rss_mb(), 1)
         out["frozen_objects"] = gc.get_freeze_count()
+
+        # the governor's accounting half, driven synchronously (no
+        # thread — deterministic sampling between evals): store layer
+        # debt with fold compaction, table cardinality, event history
+        # (none here — harness has no broker), kernel caches
+        from ..ops.select import (clear_kernel_caches,
+                                  kernel_cache_entries)
+        gov.register("state.version_debt", h.store.version_debt,
+                     WatermarkPolicy(100_000, min_reclaim_interval_s=1.0),
+                     reclaim=lambda: h.store.compact(min_tip=1024))
+        gov.register("state.allocs",
+                     lambda: len(h.store._root.table("allocs")))
+        gov.register("state.evals",
+                     lambda: len(h.store._root.table("evals")))
+        gov.register("state.changelog", h.store.changelog_len)
+        gov.register("kernel_cache.entries", kernel_cache_entries,
+                     WatermarkPolicy(256), reclaim=clear_kernel_caches)
+        from ..ops.tables import resource_memo_len
+        gov.register("node_table.resource_memo", resource_memo_len)
 
         dcs = [f"dc{d}" for d in (1, 2, 3, 4)]
 
@@ -71,60 +163,107 @@ def run_soak(minutes: float = 25.0, n_nodes: int = 50000,
                                       weight=50)]
             return svc
 
+        def reap_job(job_id: str) -> None:
+            """The core_sched eval/alloc GC analog for a stopped wave:
+            delete the wave's evals AND its allocs so the substrate
+            holds steady state instead of accreting dead rows (one of
+            the r5 soak leaks — delete_job removed the job but left
+            its allocs resident forever)."""
+            snap = h.store.snapshot()
+            eval_ids = [e.id for e in
+                        snap.evals_by_job("default", job_id)]
+            alloc_ids = [a.id for a in
+                         snap.allocs_by_job("default", job_id)]
+            if eval_ids or alloc_ids:
+                h.store.delete_evals(h.next_index(), eval_ids,
+                                     alloc_ids)
+
         # warm compiles outside the measured windows
         for w in range(3):
             warm = make_svc(10**6 + w)
             h.store.upsert_job(h.next_index(), warm)
             h.process("service", _eval_for(warm))
+        for w in range(3):
+            wid = f"soak-svc-{10**6 + w}"
+            reap_job(wid)
+            h.store.delete_job(h.next_index(), "default", wid)
 
         end = time.time() + minutes * 60.0
         i = 0
-        window_end = time.time() + 60.0
+        t_start = time.time()
+        window_end = time.time() + window_s
         lat: List[float] = []
         evals_total = 0
+        cpu_mark = time.process_time()
         while time.time() < end:
             svc = make_svc(i)
             # stop the previous wave's job so the substrate stays at
             # steady state instead of monotonically accumulating
-            if i >= 50:
-                old = f"soak-svc-{i - 50}"
+            if i >= wave_depth:
+                old = f"soak-svc-{i - wave_depth}"
+                reap_job(old)
                 h.store.delete_job(h.next_index(), "default", old)
             h.store.upsert_job(h.next_index(), svc)
             t0 = time.perf_counter()
             h.process("service", _eval_for(svc))
-            lat.append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            lat.append(dt)
+            gov.observe_eval_latency(dt)
             gcsafe.safepoint()
             i += 1
             evals_total += 1
+            if i % 25 == 0:
+                gov.sample_once()
             if time.time() >= window_end:
                 import numpy as np
                 arr = np.array(lat) * 1e3
                 counts = gc.get_count()
+                gov.sample_once()
+                cpu_now = time.process_time()
                 out["windows"].append({
-                    "t_min": round((time.time() - (end - minutes * 60))
-                                   / 60.0, 1),
+                    "t_min": round((time.time() - t_start) / 60.0, 2),
                     "evals": len(lat),
+                    # process CPU seconds consumed this window: if wall
+                    # p99 rises while cpu-per-eval stays flat, the
+                    # drift is the host's, not ours
+                    "cpu_s": round(cpu_now - cpu_mark, 1),
+                    "cpu_ms_per_eval": round(
+                        1000.0 * (cpu_now - cpu_mark)
+                        / max(len(lat), 1), 2),
                     "p50_ms": round(float(np.percentile(arr, 50)), 1),
                     "p99_ms": round(float(np.percentile(arr, 99)), 1),
                     "rss_mb": round(_rss_mb(), 1),
                     "gc_counts": list(counts),
                     "tracked_objects": len(gc.get_objects()),
+                    "version_debt": h.store.version_debt(),
+                    "store_allocs": len(
+                        h.store._root.table("allocs")),
+                    "governor_reclaims": sum(
+                        g["reclaims"] for g in gov.registry.rows()),
                 })
                 print(json.dumps(out["windows"][-1]), flush=True)
                 lat = []
-                window_end = time.time() + 60.0
+                cpu_mark = time.process_time()
+                window_end = time.time() + window_s
         out["evals_total"] = evals_total
         rss = [w["rss_mb"] for w in out["windows"]]
         objs = [w["tracked_objects"] for w in out["windows"]]
         if len(rss) >= 2:
             out["rss_growth_mb"] = round(rss[-1] - rss[0], 1)
             out["rss_growth_mb_per_hour"] = round(
-                (rss[-1] - rss[0]) / max(minutes / 60.0, 1e-9), 1)
+                _slope_per_hour([w["t_min"] for w in out["windows"]],
+                                rss), 1)
             out["tracked_growth"] = objs[-1] - objs[0]
         out["p99_ms_first_window"] = out["windows"][0]["p99_ms"] \
             if out["windows"] else None
         out["p99_ms_last_window"] = out["windows"][-1]["p99_ms"] \
             if out["windows"] else None
+        out["flatness"] = flatness_verdict(out["windows"])
+        out["governor"] = {
+            "gauges": gov.registry.rows(),
+            "events": gov.events(20),
+            "backpressure": gov.backpressure(),
+        }
     finally:
         gcsafe.exit_()
         gcsafe.unfreeze_steady_state()
@@ -136,13 +275,14 @@ def main() -> int:
     n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 50000
     seed = int(sys.argv[3]) if len(sys.argv) > 3 else 2_000_000
     out = run_soak(minutes, n_nodes, seed)
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))), "SOAK_r05.json")
+    path = os.environ.get("NOMAD_TPU_SOAK_OUT") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "SOAK_r06.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({k: v for k, v in out.items()
-                      if k != "windows"}))
-    return 0
+                      if k not in ("windows", "governor")}))
+    return 0 if out.get("flatness", {}).get("pass") else 1
 
 
 if __name__ == "__main__":

@@ -48,6 +48,7 @@ class AgentFileConfig:
     alloc_dir: str = ""
     state_dir: str = ""
     meta: dict = field(default_factory=dict)
+    cloud_fingerprint: Optional[bool] = None
 
 
 def load_agent_config(path: str) -> AgentFileConfig:
@@ -93,6 +94,8 @@ def load_agent_config(path: str) -> AgentFileConfig:
         cfg.alloc_dir = cli.get("alloc_dir", "")
         cfg.state_dir = cli.get("state_dir", "")
         cfg.meta = dict(cli.get("meta", {}))
+        if "cloud_fingerprint" in cli:
+            cfg.cloud_fingerprint = bool(cli["cloud_fingerprint"])
     return cfg
 
 
@@ -139,3 +142,6 @@ def apply_to_args(cfg: AgentFileConfig, args) -> None:
         args.replication_token = cfg.replication_token
     if cfg.meta:
         args.client_meta = cfg.meta
+    if cfg.cloud_fingerprint is not None and \
+            not getattr(args, "cloud_fingerprint", False):
+        args.cloud_fingerprint = cfg.cloud_fingerprint

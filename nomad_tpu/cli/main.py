@@ -127,7 +127,8 @@ def cmd_agent(args) -> int:
         alloc_dir=args.alloc_dir_base,
         state_dir=getattr(args, "state_dir", None) or None,
         datacenter=getattr(args, "datacenter", "") or "dc1",
-        meta=getattr(args, "client_meta", None) or {})
+        meta=getattr(args, "client_meta", None) or {},
+        cloud_fingerprint=getattr(args, "cloud_fingerprint", False))
     for i in range(n_local_clients):
         if server is not None:
             c = Client(server, ClientConfig(
@@ -1071,6 +1072,7 @@ def cmd_operator_debug(args) -> int:
     try_add("raft-status.json",
             lambda: c._request("GET", "/v1/operator/raft/configuration"))
     try_add("autopilot.json", c.autopilot_config)
+    try_add("governor.json", c.governor)
     try_add("scheduler-config.json", c.scheduler_config)
     try_add("nomad/jobs.json", c.list_jobs)
     try_add("nomad/nodes.json", c.list_nodes)
@@ -1100,6 +1102,53 @@ def cmd_operator_debug(args) -> int:
     })
     tar.close()
     print(f"Created debug archive: {out_path} ({captures} captures)")
+    return 0
+
+
+def cmd_operator_governor(args) -> int:
+    """Steady-state governor status (governor/): every governed
+    structure's gauge with watermark state, the backpressure signal,
+    and recent structured events (watermark crossings, reclaims, drift
+    findings)."""
+    c = _client(args)
+    try:
+        out = c.governor()
+    except ApiError as e:
+        print(f"Error querying governor: {e}", file=sys.stderr)
+        return 1
+    if not out.get("enabled", False):
+        print("Governor disabled on this agent")
+        return 0
+    print(f"Backpressure  = {'ENGAGED' if out.get('backpressure') else 'off'}")
+    print(f"Service p99   = {out.get('service_p99_ms', 0.0)} ms "
+          f"({out.get('latency_samples', 0)} samples)")
+    print(f"Process RSS   = {out.get('process_rss_mb', 0.0)} MB")
+    print(f"Samples       = {out.get('samples', 0)} "
+          f"(every {out.get('interval_s', 0)}s)")
+    print()
+    rows = []
+    for g in out.get("gauges", []):
+        high = g.get("high")
+        wm = (f"{g['value']:.0f}/{high:.0f}" if high is not None
+              else f"{g['value']:.0f}")
+        status = g.get("status", "ok") if high is not None else "-"
+        if g.get("pressure"):
+            status += " (pressure)"
+        rows.append([g["name"], wm, g.get("unit", "count"), status,
+                     g.get("reclaims", 0)])
+    _print_rows(rows, ["Structure", "Value/High", "Unit", "Status",
+                       "Reclaims"])
+    events = out.get("events", [])[-10:]
+    if events:
+        print()
+        print(f"Recent events ({len(events)}):")
+        for e in events:
+            ts = time.strftime("%H:%M:%S",
+                               time.localtime(e.get("ts", 0)))
+            kind = e.get("kind", "event")
+            detail = {k: v for k, v in e.items()
+                      if k not in ("ts", "kind")}
+            print(f"  {ts}  {kind:12s} {json.dumps(detail, default=str)}")
     return 0
 
 
@@ -1416,6 +1465,10 @@ def build_parser() -> argparse.ArgumentParser:
                             "(incl. this one) to form a raft cluster")
     agent.add_argument("-alloc-dir", dest="alloc_dir_base", default="",
                        help="base directory for alloc dirs (fs/logs)")
+    agent.add_argument("-cloud-fingerprint", dest="cloud_fingerprint",
+                       action="store_true",
+                       help="probe AWS/GCE/Azure metadata endpoints "
+                            "for platform node attributes")
     # explicit -region on the subparser: without it argparse would
     # abbreviation-match `agent ... -region X` onto -region-peer
     agent.add_argument("-region", default=argparse.SUPPRESS,
@@ -1600,6 +1653,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="archive path (default "
                              "nomad-debug-<ts>.tar.gz)")
     odebug.set_defaults(fn=cmd_operator_debug)
+    ogov = op.add_parser("governor",
+                         help="steady-state governor gauges/watermarks")
+    ogov.set_defaults(fn=cmd_operator_governor)
     osave = op.add_parser("snapshot-save")
     osave.add_argument("file")
     osave.set_defaults(fn=cmd_operator_snapshot_save)

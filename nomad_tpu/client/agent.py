@@ -80,6 +80,11 @@ class ClientConfig:
     # stages/publishes volumes through them (client/pluginmanager/
     # csimanager)
     csi_plugins: tuple = ()
+    # cloud environment probes (client/fingerprint.py — env_aws.go,
+    # env_gce.go, env_azure.go analogs). Off by default: a non-cloud
+    # host would pay three metadata-timeout round trips per agent
+    # start; NOMAD_CLOUD_FINGERPRINT=1 or the agent config turns it on
+    cloud_fingerprint: bool = False
 
 
 def fingerprint_accelerator_devices():
@@ -883,6 +888,12 @@ class Client:
                     fingerprint_accelerator_devices())
         for g in node.node_resources.devices:
             node.attributes[f"device.{g.type}"] = str(len(g.instances))
+        if self.config.cloud_fingerprint or \
+                os.environ.get("NOMAD_CLOUD_FINGERPRINT") == "1":
+            from .fingerprint import fingerprint_cloud
+            attrs, links = fingerprint_cloud()
+            node.attributes.update(attrs)
+            node.links.update(links)
         node.compute_class()
         if self.state_db is not None:
             self.state_db.save_identity(node.id, node.secret_id)

@@ -1,0 +1,43 @@
+"""Steady-state governor: runtime resource governance, drift
+detection, and backpressure.
+
+The round-5 soak (SOAK_r05.json) showed the system does not hold its
+numbers over time: service p99 drifted 69.5 -> 208 ms, placement
+throughput decayed ~3.4x and RSS grew at ~875 MB/hour. The reference
+Nomad keeps long-running servers flat with an auxiliary
+runtime-governance layer (leader GC in nomad/core_sched.go, broker and
+plan-queue EmitStats loops); this package is that layer for the
+repo's long-lived structures:
+
+  accounting  -- GaugeRegistry: every long-lived structure (state
+                 store tables, broker queues, event buffers, kernel
+                 caches) registers a size gauge, sampled on a cadence
+                 alongside process RSS and GC counters.
+  bounding    -- WatermarkPolicy per structure: crossing the high
+                 watermark triggers targeted, rate-limited reclamation
+                 (store layer compaction, event-buffer truncation,
+                 kernel-cache eviction) instead of unbounded growth.
+  backpressure-- when sampled service p99 or queue depth crosses its
+                 watermark the eval broker sheds new work onto an
+                 admission-controlled requeue path and workers shrink
+                 batch lanes, recovering when the gauge clears.
+  drift       -- DriftDetector: rolling-window regression over
+                 throughput/p99/RSS emits structured `governor` events
+                 naming the structure whose growth best explains the
+                 drift (surfaced via /v1/operator/governor, /v1/metrics
+                 counters, and `operator debug` archives).
+"""
+
+from .drift import DriftDetector, RollingSeries
+from .governor import Governor
+from .policy import WatermarkPolicy
+from .registry import GaugeRegistry, Registration
+
+__all__ = [
+    "DriftDetector",
+    "GaugeRegistry",
+    "Governor",
+    "Registration",
+    "RollingSeries",
+    "WatermarkPolicy",
+]

@@ -1,0 +1,242 @@
+"""The governor: sampler loop + backpressure signal + event log.
+
+One Governor per server (or per bench harness). It owns the
+GaugeRegistry and DriftDetector, keeps a bounded reservoir of recent
+eval latencies (the sampled service p99 that the backpressure rule and
+drift detector read), and exposes:
+
+  sample_once()        -- one accounting/bounding/drift step; the
+                          background thread calls it on the cadence,
+                          benches call it explicitly for determinism
+  observe_eval_latency -- workers report per-eval scheduling latency
+  backpressure()       -- admission-control signal: True while any
+                          pressure-marked gauge (queue depth, p99) is
+                          over its watermark; the eval broker's shed
+                          path and the workers' lane shrink read this
+  status()             -- full structured state for
+                          /v1/operator/governor and `operator governor`
+
+Structured events (watermark crossings, reclaims, drift findings) land
+in a bounded ring surfaced by status() and counted in /v1/metrics as
+`nomad.governor.events`; `operator debug` archives capture status()
+alongside the metrics time series.
+"""
+
+from __future__ import annotations
+
+import gc
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from ..utils import metrics
+from .drift import DEGRADES_DOWN, DEGRADES_UP, DriftDetector
+from .policy import STATUS_OVER, WatermarkPolicy
+from .registry import GaugeRegistry, Registration
+
+EVENT_LOG_MAX = 256
+LATENCY_RESERVOIR = 2048
+
+
+def rss_mb() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+class Governor:
+    def __init__(self, interval_s: float = 1.0,
+                 drift_window: int = 120, drift_min_samples: int = 30,
+                 drift_ratio_max: float = 1.5,
+                 drift_check_every: int = 10):
+        self.interval_s = interval_s
+        self.registry = GaugeRegistry()
+        self.drift = DriftDetector(window=drift_window,
+                                   min_samples=drift_min_samples,
+                                   ratio_max=drift_ratio_max)
+        self._drift_check_every = max(1, drift_check_every)
+        self._bp = threading.Event()
+        self._events: deque = deque(maxlen=EVENT_LOG_MAX)
+        self._events_l = threading.Lock()
+        self._lat: deque = deque(maxlen=LATENCY_RESERVOIR)
+        self._lat_l = threading.Lock()
+        self._evals_observed = 0
+        self._last_lat_t = 0.0          # monotonic of newest latency
+        self._last_throughput_mark = (0, 0.0)  # (evals, monotonic)
+        self._samples = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.started_at = time.time()
+
+    # -- registration proxy -------------------------------------------
+    def register(self, name: str,
+                 gauge_fn: Callable[[], float],
+                 watermark: Optional[WatermarkPolicy] = None,
+                 reclaim: Optional[Callable[[], object]] = None,
+                 unit: str = "count",
+                 suspect: bool = True) -> Registration:
+        return self.registry.register(name, gauge_fn, watermark,
+                                      reclaim, unit, suspect)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="governor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample_once()
+            except Exception:
+                import logging
+                logging.getLogger("nomad_tpu.governor").exception(
+                    "governor sample failed")
+
+    # -- observations --------------------------------------------------
+    def observe_eval_latency(self, seconds: float) -> None:
+        with self._lat_l:
+            self._lat.append(seconds * 1000.0)
+            self._evals_observed += 1
+            self._last_lat_t = time.monotonic()
+
+    # the sampled p99 reads the most RECENT slice of the reservoir, so
+    # cold-start JIT compiles (seconds each) age out of the gauge once
+    # warm traffic flows instead of pinning it over the watermark for
+    # the reservoir's whole lifetime
+    P99_WINDOW = 512
+    # p99 readings older than this are not load evidence: while
+    # backpressure sheds enqueues the workers go idle, no new
+    # latencies arrive, and a frozen over-watermark p99 would latch
+    # admission control shut forever. A stale reservoir reads as "no
+    # recent traffic", the gauge drops to 0, hysteresis releases, and
+    # the parked evals re-admit (re-engaging only if still slow).
+    P99_STALE_S = 10.0
+
+    def recent_p99_ms(self) -> float:
+        """The p99 gauge for watermark/backpressure decisions: the
+        reservoir p99 while latencies are flowing, 0.0 once the
+        newest sample is older than P99_STALE_S."""
+        with self._lat_l:
+            if not self._lat or \
+                    time.monotonic() - self._last_lat_t > self.P99_STALE_S:
+                return 0.0
+        return self.p99_ms()
+
+    def p99_ms(self) -> float:
+        with self._lat_l:
+            lat = list(self._lat)[-self.P99_WINDOW:]
+        if not lat:
+            return 0.0
+        lat.sort()
+        return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+    def latency_samples(self) -> int:
+        with self._lat_l:
+            return len(self._lat)
+
+    # -- events --------------------------------------------------------
+    def emit(self, event: dict) -> None:
+        event = dict(event, ts=time.time())
+        with self._events_l:
+            self._events.append(event)
+        metrics.incr_counter("nomad.governor.events")
+        kind = event.get("kind", "event")
+        metrics.incr_counter(f"nomad.governor.events.{kind}")
+
+    def events(self, limit: int = 50) -> List[dict]:
+        with self._events_l:
+            out = list(self._events)
+        return out[-limit:]
+
+    # -- the sampling step ---------------------------------------------
+    def sample_once(self, now: Optional[float] = None) -> List[Registration]:
+        now = time.monotonic() if now is None else now
+        regs = self.registry.sample(now=now, on_event=self.emit)
+
+        # process-level gauges ride every sample
+        rss = rss_mb()
+        metrics.set_gauge("nomad.governor.process.rss_mb", rss)
+        counts = gc.get_count()
+        metrics.set_gauge("nomad.governor.process.gc_gen0", counts[0])
+        # raw reservoir p99, distinct from nomad.governor.service.p99_ms
+        # (the registered gauge's key, gated on warm-up/staleness) —
+        # one name must not carry two disagreeing values
+        p99 = self.p99_ms()
+        metrics.set_gauge("nomad.governor.service.p99_raw_ms", p99)
+
+        # backpressure: any pressure-marked gauge over its watermark
+        over = [r for r in regs
+                if r.watermark is not None and r.watermark.pressure
+                and r.status == STATUS_OVER]
+        was = self._bp.is_set()
+        if over and not was:
+            self._bp.set()
+            self.emit({"kind": "backpressure", "state": "engaged",
+                       "structure": over[0].name,
+                       "value": over[0].value})
+        elif not over and was:
+            self._bp.clear()
+            self.emit({"kind": "backpressure", "state": "released"})
+        metrics.set_gauge("nomad.governor.backpressure",
+                          1.0 if self._bp.is_set() else 0.0)
+
+        # drift series: p99 up = bad, throughput down = bad, rss up =
+        # bad. p99 joins only once latencies exist — zeros are "no
+        # traffic yet", and mixing them in fabricates a drift edge
+        if p99 > 0:
+            self.drift.observe_perf("service.p99_ms", now, p99,
+                                    DEGRADES_UP)
+        self.drift.observe_perf("process.rss_mb", now, rss, DEGRADES_UP)
+        with self._lat_l:
+            evals = self._evals_observed
+        last_evals, last_t = self._last_throughput_mark
+        if last_t > 0 and now > last_t:
+            thr = (evals - last_evals) / (now - last_t)
+            metrics.set_gauge("nomad.governor.throughput_eps", thr)
+            if evals > last_evals:
+                self.drift.observe_perf("throughput_eps", now, thr,
+                                        DEGRADES_DOWN)
+        self._last_throughput_mark = (evals, now)
+        for reg in regs:
+            if reg.suspect:
+                self.drift.observe_struct(reg.name, now, reg.value)
+
+        self._samples += 1
+        if self._samples % self._drift_check_every == 0:
+            for finding in self.drift.check():
+                self.emit(finding)
+        return regs
+
+    # -- signals / status ----------------------------------------------
+    def backpressure(self) -> bool:
+        return self._bp.is_set()
+
+    def status(self) -> dict:
+        return {
+            "enabled": True,
+            "running": self._thread is not None,
+            "interval_s": self.interval_s,
+            "samples": self._samples,
+            "backpressure": self._bp.is_set(),
+            "service_p99_ms": round(self.p99_ms(), 2),
+            "latency_samples": self.latency_samples(),
+            "process_rss_mb": round(rss_mb(), 1),
+            "gauges": self.registry.rows(),
+            "events": self.events(),
+        }

@@ -1,0 +1,45 @@
+"""Watermark policies: the bounding rule attached to a registered gauge.
+
+A policy is a high/low watermark pair with hysteresis: the structure is
+OVER once its gauge reaches `high` and stays over until the gauge falls
+back to `low` (default 80% of high), so a gauge oscillating around the
+threshold doesn't flap reclamation or backpressure on and off every
+sample. Reclamation is additionally rate-limited by
+`min_reclaim_interval_s` — reclaim work (layer folds, cache clears)
+must never itself become the latency problem it exists to prevent.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+STATUS_OK = "ok"
+STATUS_OVER = "over"
+
+
+@dataclass
+class WatermarkPolicy:
+    high: float
+    low: Optional[float] = None          # default: 0.8 * high
+    min_reclaim_interval_s: float = 5.0
+    # gauges that participate in admission control (broker depth,
+    # service p99): crossing high engages backpressure as well as any
+    # reclaim callback
+    pressure: bool = False
+    # watermark only applies once the gauge is backed by at least this
+    # many observations (the p99 gauge is meaningless off two samples)
+    min_samples: int = 0
+
+    def __post_init__(self):
+        if self.low is None:
+            self.low = 0.8 * self.high
+        if self.low > self.high:
+            raise ValueError(
+                f"low watermark {self.low} above high {self.high}")
+
+    def next_status(self, prev: str, value: float) -> str:
+        """Hysteresis step: over at >= high, ok again only at <= low."""
+        if prev == STATUS_OVER:
+            return STATUS_OK if value <= self.low else STATUS_OVER
+        return STATUS_OVER if value >= self.high else STATUS_OK

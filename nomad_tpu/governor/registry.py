@@ -1,0 +1,143 @@
+"""Gauge registry: the accounting half of the governor.
+
+Long-lived structures register a zero-argument gauge function (current
+size in its unit — entries or bytes) plus an optional WatermarkPolicy
+and reclaim callback. sample() reads every gauge, publishes it to the
+process metrics registry under `nomad.governor.<name>` (so /v1/metrics
+carries the full accounting picture), steps each watermark's
+hysteresis state, and runs due reclaims rate-limited per policy. A
+gauge or reclaim that raises is isolated — one broken structure must
+not blind the governor to the rest.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils import metrics
+from .policy import STATUS_OK, STATUS_OVER, WatermarkPolicy
+
+LOG = logging.getLogger("nomad_tpu.governor")
+
+
+class Registration:
+    __slots__ = ("name", "gauge_fn", "watermark", "reclaim", "unit",
+                 "suspect", "value", "status", "samples", "reclaims",
+                 "last_reclaim_t", "errors")
+
+    def __init__(self, name: str, gauge_fn: Callable[[], float],
+                 watermark: Optional[WatermarkPolicy] = None,
+                 reclaim: Optional[Callable[[], object]] = None,
+                 unit: str = "count", suspect: bool = True):
+        self.name = name
+        self.gauge_fn = gauge_fn
+        self.watermark = watermark
+        self.reclaim = reclaim
+        self.unit = unit
+        # eligible as a drift-finding suspect: False for monotone
+        # counters and performance gauges, whose unbounded "growth"
+        # would always out-rank the actually leaking structure
+        self.suspect = suspect
+        self.value: float = 0.0
+        self.status: str = STATUS_OK
+        self.samples: int = 0
+        self.reclaims: int = 0
+        # -inf: the FIRST over-watermark reclaim must never be rate
+        # limited by the epoch of the monotonic clock
+        self.last_reclaim_t: float = float("-inf")
+        self.errors: int = 0
+
+    def as_dict(self) -> dict:
+        out = {"name": self.name, "value": self.value, "unit": self.unit,
+               "status": self.status, "samples": self.samples,
+               "reclaims": self.reclaims, "errors": self.errors}
+        if self.watermark is not None:
+            out["high"] = self.watermark.high
+            out["low"] = self.watermark.low
+            out["pressure"] = self.watermark.pressure
+        return out
+
+
+class GaugeRegistry:
+    def __init__(self):
+        self._l = threading.Lock()
+        self._regs: Dict[str, Registration] = {}
+
+    def register(self, name: str, gauge_fn: Callable[[], float],
+                 watermark: Optional[WatermarkPolicy] = None,
+                 reclaim: Optional[Callable[[], object]] = None,
+                 unit: str = "count",
+                 suspect: bool = True) -> Registration:
+        reg = Registration(name, gauge_fn, watermark, reclaim, unit,
+                           suspect)
+        with self._l:
+            self._regs[name] = reg
+        return reg
+
+    def deregister(self, name: str) -> None:
+        with self._l:
+            self._regs.pop(name, None)
+
+    def get(self, name: str) -> Optional[Registration]:
+        with self._l:
+            return self._regs.get(name)
+
+    def names(self) -> List[str]:
+        with self._l:
+            return sorted(self._regs)
+
+    def rows(self) -> List[dict]:
+        with self._l:
+            regs = list(self._regs.values())
+        return [r.as_dict() for r in sorted(regs, key=lambda r: r.name)]
+
+    # -- sampling ------------------------------------------------------
+    def sample(self, now: Optional[float] = None,
+               on_event: Optional[Callable[[dict], None]] = None
+               ) -> List[Registration]:
+        """Read every gauge, publish metrics, step watermark states and
+        run due reclaims. Returns the registrations (with fresh
+        .value/.status) for the caller's backpressure/drift logic."""
+        now = time.monotonic() if now is None else now
+        with self._l:
+            regs = list(self._regs.values())
+        for reg in regs:
+            try:
+                reg.value = float(reg.gauge_fn())
+            except Exception:
+                reg.errors += 1
+                if reg.errors <= 3:
+                    LOG.exception("governor gauge %s failed", reg.name)
+                continue
+            reg.samples += 1
+            metrics.set_gauge(f"nomad.governor.{reg.name}", reg.value)
+            wm = reg.watermark
+            if wm is None or reg.samples < wm.min_samples:
+                continue
+            prev = reg.status
+            reg.status = wm.next_status(prev, reg.value)
+            if reg.status == STATUS_OVER and prev == STATUS_OK \
+                    and on_event is not None:
+                on_event({"kind": "watermark", "structure": reg.name,
+                          "value": reg.value, "high": wm.high})
+            if reg.status == STATUS_OVER and reg.reclaim is not None \
+                    and now - reg.last_reclaim_t >= \
+                    wm.min_reclaim_interval_s:
+                reg.last_reclaim_t = now
+                try:
+                    detail = reg.reclaim()
+                    reg.reclaims += 1
+                    metrics.incr_counter(
+                        f"nomad.governor.reclaim.{reg.name}")
+                    if on_event is not None:
+                        on_event({"kind": "reclaim",
+                                  "structure": reg.name,
+                                  "value": reg.value,
+                                  "detail": detail})
+                except Exception:
+                    reg.errors += 1
+                    LOG.exception("governor reclaim %s failed", reg.name)
+        return regs

@@ -26,12 +26,21 @@ Shapes are padded to buckets to bound recompilation:
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import lru_cache, partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# JIT shape-cache bound (governor accounting): every distinct
+# (steps, spreads, distinct, lane) shape bucket compiles and caches an
+# XLA executable; maxsize turns the open-ended dict into a true
+# shape-LRU so a long-running server's kernel cache stays bounded and
+# evictions free the executables with the dropped reference
+KERNEL_CACHE_MAX = int(os.environ.get("NOMAD_TPU_KERNEL_CACHE_MAX",
+                                      "128"))
 
 S_MAX = 4       # max spread stanzas per task group
 P_MAX = 4       # max distinct_property constraints
@@ -359,7 +368,7 @@ _SCAN_ARGS = (
     "dp_codes", "dp_counts0", "dp_limit", "dp_valid")
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=KERNEL_CACHE_MAX)
 def _scan_batched_jit(k_steps: int, spread_alg: bool, s_live: int,
                       p_live: int):
     """The vmapped scan: B independent lanes over ONE shared capacity
@@ -561,7 +570,7 @@ _select_chunked = partial(
         _select_chunked_fn)
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=KERNEL_CACHE_MAX)
 def _chunked_batched_jit(max_steps: int, spread_alg: bool):
     """The vmapped chunked kernel: B node-local lanes over ONE shared
     capacity table in a single dispatch. The while_loop batches to
@@ -1868,3 +1877,42 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
         exhausted_dim=exh_out,
         placed=pos,
     )
+
+
+# -- kernel-cache governance (governor/registry.py) --------------------
+
+def kernel_cache_stats() -> Dict[str, int]:
+    """Entry counts for the shape-keyed JIT caches this module owns.
+    The batched-lane caches are true LRUs (KERNEL_CACHE_MAX); the
+    plain jitted kernels report jax's internal per-function cache size
+    where the running jax exposes it."""
+    out = {"scan_batched": _scan_batched_jit.cache_info().currsize,
+           "chunked_batched": _chunked_batched_jit.cache_info().currsize}
+    for name, fn in (("scan", _select_scan),
+                     ("chunked", _select_chunked),
+                     ("kway", _select_kway)):
+        try:
+            out[name] = int(fn._cache_size())
+        except Exception:
+            out[name] = 0
+    return out
+
+
+def kernel_cache_entries() -> int:
+    return sum(kernel_cache_stats().values())
+
+
+def clear_kernel_caches() -> dict:
+    """Governor reclaim: drop every cached compiled kernel. Rarely the
+    right call on a healthy server (the LRU bound handles churn);
+    exists for the watermark breach where compiled-shape cardinality
+    itself is the leak. Next dispatches recompile warm shapes."""
+    before = kernel_cache_entries()
+    _scan_batched_jit.cache_clear()
+    _chunked_batched_jit.cache_clear()
+    for fn in (_select_scan, _select_chunked, _select_kway):
+        try:
+            fn.clear_cache()
+        except Exception:
+            pass
+    return {"evicted": before}

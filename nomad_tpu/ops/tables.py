@@ -34,9 +34,40 @@ DIM_NAMES = ("cpu", "memory", "disk", "network")
 # seed shares ONE flyweight row across millions of allocs), so a 2M-row
 # table build becomes 2M dict hits instead of 2M ComparableResources
 # constructions. Values are immutable once allocated; holding the key
-# object in the memo pins its id() against reuse.
+# object in the memo pins its id() against reuse — which is also why
+# the memos must stay SMALL: every entry pins a full resources graph
+# (~2 KB) past its alloc's death. A churning server mints one fresh
+# resources object per placement wave, so the old clear-at-100k policy
+# accreted ~100-200 MB of dead graphs between resets (the r6 soak's
+# residual RSS slope). FIFO-evict at a working-set-sized bound
+# instead: misses just recompute.
+# sized for the real working set: live flyweights being added/removed
+# during a refresh (a handful), not history — verified by the r6 soak
+# instrumentation: post-fix object growth over 2000 evals is ~1
+_MEMO_MAX = 4096
 _usage_memo: Dict[int, Tuple[object, Tuple[float, float, float, float]]] = {}
 _port_bits_memo: Dict[int, Tuple[object, int]] = {}
+
+
+def _memo_insert(memo: Dict, key: int, value) -> None:
+    if len(memo) >= _MEMO_MAX:
+        # dicts preserve insertion order: drop the oldest entry.
+        # Concurrent scheduler lanes share these module-level memos
+        # unlocked, so two threads can race to evict the same key
+        # (KeyError) or mutate between iter() and next() (RuntimeError)
+        # — tolerate both rather than lock the hot path; the bound
+        # only overshoots by the thread count
+        try:
+            memo.pop(next(iter(memo)), None)
+        except (StopIteration, RuntimeError):
+            pass
+    memo[key] = value
+
+
+def resource_memo_len() -> int:
+    """Governor accounting: pinned resources-graph entries across the
+    identity memos."""
+    return len(_usage_memo) + len(_port_bits_memo)
 
 # inlined Allocation.terminal_status for the 2M-row build loop
 from ..models.alloc import (  # noqa: E402
@@ -79,9 +110,7 @@ def _alloc_usage(alloc) -> Tuple[float, float, float, float]:
     out = (float(c.cpu_shares), float(c.memory_mb), float(c.disk_mb),
            float(mbits))
     if res is not None:
-        if len(_usage_memo) > 100_000:
-            _usage_memo.clear()
-        _usage_memo[id(res)] = (res, out)
+        _memo_insert(_usage_memo, id(res), (res, out))
     return out
 
 
@@ -307,9 +336,7 @@ class NodeTable:
                     for p in ports:
                         if p.value > 0:
                             bits |= 1 << p.value
-        if len(_port_bits_memo) > 100_000:
-            _port_bits_memo.clear()
-        _port_bits_memo[id(res)] = (res, bits)
+        _memo_insert(_port_bits_memo, id(res), (res, bits))
         return bits
 
     def add_alloc_usage(self, i: int, alloc) -> None:

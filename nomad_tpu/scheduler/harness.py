@@ -37,6 +37,13 @@ class RejectPlan:
 
 
 class Harness:
+    # recorded plans/evals are assertion material for tests, but a
+    # long bench loop (bench/soak.py) drives hundreds of thousands of
+    # evals through one harness — unbounded recording was one of the
+    # round-5 soak's RSS leaks (each plan pins its placed allocs and
+    # job). Tests never come close to this bound.
+    MAX_HISTORY = 4096
+
     def __init__(self, store: Optional[StateStore] = None):
         self.store = store or StateStore()
         self.planner = None
@@ -47,6 +54,10 @@ class Harness:
         self._lock = threading.Lock()
         self._next_index = 1000
 
+    def _trim(self, lst: List) -> None:
+        if len(lst) > self.MAX_HISTORY:
+            del lst[:len(lst) - self.MAX_HISTORY]
+
     def next_index(self) -> int:
         with self._lock:
             self._next_index += 1
@@ -56,6 +67,7 @@ class Harness:
     def submit_plan(self, plan: Plan) -> PlanResult:
         with self._lock:
             self.plans.append(plan)
+            self._trim(self.plans)
         if self.planner is not None:
             return self.planner.submit_plan(plan)
 
@@ -88,14 +100,17 @@ class Harness:
     def update_eval(self, evaluation: Evaluation) -> None:
         with self._lock:
             self.evals.append(evaluation)
+            self._trim(self.evals)
 
     def create_eval(self, evaluation: Evaluation) -> None:
         with self._lock:
             self.create_evals.append(evaluation)
+            self._trim(self.create_evals)
 
     def reblock_eval(self, evaluation: Evaluation) -> None:
         with self._lock:
             self.reblock_evals.append(evaluation)
+            self._trim(self.reblock_evals)
 
     # -- driving -------------------------------------------------------
     def process(self, scheduler_name: str, evaluation: Evaluation) -> None:

@@ -108,6 +108,31 @@ class ServerConfig:
     # lease TTL for derived vault tokens (vault.go ttl on CreateToken);
     # clients renew at ttl/2 via Node.RenewVaultToken
     vault_token_ttl_s: float = 3600.0
+    # steady-state governor (governor/): accounting cadence, watermark
+    # levels for the pressure gauges, and structure bounds. Levels are
+    # deliberately high — backpressure is an overload valve, not a
+    # scheduler tune
+    governor_enabled: bool = True
+    governor_interval_s: float = 1.0
+    governor_broker_depth_high: int = 8192
+    governor_plan_depth_high: int = 256
+    governor_p99_high_ms: float = 1000.0
+    # p99 watermark needs a WARM, populated latency reservoir before
+    # it means anything — a fresh agent's first evals carry
+    # multi-second JIT compiles that must not engage backpressure
+    # (r6 e2e verify). Gates on observed LATENCIES, not uptime, and
+    # MUST exceed Governor.P99_WINDOW (512): the gauge reads the most
+    # recent 512 samples, so anything smaller opens the gauge while
+    # the compile-era latencies still sit inside the p99 window
+    governor_p99_min_samples: int = 640
+    governor_version_debt_high: int = 100_000
+    # byte watermark for early event-history shedding; the ring's own
+    # count/byte caps are the hard bound, this is the soft one (0 =
+    # disabled: never truncate below the ring's own caps)
+    governor_event_bytes_high: int = 12 << 20
+    # 0 = derive from the shape-LRU bound (2 caches x KERNEL_CACHE_MAX
+    # + slack for jax's internal per-function caches)
+    governor_kernel_cache_high: int = 0
 
 
 class Server:
@@ -129,6 +154,12 @@ class Server:
         self.events = EventBroker()
         from .event_sink import EventSinkManager
         self.event_sinks = EventSinkManager(self)
+        self.governor = None
+        if self.config.governor_enabled:
+            from ..governor import Governor
+            self.governor = Governor(
+                interval_s=self.config.governor_interval_s)
+            self._register_governor_gauges()
         self.workers: List[Worker] = []
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._hb_lock = threading.Lock()
@@ -224,6 +255,111 @@ class Server:
                                                 daemon=True,
                                                 name="volume-watcher")
         self._volume_watcher.start()
+        if self.governor is not None:
+            self.governor.start()
+
+    def _register_governor_gauges(self) -> None:
+        """Wire every long-lived structure into the governor's
+        accounting registry, with watermark policies and targeted
+        reclamation where a bound exists (ISSUE r6 tentpole; the
+        reference keeps these flat via core_sched GC + EmitStats)."""
+        from ..governor import WatermarkPolicy
+        from ..ops.select import (clear_kernel_caches,
+                                  kernel_cache_entries)
+        cfg = self.config
+        gov = self.governor
+        broker = self.eval_broker   # .stats is REPLACED on flush —
+        # gauges must read through the broker, never a captured stats
+
+        # broker queues: depth gauges; READY depth is the admission
+        # signal (backpressure sheds enqueues, workers shrink lanes)
+        gov.register("broker.ready", lambda: broker.stats.total_ready,
+                     WatermarkPolicy(cfg.governor_broker_depth_high,
+                                     pressure=True))
+        gov.register("broker.unacked",
+                     lambda: broker.stats.total_unacked)
+        gov.register("broker.waiting",
+                     lambda: broker.stats.total_waiting)
+        gov.register("broker.shed", lambda: broker.stats.total_shed,
+                     suspect=False)  # monotone counter, not a structure
+        gov.register("blocked_evals.blocked",
+                     self.blocked_evals.blocked_count)
+        gov.register("plan_queue.depth", self.plan_queue.depth,
+                     WatermarkPolicy(cfg.governor_plan_depth_high,
+                                     pressure=True))
+
+        # sampled service p99 from the workers' latency reservoir: the
+        # primary backpressure gauge (SOAK_r05: p99 drifted 69->208 ms).
+        # The gauge reports 0 until the reservoir holds enough REAL
+        # latencies — gating on observed evals, not sampler uptime, so
+        # an idle-then-cold-start agent can't trip it on JIT compiles
+        def p99_gauge():
+            if gov.latency_samples() < cfg.governor_p99_min_samples:
+                return 0.0
+            # recent_: a reservoir with no fresh latencies reads 0, so
+            # an engaged-backpressure idle period can't latch the
+            # watermark shut on frozen samples
+            return gov.recent_p99_ms()
+        # suspect=False: this IS the perf signal, not a structure
+        # whose growth could explain it
+        gov.register("service.p99_ms", p99_gauge,
+                     WatermarkPolicy(cfg.governor_p99_high_ms,
+                                     pressure=True),
+                     unit="ms", suspect=False)
+
+        # event broker: the ring enforces its own count+byte caps on
+        # publish (the hard bound). The governor watermark is the SOFT
+        # byte bound — set BELOW the ring's max_bytes so it can only
+        # fire on genuine payload-byte pressure, never sit permanently
+        # 'over' on a legitimately full ring of small events
+        gov.register("event_broker.events", self.events.buffered_events)
+        if cfg.governor_event_bytes_high > 0:
+            gov.register("event_broker.bytes",
+                         self.events.buffered_bytes,
+                         WatermarkPolicy(cfg.governor_event_bytes_high),
+                         reclaim=lambda: self.events.truncate(0.5),
+                         unit="bytes")
+        else:
+            gov.register("event_broker.bytes",
+                         self.events.buffered_bytes, unit="bytes")
+
+        # state store: uncompacted layer-overlay debt (the version
+        # chains the r5 soak showed growing between snapshots) with
+        # fold compaction as the reclaim; changelog is already bounded
+        # force=True: crossing the watermark IS the escalation — the
+        # per-table proportional fold floor must not veto every table
+        # and leave the reclaim a permanent no-op while debt grows
+        gov.register("state.version_debt", self.store.version_debt,
+                     WatermarkPolicy(cfg.governor_version_debt_high),
+                     reclaim=lambda: self.store.compact(min_tip=1024,
+                                                        force=True))
+        gov.register("state.changelog", self.store.changelog_len)
+        gov.register("state.allocs",
+                     lambda: len(self.store._root.table("allocs")))
+        gov.register("state.evals",
+                     lambda: len(self.store._root.table("evals")))
+
+        # JIT kernel caches (ops/select.py): the shape-LRUs bound
+        # themselves at KERNEL_CACHE_MAX each; the watermark (derived
+        # from that bound unless overridden, so NOMAD_TPU_KERNEL_CACHE_MAX
+        # retunes both together) alarms on jax's unbounded internal
+        # per-function caches, where the break-glass full clear is the
+        # only reclaim
+        from ..ops.select import KERNEL_CACHE_MAX
+        kc_high = cfg.governor_kernel_cache_high or \
+            (2 * KERNEL_CACHE_MAX + 512)
+        gov.register("kernel_cache.entries", kernel_cache_entries,
+                     WatermarkPolicy(kc_high),
+                     reclaim=clear_kernel_caches)
+
+        # resident-table identity memos (ops/tables.py): FIFO-bounded,
+        # but accounted — every entry pins a resources graph
+        from ..ops.tables import resource_memo_len
+        gov.register("node_table.resource_memo", resource_memo_len)
+
+        # admission control: the broker sheds fresh enqueues while any
+        # pressure gauge is over
+        self.eval_broker.pressure_fn = gov.backpressure
 
     def _emit_stats(self) -> None:
         """Periodic gauge emission (eval_broker.go:825 EmitStats,
@@ -338,6 +474,8 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        if self.governor is not None:
+            self.governor.stop()
         if getattr(self, "swim", None) is not None:
             self.swim.stop()
         if self.raft is not None:

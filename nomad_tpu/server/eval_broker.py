@@ -13,7 +13,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..models import Evaluation
+from ..models import Evaluation, JOB_TYPE_CORE
 from ..utils.ids import generate_uuid
 
 FAILED_QUEUE = "_failed"
@@ -22,6 +22,10 @@ DEFAULT_NACK_TIMEOUT_S = 60.0
 DEFAULT_DELIVERY_LIMIT = 3
 DEFAULT_INITIAL_NACK_DELAY_S = 1.0
 DEFAULT_SUBSEQUENT_NACK_DELAY_S = 20.0
+# admission-control deferral while the governor signals backpressure:
+# shed enqueues park on the delayed heap this long before re-testing
+# the pressure gauge
+DEFAULT_ADMISSION_DELAY_S = 0.25
 
 
 class _PQ:
@@ -60,10 +64,13 @@ class BrokerStats:
         self.total_unacked = 0
         self.total_blocked = 0
         self.total_waiting = 0
+        self.total_shed = 0     # admission-control deferrals (governor)
 
     def as_dict(self):
         return {"ready": self.total_ready, "unacked": self.total_unacked,
-                "blocked": self.total_blocked, "waiting": self.total_waiting}
+                "blocked": self.total_blocked,
+                "waiting": self.total_waiting,
+                "shed": self.total_shed}
 
 
 class EvalBroker:
@@ -85,9 +92,19 @@ class EvalBroker:
         self._blocked: Dict[Tuple[str, str], _PQ] = {} # per-job pending heaps
         self._requeue: Dict[str, Evaluation] = {}      # token -> reblocked eval
         self._time_wait: Dict[str, threading.Timer] = {}
-        self._delayed: List[Tuple[float, int, Evaluation]] = []  # wait_until heap
+        # wait_until heaps, split by type: core evals (rare, must admit
+        # on schedule even under backpressure) park separately so the
+        # pressured pop cycle can leave the non-core heap untouched
+        self._delayed: List[Tuple[float, int, Evaluation]] = []
+        self._delayed_core_q: List[Tuple[float, int, Evaluation]] = []
         self._delay_seq = 0
         self._delay_timer: Optional[threading.Timer] = None
+        self._delay_timer_at = 0.0      # absolute fire time when armed
+        # governor backpressure: when this returns True, fresh enqueues
+        # shed onto the admission-controlled delayed path instead of
+        # the ready queue (recovering as soon as the gauge clears)
+        self.pressure_fn = None
+        self.admission_delay_s = DEFAULT_ADMISSION_DELAY_S
         self.stats = BrokerStats()
 
     # -- lifecycle -----------------------------------------------------
@@ -117,6 +134,7 @@ class EvalBroker:
             self._requeue.clear()
             self._time_wait.clear()
             self._delayed.clear()
+            self._delayed_core_q.clear()
             self.stats = BrokerStats()
             self._l.notify_all()
 
@@ -148,11 +166,42 @@ class EvalBroker:
             return
         if ev.wait_until > 0:
             self._delay_seq += 1
-            heapq.heappush(self._delayed, (ev.wait_until, self._delay_seq, ev))
+            q = (self._delayed_core_q if ev.type == JOB_TYPE_CORE
+                 else self._delayed)
+            heapq.heappush(q, (ev.wait_until, self._delay_seq, ev))
             self.stats.total_waiting += 1
             self._reset_delay_timer()
             return
+        if self._admission_defer(ev):
+            return
         self._enqueue_locked(ev, ev.type)
+
+    def _admission_defer(self, ev: Evaluation) -> bool:
+        """Backpressure shed: while the governor's pressure gauge is
+        over its watermark, fresh (non-core) enqueues park on the
+        delayed heap for admission_delay_s instead of joining the
+        ready queue; the pop cycle re-tests the gauge, so work admits
+        the moment it clears. Bounded memory (the delayed heap) traded
+        for bounded queue depth and dispatch latency — the nack/requeue
+        analog of the reference's plan-apply admission control.
+        total_shed counts these shed DECISIONS once per eval; the pop
+        cycle's re-parks don't come back through here."""
+        fn = self.pressure_fn
+        if fn is None or ev.type == JOB_TYPE_CORE:
+            return False
+        try:
+            if not fn():
+                return False
+        except Exception:       # pragma: no cover — defensive
+            return False
+        self.stats.total_shed += 1
+        self._delay_seq += 1
+        heapq.heappush(self._delayed,
+                       (time.time() + self.admission_delay_s,
+                        self._delay_seq, ev))
+        self.stats.total_waiting += 1
+        self._reset_delay_timer()
+        return True
 
     def _process_waiting(self, ev: Evaluation) -> None:
         timer = threading.Timer(ev.wait_s, self._enqueue_waiting, args=(ev,))
@@ -167,21 +216,65 @@ class EvalBroker:
             self.stats.total_waiting -= 1
             self._enqueue_locked(ev, ev.type)
 
-    def _reset_delay_timer(self) -> None:
+    def _arm_delay_timer(self, delay: float) -> None:
         if self._delay_timer:
             self._delay_timer.cancel()
-            self._delay_timer = None
-        if not self._delayed:
-            return
-        wait_until = self._delayed[0][0]
-        delay = max(0.0, wait_until - time.time())
         self._delay_timer = threading.Timer(delay, self._pop_delayed)
         self._delay_timer.daemon = True
+        self._delay_timer_at = time.time() + delay
         self._delay_timer.start()
+
+    def _reset_delay_timer(self) -> None:
+        nxt = self._delayed[0][0] if self._delayed else None
+        if self._delayed_core_q and \
+                (nxt is None or self._delayed_core_q[0][0] < nxt):
+            nxt = self._delayed_core_q[0][0]
+        if nxt is None:
+            if self._delay_timer:
+                self._delay_timer.cancel()
+                self._delay_timer = None
+            return
+        # an armed timer already fires at/before the heap head: leave
+        # it — re-arming here would cancel and spawn a fresh OS timer
+        # thread per shed enqueue, thread churn proportional to the
+        # very overload admission control is relieving
+        if self._delay_timer is not None and self._delay_timer_at <= nxt:
+            return
+        self._arm_delay_timer(max(0.0, nxt - time.time()))
 
     def _pop_delayed(self) -> None:
         with self._l:
+            # we ARE the fired timer: forget it so _reset_delay_timer
+            # re-arms instead of trusting a dead timer's deadline
+            self._delay_timer = None
             now = time.time()
+            # core evals admit on schedule regardless of pressure —
+            # GC work keeps the overloaded server healthy
+            while self._delayed_core_q and \
+                    self._delayed_core_q[0][0] <= now:
+                _, _, ev = heapq.heappop(self._delayed_core_q)
+                self.stats.total_waiting -= 1
+                self._enqueue_locked(ev, ev.type)
+            # pressure is tested ONCE per cycle: under sustained
+            # pressure due non-core evals simply stay parked — the
+            # heap is untouched, so a 50k-deep parked set costs one
+            # function call per admission window, not 50k heap pops +
+            # pushes inside the broker lock. When the gauge clears,
+            # everything due admits in one batch
+            pressured = False
+            fn = self.pressure_fn
+            if fn is not None and self._delayed:
+                try:
+                    pressured = bool(fn())
+                except Exception:   # pragma: no cover — defensive
+                    pressured = False
+            if pressured:
+                delay = self.admission_delay_s
+                if self._delayed_core_q:
+                    delay = min(delay, max(
+                        0.0, self._delayed_core_q[0][0] - now))
+                self._arm_delay_timer(delay)
+                return
             while self._delayed and self._delayed[0][0] <= now:
                 _, _, ev = heapq.heappop(self._delayed)
                 self.stats.total_waiting -= 1

@@ -88,11 +88,37 @@ class Subscription:
             self._cond.notify_all()
 
 
+def approx_event_bytes(e: Event) -> int:
+    """Cheap shallow estimate of an event's resident footprint. Exact
+    byte accounting would serialize every payload on the hot publish
+    path; the governor only needs a consistent order-of-magnitude
+    gauge to bound the ring."""
+    sz = 200
+    p = e.payload
+    if p:
+        sz += 48 * len(p)
+        for v in p.values():
+            if isinstance(v, str):
+                sz += len(v)
+            elif isinstance(v, (list, dict)):
+                sz += 64 * len(v)
+    return sz
+
+
 class EventBroker:
-    def __init__(self, size: int = 4096):
+    # replay history is bounded by BYTES as well as count: a ring of
+    # 4096 job-register events each dragging a full wire-encoded job
+    # spec is tens of MB of history nobody asked for (round-5 soak RSS
+    # drift); count alone never bounded that
+    DEFAULT_MAX_BYTES = 16 << 20
+
+    def __init__(self, size: int = 4096,
+                 max_bytes: int = DEFAULT_MAX_BYTES):
         self._l = threading.Lock()
         self._buffer: List[Event] = []   # ring of recent events
         self._size = size
+        self._max_bytes = max_bytes
+        self._buffer_bytes = 0
         self._subs: List[Subscription] = []
         self.latest_index = 0
         # highest index ever dropped off the ring: a consumer resuming
@@ -109,11 +135,9 @@ class EventBroker:
             return
         with self._l:
             self._buffer.extend(events)
-            if len(self._buffer) > self._size:
-                drop = len(self._buffer) - self._size
-                self.trimmed_through = max(self.trimmed_through,
-                                           self._buffer[drop - 1].index)
-                del self._buffer[:drop]
+            self._buffer_bytes += sum(approx_event_bytes(e)
+                                      for e in events)
+            self._trim_locked(self._size, self._max_bytes)
             self.latest_index = max(self.latest_index,
                                     max(e.index for e in events))
             subs = list(self._subs)
@@ -144,6 +168,55 @@ class EventBroker:
         with self._l:
             if sub in self._subs:
                 self._subs.remove(sub)
+
+    # -- governance (governor/) ----------------------------------------
+    def _trim_locked(self, max_events: int, max_bytes: int) -> None:
+        """Drop oldest events until the ring fits both bounds,
+        advancing trimmed_through so resumed consumers see a proven
+        replay gap, never silence."""
+        buf = self._buffer
+        drop = 0
+        dropped_bytes = 0
+        n = len(buf)
+        while n - drop > max_events or \
+                self._buffer_bytes - dropped_bytes > max_bytes:
+            if drop >= n:
+                break
+            dropped_bytes += approx_event_bytes(buf[drop])
+            drop += 1
+        if drop:
+            self.trimmed_through = max(self.trimmed_through,
+                                       buf[drop - 1].index)
+            del buf[:drop]
+            self._buffer_bytes = max(0, self._buffer_bytes
+                                     - dropped_bytes)
+
+    def truncate(self, fraction: float = 0.5) -> dict:
+        """Governor reclaim: shed the oldest `fraction` of buffered
+        history immediately (watermark breach), keeping replay
+        correctness via trimmed_through."""
+        with self._l:
+            before = len(self._buffer)
+            keep = max(0, int(before * (1.0 - fraction)))
+            self._trim_locked(keep, self._max_bytes)
+            return {"dropped_events": before - len(self._buffer),
+                    "buffer_events": len(self._buffer)}
+
+    def buffered_events(self) -> int:
+        with self._l:
+            return len(self._buffer)
+
+    def buffered_bytes(self) -> int:
+        with self._l:
+            return self._buffer_bytes
+
+    def stats(self) -> dict:
+        with self._l:
+            return {"events": len(self._buffer),
+                    "approx_bytes": self._buffer_bytes,
+                    "subscriptions": len(self._subs),
+                    "latest_index": self.latest_index,
+                    "trimmed_through": self.trimmed_through}
 
 
 # -- FSM commit -> events (nomad/state/events.go eventsFromChanges) ----

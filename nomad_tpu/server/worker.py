@@ -283,11 +283,12 @@ class Worker:
             if ev is None:
                 continue
             batch = [(ev, token)]
-            if self.batch_size > 1 and ev.type != JOB_TYPE_CORE:
+            batch_size = self._effective_batch_size()
+            if batch_size > 1 and ev.type != JOB_TYPE_CORE:
                 # drain already-READY compatible evals without waiting
                 # (eval_broker.go:329 Dequeue; the queue depth IS the
                 # batching opportunity)
-                while len(batch) < self.batch_size:
+                while len(batch) < batch_size:
                     ev2, tok2 = self.server.eval_broker.dequeue(
                         self.schedulers, timeout_s=0)
                     if ev2 is None:
@@ -304,9 +305,21 @@ class Worker:
             if use_safepoints:
                 gcsafe.safepoint()
 
+    def _effective_batch_size(self) -> int:
+        """Configured lane width, shrunk to solo dispatches while the
+        governor signals backpressure — wide lanes multiply in-flight
+        host work exactly when sampled p99 says the host is the
+        bottleneck; width recovers when the gauge clears."""
+        if self.batch_size <= 1:
+            return self.batch_size
+        gov = getattr(self.server, "governor", None)
+        if gov is not None and gov.backpressure():
+            return 1
+        return self.batch_size
+
     # -- single eval ---------------------------------------------------
     def process_eval(self, ev: Evaluation, token: str,
-                     dispatch=None) -> None:
+                     dispatch=None, lat_scale: int = 1) -> None:
         from ..utils import metrics
         lane = EvalLane(self.server, ev, token)
         try:
@@ -339,6 +352,15 @@ class Worker:
                 f"nomad.worker.invoke_scheduler_{self._scheduler_for(ev)}"
                 if ev.type != JOB_TYPE_CORE
                 else "nomad.worker.invoke_scheduler_core", t0)
+            gov = getattr(self.server, "governor", None)
+            if gov is not None and ev.type != JOB_TYPE_CORE:
+                # lat_scale normalizes batched lanes: B concurrent
+                # GIL-sharing lanes each see ~B× their own host work
+                # in wall clock, and feeding that raw into the p99
+                # gauge would engage backpressure on healthy wide
+                # batches (then oscillate lane width)
+                gov.observe_eval_latency(
+                    (time.monotonic() - t0) / lat_scale)
             self.server.eval_broker.ack(ev.id, token)
             self.stats["processed"] += 1
         except Exception:
@@ -385,7 +407,8 @@ class Worker:
 
         def lane_run(ev, token):
             try:
-                self.process_eval(ev, token, dispatch=gateway.dispatch)
+                self.process_eval(ev, token, dispatch=gateway.dispatch,
+                                  lat_scale=len(batch))
             finally:
                 gateway.lane_finished()
 

@@ -435,6 +435,77 @@ class StateStore(StateSnapshot):
             hi = bisect.bisect_right(self._change_indexes, to_idx)
             return [(k, key) for (_i, k, key) in self._changes[lo:hi]]
 
+    # -- governance accounting / compaction (governor/) ----------------
+    def table_stats(self) -> Dict[str, dict]:
+        """Per-table size + layer-overlay stats for the governor's
+        accounting pass."""
+        out: Dict[str, dict] = {}
+        for name, t in self._root.tables.items():
+            stats = getattr(t, "layer_stats", None)
+            out[name] = stats() if stats is not None else {"size": len(t)}
+        return out
+
+    def version_debt(self) -> int:
+        """Total uncompacted overlay entries (tip writes + tombstones)
+        across tables — the store-side version chain the round-5 soak
+        showed growing between snapshots. The automatic fold threshold
+        is len(base)/8, which on a 2M-row alloc table lets ~250k stale
+        overlay entries accumulate before a fold; the governor bounds
+        this via compact()."""
+        debt = 0
+        for t in self._root.tables.values():
+            ov = getattr(t, "overlay_len", None)
+            if ov is not None:
+                debt += ov()
+        return debt
+
+    def changelog_len(self) -> int:
+        with self._lock:
+            return len(self._changes)
+
+    def compact(self, min_tip: int = 1024, force: bool = False) -> dict:
+        """Fold every table whose overlay warrants it into its base,
+        dropping tombstones (the state-store analog of old-version
+        compaction). A fold costs O(len(base)) under the write lock,
+        so a table must earn it: overlay >= min_tip AND >= base/32 —
+        without the proportional floor a 2M-row table with a 1k
+        overlay would copy 2M entries (stalling every plan apply) to
+        reclaim almost nothing.
+
+        `force` is the governor's over-watermark escalation: total
+        debt breached its bound, so the proportional floor must not
+        be allowed to veto every table (debt split across big tables,
+        each individually under base/32, would otherwise leave the
+        reclaim a permanent no-op). Forced folds go largest-overlay
+        first and stop once half the candidate debt is reclaimed, so
+        the big offenders pay and the long tail is spared.
+
+        Published snapshots keep reading their own roots untouched.
+        Returns fold accounting for the governor's reclaim event."""
+        folded = 0
+        reclaimed = 0
+        with self._lock:
+            cands = []
+            for t in self._root.tables.values():
+                ov = getattr(t, "overlay_len", None)
+                if ov is None:
+                    continue
+                n = ov()
+                if n < max(min_tip, 1):
+                    continue
+                if not force and n * 32 < t.layer_stats()["base"]:
+                    continue
+                cands.append((n, id(t), t))
+            cands.sort(reverse=True)
+            target = sum(n for n, _, _ in cands) / 2.0 if force else None
+            for n, _, t in cands:
+                if target is not None and reclaimed >= target:
+                    break
+                reclaimed += n
+                t.fold()
+                folded += 1
+        return {"tables_folded": folded, "overlay_reclaimed": reclaimed}
+
     # -- snapshot / blocking ------------------------------------------
     def snapshot(self) -> StateSnapshot:
         return StateSnapshot(self._root, self)

@@ -116,6 +116,27 @@ class LayerMap:
     def __iter__(self) -> Iterator[Any]:
         return self.keys()
 
+    # -- governance accounting (governor/registry.py) -------------------
+    def overlay_len(self) -> int:
+        """Entries in the uncompacted tip overlay (live + tombstones) —
+        the per-table "version debt" the governor bounds."""
+        return len(self._tip)
+
+    def layer_stats(self) -> dict:
+        tip = self._tip
+        return {"size": self._size, "base": len(self._base),
+                "tip": len(tip),
+                "tombs": sum(1 for v in tip.values() if v is _TOMB)}
+
+    def fold(self) -> "LayerMap":
+        """Compact the tip into the base (dropping tombstones). Safe on
+        published shared instances — _materialize swaps in an
+        equivalent mapping (see the concurrency note above). Called by
+        the state store's governor-driven compaction so overlay debt
+        can't accumulate between the automatic fold thresholds."""
+        self._materialize()
+        return self
+
     # -- writes --------------------------------------------------------
     def set(self, key, value) -> "LayerMap":
         ctx = self._ctx
