@@ -29,6 +29,7 @@ from ..jobspec import parse_job
 from ..jobspec.parse import parse_duration_s
 from ..models import Job, NODE_SCHED_ELIGIBLE, NODE_SCHED_INELIGIBLE
 from ..models.node import DrainSpec, DrainStrategy
+from ..server.eval_broker import AdmissionOverloadError
 from ..utils.codec import from_wire, to_wire
 
 
@@ -65,18 +66,22 @@ class HTTPApiServer:
             def log_message(self, fmt, *args):
                 pass
 
-            def _respond(self, code: int, payload, index: Optional[int] = None):
+            def _respond(self, code: int, payload, index: Optional[int] = None,
+                         headers: Optional[dict] = None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 if index is not None:
                     self.send_header("X-Nomad-Index", str(index))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _error(self, code: int, msg: str):
-                self._respond(code, {"error": msg})
+            def _error(self, code: int, msg: str,
+                       headers: Optional[dict] = None):
+                self._respond(code, {"error": msg}, headers=headers)
 
             def _read_body_bytes(self) -> bytes:
                 """Read (and cache) the raw request body — callers that
@@ -166,6 +171,14 @@ class HTTPApiServer:
                         self._respond(200, payload, index)
                 except PermissionError as e:
                     self._error(403, str(e) or "Permission denied")
+                except AdmissionOverloadError as e:
+                    # backpressure escalation: the broker's shed valve
+                    # is full — refuse at the edge with Retry-After so
+                    # well-behaved clients back off instead of piling
+                    # onto the delayed heap
+                    self._error(429, str(e), headers={
+                        "Retry-After":
+                        str(max(1, int(round(e.retry_after_s))))})
                 except ValueError as e:
                     self._error(400, str(e))
                 except KeyError as e:
@@ -495,6 +508,11 @@ class HTTPApiServer:
                         if j.id.startswith(prefix)]
                 return jobs, idx
             if method in ("PUT", "POST"):
+                # backpressure escalation: refuse NEW work at the edge
+                # while the broker's delayed/requeue heap is over its
+                # watermark (429 + Retry-After); internal requeues and
+                # already-admitted evals are never refused
+                s.eval_broker.check_register_admission()
                 data = body_fn()
                 spec = data.get("Job", data.get("job", data))
                 job = from_wire(Job, spec) if isinstance(spec, dict) \
@@ -551,6 +569,9 @@ class HTTPApiServer:
                 return [to_wire(d)
                         for d in store.deployments_by_job(ns, job_id)], idx
             if sub == "dispatch" and method in ("PUT", "POST"):
+                # same edge valve as job register: parameterized
+                # dispatch is the designed high-volume eval creator
+                s.eval_broker.check_register_admission()
                 import base64 as _b64
                 data = body_fn()
                 payload = data.get("Payload") or data.get("payload") or ""
@@ -562,6 +583,7 @@ class HTTPApiServer:
                         "EvalID": ev.id}, store.latest_index()
             if sub == "evaluate" and method in ("PUT", "POST"):
                 # force a fresh evaluation (job_endpoint.go Evaluate)
+                s.eval_broker.check_register_admission()
                 ev = s.evaluate_job(ns, job_id)
                 return {"EvalID": ev.id}, store.latest_index()
             if sub == "scaling-events":
@@ -571,6 +593,7 @@ class HTTPApiServer:
         m = re.match(r"^/v1/job/([^/]+)/periodic/force$", path)
         if m and method in ("PUT", "POST"):
             # launch a periodic job's child NOW (periodic_endpoint.go)
+            s.eval_broker.check_register_admission()
             ev = s.periodic.force_run(ns, m.group(1))
             if ev is None:
                 return {"EvalID": "", "Skipped": True}, \

@@ -857,11 +857,19 @@ def cmd_alloc_stop(args) -> int:
 
 
 def cmd_alloc_restart(args) -> int:
+    # reference surface: `alloc restart [-task <name>] <alloc> [<task>]`
+    # — the flag and the positional are alternatives (alloc_restart.go);
+    # naming the task both ways must agree
+    task = args.task_opt or args.task
+    if args.task_opt and args.task and args.task_opt != args.task:
+        print("Error: task name given both as -task flag and "
+              "positional argument", file=sys.stderr)
+        return 1
     c = _client(args)
     try:
         out = c._request(
             "POST", f"/v1/client/allocation/{args.alloc_id}/restart",
-            {"Task": args.task})
+            {"Task": task})
     except ApiError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
@@ -870,11 +878,18 @@ def cmd_alloc_restart(args) -> int:
 
 
 def cmd_alloc_signal(args) -> int:
+    # reference surface: `alloc signal [-s <sig>] [-task <name>]
+    # <alloc> [<task>]` (alloc_signal.go)
+    task = args.task_opt or args.task
+    if args.task_opt and args.task and args.task_opt != args.task:
+        print("Error: task name given both as -task flag and "
+              "positional argument", file=sys.stderr)
+        return 1
     c = _client(args)
     try:
         out = c._request(
             "POST", f"/v1/client/allocation/{args.alloc_id}/signal",
-            {"Task": args.task, "Signal": args.signal})
+            {"Task": task, "Signal": args.signal})
     except ApiError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
@@ -1620,11 +1635,13 @@ def build_parser() -> argparse.ArgumentParser:
     astop.add_argument("alloc_id")
     astop.set_defaults(fn=cmd_alloc_stop)
     arst = alloc.add_parser("restart")
+    arst.add_argument("-task", dest="task_opt", default="")
     arst.add_argument("alloc_id")
     arst.add_argument("task", nargs="?", default="")
     arst.set_defaults(fn=cmd_alloc_restart)
     asig = alloc.add_parser("signal")
     asig.add_argument("-s", dest="signal", default="SIGUSR1")
+    asig.add_argument("-task", dest="task_opt", default="")
     asig.add_argument("alloc_id")
     asig.add_argument("task", nargs="?", default="")
     asig.set_defaults(fn=cmd_alloc_signal)

@@ -1,0 +1,321 @@
+"""Device-resident node table: the dense columns pinned on device,
+maintained by incremental scatter deltas.
+
+BENCH_r05 showed the system host-bound AROUND the kernel (163.8k
+placements/s in-kernel vs 12.3k e2e): every eval re-shipped the full
+(N, D) capacity/used columns to the device — at 50k nodes that is two
+~800 KB H2D transfers per dispatch, each a tunnel op on a remote TPU.
+This module keeps ONE device copy per NodeTableCache and advances it
+with batched row scatters:
+
+  - `capacity` is immutable per node-set epoch: uploaded once, reused
+    by every dispatch until a node registration/status flip rebuilds
+    the host table (epoch bump -> fresh upload).
+  - `used` / `free_ports` advance by `.at[rows].set(new_rows)` — the
+    rows a plan apply touched, shipped as (idx, values) pairs instead
+    of the whole column. `.set` (not `.add`) with the host-computed
+    values makes the mirror bit-identical to the host shadow by
+    construction: no float-order concerns, and parity is checkable row
+    for row.
+  - per-eval plan overlays (`ProposedIndex.plan_delta`) apply on
+    device as a sparse `.at[rows].add(deltas)` over the resident
+    `used`, so the kernel's `used0` never crosses the bus densely.
+
+MVCC: the mirror tracks ONE version — the cache's latest. Every
+NodeTable version carries a (mirror, version) token; a kernel dispatch
+uses the device arrays only when the token still matches, otherwise it
+falls back to shipping dense columns (stale snapshots pay, the steady
+state doesn't). Scatter dispatches are ASYNC (jax's deferred
+execution): the cache never blocks on them, so the device applies
+table deltas while the host builds the next eval's masks — the
+double-buffered delta application of the pipelined worker loop.
+
+Delta debt + fold-to-rebuild: every scatter pads its row block to a
+power-of-two bucket (bounds XLA recompiles) and appends device work;
+the cumulative scattered-row count since the last full upload is the
+mirror's *delta debt*. When debt crosses the governor watermark, one
+contiguous re-upload (`fold`) is cheaper than the scatter history it
+replaces — the reclaim policy registered in nomad_tpu/governor/.
+
+`NOMAD_TPU_TABLE_DELTA=0` disables both the host delta path and this
+mirror (every refresh becomes a cold rebuild) — the bisection escape
+hatch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+TABLE_DELTA_ENV = "NOMAD_TPU_TABLE_DELTA"
+
+# overlay/scatter row blocks above this fraction of the table fall back
+# to dense shipping — scattering most of the table costs more than one
+# contiguous transfer
+SPARSE_MAX_FRAC = 0.5
+DELTA_LOG_MAX = 256
+
+
+def delta_enabled() -> bool:
+    """The bisection escape hatch: NOMAD_TPU_TABLE_DELTA=0 forces the
+    old rebuild-per-refresh path (host and device alike)."""
+    return os.environ.get(TABLE_DELTA_ENV, "1") not in ("0", "off", "no")
+
+
+def _pad_n(n: int) -> int:
+    # kept in lockstep with ops/select._pad_n (the kernel's node-axis
+    # padding rule); duplicated to keep this module import-light
+    p = 8
+    while p < n:
+        p *= 2
+    return p
+
+
+def _bucket_rows(m: int) -> int:
+    b = 8
+    while b < m:
+        b *= 2
+    return b
+
+
+class DeviceTableState:
+    """Immutable snapshot of the mirror's device arrays. Readers grab
+    one reference and use it without locking; scatter updates replace
+    the whole state object, never mutate it (jax arrays are functional
+    anyway — this just makes the version/array pairing atomic)."""
+
+    __slots__ = ("version", "epoch", "n", "n_pad", "capacity", "used",
+                 "free_ports")
+
+    def __init__(self, version: int, epoch: int, n: int, n_pad: int,
+                 capacity, used, free_ports):
+        self.version = version
+        self.epoch = epoch
+        self.n = n
+        self.n_pad = n_pad
+        self.capacity = capacity
+        self.used = used
+        self.free_ports = free_ports
+
+
+class DeviceNodeTable:
+    """The device-resident mirror one NodeTableCache owns.
+
+    Lazy: holds no device memory (and triggers no jax init) until a
+    kernel first asks for arrays via `arrays_for`. Until then,
+    `note_delta`/`note_rebuild` just advance the version counter so a
+    later materialization starts from the right table."""
+
+    def __init__(self):
+        self._l = threading.Lock()
+        self._state: Optional[DeviceTableState] = None
+        self.version = 0            # latest host table version (token)
+        self.epoch = 0              # node-set generation
+        self.delta_debt = 0         # rows scattered since last upload
+        self.delta_log: List[Tuple[int, int]] = []  # (version, rows)
+        self.stats: Dict[str, int] = {
+            "uploads": 0, "scatters": 0, "folds": 0,
+            "overlay_dispatches": 0, "stale_misses": 0,
+        }
+
+    # -- cache-side bookkeeping (called under the cache's lock) --------
+    def note_rebuild(self) -> int:
+        """A node-set rebuild invalidated the columns: bump the epoch,
+        drop the device arrays (re-materialized lazily from the new
+        table), return the new version token."""
+        with self._l:
+            self.epoch += 1
+            self.version += 1
+            self._state = None
+            self.delta_debt = 0
+            self.delta_log.clear()
+            return self.version
+
+    def note_delta(self, table, rows) -> int:
+        """Advance the mirror past an alloc-delta refresh: `rows` are
+        the host-table indices the refresh touched. When materialized,
+        dispatch the row scatter asynchronously (no block — the device
+        chews it while the host moves on); otherwise only the version
+        advances. Returns the new version token."""
+        with self._l:
+            self.version += 1
+            st = self._state
+            if st is None:
+                return self.version
+            if rows:
+                try:
+                    st = self._scatter(st, table, rows)
+                except Exception:   # pragma: no cover — defensive:
+                    # a failed device op must not poison scheduling;
+                    # drop the mirror, dense fallback takes over
+                    st = None
+                    self.stats["stale_misses"] += 1
+            if st is not None:
+                st = DeviceTableState(self.version, self.epoch, st.n,
+                                      st.n_pad, st.capacity, st.used,
+                                      st.free_ports)
+            self._state = st
+            return self.version
+
+    def _scatter(self, st: DeviceTableState, table,
+                 rows) -> DeviceTableState:
+        import jax
+
+        m = len(rows)
+        if m > st.n * SPARSE_MAX_FRAC:
+            # wide delta: one contiguous upload beats a scatter of most
+            # of the table (counts as a fold, resets the debt)
+            return self._upload(table, epoch=st.epoch, fold=True)
+        idx = np.fromiter(rows, np.int32, m)
+        b = _bucket_rows(m)
+        if b > m:
+            # pad with repeats of the first row carrying its own value:
+            # duplicate .set with an identical payload is deterministic
+            idx = np.concatenate([idx, np.full(b - m, idx[0], np.int32)])
+        from ..utils import stages
+
+        t0 = _time.perf_counter() if stages.enabled else 0.0
+        used_rows = table.base_used[idx].astype(np.float32)
+        port_rows = table.free_ports[idx].astype(np.float32)
+        used, ports = _scatter_set(st.used, st.free_ports, idx,
+                                   used_rows, port_rows)
+        if stages.enabled:
+            # dispatch cost only — the scatter itself is async; the
+            # interesting signal is rows shipped vs a dense column
+            stages.add("h2d", _time.perf_counter() - t0)
+        self.delta_debt += m
+        self.delta_log.append((self.version, m))
+        if len(self.delta_log) > DELTA_LOG_MAX:
+            del self.delta_log[:len(self.delta_log) - DELTA_LOG_MAX]
+        self.stats["scatters"] += 1
+        del jax  # imported for the side effect of a clear failure mode
+        return DeviceTableState(st.version, st.epoch, st.n, st.n_pad,
+                                st.capacity, used, ports)
+
+    def _upload(self, table, epoch: int, fold: bool) -> DeviceTableState:
+        import jax
+
+        from ..utils import stages
+
+        t0 = _time.perf_counter() if stages.enabled else 0.0
+        n = table.n
+        n_pad = _pad_n(n)
+        d = table.base_used.shape[1]
+        cap = np.zeros((n_pad, d), np.float32)
+        cap[:n] = table.capacity
+        used = np.zeros((n_pad, d), np.float32)
+        used[:n] = table.base_used
+        ports = np.zeros(n_pad, np.float32)
+        ports[:n] = table.free_ports
+        st = DeviceTableState(self.version, epoch, n, n_pad,
+                              jax.device_put(cap), jax.device_put(used),
+                              jax.device_put(ports))
+        if stages.enabled:
+            stages.add("h2d", _time.perf_counter() - t0)
+        self.delta_debt = 0
+        self.delta_log.clear()
+        self.stats["folds" if fold else "uploads"] += 1
+        return st
+
+    def fold(self, table, version: Optional[int] = None) -> dict:
+        """Governor reclaim (fold-to-rebuild): replace the scatter
+        history with one contiguous re-upload from the current host
+        table. `table` must be the version the mirror tracks (the
+        cache passes its latest). No-op when never materialized."""
+        with self._l:
+            if version is not None and version != self.version:
+                return {"folded": False, "reason": "stale table"}
+            debt = self.delta_debt
+            if self._state is None:
+                self.delta_debt = 0
+                self.delta_log.clear()
+                return {"folded": False, "reason": "not materialized"}
+            self._state = self._upload(table, epoch=self.epoch,
+                                       fold=True)
+            return {"folded": True, "debt_cleared": debt}
+
+    # -- kernel-side access --------------------------------------------
+    def arrays_for(self, table) -> Optional[DeviceTableState]:
+        """The device arrays for `table`, or None when the mirror has
+        moved past it (stale snapshot -> dense fallback). First valid
+        call materializes the mirror from this table (full upload)."""
+        token = getattr(table, "device_version", -1)
+        with self._l:
+            if token != self.version:
+                self.stats["stale_misses"] += 1
+                return None
+            st = self._state
+            if st is None:
+                try:
+                    st = self._upload(table, epoch=self.epoch,
+                                      fold=False)
+                except Exception:   # pragma: no cover — defensive
+                    return None
+                self._state = st
+            return st
+
+    def overlay_used(self, st: DeviceTableState, rows: np.ndarray,
+                     deltas: np.ndarray):
+        """used0 = resident used + sparse per-eval plan overlay,
+        computed on device. Returns a device array (async), or None
+        when the overlay is too dense to be worth scattering."""
+        m = len(rows)
+        if m == 0:
+            return st.used
+        if m > st.n * SPARSE_MAX_FRAC:
+            return None
+        idx = np.asarray(rows, np.int32)
+        vals = np.asarray(deltas, np.float32)
+        b = _bucket_rows(m)
+        if b > m:
+            idx = np.concatenate([idx, np.zeros(b - m, np.int32)])
+            vals = np.concatenate(
+                [vals, np.zeros((b - m, vals.shape[1]), np.float32)])
+        self.stats["overlay_dispatches"] += 1
+        return _overlay_add(st.used, idx, vals)
+
+    # -- governor accounting -------------------------------------------
+    def debt(self) -> int:
+        return self.delta_debt
+
+    def log_len(self) -> int:
+        return len(self.delta_log)
+
+    def snapshot(self) -> dict:
+        with self._l:
+            return {"version": self.version, "epoch": self.epoch,
+                    "materialized": self._state is not None,
+                    "delta_debt": self.delta_debt,
+                    "delta_log": len(self.delta_log), **self.stats}
+
+
+# jitted scatter kernels: compiled per (n_pad, row-bucket) shape — both
+# axes are power-of-two bucketed, so the compile count stays bounded
+_JIT_CACHE: Dict[str, object] = {}
+
+
+def _jit(name: str, fn):
+    import jax
+
+    hit = _JIT_CACHE.get(name)
+    if hit is None:
+        hit = jax.jit(fn)
+        _JIT_CACHE[name] = hit
+    return hit
+
+
+def _scatter_set(used, ports, idx, used_rows, port_rows):
+    def fn(u, p, i, ur, pr):
+        return u.at[i].set(ur), p.at[i].set(pr)
+    return _jit("scatter_set", fn)(used, ports, idx, used_rows,
+                                   port_rows)
+
+
+def _overlay_add(used, idx, vals):
+    def fn(u, i, v):
+        return u.at[i].add(v)
+    return _jit("overlay_add", fn)(used, idx, vals)

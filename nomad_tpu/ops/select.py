@@ -138,6 +138,17 @@ class SelectRequest:
     # the resident table holds ALL nodes, so metrics must not count
     # down/foreign-DC rows as evaluated (AllocMetric semantics)
     n_considered: Optional[int] = None
+    # device-resident dispatch (ops/device_table.py): the host
+    # NodeTable whose mirror token may let this dispatch reuse the
+    # device copies of capacity/used/free_ports, plus the per-eval
+    # plan overlay in sparse (rows, deltas) form so `used0` is
+    # computed ON DEVICE from the resident base instead of shipping
+    # the dense column. Only set when `used` is exactly
+    # base_used + scatter(deltas at rows) — preemption overlays and
+    # private tables leave it None (dense fallback).
+    table: Optional[object] = None
+    used_base_rows: Optional[np.ndarray] = None   # i32[M]
+    used_base_deltas: Optional[np.ndarray] = None  # f32[M,D]
 
 
 @dataclasses.dataclass
@@ -900,11 +911,26 @@ def pack_request(req: SelectRequest, n_pad: int):
     return args, statics
 
 
+def _stage_get(outs):
+    """jax.device_get with bench attribution: result transfers are the
+    `d2h` stage of the per-stage breakdown (the wall includes any
+    remaining device compute — jax blocks the transfer on it — so d2h
+    nests inside the kernel-stage window; see utils/stages)."""
+    from ..utils import stages
+    if not stages.enabled:
+        return jax.device_get(outs)
+    import time as _time
+    t0 = _time.perf_counter()
+    vals = jax.device_get(outs)
+    stages.add("d2h", _time.perf_counter() - t0)
+    return vals
+
+
 def unpack_result(req: SelectRequest, outs) -> SelectResult:
     # ONE batched transfer: per-array np.asarray would serialize a
     # ~100ms device round trip per output over a tunneled TPU
     (choices, finals, s_bin, s_anti, s_pen, s_aff, s_spread, s_dev, s_pre,
-     top_idx, top_scores, exhausted, _ok_counts) = jax.device_get(outs)
+     top_idx, top_scores, exhausted, _ok_counts) = _stage_get(outs)
     # meta rows (top-k, exhaustion) are materialized only on the first
     # and failing steps; forward-fill the sentinels in between
     sentinel = exhausted[:, 0] < 0
@@ -1174,6 +1200,104 @@ def _expand_kway(req: SelectRequest, rounds) -> SelectResult:
         placed=pos,
     )
 
+class DispatchCostModel:
+    """Measured per-shape dispatch costs, replacing the static step
+    constants once warm.
+
+    Every device phase (dispatch through result transfer) of the solo
+    and batched kernel arms reports its wall clock here, keyed by
+    (arm, n_pad) — batched arms report seconds PER LANE so solo and
+    batched numbers compare directly. The batching and host/accel
+    routing decisions then rest on what THIS host+device pair actually
+    measured at this table shape rather than on constants calibrated
+    on different hardware (BENCH_r05: the static model demoted every
+    broker lane on real TPU — service_broker_batches=0 — while the
+    shapes it demoted measured 1.42-1.61x when they fired).
+
+    Exploration: a batched arm that is never dispatched is never
+    measured, so when solo numbers are warm and batched ones are cold
+    the profitability question returns True once every PROBE_EVERY
+    calls — and a batched arm that measured SLOWER keeps being probed
+    at the same cadence, so a stale number (e.g. one taken while the
+    device was busy) cannot demote lanes forever.
+
+    Methodology (recorded for re-anchor audits, STATUS.md §2.6): EWMA
+    with alpha=0.25 over per-lane seconds, minimum 3 samples before a
+    measured number overrides a formula, count variation deliberately
+    folded into the EWMA (per-shape means per (arm, table size) — the
+    steady state re-dispatches the same shapes, which is exactly when
+    the numbers matter). The FIRST sample at a shape pays XLA compile
+    and would dominate the EWMA for many rounds (alpha=0.25 decays a
+    seconds-long compile wall to ~1s after 3 samples vs a ~5ms steady
+    state); the second observation REPLACES it rather than blending.
+    Timing windows include per-request host unpack/expand on both the
+    solo and batched arms, so the comparison is end-to-end per lane,
+    not device-dispatch-only."""
+
+    ALPHA = 0.25
+    MIN_SAMPLES = 3
+    PROBE_EVERY = 16
+
+    def __init__(self):
+        import threading
+        self._l = threading.Lock()
+        self._stats: Dict[Tuple[str, int], List[float]] = {}
+        self._probe = 0
+
+    def observe(self, arm: str, n_pad: int, seconds: float,
+                lanes: int = 1) -> None:
+        from ..utils import stages
+        if stages.enabled:
+            # every arm reports its dispatch wall here — one choke
+            # point doubles as the bench's `kernel` stage accumulator
+            stages.add("kernel", seconds)
+        per_lane = seconds / max(lanes, 1)
+        key = (arm, n_pad)
+        with self._l:
+            ent = self._stats.get(key)
+            if ent is None:
+                self._stats[key] = [per_lane, 1]
+            elif ent[1] == 1:
+                # the first sample at a shape includes XLA compile;
+                # replace it with the first steady-state number
+                # instead of folding the compile wall into the EWMA
+                ent[0] = per_lane
+                ent[1] = 2
+            else:
+                ent[0] += self.ALPHA * (per_lane - ent[0])
+                ent[1] += 1
+
+    def estimate(self, arm: str, n_pad: int) -> Optional[float]:
+        ent = self._stats.get((arm, n_pad))
+        if ent is None or ent[1] < self.MIN_SAMPLES:
+            return None
+        return ent[0]
+
+    def best(self, arms, n_pad: int) -> Optional[float]:
+        vals = [v for v in (self.estimate(a, n_pad) for a in arms)
+                if v is not None]
+        return min(vals) if vals else None
+
+    def probe_due(self) -> bool:
+        with self._l:
+            self._probe += 1
+            return self._probe % self.PROBE_EVERY == 0
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._l:
+            return {f"{arm}@{n_pad}": {"ewma_s": round(ent[0], 6),
+                                       "samples": ent[1]}
+                    for (arm, n_pad), ent in sorted(self._stats.items())}
+
+
+SOLO_ARMS = ("chunked", "kway", "scan")
+BATCHED_ARMS = ("chunked_batched", "kway_batched", "scan_batched")
+
+# process-wide: every SelectKernel (workers, gateways, benches) feeds
+# and reads the same measured numbers
+cost_model = DispatchCostModel()
+
+
 _accel_rtt_cache: List[float] = []
 
 
@@ -1308,9 +1432,11 @@ class SelectKernel:
         return self._sharded
 
     # -- routing -------------------------------------------------------
-    def _pick_device(self, n: int, est_steps: int):
+    def _pick_device(self, n: int, est_steps: int, arm: str = "chunked"):
         """Returns the CPU device to force host execution, or None to
-        use the default (accelerator) placement."""
+        use the default (accelerator) placement. Prefers MEASURED
+        per-shape dispatch costs (cost_model) over the static step
+        constants once either side is warm at this table shape."""
         if jax.default_backend() == "cpu":
             return None                      # already on host
         if self.backend == "accel":
@@ -1320,6 +1446,13 @@ class SelectKernel:
             return None
         if self.backend == "cpu":
             return cpu
+        meas_accel = cost_model.estimate(arm, n)
+        meas_cpu = cost_model.estimate(arm + "@cpu", n)
+        if meas_accel is not None and meas_cpu is not None:
+            # measured walls include d2h/unpack/continuation rounds the
+            # step formulas omit — only compare like against like; a
+            # lone measurement never overrides the formula pair
+            return cpu if meas_cpu <= meas_accel else None
         est_cpu = est_steps * (self._CPU_STEP_BASE_S
                                + n * self._CPU_STEP_PER_NODE_S)
         est_accel = 2 * _accel_roundtrip_s() + est_steps * self._ACCEL_STEP_S
@@ -1332,6 +1465,41 @@ class SelectKernel:
         return {k: (jax.device_put(v, dev) if isinstance(v, np.ndarray)
                     and v.ndim > 0 else v)
                 for k, v in args.items()}
+
+    def _resident_args(self, req: SelectRequest, n_pad: int,
+                       dev) -> Optional[Dict]:
+        """Device-resident replacements for the table-shaped inputs
+        (capacity, used0, free_ports) when the request's NodeTable
+        carries a live mirror token (ops/device_table.py): capacity
+        and free_ports come straight off the resident device arrays,
+        and used0 is computed ON DEVICE as resident-used + the sparse
+        per-eval plan overlay — no dense table column crosses the bus.
+        Returns None (dense fallback) for stale tables, host-forced
+        dispatches, or overlays too wide to scatter."""
+        if dev is not None:
+            return None                 # mirror lives on the default device
+        t = req.table
+        if t is None or req.used_base_rows is None:
+            return None
+        mirror = getattr(t, "device_mirror", None)
+        if mirror is None:
+            return None
+        from ..utils import metrics
+        state = mirror.arrays_for(t)
+        if state is None or state.n_pad != n_pad:
+            metrics.incr_counter("nomad.select.resident_fallback")
+            return None
+        used0 = mirror.overlay_used(state, req.used_base_rows,
+                                    req.used_base_deltas)
+        if used0 is None:
+            metrics.incr_counter("nomad.select.resident_fallback")
+            return None
+        out = {"capacity": state.capacity, "used0": used0}
+        if req.free_ports is not None and \
+                req.free_ports is getattr(t, "free_ports", None):
+            out["free_ports"] = state.free_ports
+        metrics.incr_counter("nomad.select.resident_dispatch")
+        return out
 
     # -- entry ---------------------------------------------------------
     def select(self, req: SelectRequest) -> SelectResult:
@@ -1396,31 +1564,41 @@ class SelectKernel:
         if chunk_ok:
             # chunked steps ~ nodes touched + overtakes, bounded by count
             est_steps = min(req.count, 2 * n)
-            dev = self._pick_device(n_pad, est_steps)
-            if req.count > 512 and n_pad > KWAY_W:
+            arm = "kway" if req.count > 512 and n_pad > KWAY_W \
+                else "chunked"
+            dev = self._pick_device(n_pad, est_steps, arm=arm)
+            if arm == "kway":
                 # big batches: K-way phases place on the top-32 nodes at
                 # once — an order of magnitude fewer sequential steps
                 return self._run_kway(req, n_pad, dev)
             return self._run_chunked(req, n_pad, dev)
-        dev = self._pick_device(n_pad, req.count)
+        import time as _time
+        dev = self._pick_device(n_pad, req.count, arm="scan")
         k = _bucket_k(max(req.count, 1))
         args, statics = pack_request(req, n_pad)
         args = self._place_args(args, dev)
+        resident = self._resident_args(req, n_pad, dev)
+        if resident:
+            args.update(resident)
+        t0 = _time.perf_counter()
         _carry, outs = _select_scan(**args, k_steps=k, **statics)
-        return unpack_result(req, outs)
+        out = unpack_result(req, outs)
+        cost_model.observe("scan" + ("@cpu" if dev is not None else ""),
+                           n_pad, _time.perf_counter() - t0)
+        return out
 
     # -- k-way chunked path --------------------------------------------
-    def _dispatch_kway(self, req: SelectRequest, n_pad: int, dev):
-        """Issue the first K-way dispatch without waiting; returns the
-        (cargs, spread_alg, pending, w) state for _finish_kway."""
+    def _pack_kway(self, req: SelectRequest, n_pad: int, dev):
+        """Pack + place the K-way kernel args; returns
+        (cargs, spread_alg, w). Split from the dispatch so the cost
+        model's window starts at the dispatch, like the other arms."""
         args, _statics = pack_request(req, n_pad)
         cargs = {k: args[k] for k in _CHUNKED_ARGS}
         cargs = self._place_args(cargs, dev)
-        spread_alg = req.algorithm == "spread"
-        w = _kway_w(n_pad)
-        pending = _select_kway(**cargs, max_steps=_kway_steps(w),
-                               spread_alg=spread_alg, w=w)
-        return cargs, spread_alg, pending, w
+        resident = self._resident_args(req, n_pad, dev)
+        if resident:
+            cargs.update(resident)
+        return cargs, req.algorithm == "spread", _kway_w(n_pad)
 
     def _finish_kway(self, req: SelectRequest, cargs, spread_alg,
                      pending, w: int) -> SelectResult:
@@ -1429,9 +1607,19 @@ class SelectKernel:
 
     def _run_kway(self, req: SelectRequest, n_pad: int,
                   dev) -> SelectResult:
-        cargs, spread_alg, pending, w = self._dispatch_kway(req, n_pad,
-                                                            dev)
-        return self._finish_kway(req, cargs, spread_alg, pending, w=w)
+        import time as _time
+        cargs, spread_alg, w = self._pack_kway(req, n_pad, dev)
+        # window matches every other arm: dispatch through
+        # unpack/expand, packing/placement excluded
+        t0 = _time.perf_counter()
+        pending = _select_kway(**cargs, max_steps=_kway_steps(w),
+                               spread_alg=spread_alg, w=w)
+        rounds = self._finish_kway_rounds(req, cargs, spread_alg,
+                                          pending, w=w)
+        out = _expand_kway(req, rounds)
+        cost_model.observe("kway" + ("@cpu" if dev is not None else ""),
+                           n_pad, _time.perf_counter() - t0)
+        return out
 
     def select_many(self, reqs: List[SelectRequest]) -> List[SelectResult]:
         """Place B independent requests over the SAME node table in one
@@ -1497,12 +1685,14 @@ class SelectKernel:
             cargs, sharded, reqs[0].capacity, n_pad,
             sum(min(r.count, 2 * n) for r in reqs))
         w = _kway_w(n_pad)
+        import time as _time
+        t0 = _time.perf_counter()
         with mesh_ctx:
             carry, outs = _select_kway_batched(**cargs,
                                                max_steps=_kway_steps(w),
                                                spread_alg=spread_alg,
                                                w=w)
-        packed_i, ts = jax.device_get(outs)
+        packed_i, ts = _stage_get(outs)
         d = reqs[0].capacity.shape[1]
         results = []
         for i, req in enumerate(reqs):
@@ -1538,6 +1728,10 @@ class SelectKernel:
                                                 pending, w=w)
                 rounds.extend(cont)
             results.append(_expand_kway(req, rounds))
+        # window includes per-lane unpack/expand so the number compares
+        # end-to-end against the solo arms (which include theirs)
+        cost_model.observe("kway_batched", n_pad,
+                           _time.perf_counter() - t0, lanes=len(reqs))
         return results
 
     @staticmethod
@@ -1579,11 +1773,18 @@ class SelectKernel:
 
     def batch_dispatch_profitable(self, n: int,
                                   count_hint: int = 16) -> bool:
-        """Should the worker coalesce evals into gateway lanes? Only
-        when a batched dispatch would route to the accelerator (mesh
-        counts): on host-routed shapes B solo chunked dispatches beat
-        one vmapped dispatch and the GIL serializes lane host work, so
-        sequential processing of the drained queue wins. Overridable
+        """Should the worker coalesce evals into gateway lanes?
+
+        Recalibrated (BENCH_r05: the static model demoted every broker
+        lane on real TPU even where batching measured 1.42-1.61x):
+        once the cost model holds MEASURED per-lane dispatch costs for
+        both a batched arm and a solo arm at this table shape, the
+        decision is simply measured-batched < measured-solo. Until the
+        batched side is warm, a periodic probe lets lanes fire so the
+        measurement exists at all. The static fallback remains: batch
+        only when the dispatch would route to the accelerator (on
+        host-routed shapes B solo chunked dispatches beat one vmapped
+        dispatch and the GIL serializes lane host work). Overridable
         with NOMAD_TPU_EVAL_BATCH=force|off (tests force lanes on CPU
         hosts)."""
         import os
@@ -1594,9 +1795,22 @@ class SelectKernel:
             return False
         if self._mesh_sharded() is not None:
             return True
+        n_pad = _pad_n(n)
+        solo = cost_model.best(SOLO_ARMS, n_pad)
+        batched = cost_model.best(BATCHED_ARMS, n_pad)
+        if solo is not None and batched is not None:
+            if batched < solo:
+                return True
+            # measured demote — but keep the batched EWMA fresh: a
+            # stale number (device contention, early-sample noise)
+            # must not demote lanes forever, so probe at the same
+            # exploration cadence
+            return cost_model.probe_due()
         if jax.default_backend() == "cpu":
             return False
-        n_pad = _pad_n(n)
+        if solo is not None and batched is None and \
+                cost_model.probe_due():
+            return True                 # exploration: measure a batch
         return self._pick_device(
             n_pad, _bucket_k(max(count_hint, 1))) is None
 
@@ -1613,9 +1827,11 @@ class SelectKernel:
         fn = _chunked_batched_jit(max_steps, spread_alg)
         cargs, mesh_ctx = self._place_batched(
             cargs, sharded, reqs[0].capacity, n_pad, min(maxc, 2 * n_pad))
+        import time as _time
+        t0 = _time.perf_counter()
         with mesh_ctx:
             carry, outs = fn(*[cargs[nm] for nm in _CHUNKED_ARGS])
-        outs_np = jax.device_get(outs)
+        outs_np = _stage_get(outs)
         results = []
         for i, req in enumerate(reqs):
             (choice, chunk, ti, ts, exh, feas, rem, steps) = \
@@ -1639,6 +1855,10 @@ class SelectKernel:
                     k_valid=np.int32(rem))
                 rounds.extend(self._chunked_rounds(lane, spread_alg))
             results.append(_expand_chunks(req, rounds))
+        # window includes per-lane unpack/expand so the number compares
+        # end-to-end against the solo arms (which include theirs)
+        cost_model.observe("chunked_batched", n_pad,
+                           _time.perf_counter() - t0, lanes=len(reqs))
         return results
 
     @staticmethod
@@ -1652,7 +1872,7 @@ class SelectKernel:
             (used, coll, freep, devs), outs = _select_chunked(
                 **cargs, max_steps=max_steps, spread_alg=spread_alg)
             (choice, chunk, ti, ts, exh, feas,
-             rem, steps) = jax.device_get(outs)
+             rem, steps) = _stage_get(outs)
             steps = int(steps)
             rem = int(rem)
             rounds.append((choice[:steps], chunk[:steps], ti[:steps],
@@ -1681,11 +1901,18 @@ class SelectKernel:
         fn = _scan_batched_jit(k, spread_alg, s_live, p_live)
         cargs, mesh_ctx = self._place_batched(
             cargs, sharded, reqs[0].capacity, n_pad, k)
+        import time as _time
+        t0 = _time.perf_counter()
         with mesh_ctx:
             _carry, outs = fn(*[cargs[nm] for nm in _SCAN_ARGS])
-        outs_np = jax.device_get(outs)
-        return [unpack_result(r, tuple(a[i] for a in outs_np))
-                for i, r in enumerate(reqs)]
+        outs_np = _stage_get(outs)
+        results = [unpack_result(r, tuple(a[i] for a in outs_np))
+                   for i, r in enumerate(reqs)]
+        # window includes per-lane unpack so the number compares
+        # end-to-end against the solo arms (which include theirs)
+        cost_model.observe("scan_batched", n_pad,
+                           _time.perf_counter() - t0, lanes=len(reqs))
+        return results
 
     def _finish_kway_rounds(self, req, cargs, spread_alg, pending,
                             w: int):
@@ -1695,7 +1922,7 @@ class SelectKernel:
         rounds = []
         while True:
             (used, coll, freep, devs), outs = pending
-            packed_i, ts = jax.device_get(outs)
+            packed_i, ts = _stage_get(outs)
             widx = packed_i[:, :w]
             chunk = packed_i[:, w:2 * w]
             ti = packed_i[:, 2 * w:2 * w + TOP_K]
@@ -1718,9 +1945,13 @@ class SelectKernel:
     # -- chunked path --------------------------------------------------
     def _run_chunked(self, req: SelectRequest, n_pad: int,
                      dev) -> SelectResult:
+        import time as _time
         args, _statics = pack_request(req, n_pad)
         cargs = {k: args[k] for k in _CHUNKED_ARGS}
         cargs = self._place_args(cargs, dev)
+        resident = self._resident_args(req, n_pad, dev)
+        if resident:
+            cargs.update(resident)
         spread_alg = req.algorithm == "spread"
         # near-equal node scores make chunks short (each placement is
         # overtaken after 1-2 instances), so a big count can need
@@ -1737,11 +1968,12 @@ class SelectKernel:
             max_steps = 16384       # covers count<=16384 in one dispatch
                                     # (a step always places >=1 or stops)
         rounds = []
+        t0 = _time.perf_counter()
         while True:
             (used, coll, freep, devs), outs = _select_chunked(
                 **cargs, max_steps=max_steps, spread_alg=spread_alg)
             (choice, chunk, ti, ts, exh, feas,
-             rem, steps) = jax.device_get(outs)
+             rem, steps) = _stage_get(outs)
             steps = int(steps)
             rem = int(rem)
             rounds.append((choice[:steps], chunk[:steps], ti[:steps],
@@ -1753,7 +1985,11 @@ class SelectKernel:
             # ran out of steps: continue from the device-resident carry
             cargs.update(used0=used, tg_coll0=coll, free_ports=freep,
                          dev_slots0=devs, k_valid=np.int32(rem))
-        return _expand_chunks(req, rounds)
+        out = _expand_chunks(req, rounds)
+        cost_model.observe(
+            "chunked" + ("@cpu" if dev is not None else ""), n_pad,
+            _time.perf_counter() - t0)
+        return out
 
 
 def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:

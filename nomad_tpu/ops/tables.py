@@ -14,6 +14,7 @@ index epoch; the scheduler calls `NodeTable.build` once per eval at most
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -27,6 +28,12 @@ from .targets import TargetColumns, constraint_mask
 
 RES_DIMS = 4  # cpu_shares, memory_mb, disk_mb, network_mbits
 DIM_NAMES = ("cpu", "memory", "disk", "network")
+
+# table-maintenance accounting (governor gauges + the steady-state
+# smoke test): full column builds vs incremental delta refreshes. A
+# healthy steady state performs ZERO full builds — every refresh rides
+# the delta path; the counters make that checkable instead of assumed.
+BUILD_STATS: Dict[str, int] = {"full_builds": 0, "delta_refreshes": 0}
 
 
 # usage rows memoized by the identity of the alloc's resources object:
@@ -152,6 +159,12 @@ class NodeTable:
         # shape; entries pin their row so id() can't be recycled
         # (scheduler/preemption.py PreemptionRound)
         self.preempt_cache: Dict[Tuple, tuple] = {}
+        # device-resident mirror token (ops/device_table.py): set by
+        # NodeTableCache on tables it serves; a kernel dispatch uses
+        # the mirror's arrays only while the token still matches the
+        # mirror's version (stale snapshots fall back to dense H2D)
+        self.device_mirror = None
+        self.device_version = -1
 
         self.capacity = np.zeros((self.n, RES_DIMS), dtype=np.float32)
         self.ready = np.zeros(self.n, dtype=bool)
@@ -209,6 +222,7 @@ class NodeTable:
                 continue
             nodes.append(node)
         nodes.sort(key=lambda n: n.id)
+        BUILD_STATS["full_builds"] += 1
         t = cls(nodes)
         # bulk accumulation: per-alloc numpy scalar adds cost ~4 ops x
         # 2M rows; instead collect (node idx, usage-code) pairs in one
@@ -314,6 +328,8 @@ class NodeTable:
         t._ready_dc_cache = self._ready_dc_cache  # status cols shared
         t._sealed = True
         t._pending_allocs = []
+        t.device_mirror = None      # stamped by the cache per version
+        t.device_version = -1
         return t
 
     @staticmethod
@@ -394,15 +410,19 @@ class NodeTable:
             if i is not None:
                 self.add_alloc_usage(i, new)
 
-    def apply_alloc_changes(self, snapshot, alloc_ids) -> None:
+    def apply_alloc_changes(self, snapshot, alloc_ids) -> set:
         """Batched delta replay: one vectorized usage scatter-add plus
         one row CoW per touched node, instead of per-alloc scalar numpy
         ops (a 10k-alloc plan apply replays in ~50 ms instead of
         ~700 ms — round-5 profile). The remove half of every change
         (update or disappearance) stays on the scalar path — rare in
         steady state; every alloc with a live new version (brand-new or
-        updated) is re-added via the batch path."""
+        updated) is re-added via the batch path.
+
+        Returns the set of touched node row indices — the cache ships
+        exactly these rows to the device mirror as a scatter delta."""
         adds = []
+        touched: set = set()
         by_id_get = self.alloc_by_id.get
         idx_get = self.id_to_idx.get
         for aid in dict.fromkeys(alloc_ids):
@@ -413,12 +433,14 @@ class NodeTable:
                 i = idx_get(old.node_id)
                 if i is not None:
                     self.remove_alloc_usage(i, old)
+                    touched.add(i)
             if new_live:
                 i = idx_get(new.node_id)
                 if i is not None:
                     adds.append((i, new))
+                    touched.add(i)
         if not adds:
-            return
+            return touched
         self._seal()
         idxs = np.fromiter((i for i, _ in adds), np.int64, len(adds))
         usage = np.asarray([_alloc_usage(a) for _, a in adds], np.float32)
@@ -442,6 +464,7 @@ class NodeTable:
             if bits:
                 self._net_bits[i] |= bits
                 self._mark_ports_dirty(i)
+        return touched
 
     def _mark_ports_dirty(self, i: int) -> None:
         if self._free_ports_dirty is None:
@@ -590,15 +613,34 @@ class NodeTableCache:
     version — the device-facing analog of the store's MVCC roots.
     Alloc changes apply as row deltas from the store changelog; node-set
     changes (rare: registration, status flips, drain) trigger a full
-    rebuild because they invalidate the attribute columns."""
+    rebuild because they invalidate the attribute columns.
+
+    Each served table carries a device-mirror token
+    (ops/device_table.py): the dense columns live on device across
+    evals and advance by the same row deltas as scatter-sets, so
+    `get` hands the kernel a device handle + delta log instead of a
+    rebuild + re-upload. `NOMAD_TPU_TABLE_DELTA=0` forces the old
+    rebuild path for bisection."""
 
     def __init__(self):
         import threading
+
+        from .device_table import DeviceNodeTable
         self._lock = threading.Lock()
         self._table: Optional[NodeTable] = None
         self._index = -1
+        self.device = DeviceNodeTable()
+        self.stats: Dict[str, int] = {"full_builds": 0,
+                                      "delta_refreshes": 0}
+
+    def _stamp(self, t: NodeTable, version: int) -> NodeTable:
+        t.device_mirror = self.device
+        t.device_version = version
+        return t
 
     def get(self, snapshot, build: bool = True) -> Optional[NodeTable]:
+        from ..utils import stages
+        from .device_table import delta_enabled
         store = snapshot._store
         target = snapshot.latest_index()
         with self._lock:
@@ -609,28 +651,64 @@ class NodeTableCache:
                 # build — or nothing, for callers that would rather
                 # fall back than pay a full build
                 return NodeTable.build_all(snapshot) if build else None
+            t0 = time.perf_counter() if stages.enabled else 0.0
             if self._table is None:
                 if not build:
                     return None
-                self._table = NodeTable.build_all(snapshot)
+                self.stats["full_builds"] += 1
+                self._table = self._stamp(NodeTable.build_all(snapshot),
+                                          self.device.note_rebuild())
                 self._index = target
+                if stages.enabled:
+                    stages.add("table_build", time.perf_counter() - t0)
                 return self._table
             changes = store.changes_since(self._index, target)
-            if changes is None or any(k == "node" for k, _ in changes):
+            if changes is None or any(k == "node" for k, _ in changes) \
+                    or (changes and not delta_enabled()):
                 if not build:
                     return None
-                self._table = NodeTable.build_all(snapshot)
+                self.stats["full_builds"] += 1
+                self._table = self._stamp(NodeTable.build_all(snapshot),
+                                          self.device.note_rebuild())
                 self._index = target
+                if stages.enabled:
+                    stages.add("table_build", time.perf_counter() - t0)
                 return self._table
             if changes:
-                # last-write-wins dedupe, then row deltas on a fresh clone
+                # last-write-wins dedupe, then row deltas on a fresh
+                # clone; the touched rows ship to the device mirror as
+                # an async scatter (the double-buffered half of the
+                # pipelined worker loop — the device applies them while
+                # the host builds the next eval's masks)
                 seen = dict.fromkeys(aid for _k, aid in changes)
                 t = self._table.clone_for_deltas()
-                t.apply_alloc_changes(snapshot, seen)
+                rows = t.apply_alloc_changes(snapshot, seen)
                 t.finalize()
-                self._table = t
+                BUILD_STATS["delta_refreshes"] += 1
+                self.stats["delta_refreshes"] += 1
+                self._table = self._stamp(
+                    t, self.device.note_delta(t, rows))
+                if stages.enabled:
+                    stages.add("table_build", time.perf_counter() - t0)
             self._index = target
             return self._table
+
+    # -- governor integration (fold-to-rebuild reclaim) ----------------
+    def device_delta_debt(self) -> int:
+        return self.device.debt()
+
+    def device_delta_log_len(self) -> int:
+        return self.device.log_len()
+
+    def fold_device(self) -> dict:
+        """Reclaim: replace the mirror's scatter history with one
+        contiguous re-upload from the current host table (registered
+        as the node_table.delta_debt watermark's reclaim)."""
+        with self._lock:
+            if self._table is None:
+                return {"folded": False, "reason": "no table"}
+            return self.device.fold(self._table,
+                                    self._table.device_version)
 
 
 class ProposedIndex:
@@ -645,8 +723,11 @@ class ProposedIndex:
         self.plan = plan
         n = table.n
         # per-node usage delta from the plan (stops/preemptions free
-        # resources; in-flight placements consume them)
+        # resources; in-flight placements consume them); touched rows
+        # tracked so the overlay can ship sparsely to a device-resident
+        # table (used_sparse)
         self.plan_delta = np.zeros((n, RES_DIMS), dtype=np.float32)
+        self._plan_touched: set = set()
         # counts of this job's proposed allocs per node / per task group
         self.job_count = np.zeros(n, dtype=np.int32)
         self.tg_count: Dict[str, np.ndarray] = {}
@@ -691,10 +772,12 @@ class ProposedIndex:
                             usage = _alloc_usage(live)
                             break
                 self.plan_delta[i] -= usage
+                self._plan_touched.add(i)
             for node_id, allocs in plan.node_allocation.items():
                 i = table.id_to_idx.get(node_id)
                 if i is None:
                     continue
+                self._plan_touched.add(i)
                 for a in allocs:
                     self.plan_delta[i] += _alloc_usage(a)
                     if a.job_id == job.id and a.namespace == job.namespace:
@@ -713,6 +796,18 @@ class ProposedIndex:
     def used(self) -> np.ndarray:
         """f32[N,3] effective usage: live + plan overlay."""
         return self.table.base_used + self.plan_delta
+
+    def used_sparse(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(rows i32[M], deltas f32[M,D]) such that used() equals
+        table.base_used with deltas scattered at rows — the per-eval
+        plan overlay in sparse form, so a device-resident dispatch
+        ships M touched rows instead of the dense (N, D) column."""
+        if not self._plan_touched:
+            return (np.zeros(0, np.int32),
+                    np.zeros((0, RES_DIMS), np.float32))
+        rows = np.fromiter(sorted(self._plan_touched), np.int32,
+                           len(self._plan_touched))
+        return rows, self.plan_delta[rows]
 
     def tg_counts(self, tg_name: str) -> np.ndarray:
         arr = self.tg_count.get(tg_name)

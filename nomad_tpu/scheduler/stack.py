@@ -426,6 +426,18 @@ class PlacementEngine:
             else:
                 pre_score = None
 
+        # device-resident dispatch (ops/device_table.py): hand the
+        # kernel the table's mirror token plus the plan overlay in
+        # sparse form, so used0 is computed on device from the
+        # resident base. Valid only when used_arr is EXACTLY
+        # base_used + plan overlay — a preemption rewrite of the used
+        # rows falls back to dense shipping.
+        table_ref = None
+        used_rows = used_deltas = None
+        if pre_score is None and proposed.table is t:
+            table_ref = t
+            used_rows, used_deltas = proposed.used_sparse()
+
         req = SelectRequest(
             ask=self.group_ask(tg),
             count=count,
@@ -452,6 +464,9 @@ class PlacementEngine:
             sum_spread_weights=sum_spread_w,
             distinct_props=distinct_props,
             n_considered=int(self._base_mask.sum()),
+            table=table_ref,
+            used_base_rows=used_rows,
+            used_base_deltas=used_deltas,
         )
         res = self.dispatch(req)
         elapsed = time.monotonic_ns() - start

@@ -133,6 +133,18 @@ class ServerConfig:
     # 0 = derive from the shape-LRU bound (2 caches x KERNEL_CACHE_MAX
     # + slack for jax's internal per-function caches)
     governor_kernel_cache_high: int = 0
+    # device-resident node table (ops/device_table.py): scattered-row
+    # debt that triggers the fold-to-rebuild reclaim (one contiguous
+    # re-upload replacing the scatter history)
+    governor_table_delta_debt_high: int = 200_000
+    # backpressure escalation: when the broker's delayed/requeue heap
+    # itself crosses this depth, the HTTP job-register path starts
+    # returning 429 + Retry-After (0 disables)
+    governor_broker_delayed_high: int = 16384
+    # pipelined worker loop: eval N's ack-side bookkeeping overlaps
+    # eval N+1's host phase, and the resident table's device scatter
+    # is dispatched right after the snapshot fence
+    worker_pipeline: bool = True
 
 
 class Server:
@@ -144,6 +156,10 @@ class Server:
         self._raft_l = threading.RLock()
         self._raft_index = 10
         self.eval_broker = EvalBroker()
+        # backpressure escalation threshold lives on the broker even
+        # with the governor off — the HTTP register path reads it
+        self.eval_broker.delayed_depth_high = \
+            self.config.governor_broker_delayed_high
         self.blocked_evals = BlockedEvals(self._unblock_enqueue)
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.plan_queue, self)
@@ -354,8 +370,32 @@ class Server:
 
         # resident-table identity memos (ops/tables.py): FIFO-bounded,
         # but accounted — every entry pins a resources graph
-        from ..ops.tables import resource_memo_len
+        from ..ops.tables import BUILD_STATS, resource_memo_len
         gov.register("node_table.resource_memo", resource_memo_len)
+
+        # device-resident node table (ops/device_table.py): scattered-
+        # row debt with fold-to-rebuild as the reclaim — when the
+        # scatter history since the last contiguous upload crosses the
+        # watermark, one full re-upload replaces it and resets the
+        # delta log. Gauges read through self.store: the table cache
+        # is REPLACED on snapshot restore (store.py), so captured
+        # references would go stale
+        gov.register("node_table.delta_debt",
+                     lambda: self.store.table_cache.device_delta_debt(),
+                     WatermarkPolicy(cfg.governor_table_delta_debt_high),
+                     reclaim=lambda: self.store.table_cache.fold_device())
+        gov.register("node_table.delta_log",
+                     lambda: self.store.table_cache.device_delta_log_len())
+        gov.register("node_table.full_builds",
+                     lambda: BUILD_STATS["full_builds"], suspect=False)
+        gov.register("node_table.delta_refreshes",
+                     lambda: BUILD_STATS["delta_refreshes"],
+                     suspect=False)
+
+        # backpressure escalation (ROADMAP open item): the delayed/
+        # requeue heap depth — when admission deferral itself backs up,
+        # the HTTP register path starts shedding with 429s
+        gov.register("broker.delayed_depth", broker.delayed_depth)
 
         # admission control: the broker sheds fresh enqueues while any
         # pressure gauge is over

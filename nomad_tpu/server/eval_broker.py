@@ -28,6 +28,17 @@ DEFAULT_SUBSEQUENT_NACK_DELAY_S = 20.0
 DEFAULT_ADMISSION_DELAY_S = 0.25
 
 
+class AdmissionOverloadError(Exception):
+    """Backpressure escalation (ROADMAP open item): raised by the HTTP
+    job-register path when the broker's delayed/requeue heap itself has
+    crossed its watermark — the shed valve is full, so new work must be
+    refused at the edge (429 + Retry-After) instead of parked."""
+
+    def __init__(self, msg: str, retry_after_s: float):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 class _PQ:
     """Priority heap: highest priority first, FIFO by create index."""
 
@@ -105,7 +116,38 @@ class EvalBroker:
         # the ready queue (recovering as soon as the gauge clears)
         self.pressure_fn = None
         self.admission_delay_s = DEFAULT_ADMISSION_DELAY_S
+        # escalation stage: when the delayed heap ITSELF exceeds this
+        # depth, register_admission() refuses new work (the HTTP path
+        # turns that into 429 + Retry-After). 0 disables.
+        self.delayed_depth_high = 0
         self.stats = BrokerStats()
+
+    # -- admission escalation ------------------------------------------
+    def delayed_depth(self) -> int:
+        """Depth of the non-core delayed/requeue heap (the shed
+        valve's backlog) — the escalation gauge."""
+        return len(self._delayed)
+
+    def check_register_admission(self) -> None:
+        """Raise AdmissionOverloadError when the shed valve is full.
+        Called by edge paths that CREATE new work (job register); the
+        broker's own requeues/nacks are never refused — refusing those
+        would lose work already admitted. Retry-After scales with how
+        far past the watermark the heap is, in admission windows: the
+        deeper the backlog, the longer a well-behaved client should
+        stay away."""
+        high = self.delayed_depth_high
+        if high <= 0:
+            return
+        depth = len(self._delayed)
+        if depth < high:
+            return
+        retry = max(1.0, self.admission_delay_s
+                    * (4.0 * min(depth / high, 8.0)))
+        raise AdmissionOverloadError(
+            f"eval broker overloaded: {depth} deferred evaluations "
+            f"(watermark {high}); retry after {retry:.0f}s",
+            retry_after_s=retry)
 
     # -- lifecycle -----------------------------------------------------
     def enabled(self) -> bool:

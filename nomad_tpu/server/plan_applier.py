@@ -149,11 +149,14 @@ class PlanApplier:
         waiter is None or a callable blocking until quorum commit. The
         synchronous test/tool entry `apply_sync` folds the wait in."""
         import time as _time
-        from ..utils import metrics
+        from ..utils import metrics, stages
         _t0 = _time.monotonic()
+        _p0 = _time.perf_counter() if stages.enabled else 0.0
         try:
             return self._apply(plan)
         finally:
+            if stages.enabled:
+                stages.add("plan_apply", _time.perf_counter() - _p0)
             metrics.measure_since("nomad.plan.evaluate", _t0)
             metrics.incr_counter("nomad.plan.apply")
 

@@ -232,7 +232,18 @@ class Worker:
         self._stop = threading.Event()
         self._paused = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.stats = {"processed": 0, "failed": 0, "batches": 0}
+        self.stats = {"processed": 0, "failed": 0, "batches": 0,
+                      "pipelined_finishes": 0}
+        # pipelined dispatch: eval N's terminal bookkeeping (broker
+        # ack + latency accounting) runs on a finisher thread while
+        # this thread dequeues eval N+1 and starts its host phase —
+        # bounded to a DOUBLE BUFFER (one finish in flight + one
+        # queued) so a wedged ack applies backpressure instead of
+        # accumulating unacked evals
+        self.pipeline = bool(getattr(server.config, "worker_pipeline",
+                                     True))
+        self._finish_q = None
+        self._finisher: Optional[threading.Thread] = None
         # one kernel shared by this worker's gateways (jit caches warm
         # across batches)
         from ..ops import SelectKernel
@@ -240,6 +251,13 @@ class Worker:
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
+        if self.pipeline:
+            import queue
+            self._finish_q = queue.Queue(maxsize=2)
+            self._finisher = threading.Thread(
+                target=self._finish_loop, daemon=True,
+                name=f"worker-{self.id}-finisher")
+            self._finisher.start()
         self._thread = threading.Thread(target=self.run, daemon=True,
                                         name=f"worker-{self.id}")
         self._thread.start()
@@ -248,6 +266,34 @@ class Worker:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2)
+        if self._finish_q is not None:
+            # drain: the sentinel rides behind any pending finishes, so
+            # deferred acks land before shutdown returns
+            import queue as _queue
+            try:
+                self._finish_q.put(None, timeout=5.0)
+            except _queue.Full:
+                LOG.warning(
+                    "worker %d: finish queue wedged at shutdown; "
+                    "pending deferred acks will be dropped (evals "
+                    "redeliver after nack timeout)", self.id)
+            if self._finisher:
+                self._finisher.join(timeout=5)
+                if self._finisher.is_alive():
+                    LOG.warning(
+                        "worker %d: finisher did not drain at "
+                        "shutdown", self.id)
+
+    def _finish_loop(self) -> None:
+        while True:
+            fn = self._finish_q.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:       # pragma: no cover — defensive
+                LOG.exception("worker %d: deferred finish failed",
+                              self.id)
 
     def set_pause(self, paused: bool) -> None:
         if paused:
@@ -329,6 +375,18 @@ class Worker:
                 ev.modify_index, timeout_s=RAFT_SYNC_LIMIT)
             metrics.measure_since("nomad.worker.wait_for_index", t0)
             lane.snapshot_index = snap.latest_index()
+            if self.pipeline and ev.type != JOB_TYPE_CORE:
+                # pipelined dispatch: refresh the resident table NOW —
+                # the host row deltas apply here and the device mirror's
+                # scatter is dispatched asynchronously (never blocked
+                # on), so the device absorbs the table update while
+                # this thread builds the scheduler and its masks.
+                # build=False: a stale snapshot must not pay a private
+                # full build just to warm a cache it can't use
+                try:
+                    snap.node_table(build=False)
+                except Exception:   # pragma: no cover — defensive
+                    pass
             if ev.type == JOB_TYPE_CORE:
                 # worker.go invokeScheduler: _core evals get the GC
                 # pseudo-scheduler, not a placement scheduler
@@ -353,16 +411,30 @@ class Worker:
                 if ev.type != JOB_TYPE_CORE
                 else "nomad.worker.invoke_scheduler_core", t0)
             gov = getattr(self.server, "governor", None)
-            if gov is not None and ev.type != JOB_TYPE_CORE:
-                # lat_scale normalizes batched lanes: B concurrent
-                # GIL-sharing lanes each see ~B× their own host work
-                # in wall clock, and feeding that raw into the p99
-                # gauge would engage backpressure on healthy wide
-                # batches (then oscillate lane width)
-                gov.observe_eval_latency(
-                    (time.monotonic() - t0) / lat_scale)
-            self.server.eval_broker.ack(ev.id, token)
-            self.stats["processed"] += 1
+            elapsed = time.monotonic() - t0
+
+            def _finish():
+                from ..utils import stages
+                if gov is not None and ev.type != JOB_TYPE_CORE:
+                    # lat_scale normalizes batched lanes: B concurrent
+                    # GIL-sharing lanes each see ~B× their own host
+                    # work in wall clock, and feeding that raw into
+                    # the p99 gauge would engage backpressure on
+                    # healthy wide batches (then oscillate lane width)
+                    gov.observe_eval_latency(elapsed / lat_scale)
+                a0 = time.perf_counter() if stages.enabled else 0.0
+                self.server.eval_broker.ack(ev.id, token)
+                if stages.enabled:
+                    stages.add("broker_ack", time.perf_counter() - a0)
+                self.stats["processed"] += 1
+
+            if self._finish_q is not None:
+                # overlap the ack-side bookkeeping with the next
+                # eval's dequeue + host phase (double-buffered)
+                self.stats["pipelined_finishes"] += 1
+                self._finish_q.put(_finish)
+            else:
+                _finish()
         except Exception:
             LOG.exception("worker %d: eval %s failed", self.id, ev.id)
             self.stats["failed"] += 1

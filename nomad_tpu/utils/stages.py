@@ -1,0 +1,79 @@
+"""Per-stage wall-clock accounting for the eval hot path.
+
+BENCH_r05 showed a 13x gap between in-kernel placement rate (163.8k/s)
+and end-to-end (12.3k/s) with no way to say WHERE the host time went —
+the gap had to be inferred from side channels. This module gives every
+stage of the pipeline a named accumulator:
+
+    table_build   host-side NodeTable full builds + delta refreshes
+    h2d           host->device transfers (uploads, scatters, arg ships)
+    kernel        device dispatch through result availability
+    d2h           device->host result transfers (device_get)
+    plan_apply    plan verification + local apply (the serialization
+                  point)
+    broker_ack    eval broker ack bookkeeping
+
+`bench.py` enables collection around a run and emits the snapshot in
+the JSON artifact (`stage_breakdown`), so the kernel-vs-e2e gap is
+attributable per round instead of inferred. Collection is off by
+default: the hot path pays one module-global bool check per report
+site when disabled.
+
+The same stage can be reported from overlapping layers (a kernel
+dispatch inside a plan-apply verify); accumulators are independent
+sums, not a strict partition of wall clock — shares are computed over
+the sum of stages, and the interesting signal is the RATIO moving
+between rounds, not the absolute seconds.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+STAGES = ("table_build", "h2d", "kernel", "d2h", "plan_apply",
+          "broker_ack")
+
+enabled = False
+
+_l = threading.Lock()
+_acc: Dict[str, list] = {s: [0.0, 0] for s in STAGES}
+
+
+def enable(reset: bool = True) -> None:
+    global enabled
+    with _l:
+        if reset:
+            for v in _acc.values():
+                v[0] = 0.0
+                v[1] = 0
+        enabled = True
+
+
+def disable() -> None:
+    global enabled
+    enabled = False
+
+
+def add(stage: str, seconds: float) -> None:
+    """Report `seconds` of wall clock spent in `stage`. Callers guard
+    with `if stages.enabled:` so the disabled cost is one bool read."""
+    with _l:
+        ent = _acc.get(stage)
+        if ent is None:                 # unknown stage: count it anyway
+            ent = _acc.setdefault(stage, [0.0, 0])
+        ent[0] += seconds
+        ent[1] += 1
+
+
+def snapshot() -> Dict[str, dict]:
+    """{stage: {seconds, calls, share}} over all stages reported since
+    enable(). `share` is each stage's fraction of the summed stage
+    time — the attribution number the bench artifact records."""
+    with _l:
+        total = sum(v[0] for v in _acc.values())
+        return {
+            s: {"seconds": round(v[0], 4), "calls": v[1],
+                "share": round(v[0] / total, 4) if total > 0 else 0.0}
+            for s, v in _acc.items() if v[1] > 0 or s in STAGES
+        }

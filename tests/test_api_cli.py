@@ -245,3 +245,120 @@ def test_cli_tranche_round4(cluster, tmp_path, capsys):
     assert _wait_for(lambda: any(
         a.id != alloc.id
         for a in server.store.allocs_by_job("default", "cli-tranche")))
+
+
+def test_alloc_restart_signal_task_variants(cluster, capsys):
+    """Reference command surface (alloc_restart.go / alloc_signal.go):
+    the task can be named by `-task <name>` flag or trailing positional
+    — both route, and naming it both ways with different values is an
+    error, not a silent pick."""
+    from nomad_tpu.cli.main import main
+
+    server, client, c = cluster
+    addr = c.address
+    job = mock.batch_job()
+    job.id = "cli-variants"
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].config = {"run_for": "60s"}
+    tg.tasks[0].resources.networks = []
+    tg.networks = []
+    server.register_job(job)
+    assert _wait_for(lambda: any(
+        a.client_status == "running"
+        for a in server.store.allocs_by_job("default", "cli-variants")))
+    alloc_id = server.store.allocs_by_job("default", "cli-variants")[0].id
+
+    # -task flag variant
+    rc = main(["-address", addr, "alloc", "restart",
+               "-task", "worker", alloc_id])
+    out = capsys.readouterr().out
+    assert rc == 0 and "Restarted 1 task(s)" in out
+
+    # positional variant still works
+    assert _wait_for(lambda: any(
+        a.client_status == "running"
+        for a in server.store.allocs_by_job("default", "cli-variants")))
+    rc = main(["-address", addr, "alloc", "restart", alloc_id, "worker"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "Restarted 1 task(s)" in out
+
+    # flag and positional disagreeing is an error
+    rc = main(["-address", addr, "alloc", "restart",
+               "-task", "worker", alloc_id, "other"])
+    err = capsys.readouterr().err
+    assert rc == 1 and "both" in err
+
+    # signal: -s and -task flags together
+    assert _wait_for(lambda: any(
+        a.client_status == "running"
+        for a in server.store.allocs_by_job("default", "cli-variants")))
+    rc = main(["-address", addr, "alloc", "signal", "-s", "SIGHUP",
+               "-task", "worker", alloc_id])
+    out = capsys.readouterr().out
+    assert rc == 0 and "Signalled" in out
+
+    # signal: conflicting task names error the same way
+    rc = main(["-address", addr, "alloc", "signal", "-s", "SIGHUP",
+               "-task", "worker", alloc_id, "other"])
+    err = capsys.readouterr().err
+    assert rc == 1 and "both" in err
+
+    # unknown task surfaces the client error, nonzero exit
+    rc = main(["-address", addr, "alloc", "restart",
+               "-task", "nope", alloc_id])
+    err = capsys.readouterr().err
+    assert rc == 1 and "Error" in err
+
+    server.deregister_job("default", "cli-variants")
+
+
+def test_job_register_backpressure_429(cluster):
+    """Backpressure escalation (ROADMAP open item): when the broker's
+    delayed/requeue heap crosses its watermark, the job-register edge
+    refuses with 429 + Retry-After instead of parking more work."""
+    import time as _t
+    import urllib.error
+    import urllib.request
+
+    from nomad_tpu.models import Evaluation
+
+    server, client, c = cluster
+    broker = server.eval_broker
+    # an existing job to exercise the evaluate edge against
+    pre = mock.batch_job()
+    pre.id = "bp-preexisting"
+    pre.task_groups[0].tasks[0].config = {"run_for": "1s"}
+    c.register_job(job_to_spec(pre))
+    old_high = broker.delayed_depth_high
+    try:
+        broker.delayed_depth_high = 2
+        # park fake deferred evals well in the future — the shed
+        # valve's backlog, without racing the pop timer
+        with broker._l:
+            for i in range(2):
+                broker._delayed.append(
+                    (_t.time() + 300, i, Evaluation(job_id=f"bp{i}")))
+
+        def expect_429(path, body_dict):
+            body = json.dumps(body_dict).encode()
+            req = urllib.request.Request(
+                f"{c.address}{path}", data=body, method="PUT",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 429
+            retry_after = e.value.headers.get("Retry-After")
+            assert retry_after is not None and int(retry_after) >= 1
+            assert "overloaded" in json.loads(e.value.read())["error"]
+
+        expect_429("/v1/jobs", {"Job": job_to_spec(mock.batch_job())})
+        # every edge that CREATES evals is valved, not just register
+        expect_429("/v1/job/bp-preexisting/evaluate", {})
+    finally:
+        with broker._l:
+            broker._delayed.clear()
+        broker.delayed_depth_high = old_high
+    # valve clear: the same register admits
+    resp = c.register_job(job_to_spec(mock.batch_job()))
+    assert "EvalID" in resp

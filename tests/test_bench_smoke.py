@@ -33,6 +33,22 @@ def test_bench_py_emits_json_line_on_cpu():
     assert data["e2e_placements_per_sec"] > 0
     assert data["service_p99_ms"] > 0
     assert data["preemption_placements_per_sec"] > 0
+    # per-stage attribution (ISSUE 2 satellite): the artifact carries
+    # the breakdown that makes the kernel-vs-e2e gap attributable
+    assert "stage_error" not in data, data
+    bd = data["stage_breakdown"]
+    for stage in ("table_build", "h2d", "kernel", "d2h", "plan_apply",
+                  "broker_ack"):
+        assert stage in bd, f"missing stage {stage}: {bd}"
+        assert set(bd[stage]) == {"seconds", "calls", "share"}
+    assert bd["kernel"]["seconds"] > 0          # e2e phases dispatched
+    assert bd["plan_apply"]["calls"] > 0
+    assert bd["broker_ack"]["calls"] > 0
+    shares = sum(v["share"] for v in bd.values())
+    assert 0.99 <= shares <= 1.01 or shares == 0.0
+    # resident-table counters + measured dispatch costs ride along
+    assert data["table_build_stats"]["delta_refreshes"] >= 0
+    assert data["dispatch_cost_model"], "cost model never observed"
 
 
 def test_c2m_seed_path_at_toy_scale():

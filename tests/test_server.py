@@ -82,6 +82,39 @@ class TestEvalBroker:
         got, _ = b.dequeue(["service"], timeout_s=1.0)
         assert got.id == ev.id
 
+    def test_register_admission_escalation(self):
+        """check_register_admission: silent below the delayed-heap
+        watermark (and when disabled), AdmissionOverloadError with a
+        depth-scaled Retry-After at/over it."""
+        from nomad_tpu.server.eval_broker import AdmissionOverloadError
+
+        b = EvalBroker()
+        b.set_enabled(True)
+        b.check_register_admission()        # high=0: disabled, no-op
+        b.delayed_depth_high = 3
+        far = time.time() + 300
+        for i in range(2):
+            ev = _eval(job_id=f"bp{i}")
+            ev.wait_until = far
+            b.enqueue(ev)
+        assert b.delayed_depth() == 2
+        b.check_register_admission()        # below watermark: admits
+        ev = _eval(job_id="bp2")
+        ev.wait_until = far
+        b.enqueue(ev)
+        with pytest.raises(AdmissionOverloadError) as e:
+            b.check_register_admission()
+        assert e.value.retry_after_s >= 1.0
+        # deeper backlog -> longer Retry-After (monotone escalation)
+        for i in range(3, 9):
+            ev = _eval(job_id=f"bp{i}")
+            ev.wait_until = far
+            b.enqueue(ev)
+        with pytest.raises(AdmissionOverloadError) as e2:
+            b.check_register_admission()
+        assert e2.value.retry_after_s >= e.value.retry_after_s
+        b.flush()
+
     def test_scheduler_type_routing(self):
         b = EvalBroker()
         b.set_enabled(True)

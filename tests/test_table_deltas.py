@@ -1,0 +1,303 @@
+"""Device-resident node table deltas (ISSUE 2 tentpole).
+
+Parity is the whole game: a table maintained by incremental row deltas
+(host clone + device scatter) must be indistinguishable from a cold
+rebuild after ANY plan sequence — adds, stops, in-place updates, port
+churn, interleaved arbitrarily. The randomized suite drives >= 1k
+such sequences through the cache and compares against
+`NodeTable.build_all` every step, with the device mirror checked row
+for row against the host shadow along the way.
+
+Also covered: the steady-state smoke (after warm-up, evals are served
+by the delta path — ZERO full builds), the `NOMAD_TPU_TABLE_DELTA=0`
+bisection escape hatch, and the governor's fold-to-rebuild reclaim
+when scatter debt crosses its watermark.
+"""
+
+import numpy as np
+import pytest
+
+from nomad_tpu.governor import Governor, WatermarkPolicy
+from nomad_tpu.mock import fixtures as mock
+from nomad_tpu.models import (
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_RUNNING, ALLOC_DESIRED_STOP,
+)
+from nomad_tpu.models.networks import Port
+from nomad_tpu.ops.tables import NodeTable
+from nomad_tpu.state import StateStore
+
+
+def _store_with_nodes(n):
+    s = StateStore()
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        node.name = f"node-{i}"
+        nodes.append(node)
+        s.upsert_node(i + 1, node)
+    return s, nodes
+
+
+_PORT_SEQ = iter(range(20000, 60000))
+
+
+def _rand_alloc(rng, nodes):
+    a = mock.alloc()
+    a.node_id = nodes[rng.randint(len(nodes))].id
+    a.client_status = ALLOC_CLIENT_RUNNING
+    res = a.allocated_resources.tasks["web"]
+    res.cpu.cpu_shares = int(rng.randint(10, 800))
+    a.allocated_resources.tasks["web"].memory.memory_mb = \
+        int(rng.randint(16, 1024))
+    # unique reserved port per alloc: port bookkeeping must survive
+    # the remove half of deltas exactly
+    a.allocated_resources.tasks["web"].networks[0].reserved_ports = \
+        [Port(label="admin", value=next(_PORT_SEQ))]
+    a.allocated_resources.tasks["web"].networks[0].dynamic_ports = []
+    return a
+
+
+def _assert_parity(t: NodeTable, cold: NodeTable, step):
+    np.testing.assert_allclose(t.base_used, cold.base_used, atol=1e-3,
+                               err_msg=f"base_used diverged at {step}")
+    np.testing.assert_allclose(t.free_ports, cold.free_ports,
+                               err_msg=f"free_ports diverged at {step}")
+    assert t._net_bits == cold._net_bits, f"net bits diverged at {step}"
+
+
+def _assert_mirror_parity(t: NodeTable, step):
+    st = t.device_mirror.arrays_for(t)
+    assert st is not None, f"mirror stale for served table at {step}"
+    np.testing.assert_allclose(np.asarray(st.used)[:t.n], t.base_used,
+                               atol=1e-3,
+                               err_msg=f"device used diverged at {step}")
+    np.testing.assert_allclose(np.asarray(st.free_ports)[:t.n],
+                               t.free_ports,
+                               err_msg=f"device ports diverged at {step}")
+    np.testing.assert_allclose(np.asarray(st.capacity)[:t.n], t.capacity,
+                               err_msg=f"device capacity diverged at {step}")
+
+
+def test_randomized_plan_sequences_delta_equals_rebuild():
+    """>= 1k randomized plan sequences (adds / stops / in-place
+    updates), each applied through the cache's delta path and compared
+    against a cold host rebuild; the device mirror is checked against
+    the host shadow every 50 steps (and advances by scatter between
+    checks)."""
+    rng = np.random.RandomState(7)
+    s, nodes = _store_with_nodes(12)
+    cache = s.table_cache
+    s.snapshot().node_table()                # prime: the one cold build
+    builds0 = cache.stats["full_builds"]
+    live = []
+    idx = 100
+    for step in range(1000):
+        batch = []
+        for _ in range(rng.randint(1, 4)):   # 1-3 placements
+            a = _rand_alloc(rng, nodes)
+            batch.append(a)
+            live.append(a)
+        if len(live) > 4 and rng.rand() < 0.5:
+            for _ in range(rng.randint(1, 3)):  # stops free resources
+                v = live.pop(rng.randint(len(live)))
+                v2 = v.copy()
+                v2.desired_status = ALLOC_DESIRED_STOP
+                v2.client_status = ALLOC_CLIENT_COMPLETE
+                batch.append(v2)
+        if live and rng.rand() < 0.3:       # in-place resource update
+            v = live[rng.randint(len(live))]
+            v2 = v.copy()
+            v2.allocated_resources = v.allocated_resources.copy()
+            v2.allocated_resources.tasks["web"].cpu.cpu_shares = \
+                int(rng.randint(10, 800))
+            live[live.index(v)] = v2
+            batch.append(v2)
+        idx += 1
+        s.upsert_allocs(idx, batch)
+        snap = s.snapshot()
+        t = snap.node_table()
+        _assert_parity(t, NodeTable.build_all(snap), step)
+        if step % 50 == 0:
+            _assert_mirror_parity(t, step)
+    # the whole sequence rode the delta path...
+    assert cache.stats["full_builds"] == builds0
+    assert cache.stats["delta_refreshes"] >= 1000
+    # ...and the device mirror really advanced by scatters, not
+    # re-uploads
+    assert cache.device.stats["scatters"] > 0
+    assert cache.device.stats["uploads"] == 1
+
+
+def test_wide_delta_falls_back_to_contiguous_upload():
+    """A refresh touching most of the table's rows re-uploads instead
+    of scattering (SPARSE_MAX_FRAC) and counts as a fold — and parity
+    still holds."""
+    rng = np.random.RandomState(11)
+    s, nodes = _store_with_nodes(8)
+    t = s.snapshot().node_table()
+    _assert_mirror_parity(t, "init")        # materialize the mirror
+    batch = []
+    for i in range(len(nodes) * 3):         # touch every node
+        a = _rand_alloc(rng, nodes)
+        a.node_id = nodes[i % len(nodes)].id
+        batch.append(a)
+    s.upsert_allocs(200, batch)
+    t2 = s.snapshot().node_table()
+    assert s.table_cache.device.stats["folds"] >= 1
+    _assert_mirror_parity(t2, "wide")
+    _assert_parity(t2, NodeTable.build_all(s.snapshot()), "wide")
+
+
+def test_stale_table_version_gets_dense_fallback():
+    """A kernel holding an old table version must not read the advanced
+    mirror: arrays_for returns None (dense fallback) once the cache has
+    moved past it."""
+    rng = np.random.RandomState(3)
+    s, nodes = _store_with_nodes(4)
+    t1 = s.snapshot().node_table()
+    assert t1.device_mirror.arrays_for(t1) is not None
+    s.upsert_allocs(300, [_rand_alloc(rng, nodes)])
+    t2 = s.snapshot().node_table()
+    assert t1.device_version != t2.device_version
+    assert t1.device_mirror.arrays_for(t1) is None      # stale
+    assert t2.device_mirror.arrays_for(t2) is not None  # current
+
+
+def test_escape_hatch_forces_rebuild_path(monkeypatch):
+    """NOMAD_TPU_TABLE_DELTA=0: every refresh is a cold rebuild — the
+    bisection escape hatch for suspected delta bugs."""
+    rng = np.random.RandomState(5)
+    monkeypatch.setenv("NOMAD_TPU_TABLE_DELTA", "0")
+    s, nodes = _store_with_nodes(4)
+    cache = s.table_cache
+    s.snapshot().node_table()
+    builds0 = cache.stats["full_builds"]
+    for i in range(3):
+        s.upsert_allocs(400 + i, [_rand_alloc(rng, nodes)])
+        s.snapshot().node_table()
+    assert cache.stats["full_builds"] == builds0 + 3
+    assert cache.stats["delta_refreshes"] == 0
+
+
+def test_node_change_still_rebuilds():
+    """Node-set changes invalidate attribute columns: they must bump
+    the mirror epoch and rebuild, not ride the delta path."""
+    s, nodes = _store_with_nodes(4)
+    t1 = s.snapshot().node_table()
+    epoch0 = s.table_cache.device.epoch
+    n2 = mock.node()
+    n2.name = "late-joiner"
+    s.upsert_node(500, n2)
+    t2 = s.snapshot().node_table()
+    assert t2.n == t1.n + 1
+    assert s.table_cache.device.epoch == epoch0 + 1
+    _assert_parity(t2, NodeTable.build_all(s.snapshot()), "node add")
+
+
+# -- governor: fold-to-rebuild reclaim ---------------------------------
+
+def test_governor_fold_reclaim_on_delta_debt():
+    """When scattered-row debt crosses the watermark, the registered
+    reclaim replaces the scatter history with one contiguous re-upload
+    and resets the debt — and the mirror still matches the host."""
+    rng = np.random.RandomState(9)
+    s, nodes = _store_with_nodes(8)
+    cache = s.table_cache
+    t = s.snapshot().node_table()
+    _assert_mirror_parity(t, "init")
+
+    gov = Governor()
+    gov.register("node_table.delta_debt", cache.device_delta_debt,
+                 WatermarkPolicy(high=4.0, low=0.5),
+                 reclaim=cache.fold_device)
+
+    idx = 600
+    while cache.device_delta_debt() < 4:
+        idx += 1
+        s.upsert_allocs(idx, [_rand_alloc(rng, nodes)])
+        t = s.snapshot().node_table()
+    debt = cache.device_delta_debt()
+    assert debt >= 4 and cache.device_delta_log_len() > 0
+
+    regs = {r.name: r for r in gov.sample_once(now=1.0)}
+    assert regs["node_table.delta_debt"].reclaims == 1
+    assert cache.device_delta_debt() == 0
+    assert cache.device_delta_log_len() == 0
+    assert cache.device.stats["folds"] >= 1
+    _assert_mirror_parity(s.snapshot().node_table(), "post fold")
+
+
+def test_fold_refuses_stale_table():
+    """The fold must only re-upload from the version the mirror tracks;
+    a stale table is rejected rather than silently regressing rows."""
+    rng = np.random.RandomState(13)
+    s, nodes = _store_with_nodes(4)
+    t1 = s.snapshot().node_table()
+    t1.device_mirror.arrays_for(t1)
+    s.upsert_allocs(700, [_rand_alloc(rng, nodes)])
+    t2 = s.snapshot().node_table()
+    out = t2.device_mirror.fold(t1, t1.device_version)
+    assert not out["folded"]
+    out2 = s.table_cache.fold_device()
+    assert out2["folded"]
+    _assert_mirror_parity(t2, "post fold")
+
+
+# -- steady-state smoke: the delta path serves evals -------------------
+
+def test_steady_state_evals_perform_zero_full_builds():
+    """Tier-1 smoke for the acceptance criterion: drive real evals
+    through the scheduler after a warm-up and assert the resident
+    table was never fully rebuilt — every refresh rode the delta
+    path."""
+    from nomad_tpu.scheduler.harness import Harness
+
+    h = Harness()
+    nodes = []
+    for i in range(8):
+        node = mock.node()
+        node.name = f"node-{i}"
+        node.datacenter = "dc1"
+        node.compute_class()
+        nodes.append(node)
+        h.store.upsert_node(h.next_index(), node)
+
+    from nomad_tpu.models import (Evaluation, EVAL_STATUS_PENDING,
+                                  TRIGGER_JOB_REGISTER)
+    from nomad_tpu.utils.ids import generate_uuid
+
+    def _eval_for(job):
+        return Evaluation(
+            id=generate_uuid(), namespace=job.namespace,
+            priority=job.priority, triggered_by=TRIGGER_JOB_REGISTER,
+            job_id=job.id, status=EVAL_STATUS_PENDING, type=job.type)
+
+    def make_job(i):
+        job = mock.job()
+        job.id = f"steady-{i}"
+        job.datacenters = ["dc1"]
+        tg = job.task_groups[0]
+        tg.count = 2
+        for t in tg.tasks:
+            t.resources.networks = []
+        tg.networks = []
+        return job
+
+    # warm-up: first eval pays the one cold build
+    wjob = make_job(10**6)
+    h.store.upsert_job(h.next_index(), wjob)
+    h.process("service", _eval_for(wjob))
+
+    cache = h.store.table_cache
+    builds0 = cache.stats["full_builds"]
+    deltas0 = cache.stats["delta_refreshes"]
+    for i in range(10):
+        job = make_job(i)
+        h.store.upsert_job(h.next_index(), job)
+        h.process("service", _eval_for(job))
+    assert cache.stats["full_builds"] == builds0, \
+        "steady-state evals must ride the delta path, not rebuild"
+    assert cache.stats["delta_refreshes"] > deltas0
+    placed = sum(sum(len(a) for a in p.node_allocation.values())
+                 for p in h.plans)
+    assert placed == 2 * 11
