@@ -106,6 +106,10 @@ def main() -> None:
         "vs_baseline": 0.0,
     }
     import os
+    # governed soak runs must be attributable: record whether the
+    # runtime sanitizer's kernel-boundary guards were armed
+    from nomad_tpu.analysis.sanitizer import enabled as _sanitize_on
+    out["sanitizer"] = "on" if _sanitize_on() else "off"
     quick = os.environ.get("NOMAD_TPU_BENCH_QUICK", "") not in ("", "0")
     try:
         platform = _init_backend()
@@ -169,6 +173,8 @@ def main() -> None:
         from nomad_tpu.ops.tables import BUILD_STATS
         out["table_build_stats"] = dict(BUILD_STATS)
         out["dispatch_cost_model"] = cost_model.snapshot()
+        from nomad_tpu.analysis.sanitizer import traces
+        out["lint_recompiles"] = traces.per_kernel()
     except Exception as e:   # pragma: no cover — defensive
         out["stage_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(out))

@@ -1,0 +1,25 @@
+"""TPU-hygiene static analysis + runtime sanitizer.
+
+Static: `python -m nomad_tpu.analysis [paths]` / `nomad-tpu dev lint`
+runs five AST passes (engine.py, passes.py) enforcing the steady-state
+invariants — host-sync discipline, jit hygiene, dtype discipline,
+lock order/scope, surface drift — with inline
+`# nomad-lint: allow[rule]` suppressions and non-zero exit on
+findings.
+
+Runtime: `NOMAD_TPU_SANITIZE=1` (sanitizer.py) adds NaN/Inf and
+out-of-bounds-row guards at the placement and scatter-delta kernel
+boundaries, and the always-on trace-signature counter feeds the
+`nomad.lint.recompiles` governor gauge.
+"""
+
+from .engine import FileContext, Finding, Project, Rule, run
+from .passes import (DtypeRule, HostSyncRule, JitHygieneRule, LockRule,
+                     SurfaceDriftRule, default_rules)
+from . import sanitizer
+
+__all__ = [
+    "FileContext", "Finding", "Project", "Rule", "run",
+    "HostSyncRule", "JitHygieneRule", "DtypeRule", "LockRule",
+    "SurfaceDriftRule", "default_rules", "sanitizer",
+]

@@ -1,0 +1,80 @@
+"""`python -m nomad_tpu.analysis [paths...]` — run the TPU-hygiene
+passes and exit non-zero when unsuppressed findings remain. Also the
+body of `nomad-tpu dev lint` and the `nomad-tpu-lint` console entry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _resolve(paths: List[str]):
+    """(root, repo-relative paths) for the engine. The scope prefixes
+    the passes match on ("nomad_tpu/ops/", ...) are repo-relative, so
+    paths must be normalized against the repo root — NOT the cwd — or
+    an invocation from outside the repo silently scopes every
+    path-gated pass to nothing and reports a false clean."""
+    # __file__ = <repo>/nomad_tpu/analysis/__main__.py
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    if not paths:
+        return repo, ["nomad_tpu"]
+    abspaths = [os.path.abspath(p) for p in paths]
+    if all(ap == repo or ap.startswith(repo + os.sep)
+           for ap in abspaths):
+        return repo, [os.path.relpath(ap, repo) or "."
+                      for ap in abspaths]
+    # linting a tree that is not this repo: cwd-relative as given
+    return ".", paths
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="nomad-tpu-lint",
+        description="TPU-hygiene linter: host-sync / jit / dtype / "
+                    "lock / surface-drift passes")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories (default: the "
+                        "nomad_tpu package)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print findings silenced by "
+                        "`# nomad-lint: allow[...]`")
+    p.add_argument("--list", action="store_true", dest="list_rules",
+                   help="list the passes and exit")
+    args = p.parse_args(argv)
+
+    from .passes import default_rules
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name:18s} {r.doc}")
+        return 0
+
+    from .engine import run
+    root, paths = _resolve(args.paths)
+    findings = run(paths, root=root, rules=rules)
+    active = [f for f in findings if not f.suppressed]
+    shown = findings if args.show_suppressed else active
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in shown],
+            "total": len(active),
+            "suppressed": len(findings) - len(active),
+        }, indent=2))
+    else:
+        for f in shown:
+            print(f.render())
+        print(f"{len(active)} finding(s), "
+              f"{len(findings) - len(active)} suppressed")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,285 @@
+"""AST lint engine: the mechanical half of the TPU-hygiene contract.
+
+The reference Nomad leans on `go vet` + the race detector to keep a
+heavily threaded orchestrator honest. This rebuild's equivalents are
+invariants, not types — "no host sync in the steady-state eval loop",
+"no unkeyed jit recompiles", "no lock held across device dispatch" —
+so they need a checker tuned to THIS codebase rather than a generic
+linter. The engine here is deliberately small:
+
+  - `Project` walks a tree (or an injected {path: source} map, which
+    is how the rule fixtures test known-bad snippets), parses each
+    file once, and hands a `FileContext` to every registered rule.
+  - A rule is a class with a `name`, a `check_file(ctx)` generator
+    for per-file AST passes, and an optional `finish(project)` for
+    cross-file passes (lock graphs, surface drift).
+  - Findings are plain records; `python -m nomad_tpu.analysis` renders
+    them for humans or as JSON and exits non-zero when any survive.
+
+Suppressions: `# nomad-lint: allow[rule-a,rule-b] <justification>` on
+a line suppresses those rules' findings for that line; on a line of
+its own it covers the next code line. Suppressed findings are still
+counted (the clean-tree test asserts on UNsuppressed findings only),
+so `--show-suppressed` keeps the escape hatches auditable.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*nomad-lint:\s*allow\[([A-Za-z0-9_,\- ]+)\]")
+# a line that is only indentation + comment: its allow[] covers the
+# next line (the finding site), since long calls rarely leave room
+COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str                    # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}{tag}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FileContext:
+    """One parsed source file: tree with parent links, raw lines, and
+    the per-line suppression map rules consult via `finding()`."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.parse_error = e
+            return
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._lint_parent = node       # type: ignore[attr-defined]
+        self.suppressions = parse_suppressions(self.lines)
+
+    # -- helpers rules lean on ----------------------------------------
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        if isinstance(node_or_line, int):
+            line, col = node_or_line, 0
+        else:
+            line = getattr(node_or_line, "lineno", 0)
+            col = getattr(node_or_line, "col_offset", 0)
+        allowed = self.suppressions.get(line, frozenset())
+        return Finding(rule=rule, path=self.path, line=line, col=col,
+                       message=message,
+                       suppressed=(rule in allowed or "*" in allowed))
+
+    def enclosing_function(self, node) -> Optional[ast.AST]:
+        cur = getattr(node, "_lint_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = getattr(cur, "_lint_parent", None)
+        return None
+
+    def enclosing_class(self, node) -> Optional[ast.ClassDef]:
+        cur = getattr(node, "_lint_parent", None)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = getattr(cur, "_lint_parent", None)
+        return None
+
+
+def parse_suppressions(lines: Sequence[str]) -> Dict[int, frozenset]:
+    """{1-based line: frozenset(rule names)} — a comment-only allow[]
+    line also covers the next line."""
+    out: Dict[int, frozenset] = {}
+    for i, raw in enumerate(lines, start=1):
+        m = SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        rules = frozenset(r.strip() for r in m.group(1).split(",")
+                          if r.strip())
+        out[i] = out.get(i, frozenset()) | rules
+        if COMMENT_ONLY_RE.match(raw):
+            out[i + 1] = out.get(i + 1, frozenset()) | rules
+    return out
+
+
+def attr_chain(node) -> Optional[str]:
+    """Dotted name of an expression: `jax.device_get` ->
+    "jax.device_get", `self._l` -> "self._l"; None for anything with a
+    non-name base (calls, subscripts)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return attr_chain(node.func)
+
+
+def decorator_names(fn) -> List[str]:
+    out = []
+    for dec in getattr(fn, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            dec = dec.func
+        name = attr_chain(dec)
+        if name:
+            out.append(name)
+    return out
+
+
+class Rule:
+    """Base lint pass. `name` is the suppression key; `doc` is the
+    one-liner `--list` prints."""
+
+    name = "rule"
+    doc = ""
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finish(self, project: "Project") -> Iterable[Finding]:
+        return ()
+
+
+class Project:
+    """A lintable tree. `files` injects {relpath: source} directly (the
+    fixture tests); otherwise sources are read from `root`."""
+
+    def __init__(self, root: str = ".",
+                 files: Optional[Dict[str, str]] = None):
+        self.root = root
+        self._files = files
+        self.contexts: Dict[str, FileContext] = {}
+        self.extra_text: Dict[str, str] = {}   # non-python (STATUS.md)
+
+    # -- file discovery -----------------------------------------------
+    def _walk_python(self, paths: Sequence[str]) -> List[Tuple[str, str]]:
+        out = []
+        for p in paths:
+            full = os.path.join(self.root, p)
+            if os.path.isfile(full):
+                out.append((p.replace(os.sep, "/"), full))
+                continue
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        fp = os.path.join(dirpath, fn)
+                        rel = os.path.relpath(fp, self.root)
+                        out.append((rel.replace(os.sep, "/"), fp))
+        return out
+
+    def load(self, paths: Sequence[str]) -> None:
+        if self._files is not None:
+            for rel, src in self._files.items():
+                rel = rel.replace(os.sep, "/")
+                if rel.endswith(".py"):
+                    self.contexts[rel] = FileContext(rel, src)
+                else:
+                    self.extra_text[rel] = src
+            return
+        for rel, full in self._walk_python(paths):
+            if rel in self.contexts:
+                continue
+            try:
+                with open(full, encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                continue
+            self.contexts[rel] = FileContext(rel, src)
+
+    def text(self, relpath: str) -> Optional[str]:
+        """Raw text of a repo file (python or not); fixture-injected
+        maps answer from memory, disk projects read lazily."""
+        relpath = relpath.replace(os.sep, "/")
+        if relpath in self.extra_text:
+            return self.extra_text[relpath]
+        ctx = self.contexts.get(relpath)
+        if ctx is not None:
+            return ctx.source
+        if self._files is not None:
+            return None
+        full = os.path.join(self.root, relpath)
+        try:
+            with open(full, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def glob_texts(self, reldir: str, suffix: str = ".py"
+                   ) -> Dict[str, str]:
+        """{relpath: text} for every file under `reldir` (loaded
+        contexts + injected texts + disk)."""
+        reldir = reldir.rstrip("/") + "/"
+        out = {p: c.source for p, c in self.contexts.items()
+               if p.startswith(reldir) and p.endswith(suffix)}
+        for p, t in self.extra_text.items():
+            if p.startswith(reldir) and p.endswith(suffix):
+                out[p] = t
+        if self._files is None:
+            full = os.path.join(self.root, reldir)
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                for fn in filenames:
+                    if not fn.endswith(suffix):
+                        continue
+                    rel = os.path.relpath(os.path.join(dirpath, fn),
+                                          self.root).replace(os.sep, "/")
+                    if rel not in out:
+                        t = self.text(rel)
+                        if t is not None:
+                            out[rel] = t
+        return out
+
+    # -- the run -------------------------------------------------------
+    def analyze(self, rules: Sequence[Rule]) -> List[Finding]:
+        findings: List[Finding] = []
+        for ctx in self.contexts.values():
+            if ctx.tree is None:
+                findings.append(Finding(
+                    rule="parse", path=ctx.path,
+                    line=ctx.parse_error.lineno or 0, col=0,
+                    message=f"syntax error: {ctx.parse_error.msg}"))
+                continue
+            for rule in rules:
+                findings.extend(rule.check_file(ctx))
+        for rule in rules:
+            findings.extend(rule.finish(self))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return findings
+
+
+def run(paths: Sequence[str], root: str = ".",
+        rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Load + analyze; the programmatic entry the CLI/tests share."""
+    from .passes import default_rules
+    project = Project(root=root)
+    project.load(paths)
+    return project.analyze(list(rules) if rules is not None
+                           else default_rules())

@@ -1,0 +1,637 @@
+"""The five TPU-hygiene passes, tuned to this codebase.
+
+Each pass enforces an invariant PRs 1-2 established but nothing
+verified mechanically (CHANGES.md, STATUS §2.6):
+
+  host-sync        zero host syncs in the steady-state eval loop —
+                   device pulls only through the attribution fences
+  jit-hygiene      no unkeyed recompile sources: config params must be
+                   static, closures under jit must be cached
+  dtype-discipline no 64-bit dtype literals in ops/ kernels (x64 is
+                   disabled — they silently downcast on device), pad
+                   widths only from the bucketing helpers
+  lock-discipline  lock-acquisition graph must be acyclic, and no lock
+                   may be held across device dispatch / blocking waits
+  surface-drift    every HTTP route needs a CLI/test reference; every
+                   ServerConfig.governor_* knob must appear in STATUS.md
+
+Rules report THROUGH ctx.finding(), so inline
+`# nomad-lint: allow[rule]` suppressions are honored uniformly.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .engine import (FileContext, Finding, Project, Rule, attr_chain,
+                     call_name, decorator_names)
+
+# modules whose steady-state hot paths the host-sync / lock passes
+# police; everything outside (cli, bench, api edges) is host-side by
+# design
+HOT_PREFIXES = ("nomad_tpu/ops/", "nomad_tpu/server/",
+                "nomad_tpu/scheduler/", "nomad_tpu/state/",
+                "nomad_tpu/parallel/", "nomad_tpu/utils/")
+
+
+def _in_hot_path(path: str) -> bool:
+    return any(path.startswith(p) for p in HOT_PREFIXES)
+
+
+def _module_names(tree: ast.Module) -> Set[str]:
+    """Names bound at module level (defs, classes, imports, assigns) —
+    the closure checks treat these as NOT free."""
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.Import):
+            out.update(a.asname or a.name.split(".")[0]
+                       for a in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            out.update(a.asname or a.name for a in node.names)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _bound_names(fn) -> Set[str]:
+    """Parameters + names assigned anywhere inside `fn` (incl. nested
+    comprehension targets) — the complement of its free variables."""
+    args = fn.args
+    names = {a.arg for a in (args.posonlyargs + args.args
+                             + args.kwonlyargs)}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Store):
+                names.add(node.id)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                names.add(node.name)
+            elif isinstance(node, ast.Import):
+                names.update(a.asname or a.name.split(".")[0]
+                             for a in node.names)
+            elif isinstance(node, ast.ImportFrom):
+                names.update(a.asname or a.name for a in node.names)
+    return names
+
+
+def _free_names(fn, module_level: Set[str]) -> Set[str]:
+    import builtins
+    bound = _bound_names(fn)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    free: Set[str] = set()
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Load):
+                n = node.id
+                if n not in bound and n not in module_level \
+                        and not hasattr(builtins, n):
+                    free.add(n)
+    return free
+
+
+# ---------------------------------------------------------------------
+class HostSyncRule(Rule):
+    """Pass 1: host-sync discipline. `jax.device_get`, `.item()`,
+    `.block_until_ready()`, and `np.asarray`/`float()` over jax values
+    are forbidden in the steady-state modules outside the whitelisted
+    attribution fences (utils/stages.py and ops/select.py's
+    `_stage_get` d2h helper) — each one is a blocking device round
+    trip that BENCH_r05 showed dominating the e2e gap."""
+
+    name = "host-sync"
+    doc = "no host syncs outside the attribution fences"
+
+    FENCE_MODULES = ("nomad_tpu/utils/stages.py",)
+    FENCE_FUNCS = {("nomad_tpu/ops/select.py", "_stage_get")}
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not _in_hot_path(ctx.path) or ctx.path in self.FENCE_MODULES:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = ctx.enclosing_function(node)
+            if fn is not None and (ctx.path, fn.name) in self.FENCE_FUNCS:
+                continue
+            name = call_name(node) or ""
+            msg = None
+            if name.endswith("device_get"):
+                msg = ("host sync: jax.device_get blocks on the device"
+                       " — route result pulls through the d2h fence "
+                       "(ops/select._stage_get) or fence this site")
+            elif name.endswith(".block_until_ready"):
+                msg = ("host sync: block_until_ready stalls the host "
+                       "on device completion outside a fence")
+            elif name.endswith(".item") and not node.args \
+                    and not node.keywords:
+                msg = (".item() is a scalar host pull (one device "
+                       "round trip per call)")
+            elif name in ("np.asarray", "np.array", "numpy.asarray",
+                          "numpy.array") and node.args:
+                inner = node.args[0]
+                iname = call_name(inner) if isinstance(inner, ast.Call) \
+                    else None
+                if iname and (iname.startswith("jnp.")
+                              or iname.startswith("jax.")):
+                    msg = (f"np.asarray over `{iname}` forces a host "
+                           f"sync on the device value")
+            elif name == "float" and node.args \
+                    and isinstance(node.args[0], ast.Call):
+                iname = call_name(node.args[0]) or ""
+                if iname.startswith("jnp.") or iname.startswith("jax."):
+                    msg = (f"float() over `{iname}` is a scalar host "
+                           f"pull")
+            if msg:
+                yield ctx.finding(self.name, node, msg)
+
+
+# ---------------------------------------------------------------------
+class JitHygieneRule(Rule):
+    """Pass 2: jit hygiene. A `jax.jit` call site must key its
+    non-array config through `static_argnums`/`static_argnames`, and a
+    closure jitted inside a plain function is reconstructed per call —
+    jax caches by function object identity, so every construction
+    compiles anew (the recompile-storm source the trace counter in
+    analysis/sanitizer.py measures at runtime)."""
+
+    name = "jit-hygiene"
+    doc = "static_argnums for config params; no uncached jit closures"
+
+    CACHING_DECORATORS = ("lru_cache", "cache", "functools.lru_cache",
+                          "functools.cache")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        module_level = _module_names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target, statics = self._jit_target(node)
+            if target is None:
+                continue
+            yield from self._check_site(ctx, node, target, statics,
+                                        module_level)
+
+    def _jit_target(self, node: ast.Call
+                    ) -> Tuple[Optional[ast.AST], bool]:
+        """(jitted expression, statics-given) for a jax.jit call site;
+        (None, False) when `node` is not one. Handles direct
+        `jax.jit(fn, ...)` and `partial(jax.jit, ...)` — the partial's
+        kwargs count as the statics."""
+        name = call_name(node) or ""
+        statics = any(kw.arg in ("static_argnums", "static_argnames")
+                      for kw in node.keywords)
+        if name.endswith("jax.jit") or name == "jit":
+            return (node.args[0] if node.args else None), statics
+        if name.endswith("partial") and node.args:
+            first = attr_chain(node.args[0]) or ""
+            if first.endswith("jax.jit") or first == "jit":
+                # partial(jax.jit, static_argnames=...)(fn): the outer
+                # call applies it; the wrapped fn is checked where the
+                # partial is invoked — too dynamic to chase, so only
+                # verify the partial carries statics OR targets a fn
+                # with none needed. Treated as statics-given when the
+                # partial has them.
+                return None, statics
+        return None, False
+
+    def _check_site(self, ctx: FileContext, node: ast.Call, target,
+                    statics: bool, module_level: Set[str]
+                    ) -> Iterable[Finding]:
+        # a jit applied through a partial-with-statics wrapper
+        # ( _select_scan = partial(jax.jit, static_argnames=...)(fn) )
+        # arrives here with statics=True via the outer call's keywords
+        parent = getattr(node, "_lint_parent", None)
+        if isinstance(parent, ast.Call):
+            pname = call_name(parent) or ""
+            if pname.endswith("partial"):
+                return
+        enclosing = ctx.enclosing_function(node)
+        cached = enclosing is not None and any(
+            d in self.CACHING_DECORATORS
+            for d in decorator_names(enclosing))
+
+        # look through jax.vmap(fn, ...) wrappers
+        inner = target
+        if isinstance(inner, ast.Call) and \
+                (call_name(inner) or "").endswith("vmap") and inner.args:
+            inner = inner.args[0]
+
+        if isinstance(inner, ast.Lambda):
+            if enclosing is not None and not cached:
+                yield ctx.finding(
+                    self.name, node,
+                    "jax.jit over a lambda constructed per call — jax "
+                    "caches by function identity, so every invocation "
+                    "of the enclosing function recompiles; hoist to "
+                    "module level or cache the wrapper")
+            return
+        if not isinstance(inner, ast.Name):
+            return
+        fndef = self._resolve(ctx, node, inner.id)
+        if fndef is None:
+            return
+        if fndef.args.kwonlyargs and not statics:
+            names = ", ".join(a.arg for a in fndef.args.kwonlyargs)
+            yield ctx.finding(
+                self.name, node,
+                f"jitted `{fndef.name}` takes keyword-only config "
+                f"params ({names}) but the jit call passes no "
+                f"static_argnums/static_argnames — every distinct "
+                f"value retraces with a poisoned cache key")
+        if enclosing is not None and not cached:
+            free = _free_names(fndef, module_level)
+            local_defs = {n.name for n in ast.walk(enclosing)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))}
+            if free and fndef.name in local_defs:
+                yield ctx.finding(
+                    self.name, node,
+                    f"jax.jit over closure `{fndef.name}` (captures "
+                    f"{', '.join(sorted(free))}) inside an uncached "
+                    f"function — each call builds a fresh callable "
+                    f"and recompiles; memoize the wrapper "
+                    f"(lru_cache) or hoist the closure")
+
+    @staticmethod
+    def _resolve(ctx: FileContext, node, name: str):
+        """Nearest FunctionDef named `name`: enclosing scopes first,
+        then module level."""
+        cur = ctx.enclosing_function(node)
+        while cur is not None:
+            for stmt in ast.walk(cur):
+                if isinstance(stmt, ast.FunctionDef) and \
+                        stmt.name == name:
+                    return stmt
+            cur = ctx.enclosing_function(cur)
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.FunctionDef) and stmt.name == name:
+                return stmt
+        return None
+
+
+# ---------------------------------------------------------------------
+class DtypeRule(Rule):
+    """Pass 3: dtype discipline in `ops/` kernel modules. x64 is
+    disabled (tests/conftest.py pins JAX_ENABLE_X64=0), so a 64-bit
+    dtype literal reaching a device array silently downcasts — the
+    value the author wrote is not the value the kernel sees. Pad
+    widths must come from the bucketing helpers, or every novel shape
+    is a fresh XLA compile."""
+
+    name = "dtype-discipline"
+    doc = "no float64/int64 literals in ops/; pad widths from buckets"
+
+    SCOPE = ("nomad_tpu/ops/",)
+    BAD_ATTRS = {"np.float64", "np.int64", "numpy.float64",
+                 "numpy.int64", "jnp.float64", "jnp.int64",
+                 "jax.numpy.float64", "jax.numpy.int64"}
+    BUCKET_HELPERS = ("_pad_n", "_bucket_k", "_bucket_rows", "_kway_w")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not any(ctx.path.startswith(p) for p in self.SCOPE):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                chain = attr_chain(node)
+                if chain in self.BAD_ATTRS:
+                    yield ctx.finding(
+                        self.name, node,
+                        f"64-bit dtype literal `{chain}` in a kernel "
+                        f"module — x64 is disabled, device use "
+                        f"silently downcasts; use the 32-bit dtype")
+            elif isinstance(node, ast.Constant) and \
+                    node.value in ("float64", "int64"):
+                yield ctx.finding(
+                    self.name, node,
+                    f"64-bit dtype string {node.value!r} in a kernel "
+                    f"module — x64 is disabled; use the 32-bit dtype")
+            elif isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                if name.endswith(".pad") and len(node.args) >= 2:
+                    width = node.args[1]
+                    if not self._uses_bucket(width):
+                        yield ctx.finding(
+                            self.name, node,
+                            "pad width is not derived from the "
+                            "bucketing table (_pad_n/_bucket_k/"
+                            "_bucket_rows) — ad-hoc pad shapes "
+                            "multiply XLA compile-cache entries")
+
+    def _uses_bucket(self, expr) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                name = (call_name(node) or "").split(".")[-1]
+                if name in self.BUCKET_HELPERS:
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------
+_LOCK_SUFFIXES = ("_l", "_lock", "lock", "_cv", "_mu", "_mutex",
+                  "_watch")
+
+# direct calls that block or dispatch while a lock is held
+_DISPATCH_CALLS = ("jax.device_put", "jax.device_get", "time.sleep")
+_DISPATCH_SUFFIXES = (".block_until_ready", ".select_many", ".result",
+                      ".urlopen")
+
+
+def _is_lock_name(chain: str) -> bool:
+    last = chain.split(".")[-1]
+    return any(last == s or last.endswith(s) for s in _LOCK_SUFFIXES)
+
+
+class LockRule(Rule):
+    """Pass 4: lock order + lock scope. Builds the lock-acquisition
+    graph from `with <lock>:` nesting across every analyzed file
+    (lock identity = Class.attr, so `self._l` in two methods is one
+    node), flags cycles (the AB/BA deadlock shape `go vet` can't see
+    either — the race detector finds it at runtime, this finds it at
+    review time), and flags device dispatch or blocking waits issued
+    while a lock is held — directly, or one call deep into a method of
+    the same class (the depth that catches `with self._l:
+    self._upload()` where _upload does the device_put)."""
+
+    name = "lock-discipline"
+    doc = "no lock cycles; no dispatch/blocking call under a lock"
+
+    def __init__(self):
+        # lock graph accumulated across check_file calls; finish()
+        # reports cycles once per run
+        self._edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        self._edge_ctx: Dict[Tuple[str, str], FileContext] = {}
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        class_methods = self._index_methods(ctx)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            yield from self._walk_fn(ctx, fn, class_methods)
+
+    # -- per-function lock tracking -----------------------------------
+    def _walk_fn(self, ctx: FileContext, fn,
+                 class_methods) -> Iterable[Finding]:
+        cls = ctx.enclosing_class(fn)
+        held: List[str] = []
+
+        def lock_id(chain: str) -> str:
+            attr = chain.split(".", 1)[1] if "." in chain else chain
+            owner = cls.name if cls is not None and \
+                chain.startswith("self.") else ctx.path
+            return f"{owner}.{attr}"
+
+        def visit(node) -> Iterable[Finding]:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                return      # nested defs tracked on their own walk
+            if isinstance(node, ast.With):
+                locks = []
+                for item in node.items:
+                    chain = attr_chain(item.context_expr)
+                    if chain and _is_lock_name(chain):
+                        locks.append(lock_id(chain))
+                for lk in locks:
+                    for outer in held:
+                        if outer != lk:
+                            self._edges.setdefault(outer, {})
+                            if lk not in self._edges[outer]:
+                                self._edges[outer][lk] = (ctx.path,
+                                                          node.lineno)
+                                self._edge_ctx[(outer, lk)] = ctx
+                    held.append(lk)
+                for child in node.body:
+                    yield from visit(child)
+                for _ in locks:
+                    held.pop()
+                return
+            if isinstance(node, ast.Call) and held:
+                yield from self._check_dispatch(ctx, node, held, cls,
+                                                class_methods)
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child)
+
+        for stmt in fn.body:
+            yield from visit(stmt)
+
+    def _check_dispatch(self, ctx: FileContext, node: ast.Call,
+                        held: List[str], cls,
+                        class_methods) -> Iterable[Finding]:
+        name = call_name(node) or ""
+        if self._is_dispatch_name(name):
+            yield ctx.finding(
+                self.name, node,
+                f"`{name}` under lock {held[-1]}: device dispatch / "
+                f"blocking call while holding a lock serializes every "
+                f"other acquirer behind the device round trip")
+            return
+        # one level deep: self.method() whose body dispatches
+        if cls is not None and name.startswith("self.") and \
+                "." not in name[5:]:
+            callee = class_methods.get((cls.name, name[5:]))
+            if callee is not None:
+                site = self._first_dispatch_in(callee)
+                if site is not None:
+                    yield ctx.finding(
+                        self.name, node,
+                        f"`{name}()` under lock {held[-1]} reaches "
+                        f"`{site}` (inside `{callee.name}`): device "
+                        f"dispatch while holding a lock")
+
+    def _is_dispatch_name(self, name: str) -> bool:
+        if name in _DISPATCH_CALLS:
+            return True
+        return any(name.endswith(s) for s in _DISPATCH_SUFFIXES)
+
+    def _first_dispatch_in(self, fndef) -> Optional[str]:
+        for node in ast.walk(fndef):
+            if isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                if self._is_dispatch_name(name):
+                    return name
+        return None
+
+    @staticmethod
+    def _index_methods(ctx: FileContext):
+        out = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        out[(node.name, stmt.name)] = stmt
+        return out
+
+    # -- cycle detection ----------------------------------------------
+    def finish(self, project: Project) -> Iterable[Finding]:
+        seen_cycles: Set[frozenset] = set()
+        for start in sorted(self._edges):
+            path: List[str] = []
+            on_path: Set[str] = set()
+            visited: Set[str] = set()
+
+            def dfs(node: str) -> Optional[List[str]]:
+                if node in on_path:
+                    return path[path.index(node):] + [node]
+                if node in visited:
+                    return None
+                visited.add(node)
+                on_path.add(node)
+                path.append(node)
+                for nxt in sorted(self._edges.get(node, {})):
+                    cyc = dfs(nxt)
+                    if cyc is not None:
+                        return cyc
+                path.pop()
+                on_path.discard(node)
+                return None
+
+            cyc = dfs(start)
+            if cyc is None:
+                continue
+            key = frozenset(cyc)
+            if key in seen_cycles:
+                continue
+            seen_cycles.add(key)
+            a, b = cyc[0], cyc[1]
+            site_path, site_line = self._edges[a][b]
+            ctx = self._edge_ctx[(a, b)]
+            yield ctx.finding(
+                self.name, site_line,
+                f"lock-order cycle: {' -> '.join(cyc)} — two threads "
+                f"taking these in opposite order deadlock")
+
+
+# ---------------------------------------------------------------------
+class SurfaceDriftRule(Rule):
+    """Pass 5: surface drift. The HTTP route table, the CLI, and
+    STATUS.md drift apart silently as the surface grows (ROADMAP: CLI
+    long tail, RPC surface). Two contracts: every `/v1/...` route in
+    api/http.py must be referenced by a CLI command, the typed client,
+    or a test; every `ServerConfig.governor_*` knob must appear in
+    STATUS.md so operators can find it."""
+
+    name = "surface-drift"
+    doc = "routes need CLI/test references; governor knobs in STATUS.md"
+
+    def __init__(self,
+                 http_path: str = "nomad_tpu/api/http.py",
+                 reference_dirs: Sequence[str] = ("nomad_tpu/cli",
+                                                 "tests"),
+                 reference_files: Sequence[str] = (
+                     "nomad_tpu/api/client.py",),
+                 config_path: str = "nomad_tpu/server/core.py",
+                 status_path: str = "STATUS.md"):
+        self.http_path = http_path
+        self.reference_dirs = tuple(reference_dirs)
+        self.reference_files = tuple(reference_files)
+        self.config_path = config_path
+        self.status_path = status_path
+
+    def finish(self, project: Project) -> Iterable[Finding]:
+        yield from self._check_routes(project)
+        yield from self._check_knobs(project)
+
+    # -- routes --------------------------------------------------------
+    def _check_routes(self, project: Project) -> Iterable[Finding]:
+        ctx = project.contexts.get(self.http_path)
+        if ctx is None or ctx.tree is None:
+            return
+        pools = self._reference_pools(project)
+        for line, route in self._routes(ctx):
+            segments = [s for s in route.split("*") if len(s) > 1]
+            if not segments:
+                continue
+            if not any(all(seg in text for seg in segments)
+                       for text in pools):
+                yield ctx.finding(
+                    self.name, line,
+                    f"route {route!r} has no CLI command, client "
+                    f"method, or test referencing it — dead or "
+                    f"untested surface")
+
+    def _routes(self, ctx: FileContext) -> List[Tuple[int, str]]:
+        """(line, normalized route) pairs: capture groups -> `*`."""
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Compare):
+                for comp in node.comparators:
+                    if isinstance(comp, ast.Constant) and \
+                            isinstance(comp.value, str) and \
+                            comp.value.startswith("/v1/"):
+                        if isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+                            out.append((node.lineno, comp.value))
+            elif isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                if name.endswith("re.match") and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    pat = node.args[0].value
+                    if pat.startswith("^/v1/"):
+                        out.append((node.lineno,
+                                    self._normalize(pat)))
+        return out
+
+    @staticmethod
+    def _normalize(pattern: str) -> str:
+        pat = pattern.lstrip("^").rstrip("$")
+        pat = re.sub(r"\((?:[^()]|\([^()]*\))*\)", "*", pat)
+        return pat.replace("\\", "")
+
+    def _reference_pools(self, project: Project) -> List[str]:
+        pools = []
+        for d in self.reference_dirs:
+            pools.extend(project.glob_texts(d).values())
+        for f in self.reference_files:
+            t = project.text(f)
+            if t is not None:
+                pools.append(t)
+        return pools
+
+    # -- governor knobs ------------------------------------------------
+    def _check_knobs(self, project: Project) -> Iterable[Finding]:
+        ctx = project.contexts.get(self.config_path)
+        if ctx is None or ctx.tree is None:
+            return
+        status = project.text(self.status_path) or ""
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef) or \
+                    node.name != "ServerConfig":
+                continue
+            for stmt in node.body:
+                target = None
+                if isinstance(stmt, ast.AnnAssign) and \
+                        isinstance(stmt.target, ast.Name):
+                    target = stmt.target.id
+                elif isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.targets[0], ast.Name):
+                    target = stmt.targets[0].id
+                if target and target.startswith("governor_") and \
+                        target not in status:
+                    yield ctx.finding(
+                        self.name, stmt,
+                        f"ServerConfig.{target} is not documented in "
+                        f"{self.status_path} — operators can't find "
+                        f"the knob")
+
+
+def default_rules() -> List[Rule]:
+    return [HostSyncRule(), JitHygieneRule(), DtypeRule(), LockRule(),
+            SurfaceDriftRule()]

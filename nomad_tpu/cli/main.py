@@ -1454,6 +1454,20 @@ def cmd_acl_token_list(args) -> int:
     return 0
 
 
+def cmd_dev_lint(args) -> int:
+    """`nomad dev lint` — the TPU-hygiene static analyzer
+    (nomad_tpu/analysis/): host-sync / jit / dtype / lock /
+    surface-drift passes over the tree, non-zero exit on unsuppressed
+    findings. Local tooling: no agent connection involved."""
+    from ..analysis.__main__ import main as lint_main
+    argv = list(args.paths or [])
+    if args.as_json:
+        argv.append("--json")
+    if args.show_suppressed:
+        argv.append("--show-suppressed")
+    return lint_main(argv)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="nomad-tpu",
                                 description="TPU-native workload orchestrator")
@@ -1771,6 +1785,19 @@ def build_parser() -> argparse.ArgumentParser:
     system = sub.add_parser("system").add_subparsers(dest="sub")
     sgc = system.add_parser("gc")
     sgc.set_defaults(fn=cmd_system_gc)
+
+    dev = sub.add_parser("dev",
+                         help="developer tooling").add_subparsers(
+                             dest="sub")
+    dlint = dev.add_parser("lint",
+                           help="TPU-hygiene static analysis "
+                                "(nomad_tpu/analysis)")
+    dlint.add_argument("paths", nargs="*",
+                       help="files/dirs (default: the package)")
+    dlint.add_argument("-json", action="store_true", dest="as_json")
+    dlint.add_argument("-show-suppressed", action="store_true",
+                       dest="show_suppressed")
+    dlint.set_defaults(fn=cmd_dev_lint)
 
     acl = sub.add_parser("acl", help="ACL policies and tokens")
     acl_sub = acl.add_subparsers(dest="acl_cmd", required=True)

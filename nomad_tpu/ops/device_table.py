@@ -171,6 +171,11 @@ class DeviceNodeTable:
             # of the table (counts as a fold, resets the debt)
             return self._upload(table, epoch=st.epoch, fold=True)
         idx = np.fromiter(rows, np.int32, m)
+        from ..analysis import sanitizer
+        if sanitizer.enabled():
+            # OOB guard BEFORE padding: on TPU `.at[rows]` silently
+            # drops out-of-range rows — the corruption would be mute
+            sanitizer.check_rows("device_table.scatter", idx, st.n)
         b = _bucket_rows(m)
         if b > m:
             # pad with repeats of the first row carrying its own value:
@@ -181,6 +186,10 @@ class DeviceNodeTable:
         t0 = _time.perf_counter() if stages.enabled else 0.0
         used_rows = table.base_used[idx].astype(np.float32)
         port_rows = table.free_ports[idx].astype(np.float32)
+        if sanitizer.enabled():
+            sanitizer.check_finite("device_table.scatter",
+                                   used_rows=used_rows,
+                                   port_rows=port_rows)
         used, ports = _scatter_set(st.used, st.free_ports, idx,
                                    used_rows, port_rows)
         if stages.enabled:
@@ -234,6 +243,7 @@ class DeviceNodeTable:
                 self.delta_debt = 0
                 self.delta_log.clear()
                 return {"folded": False, "reason": "not materialized"}
+            # nomad-lint: allow[lock-discipline] upload must be atomic with the version token; jax dispatch is async (never blocks under _l)
             self._state = self._upload(table, epoch=self.epoch,
                                        fold=True)
             return {"folded": True, "debt_cleared": debt}
@@ -251,6 +261,7 @@ class DeviceNodeTable:
             st = self._state
             if st is None:
                 try:
+                    # nomad-lint: allow[lock-discipline] lazy materialization must pair arrays with the version token; dispatch is async
                     st = self._upload(table, epoch=self.epoch,
                                       fold=False)
                 except Exception:   # pragma: no cover — defensive
@@ -270,6 +281,10 @@ class DeviceNodeTable:
             return None
         idx = np.asarray(rows, np.int32)
         vals = np.asarray(deltas, np.float32)
+        from ..analysis import sanitizer
+        if sanitizer.enabled():
+            sanitizer.check_rows("device_table.overlay", idx, st.n)
+            sanitizer.check_finite("device_table.overlay", deltas=vals)
         b = _bucket_rows(m)
         if b > m:
             idx = np.concatenate([idx, np.zeros(b - m, np.int32)])
@@ -309,6 +324,8 @@ def _jit(name: str, fn):
 
 
 def _scatter_set(used, ports, idx, used_rows, port_rows):
+    from ..analysis.sanitizer import traces
+    traces.note("scatter_set", (tuple(used.shape), len(idx)))
     def fn(u, p, i, ur, pr):
         return u.at[i].set(ur), p.at[i].set(pr)
     return _jit("scatter_set", fn)(used, ports, idx, used_rows,
@@ -316,6 +333,8 @@ def _scatter_set(used, ports, idx, used_rows, port_rows):
 
 
 def _overlay_add(used, idx, vals):
+    from ..analysis.sanitizer import traces
+    traces.note("overlay_add", (tuple(used.shape), len(idx)))
     def fn(u, i, v):
         return u.at[i].add(v)
     return _jit("overlay_add", fn)(used, idx, vals)

@@ -911,6 +911,49 @@ def pack_request(req: SelectRequest, n_pad: int):
     return args, statics
 
 
+def _note_trace(arm: str, n_pad: int, **statics) -> None:
+    """Report this dispatch's compile key to the recompile counter
+    (analysis/sanitizer.py): a NEW (arm, shape-bucket, statics) tuple
+    means XLA traced and compiled. Always on — the cost is one set
+    lookup — so the `nomad.lint.recompiles` governor gauge sees storms
+    in production, not just under the sanitizer."""
+    from ..analysis.sanitizer import traces
+    traces.note(arm, (n_pad,) + tuple(sorted(statics.items())))
+
+
+def _sanitize_request(req: SelectRequest) -> None:
+    """NOMAD_TPU_SANITIZE=1 boundary guard: NaN/Inf screens on the
+    columns this dispatch ships — a NaN in `used` silently wins every
+    argmax (checkify analog, host-side so the device never pays)."""
+    from ..analysis import sanitizer
+    if not sanitizer.enabled():
+        return
+    sanitizer.check_finite(
+        "select.request", capacity=req.capacity, used=req.used,
+        ask=np.asarray(req.ask, np.float32),
+        free_ports=req.free_ports, dev_slots=req.dev_slots)
+
+
+def _sanitize_result(req: SelectRequest,
+                     res: SelectResult) -> SelectResult:
+    """NOMAD_TPU_SANITIZE=1 boundary guard on the unpacked result:
+    chosen rows must be real table rows and scores finite."""
+    from ..analysis import sanitizer
+    if not sanitizer.enabled():
+        return res
+    n = len(req.feasible)
+    idx = res.node_idx
+    if idx.size:
+        lo, hi = int(idx.min()), int(idx.max())
+        if lo < -1 or hi >= n:
+            raise sanitizer.SanitizerError(
+                f"sanitizer[select.result]: node_idx range [{lo}, {hi}]"
+                f" outside [-1, {n}) — the kernel chose a padding row")
+    sanitizer.check_finite("select.result",
+                           final_score=res.final_score)
+    return res
+
+
 def _stage_get(outs):
     """jax.device_get with bench attribution: result transfers are the
     `d2h` stage of the per-stage breakdown (the wall includes any
@@ -949,10 +992,18 @@ def unpack_result(req: SelectRequest, outs) -> SelectResult:
     n = len(req.feasible)
     kk = req.count
     choices = choices[:kk]
+    from ..analysis import sanitizer as _san
+    if _san.enabled() and choices.size and int(choices.max()) >= n:
+        # must run BEFORE the defensive clamp below, or a kernel bug
+        # that picks a padding row is laundered into a benign
+        # "unplaced" -1 and the guard never fires
+        raise _san.SanitizerError(
+            f"sanitizer[select.result]: kernel chose padding row "
+            f"{int(choices.max())} (table has {n} rows)")
     choices = np.where(choices >= n, -1, choices)  # padding lanes
     placed = int((choices >= 0).sum())
     top_idx = np.where(top_idx >= n, -1, top_idx)
-    return SelectResult(
+    return _sanitize_result(req, SelectResult(
         node_idx=choices,
         final_score=finals[:kk],
         scores={"binpack": s_bin[:kk], "job-anti-affinity": s_anti[:kk],
@@ -968,7 +1019,7 @@ def unpack_result(req: SelectRequest, outs) -> SelectResult:
                             else n) - np.count_nonzero(req.feasible)),
         exhausted_dim=exhausted[:kk],
         placed=placed,
-    )
+    ))
 
 
 _CHUNKED_ARGS = ("capacity", "used0", "feasible", "ask", "k_valid",
@@ -1026,9 +1077,9 @@ def _node_local_scores_batch(req: SelectRequest, cs, starts, ms):
     _node_local_scores_np, so results stay bit-identical — the
     per-winner call overhead (30 tiny numpy ops each) dominated
     multi-batch expansion."""
-    cs = np.asarray(cs, np.int64)
+    cs = np.asarray(cs, np.int32)
     starts = np.asarray(starts, np.float32)
-    ms = np.asarray(ms, np.int64)
+    ms = np.asarray(ms, np.int32)
     max_m = int(ms.max()) if len(ms) else 0
     ask = np.asarray(req.ask, np.float32)
     a = np.arange(max_m, dtype=np.float32)
@@ -1095,7 +1146,7 @@ def _kway_merge_py(fin_m, nodes_v, len_v, limit):
         if j + 1 < len_v[k]:
             heapq.heappush(heap, (-float(fin_m[k, j + 1]), node,
                                   k, j + 1))
-    return np.asarray(ok, np.int64), np.asarray(oj, np.int64)
+    return np.asarray(ok, np.int32), np.asarray(oj, np.int32)
 
 
 def _kway_merge(fin_m, nodes_v, len_v, limit):
@@ -1112,7 +1163,7 @@ def _kway_merge(fin_m, nodes_v, len_v, limit):
                     fin_m.shape[1], int(limit))
     pairs = np.frombuffer(out, np.int32)
     p = len(pairs) // 2
-    return pairs[:p].astype(np.int64), pairs[p:].astype(np.int64)
+    return pairs[:p].copy(), pairs[p:].copy()
 
 
 def _expand_kway(req: SelectRequest, rounds) -> SelectResult:
@@ -1153,7 +1204,7 @@ def _expand_kway(req: SelectRequest, rounds) -> SelectResult:
             # vectorized shot ([W, max_m]; rows past each winner's m
             # are garbage the merge never reads)
             nodes_v = np.asarray([c for c, _m in winners], np.int32)
-            len_v = np.asarray([mm for _c, mm in winners], np.int64)
+            len_v = np.asarray([mm for _c, mm in winners], np.int32)
             starts_v = np.asarray([extra.get(c, 0)
                                    for c, _m in winners], np.float32)
             for c, mm in winners:
@@ -1174,8 +1225,8 @@ def _expand_kway(req: SelectRequest, rounds) -> SelectResult:
             comp["devices"][sl] = dev_v[ok]
             comp["preemption"][sl] = pre_v[ok]
             m_ti, m_ts, m_exh = last_meta if last_meta is not None else \
-                (np.full(TOP_K, -1, np.int64), np.full(TOP_K, NEG_INF),
-                 np.zeros(d, np.int64))
+                (np.full(TOP_K, -1, np.int32), np.full(TOP_K, NEG_INF),
+                 np.zeros(d, np.int32))
             top_i[sl] = np.where(np.asarray(m_ti) >= n, -1,
                                  np.asarray(m_ti))[None, :]
             top_s[sl] = np.asarray(m_ts)[None, :]
@@ -1189,7 +1240,7 @@ def _expand_kway(req: SelectRequest, rounds) -> SelectResult:
 
     considered = req.n_considered if req.n_considered is not None else n
     comp["allocation-spread"] = np.zeros(k_total, np.float32)
-    return SelectResult(
+    return _sanitize_result(req, SelectResult(
         node_idx=node_idx,
         final_score=final,
         scores=comp,
@@ -1198,7 +1249,7 @@ def _expand_kway(req: SelectRequest, rounds) -> SelectResult:
         nodes_filtered=int(considered - np.count_nonzero(req.feasible)),
         exhausted_dim=exh_out,
         placed=pos,
-    )
+    ))
 
 class DispatchCostModel:
     """Measured per-shape dispatch costs, replacing the static step
@@ -1310,9 +1361,11 @@ def _accel_roundtrip_s() -> float:
         return _accel_rtt_cache[0]
     dev = jax.devices()[0]
     small = np.zeros(8, np.float32)
+    # nomad-lint: allow[host-sync] intentional probe: the sync IS the RTT measurement
     jax.device_get(jax.device_put(small, dev))  # warm the path
     t0 = __import__("time").perf_counter()
     for _ in range(2):
+        # nomad-lint: allow[host-sync] intentional probe: the sync IS the RTT measurement
         jax.device_get(jax.device_put(small, dev))
     rtt = max((__import__("time").perf_counter() - t0) / 2, 1e-5)
     _accel_rtt_cache.append(rtt)
@@ -1534,6 +1587,7 @@ class SelectKernel:
         return feas
 
     def _select(self, req: SelectRequest) -> SelectResult:
+        _sanitize_request(req)
         sharded = self._mesh_sharded()
         if sharded is not None:
             chunk_ok = (not req.spreads and not req.distinct_props
@@ -1580,6 +1634,8 @@ class SelectKernel:
         resident = self._resident_args(req, n_pad, dev)
         if resident:
             args.update(resident)
+        _note_trace("scan", n_pad, k_steps=k, cpu=dev is not None,
+                    **statics)
         t0 = _time.perf_counter()
         _carry, outs = _select_scan(**args, k_steps=k, **statics)
         out = unpack_result(req, outs)
@@ -1609,6 +1665,8 @@ class SelectKernel:
                   dev) -> SelectResult:
         import time as _time
         cargs, spread_alg, w = self._pack_kway(req, n_pad, dev)
+        _note_trace("kway", n_pad, max_steps=_kway_steps(w),
+                    spread_alg=spread_alg, w=w, cpu=dev is not None)
         # window matches every other arm: dispatch through
         # unpack/expand, packing/placement excluded
         t0 = _time.perf_counter()
@@ -1635,6 +1693,8 @@ class SelectKernel:
         per-request select()."""
         if not reqs:
             return []
+        for r in reqs:
+            _sanitize_request(r)
         from ..utils import metrics
         sharded = self._mesh_sharded()
         n = len(reqs[0].feasible)
@@ -1685,6 +1745,9 @@ class SelectKernel:
             cargs, sharded, reqs[0].capacity, n_pad,
             sum(min(r.count, 2 * n) for r in reqs))
         w = _kway_w(n_pad)
+        _note_trace("kway_batched", n_pad, max_steps=_kway_steps(w),
+                    spread_alg=spread_alg, w=w,
+                    lanes=len(cargs["k_valid"]))
         import time as _time
         t0 = _time.perf_counter()
         with mesh_ctx:
@@ -1710,16 +1773,21 @@ class SelectKernel:
                 # rare overflow of the phase budget: continue this lane
                 # on the single-request kernel from its carry state
                 # host copies: the continuation runs on the default
-                # single-device path even when the batch ran sharded
-                lane = {k: (np.asarray(jax.device_get(cargs[k]))
+                # single-device path even when the batch ran sharded —
+                # pulled through the d2h fence so the bench attributes
+                # the transfer (lint: host-sync)
+                lane = {k: (np.asarray(_stage_get(cargs[k]))
                             if k == "capacity"
-                            else np.asarray(jax.device_get(cargs[k][i])))
+                            else np.asarray(_stage_get(cargs[k][i])))
                         for k in _CHUNKED_ARGS}
+                used0, tg0, fp0, ds0 = _stage_get(
+                    (carry[0][i], carry[1][i], carry[2][i],
+                     carry[3][i]))
                 lane.update(
-                    used0=np.asarray(jax.device_get(carry[0][i])),
-                    tg_coll0=np.asarray(jax.device_get(carry[1][i])),
-                    free_ports=np.asarray(jax.device_get(carry[2][i])),
-                    dev_slots0=np.asarray(jax.device_get(carry[3][i])),
+                    used0=np.asarray(used0),
+                    tg_coll0=np.asarray(tg0),
+                    free_ports=np.asarray(fp0),
+                    dev_slots0=np.asarray(ds0),
                     k_valid=np.int32(rem))
                 pending = _select_kway(**lane,
                                        max_steps=_kway_steps(w),
@@ -1827,6 +1895,9 @@ class SelectKernel:
         fn = _chunked_batched_jit(max_steps, spread_alg)
         cargs, mesh_ctx = self._place_batched(
             cargs, sharded, reqs[0].capacity, n_pad, min(maxc, 2 * n_pad))
+        _note_trace("chunked_batched", n_pad, max_steps=max_steps,
+                    spread_alg=spread_alg,
+                    lanes=len(cargs["k_valid"]))
         import time as _time
         t0 = _time.perf_counter()
         with mesh_ctx:
@@ -1842,16 +1913,20 @@ class SelectKernel:
                        ts[:steps], exh[:steps], feas[:steps])]
             if rem > 0 and steps > 0 and chunk[steps - 1] != 0:
                 # step-budget overflow: continue this lane solo from
-                # its carry (host copies; the default device path)
-                lane = {nm: (np.asarray(jax.device_get(cargs[nm]))
+                # its carry (host copies; the default device path) —
+                # pulled through the d2h fence (lint: host-sync)
+                lane = {nm: (np.asarray(_stage_get(cargs[nm]))
                              if nm == "capacity"
-                             else np.asarray(jax.device_get(cargs[nm][i])))
+                             else np.asarray(_stage_get(cargs[nm][i])))
                         for nm in _CHUNKED_ARGS}
+                used0, tg0, fp0, ds0 = _stage_get(
+                    (carry[0][i], carry[1][i], carry[2][i],
+                     carry[3][i]))
                 lane.update(
-                    used0=np.asarray(jax.device_get(carry[0][i])),
-                    tg_coll0=np.asarray(jax.device_get(carry[1][i])),
-                    free_ports=np.asarray(jax.device_get(carry[2][i])),
-                    dev_slots0=np.asarray(jax.device_get(carry[3][i])),
+                    used0=np.asarray(used0),
+                    tg_coll0=np.asarray(tg0),
+                    free_ports=np.asarray(fp0),
+                    dev_slots0=np.asarray(ds0),
                     k_valid=np.int32(rem))
                 rounds.extend(self._chunked_rounds(lane, spread_alg))
             results.append(_expand_chunks(req, rounds))
@@ -1901,6 +1976,8 @@ class SelectKernel:
         fn = _scan_batched_jit(k, spread_alg, s_live, p_live)
         cargs, mesh_ctx = self._place_batched(
             cargs, sharded, reqs[0].capacity, n_pad, k)
+        _note_trace("scan_batched", n_pad, k_steps=k, s_live=s_live,
+                    p_live=p_live, lanes=len(cargs["k_valid"]))
         import time as _time
         t0 = _time.perf_counter()
         with mesh_ctx:
@@ -1967,6 +2044,8 @@ class SelectKernel:
         else:
             max_steps = 16384       # covers count<=16384 in one dispatch
                                     # (a step always places >=1 or stops)
+        _note_trace("chunked", n_pad, max_steps=max_steps,
+                    spread_alg=spread_alg, cpu=dev is not None)
         rounds = []
         t0 = _time.perf_counter()
         while True:
@@ -2099,7 +2178,7 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
         exh_out[pos:] = exh_f
 
     considered = req.n_considered if req.n_considered is not None else n
-    return SelectResult(
+    return _sanitize_result(req, SelectResult(
         node_idx=node_idx,
         final_score=final,
         scores={"binpack": s_bin, "job-anti-affinity": s_anti,
@@ -2112,7 +2191,7 @@ def _expand_chunks(req: SelectRequest, rounds) -> SelectResult:
         nodes_filtered=int(considered - np.count_nonzero(req.feasible)),
         exhausted_dim=exh_out,
         placed=pos,
-    )
+    ))
 
 
 # -- kernel-cache governance (governor/registry.py) --------------------
@@ -2143,6 +2222,10 @@ def clear_kernel_caches() -> dict:
     right call on a healthy server (the LRU bound handles churn);
     exists for the watermark breach where compiled-shape cardinality
     itself is the leak. Next dispatches recompile warm shapes."""
+    # the recompile gauge must see those recompiles: forget seen trace
+    # signatures so re-traced warm shapes count as fresh compiles
+    from ..analysis.sanitizer import traces
+    traces.invalidate()
     before = kernel_cache_entries()
     _scan_batched_jit.cache_clear()
     _chunked_batched_jit.cache_clear()

@@ -281,8 +281,8 @@ class NodeTable:
         # time (one pass there beats 2M tuple appends here)
         t._bulk_rows_pending = True
         if idx_list:
-            ii = np.fromiter(idx_list, np.int64, len(idx_list))
-            cc = np.fromiter(code_list, np.int64, len(code_list))
+            ii = np.fromiter(idx_list, np.int32, len(idx_list))
+            cc = np.fromiter(code_list, np.int32, len(code_list))
             np.add.at(t.base_used, ii,
                       np.asarray(lut, np.float32)[cc])
         t.finalize()
@@ -442,7 +442,7 @@ class NodeTable:
         if not adds:
             return touched
         self._seal()
-        idxs = np.fromiter((i for i, _ in adds), np.int64, len(adds))
+        idxs = np.fromiter((i for i, _ in adds), np.int32, len(adds))
         usage = np.asarray([_alloc_usage(a) for _, a in adds], np.float32)
         np.add.at(self.base_used, idxs, usage)
         per_node: Dict[int, List] = {}

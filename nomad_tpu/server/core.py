@@ -397,6 +397,16 @@ class Server:
         # the HTTP register path starts shedding with 429s
         gov.register("broker.delayed_depth", broker.delayed_depth)
 
+        # recompile visibility (analysis/sanitizer.py): distinct
+        # compiled trace signatures across every kernel arm — a
+        # recompile storm shows up in /v1/operator/governor as a
+        # climbing gauge, not a mystery p99. suspect=False: monotone
+        # by construction, it must not out-rank a real leak in drift
+        # findings
+        from ..analysis.sanitizer import traces as lint_traces
+        gov.register("lint.recompiles", lint_traces.count,
+                     suspect=False)
+
         # admission control: the broker sheds fresh enqueues while any
         # pressure gauge is over
         self.eval_broker.pressure_fn = gov.backpressure

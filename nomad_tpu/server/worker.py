@@ -94,6 +94,7 @@ class BatchGateway:
                     remaining = self.window_s - (time.monotonic()
                                                  - self._open_t)
                     if remaining <= 0:
+                        # nomad-lint: allow[lock-discipline] _fire releases the cv around the kernel dispatch (see its body)
                         self._fire()
                         continue
                     self._cv.wait(remaining)

@@ -362,3 +362,15 @@ def test_job_register_backpressure_429(cluster):
     # valve clear: the same register admits
     resp = c.register_job(job_to_spec(mock.batch_job()))
     assert "EvalID" in resp
+
+
+def test_status_leader_and_pprof_cmdline(cluster):
+    """Surface-drift ratchet (nomad_tpu/analysis): every /v1 route
+    needs a CLI or test reference — these two had neither."""
+    server, client, c = cluster
+    # dev (raft-less) agent: trivially its own leader, reports its RPC
+    # address (status_endpoint.go Leader)
+    leader = c._request("GET", "/v1/status/leader")
+    assert isinstance(leader, str) and leader
+    cmdline = c._request("GET", "/v1/agent/pprof/cmdline")
+    assert cmdline["cmdline"]

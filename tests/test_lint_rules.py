@@ -1,0 +1,483 @@
+"""Fixture tests for the TPU-hygiene passes (nomad_tpu/analysis/):
+one known-bad and one known-good snippet per pass, suppression
+honoring, the synthetic A->B / B->A lock cycle, and the runtime
+sanitizer's guards + recompile gauge."""
+
+import numpy as np
+import pytest
+
+from nomad_tpu.analysis import (DtypeRule, HostSyncRule, JitHygieneRule,
+                                LockRule, Project, SurfaceDriftRule,
+                                sanitizer)
+
+
+def lint(files, rules):
+    project = Project(files=files)
+    project.load([])
+    return project.analyze(rules)
+
+
+def active(findings, rule=None):
+    return [f for f in findings if not f.suppressed
+            and (rule is None or f.rule == rule)]
+
+
+# -- pass 1: host-sync -------------------------------------------------
+
+HOT = "nomad_tpu/ops/fixture.py"
+
+BAD_HOST_SYNC = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def pull(x):
+    return jax.device_get(x)
+
+def scalarize(x):
+    return x.item()
+
+def wait(x):
+    x.block_until_ready()
+    return np.asarray(jnp.sum(x))
+"""
+
+GOOD_HOST_SYNC = """\
+import numpy as np
+
+def host_math(a):
+    b = np.asarray(a)          # host value: no jax call inside
+    return b.sum()
+"""
+
+
+class TestHostSync:
+    def test_bad_fires(self):
+        out = active(lint({HOT: BAD_HOST_SYNC}, [HostSyncRule()]))
+        msgs = [f.message for f in out]
+        assert len(out) == 4
+        assert any("device_get" in m for m in msgs)
+        assert any(".item()" in m for m in msgs)
+        assert any("block_until_ready" in m for m in msgs)
+        assert any("np.asarray" in m for m in msgs)
+
+    def test_good_clean(self):
+        assert not active(lint({HOT: GOOD_HOST_SYNC},
+                               [HostSyncRule()]))
+
+    def test_fence_module_and_function_whitelisted(self):
+        fence_mod = {"nomad_tpu/utils/stages.py":
+                     "import jax\n\ndef f(x):\n"
+                     "    return jax.device_get(x)\n"}
+        assert not active(lint(fence_mod, [HostSyncRule()]))
+        fence_fn = {"nomad_tpu/ops/select.py":
+                    "import jax\n\ndef _stage_get(outs):\n"
+                    "    return jax.device_get(outs)\n"}
+        assert not active(lint(fence_fn, [HostSyncRule()]))
+
+    def test_cold_modules_out_of_scope(self):
+        out = lint({"nomad_tpu/cli/fixture.py": BAD_HOST_SYNC},
+                   [HostSyncRule()])
+        assert not out
+
+    def test_suppression_honored(self):
+        src = ("import jax\n\ndef pull(x):\n"
+               "    # nomad-lint: allow[host-sync] attribution fence\n"
+               "    return jax.device_get(x)\n")
+        out = lint({HOT: src}, [HostSyncRule()])
+        assert len(out) == 1 and out[0].suppressed
+        assert not active(out)
+        # a different rule's allow[] must NOT silence this one
+        src2 = src.replace("allow[host-sync]", "allow[dtype-discipline]")
+        assert active(lint({HOT: src2}, [HostSyncRule()]))
+
+
+# -- pass 2: jit hygiene -----------------------------------------------
+
+BAD_JIT = """\
+import jax
+
+def build(k):
+    def fn(x, *, steps):
+        return x
+
+    return jax.jit(fn)
+
+def storm(a):
+    def fn(x):
+        return x + a
+
+    return jax.jit(fn)
+"""
+
+GOOD_JIT = """\
+import jax
+from functools import lru_cache, partial
+
+def _kernel(x, *, steps):
+    return x
+
+_jitted = partial(jax.jit, static_argnames=("steps",))(_kernel)
+
+@lru_cache(maxsize=8)
+def build(steps):
+    def fn(x):
+        return x * steps
+
+    return jax.jit(fn)
+"""
+
+
+class TestJitHygiene:
+    def test_bad_fires(self):
+        out = active(lint({HOT: BAD_JIT}, [JitHygieneRule()]))
+        msgs = [f.message for f in out]
+        assert any("keyword-only config" in m for m in msgs)
+        assert any("closure" in m for m in msgs)
+
+    def test_good_clean(self):
+        assert not active(lint({HOT: GOOD_JIT}, [JitHygieneRule()]))
+
+    def test_lambda_in_uncached_function(self):
+        src = ("import jax\n\ndef f(ys):\n"
+               "    return jax.jit(lambda x: x + 1)(ys)\n")
+        out = active(lint({HOT: src}, [JitHygieneRule()]))
+        assert out and "lambda" in out[0].message
+        # module-level lambda jit is one object: fine
+        src2 = "import jax\nF = jax.jit(lambda x: x + 1)\n"
+        assert not active(lint({HOT: src2}, [JitHygieneRule()]))
+
+
+# -- pass 3: dtype discipline ------------------------------------------
+
+BAD_DTYPE = """\
+import numpy as np
+import jax.numpy as jnp
+
+A = np.zeros(4, np.int64)
+B = jnp.asarray([1.0], jnp.float64)
+
+def convert(x):
+    return x.astype("float64")
+
+def pad(x, n):
+    return jnp.pad(x, (0, n + 3))
+"""
+
+GOOD_DTYPE = """\
+import numpy as np
+import jax.numpy as jnp
+
+def _pad_n(n):
+    p = 8
+    while p < n:
+        p *= 2
+    return p
+
+A = np.zeros(4, np.int32)
+
+def pad(x, n):
+    return jnp.pad(x, (0, _pad_n(n) - n))
+"""
+
+
+class TestDtypeDiscipline:
+    def test_bad_fires(self):
+        out = active(lint({HOT: BAD_DTYPE}, [DtypeRule()]))
+        msgs = [f.message for f in out]
+        assert any("np.int64" in m for m in msgs)
+        assert any("jnp.float64" in m for m in msgs)
+        assert any("'float64'" in m for m in msgs)
+        assert any("pad width" in m for m in msgs)
+
+    def test_good_clean(self):
+        assert not active(lint({HOT: GOOD_DTYPE}, [DtypeRule()]))
+
+    def test_scope_is_ops_only(self):
+        out = lint({"nomad_tpu/server/fixture.py": BAD_DTYPE},
+                   [DtypeRule()])
+        assert not out
+
+
+# -- pass 4: lock discipline -------------------------------------------
+
+CYCLE = """\
+class T:
+    def f(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def g(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+"""
+
+NO_CYCLE = """\
+class T:
+    def f(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def g(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+"""
+
+DISPATCH_UNDER_LOCK = """\
+import jax
+
+class D:
+    def direct(self, x):
+        with self._l:
+            return jax.device_put(x)
+
+    def indirect(self):
+        with self._l:
+            self._up()
+
+    def _up(self):
+        return jax.device_put(1)
+"""
+
+
+class TestLockDiscipline:
+    def test_ab_ba_cycle_detected(self):
+        out = active(lint({HOT: CYCLE}, [LockRule()]))
+        assert len(out) == 1
+        assert "T._a_lock" in out[0].message
+        assert "T._b_lock" in out[0].message
+        assert "deadlock" in out[0].message
+
+    def test_consistent_order_clean(self):
+        assert not active(lint({HOT: NO_CYCLE}, [LockRule()]))
+
+    def test_cross_file_cycle(self):
+        f1 = ("class A:\n    def f(self):\n        with self._x_lock:\n"
+              "            with self._y_lock:\n                pass\n")
+        f2 = ("class A:\n    def g(self):\n        with self._y_lock:\n"
+              "            with self._x_lock:\n                pass\n")
+        out = active(lint({"nomad_tpu/server/f1.py": f1,
+                           "nomad_tpu/server/f2.py": f2}, [LockRule()]))
+        assert len(out) == 1
+
+    def test_dispatch_under_lock(self):
+        out = active(lint({HOT: DISPATCH_UNDER_LOCK}, [LockRule()]))
+        assert len(out) == 2            # direct + one-level-deep
+        assert all("device" in f.message for f in out)
+
+
+# -- pass 5: surface drift ---------------------------------------------
+
+FIXTURE_HTTP = '''\
+import re
+
+def route(path):
+    if path == "/v1/widgets":
+        return "list"
+    m = re.match(r"^/v1/widget/([^/]+)/frob$", path)
+    if m:
+        return "frob"
+    m = re.match(r"^/v1/widget/([^/]+)$", path)
+    if m:
+        return "get"
+'''
+
+FIXTURE_CONFIG = """\
+class ServerConfig:
+    governor_documented_high: int = 5
+    governor_orphan_high: int = 9
+    other_knob: int = 1
+"""
+
+
+class TestSurfaceDrift:
+    RULE_KW = dict(http_path="nomad_tpu/api/http.py",
+                   reference_dirs=("nomad_tpu/cli", "tests"),
+                   reference_files=(),
+                   config_path="nomad_tpu/server/core.py",
+                   status_path="STATUS.md")
+
+    def files(self, cli_src, status):
+        return {"nomad_tpu/api/http.py": FIXTURE_HTTP,
+                "nomad_tpu/cli/main.py": cli_src,
+                "nomad_tpu/server/core.py": FIXTURE_CONFIG,
+                "STATUS.md": status}
+
+    def test_unreferenced_route_and_undocumented_knob(self):
+        files = self.files('JOBS = "/v1/widgets"\n'
+                           'GET = "/v1/widget/"\n',
+                           "only governor_documented_high is here")
+        out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
+        route_f = [f for f in out if "route" in f.message]
+        knob_f = [f for f in out if "governor_orphan_high" in f.message]
+        assert len(route_f) == 1        # /frob never referenced
+        assert "/frob" in route_f[0].message
+        assert len(knob_f) == 1
+        # documented knob and referenced routes are quiet
+        assert not any("governor_documented_high" in f.message
+                       for f in out)
+        assert not any("/v1/widgets" in f.message for f in out)
+
+    def test_reference_via_tests_dir(self):
+        files = self.files('JOBS = "/v1/widgets"\n'
+                           'GET = "/v1/widget/"\n',
+                           "governor_documented_high, "
+                           "governor_orphan_high")
+        files["tests/test_widget.py"] = \
+            'resp = c.get(f"/v1/widget/{wid}/frob")\n'
+        out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
+        assert not out
+
+
+# -- runtime sanitizer -------------------------------------------------
+
+class TestSanitizer:
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.delenv(sanitizer.ENV, raising=False)
+        assert not sanitizer.enabled()
+        monkeypatch.setenv(sanitizer.ENV, "1")
+        assert sanitizer.enabled()
+        monkeypatch.setenv(sanitizer.ENV, "off")
+        assert not sanitizer.enabled()
+
+    def test_check_rows(self):
+        sanitizer.check_rows("t", np.array([0, 3, 7]), 8)
+        with pytest.raises(sanitizer.SanitizerError):
+            sanitizer.check_rows("t", np.array([0, 8]), 8)
+        with pytest.raises(sanitizer.SanitizerError):
+            sanitizer.check_rows("t", np.array([-1, 2]), 8)
+
+    def test_check_finite(self):
+        sanitizer.check_finite("t", a=np.ones(3, np.float32))
+        with pytest.raises(sanitizer.SanitizerError):
+            sanitizer.check_finite(
+                "t", a=np.array([1.0, np.nan], np.float32))
+        # int arrays and None are skipped
+        sanitizer.check_finite("t", b=np.ones(3, np.int32), c=None)
+
+    def test_select_guard_catches_nan_used(self, monkeypatch):
+        monkeypatch.setenv(sanitizer.ENV, "1")
+        from nomad_tpu.ops.select import SelectKernel, SelectRequest
+        n = 16
+        capacity = np.full((n, 3), 100.0, np.float32)
+        used = np.zeros((n, 3), np.float32)
+        used[3, 1] = np.nan
+        req = SelectRequest(
+            ask=np.array([1.0, 1.0, 1.0], np.float32), count=2,
+            feasible=np.ones(n, bool), capacity=capacity, used=used,
+            desired_count=2.0, tg_collisions=np.zeros(n, np.int32),
+            job_count=np.zeros(n, np.int32))
+        with pytest.raises(sanitizer.SanitizerError):
+            SelectKernel().select(req)
+
+    def test_sanitized_select_passes_and_counts_traces(self,
+                                                       monkeypatch):
+        monkeypatch.setenv(sanitizer.ENV, "1")
+        from nomad_tpu.ops.select import SelectKernel, SelectRequest
+        n = 16
+        req = SelectRequest(
+            ask=np.array([1.0, 1.0, 1.0], np.float32), count=4,
+            feasible=np.ones(n, bool),
+            capacity=np.full((n, 3), 100.0, np.float32),
+            used=np.zeros((n, 3), np.float32),
+            desired_count=4.0, tg_collisions=np.zeros(n, np.int32),
+            job_count=np.zeros(n, np.int32))
+        res = SelectKernel().select(req)
+        assert res.placed == 4
+        assert sanitizer.traces.count() > 0
+        assert "chunked" in sanitizer.traces.per_kernel()
+
+    def test_scatter_oob_guard(self, monkeypatch):
+        monkeypatch.setenv(sanitizer.ENV, "1")
+        monkeypatch.setenv("NOMAD_TPU_TABLE_DELTA", "1")
+        from nomad_tpu.ops.device_table import DeviceNodeTable
+
+        class FakeTable:
+            n = 8
+            device_version = 0
+            base_used = np.zeros((8, 3), np.float32)
+            capacity = np.ones((8, 3), np.float32)
+            free_ports = np.ones(8, np.float32)
+
+        t = FakeTable()
+        mirror = DeviceNodeTable()
+        t.device_version = mirror.version
+        st = mirror.arrays_for(t)
+        assert st is not None
+        with pytest.raises(sanitizer.SanitizerError):
+            mirror._scatter(st, t, [2, 99])   # 99 outside [0, 8)
+
+    def test_trace_counter_dedups(self):
+        tc = sanitizer.TraceCounter()
+        assert tc.note("k", (8, "a"))
+        assert not tc.note("k", (8, "a"))
+        assert tc.note("k", (16, "a"))
+        assert tc.count() == 2
+        assert tc.per_kernel() == {"k": 2}
+
+    def test_trace_counter_invalidate_keeps_storms_visible(self):
+        """After a kernel-cache clear, warm shapes re-trace — the
+        cumulative gauge must keep climbing (a cache-thrash storm
+        must not hide behind already-seen signatures)."""
+        tc = sanitizer.TraceCounter()
+        tc.note("k", (8, "a"))
+        tc.invalidate()                 # the cache-clear hook
+        assert tc.note("k", (8, "a"))   # re-trace counts again
+        assert tc.count() == 2          # cumulative, monotone
+        assert tc.per_kernel() == {"k": 1}
+
+    def test_cache_clear_invalidates_traces(self):
+        from nomad_tpu.ops.select import clear_kernel_caches
+        sanitizer.traces.note("probe_kernel", ("x",))
+        clear_kernel_caches()
+        before = sanitizer.traces.count()
+        assert sanitizer.traces.note("probe_kernel", ("x",))
+        assert sanitizer.traces.count() == before + 1
+
+    def test_padding_row_guard_fires_before_clamp(self, monkeypatch):
+        """A kernel bug that picks a padding row must raise, not be
+        laundered into a benign unplaced -1 by unpack_result's
+        defensive clamp."""
+        monkeypatch.setenv(sanitizer.ENV, "1")
+        from nomad_tpu.ops.select import (TOP_K, SelectRequest,
+                                          unpack_result)
+        n, k = 4, 2
+        req = SelectRequest(
+            ask=np.ones(3, np.float32), count=k,
+            feasible=np.ones(n, bool),
+            capacity=np.full((n, 3), 10.0, np.float32),
+            used=np.zeros((n, 3), np.float32),
+            desired_count=float(k),
+            tg_collisions=np.zeros(n, np.int32),
+            job_count=np.zeros(n, np.int32))
+        z = np.zeros(k, np.float32)
+        outs = (np.array([n + 1, 0], np.int32),   # padding row chosen
+                z, z, z, z, z, z, z, z,
+                np.full((k, TOP_K), -1, np.int32),
+                np.full((k, TOP_K), 0.0, np.float32),
+                np.zeros((k, 3), np.int32), np.zeros(k, np.int32))
+        with pytest.raises(sanitizer.SanitizerError):
+            unpack_result(req, outs)
+
+    def test_recompile_gauge_in_governor_snapshot(self):
+        """Acceptance: the recompile counter is visible in the
+        governor snapshot (as the `lint.recompiles` gauge) and in
+        /v1/metrics (`nomad.governor.lint.recompiles`)."""
+        from nomad_tpu.server import Server, ServerConfig
+        s = Server(ServerConfig(num_schedulers=0,
+                                governor_interval_s=60.0))
+        try:
+            s.governor.sample_once()
+            status = s.governor.status()
+            rows = {g["name"]: g for g in status["gauges"]}
+            assert "lint.recompiles" in rows
+            assert rows["lint.recompiles"]["value"] >= 0
+            from nomad_tpu.utils import metrics
+            names = {g["Name"] for g in metrics.snapshot()["Gauges"]}
+            assert "nomad.governor.lint.recompiles" in names
+        finally:
+            s.shutdown()
