@@ -175,6 +175,21 @@ def main() -> None:
         out["dispatch_cost_model"] = cost_model.snapshot()
         from nomad_tpu.analysis.sanitizer import traces
         out["lint_recompiles"] = traces.per_kernel()
+        # group-commit applier + cross-eval engine reuse (ISSUE 4):
+        # group sizing and the host-phase reuse hit rate, so the next
+        # TPU run can confirm the commit half of the e2e gap closed
+        from nomad_tpu.server.plan_applier import GROUP_STATS
+        out["plan_group_stats"] = dict(GROUP_STATS)
+        out["plan_group_mean_size"] = round(
+            GROUP_STATS["plans"] / max(GROUP_STATS["groups"], 1), 2)
+        out["plan_group_conflict_retries"] = \
+            GROUP_STATS["conflict_retries"]
+        from nomad_tpu.scheduler.stack import engine_cache_stats
+        ec = engine_cache_stats()
+        out["engine_reuse"] = ec
+        out["engine_reuse_hit_rate"] = round(
+            ec["mask_hits"] / max(ec["mask_hits"] + ec["mask_misses"],
+                                  1), 4)
     except Exception as e:   # pragma: no cover — defensive
         out["stage_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(out))

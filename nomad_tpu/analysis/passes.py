@@ -13,7 +13,8 @@ verified mechanically (CHANGES.md, STATUS §2.6):
   lock-discipline  lock-acquisition graph must be acyclic, and no lock
                    may be held across device dispatch / blocking waits
   surface-drift    every HTTP route needs a CLI/test reference; every
-                   ServerConfig.governor_* knob must appear in STATUS.md
+                   ServerConfig.governor_*/plan_group_* knob must
+                   appear in STATUS.md
 
 Rules report THROUGH ctx.finding(), so inline
 `# nomad-lint: allow[rule]` suppressions are honored uniformly.
@@ -525,11 +526,15 @@ class SurfaceDriftRule(Rule):
     STATUS.md drift apart silently as the surface grows (ROADMAP: CLI
     long tail, RPC surface). Two contracts: every `/v1/...` route in
     api/http.py must be referenced by a CLI command, the typed client,
-    or a test; every `ServerConfig.governor_*` knob must appear in
-    STATUS.md so operators can find it."""
+    or a test; every `ServerConfig.governor_*` / `plan_group_*` knob
+    must appear in STATUS.md so operators can find it."""
 
     name = "surface-drift"
     doc = "routes need CLI/test references; governor knobs in STATUS.md"
+
+    # ServerConfig knob families that must appear in the STATUS.md knob
+    # table (operators find them there; the table is the contract)
+    KNOB_PREFIXES = ("governor_", "plan_group_")
 
     def __init__(self,
                  http_path: str = "nomad_tpu/api/http.py",
@@ -623,8 +628,8 @@ class SurfaceDriftRule(Rule):
                 elif isinstance(stmt, ast.Assign) and \
                         isinstance(stmt.targets[0], ast.Name):
                     target = stmt.targets[0].id
-                if target and target.startswith("governor_") and \
-                        target not in status:
+                if target and target.startswith(self.KNOB_PREFIXES) \
+                        and target not in status:
                     yield ctx.finding(
                         self.name, stmt,
                         f"ServerConfig.{target} is not documented in "

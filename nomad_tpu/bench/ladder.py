@@ -226,9 +226,13 @@ def bench_broker_service(n_nodes: int = 10000, n_jobs: int = 64,
             wall = time.perf_counter() - t0
             placed = sum(len(s.store.allocs_by_job("default", j.id))
                          for j in jobs)
+            ga = s.plan_applier.stats
             return {"rate": placed / wall, "placed": placed,
                     "wall_s": wall,
-                    "batches": sum(w.stats["batches"] for w in s.workers)}
+                    "batches": sum(w.stats["batches"] for w in s.workers),
+                    "plan_groups": ga["groups"],
+                    "plan_group_plans": ga["plans"],
+                    "plan_group_conflicts": ga["conflict_retries"]}
         finally:
             s.shutdown()
 
@@ -265,6 +269,15 @@ def bench_broker_service(n_nodes: int = 10000, n_jobs: int = 64,
         "service_broker_seq_placements_per_sec": round(solo["rate"], 1),
         "service_batching_speedup": round(
             batched["rate"] / max(solo["rate"], 1e-9), 2),
+        # group-commit visibility for THIS burst scenario (the queue
+        # depth a deployment wave builds is exactly the grouping
+        # opportunity): mean plans per commit over both runs
+        "service_broker_plan_group_mean_size": round(
+            (batched["plan_group_plans"] + solo["plan_group_plans"])
+            / max(batched["plan_groups"] + solo["plan_groups"], 1), 2),
+        "service_broker_plan_group_conflicts":
+            batched["plan_group_conflicts"]
+            + solo["plan_group_conflicts"],
     }
 
 

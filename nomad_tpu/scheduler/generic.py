@@ -341,6 +341,7 @@ class GenericScheduler:
         engine.by_dc = {node.datacenter: 1}
         engine._base_mask = t.ready.copy()
         engine._mask_cache = {}
+        engine._dc_key = None       # private table: no cross-eval cache
         engine._net_cache = {}
         engine._dev_cache = {}
         mask, _counts = engine.feasibility(tg)

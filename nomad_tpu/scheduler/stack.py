@@ -16,6 +16,7 @@ placement (stack.go:321-411), this engine:
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -33,6 +34,71 @@ from ..ops import NodeTable, ProposedIndex, SelectKernel, SelectRequest
 from ..ops.select import TOP_K
 from ..ops.tables import DIM_NAMES
 from ..ops.targets import affinity_columns, constraint_mask
+
+
+# -- cross-eval host-phase reuse (group-commit PR, tentpole part 2) ----
+#
+# Every eval builds a fresh PlacementEngine, and before this cache the
+# per-eval host phase re-derived state that is pure function of
+# (job version, task group, node table): the content-addressed static
+# key (a walk over every constraint/driver/volume/device ask), the
+# group ask vector, the port asks, and the combined static-feasibility
+# mask + filter counts. The common case — many evals for the SAME job
+# (deployments, batch dispatch, drains) — pays that walk every time.
+#
+# Two layers:
+#   - _ENGINE_CACHE: (namespace, job_id, job_version, tg_name) ->
+#     _EngineEntry{static_key, group_ask, port_asks}, pinned to the
+#     exact Job object (the store serves one instance per version;
+#     `entry.job is job` makes id-recycling and cross-store collisions
+#     impossible — a different object with the same key recomputes).
+#   - the combined (mask, counts) feasibility result, cached on the
+#     TABLE's mask_cache keyed by (static key, datacenters). That dict
+#     is shared across delta clones (node attribute/ready columns are
+#     shared) and replaced on every node-set rebuild, i.e. exactly
+#     when NodeTableCache epoch-bumps the (mirror, version) token —
+#     invalidation rides the resident table's own lifecycle.
+#
+# ENGINE_CACHE_STATS feeds the bench artifact's engine-reuse hit rate
+# and the governor's `engine_cache.entries` gauge.
+
+ENGINE_CACHE_MAX = 4096
+
+_ENGINE_CACHE: Dict[Tuple, "_EngineEntry"] = {}
+_ENGINE_CACHE_L = threading.Lock()
+
+ENGINE_CACHE_STATS: Dict[str, int] = {
+    "entry_hits": 0, "entry_misses": 0,
+    "mask_hits": 0, "mask_misses": 0,
+    # feasibility calls on private tables (_dc_key is None): no
+    # cross-eval cache exists there, so they are neither hits nor
+    # misses — counting them as misses would deflate the hit rate the
+    # ROADMAP's TPU validation reads
+    "mask_uncached": 0,
+}
+
+
+class _EngineEntry:
+    __slots__ = ("job", "static_key", "group_ask", "port_asks")
+
+    def __init__(self, job, static_key, group_ask, port_asks):
+        self.job = job
+        self.static_key = static_key
+        self.group_ask = group_ask
+        self.port_asks = port_asks
+
+
+def engine_cache_entries() -> int:
+    return len(_ENGINE_CACHE)
+
+
+def engine_cache_stats() -> Dict[str, int]:
+    return dict(ENGINE_CACHE_STATS)
+
+
+def clear_engine_cache() -> None:
+    with _ENGINE_CACHE_L:
+        _ENGINE_CACHE.clear()
 
 
 @dataclasses.dataclass
@@ -66,6 +132,10 @@ class PlacementEngine:
         # (server/worker.py BatchGateway)
         self.dispatch = self.kernel.select
         self._mask_cache: Dict[Tuple, np.ndarray] = {}
+        # datacenter key for the cross-eval combined-mask cache; None
+        # until set_nodes (set_node_list paths stay uncached — private
+        # tables don't outlive the eval anyway)
+        self._dc_key: Optional[Tuple] = None
         # per-eval NetworkIndex cache: shared across select_batch calls so
         # port offers stay consistent between task groups of one plan
         self._net_cache: Dict[str, NetworkIndex] = {}
@@ -87,6 +157,7 @@ class PlacementEngine:
         self.table = self.snapshot.node_table()
         mask, n_ready, by_dc = self.table.ready_in_dcs(datacenters)
         self._base_mask = mask
+        self._dc_key = tuple(datacenters)
         self.by_dc = dict(by_dc)
         return n_ready
 
@@ -106,6 +177,7 @@ class PlacementEngine:
                                                alloc)
         self.table.finalize()
         self._base_mask = self.table.ready.copy()
+        self._dc_key = None
         self.by_dc = {}
         for node in nodes:
             self.by_dc[node.datacenter] = self.by_dc.get(node.datacenter, 0) + 1
@@ -138,12 +210,40 @@ class PlacementEngine:
             for t in tg.tasks for r in t.resources.devices)
         return (drivers, cons, vols, devs)
 
-    def _static_checks(self, tg: TaskGroup) -> List[Tuple[str, np.ndarray]]:
+    def _engine_entry(self, tg: TaskGroup) -> _EngineEntry:
+        """Cross-eval static state for (job version, task group):
+        static key, group ask, port asks. Pinned to the exact Job
+        object — the store serves one instance per version, so a
+        different object with the same (ns, id, version) recomputes
+        rather than trusting a possibly-mutated spec."""
+        job = self.job
+        assert job is not None
+        key = (job.namespace, job.id, job.version, tg.name)
+        with _ENGINE_CACHE_L:
+            ent = _ENGINE_CACHE.get(key)
+            if ent is not None and ent.job is job:
+                ENGINE_CACHE_STATS["entry_hits"] += 1
+                return ent
+        ent = _EngineEntry(job, self._static_key(tg),
+                           self.group_ask(tg), self._port_asks(tg))
+        with _ENGINE_CACHE_L:
+            ENGINE_CACHE_STATS["entry_misses"] += 1
+            # FIFO eviction (the ops/tables._memo_insert idiom): a full
+            # clear would storm-recompute every active job's state
+            while len(_ENGINE_CACHE) >= ENGINE_CACHE_MAX:
+                _ENGINE_CACHE.pop(next(iter(_ENGINE_CACHE)))
+            _ENGINE_CACHE[key] = ent
+        return ent
+
+    def _static_checks(self, tg: TaskGroup,
+                       key: Optional[Tuple] = None
+                       ) -> List[Tuple[str, np.ndarray]]:
         """Ordered (reason, bool[N]) columns for drivers, constraints and
         host volumes — cached on the table version (cross-eval), since
         they depend only on node attributes."""
         t = self.table
-        key = self._static_key(tg)
+        if key is None:
+            key = self._static_key(tg)
         hit = t.mask_cache.get(key)
         if hit is not None:
             return hit
@@ -176,22 +276,41 @@ class PlacementEngine:
     def feasibility(self, tg: TaskGroup) -> Tuple[np.ndarray, Dict[str, int]]:
         """(mask bool[N], filtered_counts per constraint string).
         Vectorized FeasibilityWrapper (feasible.go:994-1134). Static
-        columns come from the cross-eval cache; the per-eval work is
-        masking them against ready-in-DC and counting."""
+        columns come from the cross-eval cache, and the COMBINED
+        mask+counts result is itself cached on the table keyed by
+        (static key, datacenters) — many evals for the same job skip
+        the whole masking pass, not just the column builds. Callers
+        must copy before mutating (select_batch does)."""
         key = (id(self.job), self.job.version, tg.name)
         cached = self._mask_cache.get(key)
         if cached is not None:
             return cached
+        ent = self._engine_entry(tg)
+        t = self.table
+        feas_key = None
+        if self._dc_key is not None:
+            feas_key = ("feasibility", ent.static_key, self._dc_key)
+            hit = t.mask_cache.get(feas_key)
+            if hit is not None:
+                ENGINE_CACHE_STATS["mask_hits"] += 1
+                self._mask_cache[key] = hit
+                return hit
+            ENGINE_CACHE_STATS["mask_misses"] += 1
+        else:
+            ENGINE_CACHE_STATS["mask_uncached"] += 1
         mask = self._base_mask.copy()
         counts: Dict[str, int] = {}
-        for reason, m in self._static_checks(tg):
+        for reason, m in self._static_checks(tg, ent.static_key):
             newly = mask & ~m
             n = int(newly.sum())
             if n:
                 counts[reason] = counts.get(reason, 0) + n
             mask &= m
-        self._mask_cache[key] = (mask, counts)
-        return mask, counts
+        out = (mask, counts)
+        if feas_key is not None:
+            t.mask_cache[feas_key] = out
+        self._mask_cache[key] = out
+        return out
 
     # -- ask construction ---------------------------------------------
     @staticmethod
@@ -299,6 +418,7 @@ class PlacementEngine:
         assert self.table is not None and self.job is not None
         t = self.table
         start = time.monotonic_ns()
+        ent = self._engine_entry(tg)
         mask, filtered_counts = self.feasibility(tg)
         mask = mask.copy()
         filtered_counts = dict(filtered_counts)
@@ -383,7 +503,7 @@ class PlacementEngine:
         if affinities:
             aff_col, aff_sum = affinity_columns(t.cols, affinities)
 
-        dyn_ports, reserved_ports = self._port_asks(tg)
+        dyn_ports, reserved_ports = ent.port_asks
         port_ok = t.reserved_ports_ok(reserved_ports) if reserved_ports else None
 
         # device columns (scheduler/devices.py): per-eval slot counts
@@ -439,7 +559,7 @@ class PlacementEngine:
             used_rows, used_deltas = proposed.used_sparse()
 
         req = SelectRequest(
-            ask=self.group_ask(tg),
+            ask=ent.group_ask,
             count=count,
             feasible=mask,
             capacity=t.capacity,

@@ -145,6 +145,17 @@ class ServerConfig:
     # eval N+1's host phase, and the resident table's device scatter
     # is dispatched right after the snapshot fence
     worker_pipeline: bool = True
+    # group-commit plan applier (plan_applier.py): max queued plans
+    # drained into ONE overlay-aware verify pass + ONE raft entry +
+    # ONE state-store transaction + ONE event flush. 1 restores the
+    # one-entry-per-plan pipeline; the NOMAD_TPU_PLAN_GROUP=0 env
+    # kill switch forces that at runtime (bisection)
+    plan_group_max: int = 32
+    # intra-group conflict demotions in the applier's 10s window above
+    # this shrink the group bound (reclaim halves it; a clean streak
+    # re-widens) instead of letting demoted plans thrash verify-retry
+    # round trips
+    governor_plan_group_conflict_high: int = 64
 
 
 class Server:
@@ -396,6 +407,29 @@ class Server:
         # requeue heap depth — when admission deferral itself backs up,
         # the HTTP register path starts shedding with 429s
         gov.register("broker.delayed_depth", broker.delayed_depth)
+
+        # group-commit plan applier (plan_applier.py): group sizing and
+        # intra-group conflict visibility. The conflict gauge reads a
+        # sliding 10s window (a monotone total would latch the
+        # watermark over forever); its reclaim SHRINKS the group bound
+        # so optimistic siblings stop trampling each other, and the
+        # applier re-widens after a clean streak
+        applier = self.plan_applier
+        gov.register("plan_group.size", applier.mean_group_size,
+                     suspect=False)
+        gov.register("plan_group.conflict_retries",
+                     applier.conflict_pressure,
+                     WatermarkPolicy(
+                         cfg.governor_plan_group_conflict_high),
+                     reclaim=applier.shrink_group_bound, suspect=False)
+        gov.register("plan_group.singleton_fallbacks",
+                     lambda: applier.stats["singleton_fallbacks"],
+                     suspect=False)
+
+        # cross-eval engine host-phase reuse (scheduler/stack.py):
+        # bounded keyed cache of per-(job, task-group) static state
+        from ..scheduler.stack import engine_cache_entries
+        gov.register("engine_cache.entries", engine_cache_entries)
 
         # recompile visibility (analysis/sanitizer.py): distinct
         # compiled trace signatures across every kernel arm — a
@@ -844,6 +878,15 @@ class Server:
             evals=p.get("evals"),
         )
         self._reconcile_job_statuses(index, p)
+
+    def _apply_plan_group_results(self, index: int, p: dict) -> None:
+        """One committed entry carrying a whole plan GROUP (the
+        group-commit applier): N verified plans land as ONE state-store
+        transaction — a single layer push instead of N — and publish
+        their change events in one flush."""
+        self.store.upsert_plan_group_results(index, p["groups"])
+        for g in p["groups"]:
+            self._reconcile_job_statuses(index, g)
 
     def _apply_scheduler_config(self, index: int, p: dict) -> None:
         self.store.set_scheduler_config(index, p["config"])

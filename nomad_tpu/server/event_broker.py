@@ -262,6 +262,12 @@ def events_from_apply(msg_type: str, payload: dict, index: int) -> List[Event]:
     elif msg_type == "alloc_desired_transition":
         for aid in payload.get("alloc_ids", []):
             add(TOPIC_ALLOC, "AllocationUpdateDesiredStatus", aid)
+    elif msg_type == "plan_group_results":
+        # one committed entry, one flush: every group member's events
+        # publish together (the per-plan event flush was part of the
+        # per-eval host tax the group-commit applier amortizes)
+        for g in payload.get("groups", []):
+            out.extend(events_from_apply("plan_results", g, index))
     elif msg_type == "plan_results":
         for a in payload.get("allocs_placed", []):
             add(TOPIC_ALLOC, "PlanResult", a.id, a.namespace)

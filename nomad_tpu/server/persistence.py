@@ -44,6 +44,9 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
                      "deployment": Deployment,
                      "deployment_updates": [DeploymentStatusUpdate],
                      "evals": [Evaluation]},
+    # group-commit applier: one entry carrying N plan_results payloads
+    # (encode/decode recurse per group member — see below)
+    "plan_group_results": {},
     "scheduler_config": {"config": SchedulerConfiguration},
     "deployment_status_update": {"update": DeploymentStatusUpdate,
                                  "job": Job, "evals": [Evaluation]},
@@ -96,6 +99,9 @@ _register_acl_schemas()
 
 
 def encode_payload(msg_type: str, payload: dict) -> dict:
+    if msg_type == "plan_group_results":
+        return {"groups": [encode_payload("plan_results", g)
+                           for g in payload.get("groups", [])]}
     out = {}
     for k, v in payload.items():
         out[k] = to_wire(v)
@@ -103,6 +109,9 @@ def encode_payload(msg_type: str, payload: dict) -> dict:
 
 
 def decode_payload(msg_type: str, data: dict) -> dict:
+    if msg_type == "plan_group_results":
+        return {"groups": [decode_payload("plan_results", g)
+                           for g in data.get("groups", [])]}
     schema = SCHEMAS.get(msg_type, {})
     out: dict = {}
     for k, v in data.items():

@@ -13,19 +13,63 @@ verifying N+1 (the reference applies the result to its private
 snapshot for exactly this reason). Verification batches all touched
 nodes at once (the EvaluatePool:NumCPU/2 goroutines become one
 vectorized pass).
+
+GROUP COMMIT (the r9 departure from the reference): where
+plan_apply.go pops ONE plan per iteration, this applier drains every
+queued plan — bounded by `ServerConfig.plan_group_max` — and commits
+the whole group as ONE raft entry ("plan_group_results"), ONE state
+store transaction (a single LayerMap layer push instead of N), and ONE
+event-broker flush, with per-plan results demultiplexed back onto each
+submitter's future. Verification stays order-equivalent to sequential
+apply: all plans verify against one snapshot, and each later plan sees
+the earlier group members' node claims through the same overlay
+mechanism the pipelined commit already uses — an intra-group loser
+demotes to a partial result exactly as a stale-snapshot retry would,
+with its refresh fence pointed at the group's commit index so the
+retry sees why it lost. `plan_group_max=1` or `NOMAD_TPU_PLAN_GROUP=0`
+reproduce the one-entry-per-plan path bit for bit (the bisection
+escape hatch); the governor shrinks the group bound under conflict
+churn (`governor_plan_group_conflict_high`) and re-widens it after a
+clean streak.
 """
 
 from __future__ import annotations
 
+import os
 import threading
-from typing import Dict, List, Optional
+import time as _time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
 
 from ..models import (
     Allocation, AllocsFit, Evaluation, Plan, PlanResult,
     EVAL_STATUS_PENDING,
 )
 from ..models.evaluation import TRIGGER_PREEMPTION
-from .plan_queue import PlanQueue
+from .plan_queue import PendingPlan, PlanQueue
+
+PLAN_GROUP_ENV = "NOMAD_TPU_PLAN_GROUP"
+
+# conflict-churn accounting: intra-group demotions within this window
+# feed the `plan_group.conflict_retries` governor gauge, whose
+# watermark shrinks the group bound instead of letting retries thrash
+CONFLICT_WINDOW_S = 10.0
+# consecutive conflict-free groups before a shrunk bound re-widens
+GROUP_RECOVER_CLEAN = 32
+
+# process-wide accounting (the BUILD_STATS idiom): bench.py reads this
+# after a run so group sizing is attributable across every server the
+# bench spun up. Written only by applier threads; racy reads are fine.
+GROUP_STATS: Dict[str, int] = {
+    "groups": 0, "plans": 0, "conflict_retries": 0,
+    "singleton_fallbacks": 0, "max_size": 0,
+}
+
+
+def group_commit_enabled() -> bool:
+    """The bisection escape hatch: NOMAD_TPU_PLAN_GROUP=0 forces the
+    one-raft-entry-per-plan path regardless of plan_group_max."""
+    return os.environ.get(PLAN_GROUP_ENV, "1") not in ("0", "off", "no")
 
 
 class PlanApplier:
@@ -35,12 +79,14 @@ class PlanApplier:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._committer: Optional[threading.Thread] = None
-        # (future, result, waiter) handed from the verify/apply loop to
-        # the committer. maxsize=1 bounds the pipeline to ONE in-flight
-        # commit, matching the reference's overlap of exactly plan N's
-        # raft apply with plan N+1's verification (plan_apply.go:56-70);
-        # without the bound a partitioned leader would stack local-only
-        # applies and serve each submitter its 10s failure in series
+        # (pairs, waiter, group index) handed from the verify/apply
+        # loop to the committer; pairs is [(future, result)] for one
+        # plan OR one whole group. maxsize=1 bounds the pipeline to ONE
+        # in-flight commit, matching the reference's overlap of exactly
+        # plan N's raft apply with plan N+1's verification
+        # (plan_apply.go:56-70); without the bound a partitioned leader
+        # would stack local-only applies and serve each submitter its
+        # 10s failure in series
         self._commit_q = None
         # submitted-but-not-yet-applied plan results (applier thread
         # only): with apply-at-commit the store lags the log, so N+1's
@@ -52,6 +98,18 @@ class PlanApplier:
         # commit and must keep occupying capacity until applied
         self._failed_pending: set = set()
         self._failed_l = threading.Lock()
+        # per-applier group accounting (the governor gauges read these;
+        # GROUP_STATS above is the cross-server bench aggregate)
+        self.stats: Dict[str, int] = {
+            "groups": 0, "plans": 0, "conflict_retries": 0,
+            "singleton_fallbacks": 0,
+        }
+        # adaptive group bound: None == config max; the governor's
+        # conflict watermark halves it, clean streaks re-widen it
+        self._group_bound: Optional[int] = None
+        self._clean_groups = 0
+        self._conflicts: deque = deque()
+        self._conflict_l = threading.Lock()
 
     def start(self) -> None:
         import queue as queue_mod
@@ -88,38 +146,134 @@ class PlanApplier:
                     break
                 if item is None:
                     continue
-                future, _r, _w = item
-                if not future.done():
-                    future.set_exception(
-                        RuntimeError("plan applier stopped"))
+                pairs, _w, _gi = item
+                for future, _r in pairs:
+                    if not future.done():
+                        future.set_exception(
+                            RuntimeError("plan applier stopped"))
 
+    # -- group sizing / governor hooks ---------------------------------
+    def effective_group_bound(self) -> int:
+        """Current drain bound: the config max, shrunk by the
+        governor's conflict reclaim, 1 when the env kill switch is
+        thrown (bisection)."""
+        if not group_commit_enabled():
+            return 1
+        cfg = max(1, int(getattr(self.server.config,
+                                 "plan_group_max", 1) or 1))
+        b = self._group_bound
+        return cfg if b is None else max(1, min(b, cfg))
+
+    def mean_group_size(self) -> float:
+        g = self.stats["groups"]
+        return self.stats["plans"] / g if g else 0.0
+
+    def conflict_pressure(self) -> int:
+        """Intra-group demotions within the sliding window — the
+        governor gauge the conflict watermark reads (a monotone total
+        would cross once and latch over forever)."""
+        now = _time.monotonic()
+        with self._conflict_l:
+            while self._conflicts and \
+                    now - self._conflicts[0] > CONFLICT_WINDOW_S:
+                self._conflicts.popleft()
+            return len(self._conflicts)
+
+    def shrink_group_bound(self) -> dict:
+        """Governor reclaim for `governor_plan_group_conflict_high`:
+        halve the group bound so optimistic siblings stop trampling
+        each other, instead of letting every demoted plan burn a
+        verify-retry round trip. Recovery is automatic (_note_group)."""
+        cfg = max(1, int(getattr(self.server.config,
+                                 "plan_group_max", 1) or 1))
+        cur = self._group_bound if self._group_bound is not None else cfg
+        self._group_bound = max(1, cur // 2)
+        self._clean_groups = 0
+        return {"plan_group_bound": self._group_bound, "was": cur}
+
+    def _note_group(self, size: int, conflicts: int,
+                    singleton: bool = False) -> None:
+        self.stats["groups"] += 1
+        self.stats["plans"] += size
+        GROUP_STATS["groups"] += 1
+        GROUP_STATS["plans"] += size
+        if size > GROUP_STATS["max_size"]:
+            GROUP_STATS["max_size"] = size
+        if singleton:
+            self.stats["singleton_fallbacks"] += 1
+            GROUP_STATS["singleton_fallbacks"] += 1
+        if conflicts:
+            self.stats["conflict_retries"] += conflicts
+            GROUP_STATS["conflict_retries"] += conflicts
+            now = _time.monotonic()
+            with self._conflict_l:
+                self._conflicts.extend([now] * conflicts)
+            self._clean_groups = 0
+        else:
+            self._clean_groups += 1
+            if self._group_bound is not None and \
+                    self._clean_groups >= GROUP_RECOVER_CLEAN:
+                self._clean_groups = 0
+                cfg = max(1, int(getattr(self.server.config,
+                                         "plan_group_max", 1) or 1))
+                widened = min(cfg, self._group_bound * 2)
+                self._group_bound = None if widened >= cfg else widened
+
+    # -- the applier loop ----------------------------------------------
     def _run(self) -> None:
         while not self._stop.is_set():
-            pending = self.queue.dequeue(timeout_s=0.2)
-            if pending is None:
+            bound = self.effective_group_bound()
+            if bound > 1:
+                group = self.queue.dequeue_group(bound, timeout_s=0.2)
+            else:
+                pending = self.queue.dequeue(timeout_s=0.2)
+                group = [pending] if pending is not None else []
+            if not group:
                 continue
-            try:
-                result, waiter = self.apply(pending.plan)
-            except Exception as e:      # pragma: no cover - defensive
-                pending.future.set_exception(e)
-                continue
+            if len(group) == 1:
+                # the escape hatch AND the idle-queue common case: one
+                # plan commits through the unchanged singleton path
+                # ("plan_results" raft entries), so plan_group_max=1 /
+                # NOMAD_TPU_PLAN_GROUP=0 reproduce the r8 pipeline
+                pending = group[0]
+                try:
+                    result, waiter = self.apply(pending.plan)
+                except Exception as e:
+                    pending.future.set_exception(e)
+                    continue
+                self._note_group(1, 0, singleton=True)
+                item = ([(pending.future, result)], waiter,
+                        result.alloc_index)
+            else:
+                try:
+                    pairs, waiter, index = self.apply_group(group)
+                except Exception as e:  # pragma: no cover - defensive
+                    for pending in group:
+                        if not pending.future.done():
+                            pending.future.set_exception(e)
+                    continue
+                if not pairs:
+                    continue
+                item = (pairs, waiter, index)
             # hand the quorum wait to the committer and move on to
-            # verifying the next plan (pipelined commit); blocks while
+            # verifying the next group (pipelined commit); blocks while
             # one commit is already in flight (bounded pipeline)
             placed = False
             while not self._stop.is_set():
                 try:
-                    self._commit_q.put((pending.future, result, waiter),
-                                       timeout=0.2)
+                    self._commit_q.put(item, timeout=0.2)
                     placed = True
                     break
                 except Exception:
                     continue
-            if not placed and not pending.future.done():
-                pending.future.set_exception(
-                    RuntimeError("plan applier stopped"))
+            if not placed:
+                for future, _r in item[0]:
+                    if not future.done():
+                        future.set_exception(
+                            RuntimeError("plan applier stopped"))
 
     def _commit_loop(self) -> None:
+        from ..utils import stages
         while True:
             try:
                 item = self._commit_q.get(timeout=0.2)
@@ -129,34 +283,41 @@ class PlanApplier:
                 continue
             if item is None:            # shutdown sentinel
                 return
-            future, result, waiter = item
+            pairs, waiter, group_index = item
             try:
                 if waiter is not None:
+                    c0 = _time.perf_counter() if stages.enabled else 0.0
                     waiter()
-                future.set_result(result)
+                    if stages.enabled:
+                        stages.add("plan_commit",
+                                   _time.perf_counter() - c0)
+                # demultiplex: every submitter gets ITS result off the
+                # one group commit, in submission order
+                for future, result in pairs:
+                    if not future.done():
+                        future.set_result(result)
             except Exception as e:
                 # quorum unreachable / leadership lost: the submitting
-                # worker sees the failure and nacks its eval; THIS
-                # plan's overlay must not keep rejecting capacity
-                # forever (siblings may still commit — they stay)
+                # workers see the failure and nack their evals; THIS
+                # group's overlay must not keep rejecting capacity
+                # forever (siblings already in flight stay)
                 with self._failed_l:
-                    self._failed_pending.add(result.alloc_index)
-                future.set_exception(e)
+                    if group_index:
+                        self._failed_pending.add(group_index)
+                for future, _result in pairs:
+                    if not future.done():
+                        future.set_exception(e)
 
     # -- the core ------------------------------------------------------
     def apply(self, plan: Plan):
-        """Verify + locally apply one plan. Returns (result, waiter);
+        """Verify + locally apply ONE plan. Returns (result, waiter);
         waiter is None or a callable blocking until quorum commit. The
         synchronous test/tool entry `apply_sync` folds the wait in."""
-        import time as _time
-        from ..utils import metrics, stages
+        from ..utils import metrics
         _t0 = _time.monotonic()
-        _p0 = _time.perf_counter() if stages.enabled else 0.0
         try:
             return self._apply(plan)
         finally:
-            if stages.enabled:
-                stages.add("plan_apply", _time.perf_counter() - _p0)
             metrics.measure_since("nomad.plan.evaluate", _t0)
             metrics.incr_counter("nomad.plan.apply")
 
@@ -167,11 +328,110 @@ class PlanApplier:
         return result
 
     def _apply(self, plan: Plan):
-        # token fence (plan_queue admission in the reference): a plan
-        # whose eval has been re-delivered (nack timeout mid-process)
-        # carries a stale token — committing it would double-place the
-        # job alongside the new holder's plan. Plans from test harness
-        # paths carry no outstanding eval and pass through.
+        from ..utils import stages
+        self._check_token(plan)
+        store = self.server.store
+        snapshot = store.snapshot()
+        self._retire_pending(snapshot)
+        _v0 = _time.perf_counter() if stages.enabled else 0.0
+        result, payload, evals, _conflicted = self._verify(snapshot,
+                                                           plan, ())
+        if stages.enabled:
+            stages.add("plan_verify", _time.perf_counter() - _v0)
+        if payload is None:
+            return result, None
+
+        # commit through the raft shim (FSM ApplyPlanResults)
+        _c0 = _time.perf_counter() if stages.enabled else 0.0
+        index, waiter = self.server.raft_apply_async(
+            "plan_results", payload)
+        result.alloc_index = index
+        if waiter is not None:
+            # apply-at-commit: the store won't show this plan until the
+            # committer's waiter resolves — overlay it for the next
+            # verification round
+            self._pending.append((index, result))
+        for ev in evals:
+            self.server.enqueue_eval(ev)
+        if stages.enabled:
+            stages.add("plan_commit", _time.perf_counter() - _c0)
+        return result, waiter
+
+    def apply_group(self, group: List[PendingPlan]):
+        """Group commit: verify every plan in `group` against ONE
+        snapshot — later plans see earlier members' claims through the
+        pending-plan overlay, so an intra-group loser demotes to a
+        partial result exactly as a stale-snapshot retry would — then
+        commit all survivors as ONE raft entry / store transaction /
+        event flush. Returns (pairs, waiter, group_index) where pairs
+        is [(future, result)] in submission order; futures are resolved
+        by the committer, not here. A plan failing the token fence
+        fails only its own future and drops out of the group."""
+        from ..utils import metrics, stages
+        _t0 = _time.monotonic()
+        _v0 = _time.perf_counter() if stages.enabled else 0.0
+        store = self.server.store
+        snapshot = store.snapshot()
+        self._retire_pending(snapshot)
+
+        entries: List[Tuple] = []       # (pending, result, payload, evals)
+        accepted: List[PlanResult] = []
+        conflicts = 0
+        for pending in group:
+            plan = pending.plan
+            try:
+                self._check_token(plan)
+                result, payload, evals, conflicted = self._verify(
+                    snapshot, plan, accepted)
+            except Exception as e:
+                if not pending.future.done():
+                    pending.future.set_exception(e)
+                continue
+            if conflicted:
+                conflicts += 1
+            entries.append((pending, result, payload, evals))
+            if payload is not None:
+                accepted.append(result)
+            metrics.incr_counter("nomad.plan.apply")
+        metrics.measure_since("nomad.plan.evaluate", _t0)
+        if stages.enabled:
+            stages.add("plan_verify", _time.perf_counter() - _v0)
+        self._note_group(len(group), conflicts)
+
+        pairs = [(pending.future, result)
+                 for (pending, result, _p, _e) in entries]
+        payloads = [p for (_pe, _r, p, _e) in entries if p is not None]
+        if not payloads:
+            return pairs, None, 0
+
+        _c0 = _time.perf_counter() if stages.enabled else 0.0
+        index, waiter = self.server.raft_apply_async(
+            "plan_group_results", dict(groups=payloads))
+        for _pending, result, payload, _evs in entries:
+            if payload is not None:
+                result.alloc_index = index
+                if waiter is not None:
+                    self._pending.append((index, result))
+            if result.refresh_index:
+                # a demoted plan's missing capacity becomes visible at
+                # the GROUP's commit index, not the snapshot's — point
+                # the worker's refresh fence there so the retry sees
+                # why it lost instead of replaying the same conflict
+                result.refresh_index = max(result.refresh_index, index)
+        for _pending, _result, _payload, evals in entries:
+            for ev in evals:
+                self.server.enqueue_eval(ev)
+        if stages.enabled:
+            stages.add("plan_commit", _time.perf_counter() - _c0)
+        return pairs, waiter, index
+
+    # -- verification --------------------------------------------------
+    def _check_token(self, plan: Plan) -> None:
+        """Token fence (plan_queue admission in the reference): a plan
+        whose eval has been re-delivered (nack timeout mid-process)
+        carries a stale token — committing it would double-place the
+        job alongside the new holder's plan. Plans from test harness
+        paths carry no outstanding eval and pass through."""
         if plan.eval_id and plan.eval_token:
             # tokens come only from worker dequeues, so a tokened plan
             # must still hold the delivery: token mismatch OR a no-
@@ -182,23 +442,39 @@ class PlanApplier:
                 raise RuntimeError(
                     f"plan for eval {plan.eval_id} submitted with stale "
                     "token; evaluation was re-delivered")
-        store = self.server.store
-        snapshot = store.snapshot()
-        # retire overlay entries the FSM has applied (visible in the
-        # snapshot now) or whose commit failed
+
+    def _retire_pending(self, snapshot) -> None:
+        """Retire overlay entries the FSM has applied (visible in the
+        snapshot now) or whose commit failed. The snapshot is an
+        immutable MVCC root, so an entry kept here can never ALSO be
+        visible in it — no double counting."""
         with self._failed_l:
             failed, self._failed_pending = self._failed_pending, set()
         latest = snapshot.latest_index()
         self._pending = [(i, r) for (i, r) in self._pending
                          if i > latest and i not in failed]
 
+    def _verify(self, snapshot, plan: Plan, extra):
+        """Verify one plan against `snapshot` + the submitted-but-
+        unapplied overlay (self._pending) + `extra` (accepted results
+        of earlier plans in the same group). Returns (result, payload,
+        follow_up_evals, conflicted): payload is None for a no-op
+        result; conflicted means a rejection touched a node an `extra`
+        result claimed — an intra-group demotion the submitting worker
+        will retry."""
         result = PlanResult()
         rejected = False
 
         # verify each touched node (evaluatePlan / evaluateNodePlan) —
         # one columnar pass over the resident node table for the common
         # shape, scalar fallback for nodes with removals/ports/devices
-        verdicts = self._evaluate_nodes(snapshot, plan)
+        verdicts = self._evaluate_nodes(snapshot, plan, extra)
+        conflict_nodes = set()
+        for r in extra:
+            conflict_nodes.update(r.node_allocation)
+            conflict_nodes.update(r.node_update)
+            conflict_nodes.update(r.node_preemptions)
+        conflicted = False
         n_rejected = 0
         for node_id, placements in plan.node_allocation.items():
             if verdicts[node_id]:
@@ -206,6 +482,8 @@ class PlanApplier:
             else:
                 rejected = True
                 n_rejected += len(placements)
+                if node_id in conflict_nodes:
+                    conflicted = True
         if n_rejected:
             from ..utils import metrics
             metrics.incr_counter("nomad.plan.node_rejected", n_rejected)
@@ -215,7 +493,9 @@ class PlanApplier:
         # more write claimants than the volume's access mode admits
         # (csi.go WriteFreeClaims:385; claims apply per-placement)
         csi_rejected = self._enforce_csi_write_caps(
-            snapshot, plan, result.node_allocation)
+            snapshot, plan, result.node_allocation, extra)
+        if csi_rejected and extra:
+            conflicted = True
         rejected = rejected or csi_rejected
         # stops are always committable; preemptions commit only when the
         # placement they made room for was accepted — otherwise victims
@@ -231,10 +511,10 @@ class PlanApplier:
         if rejected:
             result.refresh_index = snapshot.latest_index()
         if result.is_no_op():
-            return result, None
+            return result, None, [], conflicted
 
-        # commit through the raft shim (FSM ApplyPlanResults)
-        stopped = [a for allocs in result.node_update.values() for a in allocs]
+        stopped = [a for allocs in result.node_update.values()
+                   for a in allocs]
         placed = [a for allocs in result.node_allocation.values()
                   for a in allocs]
         preempted = [a for allocs in result.node_preemptions.values()
@@ -263,23 +543,23 @@ class PlanApplier:
                 type=job.type, triggered_by=TRIGGER_PREEMPTION,
                 job_id=job.id, status=EVAL_STATUS_PENDING))
 
-        index, waiter = self.server.raft_apply_async(
-            "plan_results",
-            dict(allocs_stopped=stopped, allocs_placed=placed,
-                 allocs_preempted=preempted, deployment=result.deployment,
-                 deployment_updates=result.deployment_updates, evals=evals))
-        result.alloc_index = index
-        if waiter is not None:
-            # apply-at-commit: the store won't show this plan until the
-            # committer's waiter resolves — overlay it for the next
-            # verification round
-            self._pending.append((index, result))
-        for ev in evals:
-            self.server.enqueue_eval(ev)
-        return result, waiter
+        payload = dict(allocs_stopped=stopped, allocs_placed=placed,
+                       allocs_preempted=preempted,
+                       deployment=result.deployment,
+                       deployment_updates=result.deployment_updates,
+                       evals=evals)
+        return result, payload, evals, conflicted
+
+    def _overlay_results(self, extra) -> List[PlanResult]:
+        """Submitted-but-unapplied results PLUS earlier same-group
+        results — everything whose claims the snapshot cannot show."""
+        out = [r for _i, r in self._pending]
+        out.extend(extra)
+        return out
 
     def _enforce_csi_write_caps(self, snapshot, plan: Plan,
-                                node_allocation: Dict[str, List]) -> bool:
+                                node_allocation: Dict[str, List],
+                                extra=()) -> bool:
         """Drop placements whose CSI write claims would exceed the
         volume's access mode, budgeting across the whole plan. Mutates
         node_allocation in place; returns True if anything was dropped
@@ -287,8 +567,9 @@ class PlanApplier:
         from ..models.csi import (ACCESS_MULTI_NODE_SINGLE_WRITER,
                                   ACCESS_SINGLE_NODE_WRITER)
         budgets: Dict = {}          # (ns, vol_id) -> free write slots
-        # submitted-but-unapplied plans already hold their write slots
-        for _idx, pres in self._pending:
+        # submitted-but-unapplied plans (and earlier plans of this
+        # group) already hold their write slots
+        for pres in self._overlay_results(extra):
             for allocs in pres.node_allocation.values():
                 for pa in allocs:
                     pjob = pa.job or snapshot.job_by_id(pa.namespace,
@@ -368,14 +649,16 @@ class PlanApplier:
         memo[id(res)] = (has_net, has_dev, res)
         return has_net, has_dev
 
-    def _evaluate_nodes(self, snapshot, plan: Plan) -> Dict[str, bool]:
+    def _evaluate_nodes(self, snapshot, plan: Plan,
+                        extra=()) -> Dict[str, bool]:
         """Batched evaluateNodePlan: the reference fans node checks to
         an EvaluatePool of goroutines (plan_apply.go:400); here the
         resident node table turns the common case — placements with no
         removals, ports, or devices on a ready node — into one
         vectorized usage-delta + capacity compare. A 10k-node plan
         verifies in ~50 ms instead of ~10 s of per-node alloc summing.
-        Nodes outside the fast shape use the scalar path unchanged."""
+        Nodes outside the fast shape use the scalar path unchanged.
+        `extra` carries earlier same-group results (group commit)."""
         import numpy as np
 
         from ..ops.tables import _alloc_usage
@@ -394,21 +677,24 @@ class PlanApplier:
         if table is None:
             for node_id, _p in items:
                 out[node_id] = self._evaluate_node(snapshot, plan,
-                                                   node_id)
+                                                   node_id, extra)
             return out
 
-        # overlay usage per node from submitted-but-unapplied plans,
-        # kept per alloc id: a placement in THIS plan that re-uses an
-        # overlay alloc's id supersedes it (the scalar path's
-        # placed_ids exclusion), so its overlay usage must not also
-        # count
-        overlay_usage: Dict[str, List[tuple]] = {}
+        # overlay usage per node from submitted-but-unapplied plans
+        # AND earlier group members, kept per alloc id LAST-WRITE-WINS:
+        # an in-place update in the overlay supersedes both the
+        # snapshot's copy (subtracted below) and any earlier overlay
+        # copy of the same alloc, and a placement in THIS plan that
+        # re-uses an overlay alloc's id supersedes it too (the scalar
+        # path's placed_ids exclusion) — otherwise the node double-
+        # counts one alloc's resources across its versions
+        overlay_usage: Dict[str, Dict[str, tuple]] = {}
         overlay_flags: Dict[str, bool] = {}
-        for _idx, pres in self._pending:
+        for pres in self._overlay_results(extra):
             for node_id, adds in pres.node_allocation.items():
-                rows = overlay_usage.setdefault(node_id, [])
+                rows = overlay_usage.setdefault(node_id, {})
                 for a in adds:
-                    rows.append((a.id, _alloc_usage(a)))
+                    rows[a.id] = _alloc_usage(a)
                     hn, hd = self._res_flags(a)
                     if hn or hd:
                         overlay_flags[node_id] = True
@@ -432,7 +718,7 @@ class PlanApplier:
                     or (node.node_resources is not None
                         and node.node_resources.devices):
                 out[node_id] = self._evaluate_node(snapshot, plan,
-                                                   node_id)
+                                                   node_id, extra)
                 continue
             d0 = d1 = d2 = d3 = 0.0
             ok = True
@@ -456,18 +742,27 @@ class PlanApplier:
                     d3 -= ou[3]
             if not ok:
                 out[node_id] = self._evaluate_node(snapshot, plan,
-                                                   node_id)
+                                                   node_id, extra)
                 continue
             ov = overlay_usage.get(node_id)
             if ov is not None:
                 placed_ids = {p.id for p in placements}
-                for aid, u in ov:
+                for aid, u in ov.items():
                     if aid in placed_ids:
                         continue
                     d0 += u[0]
                     d1 += u[1]
                     d2 += u[2]
                     d3 += u[3]
+                    old = alloc_by_id(aid)
+                    if old is not None and not old.terminal_status():
+                        # overlay in-place update: the snapshot's live
+                        # copy is superseded at commit
+                        ou = _alloc_usage(old)
+                        d0 -= ou[0]
+                        d1 -= ou[1]
+                        d2 -= ou[2]
+                        d3 -= ou[3]
             cand_idx.append(i)
             cand_nodes.append(node_id)
             deltas.append((d0, d1, d2, d3))
@@ -481,7 +776,8 @@ class PlanApplier:
                 out[node_id] = bool(fit)
         return out
 
-    def _evaluate_node(self, snapshot, plan: Plan, node_id: str) -> bool:
+    def _evaluate_node(self, snapshot, plan: Plan, node_id: str,
+                       extra=()) -> bool:
         """evaluateNodePlan (plan_apply.go:629): would this node's
         placements fit against the freshest state?"""
         node = snapshot.node_by_id(node_id)
@@ -501,20 +797,26 @@ class PlanApplier:
         # node double-counts its resources (plan_apply.go:674-678).
         placements = plan.node_allocation.get(node_id, [])
         remove_ids |= {a.id for a in placements}
-        # overlay submitted-but-unapplied plans (pipelined commit):
-        # their placements occupy capacity, their stops/preemptions
-        # free it
-        overlay_add = []
-        for _idx, pres in self._pending:
-            remove_ids |= {a.id for a in pres.node_update.get(node_id, [])}
-            remove_ids |= {a.id
-                           for a in pres.node_preemptions.get(node_id, [])}
-            overlay_add.extend(pres.node_allocation.get(node_id, []))
+        # overlay submitted-but-unapplied plans (pipelined commit) and
+        # earlier same-group results (group commit): their placements
+        # occupy capacity, their stops/preemptions free it. Last write
+        # wins per alloc id IN COMMIT ORDER — an overlay in-place
+        # update supersedes the snapshot's copy and any earlier overlay
+        # copy, exactly what the FSM will do at apply
+        overlay_by_id: Dict[str, Optional[Allocation]] = {}
+        for pres in self._overlay_results(extra):
+            for a in pres.node_update.get(node_id, []):
+                overlay_by_id[a.id] = None
+            for a in pres.node_preemptions.get(node_id, []):
+                overlay_by_id[a.id] = None
+            for a in pres.node_allocation.get(node_id, []):
+                overlay_by_id[a.id] = a
+        remove_ids |= set(overlay_by_id)
         placed_ids = {p.id for p in placements}
         proposed = [a for a in snapshot.allocs_by_node(node_id)
                     if not a.terminal_status() and a.id not in remove_ids]
-        proposed.extend(a for a in overlay_add
-                        if a.id not in placed_ids)
+        proposed.extend(a for a in overlay_by_id.values()
+                        if a is not None and a.id not in placed_ids)
         proposed.extend(placements)
         fit, _dim, _used = AllocsFit(
             node, proposed,

@@ -50,18 +50,32 @@ class PlanQueue:
             return pending.future
 
     def dequeue(self, timeout_s: Optional[float] = None) -> Optional[PendingPlan]:
+        group = self.dequeue_group(1, timeout_s)
+        return group[0] if group else None
+
+    def dequeue_group(self, max_n: int,
+                      timeout_s: Optional[float] = None
+                      ) -> List[PendingPlan]:
+        """Group drain for the group-commit applier: block (up to
+        timeout_s) for the first plan, then take every plan already
+        queued — up to max_n total — WITHOUT waiting for more. Plans
+        come off in priority order, exactly the order the one-at-a-time
+        dequeue would have served them; a plan arriving after the drain
+        simply leads the next group. Returns [] on timeout."""
         import time
         deadline = (time.monotonic() + timeout_s) if timeout_s else None
         with self._l:
-            while True:
-                if self._heap:
-                    return heapq.heappop(self._heap)[2]
+            while not self._heap:
                 remaining = None
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
-                        return None
+                        return []
                 self._l.wait(remaining if remaining is not None else 1.0)
+            out: List[PendingPlan] = []
+            while self._heap and len(out) < max_n:
+                out.append(heapq.heappop(self._heap)[2])
+            return out
 
     def depth(self) -> int:
         with self._l:

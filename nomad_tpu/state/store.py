@@ -1180,45 +1180,84 @@ class StateStore(StateSnapshot):
         """Apply a verified plan atomically (fsm.go ApplyPlanResults /
         state_store.go UpsertPlanResults)."""
         with self._lock:
-            root = self._root.edit()
-            t_allocs = root.table("allocs")
-            fresh = [a for a in allocs_placed
-                     if t_allocs.get(a.id) is None]
-            fresh_ids = {a.id for a in fresh}
-            new_placed = [a for a in fresh if a.deployment_id]
-            for a in allocs_stopped:
-                root = self._upsert_alloc_impl(root, index, a)
-            # in-place updates go through the general path; brand-new
-            # placements take the bulk path (one index write per key)
-            for a in allocs_placed:
-                if a.id not in fresh_ids:
-                    root = self._upsert_alloc_impl(root, index, a)
-            root = self._bulk_insert_allocs(root, index, fresh)
-            for a in allocs_preempted:
-                root = self._upsert_alloc_impl(root, index, a)
-            # claim CSI volumes for placements whose task group requests
-            # them (csi_hook claim-at-placement; the volume watcher
-            # releases claims once allocs turn terminal)
-            root = self._claim_csi_for_placements(root, index,
-                                                  allocs_placed)
-            if deployment is not None:
-                root = self._upsert_deployment_impl(root, index, deployment)
-            for a in new_placed:
-                root = self._deployment_account_placement(root, index, a)
-            for du in (deployment_updates or []):
-                d = root.table("deployments").get(du.deployment_id)
-                if d is not None:
-                    d = replace(d, status=du.status,
-                                status_description=du.status_description,
-                                modify_index=index)
-                    root = root.with_table(
-                        "deployments", root.table("deployments").set(d.id, d))
-            for e in (evals or []):
-                root = self._upsert_eval_impl(root, index, e)
-            root = (root.with_index("allocs", index)
-                        .with_index("deployments", index)
-                        .with_index("evals", index))
+            root = self._plan_results_root(
+                self._root.edit(), index,
+                allocs_stopped=allocs_stopped,
+                allocs_placed=allocs_placed,
+                allocs_preempted=allocs_preempted,
+                deployment=deployment,
+                deployment_updates=deployment_updates,
+                evals=evals)
             self._publish(root)
+
+    def upsert_plan_group_results(self, index: int,
+                                  groups: List[dict]) -> None:
+        """Apply a whole plan GROUP as ONE transaction (group-commit
+        applier): every group member's writes land on one edit root —
+        a single layer push across the alloc/index/summary tables
+        instead of N, directly reducing the layer-overlay debt the
+        governor's compact() reclaim exists to fold — and publish once,
+        so watchers wake once per group."""
+        with self._lock:
+            root = self._root.edit()
+            for g in groups:
+                root = self._plan_results_root(
+                    root, index,
+                    allocs_stopped=g.get("allocs_stopped") or [],
+                    allocs_placed=g.get("allocs_placed") or [],
+                    allocs_preempted=g.get("allocs_preempted") or [],
+                    deployment=g.get("deployment"),
+                    deployment_updates=g.get("deployment_updates"),
+                    evals=g.get("evals"))
+            self._publish(root)
+
+    def _plan_results_root(self, root: _Root, index: int, *,
+                           allocs_stopped: List[Allocation],
+                           allocs_placed: List[Allocation],
+                           allocs_preempted: List[Allocation],
+                           deployment: Optional[Deployment] = None,
+                           deployment_updates: Optional[List[DeploymentStatusUpdate]] = None,
+                           evals: Optional[List[Evaluation]] = None) -> _Root:
+        """One plan's writes onto an open edit root (shared by the
+        single-plan and group-commit paths; caller holds the lock and
+        publishes)."""
+        t_allocs = root.table("allocs")
+        fresh = [a for a in allocs_placed
+                 if t_allocs.get(a.id) is None]
+        fresh_ids = {a.id for a in fresh}
+        new_placed = [a for a in fresh if a.deployment_id]
+        for a in allocs_stopped:
+            root = self._upsert_alloc_impl(root, index, a)
+        # in-place updates go through the general path; brand-new
+        # placements take the bulk path (one index write per key)
+        for a in allocs_placed:
+            if a.id not in fresh_ids:
+                root = self._upsert_alloc_impl(root, index, a)
+        root = self._bulk_insert_allocs(root, index, fresh)
+        for a in allocs_preempted:
+            root = self._upsert_alloc_impl(root, index, a)
+        # claim CSI volumes for placements whose task group requests
+        # them (csi_hook claim-at-placement; the volume watcher
+        # releases claims once allocs turn terminal)
+        root = self._claim_csi_for_placements(root, index,
+                                              allocs_placed)
+        if deployment is not None:
+            root = self._upsert_deployment_impl(root, index, deployment)
+        for a in new_placed:
+            root = self._deployment_account_placement(root, index, a)
+        for du in (deployment_updates or []):
+            d = root.table("deployments").get(du.deployment_id)
+            if d is not None:
+                d = replace(d, status=du.status,
+                            status_description=du.status_description,
+                            modify_index=index)
+                root = root.with_table(
+                    "deployments", root.table("deployments").set(d.id, d))
+        for e in (evals or []):
+            root = self._upsert_eval_impl(root, index, e)
+        return (root.with_index("allocs", index)
+                    .with_index("deployments", index)
+                    .with_index("evals", index))
 
     def _bulk_insert_allocs(self, root: _Root, index: int,
                             allocs: List[Allocation]) -> _Root:

@@ -9,9 +9,16 @@ stage of the pipeline a named accumulator:
     h2d           host->device transfers (uploads, scatters, arg ships)
     kernel        device dispatch through result availability
     d2h           device->host result transfers (device_get)
-    plan_apply    plan verification + local apply (the serialization
-                  point)
+    plan_verify   plan verification against the freshest snapshot +
+                  group overlay (the serialization point's read half)
+    plan_commit   raft append/apply + quorum wait + store transaction
+                  (the serialization point's write half)
     broker_ack    eval broker ack bookkeeping
+
+r8 lumped verify, raft apply, and ack bookkeeping into one
+`plan_apply` bucket; the group-commit applier splits it so the bench
+artifact can show whether batched commit actually shrank the commit
+half (one raft entry / store transaction / event flush per GROUP).
 
 `bench.py` enables collection around a run and emits the snapshot in
 the JSON artifact (`stage_breakdown`), so the kernel-vs-e2e gap is
@@ -31,8 +38,8 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
-STAGES = ("table_build", "h2d", "kernel", "d2h", "plan_apply",
-          "broker_ack")
+STAGES = ("table_build", "h2d", "kernel", "d2h", "plan_verify",
+          "plan_commit", "broker_ack")
 
 enabled = False
 

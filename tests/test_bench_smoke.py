@@ -37,18 +37,29 @@ def test_bench_py_emits_json_line_on_cpu():
     # the breakdown that makes the kernel-vs-e2e gap attributable
     assert "stage_error" not in data, data
     bd = data["stage_breakdown"]
-    for stage in ("table_build", "h2d", "kernel", "d2h", "plan_apply",
-                  "broker_ack"):
+    # plan_apply split into plan_verify/plan_commit (ISSUE 4 satellite:
+    # the artifact must attribute verify separately from commit so the
+    # group-commit win is measurable per round)
+    for stage in ("table_build", "h2d", "kernel", "d2h", "plan_verify",
+                  "plan_commit", "broker_ack"):
         assert stage in bd, f"missing stage {stage}: {bd}"
         assert set(bd[stage]) == {"seconds", "calls", "share"}
     assert bd["kernel"]["seconds"] > 0          # e2e phases dispatched
-    assert bd["plan_apply"]["calls"] > 0
+    assert bd["plan_verify"]["calls"] > 0
+    assert bd["plan_commit"]["calls"] > 0
     assert bd["broker_ack"]["calls"] > 0
     shares = sum(v["share"] for v in bd.values())
     assert 0.99 <= shares <= 1.01 or shares == 0.0
     # resident-table counters + measured dispatch costs ride along
     assert data["table_build_stats"]["delta_refreshes"] >= 0
     assert data["dispatch_cost_model"], "cost model never observed"
+    # group-commit + engine-reuse attribution (ISSUE 4 satellite)
+    assert data["plan_group_stats"]["groups"] > 0
+    assert data["plan_group_mean_size"] >= 1.0
+    assert data["plan_group_conflict_retries"] >= 0
+    assert 0.0 <= data["engine_reuse_hit_rate"] <= 1.0
+    # the broker burst scenario reports its own group sizing
+    assert data["service_broker_plan_group_mean_size"] >= 1.0
 
 
 def test_c2m_seed_path_at_toy_scale():

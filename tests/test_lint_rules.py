@@ -290,6 +290,8 @@ FIXTURE_CONFIG = """\
 class ServerConfig:
     governor_documented_high: int = 5
     governor_orphan_high: int = 9
+    plan_group_documented_max: int = 32
+    plan_group_orphan_max: int = 7
     other_knob: int = 1
 """
 
@@ -310,15 +312,22 @@ class TestSurfaceDrift:
     def test_unreferenced_route_and_undocumented_knob(self):
         files = self.files('JOBS = "/v1/widgets"\n'
                            'GET = "/v1/widget/"\n',
-                           "only governor_documented_high is here")
+                           "governor_documented_high and "
+                           "plan_group_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
         knob_f = [f for f in out if "governor_orphan_high" in f.message]
+        # plan_group_* knobs are covered by the same contract (ISSUE 4:
+        # group-commit knobs must land in the STATUS.md knob table)
+        pg_f = [f for f in out if "plan_group_orphan_max" in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
-        # documented knob and referenced routes are quiet
+        assert len(pg_f) == 1
+        # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
+                       for f in out)
+        assert not any("plan_group_documented_max" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -326,7 +335,9 @@ class TestSurfaceDrift:
         files = self.files('JOBS = "/v1/widgets"\n'
                            'GET = "/v1/widget/"\n',
                            "governor_documented_high, "
-                           "governor_orphan_high")
+                           "governor_orphan_high, "
+                           "plan_group_documented_max, "
+                           "plan_group_orphan_max")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
