@@ -190,6 +190,14 @@ def main() -> None:
         out["engine_reuse_hit_rate"] = round(
             ec["mask_hits"] / max(ec["mask_hits"] + ec["mask_misses"],
                                   1), 4)
+        # columnar reconcile engine (ISSUE 6): the tasks_updated memo
+        # over the whole run — the deployment-wave scenario reports its
+        # own deploy_wave_* keys for the on-vs-off comparison
+        from nomad_tpu.scheduler.stack import (tasks_updated_hit_rate,
+                                               tasks_updated_stats)
+        out["tasks_updated"] = tasks_updated_stats()
+        out["tasks_updated_hit_rate"] = round(tasks_updated_hit_rate(),
+                                              4)
     except Exception as e:   # pragma: no cover — defensive
         out["stage_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(out))

@@ -24,12 +24,16 @@ from ..models import (
 )
 from ..models.alloc import RescheduleEvent, RescheduleTracker, AllocDeploymentStatus
 from ..ops import ProposedIndex
+from ..utils import stages
 from ..utils.ids import generate_uuid
 from .context import EvalContext
 from .reconcile import AllocReconciler
-from .stack import PlacementEngine, SelectOptions
-from .util import (adjust_queued_allocations, tainted_nodes, tasks_updated,
-                   update_non_terminal_allocs_to_lost)
+from .reconcile_columnar import ColumnarAllocReconciler, columnar_enabled
+from .stack import PlacementEngine, SelectOptions, tasks_updated_cached
+from .util import (adjust_queued_allocations, tainted_nodes,
+                   tainted_nodes_columnar, tasks_updated,
+                   update_non_terminal_allocs_to_lost,
+                   update_non_terminal_allocs_to_lost_columnar)
 
 MAX_SERVICE_ATTEMPTS = 5
 MAX_BATCH_ATTEMPTS = 2
@@ -60,6 +64,11 @@ class GenericScheduler:
         self.deployment = None
 
         self.blocked: Optional[Evaluation] = None
+        # True while this eval reconciles columnar: gates the
+        # tasks_updated memo so engine-off (env hatch OR
+        # ServerConfig.reconcile_columnar=False) measures the raw
+        # reference diff cost, not the memoized one
+        self._columnar_active = False
         self.failed_tg_allocs: Dict[str, AllocMetric] = {}
         self.queued_allocs: Dict[str, int] = {}
         self.followup_evals: List[Evaluation] = []
@@ -184,21 +193,42 @@ class GenericScheduler:
     # -- reconcile + place --------------------------------------------
     def _compute_job_allocs(self) -> None:
         ev = self.eval
-        allocs = self.state.allocs_by_job(ev.namespace, ev.job_id)
-        tainted = tainted_nodes(self.state, allocs)
+        t0 = time.perf_counter() if stages.enabled else 0.0
 
-        update_non_terminal_allocs_to_lost(self.plan, tainted, allocs)
+        # columnar reconcile engine: the state store's per-job alloc
+        # index turns the O(allocs) host phase into mask ops
+        # (reconcile_columnar.py); NOMAD_TPU_COLUMNAR_RECONCILE=0 or a
+        # detached snapshot falls back to the reference reconciler
+        cols = None
+        if columnar_enabled():
+            getter = getattr(self.state, "job_alloc_columns", None)
+            if getter is not None:
+                cols = getter(ev.namespace, ev.job_id)
+        self._columnar_active = cols is not None
 
-        if self.job is None or self.job.stopped():
-            job = self.job if self.job is not None else Job(
+        if cols is not None:
+            tainted = tainted_nodes_columnar(self.state, cols)
+            update_non_terminal_allocs_to_lost_columnar(
+                self.plan, tainted, cols)
+        else:
+            allocs = self.state.allocs_by_job(ev.namespace, ev.job_id)
+            tainted = tainted_nodes(self.state, allocs)
+            update_non_terminal_allocs_to_lost(self.plan, tainted,
+                                               allocs)
+
+        job = self.job
+        if job is None or job.stopped():
+            job = job if job is not None else Job(
                 id=ev.job_id, namespace=ev.namespace, stop=True,
                 task_groups=[])
-            reconciler = AllocReconciler(
+        if cols is not None:
+            reconciler = ColumnarAllocReconciler(
                 self._alloc_update_fn, self.batch, ev.job_id, job,
-                self.deployment, allocs, tainted, ev.id)
+                self.deployment, cols, tainted, ev.id,
+                spec_change_fn=self._spec_change_fn)
         else:
             reconciler = AllocReconciler(
-                self._alloc_update_fn, self.batch, ev.job_id, self.job,
+                self._alloc_update_fn, self.batch, ev.job_id, job,
                 self.deployment, allocs, tainted, ev.id)
         results = reconciler.compute()
 
@@ -233,20 +263,28 @@ class GenericScheduler:
         for alloc in results.inplace_update:
             self.plan.append_alloc(alloc)
 
-        # Queued allocations = requested placements per tg
-        for place in results.place:
-            tg = place.task_group
-            if tg is not None:
-                self.queued_allocs[tg.name] = \
-                    self.queued_allocs.get(tg.name, 0) + 1
-        for du in results.destructive_update:
-            tg = du.place_task_group
-            if tg is not None:
-                self.queued_allocs[tg.name] = \
-                    self.queued_allocs.get(tg.name, 0) + 1
+        # Queued allocations = requested placements per tg, derived
+        # from the reconciler's per-tg counts in ONE pass: fresh places
+        # + canaries + migrations land in results.place, destructive
+        # updates in results.destructive_update, and the old code
+        # re-walked both 10k-entry lists after the reconciler had
+        # already bucketed them
+        for tg_name, du in results.desired_tg_updates.items():
+            n = du.place + du.canary + du.migrate + du.destructive_update
+            if n:
+                self.queued_allocs[tg_name] = \
+                    self.queued_allocs.get(tg_name, 0) + n
+
+        if stages.enabled:
+            stages.add("reconcile", time.perf_counter() - t0)
 
         # Compute placements (destructive first to discount resources)
         self._compute_placements(results.destructive_update, results.place)
+
+    def _spec_change_fn(self, old_job: Job, tg_name: str) -> bool:
+        """Destructive-update verdict for the columnar reconciler: one
+        memoized deep diff per (old version, new version, tg)."""
+        return tasks_updated_cached(self.job, old_job, tg_name)
 
     # genericAllocUpdateFn (util.go:926)
     def _alloc_update_fn(self, existing: Allocation, new_job: Job, new_tg):
@@ -255,7 +293,15 @@ class GenericScheduler:
             return True, False, None
         if existing.job is None:
             return False, True, None
-        if tasks_updated(new_job, existing.job, new_tg.name):
+        # memoized with the engine on (one diff per version pair);
+        # engine-off — env hatch or reconcile_columnar=False — keeps
+        # the raw diff so comparisons measure the true reference cost
+        updated = (tasks_updated_cached(new_job, existing.job,
+                                        new_tg.name)
+                   if self._columnar_active
+                   else tasks_updated(new_job, existing.job,
+                                      new_tg.name))
+        if updated:
             return False, True, None
         if existing.terminal_status():
             return True, False, None

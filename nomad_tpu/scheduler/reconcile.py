@@ -135,9 +135,55 @@ class AllocReconciler:
         self.deployment_failed = False
         self.result = ReconcileResults()
 
+    # -- set-algebra hooks ---------------------------------------------
+    # The columnar engine (reconcile_columnar.ColumnarAllocReconciler)
+    # overrides these with numpy-mask versions computed over the state
+    # store's per-job alloc index; the base implementations are the
+    # reference per-alloc path. Hooks return the SAME dict shapes so
+    # the group math below stays shared between both engines.
+    def _matrix(self) -> Dict[str, AllocSet]:
+        return new_alloc_matrix(self.job, self.existing_allocs)
+
+    def _filter_tainted(self, a: AllocSet):
+        return filter_by_tainted(a, self.tainted_nodes)
+
+    def _filter_terminal(self, a: AllocSet) -> AllocSet:
+        return filter_by_terminal(a)
+
+    def _filter_rescheduleable(self, a: AllocSet):
+        return filter_by_rescheduleable(a, self.batch, self.now,
+                                        self.eval_id, self.deployment)
+
+    def _name_index(self, group: str, count: int, untainted: AllocSet,
+                    migrate: AllocSet,
+                    reschedule_now: AllocSet) -> "AllocNameIndex":
+        return AllocNameIndex(self.job_id, group, count,
+                              union(untainted, migrate, reschedule_now))
+
+    def _had_running(self, all_set: AllocSet) -> bool:
+        return any(
+            a.job is not None and a.job.version == self.job.version
+            and a.job.create_index == self.job.create_index
+            for a in all_set.values())
+
+    def _deployment_health(self, untainted: AllocSet,
+                           deployment_id: str):
+        """(any_unhealthy, n_not_healthy) over the untainted allocs
+        that belong to `deployment_id` (the rolling-limit discount,
+        reconcile.go computeLimit)."""
+        part_of, _ = filter_by_deployment(untainted, deployment_id)
+        n = 0
+        for alloc in part_of.values():
+            ds = alloc.deployment_status
+            if ds is not None and ds.is_unhealthy():
+                return True, n
+            if ds is None or not ds.is_healthy():
+                n += 1
+        return False, n
+
     # -- top level -----------------------------------------------------
     def compute(self) -> ReconcileResults:
-        m = new_alloc_matrix(self.job, self.existing_allocs)
+        m = self._matrix()
         self._cancel_deployments()
 
         # a nil job behaves as stopped (structs.go Job.Stopped treats a
@@ -198,8 +244,8 @@ class AllocReconciler:
 
     def _handle_stop(self, m: Dict[str, AllocSet]) -> None:
         for group, allocs in m.items():
-            allocs = filter_by_terminal(allocs)
-            untainted, migrate, lost = filter_by_tainted(allocs, self.tainted_nodes)
+            allocs = self._filter_terminal(allocs)
+            untainted, migrate, lost = self._filter_tainted(allocs)
             self._mark_stop(untainted, "", ALLOC_NOT_NEEDED)
             self._mark_stop(migrate, "", ALLOC_NOT_NEEDED)
             self._mark_stop(lost, ALLOC_CLIENT_LOST, ALLOC_LOST)
@@ -221,7 +267,7 @@ class AllocReconciler:
         tg = self.job.lookup_task_group(group)
 
         if tg is None:
-            untainted, migrate, lost = filter_by_tainted(all_set, self.tainted_nodes)
+            untainted, migrate, lost = self._filter_tainted(all_set)
             self._mark_stop(untainted, "", ALLOC_NOT_NEEDED)
             self._mark_stop(migrate, "", ALLOC_NOT_NEEDED)
             self._mark_stop(lost, ALLOC_CLIENT_LOST, ALLOC_LOST)
@@ -240,23 +286,22 @@ class AllocReconciler:
                 dstate.auto_promote = tg.update.auto_promote
                 dstate.progress_deadline_s = tg.update.progress_deadline_s
 
-        all_set, ignore = self._filter_old_terminal_allocs(all_set)
-        desired.ignore += len(ignore)
+        all_set, n_old_ignore = self._filter_old_terminal_allocs(all_set)
+        desired.ignore += n_old_ignore
 
         canaries, all_set = self._handle_group_canaries(all_set, desired)
 
-        untainted, migrate, lost = filter_by_tainted(all_set, self.tainted_nodes)
-        untainted, reschedule_now, reschedule_later = filter_by_rescheduleable(
-            untainted, self.batch, self.now, self.eval_id, self.deployment)
+        untainted, migrate, lost = self._filter_tainted(all_set)
+        untainted, reschedule_now, reschedule_later = \
+            self._filter_rescheduleable(untainted)
 
         lost_later = ru.delay_by_stop_after_client_disconnect(lost, self.now)
         lost_later_evals = self._handle_delayed_lost(lost_later, all_set,
                                                      tg.name)
         self._handle_delayed_reschedules(reschedule_later, all_set, tg.name)
 
-        name_index = AllocNameIndex(
-            self.job_id, group, tg.count,
-            union(untainted, migrate, reschedule_now))
+        name_index = self._name_index(group, tg.count, untainted,
+                                      migrate, reschedule_now)
 
         canary_state = (dstate is not None and dstate.desired_canaries != 0
                         and not dstate.promoted)
@@ -360,10 +405,7 @@ class AllocReconciler:
 
         # Create a deployment if the spec is updating or first run
         updating_spec = len(destructive) != 0 or len(self.result.inplace_update) != 0
-        had_running = any(
-            a.job is not None and a.job.version == self.job.version
-            and a.job.create_index == self.job.create_index
-            for a in all_set.values())
+        had_running = self._had_running(all_set)
         if (not existing_deployment and strategy is not None
                 and dstate.desired_total != 0
                 and (not had_running or updating_spec)):
@@ -386,18 +428,19 @@ class AllocReconciler:
 
     # -- helpers -------------------------------------------------------
     def _filter_old_terminal_allocs(self, all_set: AllocSet):
+        """(filtered_set, n_ignored) — only the count is consumed."""
         if not self.batch:
-            return all_set, {}
+            return all_set, 0
         filtered = dict(all_set)
-        ignored: AllocSet = {}
+        n = 0
         for aid, alloc in list(filtered.items()):
             older = (alloc.job is not None
                      and (alloc.job.version < self.job.version
                           or alloc.job.create_index < self.job.create_index))
             if older and alloc.terminal_status():
                 del filtered[aid]
-                ignored[aid] = alloc
-        return filtered, ignored
+                n += 1
+        return filtered, n
 
     def _handle_group_canaries(self, all_set: AllocSet,
                                desired: DesiredUpdates):
@@ -422,8 +465,7 @@ class AllocReconciler:
             for ds in self.deployment.task_groups.values():
                 canary_ids.extend(ds.placed_canaries)
             canaries = from_keys(all_set, canary_ids)
-            untainted, migrate, lost = filter_by_tainted(canaries,
-                                                         self.tainted_nodes)
+            untainted, migrate, lost = self._filter_tainted(canaries)
             self._mark_stop(migrate, "", ALLOC_MIGRATING)
             self._mark_stop(lost, ALLOC_CLIENT_LOST, ALLOC_LOST)
             canaries = untainted
@@ -442,13 +484,11 @@ class AllocReconciler:
             return 0
         limit = tg.update.max_parallel
         if self.deployment is not None:
-            part_of, _ = filter_by_deployment(untainted, self.deployment.id)
-            for alloc in part_of.values():
-                ds = alloc.deployment_status
-                if ds is not None and ds.is_unhealthy():
-                    return 0
-                if ds is None or not ds.is_healthy():
-                    limit -= 1
+            any_unhealthy, n_not_healthy = self._deployment_health(
+                untainted, self.deployment.id)
+            if any_unhealthy:
+                return 0
+            limit -= n_not_healthy
         return max(limit, 0)
 
     def _compute_placements(self, tg: TaskGroup, name_index: AllocNameIndex,
@@ -486,7 +526,7 @@ class AllocReconciler:
         if remove <= 0:
             return stop
 
-        untainted = filter_by_terminal(untainted)
+        untainted = self._filter_terminal(untainted)
 
         if not canary_state and len(canaries) != 0:
             canary_names = name_set(canaries)

@@ -1,0 +1,314 @@
+"""Columnar reconcile engine: the AllocReconciler's per-alloc host
+loops recast as numpy mask ops over the state store's per-job alloc
+index (state/alloc_index.py JobAllocColumns).
+
+The reference reconciler pays O(allocs) Python per eval — status
+predicates, name parsing, job-version checks, and (the whale) one deep
+`tasks_updated` structural diff per alloc during a deployment wave.
+This subclass overrides the set-algebra hooks the base class exposes
+(reconcile.py `_matrix`/`_filter_*`/`_name_index`/`_compute_updates`/
+`_deployment_health`/`_had_running`) with vectorized versions:
+
+  - partition predicates (terminal, migrate-flagged, tainted-lost,
+    same-version ignore, old-terminal, per-tg bucketing) evaluate as
+    boolean masks over the columns;
+  - `tasks_updated` verdicts are computed ONCE per distinct
+    (old job snapshot, task group) via `spec_change_fn` (the memoized
+    stack.tasks_updated_cached) and broadcast over rows;
+  - per-alloc Python survives only for the rows the masks flag:
+    reschedule-eligibility of FAILED allocs, batch `ran_successfully`,
+    in-place update candidates (node feasibility + alloc construction),
+    canaries, and the deployment state machine.
+
+Result sets stay plain AllocSet dicts (bulk-materialized at C speed),
+so the intricate group math in the base class is SHARED — columnar and
+reference run the same control flow over identically-shaped inputs,
+which is what the randomized parity suite (tests/
+test_reconcile_columnar.py) pins down.
+
+`NOMAD_TPU_COLUMNAR_RECONCILE=0` is the runtime escape hatch: the
+generic scheduler falls back to the reference reconciler (and the raw,
+un-memoized `tasks_updated`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..state.alloc_index import (CLIENT_FAILED_CODE, JobAllocColumns)
+from .reconcile import AllocReconciler
+from .reconcile_util import (AllocNameIndex, AllocSet,
+                             DelayedRescheduleInfo,
+                             update_by_reschedulable)
+
+
+def columnar_enabled() -> bool:
+    return os.environ.get("NOMAD_TPU_COLUMNAR_RECONCILE", "1").lower() \
+        not in ("0", "false", "no")
+
+
+_EMPTY_ROWS = np.zeros(0, dtype=np.intp)
+
+
+class ColumnarAllocReconciler(AllocReconciler):
+    def __init__(self, alloc_update_fn, batch: bool, job_id: str, job,
+                 deployment, cols: JobAllocColumns, tainted_nodes,
+                 eval_id: str, now: Optional[float] = None,
+                 spec_change_fn: Optional[Callable] = None):
+        super().__init__(alloc_update_fn, batch, job_id, job,
+                         deployment, cols.allocs, tainted_nodes,
+                         eval_id, now=now)
+        self.cols = cols
+        # spec_change_fn(old_job, tg_name) -> bool: the vectorizable
+        # destructive-update verdict (generic.py wires the memoized
+        # tasks_updated). None = unknown update fn: _compute_updates
+        # falls back to the reference per-alloc loop.
+        self.spec_change_fn = spec_change_fn
+        # materialized-dict -> row-array stash so chained filters skip
+        # the id->row reconversion; entries are only trusted while the
+        # dict object is unmutated (length check)
+        self._stash: Dict[int, tuple] = {}
+        c = cols
+        n = c.n
+        self._terminal = (c.desired[:n] > 0) | (c.client[:n] >= 2)
+        # per-node tainted categories, resolved once per eval
+        n_nodes = len(c.node_ids)
+        tainted_col = np.zeros(n_nodes, dtype=bool)
+        lost_col = np.zeros(n_nodes, dtype=bool)
+        for nid, node in tainted_nodes.items():
+            code = c.node_of.get(nid)
+            if code is None:
+                continue
+            tainted_col[code] = True
+            if node is None or node.terminal_status():
+                lost_col[code] = True
+        self._node_tainted = tainted_col
+        self._node_lost = lost_col
+
+    # -- row/dict plumbing --------------------------------------------
+    def _mat(self, rows: np.ndarray) -> AllocSet:
+        ids = self.cols.ids
+        allocs = self.cols.allocs
+        d = {ids[r]: allocs[r] for r in rows.tolist()}
+        self._stash[id(d)] = (d, rows)
+        return d
+
+    def _rows_for(self, s: AllocSet) -> np.ndarray:
+        ent = self._stash.get(id(s))
+        if ent is not None and ent[0] is s and len(ent[1]) == len(s):
+            return ent[1]
+        if not s:
+            return _EMPTY_ROWS
+        rof = self.cols.row_of
+        return np.fromiter((rof[k] for k in s), dtype=np.intp,
+                           count=len(s))
+
+    # -- hook overrides ------------------------------------------------
+    def _matrix(self) -> Dict[str, AllocSet]:
+        c = self.cols
+        tg_code = c.tg_code[:c.n]
+        m: Dict[str, AllocSet] = {}
+        for code, name in enumerate(c.tg_names):
+            rows = np.nonzero(tg_code == code)[0]
+            if len(rows):
+                m[name] = self._mat(rows)
+        if self.job is not None:
+            for tg in self.job.task_groups:
+                m.setdefault(tg.name, {})
+        return m
+
+    def _filter_tainted(self, s: AllocSet):
+        rows = self._rows_for(s)
+        if not len(rows):
+            return {}, {}, {}
+        c = self.cols
+        term = self._terminal[rows]
+        mig = ~term & c.migrate[rows]
+        nc = c.node_code[rows]
+        lost = (~term & ~mig & self._node_tainted[nc]
+                & self._node_lost[nc])
+        unt = ~mig & ~lost
+        return (self._mat(rows[unt]), self._mat(rows[mig]),
+                self._mat(rows[lost]))
+
+    def _filter_terminal(self, s: AllocSet) -> AllocSet:
+        rows = self._rows_for(s)
+        if not len(rows):
+            return {}
+        return self._mat(rows[~self._terminal[rows]])
+
+    def _filter_old_terminal_allocs(self, all_set: AllocSet):
+        if not self.batch:
+            return all_set, 0
+        rows = self._rows_for(all_set)
+        if not len(rows):
+            return all_set, 0
+        c = self.cols
+        older = c.has_job[rows] & (
+            (c.job_version[rows] < self.job.version)
+            | (c.job_create[rows] < self.job.create_index))
+        ign = older & self._terminal[rows]
+        n = int(ign.sum())
+        if not n:
+            return all_set, 0
+        return self._mat(rows[~ign]), n
+
+    def _filter_rescheduleable(self, s: AllocSet):
+        rows = self._rows_for(s)
+        if not len(rows):
+            return {}, {}, []
+        c = self.cols
+        term = self._terminal[rows]
+        keep = ~(c.has_next[rows] & term)
+        rows = rows[keep]
+        if not len(rows):
+            return {}, {}, []
+        de = c.desired[rows]
+        cl = c.client[rows]
+        stop_evict = de > 0
+        untainted_m = np.zeros(len(rows), dtype=bool)
+        if self.batch:
+            # stopped/evicted batch allocs: ran_successfully decides,
+            # and it reads task_states — per-alloc, flagged rows only
+            for i in np.nonzero(stop_evict)[0].tolist():
+                if c.allocs[rows[i]].ran_successfully():
+                    untainted_m[i] = True
+            untainted_m |= ~stop_evict & (cl != CLIENT_FAILED_CODE)
+            proceed = ~stop_evict & (cl == CLIENT_FAILED_CODE)
+        else:
+            proceed = ~(stop_evict | (cl == 2) | (cl == 4))
+        # active-deployment member without a reschedule flag: never
+        # rescheduled by this eval (update_by_reschedulable's gate)
+        dep = self.deployment
+        if dep is not None and dep.active():
+            depcode = c.dep_of.get(dep.id, -2)
+            blocked = (proceed & (c.dep_code[rows] == depcode)
+                       & ~c.resched_flag[rows])
+            untainted_m |= blocked
+            proceed &= ~blocked
+        # only FAILED rows can be reschedule-eligible (delay math needs
+        # policy + tracker + task states); the rest reduce to the
+        # force-reschedule flag. The per-alloc verdicts are folded back
+        # into the masks BEFORE materializing so dict insertion order
+        # stays row order — the reference's `place[:allowed]` slice
+        # makes set iteration order semantic, so it must match exactly.
+        need_py = proceed & (cl == CLIENT_FAILED_CODE)
+        simple = proceed & ~need_py
+        force = c.force_resched[rows]
+        now_m = simple & force
+        untainted_m |= simple & ~force
+        reschedule_later: List[DelayedRescheduleInfo] = []
+        for i in np.nonzero(need_py)[0].tolist():
+            a = c.allocs[rows[i]]
+            now_ok, later_ok, t = update_by_reschedulable(
+                a, self.now, self.eval_id, self.deployment)
+            if not now_ok:
+                untainted_m[i] = True
+                if later_ok:
+                    reschedule_later.append(
+                        DelayedRescheduleInfo(a.id, a, t))
+            else:
+                now_m[i] = True
+        return (self._mat(rows[untainted_m]), self._mat(rows[now_m]),
+                reschedule_later)
+
+    def _name_index(self, group: str, count: int, untainted: AllocSet,
+                    migrate: AllocSet,
+                    reschedule_now: AllocSet) -> AllocNameIndex:
+        ni = AllocNameIndex(self.job_id, group, count, {})
+        rows = self._rows_for(untainted)
+        if len(rows):
+            vals = self.cols.name_idx[rows]
+            ni.b = set(np.unique(vals[vals >= 0]).tolist())
+        for small in (migrate, reschedule_now):
+            for a in small.values():
+                idx = a.index()
+                if idx >= 0:
+                    ni.b.add(idx)
+        return ni
+
+    def _had_running(self, all_set: AllocSet) -> bool:
+        rows = self._rows_for(all_set)
+        if not len(rows):
+            return False
+        c = self.cols
+        return bool(np.any(
+            c.has_job[rows]
+            & (c.job_version[rows] == self.job.version)
+            & (c.job_create[rows] == self.job.create_index)))
+
+    def _deployment_health(self, untainted: AllocSet,
+                           deployment_id: str):
+        c = self.cols
+        code = c.dep_of.get(deployment_id, -2)
+        rows = self._rows_for(untainted)
+        part = rows[c.dep_code[rows] == code] if len(rows) else rows
+        if not len(part):
+            return False, 0
+        h = c.healthy[part]
+        if np.any(h == -1):
+            return True, 0
+        return False, int((h != 1).sum())
+
+    def _compute_stop(self, tg, name_index, untainted, migrate, lost,
+                      canaries, canary_state, followup_evals):
+        # steady-state fast path: nothing lost, nothing migrating, no
+        # canaries, and the group is not over count -> the reference
+        # body provably returns an empty stop set without side effects
+        if not lost and not migrate and not canaries \
+                and len(untainted) <= tg.count:
+            return {}
+        return super()._compute_stop(tg, name_index, untainted, migrate,
+                                     lost, canaries, canary_state,
+                                     followup_evals)
+
+    def _compute_updates(self, tg, untainted: AllocSet):
+        if self.spec_change_fn is None:
+            # unknown alloc_update_fn semantics: reference loop
+            return super()._compute_updates(tg, untainted)
+        c = self.cols
+        rows = self._rows_for(untainted)
+        if not len(rows):
+            return {}, {}, {}
+        # mirrors genericAllocUpdateFn's decision ladder (util.go:926)
+        # column-wise: (1) same job_modify_index -> ignore; (2) no job
+        # snapshot -> destructive; (3) spec changed -> destructive,
+        # ONE verdict per distinct old-job snapshot; (4) terminal ->
+        # ignore; remaining rows are in-place candidates and drop to
+        # the real fn (single-node feasibility + alloc construction)
+        hj = c.has_job[rows]
+        same = hj & (c.job_mod[rows] == self.job.job_modify_index)
+        nojob = ~hj
+        rest = ~same & ~nojob
+        changed = np.zeros(len(rows), dtype=bool)
+        if rest.any():
+            from .stack import note_tasks_updated_broadcast
+            jc = c.job_code[rows]
+            for code in np.unique(jc[rest]).tolist():
+                members = rest & (jc == code)
+                if self.spec_change_fn(c.job_objs[code], tg.name):
+                    changed |= members
+                note_tasks_updated_broadcast(int(members.sum()))
+        dest_m = nojob | (rest & changed)
+        rem = rest & ~changed
+        ign2 = rem & self._terminal[rows]
+        cand = rem & ~ign2
+        ignore = self._mat(rows[same | ign2])
+        destructive = self._mat(rows[dest_m])
+        inplace: AllocSet = {}
+        for r in rows[cand].tolist():
+            a = c.allocs[r]
+            ignore_change, destructive_change, updated = \
+                self.alloc_update_fn(a, self.job, tg)
+            if ignore_change:
+                ignore[a.id] = a
+            elif destructive_change:
+                destructive[a.id] = a
+            else:
+                inplace[a.id] = a
+                if updated is not None:
+                    self.result.inplace_update.append(updated)
+        return ignore, inplace, destructive

@@ -8,6 +8,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from ..models import (
     Allocation, Job, Node, PlanResult, TaskGroup,
     ALLOC_CLIENT_LOST, ALLOC_DESIRED_EVICT, ALLOC_DESIRED_STOP,
@@ -30,6 +32,48 @@ def tainted_nodes(snapshot, allocs: List[Allocation]) -> Dict[str, Optional[Node
         if node.drain or node.status == NODE_STATUS_DOWN:
             out[alloc.node_id] = node
     return out
+
+
+def tainted_nodes_columnar(snapshot, cols) -> Dict[str, Optional[Node]]:
+    """tainted_nodes over the columnar alloc index: one node lookup per
+    DISTINCT node instead of one per alloc (a 10k-alloc job on 1k
+    nodes pays 1k lookups)."""
+    out: Dict[str, Optional[Node]] = {}
+    for code in np.unique(cols.node_code[:cols.n]).tolist():
+        nid = cols.node_ids[code]
+        node = snapshot.node_by_id(nid)
+        if node is None:
+            out[nid] = None
+        elif node.drain or node.status == NODE_STATUS_DOWN:
+            out[nid] = node
+    return out
+
+
+def update_non_terminal_allocs_to_lost_columnar(plan, tainted, cols) -> None:
+    """update_non_terminal_allocs_to_lost as a mask: qualifying rows
+    (down/GC'd node, desired stop/evict, client running/pending) are
+    flagged vectorized and only those touch Python."""
+    if not tainted:
+        return
+    down_codes = np.zeros(len(cols.node_ids), dtype=bool)
+    any_down = False
+    for nid, node in tainted.items():
+        if node is not None and node.status != NODE_STATUS_DOWN:
+            continue
+        code = cols.node_of.get(nid)
+        if code is not None:
+            down_codes[code] = True
+            any_down = True
+    if not any_down:
+        return
+    n = cols.n
+    # client codes 0/1 = pending/running (state/alloc_index.py)
+    mask = (down_codes[cols.node_code[:n]] & (cols.desired[:n] > 0)
+            & (cols.client[:n] <= 1) & (cols.client[:n] >= 0))
+    for r in np.nonzero(mask)[0].tolist():
+        plan.append_stopped_alloc(
+            cols.allocs[r], "alloc is lost since its node is down",
+            ALLOC_CLIENT_LOST)
 
 
 def _networks_wire(networks) -> list:

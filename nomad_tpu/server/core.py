@@ -156,12 +156,33 @@ class ServerConfig:
     # re-widens) instead of letting demoted plans thrash verify-retry
     # round trips
     governor_plan_group_conflict_high: int = 64
+    # columnar reconcile engine (state/alloc_index.py +
+    # scheduler/reconcile_columnar.py): the per-job struct-of-arrays
+    # alloc index the reconciler's masks read. False disables index
+    # maintenance and the schedulers fall back to the reference
+    # per-alloc reconciler (NOMAD_TPU_COLUMNAR_RECONCILE=0 is the
+    # runtime kill switch for bisection)
+    reconcile_columnar: bool = True
+    # bound on live per-job index entries (FIFO eviction)
+    reconcile_index_max_jobs: int = 512
+    # pending write-through deltas beyond this drop the entry — a cold
+    # job nobody reconciles must not hoard a delta log; the next read
+    # rebuilds dense
+    reconcile_index_delta_max: int = 4096
+    # total pending columnar-index delta debt across jobs: crossing it
+    # folds the index back to dense rebuild (governor reclaim)
+    governor_reconcile_index_debt_high: int = 65536
 
 
 class Server:
     def __init__(self, config: Optional[ServerConfig] = None):
         self.config = config or ServerConfig()
         self.store = StateStore()
+        self.store.alloc_index.enabled = self.config.reconcile_columnar
+        self.store.alloc_index.max_jobs = \
+            self.config.reconcile_index_max_jobs
+        self.store.alloc_index.delta_max = \
+            self.config.reconcile_index_delta_max
         # RLock: FSM appliers can nest (e.g. a node-register unblocking a
         # blocked eval re-enters raft_apply on the same thread)
         self._raft_l = threading.RLock()
@@ -430,6 +451,26 @@ class Server:
         # bounded keyed cache of per-(job, task-group) static state
         from ..scheduler.stack import engine_cache_entries
         gov.register("engine_cache.entries", engine_cache_entries)
+
+        # columnar reconcile engine (state/alloc_index.py): index
+        # sizing, dense rebuilds, the tasks_updated memo hit rate, and
+        # pending write-through delta debt with fold-to-rebuild as the
+        # reclaim. Gauges read through self.store — the cache is
+        # replaced on snapshot restore
+        from ..scheduler.stack import tasks_updated_hit_rate
+        gov.register("reconcile.index_rows",
+                     lambda: self.store.alloc_index.rows())
+        gov.register("reconcile.index_rebuilds",
+                     lambda: self.store.alloc_index.stats["rebuilds"],
+                     suspect=False)
+        gov.register("reconcile.tasks_updated_hit_rate",
+                     tasks_updated_hit_rate, unit="ratio",
+                     suspect=False)
+        gov.register("reconcile.index_debt",
+                     lambda: self.store.alloc_index.debt(),
+                     WatermarkPolicy(
+                         cfg.governor_reconcile_index_debt_high),
+                     reclaim=lambda: self.store.alloc_index.fold())
 
         # recompile visibility (analysis/sanitizer.py): distinct
         # compiled trace signatures across every kernel arm — a

@@ -405,8 +405,11 @@ class Worker:
                 n_workers = len(getattr(self.server, "workers", []) or [])
                 if n_workers > 1:
                     sched.kernel_decorrelate = (self.id, n_workers)
+            from ..utils import stages
             t0 = time.monotonic()
             sched.process(ev)
+            if stages.enabled and ev.type != JOB_TYPE_CORE:
+                stages.add("sched_host", time.monotonic() - t0)
             metrics.measure_since(
                 f"nomad.worker.invoke_scheduler_{self._scheduler_for(ev)}"
                 if ev.type != JOB_TYPE_CORE

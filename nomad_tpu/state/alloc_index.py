@@ -1,0 +1,354 @@
+"""Incremental per-job columnar alloc index — struct-of-arrays over one
+job's allocations, advanced on every alloc upsert.
+
+BENCH_r05's 13x kernel-vs-e2e gap sits in the per-eval host phase: the
+reconciler walks every existing alloc of the job in Python (status
+predicates, name parsing, job-version checks, deep spec diffs) on EVERY
+eval, even when nothing changed. This module keeps those facts resident
+as numpy columns so the reconciler's partition math (terminal filter,
+tainted split, per-tg bucketing, same-version ignore) becomes mask ops
+(scheduler/reconcile_columnar.py), the same way ops/tables.py made node
+feasibility columnar.
+
+Lifecycle mirrors the resident node table's delta scheme:
+
+  - columns live on the StateStore (`store.alloc_index`), created
+    lazily on the first columnar read of a job;
+  - every alloc write appends a (raft index, op, payload) delta to the
+    job's entry under the store lock; the next read applies pending
+    deltas up to its snapshot's alloc-table index (O(changes), not
+    O(allocs));
+  - a snapshot OLDER than the synced arrays, a wholesale load
+    (bulk_load/restore), or a delta log past `delta_max` falls back to
+    a dense rebuild from the snapshot — counted in `stats["rebuilds"]`
+    and surfaced as the governor's `reconcile.index_rebuilds` gauge;
+  - the governor's `reconcile.index_debt` watermark
+    (`governor_reconcile_index_debt_high`) folds the whole index back
+    to dense rebuild via `fold()` when pending delta debt grows.
+
+Concurrency contract: delta sync mutates an entry's arrays in place,
+which is safe because the eval broker enforces one outstanding eval per
+job — no two reconcilers read the same job's columns concurrently, and
+writers only append deltas (applied under the cache lock).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models import (
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
+    ALLOC_CLIENT_PENDING, ALLOC_CLIENT_RUNNING,
+    ALLOC_DESIRED_EVICT, ALLOC_DESIRED_RUN, ALLOC_DESIRED_STOP,
+    Allocation,
+)
+
+# status codes: client_terminal <=> code >= 2, server_terminal <=> code > 0
+CLIENT_CODES = {ALLOC_CLIENT_PENDING: 0, ALLOC_CLIENT_RUNNING: 1,
+                ALLOC_CLIENT_COMPLETE: 2, ALLOC_CLIENT_FAILED: 3,
+                ALLOC_CLIENT_LOST: 4}
+CLIENT_FAILED_CODE = 3
+DESIRED_CODES = {ALLOC_DESIRED_RUN: 0, ALLOC_DESIRED_STOP: 1,
+                 ALLOC_DESIRED_EVICT: 2}
+
+_INT_COLS = (
+    ("client", np.int8), ("desired", np.int8), ("healthy", np.int8),
+    ("tg_code", np.int32), ("name_idx", np.int32),
+    ("node_code", np.int32), ("job_code", np.int32),
+    ("dep_code", np.int32),
+    ("job_version", np.int64), ("job_create", np.int64),
+    ("job_mod", np.int64),
+)
+_BOOL_COLS = ("has_job", "migrate", "force_resched", "resched_flag",
+              "has_next")
+
+
+class JobAllocColumns:
+    """Struct-of-arrays over one job's allocs. `allocs`/`ids` are
+    positional and exactly row-aligned with every column; deletes
+    swap-remove so rows stay dense."""
+
+    __slots__ = tuple(n for n, _ in _INT_COLS) + _BOOL_COLS + (
+        "n", "cap", "ids", "allocs", "row_of",
+        "tg_names", "tg_of", "node_ids", "node_of",
+        "job_objs", "job_of", "dep_ids", "dep_of")
+
+    def __init__(self, cap: int = 16):
+        self.n = 0
+        self.cap = max(cap, 4)
+        for name, dtype in _INT_COLS:
+            setattr(self, name, np.zeros(self.cap, dtype=dtype))
+        for name in _BOOL_COLS:
+            setattr(self, name, np.zeros(self.cap, dtype=bool))
+        self.ids: List[str] = []
+        self.allocs: List[Allocation] = []
+        self.row_of: Dict[str, int] = {}
+        self.tg_names: List[str] = []
+        self.tg_of: Dict[str, int] = {}
+        self.node_ids: List[str] = []
+        self.node_of: Dict[str, int] = {}
+        self.job_objs: List = []            # pins alloc.job snapshots
+        self.job_of: Dict[int, int] = {}    # id(job) -> code
+        self.dep_ids: List[str] = []
+        self.dep_of: Dict[str, int] = {}
+
+    @classmethod
+    def build(cls, allocs: List[Allocation]) -> "JobAllocColumns":
+        c = cls(cap=max(len(allocs), 4))
+        for a in allocs:
+            c.upsert(a)
+        return c
+
+    # -- codes ---------------------------------------------------------
+    def _code(self, value, values: list, of: dict) -> int:
+        code = of.get(value)
+        if code is None:
+            code = len(values)
+            values.append(value)
+            of[value] = code
+        return code
+
+    # -- row maintenance ----------------------------------------------
+    def _grow(self) -> None:
+        self.cap *= 2
+        for name, _ in _INT_COLS:
+            col = getattr(self, name)
+            setattr(self, name, np.resize(col, self.cap))
+        for name in _BOOL_COLS:
+            col = getattr(self, name)
+            setattr(self, name, np.resize(col, self.cap))
+
+    def _set_row(self, r: int, a: Allocation) -> None:
+        self.client[r] = CLIENT_CODES.get(a.client_status, -1)
+        self.desired[r] = DESIRED_CODES.get(a.desired_status, -1)
+        self.tg_code[r] = self._code(a.task_group, self.tg_names,
+                                     self.tg_of)
+        self.name_idx[r] = a.index()
+        self.node_code[r] = self._code(a.node_id, self.node_ids,
+                                       self.node_of)
+        job = a.job
+        if job is None:
+            self.has_job[r] = False
+            self.job_code[r] = -1
+            self.job_version[r] = -1
+            self.job_create[r] = -1
+            self.job_mod[r] = -1
+        else:
+            self.has_job[r] = True
+            code = self.job_of.get(id(job))
+            if code is None:
+                code = len(self.job_objs)
+                self.job_objs.append(job)
+                self.job_of[id(job)] = code
+            self.job_code[r] = code
+            self.job_version[r] = job.version
+            self.job_create[r] = job.create_index
+            self.job_mod[r] = job.job_modify_index
+        dt = a.desired_transition
+        self.migrate[r] = bool(dt.migrate)
+        self.force_resched[r] = bool(dt.force_reschedule)
+        self.resched_flag[r] = bool(dt.reschedule)
+        ds = a.deployment_status
+        if ds is None or ds.healthy is None:
+            self.healthy[r] = 0
+        else:
+            self.healthy[r] = 1 if ds.healthy else -1
+        self.dep_code[r] = (self._code(a.deployment_id, self.dep_ids,
+                                       self.dep_of)
+                            if a.deployment_id else -1)
+        self.has_next[r] = a.next_allocation != ""
+
+    def upsert(self, a: Allocation) -> None:
+        r = self.row_of.get(a.id)
+        if r is None:
+            if self.n == self.cap:
+                self._grow()
+            r = self.n
+            self.n += 1
+            self.ids.append(a.id)
+            self.allocs.append(a)
+            self.row_of[a.id] = r
+        else:
+            self.allocs[r] = a
+        self._set_row(r, a)
+
+    def delete(self, alloc_id: str) -> None:
+        r = self.row_of.pop(alloc_id, None)
+        if r is None:
+            return
+        last = self.n - 1
+        if r != last:
+            for name, _ in _INT_COLS:
+                col = getattr(self, name)
+                col[r] = col[last]
+            for name in _BOOL_COLS:
+                col = getattr(self, name)
+                col[r] = col[last]
+            self.ids[r] = self.ids[last]
+            self.allocs[r] = self.allocs[last]
+            self.row_of[self.ids[r]] = r
+        self.ids.pop()
+        self.allocs.pop()
+        self.n = last
+
+
+class _Entry:
+    __slots__ = ("cols", "version", "deltas")
+
+    def __init__(self, cols: JobAllocColumns, version: int):
+        self.cols = cols
+        self.version = version
+        self.deltas: List[Tuple[int, str, object]] = []
+
+
+# entries whose job-object pin list outgrows this rebuild dense: each
+# pinned Job snapshot is a dead version the store already pruned
+_JOB_PIN_MAX = 128
+
+
+class AllocIndexCache:
+    """Per-(namespace, job) columnar indexes with write-through deltas.
+    One per StateStore (`store.alloc_index`); every alloc write path
+    notes its change here, next to the changelog."""
+
+    def __init__(self, max_jobs: int = 512, delta_max: int = 4096,
+                 enabled: bool = True):
+        self.enabled = enabled
+        self.max_jobs = max_jobs
+        self.delta_max = delta_max
+        self._entries: Dict[Tuple[str, str], _Entry] = {}
+        self._lock = threading.Lock()
+        self.stats = {"rebuilds": 0, "delta_syncs": 0, "delta_rows": 0,
+                      "entry_drops": 0, "folds": 0}
+
+    # -- write-through (called under the store lock) -------------------
+    def note_upsert(self, index: int, a: Allocation) -> None:
+        if self.enabled:
+            self._note((a.namespace, a.job_id), index, "up", a)
+
+    def note_delete(self, index: int, namespace: str, job_id: str,
+                    alloc_id: str) -> None:
+        if self.enabled:
+            self._note((namespace, job_id), index, "del", alloc_id)
+
+    def _note(self, key, index: int, op: str, payload) -> None:
+        # unlocked early-out: with no live entries (engine off, or no
+        # columnar read yet) a 10k-alloc plan apply must not pay 10k
+        # mutex round-trips on the commit path. Safe, not just benign:
+        # entry INSTALL happens under the store lock (get()), and every
+        # _note caller also holds the store lock, so install and note
+        # can never interleave
+        if not self._entries:
+            return
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return
+            if len(e.deltas) >= self.delta_max:
+                # a cold entry nobody reads must not hoard deltas; the
+                # next read rebuilds dense
+                del self._entries[key]
+                self.stats["entry_drops"] += 1
+                return
+            e.deltas.append((index, op, payload))
+
+    def invalidate_all(self) -> None:
+        """Wholesale state replacement (bulk load / restore): every
+        entry is stale beyond delta repair."""
+        with self._lock:
+            self._entries.clear()
+
+    # -- reads ---------------------------------------------------------
+    def get(self, snapshot, namespace: str,
+            job_id: str) -> Optional[JobAllocColumns]:
+        """Columns valid at `snapshot`'s alloc-table index, or None
+        when the engine is disabled. Pending deltas at or below the
+        snapshot index are applied in place (see the module concurrency
+        contract); an older-than-synced snapshot gets a detached dense
+        build."""
+        if not self.enabled:
+            return None
+        target = snapshot.index("allocs")
+        key = (namespace, job_id)
+        due = None
+        cols = None
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.version <= target:
+                if len(e.cols.job_objs) > _JOB_PIN_MAX:
+                    del self._entries[key]   # stale job pins: rebuild
+                    self.stats["entry_drops"] += 1
+                else:
+                    d = e.deltas
+                    i = 0
+                    while i < len(d) and d[i][0] <= target:
+                        i += 1
+                    due = d[:i]
+                    if i:
+                        del d[:i]
+                        self.stats["delta_syncs"] += 1
+                        self.stats["delta_rows"] += i
+                    e.version = target
+                    cols = e.cols
+        if cols is not None:
+            # apply OUTSIDE the cache lock: note_* callers hold the
+            # store lock while waiting on it, so a large sync under the
+            # lock would stall the raft apply path. Safe per the module
+            # contract (one reconciling reader per job), and the due
+            # slice is already detached — concurrent writers only
+            # append fresh deltas with higher indexes.
+            for _idx, op, payload in due:
+                if op == "del":
+                    cols.delete(payload)
+                else:
+                    cols.upsert(payload)
+            return cols
+
+        cols = JobAllocColumns.build(snapshot.allocs_by_job(namespace,
+                                                            job_id))
+        with self._lock:
+            self.stats["rebuilds"] += 1
+        store = getattr(snapshot, "_store", None)
+        if store is not None:
+            # install only if the live store still sits exactly at this
+            # snapshot's alloc index: writes hold store._lock while they
+            # note deltas, so checking under it closes the race where a
+            # commit between build and install would be lost forever
+            with store._lock:
+                if store.index("allocs") == target:
+                    with self._lock:
+                        if key not in self._entries:
+                            while len(self._entries) >= self.max_jobs:
+                                self._entries.pop(
+                                    next(iter(self._entries)))
+                                self.stats["entry_drops"] += 1
+                            self._entries[key] = _Entry(cols, target)
+        return cols
+
+    # -- accounting (governor gauges) ----------------------------------
+    def rows(self) -> int:
+        with self._lock:
+            return sum(e.cols.n for e in self._entries.values())
+
+    def debt(self) -> int:
+        with self._lock:
+            return sum(len(e.deltas) for e in self._entries.values())
+
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def fold(self) -> dict:
+        """Governor reclaim: drop every entry so the next read per job
+        is one dense rebuild — the columnar-index analog of the node
+        table's fold-to-rebuild."""
+        with self._lock:
+            dropped = len(self._entries)
+            reclaimed = sum(len(e.deltas) for e in self._entries.values())
+            self._entries.clear()
+            self.stats["folds"] += 1
+        return {"entries_dropped": dropped,
+                "delta_reclaimed": reclaimed}

@@ -145,6 +145,14 @@ class StateSnapshot:
         self._root = root
         self._store = store
 
+    def job_alloc_columns(self, namespace: str, job_id: str):
+        """Columnar alloc index for one job at this snapshot's alloc
+        index (state/alloc_index.py JobAllocColumns), or None when the
+        engine is off or the snapshot is detached from a store."""
+        if self._store is None:
+            return None
+        return self._store.alloc_index.get(self, namespace, job_id)
+
     def node_table(self, build: bool = True):
         """The columnar node table for this snapshot. Snapshots taken
         from a live store share its resident delta-maintained table
@@ -412,6 +420,11 @@ class StateStore(StateSnapshot):
         self._change_floor = 0
         from ..ops.tables import NodeTableCache
         self.table_cache = NodeTableCache()
+        # columnar per-job alloc index (state/alloc_index.py): the
+        # reconciler's struct-of-arrays view, advanced write-through by
+        # every alloc mutation below
+        from .alloc_index import AllocIndexCache
+        self.alloc_index = AllocIndexCache()
 
     # -- changelog -----------------------------------------------------
     def _log_change(self, index: int, kind: str, key: str) -> None:
@@ -983,6 +996,7 @@ class StateStore(StateSnapshot):
             self._changes.clear()
             self._change_indexes.clear()
             self._change_floor = index
+            self.alloc_index.invalidate_all()
             self._publish(root)
 
     def _upsert_alloc_impl(self, root: _Root, index: int, a: Allocation) -> _Root:
@@ -1024,6 +1038,7 @@ class StateStore(StateSnapshot):
             root = self._index_add(root, "allocs_by_node", a.node_id, a.id)
         root = self._update_summary_for_alloc(root, index, existing, a)
         self._log_change(index, "alloc", a.id)
+        self.alloc_index.note_upsert(index, a)
         return root
 
     def _delete_alloc_impl(self, root: _Root, alloc_id: str,
@@ -1032,6 +1047,8 @@ class StateStore(StateSnapshot):
         if a is None:
             return root
         self._log_change(index, "alloc", alloc_id)
+        self.alloc_index.note_delete(index, a.namespace, a.job_id,
+                                     alloc_id)
         root = root.with_table("allocs", root.table("allocs").delete(alloc_id))
         root = self._index_del(root, "allocs_by_node", a.node_id, alloc_id)
         root = self._index_del(root, "allocs_by_job",
@@ -1063,6 +1080,7 @@ class StateStore(StateSnapshot):
                 root = self._update_summary_for_alloc(root, index, existing, merged)
                 root = self._maybe_update_deployment_health(root, index, merged)
                 self._log_change(index, "alloc", merged.id)
+                self.alloc_index.note_upsert(index, merged)
             root = root.with_index("allocs", index)
             self._publish(root)
 
@@ -1276,6 +1294,7 @@ class StateStore(StateSnapshot):
             a.alloc_modify_index = index
             pairs.append((a.id, a))
             self._log_change(index, "alloc", a.id)
+            self.alloc_index.note_upsert(index, a)
         root = root.with_table("allocs", t.update(pairs))
 
         for table, keyfn in (
@@ -1352,6 +1371,7 @@ class StateStore(StateSnapshot):
                 root = root.with_table("allocs",
                                        root.table("allocs").set(aid, a))
                 self._log_change(index, "alloc", aid)
+                self.alloc_index.note_upsert(index, a)
             for e in (evals or []):
                 root = self._upsert_eval_impl(root, index, e)
             root = root.with_index("allocs", index)
@@ -1856,6 +1876,11 @@ class StateStore(StateSnapshot):
                 [0] + [int(i) for i in data.get("indexes", {}).values()])
             from ..ops.tables import NodeTableCache
             self.table_cache = NodeTableCache()
+            from .alloc_index import AllocIndexCache
+            old_ai = self.alloc_index
+            self.alloc_index = AllocIndexCache(
+                max_jobs=old_ai.max_jobs, delta_max=old_ai.delta_max,
+                enabled=old_ai.enabled)
             root = _Root(_Table(), _Table()).edit()
             t = root.table("nodes")
             for w in data["tables"].get("nodes", []):

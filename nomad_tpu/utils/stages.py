@@ -9,6 +9,13 @@ stage of the pipeline a named accumulator:
     h2d           host->device transfers (uploads, scatters, arg ships)
     kernel        device dispatch through result availability
     d2h           device->host result transfers (device_get)
+    reconcile     alloc-diff host phase: alloc fetch + tainted split +
+                  AllocReconciler.compute + result staging (ISSUE 6:
+                  this cost was previously invisible — it had to be
+                  inferred as "the rest of the host share")
+    sched_host    one whole scheduler Process() call as seen by the
+                  worker (reconcile + placement + plan build; overlaps
+                  kernel/h2d/d2h by design — see the note below)
     plan_verify   plan verification against the freshest snapshot +
                   group overlay (the serialization point's read half)
     plan_commit   raft append/apply + quorum wait + store transaction
@@ -38,8 +45,17 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
-STAGES = ("table_build", "h2d", "kernel", "d2h", "plan_verify",
-          "plan_commit", "broker_ack")
+STAGES = ("table_build", "h2d", "kernel", "d2h", "reconcile",
+          "sched_host", "plan_verify", "plan_commit", "broker_ack")
+
+# superset accumulators: wholly contain other stages' time (sched_host
+# wraps reconcile + table_build + h2d + kernel + d2h per dispatch), so
+# they are EXCLUDED from the share denominator — otherwise adding one
+# would halve every other stage's share and break the cross-round
+# share comparisons the bench artifacts exist for. Their own `share`
+# is still reported relative to that same denominator (it can
+# legitimately exceed other stages' combined share).
+SHARE_SUPERSETS = frozenset({"sched_host"})
 
 enabled = False
 
@@ -78,7 +94,8 @@ def snapshot() -> Dict[str, dict]:
     enable(). `share` is each stage's fraction of the summed stage
     time — the attribution number the bench artifact records."""
     with _l:
-        total = sum(v[0] for v in _acc.values())
+        total = sum(v[0] for s, v in _acc.items()
+                    if s not in SHARE_SUPERSETS)
         return {
             s: {"seconds": round(v[0], 4), "calls": v[1],
                 "share": round(v[0] / total, 4) if total > 0 else 0.0}

@@ -40,15 +40,24 @@ def test_bench_py_emits_json_line_on_cpu():
     # plan_apply split into plan_verify/plan_commit (ISSUE 4 satellite:
     # the artifact must attribute verify separately from commit so the
     # group-commit win is measurable per round)
-    for stage in ("table_build", "h2d", "kernel", "d2h", "plan_verify",
-                  "plan_commit", "broker_ack"):
+    # reconcile + sched_host joined the breakdown (ISSUE 6 satellite:
+    # the alloc-diff host phase is now attributable, not inferred)
+    for stage in ("table_build", "h2d", "kernel", "d2h", "reconcile",
+                  "sched_host", "plan_verify", "plan_commit",
+                  "broker_ack"):
         assert stage in bd, f"missing stage {stage}: {bd}"
         assert set(bd[stage]) == {"seconds", "calls", "share"}
     assert bd["kernel"]["seconds"] > 0          # e2e phases dispatched
     assert bd["plan_verify"]["calls"] > 0
     assert bd["plan_commit"]["calls"] > 0
     assert bd["broker_ack"]["calls"] > 0
-    shares = sum(v["share"] for v in bd.values())
+    assert bd["reconcile"]["calls"] > 0
+    assert bd["reconcile"]["seconds"] > 0
+    assert bd["sched_host"]["calls"] > 0
+    # sched_host is a superset accumulator excluded from the share
+    # denominator (utils/stages.py SHARE_SUPERSETS) so r9-era share
+    # comparisons stay meaningful
+    shares = sum(v["share"] for k, v in bd.items() if k != "sched_host")
     assert 0.99 <= shares <= 1.01 or shares == 0.0
     # resident-table counters + measured dispatch costs ride along
     assert data["table_build_stats"]["delta_refreshes"] >= 0
@@ -60,6 +69,14 @@ def test_bench_py_emits_json_line_on_cpu():
     assert 0.0 <= data["engine_reuse_hit_rate"] <= 1.0
     # the broker burst scenario reports its own group sizing
     assert data["service_broker_plan_group_mean_size"] >= 1.0
+    # columnar reconcile engine (ISSUE 6): the deployment-wave scenario
+    # must show the memo paying one deep diff per version pair (hit
+    # rate ~1.0) and a >= 2x evals/s win over the engine-off path
+    assert data["deploy_wave_evals_per_sec"] > 0
+    assert data["deploy_wave_tasks_updated_hit_rate"] > 0.9
+    assert data["deploy_wave_speedup"] >= 2.0, data
+    assert data["deploy_wave_reconcile_stage_s"] >= 0.0
+    assert 0.0 <= data["tasks_updated_hit_rate"] <= 1.0
 
 
 def test_c2m_seed_path_at_toy_scale():

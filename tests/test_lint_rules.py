@@ -292,6 +292,8 @@ class ServerConfig:
     governor_orphan_high: int = 9
     plan_group_documented_max: int = 32
     plan_group_orphan_max: int = 7
+    reconcile_documented_max: int = 512
+    reconcile_orphan_max: int = 11
     other_knob: int = 1
 """
 
@@ -313,21 +315,28 @@ class TestSurfaceDrift:
         files = self.files('JOBS = "/v1/widgets"\n'
                            'GET = "/v1/widget/"\n',
                            "governor_documented_high and "
-                           "plan_group_documented_max are here")
+                           "plan_group_documented_max and "
+                           "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
         knob_f = [f for f in out if "governor_orphan_high" in f.message]
         # plan_group_* knobs are covered by the same contract (ISSUE 4:
         # group-commit knobs must land in the STATUS.md knob table)
         pg_f = [f for f in out if "plan_group_orphan_max" in f.message]
+        # reconcile_* knobs joined the contract (ISSUE 6: columnar
+        # reconcile engine knobs must land in the STATUS.md knob table)
+        rc_f = [f for f in out if "reconcile_orphan_max" in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
         assert len(pg_f) == 1
+        assert len(rc_f) == 1
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
                        for f in out)
         assert not any("plan_group_documented_max" in f.message
+                       for f in out)
+        assert not any("reconcile_documented_max" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -337,7 +346,9 @@ class TestSurfaceDrift:
                            "governor_documented_high, "
                            "governor_orphan_high, "
                            "plan_group_documented_max, "
-                           "plan_group_orphan_max")
+                           "plan_group_orphan_max, "
+                           "reconcile_documented_max, "
+                           "reconcile_orphan_max")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
