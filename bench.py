@@ -110,6 +110,10 @@ def main() -> None:
     # runtime sanitizer's kernel-boundary guards were armed
     from nomad_tpu.analysis.sanitizer import enabled as _sanitize_on
     out["sanitizer"] = "on" if _sanitize_on() else "off"
+    # micro-batch gateway engagement must be attributable per round
+    # (ISSUE 7): record whether the env kill switch disabled it
+    out["microbatch"] = ("off" if os.environ.get(
+        "NOMAD_TPU_MICROBATCH", "1") in ("0", "off") else "on")
     quick = os.environ.get("NOMAD_TPU_BENCH_QUICK", "") not in ("", "0")
     try:
         platform = _init_backend()

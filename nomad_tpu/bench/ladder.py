@@ -139,22 +139,42 @@ def bench_broker_service(n_nodes: int = 10000, n_jobs: int = 64,
                          count: int = 10, batch: int = 8,
                          schedulers: int = 2) -> Dict:
     """Service throughput through the PRODUCTION control plane: a real
-    Server — eval broker -> batched workers (BatchGateway/select_many)
+    Server — eval broker -> workers -> micro-batch gateway/select_many
     -> plan queue -> pipelined applier -> store. Jobs are registered
     while workers are paused so the broker's queue depth exists (the
     C1M shape: a deployment wave, not a drip), then the wall clock runs
     until every job is fully placed.
 
-    Reports the batched rate AND the same run with eval_batch_size=1
-    so the batching speedup is measured, not asserted."""
+    Three runs, all against a dispatch cost model SEEDED by the
+    startup calibration probe (ISSUE 7 — the 1-in-16 organic probe
+    never fires inside a scenario this short, which is exactly how
+    BENCH_r05 shipped service_broker_batches=0):
+      1. micro-batching ON (the headline service_broker_* keys +
+         service_microbatch_* occupancy/window/latency keys)
+      2. the SAME run with NOMAD_TPU_MICROBATCH=0 (the legacy
+         rendezvous path; service_microbatch_*_off keys)
+      3. eval_batch_size=1, micro-batching off (the sequential
+         baseline behind service_batching_speedup)
+    so both the micro-batch win and the legacy batching win are
+    measured, not asserted."""
+    import os
+
     from ..mock import fixtures as mock
     from ..models import Affinity
     from ..server import Server, ServerConfig
 
-    def run(batch_size: int) -> Dict:
-        s = Server(ServerConfig(num_schedulers=schedulers,
-                                eval_batch_size=batch_size,
-                                heartbeat_ttl_s=3600.0))
+    def run(batch_size: int, micro: bool) -> Dict:
+        prev = os.environ.get("NOMAD_TPU_MICROBATCH")
+        os.environ["NOMAD_TPU_MICROBATCH"] = "1" if micro else "0"
+        try:
+            s = Server(ServerConfig(num_schedulers=schedulers,
+                                    eval_batch_size=batch_size,
+                                    heartbeat_ttl_s=3600.0))
+        finally:
+            if prev is None:
+                os.environ.pop("NOMAD_TPU_MICROBATCH", None)
+            else:
+                os.environ["NOMAD_TPU_MICROBATCH"] = prev
         s.start()
         try:
             for w in s.workers:
@@ -227,12 +247,28 @@ def bench_broker_service(n_nodes: int = 10000, n_jobs: int = 64,
             placed = sum(len(s.store.allocs_by_job("default", j.id))
                          for j in jobs)
             ga = s.plan_applier.stats
-            return {"rate": placed / wall, "placed": placed,
-                    "wall_s": wall,
-                    "batches": sum(w.stats["batches"] for w in s.workers),
-                    "plan_groups": ga["groups"],
-                    "plan_group_plans": ga["plans"],
-                    "plan_group_conflicts": ga["conflict_retries"]}
+            gw = s.gateway
+            out = {"rate": placed / wall, "placed": placed,
+                   "wall_s": wall,
+                   # legacy rendezvous batches + gateway multi-lane
+                   # dispatches: either one is "evals shared a device
+                   # dispatch"
+                   "batches": sum(w.stats["batches"] for w in s.workers)
+                   + (gw.stats["batches"] if gw is not None else 0),
+                   "occupancy": (gw.occupancy_mean()
+                                 if gw is not None else 1.0),
+                   "window_us": (gw.window_us() if gw is not None
+                                 else 0.0),
+                   "plan_groups": ga["groups"],
+                   "plan_group_plans": ga["plans"],
+                   "plan_group_conflicts": ga["conflict_retries"]}
+            # worker-observed eval latency (queue wait INCLUDED — the
+            # ISSUE 7 attribution fix), read from the governor's
+            # reservoir
+            if s.governor is not None:
+                out["p50_ms"] = s.governor.latency_percentile_ms(50)
+                out["p99_ms"] = s.governor.latency_percentile_ms(99)
+            return out
         finally:
             s.shutdown()
 
@@ -260,8 +296,23 @@ def bench_broker_service(n_nodes: int = 10000, n_jobs: int = 64,
         wk.select_many([_warm_req() for _ in range(width)])
         width *= 2
 
-    batched = run(batch)
-    solo = run(1)
+    # startup calibration probe (ISSUE 7): seed the cost model with
+    # measured solo + batched per-lane costs at THIS table shape so
+    # batched lanes are cost-favored (or correctly demoted) from the
+    # first dispatch — the 1-in-16 organic probe never fires inside a
+    # scenario this short (BENCH_r05: service_broker_batches=0)
+    from ..ops.select import calibrate_cost_model
+    calibrate_cost_model(n_nodes, count=count, lanes=min(batch, 8),
+                         kernel=wk)
+
+    batched = run(batch, micro=True)
+    legacy = run(batch, micro=False)
+    solo = run(1, micro=False)
+    # CPU-CI regression fence (ISSUE 7 satellite): with the cost model
+    # seeded, the burst scenario MUST engage batching — evals sharing
+    # device dispatches is the entire point of the gateway
+    assert batched["batches"] > 0, (
+        f"broker scenario never batched: {batched}")
     return {
         "service_broker_placements_per_sec": round(batched["rate"], 1),
         "service_broker_wall_s": round(batched["wall_s"], 3),
@@ -269,14 +320,37 @@ def bench_broker_service(n_nodes: int = 10000, n_jobs: int = 64,
         "service_broker_seq_placements_per_sec": round(solo["rate"], 1),
         "service_batching_speedup": round(
             batched["rate"] / max(solo["rate"], 1e-9), 2),
+        # micro-batch gateway engagement + win (ISSUE 7): occupancy,
+        # live window, and the on/off rate + latency comparison the
+        # TPU re-run verifies
+        "service_microbatch_occupancy_mean": round(
+            batched["occupancy"], 2),
+        "service_microbatch_window_us": round(batched["window_us"], 1),
+        "service_microbatch_placements_per_sec": round(
+            batched["rate"], 1),
+        "service_microbatch_placements_per_sec_off": round(
+            legacy["rate"], 1),
+        "service_microbatch_speedup": round(
+            batched["rate"] / max(legacy["rate"], 1e-9), 2),
+        "service_microbatch_p50_ms": round(
+            batched.get("p50_ms", 0.0), 1),
+        "service_microbatch_p99_ms": round(
+            batched.get("p99_ms", 0.0), 1),
+        "service_microbatch_p50_ms_off": round(
+            legacy.get("p50_ms", 0.0), 1),
+        "service_microbatch_p99_ms_off": round(
+            legacy.get("p99_ms", 0.0), 1),
         # group-commit visibility for THIS burst scenario (the queue
         # depth a deployment wave builds is exactly the grouping
-        # opportunity): mean plans per commit over both runs
+        # opportunity): mean plans per commit over the on/off/seq runs
         "service_broker_plan_group_mean_size": round(
-            (batched["plan_group_plans"] + solo["plan_group_plans"])
-            / max(batched["plan_groups"] + solo["plan_groups"], 1), 2),
+            (batched["plan_group_plans"] + legacy["plan_group_plans"]
+             + solo["plan_group_plans"])
+            / max(batched["plan_groups"] + legacy["plan_groups"]
+                  + solo["plan_groups"], 1), 2),
         "service_broker_plan_group_conflicts":
             batched["plan_group_conflicts"]
+            + legacy["plan_group_conflicts"]
             + solo["plan_group_conflicts"],
     }
 

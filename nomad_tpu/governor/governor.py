@@ -65,6 +65,10 @@ class Governor:
         self._events: deque = deque(maxlen=EVENT_LOG_MAX)
         self._events_l = threading.Lock()
         self._lat: deque = deque(maxlen=LATENCY_RESERVOIR)
+        # full latency incl. broker queue wait — attribution/bench
+        # percentiles only, never the backpressure gauge (see
+        # observe_eval_latency)
+        self._lat_full: deque = deque(maxlen=LATENCY_RESERVOIR)
         self._lat_l = threading.Lock()
         self._evals_observed = 0
         self._last_lat_t = 0.0          # monotonic of newest latency
@@ -109,9 +113,21 @@ class Governor:
                     "governor sample failed")
 
     # -- observations --------------------------------------------------
-    def observe_eval_latency(self, seconds: float) -> None:
+    def observe_eval_latency(self, seconds: float,
+                             queue_wait_s: float = 0.0) -> None:
+        """`seconds` is the HOST processing latency — it feeds the
+        backpressure p99 gauge, whose meaning is "the host is the
+        bottleneck" (lane shrink + admission shed react to it).
+        `queue_wait_s` is broker READY-queue wait: it joins only the
+        FULL-latency reservoir (latency_percentile_ms — what an eval
+        actually experienced, the bench/attribution number). Folding
+        wait into the pressure gauge would be a positive feedback
+        loop: a backlog inflates p99, p99 sheds enqueues and shrinks
+        lanes, the queue deepens, p99 inflates further."""
         with self._lat_l:
             self._lat.append(seconds * 1000.0)
+            self._lat_full.append((seconds + max(queue_wait_s, 0.0))
+                                  * 1000.0)
             self._evals_observed += 1
             self._last_lat_t = time.monotonic()
 
@@ -149,6 +165,22 @@ class Governor:
     def latency_samples(self) -> int:
         with self._lat_l:
             return len(self._lat)
+
+    def latency_percentile_ms(self, pct: float,
+                              window: Optional[int] = None) -> float:
+        """Arbitrary percentile over the most recent `window` FULL
+        latency samples — host processing PLUS broker queue wait, what
+        an eval actually experienced (the bench reads p50/p99 of this
+        for the micro-batch on/off comparison). Distinct from the
+        host-only reservoir behind the backpressure p99 gauge."""
+        with self._lat_l:
+            lat = list(self._lat_full)
+        if window is not None:
+            lat = lat[-window:]
+        if not lat:
+            return 0.0
+        lat.sort()
+        return lat[min(len(lat) - 1, int(pct / 100.0 * len(lat)))]
 
     # -- events --------------------------------------------------------
     def emit(self, event: dict) -> None:

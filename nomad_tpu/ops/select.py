@@ -806,6 +806,13 @@ PACK_SHARD_KINDS = {
 MAX_SCAN_STEPS = 65536
 # counts at or below this take the vmapped-scan arm of select_many
 SCAN_BATCH_MAX = 256
+# max lanes one micro-batch gateway fire ships in a single vmapped
+# dispatch (server/worker.py MicroBatchGateway). Together with
+# _pad_and_stack's power-of-two lane padding this bounds the distinct
+# (arm, n_pad, lanes) trace signatures micro-batching can mint to
+# {2, 4, 8, 16} per shape bucket — the lint.recompiles gauge stays
+# bounded no matter how occupancy fluctuates per window
+GATEWAY_MAX_LANES = 16
 
 # process-wide sharded dispatcher (see SelectKernel._mesh_sharded)
 _SHARED_SHARDED = None
@@ -911,14 +918,22 @@ def pack_request(req: SelectRequest, n_pad: int):
     return args, statics
 
 
-def _note_trace(arm: str, n_pad: int, **statics) -> None:
+def _note_trace(arm: str, n_pad: int, **statics) -> bool:
     """Report this dispatch's compile key to the recompile counter
     (analysis/sanitizer.py): a NEW (arm, shape-bucket, statics) tuple
     means XLA traced and compiled. Always on — the cost is one set
     lookup — so the `nomad.lint.recompiles` governor gauge sees storms
-    in production, not just under the sanitizer."""
+    in production, not just under the sanitizer. Returns True when the
+    signature is fresh (this dispatch pays the compile): the caller
+    passes that to cost_model.observe so a compile wall is NEVER
+    blended into a steady-state EWMA — per-key first-sample
+    replacement can absorb only ONE compile, but one (arm, n_pad) key
+    folds many lane/step buckets that each compile separately (the r11
+    warm-loop pollution: three batched lane widths pushed
+    chunked_batched@2048 to 72 ms 'steady state' and demoted every
+    lane)."""
     from ..analysis.sanitizer import traces
-    traces.note(arm, (n_pad,) + tuple(sorted(statics.items())))
+    return traces.note(arm, (n_pad,) + tuple(sorted(statics.items())))
 
 
 def _sanitize_request(req: SelectRequest) -> None:
@@ -1277,13 +1292,14 @@ class DispatchCostModel:
     measured number overrides a formula, count variation deliberately
     folded into the EWMA (per-shape means per (arm, table size) — the
     steady state re-dispatches the same shapes, which is exactly when
-    the numbers matter). The FIRST sample at a shape pays XLA compile
-    and would dominate the EWMA for many rounds (alpha=0.25 decays a
-    seconds-long compile wall to ~1s after 3 samples vs a ~5ms steady
-    state); the second observation REPLACES it rather than blending.
-    Timing windows include per-request host unpack/expand on both the
-    solo and batched arms, so the comparison is end-to-end per lane,
-    not device-dispatch-only."""
+    the numbers matter). Compile walls are excluded at the source: a
+    dispatch that mints a NEW trace signature (_note_trace) reports
+    with compiled=True and never enters the EWMA — a seconds-long
+    compile would otherwise dominate it for many rounds, and one
+    (arm, n_pad) key folds many separately-compiling lane/step
+    buckets. Timing windows include per-request host unpack/expand on
+    both the solo and batched arms, so the comparison is end-to-end
+    per lane, not device-dispatch-only."""
 
     ALPHA = 0.25
     MIN_SAMPLES = 3
@@ -1296,24 +1312,40 @@ class DispatchCostModel:
         self._probe = 0
 
     def observe(self, arm: str, n_pad: int, seconds: float,
-                lanes: int = 1) -> None:
+                lanes: int = 1, compiled: bool = False) -> None:
         from ..utils import stages
         if stages.enabled:
             # every arm reports its dispatch wall here — one choke
             # point doubles as the bench's `kernel` stage accumulator
             stages.add("kernel", seconds)
-        per_lane = seconds / max(lanes, 1)
         key = (arm, n_pad)
+        if compiled:
+            # this dispatch minted a new trace signature (_note_trace):
+            # its wall includes XLA compile and must not enter the
+            # steady-state EWMA at all — one (arm, n_pad) key folds
+            # many lane/step buckets that each compile separately, so
+            # no single-replacement scheme could absorb them. The skip
+            # also satisfies a restored entry's seeded marker: the
+            # compile this restore was bracing for just happened
+            with self._l:
+                ent = self._stats.get(key)
+                if ent is not None and len(ent) > 2:
+                    ent[2] = False
+            return
+        per_lane = seconds / max(lanes, 1)
         with self._l:
             ent = self._stats.get(key)
             if ent is None:
+                # compile walls never reach this point, so the first
+                # recorded sample is already a steady-state one
                 self._stats[key] = [per_lane, 1]
-            elif ent[1] == 1:
-                # the first sample at a shape includes XLA compile;
-                # replace it with the first steady-state number
-                # instead of folding the compile wall into the EWMA
-                ent[0] = per_lane
-                ent[1] = 2
+            elif len(ent) > 2 and ent[2]:
+                # entry restored from a persisted snapshot whose
+                # this-process compile was NOT caught by the trace
+                # rule (e.g. the shape was traced earlier in-process):
+                # drop one sample defensively rather than blend a
+                # possible compile wall into a good persisted EWMA
+                ent[2] = False
             else:
                 ent[0] += self.ALPHA * (per_lane - ent[0])
                 ent[1] += 1
@@ -1334,6 +1366,57 @@ class DispatchCostModel:
             self._probe += 1
             return self._probe % self.PROBE_EVERY == 0
 
+    # -- seeding (ISSUE 7: kill the cold start) ------------------------
+    def seed(self, arm: str, n_pad: int, seconds: float,
+             lanes: int = 1) -> None:
+        """Install a steady-state measurement at MIN_SAMPLES weight so
+        the very first organic dispatch decision at this shape is
+        measured, not cold. A seed never overrides an entry that is
+        already warm from live traffic."""
+        per_lane = seconds / max(lanes, 1)
+        with self._l:
+            ent = self._stats.get((arm, n_pad))
+            if ent is None or ent[1] < self.MIN_SAMPLES:
+                self._stats[(arm, n_pad)] = [per_lane, self.MIN_SAMPLES]
+
+    def promote(self, n_pad: int) -> int:
+        """Calibration epilogue: entries at this shape count as warm
+        (samples -> MIN_SAMPLES) so routing engages off the
+        calibration run instead of waiting for 3+ organic samples.
+        Safe because compile walls never enter the stats at all
+        (observe's `compiled` flag) — any recorded sample is a
+        steady-state one."""
+        bumped = 0
+        with self._l:
+            for (_arm, np_), ent in self._stats.items():
+                if np_ == n_pad and 1 <= ent[1] < self.MIN_SAMPLES:
+                    ent[1] = self.MIN_SAMPLES
+                    bumped += 1
+        return bumped
+
+    def load_snapshot(self, snap: Dict[str, dict]) -> int:
+        """Restore persisted measurements (the snapshot() format, JSON
+        next to the WAL snapshot): each entry installs at MIN_SAMPLES
+        weight with a seeded marker so the first live observation —
+        which pays this process's XLA compile — is dropped instead of
+        blended. Entries already warm from live traffic win over the
+        file."""
+        loaded = 0
+        for key_s, ent_d in (snap or {}).items():
+            try:
+                arm, np_s = key_s.rsplit("@", 1)
+                n_pad = int(np_s)
+                ewma = float(ent_d["ewma_s"])
+            except (ValueError, KeyError, TypeError, AttributeError):
+                continue
+            with self._l:
+                ent = self._stats.get((arm, n_pad))
+                if ent is None or ent[1] < self.MIN_SAMPLES:
+                    self._stats[(arm, n_pad)] = [ewma, self.MIN_SAMPLES,
+                                                 True]
+                    loaded += 1
+        return loaded
+
     def snapshot(self) -> Dict[str, dict]:
         with self._l:
             return {f"{arm}@{n_pad}": {"ewma_s": round(ent[0], 6),
@@ -1347,6 +1430,48 @@ BATCHED_ARMS = ("chunked_batched", "kway_batched", "scan_batched")
 # process-wide: every SelectKernel (workers, gateways, benches) feeds
 # and reads the same measured numbers
 cost_model = DispatchCostModel()
+
+
+def calibrate_cost_model(n: int, count: int = 16, lanes: int = 2,
+                         kernel: Optional["SelectKernel"] = None
+                         ) -> Dict[str, dict]:
+    """Startup calibration probe (ISSUE 7): measure the solo and the
+    batched dispatch arms at the live table shape with synthetic
+    requests and seed the process-wide cost model, so batched lanes are
+    cost-favored (or correctly demoted) from the FIRST organic dispatch
+    instead of after 3+ organic samples — the 1-in-16 exploration probe
+    never fires inside short scenarios (BENCH_r05:
+    service_broker_batches=0 for the whole service run).
+
+    Two dispatches per arm: the first pays XLA compile (the cost
+    model's replace-first-sample rule discards it), the second is the
+    steady-state number; promote() then lifts both arms to engagement
+    weight. All timing flows through select()/select_many(), which
+    block on the result transfer via the `_stage_get` fence — no raw
+    host syncs here (lint: host-sync stays clean). Returns the cost
+    model snapshot at this shape for logging/benches."""
+    k = kernel or SelectKernel()
+    n_pad = _pad_n(n)
+    cap = np.tile(np.array([[4000.0, 8192.0, 102400.0, 1000.0]],
+                           np.float32), (n, 1))
+    ask = np.array([100.0, 100.0, 10.0, 0.0], np.float32)
+
+    def req():
+        return SelectRequest(
+            ask=ask, count=count, feasible=np.ones(n, bool),
+            capacity=cap, used=np.zeros_like(cap),
+            desired_count=float(count),
+            tg_collisions=np.zeros(n, np.int32),
+            job_count=np.zeros(n, np.int32))
+
+    lanes = max(2, min(int(lanes), GATEWAY_MAX_LANES))
+    for _ in range(3):          # compile round, then steady state
+        k.select(req())
+        k.select_many([req() for _ in range(lanes)])
+    cost_model.promote(n_pad)
+    snap = cost_model.snapshot()
+    return {key: v for key, v in snap.items()
+            if key.rsplit("@", 1)[-1] == str(n_pad)}
 
 
 _accel_rtt_cache: List[float] = []
@@ -1411,6 +1536,35 @@ def decorrelation_slice(req, lane: int, total: int, cache):
     if headroom < 2.0 * req.count:
         return None, cache
     return slice_mask, cache
+
+
+def partition_lanes(reqs, lane_base: int, total: int, cache):
+    """Decorrelate the lanes of ONE batched dispatch: identical argmax
+    sequences would make every lane place on the same winners and
+    collide in the plan applier (optimistic concurrency). Applies
+    decorrelation_slice per lane — hash partition + capacity-aware
+    headroom — mutating each request's feasible mask in place. Returns
+    (originals, cache): the original masks (None where untouched) so a
+    lane that can't fill its slice retries on the FULL set —
+    partitioning is a throughput heuristic and must never change
+    failure semantics. Shared by the per-batch rendezvous gateway and
+    the micro-batch gateway (server/worker.py)."""
+    lanes = len(reqs)
+    total = max(total, lanes)
+    originals = [None] * lanes
+    if not reqs:
+        return originals, cache
+    n = len(reqs[0].feasible)
+    for i, req in enumerate(reqs):
+        if len(req.feasible) != n:
+            continue
+        slice_mask, cache = decorrelation_slice(
+            req, lane_base + i, total, cache)
+        if slice_mask is None:
+            continue
+        originals[i] = req.feasible
+        req.feasible = slice_mask
+    return originals, cache
 
 
 class SelectKernel:
@@ -1634,13 +1788,14 @@ class SelectKernel:
         resident = self._resident_args(req, n_pad, dev)
         if resident:
             args.update(resident)
-        _note_trace("scan", n_pad, k_steps=k, cpu=dev is not None,
-                    **statics)
+        fresh = _note_trace("scan", n_pad, k_steps=k,
+                            cpu=dev is not None, **statics)
         t0 = _time.perf_counter()
         _carry, outs = _select_scan(**args, k_steps=k, **statics)
         out = unpack_result(req, outs)
         cost_model.observe("scan" + ("@cpu" if dev is not None else ""),
-                           n_pad, _time.perf_counter() - t0)
+                           n_pad, _time.perf_counter() - t0,
+                           compiled=fresh)
         return out
 
     # -- k-way chunked path --------------------------------------------
@@ -1665,8 +1820,9 @@ class SelectKernel:
                   dev) -> SelectResult:
         import time as _time
         cargs, spread_alg, w = self._pack_kway(req, n_pad, dev)
-        _note_trace("kway", n_pad, max_steps=_kway_steps(w),
-                    spread_alg=spread_alg, w=w, cpu=dev is not None)
+        fresh = _note_trace("kway", n_pad, max_steps=_kway_steps(w),
+                            spread_alg=spread_alg, w=w,
+                            cpu=dev is not None)
         # window matches every other arm: dispatch through
         # unpack/expand, packing/placement excluded
         t0 = _time.perf_counter()
@@ -1676,7 +1832,8 @@ class SelectKernel:
                                           pending, w=w)
         out = _expand_kway(req, rounds)
         cost_model.observe("kway" + ("@cpu" if dev is not None else ""),
-                           n_pad, _time.perf_counter() - t0)
+                           n_pad, _time.perf_counter() - t0,
+                           compiled=fresh)
         return out
 
     def select_many(self, reqs: List[SelectRequest]) -> List[SelectResult]:
@@ -1745,9 +1902,10 @@ class SelectKernel:
             cargs, sharded, reqs[0].capacity, n_pad,
             sum(min(r.count, 2 * n) for r in reqs))
         w = _kway_w(n_pad)
-        _note_trace("kway_batched", n_pad, max_steps=_kway_steps(w),
-                    spread_alg=spread_alg, w=w,
-                    lanes=len(cargs["k_valid"]))
+        fresh = _note_trace("kway_batched", n_pad,
+                            max_steps=_kway_steps(w),
+                            spread_alg=spread_alg, w=w,
+                            lanes=len(cargs["k_valid"]))
         import time as _time
         t0 = _time.perf_counter()
         with mesh_ctx:
@@ -1799,7 +1957,8 @@ class SelectKernel:
         # window includes per-lane unpack/expand so the number compares
         # end-to-end against the solo arms (which include theirs)
         cost_model.observe("kway_batched", n_pad,
-                           _time.perf_counter() - t0, lanes=len(reqs))
+                           _time.perf_counter() - t0, lanes=len(reqs),
+                           compiled=fresh)
         return results
 
     @staticmethod
@@ -1839,22 +1998,29 @@ class SelectKernel:
         dev = self._pick_device(n_pad, est_steps)
         return self._place_args(cargs, dev), contextlib.nullcontext()
 
-    def batch_dispatch_profitable(self, n: int,
-                                  count_hint: int = 16) -> bool:
+    def batch_dispatch_profitable(self, n: int, count_hint: int = 16,
+                                  tolerance: float = 1.0) -> bool:
         """Should the worker coalesce evals into gateway lanes?
 
         Recalibrated (BENCH_r05: the static model demoted every broker
         lane on real TPU even where batching measured 1.42-1.61x):
         once the cost model holds MEASURED per-lane dispatch costs for
         both a batched arm and a solo arm at this table shape, the
-        decision is simply measured-batched < measured-solo. Until the
-        batched side is warm, a periodic probe lets lanes fire so the
-        measurement exists at all. The static fallback remains: batch
-        only when the dispatch would route to the accelerator (on
-        host-routed shapes B solo chunked dispatches beat one vmapped
-        dispatch and the GIL serializes lane host work). Overridable
-        with NOMAD_TPU_EVAL_BATCH=force|off (tests force lanes on CPU
-        hosts)."""
+        decision is measured-batched < measured-solo * tolerance.
+        Until the batched side is warm, a periodic probe lets lanes
+        fire so the measurement exists at all. The static fallback
+        remains: batch only when the dispatch would route to the
+        accelerator (on host-routed shapes B solo chunked dispatches
+        beat one vmapped dispatch and the GIL serializes lane host
+        work). Overridable with NOMAD_TPU_EVAL_BATCH=force|off (tests
+        force lanes on CPU hosts).
+
+        `tolerance` > 1 is the continuous-batching caller's setting
+        (server/worker.py MicroBatchGateway): the per-lane EWMA folds
+        ALL batch widths together, so on shapes where width 2 measures
+        ~parity and width 8 wins, a strict < would flap coalescing off
+        exactly when occupancy could grow — coalesce unless the
+        batched arm measures DECISIVELY slower."""
         import os
         mode = os.environ.get("NOMAD_TPU_EVAL_BATCH", "auto")
         if mode == "force":
@@ -1867,7 +2033,7 @@ class SelectKernel:
         solo = cost_model.best(SOLO_ARMS, n_pad)
         batched = cost_model.best(BATCHED_ARMS, n_pad)
         if solo is not None and batched is not None:
-            if batched < solo:
+            if batched < solo * tolerance:
                 return True
             # measured demote — but keep the batched EWMA fresh: a
             # stale number (device contention, early-sample noise)
@@ -1895,9 +2061,9 @@ class SelectKernel:
         fn = _chunked_batched_jit(max_steps, spread_alg)
         cargs, mesh_ctx = self._place_batched(
             cargs, sharded, reqs[0].capacity, n_pad, min(maxc, 2 * n_pad))
-        _note_trace("chunked_batched", n_pad, max_steps=max_steps,
-                    spread_alg=spread_alg,
-                    lanes=len(cargs["k_valid"]))
+        fresh = _note_trace("chunked_batched", n_pad,
+                            max_steps=max_steps, spread_alg=spread_alg,
+                            lanes=len(cargs["k_valid"]))
         import time as _time
         t0 = _time.perf_counter()
         with mesh_ctx:
@@ -1933,7 +2099,8 @@ class SelectKernel:
         # window includes per-lane unpack/expand so the number compares
         # end-to-end against the solo arms (which include theirs)
         cost_model.observe("chunked_batched", n_pad,
-                           _time.perf_counter() - t0, lanes=len(reqs))
+                           _time.perf_counter() - t0, lanes=len(reqs),
+                           compiled=fresh)
         return results
 
     @staticmethod
@@ -1976,8 +2143,9 @@ class SelectKernel:
         fn = _scan_batched_jit(k, spread_alg, s_live, p_live)
         cargs, mesh_ctx = self._place_batched(
             cargs, sharded, reqs[0].capacity, n_pad, k)
-        _note_trace("scan_batched", n_pad, k_steps=k, s_live=s_live,
-                    p_live=p_live, lanes=len(cargs["k_valid"]))
+        fresh = _note_trace("scan_batched", n_pad, k_steps=k,
+                            s_live=s_live, p_live=p_live,
+                            lanes=len(cargs["k_valid"]))
         import time as _time
         t0 = _time.perf_counter()
         with mesh_ctx:
@@ -1988,7 +2156,8 @@ class SelectKernel:
         # window includes per-lane unpack so the number compares
         # end-to-end against the solo arms (which include theirs)
         cost_model.observe("scan_batched", n_pad,
-                           _time.perf_counter() - t0, lanes=len(reqs))
+                           _time.perf_counter() - t0, lanes=len(reqs),
+                           compiled=fresh)
         return results
 
     def _finish_kway_rounds(self, req, cargs, spread_alg, pending,
@@ -2044,8 +2213,8 @@ class SelectKernel:
         else:
             max_steps = 16384       # covers count<=16384 in one dispatch
                                     # (a step always places >=1 or stops)
-        _note_trace("chunked", n_pad, max_steps=max_steps,
-                    spread_alg=spread_alg, cpu=dev is not None)
+        fresh = _note_trace("chunked", n_pad, max_steps=max_steps,
+                            spread_alg=spread_alg, cpu=dev is not None)
         rounds = []
         t0 = _time.perf_counter()
         while True:
@@ -2067,7 +2236,7 @@ class SelectKernel:
         out = _expand_chunks(req, rounds)
         cost_model.observe(
             "chunked" + ("@cpu" if dev is not None else ""), n_pad,
-            _time.perf_counter() - t0)
+            _time.perf_counter() - t0, compiled=fresh)
         return out
 
 

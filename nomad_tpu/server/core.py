@@ -172,6 +172,31 @@ class ServerConfig:
     # total pending columnar-index delta debt across jobs: crossing it
     # folds the index back to dense rebuild (governor reclaim)
     governor_reconcile_index_debt_high: int = 65536
+    # adaptive micro-batch eval dispatch (server/worker.py
+    # MicroBatchGateway): concurrent evals' kernel requests accumulate
+    # for up to this window and ship as ONE vmapped padded device call.
+    # The live window adapts off the per-lane arrival-rate EWMA
+    # (idle lanes dispatch immediately) and queue depth (see
+    # governor_gateway_depth_high); over a tunneled accelerator the
+    # base widens to half the measured RTT. 0 disables the gateway
+    # entirely (exactly the pre-gateway dispatch path);
+    # NOMAD_TPU_MICROBATCH=0 is the runtime kill switch
+    gateway_window_us: int = 2000
+    # occupancy trigger: a lane holding this many parked requests
+    # fires without waiting out the window
+    gateway_min_batch: int = 4
+    # broker READY depth above which the gateway widens its window
+    # (occupancy over per-eval latency while a backlog exists; decays
+    # back once the queue drains). The governor's READY-depth
+    # watermark reclaim also widens it directly
+    governor_gateway_depth_high: int = 512
+    # startup calibration probe (ops/select.calibrate_cost_model):
+    # measure the solo + batched dispatch arms at the restored table
+    # shape and seed the dispatch cost model, so batched lanes are
+    # cost-favored from the first dispatch instead of after 3+
+    # organic samples. Pays two XLA compiles at start, so off by
+    # default; the CLI agent and the benches turn it on
+    dispatch_calibration: bool = False
 
 
 class Server:
@@ -202,6 +227,22 @@ class Server:
         self.events = EventBroker()
         from .event_sink import EventSinkManager
         self.event_sinks = EventSinkManager(self)
+        # adaptive micro-batch eval dispatch (ISSUE 7): one gateway per
+        # server — every worker's (and every lane thread's) kernel
+        # dispatches coalesce here. window=0 and the env kill switch
+        # both mean NO gateway object, so the worker path degenerates
+        # exactly to the pre-gateway one
+        import os as _os
+        self.gateway = None
+        if self.config.gateway_window_us > 0 and \
+                _os.environ.get("NOMAD_TPU_MICROBATCH", "1") \
+                not in ("0", "off"):
+            from .worker import MicroBatchGateway
+            self.gateway = MicroBatchGateway(
+                window_us=self.config.gateway_window_us,
+                min_batch=self.config.gateway_min_batch,
+                depth_fn=lambda: self.eval_broker.stats.total_ready,
+                depth_high=self.config.governor_gateway_depth_high)
         self.governor = None
         if self.config.governor_enabled:
             from ..governor import Governor
@@ -254,6 +295,19 @@ class Server:
         # event history starts HERE: restore/replay publish no events,
         # so sink progress at or below this floor has a proven gap
         self.events.epoch_floor = self._raft_index
+        if self.persistence is not None:
+            # measured per-(arm, n_pad) dispatch costs persist next to
+            # the WAL snapshot (ISSUE 7): a restarted server routes and
+            # batches off its last life's measurements instead of
+            # re-learning from cold (first live sample per shape is
+            # dropped — it pays this process's XLA compile)
+            from ..ops.select import cost_model
+            seeds = self.persistence.load_cost_model()
+            if seeds:
+                loaded = cost_model.load_snapshot(seeds)
+                LOG.info("cost model restored: %d measured shapes",
+                         loaded)
+            self.persistence.cost_model_provider = cost_model.snapshot
 
     # -- lifecycle -----------------------------------------------------
     def attach_raft(self, rpc_server, peers, self_addr: str = "") -> None:
@@ -305,6 +359,21 @@ class Server:
         self._volume_watcher.start()
         if self.governor is not None:
             self.governor.start()
+        if self.config.dispatch_calibration:
+            # seed the dispatch cost model at the restored table shape
+            # BEFORE traffic: the solo and batched arms both carry
+            # measured numbers from the first organic dispatch (no
+            # nodes yet == nothing to calibrate; benches with
+            # programmatic node seeding call calibrate_cost_model
+            # themselves after seeding)
+            try:
+                n = self.store.node_count()
+                if n >= 8:
+                    from ..ops.select import calibrate_cost_model
+                    calibrate_cost_model(
+                        n, lanes=self.config.gateway_min_batch)
+            except Exception:   # pragma: no cover — best effort
+                LOG.exception("dispatch calibration failed")
 
     def _register_governor_gauges(self) -> None:
         """Wire every long-lived structure into the governor's
@@ -320,10 +389,15 @@ class Server:
         # gauges must read through the broker, never a captured stats
 
         # broker queues: depth gauges; READY depth is the admission
-        # signal (backpressure sheds enqueues, workers shrink lanes)
+        # signal (backpressure sheds enqueues, workers shrink lanes).
+        # With the micro-batch gateway live, the watermark reclaim
+        # WIDENS its dispatch window — under a backlog, batch occupancy
+        # beats per-eval dispatch latency (ISSUE 7)
         gov.register("broker.ready", lambda: broker.stats.total_ready,
                      WatermarkPolicy(cfg.governor_broker_depth_high,
-                                     pressure=True))
+                                     pressure=True),
+                     reclaim=(self.gateway.widen_window
+                              if self.gateway is not None else None))
         gov.register("broker.unacked",
                      lambda: broker.stats.total_unacked)
         gov.register("broker.waiting",
@@ -472,6 +546,24 @@ class Server:
                          cfg.governor_reconcile_index_debt_high),
                      reclaim=lambda: self.store.alloc_index.fold())
 
+        # adaptive micro-batch gateway (server/worker.py, ISSUE 7):
+        # live window, mean lanes per device dispatch, and the trigger
+        # split — immediate (idle lane / unprofitable shape) vs
+        # deadline (window expired while streaming). All monotone or
+        # performance gauges, never drift suspects
+        if self.gateway is not None:
+            gw = self.gateway
+            gov.register("gateway.window_us", gw.window_us, unit="us",
+                         suspect=False)
+            gov.register("gateway.batch_occupancy", gw.occupancy_mean,
+                         unit="ratio", suspect=False)
+            gov.register("gateway.immediate_dispatches",
+                         lambda: gw.stats["immediate_dispatches"],
+                         suspect=False)
+            gov.register("gateway.deadline_dispatches",
+                         lambda: gw.stats["deadline_dispatches"],
+                         suspect=False)
+
         # recompile visibility (analysis/sanitizer.py): distinct
         # compiled trace signatures across every kernel arm — a
         # recompile storm shows up in /v1/operator/governor as a
@@ -599,6 +691,11 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        if self.persistence is not None:
+            try:
+                self.persistence.save_cost_model()
+            except Exception:   # pragma: no cover — best effort
+                LOG.exception("cost model save failed")
         if self.governor is not None:
             self.governor.stop()
         if getattr(self, "swim", None) is not None:

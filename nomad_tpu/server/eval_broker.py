@@ -336,6 +336,11 @@ class EvalBroker:
             self.stats.total_blocked += 1
             return
         q = self._ready.setdefault(queue, _PQ())
+        # queue-wait attribution (ISSUE 7 satellite): stamp READY-queue
+        # entry so dequeue can report how long the eval waited — the
+        # workers fold it into the sampled p99, where a backed-up
+        # queue was previously invisible
+        ev._brokered_t = time.monotonic()
         q.push(ev)
         self.stats.total_ready += 1
         self._l.notify_all()
@@ -372,6 +377,9 @@ class EvalBroker:
     def _dequeue_for_sched(self, sched: str) -> Tuple[Evaluation, str]:
         q = self._ready[sched]
         ev = q.pop()
+        ev.queue_wait_s = max(
+            0.0, time.monotonic() - getattr(ev, "_brokered_t",
+                                            time.monotonic()))
         token = generate_uuid()
         timer = threading.Timer(self.nack_timeout_s, self.nack,
                                 args=(ev.id, token))

@@ -197,6 +197,13 @@ class Persistence:
 
     SNAPSHOT = "state.snap"
     WAL = "raft.log"
+    # measured per-(arm, n_pad) dispatch costs (ops/select.py
+    # DispatchCostModel.snapshot() format: {"<arm>@<n_pad>":
+    # {"ewma_s": float, "samples": int}}), persisted as JSON next to
+    # the state snapshot so a restarted server's routing/batching
+    # decisions start measured instead of cold (ISSUE 7). Host+device
+    # local by construction — never replicated, safe to delete
+    COST_MODEL = "cost_model.json"
 
     def __init__(self, data_dir: str, snapshot_every: int = 1024):
         self.data_dir = data_dir
@@ -207,11 +214,39 @@ class Persistence:
         # server-level state (e.g. the GC TimeTable) rides along in the
         # snapshot under "extra"; the provider is set by the Server
         self.extra_provider = None
+        # set by the Server: returns the live cost-model snapshot dict;
+        # written on every state snapshot and at shutdown
+        self.cost_model_provider = None
         self.restored_extra: dict = {}
 
     @property
     def snapshot_path(self) -> str:
         return os.path.join(self.data_dir, self.SNAPSHOT)
+
+    @property
+    def cost_model_path(self) -> str:
+        return os.path.join(self.data_dir, self.COST_MODEL)
+
+    def load_cost_model(self) -> dict:
+        import json
+        try:
+            with open(self.cost_model_path) as f:
+                data = json.load(f)
+            return data if isinstance(data, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def save_cost_model(self) -> None:
+        import json
+        if self.cost_model_provider is None:
+            return
+        snap = self.cost_model_provider()
+        if not snap:
+            return
+        tmp = self.cost_model_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f, indent=0, sort_keys=True)
+        os.replace(tmp, self.cost_model_path)
 
     def restore_into(self, store) -> int:
         """Load snapshot + replay WAL into the store. Returns the highest
@@ -253,3 +288,7 @@ class Persistence:
             os.fsync(f.fileno())
         os.replace(tmp, self.snapshot_path)
         self.log.truncate()
+        try:
+            self.save_cost_model()
+        except OSError:         # pragma: no cover — best effort
+            pass

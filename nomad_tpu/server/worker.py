@@ -35,6 +35,10 @@ BACKOFF_BASE_S = 0.05
 BACKOFF_LIMIT_S = 3.0
 DEQUEUE_TIMEOUT_S = 0.5
 RAFT_SYNC_LIMIT = 10.0
+# micro-batch lane concurrency per worker: enough overlapping evals to
+# feed the gateway's coalescing, few enough that GIL-sharing host
+# phases don't inflate each other into the latency the gateway saves
+MICRO_LANES = 4
 
 
 class BatchGateway:
@@ -152,33 +156,392 @@ class BatchGateway:
         self._cv.notify_all()
 
     def _partition(self, reqs):
-        """Decorrelate concurrent lanes: identical argmax sequences
-        would make every lane place on the same winners and collide in
-        the plan applier (optimistic concurrency). The reference
-        decorrelates workers by shuffling the node list per eval
-        (stack.go:70-90); the columnar analog restricts each lane to a
-        hash-partitioned slice of the feasible set — only when the
-        slice still leaves generous headroom over the lane's ask.
-        Returns the original feasible masks (None where untouched) so
-        unlucky lanes can retry unpartitioned."""
-        from ..ops.select import decorrelation_slice
-        lanes = len(reqs)
-        total = max(self._lane_total, lanes)
-        originals = [None] * lanes
-        n = len(reqs[0].feasible)
-        for i, req in enumerate(reqs):
-            if len(req.feasible) != n:
-                continue
-            # one shared rule with the worker's solo decorrelation
-            # (ops/select.decorrelation_slice): hash-partition +
-            # capacity-aware headroom, retry-on-shortfall semantics
-            slice_mask, self._part_cache = decorrelation_slice(
-                req, self._lane_base + i, total, self._part_cache)
-            if slice_mask is None:
-                continue
-            originals[i] = req.feasible
-            req.feasible = slice_mask
+        """Decorrelate concurrent lanes (ops/select.partition_lanes:
+        hash-partition + capacity-aware headroom, retry-on-shortfall
+        semantics — one shared rule with the worker's solo
+        decorrelation and the micro-batch gateway)."""
+        from ..ops.select import partition_lanes
+        originals, self._part_cache = partition_lanes(
+            reqs, self._lane_base, self._lane_total, self._part_cache)
         return originals
+
+
+class MicroBatchGateway:
+    """Continuous micro-batching for eval kernel dispatches (ISSUE 7) —
+    the LLM-inference-server shape applied to eval dispatch: concurrent
+    evals' feasibility/rank requests accumulate in a lane for a short
+    ADAPTIVE deadline and ship as one vmapped padded kernel call
+    (ops/select.select_many), instead of each paying a full solo
+    dispatch.
+
+    One gateway per server (all workers and all their lane threads
+    share it — unlike the per-drain BatchGateway rendezvous above,
+    coalescing is continuous across dequeues and across workers).
+    Triggers, in priority order:
+
+      occupancy  len(waiting) >= gateway_min_batch (and a pipeline
+                 slot is free): the batch is wide enough — fire now,
+                 waiting longer only adds latency
+      immediate  the cost model says batched dispatch doesn't pay at
+                 this shape, or the lane is idle (nothing in flight
+                 and the EWMA of inter-arrival gaps says no companion
+                 is expected within the window): dispatch NOW,
+                 protecting p99
+      drain      an in-flight dispatch IS the window (continuous
+                 batching): requests that arrived while the device was
+                 busy park, and the moment the pipeline empties they
+                 fire as one batch — self-clocking, so occupancy grows
+                 with load and the added wait is bounded by a dispatch
+                 the request could not have started anyway
+      deadline   the oldest parked request waited out the adaptive
+                 window while requests were streaming: fire whatever
+                 accumulated (falls through SOLO when both pipeline
+                 slots are busy, so the cap never wedges an eval)
+
+    The window adapts in both directions: broker queue depth above
+    `governor_gateway_depth_high` widens it (up to 4x — under a
+    backlog, occupancy is worth more than per-eval latency) and a
+    shallow queue decays it back; the governor's reclaim hook
+    (widen_window) doubles it when the READY-depth watermark trips.
+    Two-deep pipeline: at most MAX_INFLIGHT device batches are in
+    flight — the condition variable is RELEASED around the kernel call
+    (extending the r7 double-buffering), so later evals' host phases
+    (reconcile, stack setup) overlap an in-flight device batch and
+    accumulate the next one. A fire takes at most
+    ops/select.GATEWAY_MAX_LANES requests (lane padding then lands on
+    {2,4,8,16}, bounding trace signatures).
+
+    Degeneration: `gateway_window_us=0` or NOMAD_TPU_MICROBATCH=0 mean
+    the server never constructs a gateway and the worker path is
+    exactly the pre-ISSUE-7 one."""
+
+    MAX_INFLIGHT = 2        # two-deep dispatch pipeline
+    SCALE_MAX = 4.0         # widest backpressure window multiplier
+    GAP_ALPHA = 0.5         # inter-arrival EWMA: recover from an idle
+                            # period within ~3 burst arrivals
+    GAP_CAP_WINDOWS = 8.0   # idle gaps fold in capped at 8 windows
+    STREAM_FACTOR = 2.0     # gap EWMA <= 2 windows == streaming
+    STRAGGLER_GAPS = 4.0    # idle-engine wait bound in arrival gaps:
+                            # if no companion shows within ~4 expected
+                            # gaps the stream has ended — fire rather
+                            # than pin the last eval of a burst to the
+                            # full window (p99 protection)
+    COST_TOLERANCE = 1.5    # coalesce unless the batched arm measures
+                            # decisively slower (the per-lane EWMA
+                            # folds widths: width 2 ~parity, width 8
+                            # wins — strict < would flap batching off)
+
+    def __init__(self, kernel=None, window_us: int = 2000,
+                 min_batch: int = 4, depth_fn=None, depth_high: int = 0,
+                 partition: bool = True):
+        if kernel is None:
+            from ..ops import SelectKernel
+            kernel = SelectKernel()
+        self._kernel = kernel
+        self._cv = threading.Condition()
+        self._waiting: List = []    # [[req, slot, arrival_t, decor]]
+        self._inflight = 0
+        self.min_batch = max(2, int(min_batch))
+        self.partition = partition
+        self._depth_fn = depth_fn
+        self._depth_high = int(depth_high)
+        self._scale = 1.0
+        self._gap_ewma: Optional[float] = None
+        self._last_arrival: Optional[float] = None
+        self._dispatch_ewma = 0.0   # EWMA of fire wall clock: while a
+        # dispatch is in flight, parked requests extend their deadline
+        # to cover it — the drain trigger (not a premature solo
+        # deadline fire) should collect them when the window is
+        # shorter than one dispatch
+        self._part_cache = (None, None)
+        self._solo_decor_cache = (None, None)
+        # rotating lane-partition offset: two batches fired while both
+        # in flight must not hand their lane 0 the SAME hash slice of
+        # the node table — they would argmax the same winners and
+        # collide in the plan applier exactly like unpartitioned lanes
+        self._part_rot = 0
+        self.stats = {"requests": 0, "dispatches": 0, "batches": 0,
+                      "lanes_sum": 0, "immediate_dispatches": 0,
+                      "occupancy_dispatches": 0, "drain_dispatches": 0,
+                      "deadline_dispatches": 0,
+                      "wait_s_sum": 0.0, "partition_retries": 0}
+        # window scaled to the measured dispatch latency, like the
+        # rendezvous gateway: over a tunneled accelerator one round
+        # trip costs ~70-250 ms and a ~2 ms window never forms a batch
+        # there — waiting up to half an RTT to share a dispatch is
+        # always worth it
+        self.base_window_s = max(window_us, 0) / 1e6
+        try:
+            import jax
+
+            from ..ops.select import _accel_roundtrip_s
+            if jax.default_backend() != "cpu":
+                self.base_window_s = min(
+                    max(0.5 * _accel_roundtrip_s(), self.base_window_s),
+                    0.15)
+        except Exception:
+            pass
+
+    # -- window --------------------------------------------------------
+    def window_s(self) -> float:
+        return self.base_window_s * self._scale
+
+    def window_us(self) -> float:
+        return self.window_s() * 1e6
+
+    def occupancy_mean(self) -> float:
+        return self.stats["lanes_sum"] / max(self.stats["dispatches"], 1)
+
+    def widen_window(self) -> dict:
+        """Governor reclaim hook for the READY-depth watermark: under a
+        queue backlog, a wider window buys occupancy (one padded
+        dispatch for many evals) at the cost of per-eval wait — the
+        right trade exactly when the queue, not the eval, dominates
+        latency. Decays back via _adapt once the depth clears."""
+        with self._cv:
+            self._scale = min(self._scale * 2.0, self.SCALE_MAX)
+            return {"window_us": round(self.window_us(), 1)}
+
+    def _adapt(self) -> None:
+        """Depth-coupled window adaptation (cv held): widen while the
+        broker's READY depth is over `governor_gateway_depth_high`,
+        decay back toward the configured target once the queue is
+        shallow — idle lanes additionally dispatch immediately via the
+        streaming test, so p99 is protected from both directions."""
+        if self._depth_fn is None or self._depth_high <= 0:
+            return
+        try:
+            depth = self._depth_fn()
+        except Exception:       # pragma: no cover — defensive
+            return
+        if depth > self._depth_high:
+            self._scale = min(self._scale * 1.5, self.SCALE_MAX)
+        elif depth * 4 < self._depth_high and self._scale > 1.0:
+            self._scale = max(self._scale * 0.75, 1.0)
+
+    # -- arrival-rate model --------------------------------------------
+    def _note_arrival(self, now: float) -> None:
+        if self._last_arrival is not None:
+            cap = self.GAP_CAP_WINDOWS * max(self.base_window_s, 1e-4)
+            gap = min(now - self._last_arrival, cap)
+            if self._gap_ewma is None:
+                self._gap_ewma = gap
+            else:
+                self._gap_ewma += self.GAP_ALPHA * (gap - self._gap_ewma)
+        self._last_arrival = now
+
+    def _streaming(self) -> bool:
+        """Are more requests expected within the window? Cold and idle
+        lanes say no — their requests dispatch immediately instead of
+        paying a window that nothing will share."""
+        if self.window_s() <= 0:
+            return False
+        if self._gap_ewma is None:
+            return False
+        return self._gap_ewma <= self.STREAM_FACTOR * self.window_s()
+
+    def _worth_waiting(self, req) -> bool:
+        """Cost-model gate: coalescing pays where one batched dispatch
+        beats per-lane solo dispatches within COST_TOLERANCE
+        (measured, seeded by the startup calibration probe;
+        exploration probes keep the batched side measured either
+        way)."""
+        try:
+            return self._kernel.batch_dispatch_profitable(
+                len(req.feasible), count_hint=max(req.count, 1),
+                tolerance=self.COST_TOLERANCE)
+        except Exception:       # pragma: no cover — defensive
+            return True
+
+    # -- dispatch ------------------------------------------------------
+    def dispatch(self, req, decorrelate=None):
+        """Block until this request's result is ready; requests that
+        overlap in the window return from ONE coalesced select_many.
+        `decorrelate` carries the worker's (lane, lanes) so solo fires
+        keep the cross-worker hash-slice decorrelation the direct
+        kernel path applies."""
+        import time as _time
+        slot: dict = {}
+        now = _time.monotonic()
+        entry = [req, slot, now, decorrelate]
+        with self._cv:
+            self._note_arrival(now)
+            self._adapt()
+            self.stats["requests"] += 1
+            self._waiting.append(entry)
+            worth = self._worth_waiting(req)
+            if worth and len(self._waiting) >= self.min_batch and \
+                    self._inflight < self.MAX_INFLIGHT:
+                # nomad-lint: allow[lock-discipline] _fire releases the cv around the kernel dispatch (see its body)
+                self._fire("occupancy")
+            elif not worth or (self._inflight == 0
+                               and not self._streaming()):
+                self._fire("immediate")
+            while "out" not in slot:
+                if self._waiting:
+                    if self._inflight == 0 and len(self._waiting) >= 2:
+                        # the dispatch that just landed was this
+                        # group's window: drain it as one batch
+                        if self._fire("drain"):
+                            continue
+                    eff_window = self.window_s()
+                    if self._inflight > 0:
+                        # engine busy: don't deadline-fire a parked
+                        # request solo moments before the in-flight
+                        # dispatch would have drained it into a batch
+                        eff_window = max(
+                            eff_window,
+                            min(self._dispatch_ewma * 2.0, 0.25))
+                    elif self._gap_ewma is not None:
+                        # engine idle: a companion is only expected
+                        # within ~the arrival gap — when none shows in
+                        # a few gaps the stream has ended, and the last
+                        # eval of a burst must not eat the full window
+                        eff_window = min(
+                            eff_window,
+                            max(self.STRAGGLER_GAPS * self._gap_ewma,
+                                1e-4))
+                    remaining = (self._waiting[0][2] + eff_window
+                                 - _time.monotonic())
+                    if remaining <= 0:
+                        if not self._fire("deadline"):
+                            # racing fire emptied the lane under us
+                            self._cv.wait(0.01)
+                        continue
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait(0.5)
+        out = slot["out"]
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    def _take_batch(self, max_width: int) -> Optional[List]:
+        """Pop the oldest waiter's shared-table group (same node count,
+        same capacity identity, same algorithm — select_many's batching
+        precondition), capped at max_width. Waiters left behind fire on
+        their own deadline."""
+        if not self._waiting:
+            return None
+        head = self._waiting[0][0]
+        key = (len(head.feasible), id(head.capacity), head.algorithm)
+        batch, rest = [], []
+        for e in self._waiting:
+            r = e[0]
+            if len(batch) < max_width and \
+                    (len(r.feasible), id(r.capacity),
+                     r.algorithm) == key:
+                batch.append(e)
+            else:
+                rest.append(e)
+        self._waiting = rest
+        return batch
+
+    def _fire(self, trigger: str) -> bool:
+        # cv held on entry; the kernel work runs with it RELEASED so
+        # later evals' host phases overlap the in-flight device batch
+        # and accumulate the next one (two-deep pipeline: at most
+        # MAX_INFLIGHT BATCHED dispatches in flight). With both
+        # pipeline slots busy — in practice only during a cold-start
+        # compile storm — the oldest waiter falls through SOLO, the
+        # exact unbounded-concurrency behavior of the direct kernel
+        # path, so the cap can delay coalescing but never an eval
+        from ..ops.select import GATEWAY_MAX_LANES
+        width = GATEWAY_MAX_LANES if self._inflight < self.MAX_INFLIGHT \
+            else 1
+        batch = self._take_batch(width)
+        if not batch:
+            return False
+        import time as _time
+        from ..utils import stages
+        now = _time.monotonic()
+        self.stats[trigger + "_dispatches"] += 1
+        self.stats["dispatches"] += 1
+        self.stats["lanes_sum"] += len(batch)
+        if len(batch) > 1:
+            self.stats["batches"] += 1
+        for e in batch:
+            waited = now - e[2]
+            self.stats["wait_s_sum"] += waited
+            if stages.enabled:
+                stages.add("gateway_wait", waited)
+        # every fire counts as in-flight (the drain trigger's
+        # engine-busy signal); the MAX_INFLIGHT cap only limits how
+        # WIDE a fire may be, so solo fallthroughs can exceed it
+        self._inflight += 1
+        reqs = [e[0] for e in batch]
+        decors = [e[3] for e in batch]
+        self._cv.release()
+        try:
+            outs = self._run(reqs, decors)
+        finally:
+            self._cv.acquire()
+            self._inflight -= 1
+            wall = _time.monotonic() - now
+            self._dispatch_ewma += 0.3 * (wall - self._dispatch_ewma)
+        for e, res in zip(batch, outs):
+            e[1]["out"] = res
+        self._cv.notify_all()
+        return True
+
+    def _run(self, reqs, decors) -> List:
+        try:
+            if len(reqs) == 1:
+                return [self._solo(reqs[0], decors[0])]
+            originals = None
+            if self.partition:
+                from ..ops.select import (GATEWAY_MAX_LANES,
+                                          partition_lanes)
+                # cache read/advance/writeback under the cv: two
+                # pipelined in-flight fires racing an unlocked
+                # reassignment would lose the (n, total)->lane_ids
+                # memo every time they overlap
+                with self._cv:
+                    base = self._part_rot
+                    self._part_rot = (self._part_rot + len(reqs)) \
+                        % GATEWAY_MAX_LANES
+                    cache = self._part_cache
+                originals, cache = partition_lanes(
+                    reqs, base, GATEWAY_MAX_LANES, cache)
+                with self._cv:
+                    self._part_cache = cache
+            results = self._kernel.select_many(reqs)
+            if originals is not None:
+                # a lane that could not fill its slice retries solo on
+                # the FULL node set — partitioning must never change
+                # failure semantics
+                for i, (req, res) in enumerate(zip(reqs, results)):
+                    if originals[i] is not None and \
+                            res.placed < req.count:
+                        req.feasible = originals[i]
+                        self.stats["partition_retries"] += 1
+                        results[i] = self._kernel.select(req)
+            return results
+        except Exception as e:  # pragma: no cover — defensive
+            return [e] * len(reqs)
+
+    def _solo(self, req, decor):
+        """Solo fire with the worker's cross-worker decorrelation (the
+        same hash-slice + retry-on-shortfall rule the direct kernel
+        path applies for large batch asks)."""
+        if decor is not None and req.count >= 256:
+            from ..ops.select import decorrelation_slice
+            lane, lanes = decor
+            with self._cv:
+                cache = self._solo_decor_cache
+            slice_mask, cache = decorrelation_slice(
+                req, lane, lanes, cache)
+            with self._cv:
+                self._solo_decor_cache = cache
+            if slice_mask is not None:
+                original = req.feasible
+                req.feasible = slice_mask
+                res = self._kernel.select(req)
+                if res.placed < req.count:
+                    req.feasible = original
+                    res = self._kernel.select(req)
+                return res
+        return self._kernel.select(req)
 
 
 class EvalLane:
@@ -364,11 +727,37 @@ class Worker:
             return 1
         return self.batch_size
 
+    def _micro_gateway(self):
+        """The server-wide micro-batch gateway, or None when disabled
+        (gateway_window_us=0 / NOMAD_TPU_MICROBATCH=0 — the server
+        never constructs one) or when tests force the legacy per-drain
+        rendezvous path with NOMAD_TPU_EVAL_BATCH=force."""
+        import os
+        if os.environ.get("NOMAD_TPU_EVAL_BATCH") == "force":
+            return None
+        return getattr(self.server, "gateway", None)
+
     # -- single eval ---------------------------------------------------
     def process_eval(self, ev: Evaluation, token: str,
                      dispatch=None, lat_scale: int = 1) -> None:
         from ..utils import metrics
         lane = EvalLane(self.server, ev, token)
+        if dispatch is None and ev.type != JOB_TYPE_CORE:
+            # continuous micro-batching (ISSUE 7): every eval's kernel
+            # dispatches flow through the server-wide gateway, where
+            # requests that overlap within the adaptive window coalesce
+            # into one padded device call — across lanes AND across
+            # workers. The gateway's solo path preserves the
+            # cross-worker decorrelation the direct kernel path applies
+            gw = self._micro_gateway()
+            if gw is not None:
+                n_workers = len(getattr(self.server, "workers", []) or [])
+                if n_workers > 1:
+                    from functools import partial
+                    dispatch = partial(gw.dispatch,
+                                       decorrelate=(self.id, n_workers))
+                else:
+                    dispatch = gw.dispatch
         try:
             # wait for the state store to catch up to the eval
             t0 = time.monotonic()
@@ -417,6 +806,16 @@ class Worker:
             gov = getattr(self.server, "governor", None)
             elapsed = time.monotonic() - t0
 
+            # service-latency attribution fix (ISSUE 7 satellite): the
+            # broker stamps how long the eval sat in the READY queue;
+            # without it latency reporting starts at dequeue and a
+            # backed-up queue reads as a healthy server. It feeds the
+            # governor's FULL-latency reservoir only — the
+            # backpressure p99 gauge stays host-processing-only, or a
+            # backlog would inflate the very gauge that sheds
+            # enqueues and shrinks lanes (positive feedback)
+            q_wait = getattr(ev, "queue_wait_s", 0.0)
+
             def _finish():
                 from ..utils import stages
                 if gov is not None and ev.type != JOB_TYPE_CORE:
@@ -425,7 +824,8 @@ class Worker:
                     # work in wall clock, and feeding that raw into
                     # the p99 gauge would engage backpressure on
                     # healthy wide batches (then oscillate lane width)
-                    gov.observe_eval_latency(elapsed / lat_scale)
+                    gov.observe_eval_latency(elapsed / lat_scale,
+                                             queue_wait_s=q_wait)
                 a0 = time.perf_counter() if stages.enabled else 0.0
                 self.server.eval_broker.ack(ev.id, token)
                 if stages.enabled:
@@ -449,8 +849,14 @@ class Worker:
 
     # -- batched evals -------------------------------------------------
     def process_eval_batch(self, batch: List) -> None:
-        """Process B dequeued evals as concurrent lanes sharing one
-        BatchGateway: their kernel dispatches coalesce into select_many
+        """Process B dequeued evals as concurrent lanes. With the
+        micro-batch gateway live (ISSUE 7), the lanes simply run
+        concurrently and their kernel dispatches flow into the
+        server-wide gateway, where the window/occupancy triggers — not
+        a per-drain pre-decision — determine coalescing (lanes from
+        OTHER workers join the same batches). Legacy path (gateway off
+        or NOMAD_TPU_EVAL_BATCH=force): one per-drain BatchGateway
+        rendezvous; their kernel dispatches coalesce into select_many
         calls. Host-side work (reconcile, plan build) interleaves under
         the GIL; the device sees whole batches. When the kernel's cost
         model says these shapes route to the host CPU anyway, the
@@ -470,10 +876,44 @@ class Worker:
                                          for tg in job.task_groups))
         except Exception:
             pass
+        micro = self._micro_gateway() is not None
         if not self._kernel.batch_dispatch_profitable(
-                self.server.store.node_count(), count_hint=count_hint):
+                self.server.store.node_count(), count_hint=count_hint,
+                tolerance=(MicroBatchGateway.COST_TOLERANCE
+                           if micro else 1.0)):
+            # host-routed shapes: B solo dispatches beat one vmapped
+            # dispatch and the GIL serializes lane host work — with or
+            # without the gateway, lane threads would only add overhead
             for ev, token in batch:
                 self.process_eval(ev, token)
+            return
+        if micro:
+            # bounded lane concurrency: the gateway only needs ENOUGH
+            # overlap to coalesce (its occupancy grows with load via
+            # the drain trigger), while every extra GIL-sharing host
+            # phase inflates ALL of them — lane threads PULL from the
+            # drained batch instead of one-thread-per-eval
+            lanes = min(MICRO_LANES, len(batch))
+            lock = threading.Lock()
+            it = iter(batch)
+
+            def lane_run():
+                while True:
+                    with lock:
+                        ev_tok = next(it, None)
+                    if ev_tok is None:
+                        return
+                    self.process_eval(ev_tok[0], ev_tok[1],
+                                      lat_scale=lanes)
+
+            threads = [threading.Thread(
+                target=lane_run, daemon=True,
+                name=f"worker-{self.id}-lane-{i}")
+                for i in range(lanes)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
             return
         n_workers = max(1, len(getattr(self.server, "workers", []) or []))
         gateway = BatchGateway(self._kernel, lanes=len(batch),

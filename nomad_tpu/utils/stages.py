@@ -13,6 +13,11 @@ stage of the pipeline a named accumulator:
                   AllocReconciler.compute + result staging (ISSUE 6:
                   this cost was previously invisible — it had to be
                   inferred as "the rest of the host share")
+    gateway_wait  time an eval's kernel request spent parked in the
+                  micro-batch gateway's dispatch window before its
+                  batch fired (ISSUE 7: queue/coalescing wait was
+                  invisible in the latency attribution; nests inside
+                  sched_host like the device stages do)
     sched_host    one whole scheduler Process() call as seen by the
                   worker (reconcile + placement + plan build; overlaps
                   kernel/h2d/d2h by design — see the note below)
@@ -46,7 +51,8 @@ import threading
 from typing import Dict
 
 STAGES = ("table_build", "h2d", "kernel", "d2h", "reconcile",
-          "sched_host", "plan_verify", "plan_commit", "broker_ack")
+          "gateway_wait", "sched_host", "plan_verify", "plan_commit",
+          "broker_ack")
 
 # superset accumulators: wholly contain other stages' time (sched_host
 # wraps reconcile + table_build + h2d + kernel + d2h per dispatch), so

@@ -41,10 +41,11 @@ def test_bench_py_emits_json_line_on_cpu():
     # the artifact must attribute verify separately from commit so the
     # group-commit win is measurable per round)
     # reconcile + sched_host joined the breakdown (ISSUE 6 satellite:
-    # the alloc-diff host phase is now attributable, not inferred)
+    # the alloc-diff host phase is now attributable, not inferred);
+    # gateway_wait joined in ISSUE 7 (micro-batch coalescing wait)
     for stage in ("table_build", "h2d", "kernel", "d2h", "reconcile",
-                  "sched_host", "plan_verify", "plan_commit",
-                  "broker_ack"):
+                  "gateway_wait", "sched_host", "plan_verify",
+                  "plan_commit", "broker_ack"):
         assert stage in bd, f"missing stage {stage}: {bd}"
         assert set(bd[stage]) == {"seconds", "calls", "share"}
     assert bd["kernel"]["seconds"] > 0          # e2e phases dispatched
@@ -69,6 +70,19 @@ def test_bench_py_emits_json_line_on_cpu():
     assert 0.0 <= data["engine_reuse_hit_rate"] <= 1.0
     # the broker burst scenario reports its own group sizing
     assert data["service_broker_plan_group_mean_size"] >= 1.0
+    # micro-batch gateway engagement (ISSUE 7): with the cost model
+    # calibration-seeded, the broker burst MUST coalesce evals into
+    # shared device dispatches — the r5 regression this PR kills —
+    # and the gateway's parked time is attributable in the breakdown
+    assert data["microbatch"] == "on"
+    assert data["service_broker_batches"] > 0, data
+    assert data["service_microbatch_occupancy_mean"] > 1.0, data
+    assert data["service_microbatch_window_us"] > 0
+    assert data["service_microbatch_placements_per_sec"] > 0
+    assert data["service_microbatch_placements_per_sec_off"] > 0
+    assert data["service_microbatch_speedup"] > 0
+    assert data["service_microbatch_p99_ms"] > 0
+    assert bd["gateway_wait"]["calls"] > 0
     # columnar reconcile engine (ISSUE 6): the deployment-wave scenario
     # must show the memo paying one deep diff per version pair (hit
     # rate ~1.0) and a >= 2x evals/s win over the engine-off path

@@ -294,6 +294,8 @@ class ServerConfig:
     plan_group_orphan_max: int = 7
     reconcile_documented_max: int = 512
     reconcile_orphan_max: int = 11
+    gateway_documented_us: int = 2000
+    gateway_orphan_us: int = 13
     other_knob: int = 1
 """
 
@@ -316,6 +318,7 @@ class TestSurfaceDrift:
                            'GET = "/v1/widget/"\n',
                            "governor_documented_high and "
                            "plan_group_documented_max and "
+                           "gateway_documented_us and "
                            "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
@@ -326,17 +329,23 @@ class TestSurfaceDrift:
         # reconcile_* knobs joined the contract (ISSUE 6: columnar
         # reconcile engine knobs must land in the STATUS.md knob table)
         rc_f = [f for f in out if "reconcile_orphan_max" in f.message]
+        # gateway_* knobs joined the contract (ISSUE 7: micro-batch
+        # gateway knobs must land in the STATUS.md knob table)
+        gw_f = [f for f in out if "gateway_orphan_us" in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
         assert len(pg_f) == 1
         assert len(rc_f) == 1
+        assert len(gw_f) == 1
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
                        for f in out)
         assert not any("plan_group_documented_max" in f.message
                        for f in out)
         assert not any("reconcile_documented_max" in f.message
+                       for f in out)
+        assert not any("gateway_documented_us" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -348,7 +357,9 @@ class TestSurfaceDrift:
                            "plan_group_documented_max, "
                            "plan_group_orphan_max, "
                            "reconcile_documented_max, "
-                           "reconcile_orphan_max")
+                           "reconcile_orphan_max, "
+                           "gateway_documented_us, "
+                           "gateway_orphan_us")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
