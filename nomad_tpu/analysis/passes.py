@@ -530,12 +530,13 @@ class SurfaceDriftRule(Rule):
     must appear in STATUS.md so operators can find it."""
 
     name = "surface-drift"
-    doc = "routes need CLI/test references; governor knobs in STATUS.md"
+    doc = ("routes need CLI/test references; governor/persistence "
+           "knobs in STATUS.md")
 
     # ServerConfig knob families that must appear in the STATUS.md knob
     # table (operators find them there; the table is the contract)
     KNOB_PREFIXES = ("governor_", "plan_group_", "reconcile_",
-                     "gateway_")
+                     "gateway_", "snapshot_", "wal_")
 
     def __init__(self,
                  http_path: str = "nomad_tpu/api/http.py",

@@ -877,6 +877,137 @@ def bench_deployment_wave(n_nodes: int = 1000, count: int = 10000,
     }
 
 
+def bench_cold_start(n_nodes: int = 1000, seed_allocs: int = 30000,
+                     n_jobs: int = 8, wal_tail: int = 48) -> Dict:
+    """Cold-start recovery (ISSUE 8): seed a C2M-CI-scale store, write
+    BOTH snapshot formats of the same state plus a shared WAL tail,
+    then time a fresh boot from each — snapshot restore, cold
+    resident-table build, batched WAL replay. The columnar pipeline
+    (state/columnar.py + the primed NodeTable + eager alloc index)
+    must beat the legacy object snapshot ≥ 3× on the summed recovery
+    stages (asserted in tests/test_bench_smoke.py), and after the
+    columnar boot the recovery invariants hold: the first columnar
+    read per job pays ZERO dense index rebuilds and the first
+    node_table() read pays ZERO full NodeTable builds."""
+    import os
+    import shutil
+    import tempfile
+
+    from ..mock import fixtures as mock
+    from ..models import Allocation
+    from ..models.resources import (AllocatedCpuResources,
+                                    AllocatedMemoryResources,
+                                    AllocatedResources,
+                                    AllocatedSharedResources,
+                                    AllocatedTaskResources)
+    from ..server import Server, ServerConfig
+    from ..server.persistence import Persistence
+
+    base = tempfile.mkdtemp(prefix="nomad-tpu-cold-")
+    col_dir = os.path.join(base, "columnar")
+    leg_dir = os.path.join(base, "legacy")
+    try:
+        srv = Server(ServerConfig(num_schedulers=0, data_dir=col_dir,
+                                  snapshot_background=False,
+                                  heartbeat_ttl_s=3600.0))
+        idx = srv._raft_index
+        nodes = []
+        for i in range(n_nodes):
+            node = mock.node()
+            node.name = f"cold-{i}"
+            node.datacenter = f"dc{(i % 4) + 1}"
+            node.compute_class()
+            idx += 1
+            srv.store.upsert_node(idx, node)
+            nodes.append(node)
+        jobs = []
+        per_job = max(seed_allocs // n_jobs, 1)
+        for jn in range(n_jobs):
+            job = mock.batch_job()
+            job.id = f"cold-job-{jn}"
+            tg = job.task_groups[0]
+            tg.count = per_job
+            tg.tasks[0].resources.networks = []
+            tg.networks = []
+            idx += 1
+            srv.store.upsert_job(idx, job)
+            jobs.append(job)
+            # one shared flyweight resources row per job (the C2M seed
+            # shape — the columnar pool collapses it to one entry)
+            res = AllocatedResources(
+                tasks={tg.tasks[0].name: AllocatedTaskResources(
+                    cpu=AllocatedCpuResources(cpu_shares=50),
+                    memory=AllocatedMemoryResources(memory_mb=64))},
+                shared=AllocatedSharedResources(disk_mb=10))
+            allocs = [Allocation(
+                id=f"cold-{jn}-{i:07d}", namespace="default",
+                job_id=job.id, task_group=tg.name,
+                name=f"{job.id}.{tg.name}[{i}]",
+                node_id=nodes[(jn * per_job + i) % n_nodes].id,
+                eval_id=f"cold-seed-eval-{jn}",
+                client_status="running", desired_status="run",
+                allocated_resources=res) for i in range(per_job)]
+            idx += 1
+            srv.store.bulk_load_allocs(idx, allocs)
+        srv._raft_index = srv.store.latest_index()
+        # legacy (object) snapshot of the SAME state, columnar
+        # snapshot via the server's own persistence, one shared WAL
+        # tail appended after both
+        leg = Persistence(leg_dir, columnar=False, background=False)
+        leg.snapshot(srv.store)
+        srv.persistence.snapshot(srv.store)
+        for k in range(wal_tail):
+            srv.raft_apply("eval_update",
+                           dict(evals=[_eval_for(jobs[k % n_jobs])]))
+        srv.shutdown()
+        shutil.copyfile(os.path.join(col_dir, "raft.log"),
+                        os.path.join(leg_dir, "raft.log"))
+
+        def boot(data_dir: str):
+            s2 = Server(ServerConfig(num_schedulers=0,
+                                     data_dir=data_dir,
+                                     heartbeat_ttl_s=3600.0))
+            st = dict(s2.cold_start_stats)
+            st["total_s"] = (st["restore_s"] + st["table_build_s"]
+                             + st["wal_replay_s"])
+            return s2, st
+
+        s2, cst = boot(col_dir)
+        assert cst["snapshot_format"] == 2.0, cst
+        # recovery invariants (acceptance): the first columnar read
+        # per job finds the eagerly rebuilt index (zero dense
+        # rebuilds), the first table read finds the primed resident
+        # table (zero full builds)
+        snap = s2.store.snapshot()
+        for job in jobs:
+            snap.job_alloc_columns("default", job.id)
+        assert s2.store.alloc_index.stats["rebuilds"] == 0, \
+            s2.store.alloc_index.stats
+        snap.node_table()
+        assert s2.store.table_cache.stats["full_builds"] == 0, \
+            s2.store.table_cache.stats
+        n_allocs = sum(1 for _ in s2.store.allocs())
+        s2.shutdown()
+
+        s3, lst = boot(leg_dir)
+        assert lst["snapshot_format"] == 1.0, lst
+        assert sum(1 for _ in s3.store.allocs()) == n_allocs
+        s3.shutdown()
+        return {
+            "cold_nodes": n_nodes,
+            "cold_allocs": n_allocs,
+            "cold_restore_s": round(cst["restore_s"], 4),
+            "cold_table_build_s": round(cst["table_build_s"], 4),
+            "cold_wal_replay_s": round(cst["wal_replay_s"], 4),
+            "cold_start_s": round(cst["total_s"], 4),
+            "cold_start_legacy_s": round(lst["total_s"], 4),
+            "cold_start_speedup": round(
+                lst["total_s"] / max(cst["total_s"], 1e-9), 2),
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def run_ladder(quick: bool = False) -> Dict:
     """Run the full ladder; returns a flat dict of results."""
     out: Dict = {}
@@ -911,4 +1042,11 @@ def run_ladder(quick: bool = False) -> Dict:
         count=2000 if quick else 10000,
         versions=2 if quick else 3,
         evals_per_version=8))
+    # cold-start recovery: columnar vs legacy snapshot restore on the
+    # same seeded store (ISSUE 8; speedup floor asserted in
+    # tests/test_bench_smoke.py)
+    out.update(bench_cold_start(
+        n_nodes=300 if quick else 1000,
+        seed_allocs=8000 if quick else 30000,
+        n_jobs=6 if quick else 8))
     return out

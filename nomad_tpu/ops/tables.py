@@ -104,6 +104,27 @@ def _reserved_port_bits(spec: str) -> int:
     return bits
 
 
+def _res_port_bits(res) -> int:
+    """Port bitmask of one AllocatedResources graph (the unmemoized
+    core of NodeTable._alloc_port_bits; the columnar cold build calls
+    it once per unique resources-pool entry)."""
+    if res is None:
+        return 0
+    bits = 0
+    for nw in res.shared.networks:
+        for ports in (nw.reserved_ports, nw.dynamic_ports):
+            for p in ports:
+                if p.value > 0:
+                    bits |= 1 << p.value
+    for task in res.tasks.values():
+        for nw in task.networks:
+            for ports in (nw.reserved_ports, nw.dynamic_ports):
+                for p in ports:
+                    if p.value > 0:
+                        bits |= 1 << p.value
+    return bits
+
+
 def _alloc_usage(alloc) -> Tuple[float, float, float, float]:
     res = alloc.allocated_resources
     if res is not None:
@@ -295,6 +316,64 @@ class NodeTable:
         one table serves every eval (SURVEY §7.2 step 8)."""
         return cls.build(snapshot, datacenters=None, include_all=True)
 
+    @classmethod
+    def build_from_columns(cls, snapshot, cold) -> "NodeTable":
+        """Vectorized cold build from a columnar restore's decoded
+        alloc columns (state/columnar.py ColdAllocColumns — ISSUE 8):
+        used-resources lands as ONE np.add.at scatter over (node row,
+        resources-pool code), with usage and port bits computed once
+        per UNIQUE pool entry instead of once per alloc. Produces a
+        table identical to build_all(snapshot) on the same state
+        (liveness, row lists, port bits — parity-tested in
+        tests/test_cold_start.py)."""
+        nodes = sorted(snapshot.nodes(), key=lambda n: n.id)
+        BUILD_STATS["column_builds"] = \
+            BUILD_STATS.get("column_builds", 0) + 1
+        t = cls(nodes)
+        n_rows = len(cold.allocs)
+        if n_rows:
+            idx_get = t.id_to_idx.get
+            node_idx = np.fromiter(
+                (idx_get(nid, -1) for nid in cold.node_ids),
+                np.int32, n_rows)
+            sel = cold.live & (node_idx >= 0)
+            # usage LUT + port bits once per unique resources row;
+            # code -1 (no resources) lands on the trailing zero row
+            pool = cold.res_pool
+            lut = np.zeros((len(pool) + 1, RES_DIMS), np.float32)
+            pool_bits: List[int] = []
+            for c, res in enumerate(pool):
+                comp = res.comparable()
+                lut[c] = (float(comp.cpu_shares), float(comp.memory_mb),
+                          float(comp.disk_mb),
+                          float(sum(nw.mbits for nw in comp.networks)))
+                pool_bits.append(_res_port_bits(res))
+            if cold.res_codes is not None:
+                # astype always copies: frombuffer views are read-only
+                codes = cold.res_codes.astype(np.int32)
+                codes[codes < 0] = len(pool)
+            else:
+                codes = np.full(n_rows, len(pool), np.int32)
+            live_rows = np.nonzero(sel)[0]
+            ii = node_idx[live_rows]
+            np.add.at(t.base_used, ii, lut[codes[live_rows]])
+            rows = t.live_allocs
+            allocs = cold.allocs
+            sel_nodes = ii.tolist()
+            for j, i in zip(live_rows.tolist(), sel_nodes):
+                rows[i].append(allocs[j])
+            if any(pool_bits):
+                net_bits = t._net_bits
+                npool = len(pool)
+                for i, c in zip(sel_nodes, codes[live_rows].tolist()):
+                    if c < npool:
+                        b = pool_bits[c]
+                        if b:
+                            net_bits[i] |= b
+            t._bulk_rows_pending = True
+        t.finalize()
+        return t
+
     def clone_for_deltas(self) -> "NodeTable":
         """Copy-on-write clone sharing the immutable node columns
         (capacity, attrs, ids) but with private usage state, so alloc
@@ -340,18 +419,7 @@ class NodeTable:
         hit = _port_bits_memo.get(id(res))
         if hit is not None and hit[0] is res:
             return hit[1]
-        bits = 0
-        for nw in res.shared.networks:
-            for ports in (nw.reserved_ports, nw.dynamic_ports):
-                for p in ports:
-                    if p.value > 0:
-                        bits |= 1 << p.value
-        for task in res.tasks.values():
-            for nw in task.networks:
-                for ports in (nw.reserved_ports, nw.dynamic_ports):
-                    for p in ports:
-                        if p.value > 0:
-                            bits |= 1 << p.value
+        bits = _res_port_bits(res)
         _memo_insert(_port_bits_memo, id(res), (res, bits))
         return bits
 
@@ -637,6 +705,38 @@ class NodeTableCache:
         t.device_mirror = self.device
         t.device_version = version
         return t
+
+    def prime(self, snapshot, cold=None) -> None:
+        """Cold-start install (ISSUE 8 — server/core.py restore
+        pipeline): build the resident table ONCE at the restored index,
+        from the snapshot's decoded alloc columns when available
+        (NodeTable.build_from_columns), so the first eval after
+        recovery takes the delta path instead of paying a dense
+        rebuild inside its latency budget. Pair with prefetch_device()
+        to overlap the device H2D upload with WAL tail replay."""
+        from ..utils import stages
+        t0 = time.perf_counter() if stages.enabled else 0.0
+        t = (NodeTable.build_from_columns(snapshot, cold)
+             if cold is not None else NodeTable.build_all(snapshot))
+        with self._lock:
+            self._table = self._stamp(t, self.device.note_rebuild())
+            self._index = snapshot.latest_index()
+            self.stats["primes"] = self.stats.get("primes", 0) + 1
+        if stages.enabled:
+            stages.add("table_build", time.perf_counter() - t0)
+
+    def prefetch_device(self) -> None:
+        """Materialize the device mirror for the current table (full
+        H2D upload). Run on a background thread at cold start so the
+        upload overlaps WAL replay; a no-op when nothing is primed."""
+        with self._lock:
+            t = self._table
+        if t is None:
+            return
+        try:
+            self.device.arrays_for(t)
+        except Exception:       # pragma: no cover — defensive: a dead
+            pass                # device falls back to dense shipping
 
     def get(self, snapshot, build: bool = True) -> Optional[NodeTable]:
         from ..utils import stages

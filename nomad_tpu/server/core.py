@@ -93,6 +93,22 @@ class ServerConfig:
     dev_mode: bool = True
     data_dir: str = ""              # empty == in-memory only
     snapshot_every: int = 1024      # WAL entries between snapshots
+    # columnar snapshot & cold-start recovery pipeline (ISSUE 8,
+    # server/persistence.py + state/columnar.py):
+    # write format-2 columnar snapshots (struct-of-arrays framed in
+    # msgpack) instead of the legacy per-object dump; restore reads
+    # BOTH formats regardless, so flipping this is always safe
+    snapshot_columnar: bool = True
+    # serialize snapshots on a background thread off an O(1) MVCC
+    # store snapshot — maybe_snapshot only triggers, the applier never
+    # blocks on a dump of a large store
+    snapshot_background: bool = True
+    # WAL durability: fsync appends (False matches the pre-r12
+    # flush-only behavior — tests and benches stay fast); with fsync
+    # on, wal_group_fsync pays ONE fsync per committed apply batch
+    # (the raft FSM batch / dev-mode entry) instead of one per frame
+    wal_fsync: bool = False
+    wal_group_fsync: bool = True
     # GC cadence + retention (nomad/config.go *GCInterval/*GCThreshold)
     gc_interval_s: float = 60.0
     eval_gc_threshold_s: float = 3600.0
@@ -269,29 +285,53 @@ class Server:
 
         # restore persisted state AFTER all subsystems exist: WAL replay
         # drives the same FSM appliers (broker/blocked are disabled until
-        # leadership, so replay has no scheduling side effects)
+        # leadership, so replay has no scheduling side effects, and no
+        # change events publish — replay is not new history)
         self.persistence = None
+        self.cold_start_stats: Dict[str, float] = {}
         if self.config.data_dir:
             from .persistence import Persistence
-            self.persistence = Persistence(self.config.data_dir,
-                                           self.config.snapshot_every)
+            self.persistence = Persistence(
+                self.config.data_dir, self.config.snapshot_every,
+                columnar=self.config.snapshot_columnar,
+                background=self.config.snapshot_background,
+                wal_fsync=self.config.wal_fsync,
+                wal_group_fsync=self.config.wal_group_fsync)
             self.persistence.extra_provider = lambda: {
                 "time_table": self.time_table.dump()}
+            t0 = time.perf_counter()
             highest, entries = self.persistence.restore_into(self.store)
+            restore_s = time.perf_counter() - t0
             self.time_table.restore(
                 self.persistence.restored_extra.get("time_table", []))
             self._raft_index = max(self._raft_index, highest)
-            for index, msg_type, payload, ts in entries:
-                if index <= highest:
-                    continue
-                try:
-                    getattr(self, f"_apply_{msg_type}")(index, payload)
-                    self._raft_index = max(self._raft_index, index)
-                    if ts:
-                        self.time_table.witness(index, ts)
-                except Exception:
-                    LOG.exception("WAL replay failed at %d/%s",
-                                  index, msg_type)
+            # cold-start pipeline (ISSUE 8): prime the resident node
+            # table ONCE at the restored index — from the snapshot's
+            # decoded columns when the format provides them — then let
+            # the device H2D upload overlap the WAL tail replay below;
+            # the first eval after recovery rides the delta path, and
+            # the eagerly rebuilt alloc index (state/store.py restore)
+            # keeps reconcile.index_rebuilds at zero
+            table_build_s = 0.0
+            if highest > 0:
+                t0 = time.perf_counter()
+                self.store.table_cache.prime(self.store.snapshot(),
+                                             self.store.pop_cold_columns())
+                table_build_s = time.perf_counter() - t0
+                threading.Thread(target=self.store.table_cache
+                                 .prefetch_device, daemon=True,
+                                 name="table-prefetch").start()
+            t0 = time.perf_counter()
+            replayed = self._replay_entries(entries, highest)
+            wal_replay_s = time.perf_counter() - t0
+            self.cold_start_stats = {
+                "restore_s": restore_s,
+                "table_build_s": table_build_s,
+                "wal_replay_s": wal_replay_s,
+                "wal_entries_replayed": float(replayed),
+                "snapshot_format": float(
+                    self.persistence.stats["restore_format"]),
+            }
         # event history starts HERE: restore/replay publish no events,
         # so sink progress at or below this floor has a proven gap
         self.events.epoch_floor = self._raft_index
@@ -308,6 +348,8 @@ class Server:
                 LOG.info("cost model restored: %d measured shapes",
                          loaded)
             self.persistence.cost_model_provider = cost_model.snapshot
+            if self.governor is not None:
+                self._register_persistence_gauges()
 
     # -- lifecycle -----------------------------------------------------
     def attach_raft(self, rpc_server, peers, self_addr: str = "") -> None:
@@ -578,6 +620,27 @@ class Server:
         # pressure gauge is over
         self.eval_broker.pressure_fn = gov.backpressure
 
+    def _register_persistence_gauges(self) -> None:
+        """Snapshot cadence, off-thread serialization time, and skipped
+        triggers (ISSUE 8 cold-start pipeline) — a snapshot that keeps
+        getting skipped-in-flight means the store outgrew the writer
+        and the WAL tail is ballooning. Registered separately from
+        _register_governor_gauges because Persistence is constructed
+        after the governor. All monotone/perf gauges, never drift
+        suspects."""
+        p = self.persistence
+        gov = self.governor
+        gov.register("persistence.snapshots",
+                     lambda: p.stats["snapshots"], suspect=False)
+        gov.register("persistence.snapshot_skipped_inflight",
+                     lambda: p.stats["snapshot_skipped_inflight"],
+                     suspect=False)
+        gov.register("persistence.last_snapshot_s",
+                     lambda: p.stats["last_snapshot_s"], unit="s",
+                     suspect=False)
+        gov.register("persistence.snapshot_errors",
+                     lambda: p.stats["snapshot_errors"], suspect=False)
+
     def _emit_stats(self) -> None:
         """Periodic gauge emission (eval_broker.go:825 EmitStats,
         blocked_evals stats, worker counters)."""
@@ -693,6 +756,11 @@ class Server:
         self._shutdown = True
         if self.persistence is not None:
             try:
+                # a background snapshot writer racing teardown could
+                # leave a half-written .tmp for the next boot to skip;
+                # wait it out, then flush any fsync-pending WAL bytes
+                self.persistence.wait_idle()
+                self.persistence.commit_barrier()
                 self.persistence.save_cost_model()
             except Exception:   # pragma: no cover — best effort
                 LOG.exception("cost model save failed")
@@ -826,6 +894,125 @@ class Server:
             elif ev.should_block():
                 self.blocked_evals.block(ev)
 
+    # -- WAL replay (cold start; ISSUE 8 batched replay) ---------------
+    # entry types whose replay batches through the store's bulk paths;
+    # a batch flushes when the incoming entry shares a (namespace, job)
+    # with one already pending, so the grouped transaction is EXACTLY
+    # state-equivalent to sequential per-entry replay (the per-entry
+    # side-effect loops only ever read/write their own job's rows)
+    _REPLAY_BATCH_TYPES = ("eval_update", "alloc_client_update")
+
+    def _replay_entries(self, entries, highest: int) -> int:
+        """Replay the WAL tail into the FSM. Event publication is
+        suppressed throughout (replay is not new history — the epoch
+        floor is raised after), and runs of eval/alloc-update entries
+        group into single store transactions
+        (NOMAD_TPU_WAL_REPLAY_BATCH=0 forces the sequential path for
+        bisection)."""
+        import os as _os
+
+        from ..utils import stages
+        batch_on = _os.environ.get("NOMAD_TPU_WAL_REPLAY_BATCH", "1") \
+            not in ("0", "off")
+        t0 = time.perf_counter() if stages.enabled else 0.0
+        pending: List = []          # one same-type run
+        pending_jobs: set = set()
+        applied = 0
+
+        def job_keys(msg_type: str, p: dict) -> set:
+            keys = {(e.namespace, e.job_id) for e in p.get("evals", [])}
+            if msg_type == "alloc_client_update":
+                keys |= {(a.namespace, a.job_id)
+                         for a in p.get("allocs", [])}
+            return keys
+
+        def flush() -> None:
+            if not pending:
+                return
+            if len(pending) == 1:
+                self._replay_one(*pending[0])
+            else:
+                try:
+                    if pending[0][1] == "eval_update":
+                        self._replay_eval_updates(pending)
+                    else:
+                        self._replay_alloc_client_updates(pending)
+                    for index, _mt, _p, ts in pending:
+                        self._raft_index = max(self._raft_index, index)
+                        if ts:
+                            self.time_table.witness(index, ts)
+                except Exception:
+                    LOG.exception("batched WAL replay failed "
+                                  "(%d %s entries)", len(pending),
+                                  pending[0][1])
+            pending.clear()
+            pending_jobs.clear()
+
+        for index, msg_type, payload, ts in entries:
+            if index <= highest:
+                continue
+            applied += 1
+            if batch_on and msg_type in self._REPLAY_BATCH_TYPES:
+                keys = job_keys(msg_type, payload)
+                if pending and (pending[0][1] != msg_type
+                                or keys & pending_jobs):
+                    flush()
+                pending.append((index, msg_type, payload, ts))
+                pending_jobs.update(keys)
+                continue
+            flush()
+            self._replay_one(index, msg_type, payload, ts)
+        flush()
+        if stages.enabled:
+            stages.add("wal_replay", time.perf_counter() - t0)
+        return applied
+
+    def _replay_one(self, index: int, msg_type: str, payload: dict,
+                    ts: float) -> None:
+        try:
+            getattr(self, f"_apply_{msg_type}")(index, payload)
+            self._raft_index = max(self._raft_index, index)
+            if ts:
+                self.time_table.witness(index, ts)
+        except Exception:
+            LOG.exception("WAL replay failed at %d/%s", index, msg_type)
+
+    def _replay_eval_updates(self, pending: List) -> None:
+        """N job-disjoint eval_update entries as ONE store transaction;
+        the per-eval side effects run per entry exactly as
+        _apply_eval_update would (broker/blocked are disabled during
+        replay, so enqueue is a no-op; reconcile writes are real)."""
+        self.store.upsert_evals_batch(
+            [(index, p["evals"]) for index, _mt, p, _ts in pending])
+        for index, _mt, p, _ts in pending:
+            for ev in p["evals"]:
+                self.enqueue_eval(ev)
+                if ev.job_id and ev.type != JOB_TYPE_CORE:
+                    self.store.reconcile_job_status(index, ev.namespace,
+                                                    ev.job_id)
+
+    def _replay_alloc_client_updates(self, pending: List) -> None:
+        """N job-disjoint alloc_client_update entries: one batched
+        store transaction for the alloc merges, then each entry's
+        unblock/eval/status side effects in order (job-disjointness
+        makes this exactly sequential-equivalent)."""
+        self.store.update_allocs_from_client_batch(
+            [(index, p["allocs"]) for index, _mt, p, _ts in pending])
+        for index, _mt, p, _ts in pending:
+            for stub in p["allocs"]:
+                alloc = self.store.alloc_by_id(stub.id)
+                if alloc is None or not alloc.client_terminal_status():
+                    continue
+                node = self.store.node_by_id(alloc.node_id)
+                if node is not None:
+                    self.blocked_evals.unblock(node.computed_class,
+                                               index)
+            for ev in p.get("evals", []):
+                self.store.upsert_evals(index, [ev])
+                self.enqueue_eval(ev)
+            self._reconcile_job_statuses(index,
+                                         {"allocs_placed": p["allocs"]})
+
     # -- raft apply ----------------------------------------------------
     def raft_apply(self, msg_type: str, payload: dict) -> int:
         """Serialized FSM apply (fsm.go Apply:210-300). Returns the
@@ -898,6 +1085,9 @@ class Server:
             fn(index, payload)
             self.time_table.witness(index)
             if self.persistence is not None:
+                # dev mode: the entry IS the commit unit, so the
+                # group-fsync barrier sits right here
+                self.persistence.commit_barrier()
                 self.persistence.maybe_snapshot(self.store)
             try:
                 self.events.publish(events_from_apply(

@@ -267,6 +267,14 @@ class RaftNode:
                     with self.server._raft_l:
                         if self.server._raft_index < idx:
                             self.server._raft_index = idx
+            # group-fsync barrier (ISSUE 8): the committed batch is the
+            # WAL's commit unit — one fsync covers every entry recorded
+            # above instead of one per frame (wal_group_fsync)
+            if self.server.persistence is not None:
+                try:
+                    self.server.persistence.commit_barrier()
+                except OSError:     # pragma: no cover — best effort
+                    LOG.exception("WAL group fsync failed")
             with self._commit_cv:
                 self._commit_cv.notify_all()   # wake wait_for_applied
 

@@ -255,11 +255,47 @@ class AllocIndexCache:
                 return
             e.deltas.append((index, op, payload))
 
-    def invalidate_all(self) -> None:
-        """Wholesale state replacement (bulk load / restore): every
-        entry is stale beyond delta repair."""
+    def install(self, key: Tuple[str, str], cols: JobAllocColumns,
+                version: int) -> None:
+        """Install a pre-built entry (restore's eager rebuild — ISSUE
+        8 satellite). Caller holds the store lock, same as the install
+        in get(), so the unlocked early-out in _note stays safe."""
+        if not self.enabled:
+            return
         with self._lock:
-            self._entries.clear()
+            while len(self._entries) >= self.max_jobs:
+                self._entries.pop(next(iter(self._entries)))
+                self.stats["entry_drops"] += 1
+            self._entries[key] = _Entry(cols, version)
+
+    def note_bulk_load(self, index: int,
+                       groups: Dict[Tuple[str, str], List[Allocation]],
+                       had_prior: Dict[Tuple[str, str], bool]) -> None:
+        """Wholesale insert of brand-new allocs (store.bulk_load_allocs
+        — called under the store lock): keep the index WARM instead of
+        invalidating. An existing entry absorbs its job's rows in place
+        (bulk loads ride the module's single-reconciling-reader
+        contract: nobody reconciles a job mid-seed) and advances to
+        `index` so older snapshots fall back to detached dense builds;
+        a job with NO prior allocs gets a fresh entry built from
+        exactly this batch — the whole job state. A job with prior
+        allocs but no live entry stays absent (lazy build on first
+        read, as before)."""
+        if not self.enabled:
+            return
+        for key, allocs in groups.items():
+            with self._lock:
+                e = self._entries.get(key)
+                if e is not None:
+                    for a in allocs:
+                        e.cols.upsert(a)
+                    e.version = max(e.version, index)
+                elif not had_prior.get(key):
+                    while len(self._entries) >= self.max_jobs:
+                        self._entries.pop(next(iter(self._entries)))
+                        self.stats["entry_drops"] += 1
+                    self._entries[key] = _Entry(
+                        JobAllocColumns.build(allocs), index)
 
     # -- reads ---------------------------------------------------------
     def get(self, snapshot, namespace: str,

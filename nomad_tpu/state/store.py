@@ -348,7 +348,9 @@ class StateSnapshot:
 
     # -- checkpoint (fsm.go Snapshot:1360) -----------------------------
     def dump(self) -> dict:
-        """Wire-encode the full database for a snapshot file. Defined on
+        """Wire-encode the full database for a snapshot file (LEGACY
+        object format — one wire dict per row; the raft InstallSnapshot
+        wire keeps using it for cross-version compatibility). Defined on
         the snapshot view so a raft leader can capture an O(1) MVCC root
         under the apply lock and serialize it afterwards without
         blocking writers (raft.py _send_snapshot)."""
@@ -357,13 +359,38 @@ class StateSnapshot:
         out = {"indexes": dict(root.indexes.items()), "tables": {}}
         plain = out["tables"]
         plain["nodes"] = [to_wire(n) for n in root.table("nodes").values()]
+        plain["evals"] = [to_wire(e) for e in root.table("evals").values()]
+        plain["allocs"] = [to_wire(a) for a in root.table("allocs").values()]
+        self._dump_small(root, plain)
+        return out
+
+    def dump_columnar(self) -> dict:
+        """Format-2 snapshot: the three big tables (allocs/evals/nodes)
+        as struct-of-arrays (state/columnar.py — numpy buffers framed
+        in msgpack, dedup pools for nested values), everything else in
+        the legacy wire shape. Encode/decode is O(columns + unique
+        nested values) instead of O(objects)."""
+        from .columnar import SNAPSHOT_FORMAT, encode_table
+        root = self._root
+        out = {"format": SNAPSHOT_FORMAT,
+               "indexes": dict(root.indexes.items()),
+               "tables": {}, "columnar": {}}
+        self._dump_small(root, out["tables"])
+        cal = out["columnar"]
+        cal["nodes"] = encode_table(list(root.table("nodes").values()))
+        cal["evals"] = encode_table(list(root.table("evals").values()))
+        cal["allocs"] = encode_table(list(root.table("allocs").values()))
+        return out
+
+    def _dump_small(self, root: _Root, plain: dict) -> None:
+        """Every table EXCEPT the big three — shared by the legacy and
+        columnar dump formats."""
+        from ..utils.codec import to_wire
         plain["jobs"] = [to_wire(j) for j in root.table("jobs").values()]
         plain["job_versions"] = [
             {"key": list(k), "versions": {str(v): to_wire(j)
                                           for v, j in versions.items()}}
             for k, versions in root.table("job_versions").items()]
-        plain["evals"] = [to_wire(e) for e in root.table("evals").values()]
-        plain["allocs"] = [to_wire(a) for a in root.table("allocs").values()]
         plain["deployments"] = [to_wire(d)
                                 for d in root.table("deployments").values()]
         plain["job_summaries"] = [to_wire(s) for s in
@@ -395,7 +422,6 @@ class StateSnapshot:
                                root.table("namespaces").values()]
         plain["vault_accessors"] = [to_wire(a) for a in
                                     root.table("vault_accessors").values()]
-        return out
 
 
 class StateStore(StateSnapshot):
@@ -425,6 +451,9 @@ class StateStore(StateSnapshot):
         # every alloc mutation below
         from .alloc_index import AllocIndexCache
         self.alloc_index = AllocIndexCache()
+        # decoded alloc columns left behind by a columnar restore for
+        # the resident table's vectorized cold build (pop_cold_columns)
+        self._cold_columns = None
 
     # -- changelog -----------------------------------------------------
     def _log_change(self, index: int, kind: str, key: str) -> None:
@@ -887,6 +916,24 @@ class StateStore(StateSnapshot):
             root = root.with_index("evals", index)
             self._publish(root)
 
+    def upsert_evals_batch(
+            self, items: List[Tuple[int, List[Evaluation]]]) -> None:
+        """Batched WAL replay (ISSUE 8): N `eval_update` entries' evals
+        on ONE edit root with ONE publish, each eval stamped with its
+        own entry index — state-equivalent to sequential upsert_evals
+        calls."""
+        if not items:
+            return
+        with self._lock:
+            root = self._root.edit()
+            last = 0
+            for index, evals in items:
+                for e in evals:
+                    root = self._upsert_eval_impl(root, index, e)
+                last = index
+            root = root.with_index("evals", last)
+            self._publish(root)
+
     def _upsert_eval_impl(self, root: _Root, index: int, e: Evaluation) -> _Root:
         existing = root.table("evals").get(e.id)
         if existing is not None:
@@ -941,6 +988,7 @@ class StateStore(StateSnapshot):
             pairs: List[Tuple[str, Allocation]] = []
             by_node: Dict[str, List[str]] = {}
             by_job: Dict[Tuple[str, str], List[str]] = {}
+            by_job_objs: Dict[Tuple[str, str], List[Allocation]] = {}
             by_eval: Dict[str, List[str]] = {}
             summary_delta: Dict[Tuple[str, str], Dict[str, Dict[str, int]]] = {}
             for a in allocs:
@@ -950,12 +998,19 @@ class StateStore(StateSnapshot):
                 pairs.append((a.id, a))
                 by_node.setdefault(a.node_id, []).append(a.id)
                 by_job.setdefault((a.namespace, a.job_id), []).append(a.id)
+                by_job_objs.setdefault((a.namespace, a.job_id),
+                                       []).append(a)
                 by_eval.setdefault(a.eval_id, []).append(a.id)
                 b = _client_status_bucket(a)
                 if b is not None:
                     tgs = summary_delta.setdefault((a.namespace, a.job_id), {})
                     counts = tgs.setdefault(a.task_group, {})
                     counts[b] = counts.get(b, 0) + 1
+            # captured BEFORE the index update below: a job with no
+            # prior allocs can take a fresh columnar-index entry built
+            # from exactly this batch (note_bulk_load)
+            prior_jobs = {key: root.table("allocs_by_job").get(key)
+                          is not None for key in by_job}
             root = root.with_table("allocs", t.update(pairs))
             for name, groups in (("allocs_by_node", by_node),
                                  ("allocs_by_job", by_job),
@@ -991,12 +1046,18 @@ class StateStore(StateSnapshot):
                 root = root.with_table("job_summaries", summaries) \
                            .with_index("job_summaries", index)
             root = root.with_index("allocs", index)
-            # invalidate the delta path wholesale: one rebuild beats
-            # replaying a multi-million-row changelog
+            # invalidate the RESIDENT TABLE delta path wholesale: one
+            # rebuild beats replaying a multi-million-row changelog
             self._changes.clear()
             self._change_indexes.clear()
             self._change_floor = index
-            self.alloc_index.invalidate_all()
+            # …but keep the per-job columnar alloc index WARM (ISSUE 8
+            # satellite — the old invalidate_all here made the eval
+            # after a seed/restore pay a dense rebuild): existing
+            # entries absorb the new rows in place, brand-new jobs get
+            # a fresh entry built from exactly this batch
+            self.alloc_index.note_bulk_load(index, by_job_objs,
+                                            prior_jobs)
             self._publish(root)
 
     def _upsert_alloc_impl(self, root: _Root, index: int, a: Allocation) -> _Root:
@@ -1060,29 +1121,51 @@ class StateStore(StateSnapshot):
                                   allocs: List[Allocation]) -> None:
         """Client pushes task states / client status (node_endpoint.go:1065)."""
         with self._lock:
-            root = self._root.edit()
-            for update in allocs:
-                existing = root.table("allocs").get(update.id)
-                if existing is None:
-                    continue
-                merged = replace(
-                    existing,
-                    client_status=update.client_status,
-                    client_description=update.client_description,
-                    task_states=update.task_states or existing.task_states,
-                    deployment_status=(update.deployment_status
-                                       or existing.deployment_status),
-                    modify_index=index,
-                    modify_time=update.modify_time or existing.modify_time,
-                )
-                root = root.with_table("allocs",
-                                       root.table("allocs").set(merged.id, merged))
-                root = self._update_summary_for_alloc(root, index, existing, merged)
-                root = self._maybe_update_deployment_health(root, index, merged)
-                self._log_change(index, "alloc", merged.id)
-                self.alloc_index.note_upsert(index, merged)
+            root = self._update_allocs_from_client_root(
+                self._root.edit(), index, allocs)
             root = root.with_index("allocs", index)
             self._publish(root)
+
+    def update_allocs_from_client_batch(
+            self, items: List[Tuple[int, List[Allocation]]]) -> None:
+        """Batched WAL replay (ISSUE 8): N `alloc_client_update`
+        entries' writes on ONE edit root with ONE publish, each entry
+        stamped with its own index — state-equivalent to sequential
+        update_allocs_from_client calls (the mutation sequence is
+        identical; only the layer pushes and watcher wakes collapse)."""
+        if not items:
+            return
+        with self._lock:
+            root = self._root.edit()
+            for index, allocs in items:
+                root = self._update_allocs_from_client_root(root, index,
+                                                            allocs)
+                root = root.with_index("allocs", index)
+            self._publish(root)
+
+    def _update_allocs_from_client_root(self, root: _Root, index: int,
+                                        allocs: List[Allocation]) -> _Root:
+        for update in allocs:
+            existing = root.table("allocs").get(update.id)
+            if existing is None:
+                continue
+            merged = replace(
+                existing,
+                client_status=update.client_status,
+                client_description=update.client_description,
+                task_states=update.task_states or existing.task_states,
+                deployment_status=(update.deployment_status
+                                   or existing.deployment_status),
+                modify_index=index,
+                modify_time=update.modify_time or existing.modify_time,
+            )
+            root = root.with_table("allocs",
+                                   root.table("allocs").set(merged.id, merged))
+            root = self._update_summary_for_alloc(root, index, existing, merged)
+            root = self._maybe_update_deployment_health(root, index, merged)
+            self._log_change(index, "alloc", merged.id)
+            self.alloc_index.note_upsert(index, merged)
+        return root
 
     def _maybe_update_deployment_health(self, root: _Root, index: int,
                                         alloc: Allocation) -> _Root:
@@ -1863,9 +1946,40 @@ class StateStore(StateSnapshot):
 
     # -- checkpoint / restore (fsm.go Snapshot:1360 / Restore:1374) ----
     def restore(self, data: dict) -> None:
-        """Rebuild the database from a dump. Replaces all state."""
+        """Rebuild the database from a dump. Replaces all state. Both
+        formats restore here: legacy object snapshots (format 1 — one
+        wire dict per row) and columnar format-2 snapshots
+        (state/columnar.py struct-of-arrays).
+
+        The big three tables land through the same grouped bulk-index
+        path a plan apply uses (one sub-table build per key instead of
+        one HAMT write per row), the per-job columnar alloc index is
+        rebuilt EAGERLY from the loaded rows — the pre-r12 wholesale
+        invalidate made the first eval after recovery pay a dense
+        O(allocs) rebuild inside its latency budget — and a columnar
+        snapshot leaves its decoded alloc columns on `_cold_columns`
+        for the resident NodeTable's vectorized cold build
+        (ops/tables.py NodeTable.build_from_columns via
+        pop_cold_columns)."""
         from ..models import SchedulerConfiguration
         from ..utils.codec import from_wire
+        fmt = int(data.get("format", 1))
+        tables = data.get("tables", {})
+        cold = None
+        if fmt >= 2:
+            from .columnar import cold_alloc_columns, decode_table
+            cal = data.get("columnar", {})
+            dec_allocs = decode_table(Allocation, cal.get("allocs"))
+            nodes = decode_table(Node, cal.get("nodes")).objs
+            evals = decode_table(Evaluation, cal.get("evals")).objs
+            allocs = dec_allocs.objs
+            cold = cold_alloc_columns(dec_allocs)
+        else:
+            nodes = [from_wire(Node, w) for w in tables.get("nodes", [])]
+            evals = [from_wire(Evaluation, w)
+                     for w in tables.get("evals", [])]
+            allocs = [from_wire(Allocation, w)
+                      for w in tables.get("allocs", [])]
         with self._lock:
             # invalidate the changelog AND the resident table cache:
             # restore replaces state wholesale, so a cached table at the
@@ -1882,20 +1996,19 @@ class StateStore(StateSnapshot):
                 max_jobs=old_ai.max_jobs, delta_max=old_ai.delta_max,
                 enabled=old_ai.enabled)
             root = _Root(_Table(), _Table()).edit()
-            t = root.table("nodes")
-            for w in data["tables"].get("nodes", []):
-                node = from_wire(Node, w)
-                t = t.set(node.id, node)
-            root = root.with_table("nodes", t)
+            if nodes:
+                root = root.with_table(
+                    "nodes", root.table("nodes").update(
+                        [(n.id, n) for n in nodes]))
 
             t = root.table("jobs")
-            for w in data["tables"].get("jobs", []):
+            for w in tables.get("jobs", []):
                 job = from_wire(Job, w)
                 t = t.set(job.namespaced_id(), job)
             root = root.with_table("jobs", t)
 
             t = root.table("job_versions")
-            for entry in data["tables"].get("job_versions", []):
+            for entry in tables.get("job_versions", []):
                 key = tuple(entry["key"])
                 versions = _Table()
                 for v, w in entry["versions"].items():
@@ -1903,25 +2016,8 @@ class StateStore(StateSnapshot):
                 t = t.set(key, versions)
             root = root.with_table("job_versions", t)
 
-            t = root.table("evals")
-            for w in data["tables"].get("evals", []):
-                ev = from_wire(Evaluation, w)
-                t = t.set(ev.id, ev)
-                root = root.with_table("evals", t)
-                root = self._index_add(root, "evals_by_job",
-                                       (ev.namespace, ev.job_id), ev.id)
-                t = root.table("evals")
-
-            t = root.table("allocs")
-            for w in data["tables"].get("allocs", []):
-                a = from_wire(Allocation, w)
-                t = t.set(a.id, a)
-                root = root.with_table("allocs", t)
-                root = self._index_add(root, "allocs_by_node", a.node_id, a.id)
-                root = self._index_add(root, "allocs_by_job",
-                                       (a.namespace, a.job_id), a.id)
-                root = self._index_add(root, "allocs_by_eval", a.eval_id, a.id)
-                t = root.table("allocs")
+            root = self._bulk_install_evals(root, evals)
+            root = self._bulk_install_allocs(root, allocs)
 
             t = root.table("deployments")
             for w in data["tables"].get("deployments", []):
@@ -2038,6 +2134,85 @@ class StateStore(StateSnapshot):
             for table, index in data.get("indexes", {}).items():
                 root = root.with_index(table, index)
             self._publish(root)
+            # eager per-job columnar index: the eval that follows
+            # recovery reads warm columns, zero dense rebuilds
+            if allocs:
+                self._prime_alloc_index(allocs, self.index("allocs"))
+            self._cold_columns = cold
+
+    def _bulk_install_evals(self, root: _Root, evals: List[Evaluation]
+                            ) -> _Root:
+        """Restore-grade bulk insert: one outer batch write per table,
+        one sub-table build per (namespace, job) — same nested-map
+        shape `_index_add` produces row by row."""
+        if not evals:
+            return root
+        root = root.with_table(
+            "evals",
+            root.table("evals").update([(e.id, e) for e in evals]))
+        groups: Dict[Tuple[str, str], List[str]] = {}
+        for e in evals:
+            groups.setdefault((e.namespace, e.job_id), []).append(e.id)
+        t = root.table("evals_by_job")
+        pairs = []
+        for key, ids in groups.items():
+            members = (t.get(key) or _Table()).with_ctx(root._ctx)
+            pairs.append((key, members.update(
+                [(i, True) for i in ids]).frozen()))
+        return root.with_table("evals_by_job", t.update(pairs))
+
+    def _bulk_install_allocs(self, root: _Root,
+                             allocs: List[Allocation]) -> _Root:
+        """Restore-grade alloc insert: grouped secondary-index builds
+        (by node / job / eval) instead of three HAMT writes per row."""
+        if not allocs:
+            return root
+        root = root.with_table(
+            "allocs",
+            root.table("allocs").update([(a.id, a) for a in allocs]))
+        for table, keyfn in (
+                ("allocs_by_node", lambda a: a.node_id),
+                ("allocs_by_job", lambda a: (a.namespace, a.job_id)),
+                ("allocs_by_eval", lambda a: a.eval_id)):
+            groups: Dict = {}
+            for a in allocs:
+                groups.setdefault(keyfn(a), []).append(a.id)
+            t = root.table(table)
+            pairs = []
+            for key, ids in groups.items():
+                members = (t.get(key) or _Table()).with_ctx(root._ctx)
+                pairs.append((key, members.update(
+                    [(i, True) for i in ids]).frozen()))
+            root = root.with_table(table, t.update(pairs))
+        return root
+
+    def _prime_alloc_index(self, allocs: List[Allocation],
+                           index: int) -> None:
+        """Rebuild the per-job columnar alloc index eagerly from
+        freshly loaded rows (ISSUE 8 satellite: restore used to
+        invalidate wholesale, so the eval after recovery paid a dense
+        O(allocs) rebuild — `reconcile.index_rebuilds` must stay 0
+        after a restore). Bounded by the cache's max_jobs, largest
+        jobs first: the entries most expensive to rebuild are the ones
+        kept warm."""
+        ai = self.alloc_index
+        if not ai.enabled:
+            return
+        from .alloc_index import JobAllocColumns
+        groups: Dict[Tuple[str, str], List[Allocation]] = {}
+        for a in allocs:
+            groups.setdefault((a.namespace, a.job_id), []).append(a)
+        keys = sorted(groups, key=lambda k: -len(groups[k]))
+        for key in keys[:ai.max_jobs]:
+            ai.install(key, JobAllocColumns.build(groups[key]), index)
+
+    def pop_cold_columns(self):
+        """One-shot handoff of the last restore's decoded alloc columns
+        to the resident-table prime (server/core.py cold-start
+        pipeline; None after a legacy-format restore)."""
+        cold = getattr(self, "_cold_columns", None)
+        self._cold_columns = None
+        return cold
 
     # -- job status reconciliation (fsm setJobStatus analog) ----------
     def set_job_status(self, index: int, namespace: str, job_id: str,

@@ -5,6 +5,9 @@ and end-to-end (12.3k/s) with no way to say WHERE the host time went —
 the gap had to be inferred from side channels. This module gives every
 stage of the pipeline a named accumulator:
 
+    restore       cold start: snapshot load + store rebuild
+                  (server/persistence.py restore_into — ISSUE 8)
+    wal_replay    cold start: batched WAL tail replay into the FSM
     table_build   host-side NodeTable full builds + delta refreshes
     h2d           host->device transfers (uploads, scatters, arg ships)
     kernel        device dispatch through result availability
@@ -50,9 +53,9 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
-STAGES = ("table_build", "h2d", "kernel", "d2h", "reconcile",
-          "gateway_wait", "sched_host", "plan_verify", "plan_commit",
-          "broker_ack")
+STAGES = ("restore", "wal_replay", "table_build", "h2d", "kernel",
+          "d2h", "reconcile", "gateway_wait", "sched_host",
+          "plan_verify", "plan_commit", "broker_ack")
 
 # superset accumulators: wholly contain other stages' time (sched_host
 # wraps reconcile + table_build + h2d + kernel + d2h per dispatch), so

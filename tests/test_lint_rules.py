@@ -296,6 +296,10 @@ class ServerConfig:
     reconcile_orphan_max: int = 11
     gateway_documented_us: int = 2000
     gateway_orphan_us: int = 13
+    snapshot_documented_every: int = 1024
+    snapshot_orphan_every: int = 15
+    wal_documented_fsync: bool = False
+    wal_orphan_fsync: bool = True
     other_knob: int = 1
 """
 
@@ -319,6 +323,8 @@ class TestSurfaceDrift:
                            "governor_documented_high and "
                            "plan_group_documented_max and "
                            "gateway_documented_us and "
+                           "snapshot_documented_every and "
+                           "wal_documented_fsync and "
                            "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
@@ -332,12 +338,19 @@ class TestSurfaceDrift:
         # gateway_* knobs joined the contract (ISSUE 7: micro-batch
         # gateway knobs must land in the STATUS.md knob table)
         gw_f = [f for f in out if "gateway_orphan_us" in f.message]
+        # snapshot_* / wal_* knobs joined the contract (ISSUE 8:
+        # columnar-snapshot + WAL fsync knobs must land in the
+        # STATUS.md knob table)
+        sn_f = [f for f in out if "snapshot_orphan_every" in f.message]
+        wl_f = [f for f in out if "wal_orphan_fsync" in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
         assert len(pg_f) == 1
         assert len(rc_f) == 1
         assert len(gw_f) == 1
+        assert len(sn_f) == 1
+        assert len(wl_f) == 1
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
                        for f in out)
@@ -346,6 +359,10 @@ class TestSurfaceDrift:
         assert not any("reconcile_documented_max" in f.message
                        for f in out)
         assert not any("gateway_documented_us" in f.message
+                       for f in out)
+        assert not any("snapshot_documented_every" in f.message
+                       for f in out)
+        assert not any("wal_documented_fsync" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -359,7 +376,11 @@ class TestSurfaceDrift:
                            "reconcile_documented_max, "
                            "reconcile_orphan_max, "
                            "gateway_documented_us, "
-                           "gateway_orphan_us")
+                           "gateway_orphan_us, "
+                           "snapshot_documented_every, "
+                           "snapshot_orphan_every, "
+                           "wal_documented_fsync, "
+                           "wal_orphan_fsync")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
