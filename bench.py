@@ -173,6 +173,42 @@ def main() -> None:
     # device scatters) — the steady-state story in one place
     try:
         out["stage_breakdown"] = stages.snapshot()
+        # eval flight recorder (ISSUE 9): the per-stage PERCENTILE
+        # breakdown (sums can't show bimodality), whether tracing was
+        # armed for this round, and the tail-exemplar evidence — a TPU
+        # run comes back with the anatomy of its worst evals, and the
+        # completeness bit proves the span tree covered enqueue->ack
+        # with gateway + commit attrs populated
+        from nomad_tpu.trace import tracer as flight
+        out["trace"] = "on" if flight.enabled() else "off"
+        out["stage_percentiles"] = flight.stage_percentiles()
+        exemplars = flight.exemplars()
+        out["trace_exemplars"] = len(exemplars)
+        need = {"queue_wait", "sched_host", "plan_verify",
+                "plan_commit", "broker_ack"}
+
+        def _complete(t):
+            names = {sp["name"] for sp in t["spans"]}
+            gw = any(sp["name"] == "gateway_wait"
+                     and "batch" in sp.get("attrs", {})
+                     for sp in t["spans"])
+            cm = any(sp["name"] == "plan_commit"
+                     and "group" in sp.get("attrs", {})
+                     for sp in t["spans"])
+            return need <= names and gw and cm
+
+        out["trace_exemplar_complete"] = any(
+            _complete(t) for t in exemplars)
+        # which exemplars survive worst-K retention is load-dependent
+        # (a drift auto-pin mid-bench can park early traces), so the
+        # CI-stable completeness claim scans the whole recorder: a
+        # complete capture exists SOMEWHERE in exemplars ∪ ring
+        out["trace_capture_complete"] = (
+            out["trace_exemplar_complete"]
+            or any(_complete(t) for t in flight.recent(512)))
+        if exemplars:
+            out["trace_exemplar_max_ms"] = round(
+                max(t["total_ms"] for t in exemplars), 1)
         from nomad_tpu.ops.select import cost_model
         from nomad_tpu.ops.tables import BUILD_STATS
         out["table_build_stats"] = dict(BUILD_STATS)

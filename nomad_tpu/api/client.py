@@ -295,6 +295,13 @@ class ApiClient:
     def governor(self) -> dict:
         return self._request("GET", "/v1/operator/governor")
 
+    def trace(self, params: Optional[dict] = None) -> dict:
+        """Eval flight recorder: recent span trees, tail exemplars,
+        and per-stage percentiles; params: n, exemplars=true,
+        format=chrome (Perfetto-loadable trace-event JSON)."""
+        return self._request("GET", "/v1/operator/trace",
+                             params=params)
+
     def set_autopilot_config(self, config: dict) -> dict:
         return self._request("PUT",
                              "/v1/operator/autopilot/configuration",

@@ -904,6 +904,22 @@ class HTTPApiServer:
                 return {"enabled": False}, idx
             return gov.status(), idx
 
+        # eval flight recorder (nomad_tpu/trace/): recent per-eval
+        # span trees, pinned tail exemplars, per-stage p50/p95/p99.
+        # ?format=chrome emits Chrome trace-event JSON (one track per
+        # worker/gateway/applier) loadable in Perfetto;
+        # ?exemplars=true restricts to the pinned exemplar set
+        if path == "/v1/operator/trace" and method == "GET":
+            from ..trace import tracer
+            exemplars_only = str(q.get("exemplars", "")).lower() \
+                in ("1", "true")
+            limit = max(0, min(int(q.get("n", 32)), 512))
+            if q.get("format", "") == "chrome":
+                return tracer.export_chrome(
+                    limit=limit, exemplars_only=exemplars_only), idx
+            return tracer.status(
+                limit=limit, exemplars_only=exemplars_only), idx
+
         # operator autopilot configuration (nomad/operator_endpoint.go
         # AutopilotGetConfiguration / AutopilotSetConfiguration)
         if path == "/v1/operator/autopilot/configuration":

@@ -313,7 +313,13 @@ def bench_broker_service(n_nodes: int = 10000, n_jobs: int = 64,
     # device dispatches is the entire point of the gateway
     assert batched["batches"] > 0, (
         f"broker scenario never batched: {batched}")
+    # flight-recorder engagement for the service workload (ISSUE 9):
+    # this burst is where tail exemplars are born on CPU CI — record
+    # how many the recorder holds after the three runs so the
+    # artifact shows the soak story will have its evidence
+    from ..trace import tracer as _flight
     return {
+        "service_trace_exemplars": _flight.exemplar_count(),
         "service_broker_placements_per_sec": round(batched["rate"], 1),
         "service_broker_wall_s": round(batched["wall_s"], 3),
         "service_broker_batches": batched["batches"],

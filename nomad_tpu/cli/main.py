@@ -1088,6 +1088,12 @@ def cmd_operator_debug(args) -> int:
             lambda: c._request("GET", "/v1/operator/raft/configuration"))
     try_add("autopilot.json", c.autopilot_config)
     try_add("governor.json", c.governor)
+    # flight recorder: exemplar span trees + stage percentiles ride in
+    # the bundle, so a support ticket carries the anatomy of the worst
+    # evals, not just gauge values
+    try_add("trace.json", c.trace)
+    try_add("trace-chrome.json",
+            lambda: c.trace({"format": "chrome"}))
     try_add("scheduler-config.json", c.scheduler_config)
     try_add("nomad/jobs.json", c.list_jobs)
     try_add("nomad/nodes.json", c.list_nodes)
@@ -1164,6 +1170,75 @@ def cmd_operator_governor(args) -> int:
             detail = {k: v for k, v in e.items()
                       if k not in ("ts", "kind")}
             print(f"  {ts}  {kind:12s} {json.dumps(detail, default=str)}")
+    return 0
+
+
+def cmd_operator_trace(args) -> int:
+    """Eval flight recorder (nomad_tpu/trace/): per-eval span trees,
+    tail exemplars with governor-gauge snapshots, per-stage
+    percentiles. `-o chrome` emits Chrome trace-event JSON — load it
+    in Perfetto (ui.perfetto.dev) or chrome://tracing; one track per
+    worker / gateway / applier so cross-thread overlap is visible."""
+    c = _client(args)
+    params = {"n": str(args.n)}
+    if args.exemplars:
+        params["exemplars"] = "true"
+    if args.o == "chrome":
+        params["format"] = "chrome"
+    try:
+        out = c.trace(params)
+    except ApiError as e:
+        print(f"Error querying trace: {e}", file=sys.stderr)
+        return 1
+    if args.o == "chrome":
+        payload = json.dumps(out)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(payload)
+            print(f"Wrote {len(out.get('traceEvents', []))} trace "
+                  f"events to {args.output} (load in Perfetto / "
+                  f"chrome://tracing)")
+        else:
+            print(payload)
+        return 0
+    if not out.get("enabled", False):
+        print("Flight recorder disabled on this agent "
+              "(NOMAD_TPU_TRACE=0)")
+        return 0
+    ring = out.get("ring", {})
+    st = out.get("stats", {})
+    print(f"Traces        = {ring.get('traces', 0)} in ring "
+          f"({ring.get('bytes', 0)}/{ring.get('bytes_max', 0)} bytes); "
+          f"{st.get('traces', 0)} recorded, {st.get('dropped', 0)} "
+          f"aged out")
+    print(f"Exemplars     = {len(out.get('exemplars', []))}"
+          f"/{out.get('exemplar_slots', 0)} "
+          f"(threshold {out.get('threshold_ms', 0.0)} ms, "
+          f"{st.get('exemplar_pins', 0)} pinned)")
+    print()
+    rows = []
+    for stage, p in out.get("stage_percentiles", {}).items():
+        rows.append([stage, p["p50_ms"], p["p95_ms"], p["p99_ms"],
+                     p["count"]])
+    if rows:
+        _print_rows(rows, ["Stage", "p50 ms", "p95 ms", "p99 ms",
+                           "Samples"])
+    exemplars = out.get("exemplars", [])
+    if exemplars:
+        print()
+        print(f"Tail exemplars ({len(exemplars)}):")
+        for t in exemplars:
+            pin = " PINNED " + t.get("reason", "") \
+                if t.get("pinned") else ""
+            print(f"  {t['eval_id'][:8]}  {t['total_ms']:9.1f} ms  "
+                  f"{t.get('type', ''):8s} {t.get('job_id', '')} "
+                  f"({len(t.get('spans', []))} spans){pin}")
+            for sp in t.get("spans", []):
+                attrs = sp.get("attrs")
+                extra = f"  {json.dumps(attrs)}" if attrs else ""
+                print(f"      {sp['t0_ms']:9.1f} +{sp['dur_ms']:8.2f}"
+                      f"  {sp['name']:13s} [{sp.get('track', '')}]"
+                      f"{extra}")
     return 0
 
 
@@ -1687,6 +1762,20 @@ def build_parser() -> argparse.ArgumentParser:
     ogov = op.add_parser("governor",
                          help="steady-state governor gauges/watermarks")
     ogov.set_defaults(fn=cmd_operator_governor)
+    otrace = op.add_parser(
+        "trace", help="eval flight recorder: span trees, tail "
+                      "exemplars, stage percentiles")
+    otrace.add_argument("-exemplars", action="store_true",
+                        help="only the pinned tail-exemplar set")
+    otrace.add_argument("-o", default="", choices=["", "chrome"],
+                        help="chrome: trace-event JSON for "
+                             "Perfetto/chrome://tracing")
+    otrace.add_argument("-n", type=int, default=32,
+                        help="recent traces to include (default 32)")
+    otrace.add_argument("-output", default="",
+                        help="write chrome output to a file instead "
+                             "of stdout")
+    otrace.set_defaults(fn=cmd_operator_trace)
     osave = op.add_parser("snapshot-save")
     osave.add_argument("file")
     osave.set_defaults(fn=cmd_operator_snapshot_save)

@@ -77,6 +77,12 @@ class Governor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.started_at = time.time()
+        # drift-finding subscribers (ISSUE 9 satellite): the server
+        # auto-pins the flight recorder's exemplar set when a finding
+        # names a suspect structure — the span trees that existed when
+        # the drift was detected ARE the capture worth keeping. Hooks
+        # run on the sampler thread; exceptions are isolated.
+        self.drift_hooks: List[Callable[[dict], None]] = []
 
     # -- registration proxy -------------------------------------------
     def register(self, name: str,
@@ -253,6 +259,14 @@ class Governor:
         if self._samples % self._drift_check_every == 0:
             for finding in self.drift.check():
                 self.emit(finding)
+                for hook in list(self.drift_hooks):
+                    try:
+                        hook(finding)
+                    except Exception:   # pragma: no cover — defensive
+                        import logging
+                        logging.getLogger(
+                            "nomad_tpu.governor").exception(
+                            "drift hook failed")
         return regs
 
     # -- signals / status ----------------------------------------------

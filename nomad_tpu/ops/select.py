@@ -1317,7 +1317,14 @@ class DispatchCostModel:
         if stages.enabled:
             # every arm reports its dispatch wall here — one choke
             # point doubles as the bench's `kernel` stage accumulator
+            # AND the flight recorder's kernel-span emitter: solo arms
+            # attribute to the dispatching eval's thread context, a
+            # gateway fire fans the shared span out to every lane's
+            # trace, each carrying (arm, n_pad, lanes, fresh-compile)
             stages.add("kernel", seconds)
+            from ..trace import emit_kernel
+            emit_kernel(arm, n_pad, seconds, lanes=lanes,
+                        fresh=compiled)
         key = (arm, n_pad)
         if compiled:
             # this dispatch minted a new trace signature (_note_trace):

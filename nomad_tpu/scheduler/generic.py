@@ -276,7 +276,11 @@ class GenericScheduler:
                     self.queued_allocs.get(tg_name, 0) + n
 
         if stages.enabled:
-            stages.add("reconcile", time.perf_counter() - t0)
+            # attrs ride onto the flight recorder's reconcile span (a
+            # slow reconcile means something different on the columnar
+            # engine vs the reference fallback)
+            stages.add("reconcile", time.perf_counter() - t0,
+                       attrs={"columnar": self._columnar_active})
 
         # Compute placements (destructive first to discount resources)
         self._compute_placements(results.destructive_update, results.place)

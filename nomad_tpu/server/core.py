@@ -213,6 +213,21 @@ class ServerConfig:
     # organic samples. Pays two XLA compiles at start, so off by
     # default; the CLI agent and the benches turn it on
     dispatch_calibration: bool = False
+    # eval flight recorder (nomad_tpu/trace/): always-on per-eval span
+    # tracing — enqueue -> gateway -> kernel -> group commit -> ack —
+    # with a byte-bounded completed-trace ring, pinned tail exemplars,
+    # and per-stage percentile reservoirs. Surfaced at
+    # /v1/operator/trace and `nomad operator trace [-o chrome]`;
+    # NOMAD_TPU_TRACE=0 is the kill switch
+    trace_ring_bytes: int = 4 << 20
+    # pinned exemplar slots: evals whose full enqueue->ack latency
+    # clears the adaptive threshold keep their whole span tree plus a
+    # governor-gauge snapshot (worst-K retention; drift findings
+    # auto-pin the current set)
+    trace_exemplar_slots: int = 8
+    # promotion threshold as a percent of the governor-tracked
+    # full-latency p99 (100 = promote anything at/above p99)
+    trace_exemplar_threshold_pct: float = 100.0
 
 
 class Server:
@@ -265,6 +280,34 @@ class Server:
             self.governor = Governor(
                 interval_s=self.config.governor_interval_s)
             self._register_governor_gauges()
+        # eval flight recorder (ISSUE 9): the process-wide tracer is
+        # configured from this server's knobs and wired to its
+        # governor — the exemplar threshold tracks the FULL-latency
+        # p99 (queue wait included: what the eval experienced), each
+        # promoted exemplar snapshots the gauge rows, and a drift
+        # finding that names a suspect structure auto-pins the current
+        # exemplar set (the ROADMAP "automatic operator debug capture"
+        # item, done at the trace layer)
+        from ..trace import tracer as _flight
+        self.tracer = _flight
+        _flight.configure(
+            ring_bytes=self.config.trace_ring_bytes,
+            exemplar_slots=self.config.trace_exemplar_slots,
+            threshold_pct=self.config.trace_exemplar_threshold_pct)
+        self._tracer_fns = None
+        if self.governor is not None:
+            gov = self.governor
+            _flight.threshold_fn = \
+                lambda g=gov: g.latency_percentile_ms(99)
+            _flight.gauge_fn = lambda g=gov: {
+                r["name"]: r["value"] for r in g.registry.rows()}
+            # remembered so shutdown can detach THESE closures (and
+            # only these — a newer server may have rebound them):
+            # the module-global tracer outlives this server, and the
+            # lambdas would otherwise pin the whole dead governor
+            # graph (gauge closures reach broker/applier/store)
+            self._tracer_fns = (_flight.threshold_fn, _flight.gauge_fn)
+            gov.drift_hooks.append(self._auto_pin_exemplars)
         self.workers: List[Worker] = []
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._hb_lock = threading.Lock()
@@ -616,9 +659,36 @@ class Server:
         gov.register("lint.recompiles", lint_traces.count,
                      suspect=False)
 
+        # flight-recorder visibility (ISSUE 9): ring occupancy and the
+        # exemplar count in /v1/operator/governor. suspect=False: both
+        # are bounded by construction
+        from ..trace import tracer as _flight
+        gov.register("trace.ring_traces", _flight.ring_len,
+                     suspect=False)
+        gov.register("trace.exemplars", _flight.exemplar_count,
+                     suspect=False)
+
         # admission control: the broker sheds fresh enqueues while any
         # pressure gauge is over
         self.eval_broker.pressure_fn = gov.backpressure
+
+    def _auto_pin_exemplars(self, finding: dict) -> None:
+        """Drift hook (ISSUE 9 satellite): a drift finding that names
+        a suspect structure pins the flight recorder's CURRENT
+        exemplar set — the worst span trees recorded while the drift
+        was building are the capture an operator would have wanted
+        `operator debug` to take automatically."""
+        suspect = finding.get("suspect_structure")
+        if not suspect:
+            return
+        reason = (f"drift:{finding.get('metric', '?')}"
+                  f"->{suspect}")
+        pinned = self.tracer.pin_exemplars(reason=reason)
+        if pinned and self.governor is not None:
+            self.governor.emit({"kind": "trace_pin",
+                                "exemplars": pinned,
+                                "suspect": suspect,
+                                "metric": finding.get("metric")})
 
     def _register_persistence_gauges(self) -> None:
         """Snapshot cadence, off-thread serialization time, and skipped
@@ -766,6 +836,16 @@ class Server:
                 LOG.exception("cost model save failed")
         if self.governor is not None:
             self.governor.stop()
+        # detach the flight recorder from this server's governor — but
+        # only if a newer server hasn't already rebound the hooks (the
+        # tracer is process-global; holding our closures past shutdown
+        # would keep the dead governor graph reachable forever)
+        fns = getattr(self, "_tracer_fns", None)
+        if fns is not None:
+            if self.tracer.threshold_fn is fns[0]:
+                self.tracer.threshold_fn = None
+            if self.tracer.gauge_fn is fns[1]:
+                self.tracer.gauge_fn = None
         if getattr(self, "swim", None) is not None:
             self.swim.stop()
         if self.raft is not None:

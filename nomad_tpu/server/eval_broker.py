@@ -194,6 +194,14 @@ class EvalBroker:
     def _process_enqueue(self, ev: Evaluation, token: str) -> None:
         if not self._enabled:
             return
+        # flight-recorder anchor (ISSUE 9): FIRST broker entry, kept
+        # across blocked/delayed parking and requeues — dequeue derives
+        # broker_wait_s from it, so an eval that sat on the per-job
+        # blocked heap or the delayed heap shows that time in its span
+        # tree (queue_wait_s below stays READY-queue-only: it feeds
+        # the governor's latency reservoir and must keep its meaning)
+        if getattr(ev, "_entered_broker_t", None) is None:
+            ev._entered_broker_t = time.monotonic()
         if ev.id in self._evals:
             if token == "":
                 return
@@ -377,9 +385,12 @@ class EvalBroker:
     def _dequeue_for_sched(self, sched: str) -> Tuple[Evaluation, str]:
         q = self._ready[sched]
         ev = q.pop()
+        now = time.monotonic()
         ev.queue_wait_s = max(
-            0.0, time.monotonic() - getattr(ev, "_brokered_t",
-                                            time.monotonic()))
+            0.0, now - getattr(ev, "_brokered_t", now))
+        ev.broker_wait_s = max(
+            ev.queue_wait_s,
+            now - (getattr(ev, "_entered_broker_t", None) or now))
         token = generate_uuid()
         timer = threading.Timer(self.nack_timeout_s, self.nack,
                                 args=(ev.id, token))

@@ -41,6 +41,7 @@ import time as _time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from .. import trace
 from ..models import (
     Allocation, AllocsFit, Evaluation, Plan, PlanResult,
     EVAL_STATUS_PENDING,
@@ -289,8 +290,16 @@ class PlanApplier:
                     c0 = _time.perf_counter() if stages.enabled else 0.0
                     waiter()
                     if stages.enabled:
-                        stages.add("plan_commit",
-                                   _time.perf_counter() - c0)
+                        wdt = _time.perf_counter() - c0
+                        stages.add("plan_commit", wdt)
+                        # the quorum wait (pipelined behind the next
+                        # group's verification) on each member's trace
+                        for _future, result in pairs:
+                            trace.emit(
+                                getattr(result, "_trace", None),
+                                "plan_commit", wdt, track="committer",
+                                group=len(pairs), index=group_index,
+                                phase="quorum")
                 # demultiplex: every submitter gets ITS result off the
                 # one group commit, in submission order
                 for future, result in pairs:
@@ -329,6 +338,7 @@ class PlanApplier:
 
     def _apply(self, plan: Plan):
         from ..utils import stages
+        tr = getattr(plan, "_trace", None)
         self._check_token(plan)
         store = self.server.store
         snapshot = store.snapshot()
@@ -336,8 +346,12 @@ class PlanApplier:
         _v0 = _time.perf_counter() if stages.enabled else 0.0
         result, payload, evals, _conflicted = self._verify(snapshot,
                                                            plan, ())
+        result._trace = tr      # committer attributes the quorum wait
         if stages.enabled:
-            stages.add("plan_verify", _time.perf_counter() - _v0)
+            _vdt = _time.perf_counter() - _v0
+            stages.add("plan_verify", _vdt)
+            trace.emit(tr, "plan_verify", _vdt, track="applier",
+                       group=1, demoted=bool(result.refresh_index))
         if payload is None:
             return result, None
 
@@ -354,7 +368,11 @@ class PlanApplier:
         for ev in evals:
             self.server.enqueue_eval(ev)
         if stages.enabled:
-            stages.add("plan_commit", _time.perf_counter() - _c0)
+            _cdt = _time.perf_counter() - _c0
+            stages.add("plan_commit", _cdt)
+            trace.emit(tr, "plan_commit", _cdt, track="applier",
+                       group=1, index=index,
+                       pipelined=waiter is not None)
         return result, waiter
 
     def apply_group(self, group: List[PendingPlan]):
@@ -379,6 +397,8 @@ class PlanApplier:
         conflicts = 0
         for pending in group:
             plan = pending.plan
+            tr = getattr(plan, "_trace", None)
+            _p0 = _time.perf_counter() if stages.enabled else 0.0
             try:
                 self._check_token(plan)
                 result, payload, evals, conflicted = self._verify(
@@ -387,6 +407,20 @@ class PlanApplier:
                 if not pending.future.done():
                     pending.future.set_exception(e)
                 continue
+            result._trace = tr  # committer attributes the quorum wait
+            if stages.enabled:
+                # per-plan span with the group anatomy the aggregate
+                # window can't carry: width, intra-group conflict,
+                # demotion, and how long the plan sat queued behind
+                # the serialization point
+                trace.emit(
+                    tr, "plan_verify", _time.perf_counter() - _p0,
+                    track="applier", group=len(group),
+                    conflicted=conflicted,
+                    demoted=bool(result.refresh_index),
+                    queue_ms=round(max(
+                        _time.monotonic() - pending.enqueued_t, 0.0)
+                        * 1000.0, 3))
             if conflicted:
                 conflicts += 1
             entries.append((pending, result, payload, evals))
@@ -422,7 +456,17 @@ class PlanApplier:
             for ev in evals:
                 self.server.enqueue_eval(ev)
         if stages.enabled:
-            stages.add("plan_commit", _time.perf_counter() - _c0)
+            _cdt = _time.perf_counter() - _c0
+            stages.add("plan_commit", _cdt)
+            # ONE raft entry / store transaction for the whole group:
+            # the shared commit span lands on every member's trace
+            # with the group size, so a p99 eval's anatomy shows
+            # whether it amortized its commit or paid one alone
+            for _pending, result, payload, _evs in entries:
+                trace.emit(getattr(result, "_trace", None),
+                           "plan_commit", _cdt, track="applier",
+                           group=len(group), index=index,
+                           committed=payload is not None)
         return pairs, waiter, index
 
     # -- verification --------------------------------------------------

@@ -15,11 +15,17 @@ from ..models import Plan
 
 
 class PendingPlan:
-    __slots__ = ("plan", "future")
+    __slots__ = ("plan", "future", "enqueued_t")
 
     def __init__(self, plan: Plan):
+        import time
         self.plan = plan
         self.future: Future = Future()
+        # flight recorder (ISSUE 9): the applier stamps this plan's
+        # queue wait onto its verify span — under load the gap between
+        # Process() ending and verification starting IS the plan
+        # queue, and a sum can't show which eval paid it
+        self.enqueued_t = time.monotonic()
 
 
 class PlanQueue:

@@ -23,6 +23,7 @@ import logging
 import threading
 import time
 
+from .. import trace
 from ..utils import gcsafe
 from typing import List, Optional
 
@@ -363,7 +364,11 @@ class MicroBatchGateway:
         import time as _time
         slot: dict = {}
         now = _time.monotonic()
-        entry = [req, slot, now, decorrelate]
+        # flight recorder (ISSUE 9): capture the DISPATCHING eval's
+        # trace context now — the fire that eventually serves this
+        # request runs on whichever thread triggered it, so the park
+        # span must attach through the entry, not thread-locals
+        entry = [req, slot, now, decorrelate, trace.current_all()]
         with self._cv:
             self._note_arrival(now)
             self._adapt()
@@ -460,20 +465,34 @@ class MicroBatchGateway:
         self.stats["lanes_sum"] += len(batch)
         if len(batch) > 1:
             self.stats["batches"] += 1
+        batch_id = self.stats["dispatches"]
         for e in batch:
             waited = now - e[2]
             self.stats["wait_s_sum"] += waited
             if stages.enabled:
                 stages.add("gateway_wait", waited)
+            # flight recorder: the park span lands on the PARKED
+            # eval's trace (captured at dispatch()) with the batch
+            # anatomy — the firing thread belongs to some other eval
+            for tr_ in e[4]:
+                tr_.add_span("gateway_wait", waited, end_mono=now,
+                             track="gateway",
+                             attrs={"trigger": trigger,
+                                    "batch": batch_id,
+                                    "lanes": len(batch)})
         # every fire counts as in-flight (the drain trigger's
         # engine-busy signal); the MAX_INFLIGHT cap only limits how
         # WIDE a fire may be, so solo fallthroughs can exceed it
         self._inflight += 1
         reqs = [e[0] for e in batch]
         decors = [e[3] for e in batch]
+        # the shared device dispatch fans out to every lane's trace
+        # (kernel/h2d/d2h spans attach to each eval that rode it)
+        fan = [t for e in batch for t in e[4]]
         self._cv.release()
         try:
-            outs = self._run(reqs, decors)
+            with trace.use_many(fan, track="gateway"):
+                outs = self._run(reqs, decors)
         finally:
             self._cv.acquire()
             self._inflight -= 1
@@ -561,6 +580,9 @@ class EvalLane:
         t0 = time.monotonic()
         plan.eval_token = self.token
         plan.snapshot_index = self.snapshot_index
+        # flight recorder: the applier/committer threads attribute
+        # their verify/commit spans through the plan, not thread-locals
+        plan._trace = trace.current()
         future = self.server.plan_queue.enqueue(plan)
         result: PlanResult = future.result(timeout=30)
         metrics.measure_since("nomad.worker.submit_plan", t0)
@@ -758,47 +780,65 @@ class Worker:
                                        decorrelate=(self.id, n_workers))
                 else:
                     dispatch = gw.dispatch
+        # flight recorder (ISSUE 9): one span tree per eval, anchored
+        # back at broker enqueue. The context installs the trace as
+        # this thread's span target, so the stage report sites inside
+        # the fence + Process() window (reconcile, table_build, h2d,
+        # kernel, d2h, sched_host) attribute to THIS eval; the plan
+        # applier and gateway attach their spans through the plan /
+        # dispatch entry instead. Core evals don't place — not traced.
+        from ..utils import stages
+        tr = None
+        if ev.type != JOB_TYPE_CORE:
+            tr = trace.begin(ev, track=f"worker-{self.id}")
+            if stages.enabled:
+                stages.add("queue_wait",
+                           getattr(ev, "queue_wait_s", 0.0) or 0.0)
         try:
-            # wait for the state store to catch up to the eval
-            t0 = time.monotonic()
-            snap = self.server.store.snapshot_min_index(
-                ev.modify_index, timeout_s=RAFT_SYNC_LIMIT)
-            metrics.measure_since("nomad.worker.wait_for_index", t0)
-            lane.snapshot_index = snap.latest_index()
-            if self.pipeline and ev.type != JOB_TYPE_CORE:
-                # pipelined dispatch: refresh the resident table NOW —
-                # the host row deltas apply here and the device mirror's
-                # scatter is dispatched asynchronously (never blocked
-                # on), so the device absorbs the table update while
-                # this thread builds the scheduler and its masks.
-                # build=False: a stale snapshot must not pay a private
-                # full build just to warm a cache it can't use
-                try:
-                    snap.node_table(build=False)
-                except Exception:   # pragma: no cover — defensive
-                    pass
-            if ev.type == JOB_TYPE_CORE:
-                # worker.go invokeScheduler: _core evals get the GC
-                # pseudo-scheduler, not a placement scheduler
-                from .core_sched import CoreScheduler
-                sched = CoreScheduler(snap, self.server)
-            else:
-                sched = new_scheduler(self._scheduler_for(ev), snap, lane)
-                if dispatch is not None and \
-                        hasattr(sched, "kernel_dispatch"):
-                    sched.kernel_dispatch = dispatch
-                # cross-worker decorrelation: concurrent workers must
-                # not all argmax onto the same winners (ops/select.py
-                # SelectKernel.decorrelate; propagated onto the
-                # engine's kernel by _process_once)
-                n_workers = len(getattr(self.server, "workers", []) or [])
-                if n_workers > 1:
-                    sched.kernel_decorrelate = (self.id, n_workers)
-            from ..utils import stages
-            t0 = time.monotonic()
-            sched.process(ev)
-            if stages.enabled and ev.type != JOB_TYPE_CORE:
-                stages.add("sched_host", time.monotonic() - t0)
+            with trace.use(tr):
+                # wait for the state store to catch up to the eval
+                t0 = time.monotonic()
+                snap = self.server.store.snapshot_min_index(
+                    ev.modify_index, timeout_s=RAFT_SYNC_LIMIT)
+                metrics.measure_since("nomad.worker.wait_for_index", t0)
+                lane.snapshot_index = snap.latest_index()
+                if self.pipeline and ev.type != JOB_TYPE_CORE:
+                    # pipelined dispatch: refresh the resident table
+                    # NOW — the host row deltas apply here and the
+                    # device mirror's scatter is dispatched
+                    # asynchronously (never blocked on), so the device
+                    # absorbs the table update while this thread
+                    # builds the scheduler and its masks. build=False:
+                    # a stale snapshot must not pay a private full
+                    # build just to warm a cache it can't use
+                    try:
+                        snap.node_table(build=False)
+                    except Exception:   # pragma: no cover — defensive
+                        pass
+                if ev.type == JOB_TYPE_CORE:
+                    # worker.go invokeScheduler: _core evals get the GC
+                    # pseudo-scheduler, not a placement scheduler
+                    from .core_sched import CoreScheduler
+                    sched = CoreScheduler(snap, self.server)
+                else:
+                    sched = new_scheduler(self._scheduler_for(ev), snap,
+                                          lane)
+                    if dispatch is not None and \
+                            hasattr(sched, "kernel_dispatch"):
+                        sched.kernel_dispatch = dispatch
+                    # cross-worker decorrelation: concurrent workers
+                    # must not all argmax onto the same winners
+                    # (ops/select.py SelectKernel.decorrelate;
+                    # propagated onto the engine's kernel by
+                    # _process_once)
+                    n_workers = len(getattr(self.server, "workers", [])
+                                    or [])
+                    if n_workers > 1:
+                        sched.kernel_decorrelate = (self.id, n_workers)
+                t0 = time.monotonic()
+                sched.process(ev)
+                if stages.enabled and ev.type != JOB_TYPE_CORE:
+                    stages.add("sched_host", time.monotonic() - t0)
             metrics.measure_since(
                 f"nomad.worker.invoke_scheduler_{self._scheduler_for(ev)}"
                 if ev.type != JOB_TYPE_CORE
@@ -827,9 +867,13 @@ class Worker:
                     gov.observe_eval_latency(elapsed / lat_scale,
                                              queue_wait_s=q_wait)
                 a0 = time.perf_counter() if stages.enabled else 0.0
-                self.server.eval_broker.ack(ev.id, token)
-                if stages.enabled:
-                    stages.add("broker_ack", time.perf_counter() - a0)
+                with trace.use(tr):
+                    self.server.eval_broker.ack(ev.id, token)
+                    if stages.enabled:
+                        stages.add("broker_ack",
+                                   time.perf_counter() - a0)
+                # the ack closes the span tree: enqueue -> ... -> ack
+                trace.finish(tr, status="acked")
                 self.stats["processed"] += 1
 
             if self._finish_q is not None:
@@ -846,6 +890,7 @@ class Worker:
                 self.server.eval_broker.nack(ev.id, token)
             except Exception:
                 pass
+            trace.finish(tr, status="failed")
 
     # -- batched evals -------------------------------------------------
     def process_eval_batch(self, batch: List) -> None:

@@ -16,6 +16,10 @@ stage of the pipeline a named accumulator:
                   AllocReconciler.compute + result staging (ISSUE 6:
                   this cost was previously invisible — it had to be
                   inferred as "the rest of the host share")
+    queue_wait    time the eval sat in the broker's READY queue before
+                  a worker dequeued it (ISSUE 9: the enqueue->dequeue
+                  leg of the flight recorder's span tree; idle time,
+                  not attributable work — see SHARE_EXCLUDED)
     gateway_wait  time an eval's kernel request spent parked in the
                   micro-batch gateway's dispatch window before its
                   batch fired (ISSUE 7: queue/coalescing wait was
@@ -37,9 +41,17 @@ half (one raft entry / store transaction / event flush per GROUP).
 
 `bench.py` enables collection around a run and emits the snapshot in
 the JSON artifact (`stage_breakdown`), so the kernel-vs-e2e gap is
-attributable per round instead of inferred. Collection is off by
-default: the hot path pays one module-global bool check per report
-site when disabled.
+attributable per round instead of inferred.
+
+The eval flight recorder (nomad_tpu/trace/, ISSUE 9) taps the same
+report sites: every add() forwards (stage, seconds, attrs) through the
+registered trace hook, which feeds the per-stage percentile reservoirs
+and — for stages reported on the eval's own thread — emits a span onto
+the thread-local current trace. The aggregate sums are untouched.
+`enabled` is therefore True whenever EITHER consumer wants reports
+(accumulation via enable()/disable(), tracing via set_trace_hook);
+with both off the hot path pays one module-global bool check per
+report site, exactly as before.
 
 The same stage can be reported from overlapping layers (a kernel
 dispatch inside a plan-apply verify); accumulators are independent
@@ -51,11 +63,11 @@ between rounds, not the absolute seconds.
 from __future__ import annotations
 
 import threading
-from typing import Dict
+from typing import Callable, Dict, Optional
 
 STAGES = ("restore", "wal_replay", "table_build", "h2d", "kernel",
-          "d2h", "reconcile", "gateway_wait", "sched_host",
-          "plan_verify", "plan_commit", "broker_ack")
+          "d2h", "reconcile", "queue_wait", "gateway_wait",
+          "sched_host", "plan_verify", "plan_commit", "broker_ack")
 
 # superset accumulators: wholly contain other stages' time (sched_host
 # wraps reconcile + table_build + h2d + kernel + d2h per dispatch), so
@@ -66,47 +78,97 @@ STAGES = ("restore", "wal_replay", "table_build", "h2d", "kernel",
 # legitimately exceed other stages' combined share).
 SHARE_SUPERSETS = frozenset({"sched_host"})
 
+# queue_wait is dead time on the broker heap, not attributable work: a
+# paused-worker burst would let it dwarf every real stage and wreck
+# the cross-round share ratios, so it too stays out of the denominator
+# (its own share is still reported against it, like the supersets)
+SHARE_EXCLUDED = SHARE_SUPERSETS | frozenset({"queue_wait"})
+
+# cold-start stages dilute steady-state shares when a run cold-boots
+# mid-round (ISSUE 9 satellite): snapshot() reports `steady_share`
+# over a denominator that excludes them, so cross-round ratio
+# comparisons survive a cold boot in the same run. The cold stages'
+# own steady_share is 0.0 by definition.
+COLD_STAGES = frozenset({"restore", "wal_replay"})
+
 enabled = False
 
 _l = threading.Lock()
 _acc: Dict[str, list] = {s: [0.0, 0] for s in STAGES}
 
+# the flight recorder's tap (nomad_tpu/trace/ installs it at import):
+# called as hook(stage, seconds, attrs) AFTER the accumulator update
+_collecting = False
+_trace_hook: Optional[Callable] = None
+_trace_on = False
+
+
+def set_trace_hook(hook: Optional[Callable], on: bool = True) -> None:
+    """Register (or disarm) the flight recorder's report tap. Arms the
+    module-global `enabled` flag so the `if stages.enabled:` guards at
+    every report site fire for the tracer even while bench
+    accumulation is off."""
+    global _trace_hook, _trace_on, enabled
+    _trace_hook = hook
+    _trace_on = bool(on and hook is not None)
+    enabled = _collecting or _trace_on
+
 
 def enable(reset: bool = True) -> None:
-    global enabled
+    global _collecting, enabled
     with _l:
         if reset:
             for v in _acc.values():
                 v[0] = 0.0
                 v[1] = 0
+        _collecting = True
         enabled = True
 
 
 def disable() -> None:
-    global enabled
-    enabled = False
+    global _collecting, enabled
+    _collecting = False
+    enabled = _collecting or _trace_on
 
 
-def add(stage: str, seconds: float) -> None:
+def add(stage: str, seconds: float,
+        attrs: Optional[dict] = None) -> None:
     """Report `seconds` of wall clock spent in `stage`. Callers guard
-    with `if stages.enabled:` so the disabled cost is one bool read."""
-    with _l:
-        ent = _acc.get(stage)
-        if ent is None:                 # unknown stage: count it anyway
-            ent = _acc.setdefault(stage, [0.0, 0])
-        ent[0] += seconds
-        ent[1] += 1
+    with `if stages.enabled:` so the disabled cost is one bool read.
+    `attrs` ride through to the flight recorder's span (never into the
+    aggregate sums)."""
+    if _collecting:
+        with _l:
+            ent = _acc.get(stage)
+            if ent is None:             # unknown stage: count it anyway
+                ent = _acc.setdefault(stage, [0.0, 0])
+            ent[0] += seconds
+            ent[1] += 1
+    hook = _trace_hook
+    if _trace_on and hook is not None:
+        try:
+            hook(stage, seconds, attrs)
+        except Exception:       # pragma: no cover — defensive
+            pass
 
 
 def snapshot() -> Dict[str, dict]:
-    """{stage: {seconds, calls, share}} over all stages reported since
-    enable(). `share` is each stage's fraction of the summed stage
-    time — the attribution number the bench artifact records."""
+    """{stage: {seconds, calls, share, steady_share}} over all stages
+    reported since enable(). `share` is each stage's fraction of the
+    summed stage time — the attribution number the bench artifact
+    records; `steady_share` excludes the cold-start stages from the
+    denominator (and reports 0.0 for them) so steady-state ratios
+    compare across rounds regardless of whether a round cold-booted."""
     with _l:
         total = sum(v[0] for s, v in _acc.items()
-                    if s not in SHARE_SUPERSETS)
+                    if s not in SHARE_EXCLUDED)
+        steady = sum(v[0] for s, v in _acc.items()
+                     if s not in SHARE_EXCLUDED and s not in COLD_STAGES)
         return {
             s: {"seconds": round(v[0], 4), "calls": v[1],
-                "share": round(v[0] / total, 4) if total > 0 else 0.0}
+                "share": round(v[0] / total, 4) if total > 0 else 0.0,
+                "steady_share": (
+                    0.0 if s in COLD_STAGES or steady <= 0
+                    else round(v[0] / steady, 4))}
             for s, v in _acc.items() if v[1] > 0 or s in STAGES
         }

@@ -44,13 +44,17 @@ def test_bench_py_emits_json_line_on_cpu():
     # the alloc-diff host phase is now attributable, not inferred);
     # gateway_wait joined in ISSUE 7 (micro-batch coalescing wait)
     # restore + wal_replay joined in ISSUE 8 (cold-start recovery
-    # attribution: snapshot load and batched WAL replay are stages)
+    # attribution: snapshot load and batched WAL replay are stages);
+    # queue_wait joined in ISSUE 9 (the flight recorder's broker
+    # enqueue->dequeue leg), which also added steady_share (shares
+    # with the cold-start stages excluded from the denominator)
     for stage in ("restore", "wal_replay", "table_build", "h2d",
-                  "kernel", "d2h", "reconcile", "gateway_wait",
-                  "sched_host", "plan_verify", "plan_commit",
-                  "broker_ack"):
+                  "kernel", "d2h", "reconcile", "queue_wait",
+                  "gateway_wait", "sched_host", "plan_verify",
+                  "plan_commit", "broker_ack"):
         assert stage in bd, f"missing stage {stage}: {bd}"
-        assert set(bd[stage]) == {"seconds", "calls", "share"}
+        assert set(bd[stage]) == {"seconds", "calls", "share",
+                                  "steady_share"}
     assert bd["kernel"]["seconds"] > 0          # e2e phases dispatched
     assert bd["plan_verify"]["calls"] > 0
     assert bd["plan_commit"]["calls"] > 0
@@ -58,11 +62,22 @@ def test_bench_py_emits_json_line_on_cpu():
     assert bd["reconcile"]["calls"] > 0
     assert bd["reconcile"]["seconds"] > 0
     assert bd["sched_host"]["calls"] > 0
-    # sched_host is a superset accumulator excluded from the share
-    # denominator (utils/stages.py SHARE_SUPERSETS) so r9-era share
-    # comparisons stay meaningful
-    shares = sum(v["share"] for k, v in bd.items() if k != "sched_host")
+    # sched_host (superset) and queue_wait (broker idle time) are
+    # excluded from the share denominator (utils/stages.py
+    # SHARE_EXCLUDED) so r9-era share comparisons stay meaningful
+    excluded = {"sched_host", "queue_wait"}
+    shares = sum(v["share"] for k, v in bd.items() if k not in excluded)
     assert 0.99 <= shares <= 1.01 or shares == 0.0
+    # steady_share: same identity with restore/wal_replay excluded
+    # too, and the cold stages report 0.0 by definition (ISSUE 9
+    # satellite: cold-start stages must not dilute steady-state
+    # ratios across rounds)
+    steady = sum(v["steady_share"] for k, v in bd.items()
+                 if k not in excluded | {"restore", "wal_replay"})
+    assert 0.99 <= steady <= 1.01 or steady == 0.0
+    assert bd["restore"]["steady_share"] == 0.0
+    assert bd["wal_replay"]["steady_share"] == 0.0
+    assert bd["queue_wait"]["calls"] > 0
     # resident-table counters + measured dispatch costs ride along
     assert data["table_build_stats"]["delta_refreshes"] >= 0
     assert data["dispatch_cost_model"], "cost model never observed"
@@ -107,6 +122,25 @@ def test_bench_py_emits_json_line_on_cpu():
     assert data["cold_start_speedup"] >= 3.0, data
     assert bd["restore"]["calls"] > 0
     assert bd["wal_replay"]["calls"] > 0
+    # eval flight recorder (ISSUE 9): tracing was armed, the per-stage
+    # PERCENTILE breakdown rides the artifact next to the sums, and at
+    # least one tail exemplar carries a COMPLETE span tree —
+    # enqueue->ack with the gateway batch id and commit group attrs
+    # populated (bench.py computes the completeness bit)
+    assert data["trace"] == "on"
+    sp = data["stage_percentiles"]
+    for stage in ("kernel", "plan_verify", "plan_commit", "sched_host",
+                  "queue_wait", "gateway_wait"):
+        assert stage in sp, f"missing percentile stage {stage}: {sp}"
+        assert sp[stage]["count"] > 0
+        assert sp[stage]["p50_ms"] <= sp[stage]["p99_ms"]
+    assert data["trace_exemplars"] >= 1, data
+    # the CI-stable claim: a complete capture exists in the recorder
+    # (exemplar set OR ring — which traces win the worst-K exemplar
+    # slots is load-dependent; trace_exemplar_complete is recorded in
+    # the artifact for the TPU run to judge at scale)
+    assert data["trace_capture_complete"] is True, data
+    assert data["service_trace_exemplars"] >= 1
 
 
 def test_c2m_seed_path_at_toy_scale():

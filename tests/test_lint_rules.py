@@ -300,6 +300,8 @@ class ServerConfig:
     snapshot_orphan_every: int = 15
     wal_documented_fsync: bool = False
     wal_orphan_fsync: bool = True
+    trace_documented_bytes: int = 4096
+    trace_orphan_bytes: int = 17
     other_knob: int = 1
 """
 
@@ -325,6 +327,7 @@ class TestSurfaceDrift:
                            "gateway_documented_us and "
                            "snapshot_documented_every and "
                            "wal_documented_fsync and "
+                           "trace_documented_bytes and "
                            "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
@@ -343,6 +346,9 @@ class TestSurfaceDrift:
         # STATUS.md knob table)
         sn_f = [f for f in out if "snapshot_orphan_every" in f.message]
         wl_f = [f for f in out if "wal_orphan_fsync" in f.message]
+        # trace_* knobs joined the contract (ISSUE 9: flight-recorder
+        # knobs must land in the STATUS.md knob table)
+        tr_f = [f for f in out if "trace_orphan_bytes" in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
@@ -351,6 +357,7 @@ class TestSurfaceDrift:
         assert len(gw_f) == 1
         assert len(sn_f) == 1
         assert len(wl_f) == 1
+        assert len(tr_f) == 1
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
                        for f in out)
@@ -363,6 +370,8 @@ class TestSurfaceDrift:
         assert not any("snapshot_documented_every" in f.message
                        for f in out)
         assert not any("wal_documented_fsync" in f.message
+                       for f in out)
+        assert not any("trace_documented_bytes" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -380,7 +389,9 @@ class TestSurfaceDrift:
                            "snapshot_documented_every, "
                            "snapshot_orphan_every, "
                            "wal_documented_fsync, "
-                           "wal_orphan_fsync")
+                           "wal_orphan_fsync, "
+                           "trace_documented_bytes, "
+                           "trace_orphan_bytes")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))

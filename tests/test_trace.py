@@ -1,0 +1,609 @@
+"""Eval flight recorder (ISSUE 9 tentpole + satellites).
+
+Covers: span-tree completeness per eval path (solo / gateway-dispatched
+/ group-committed / demoted-retry), ring bounding + exemplar
+worst-K retention and pinning under churn, drift auto-pin, the
+NOMAD_TPU_TRACE kill switch, Chrome trace-event JSON schema validity,
+the HTTP/CLI surface, stages steady_share, and an overhead smoke
+asserting tracing-on e2e placements/s within 5% of tracing-off.
+"""
+
+import json
+import time
+
+import pytest
+
+from nomad_tpu import mock, trace
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.trace import EvalTrace, Tracer, to_chrome, tracer
+from nomad_tpu.utils import stages
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    tracer.reset()
+    tracer.refresh()
+    yield
+    tracer.reset()
+    tracer.refresh()
+
+
+def _mk_eval_trace(eid="ev-test", track="test"):
+    class Ev:
+        id = eid
+        job_id = "j"
+        namespace = "default"
+        type = "service"
+        queue_wait_s = 0.0
+
+    tr = tracer.begin(Ev(), track=track)
+    assert tr is not None
+    return tr
+
+
+def _run_jobs(n_jobs=3, count=2, prefix="trace", **cfg):
+    """Drive n_jobs service jobs through a real Server; returns
+    (jobs, placements/s). Workers paused during registration so the
+    broker has depth (the gateway-coalescing shape)."""
+    s = Server(ServerConfig(num_schedulers=2, heartbeat_ttl_s=3600.0,
+                            **cfg))
+    s.start()
+    try:
+        for w in s.workers:
+            w.set_pause(True)
+        for i in range(12):
+            node = mock.node()
+            node.name = f"{prefix}-n{i}"
+            node.compute_class()
+            s.register_node(node)
+        jobs = []
+        for i in range(n_jobs):
+            job = mock.job()
+            job.id = f"{prefix}-{i}"
+            tg = job.task_groups[0]
+            tg.count = count
+            for t in tg.tasks:
+                t.resources.networks = []
+            tg.networks = []
+            jobs.append(job)
+            s.register_job(job)
+        t0 = time.perf_counter()
+        for w in s.workers:
+            w.set_pause(False)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(len(s.store.allocs_by_job("default", j.id)) == count
+                   for j in jobs):
+                break
+            time.sleep(0.005)
+        wall = time.perf_counter() - t0
+        placed = sum(len(s.store.allocs_by_job("default", j.id))
+                     for j in jobs)
+        assert placed == n_jobs * count
+    finally:
+        s.shutdown()     # drains the deferred-finish queues
+    return jobs, placed / max(wall, 1e-9)
+
+
+def _traces_for(prefix):
+    return [t for t in tracer.recent(200)
+            if t["job_id"].startswith(prefix + "-")]
+
+
+# -- span-tree completeness --------------------------------------------
+
+REQUIRED_SOLO = {"queue_wait", "sched_host", "reconcile",
+                 "plan_verify", "plan_commit", "broker_ack"}
+
+
+def test_solo_path_span_tree_complete():
+    """Gateway off (window=0): every placing eval's trace carries the
+    full enqueue->ack tree with commit attrs, and the static parent
+    encoding holds."""
+    _run_jobs(prefix="solo", gateway_window_us=0)
+    ts = _traces_for("solo")
+    assert len(ts) >= 3
+    placing = [t for t in ts
+               if any(s["name"] == "plan_commit" for s in t["spans"])]
+    assert len(placing) >= 3
+    for t in placing:
+        names = {s["name"] for s in t["spans"]}
+        assert REQUIRED_SOLO <= names, names
+        assert t["status"] == "acked"
+        assert t["total_ms"] > 0
+        for sp in t["spans"]:
+            assert sp["parent"] in (None, "eval", "sched_host")
+            assert sp["t0_ms"] >= 0.0 and sp["dur_ms"] >= 0.0
+            # spans sit inside the eval window (small slack for the
+            # finish-side bookkeeping racing the deferred ack)
+            assert sp["t0_ms"] <= t["total_ms"] + 50.0
+        qw = next(s for s in t["spans"] if s["name"] == "queue_wait")
+        assert qw["track"] == "broker"
+        assert "ready_ms" in qw["attrs"]
+        pv = next(s for s in t["spans"] if s["name"] == "plan_verify")
+        assert pv["attrs"]["group"] >= 1
+        assert pv["track"] == "applier"
+        pc = next(s for s in t["spans"] if s["name"] == "plan_commit")
+        assert pc["attrs"]["group"] >= 1
+        rc = next(s for s in t["spans"] if s["name"] == "reconcile")
+        assert rc["attrs"]["columnar"] in (True, False)
+
+
+def test_gateway_path_records_batch_attrs_and_kernel_arms():
+    """Gateway on (default): every dispatched eval gets a
+    gateway_wait span with the fire anatomy (trigger/batch/lanes) on
+    the gateway track, and kernel spans carry (arm, n_pad, fresh)."""
+    _run_jobs(prefix="gw")
+    ts = _traces_for("gw")
+    assert ts
+    gws = [s for t in ts for s in t["spans"]
+           if s["name"] == "gateway_wait"]
+    assert gws, "no gateway spans recorded"
+    for s in gws:
+        assert s["track"] == "gateway"
+        assert s["attrs"]["trigger"] in (
+            "occupancy", "immediate", "drain", "deadline")
+        assert s["attrs"]["batch"] >= 1
+        assert s["attrs"]["lanes"] >= 1
+    kernels = [s for t in ts for s in t["spans"]
+               if s["name"] == "kernel"]
+    assert kernels, "no kernel spans recorded"
+    for s in kernels:
+        assert isinstance(s["attrs"]["arm"], str) and s["attrs"]["arm"]
+        assert s["attrs"]["n_pad"] >= 1
+        assert s["attrs"]["fresh"] in (True, False)
+
+    # Chrome export over the real ring: valid trace-event JSON, every
+    # X event on a named track
+    out = tracer.export_chrome(limit=100)
+    json.loads(json.dumps(out))     # round-trips
+    assert out["displayTimeUnit"] == "ms"
+    evs = out["traceEvents"]
+    assert evs
+    named, used = set(), set()
+    for e in evs:
+        assert e["ph"] in ("X", "M")
+        assert e["pid"] == 1 and isinstance(e["tid"], int)
+        if e["ph"] == "M":
+            assert e["name"] == "thread_name"
+            assert e["args"]["name"]
+            named.add(e["tid"])
+        else:
+            assert e["name"]
+            assert e["ts"] >= 0 and e["dur"] >= 0
+            used.add(e["tid"])
+    assert used <= named
+    tracks = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert "gateway" in tracks and "applier" in tracks
+
+
+def _conflict_fixture():
+    """Two plans overfilling one node (the test_plan_group shape):
+    grouped, the second demotes exactly like a stale-snapshot retry."""
+    from nomad_tpu.models import ALLOC_CLIENT_RUNNING, Plan
+    from nomad_tpu.utils.ids import generate_uuid
+
+    job = mock.batch_job()
+    node = mock.node()
+
+    def make_plan():
+        a = mock.batch_alloc()
+        a.id = generate_uuid()
+        a.eval_id = ""
+        a.job = None
+        a.job_id = job.id
+        a.task_group = job.task_groups[0].name
+        a.node_id = node.id
+        a.client_status = ALLOC_CLIENT_RUNNING
+        res = a.allocated_resources.tasks["worker"]
+        res.cpu.cpu_shares = 3000
+        res.memory.memory_mb = 6000
+        p = Plan(priority=50)
+        p.job = job
+        p.node_allocation = {node.id: [a]}
+        return p
+
+    return job, node, make_plan(), make_plan()
+
+
+def test_group_commit_and_demotion_span_attrs():
+    """Grouped plans: each member's trace gets a per-plan verify span
+    with the group width, the loser's is marked conflicted+demoted,
+    and the shared commit span carries the group size + raft index."""
+    from nomad_tpu.server.plan_queue import PendingPlan
+
+    job, node, p1, p2 = _conflict_fixture()
+    t1 = _mk_eval_trace("ev-winner")
+    t2 = _mk_eval_trace("ev-loser")
+    p1._trace = t1
+    p2._trace = t2
+    srv = Server(ServerConfig(num_schedulers=0, heartbeat_ttl_s=3600.0))
+    srv.store.upsert_node(100, node)
+    srv.store.upsert_job(101, job)
+    srv._raft_index = 101
+    pairs, waiter, gidx = srv.plan_applier.apply_group(
+        [PendingPlan(p1), PendingPlan(p2)])
+    assert waiter is None and len(pairs) == 2 and gidx > 0
+
+    v1 = next(s for s in t1.spans if s["name"] == "plan_verify")
+    assert v1["attrs"]["group"] == 2
+    assert v1["attrs"]["conflicted"] is False
+    assert v1["attrs"]["demoted"] is False
+    assert v1["attrs"]["queue_ms"] >= 0.0
+    v2 = next(s for s in t2.spans if s["name"] == "plan_verify")
+    assert v2["attrs"]["conflicted"] is True
+    assert v2["attrs"]["demoted"] is True
+
+    c1 = next(s for s in t1.spans if s["name"] == "plan_commit")
+    assert c1["attrs"]["group"] == 2
+    assert c1["attrs"]["index"] == gidx
+    assert c1["attrs"]["committed"] is True
+    # the fully rejected plan had nothing to commit but still learns
+    # the group's commit index from its span
+    c2 = next(s for s in t2.spans if s["name"] == "plan_commit")
+    assert c2["attrs"]["committed"] is False
+
+
+def test_kernel_span_fans_out_to_every_lane():
+    """A batched fire's ONE device dispatch must land on each lane's
+    trace (the gateway installs the union context around _run)."""
+    from nomad_tpu.ops.select import cost_model
+
+    t1 = _mk_eval_trace("lane-1")
+    t2 = _mk_eval_trace("lane-2")
+    with trace.use_many([t1, t2], track="gateway"):
+        cost_model.observe("kway_batched", 128, 0.005, lanes=2)
+    for tr in (t1, t2):
+        ks = [s for s in tr.spans if s["name"] == "kernel"]
+        assert len(ks) == 1
+        assert ks[0]["attrs"] == {"arm": "kway_batched", "n_pad": 128,
+                                  "lanes": 2, "fresh": False}
+        assert ks[0]["track"] == "gateway"
+    # compile walls are flagged, not hidden
+    with trace.use(t1):
+        cost_model.observe("chunked", 64, 1.5, compiled=True)
+    fresh = [s for s in t1.spans
+             if s["name"] == "kernel" and s["attrs"]["fresh"]]
+    assert len(fresh) == 1
+
+
+# -- ring bounding / exemplars under churn -----------------------------
+
+def _complete_synthetic(t, ms, eid, spans=5):
+    now = time.monotonic()
+    tr = EvalTrace(eid, "job", "default", "service", "w",
+                   mono0=now - ms / 1000.0, wall0=time.time())
+    for _ in range(spans):
+        tr.add_span("reconcile", 0.0005)
+    t.finish(tr)
+    return tr
+
+
+def test_ring_stays_within_byte_budget_under_churn():
+    t = Tracer(ring_bytes=6000, exemplar_slots=0)
+    for i in range(200):
+        _complete_synthetic(t, 5.0, f"churn-{i}")
+    assert t._ring_used <= 6000
+    assert t.ring_len() < 200
+    assert t.stats["dropped"] > 0
+    assert t.stats["traces"] == 200
+    # newest survive, oldest aged out
+    ids = [d["eval_id"] for d in t.recent(1000)]
+    assert ids[-1] == "churn-199"
+    assert "churn-0" not in ids
+
+
+def test_exemplar_worst_k_retention_and_pinning():
+    t = Tracer(exemplar_slots=2)
+    t.force_threshold_ms = 0.0          # promote everything offered
+    _complete_synthetic(t, 10.0, "a")
+    _complete_synthetic(t, 20.0, "b")
+    _complete_synthetic(t, 30.0, "c")   # displaces a (the fastest)
+    ids = {e["eval_id"] for e in t.exemplars()}
+    assert ids == {"b", "c"}
+    # exemplars sorted worst-first
+    assert t.exemplars()[0]["eval_id"] == "c"
+
+    # a pin MOVES the current set to the pinned store, freeing the
+    # rolling slots — a drift event must not blind the recorder to
+    # tails that develop after it
+    assert t.pin_exemplars("drift:service.p99_ms->broker.ready") == 2
+    _complete_synthetic(t, 500.0, "d")  # still captured post-pin
+    by_id = {e["eval_id"]: e for e in t.exemplars()}
+    assert set(by_id) == {"b", "c", "d"}
+    assert by_id["b"]["pinned"] and by_id["c"]["pinned"]
+    assert "broker.ready" in by_id["b"]["reason"]
+    assert not by_id["d"]["pinned"]
+    # pinned captures survive slower arrivals indefinitely
+    _complete_synthetic(t, 900.0, "e")
+    _complete_synthetic(t, 950.0, "f")  # rolling = worst-2 of d/e/f
+    ids = {x["eval_id"] for x in t.exemplars()}
+    assert {"b", "c", "e", "f"} <= ids and "d" not in ids
+    assert t.stats["exemplar_pins"] == 2
+    # the pinned store is bounded at 2x slots: pinning the rolling
+    # pair fills it (4); further pins are dropped
+    assert t.pin_exemplars("again") == 2
+    _complete_synthetic(t, 990.0, "g")
+    assert t.pin_exemplars("overflow") == 0
+    assert t.exemplar_count() == 5      # 4 pinned + 1 rolling
+
+
+def test_threshold_adapts_to_governor_p99():
+    t = Tracer(exemplar_slots=4)
+    t.threshold_fn = lambda: 50.0
+    t.threshold_pct = 200.0
+    assert t.threshold_ms() == 100.0
+    _complete_synthetic(t, 40.0, "fast")    # below threshold: dropped
+    assert t.exemplar_count() == 0
+    _complete_synthetic(t, 150.0, "slow")   # above: promoted
+    assert t.exemplar_count() == 1
+    assert t.exemplars()[0]["eval_id"] == "slow"
+    # forced override wins (the test hook)
+    t.force_threshold_ms = 5.0
+    assert t.threshold_ms() == 5.0
+
+
+def test_exemplar_gauge_snapshot_taken_at_completion():
+    t = Tracer(exemplar_slots=2)
+    t.force_threshold_ms = 0.0
+    t.gauge_fn = lambda: {"broker.ready": 7.0}
+    _complete_synthetic(t, 10.0, "g")
+    ex = t.exemplars()
+    assert ex[0]["gauges"] == {"broker.ready": 7.0}
+
+
+def test_drift_finding_auto_pins_via_server_hook():
+    srv = Server(ServerConfig(num_schedulers=0, heartbeat_ttl_s=3600.0))
+    assert srv.governor is not None
+    assert srv._auto_pin_exemplars in srv.governor.drift_hooks
+    tracer.force_threshold_ms = 0.0
+    tracer.finish(_mk_eval_trace("pin-me"))
+    assert tracer.exemplar_count() == 1
+    finding = {"kind": "drift", "metric": "service.p99_ms",
+               "ratio": 2.0, "suspect_structure": "broker.ready"}
+    for hook in list(srv.governor.drift_hooks):
+        hook(finding)
+    ex = tracer.exemplars()
+    assert ex and all(e["pinned"] for e in ex)
+    assert "broker.ready" in ex[0]["reason"]
+    assert any(e.get("kind") == "trace_pin"
+               for e in srv.governor.events())
+    # findings without a suspect pin nothing
+    before = tracer.stats["exemplar_pins"]
+    srv._auto_pin_exemplars({"kind": "drift", "metric": "x"})
+    assert tracer.stats["exemplar_pins"] == before
+
+
+def test_sample_once_invokes_drift_hooks(monkeypatch):
+    from nomad_tpu.governor import Governor
+    gov = Governor(drift_check_every=1)
+    seen = []
+    gov.drift_hooks.append(seen.append)
+    monkeypatch.setattr(
+        gov.drift, "check",
+        lambda: [{"kind": "drift", "metric": "m",
+                  "suspect_structure": "s"}])
+    gov.sample_once()
+    assert seen and seen[0]["suspect_structure"] == "s"
+
+
+# -- kill switch / context plumbing ------------------------------------
+
+def test_env_kill_switch_disarms_everything(monkeypatch):
+    stages.disable()
+    monkeypatch.setenv("NOMAD_TPU_TRACE", "0")
+    tracer.refresh()
+    assert not tracer.enabled()
+    # no bench collection + no tracing => report sites see one False
+    assert not stages.enabled
+    class Ev:
+        id = "x"
+        job_id = "j"
+        namespace = "d"
+        type = "service"
+        queue_wait_s = 0.0
+    assert tracer.begin(Ev(), track="w") is None
+    # a Server constructed under the kill switch stays dark
+    srv = Server(ServerConfig(num_schedulers=0, heartbeat_ttl_s=3600.0))
+    assert not srv.tracer.enabled()
+    monkeypatch.delenv("NOMAD_TPU_TRACE")
+    tracer.refresh()
+    assert tracer.enabled()
+    assert stages.enabled       # trace hook re-arms the report sites
+
+
+def test_use_context_nests_and_restores():
+    t1 = _mk_eval_trace("outer")
+    t2 = _mk_eval_trace("inner")
+    assert trace.current() is None
+    with trace.use(t1):
+        assert trace.current() is t1
+        with trace.use_many([t1, t2], track="gateway"):
+            assert set(trace.current_all()) == {t1, t2}
+        assert trace.current() is t1
+    assert trace.current() is None
+
+
+def test_span_cap_bounds_a_runaway_eval():
+    from nomad_tpu.trace.tracer import MAX_SPANS_PER_TRACE
+    tr = _mk_eval_trace("runaway")
+    for _ in range(MAX_SPANS_PER_TRACE + 50):
+        tr.add_span("reconcile", 0.001)
+    assert len(tr.spans) == MAX_SPANS_PER_TRACE
+    # begin() spent one slot on queue_wait: 51 appends bounced
+    assert tr.truncated == 51
+    d = tr.to_dict()
+    assert d["truncated_spans"] == tr.truncated
+
+
+# -- stages steady_share (satellite) -----------------------------------
+
+def test_stages_steady_share_excludes_cold_start():
+    stages.enable()
+    try:
+        stages.add("restore", 3.0)
+        stages.add("kernel", 1.0)
+        stages.add("reconcile", 1.0)
+        stages.add("queue_wait", 10.0)      # excluded from both
+        stages.add("sched_host", 2.0)       # superset: excluded
+        snap = stages.snapshot()
+        # share: over restore+kernel+reconcile = 5.0
+        assert snap["restore"]["share"] == 0.6
+        assert snap["kernel"]["share"] == 0.2
+        # steady_share: cold stages out of the denominator (2.0)
+        assert snap["restore"]["steady_share"] == 0.0
+        assert snap["wal_replay"]["steady_share"] == 0.0
+        assert snap["kernel"]["steady_share"] == 0.5
+        assert snap["reconcile"]["steady_share"] == 0.5
+        # excluded stages still report their own ratios
+        assert snap["queue_wait"]["share"] == 2.0
+        assert snap["sched_host"]["steady_share"] == 1.0
+    finally:
+        stages.disable()
+
+
+# -- HTTP / CLI surface ------------------------------------------------
+
+def test_http_route_and_cli_surface(tmp_path):
+    from nomad_tpu.api import ApiClient, HTTPApiServer
+
+    srv = Server(ServerConfig(num_schedulers=0, heartbeat_ttl_s=3600.0))
+    tracer.force_threshold_ms = 0.0
+    tr = _mk_eval_trace("http-ev")
+    tr.add_span("reconcile", 0.001)
+    tracer.finish(tr)
+    api = HTTPApiServer(srv, port=0)
+    api.start()
+    try:
+        c = ApiClient(f"http://127.0.0.1:{api.port}")
+        out = c.trace()
+        assert out["enabled"] is True
+        assert out["ring"]["traces"] >= 1
+        assert out["ring"]["bytes_max"] == srv.config.trace_ring_bytes
+        assert any(t["eval_id"] == "http-ev" for t in out["recent"])
+        assert out["exemplars"] and "stage_percentiles" in out
+        only_ex = c.trace({"exemplars": "true"})
+        assert "recent" not in only_ex
+        chrome = c.trace({"format": "chrome"})
+        assert chrome["traceEvents"]
+        assert {e["ph"] for e in chrome["traceEvents"]} <= {"X", "M"}
+
+        # governor carries the recorder gauges
+        names = [g["name"] for g in c.governor()["gauges"]]
+        assert "trace.ring_traces" in names
+        assert "trace.exemplars" in names
+
+        # the CLI renders both forms
+        from nomad_tpu.cli.main import main as cli_main
+        rc = cli_main(["-address", f"http://127.0.0.1:{api.port}",
+                       "operator", "trace"])
+        assert rc == 0
+        out_file = str(tmp_path / "trace.json")
+        rc = cli_main(["-address", f"http://127.0.0.1:{api.port}",
+                       "operator", "trace", "-exemplars",
+                       "-o", "chrome", "-output", out_file])
+        assert rc == 0
+        with open(out_file) as f:
+            payload = json.load(f)
+        assert payload["traceEvents"]
+    finally:
+        api.shutdown()
+
+
+def test_to_chrome_handles_empty_and_minimal():
+    assert to_chrome([]) == {"traceEvents": [],
+                             "displayTimeUnit": "ms"}
+    out = to_chrome([{"eval_id": "e", "track": "w", "start": 1.0,
+                      "total_ms": 2.0, "spans": []}])
+    assert len(out["traceEvents"]) == 2     # thread_name + root
+
+
+# -- overhead smoke ----------------------------------------------------
+
+def test_tracing_overhead_within_5pct(monkeypatch):
+    """Tracing-on e2e placements/s within 5% of tracing-off at bench
+    quick scale (ISSUE 9 acceptance). Measures the bench's e2e shape —
+    full scheduler Process() over a seeded store — single-threaded
+    through the Harness with a REAL trace context per eval (begin /
+    ambient spans / kernel span / finish+promotion all on the clock),
+    so the comparison resolves the recorder's cost instead of the
+    worker thread-pool's dequeue jitter: a paused-burst Server wall at
+    this scale swings ±20% under CI load, 4000x the actual span
+    overhead. Interleaved best-of-3 per mode, bounded retries."""
+    from nomad_tpu.bench.ladder import _eval_for, _seed_nodes
+    from nomad_tpu.scheduler.harness import Harness
+
+    h = Harness()
+    _seed_nodes(h, 200, dcs=1)
+
+    def mk_job(tag, i):
+        from nomad_tpu import mock as _mock
+        job = _mock.job()
+        job.id = f"ovh-{tag}-{i}"
+        job.datacenters = ["dc1"]
+        tg = job.task_groups[0]
+        tg.count = 10
+        for t in tg.tasks:
+            t.resources.networks = []
+        tg.networks = []
+        return job
+
+    from nomad_tpu.utils import gcsafe
+
+    def _set_mode(trace_on):
+        if trace_on:
+            monkeypatch.delenv("NOMAD_TPU_TRACE", raising=False)
+        else:
+            monkeypatch.setenv("NOMAD_TPU_TRACE", "0")
+        tracer.refresh()
+
+    def run_paired(tag, n_pairs=24):
+        """PAIRED design: modes alternate eval-by-eval, so the
+        workload's own non-stationarity (the store grows and caches
+        warm as evals run — measured drift between sequential phases
+        reaches 50%, 15x the recorder's real cost) hits both classes
+        identically; medians are outlier-robust (one GC/preemption
+        must not decide a 5% verdict) and collector pauses park
+        between evals exactly like the bench's timed windows. Returns
+        (on_median_s, off_median_s)."""
+        placed_before = len(h.plans)
+        times = {True: [], False: []}
+        with gcsafe.safepoints():
+            for i in range(2 * n_pairs):
+                trace_on = (i % 2 == 0)
+                _set_mode(trace_on)
+                job = mk_job(tag, i)
+                h.store.upsert_job(h.next_index(), job)
+                ev = _eval_for(job)
+                t0 = time.perf_counter()
+                tr = tracer.begin(ev, track="bench")
+                with trace.use(tr):
+                    h.process("service", ev)
+                tracer.finish(tr)
+                times[trace_on].append(time.perf_counter() - t0)
+                gcsafe.safepoint()
+        placed = sum(
+            sum(len(a) for a in p.node_allocation.values())
+            for p in h.plans[placed_before:])
+        assert placed == 2 * n_pairs * 10
+
+        def median(v):
+            v = sorted(v)
+            return v[len(v) // 2]
+
+        return median(times[True]), median(times[False])
+
+    _set_mode(True)
+    run_paired("warm", n_pairs=2)           # compile + caches
+
+    on, off = run_paired("m0")
+    for attempt in range(2):
+        if on <= off / 0.95:
+            break
+        on2, off2 = run_paired(f"m{attempt + 1}")   # noise retry
+        on, off = min(on, on2), min(off, off2)
+    # placements/s per eval = count/median: within 5% <=> medians
+    # within 1/0.95
+    assert on <= off / 0.95, (
+        f"tracing-on median {on * 1e3:.2f} ms/eval vs off "
+        f"{off * 1e3:.2f} ms/eval")
