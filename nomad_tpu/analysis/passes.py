@@ -536,7 +536,8 @@ class SurfaceDriftRule(Rule):
     # ServerConfig knob families that must appear in the STATUS.md knob
     # table (operators find them there; the table is the contract)
     KNOB_PREFIXES = ("governor_", "plan_group_", "reconcile_",
-                     "gateway_", "snapshot_", "wal_", "trace_")
+                     "gateway_", "snapshot_", "wal_", "trace_",
+                     "preempt_")
 
     def __init__(self,
                  http_path: str = "nomad_tpu/api/http.py",

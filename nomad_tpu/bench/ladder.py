@@ -11,6 +11,8 @@ Ladder configs (BASELINE.md):
   #2  batch job count=10k over 1k nodes        -> placements/sec e2e
   #3  service job w/ spread+affinity, 10k nodes -> p99 Process() latency
   #4  mixed-priority preemption, 1k nodes       -> preemption evals/sec
+      (run twice in-process: batched columnar victim selection vs the
+      NOMAD_TPU_COLUMNAR_PREEMPT=0 reference path — ISSUE 10)
 """
 
 from __future__ import annotations
@@ -364,9 +366,44 @@ def bench_broker_service(n_nodes: int = 10000, n_jobs: int = 64,
 def bench_preemption(n_nodes: int = 1000, n_evals: int = 10,
                      count: int = 50) -> Dict:
     """Ladder #4: nodes saturated by low-priority batch allocs; a
-    high-priority service job must preempt to place. Measures e2e evals
-    with the preemption path live."""
+    high-priority service job must preempt to place. Runs the scenario
+    twice in-process — batched columnar victim selection vs the
+    NOMAD_TPU_COLUMNAR_PREEMPT=0 per-node reference path (ISSUE 10) —
+    and reports the victim-selection speedup from the accumulated
+    preempt-phase seconds (the e2e rate also rides along for both, but
+    at CI scale the eval's kernel/plan/commit overhead would mask the
+    selector win the acceptance floor is about)."""
+    import os
+
+    # both arms force their switch explicitly (the bench_reconcile
+    # idiom) — an ambient kill switch in the environment must not
+    # silently turn the "on" arm into a second reference run
+    prev = os.environ.get("NOMAD_TPU_COLUMNAR_PREEMPT")
+    try:
+        os.environ["NOMAD_TPU_COLUMNAR_PREEMPT"] = "1"
+        # a throwaway run at the REAL shape absorbs process-global
+        # warmup (imports, allocator growth, fresh XLA traces for this
+        # node/count bucket) that would otherwise land entirely on
+        # whichever arm runs first and skew rate and speedup alike
+        _preemption_run(n_nodes, 1, count)
+        on = _preemption_run(n_nodes, n_evals, count)
+        os.environ["NOMAD_TPU_COLUMNAR_PREEMPT"] = "0"
+        off = _preemption_run(n_nodes, n_evals, count)
+    finally:
+        if prev is None:
+            os.environ.pop("NOMAD_TPU_COLUMNAR_PREEMPT", None)
+        else:
+            os.environ["NOMAD_TPU_COLUMNAR_PREEMPT"] = prev
+    out = dict(on)
+    out["rate_off"] = off["rate"]
+    out["speedup"] = (off["select_s"] / on["select_s"]
+                      if on["select_s"] > 0 else 0.0)
+    return out
+
+
+def _preemption_run(n_nodes: int, n_evals: int, count: int) -> Dict:
     from ..mock import fixtures as mock
+    from ..scheduler import preemption as pmod
     from ..scheduler.harness import Harness
 
     h = Harness()
@@ -404,6 +441,7 @@ def bench_preemption(n_nodes: int = 1000, n_evals: int = 10,
     h.store.upsert_job(h.next_index(), warm)
     h.process("service", _eval_for(warm))
     n_warm_plans = len(h.plans)
+    stats0 = pmod.preempt_stats()       # baseline AFTER the warm eval
 
     # same GC regime as the agent's workers (utils/gcsafe.py)
     from ..utils import gcsafe
@@ -419,15 +457,24 @@ def bench_preemption(n_nodes: int = 1000, n_evals: int = 10,
             times.append(time.perf_counter() - t0)
             gcsafe.safepoint()
     wall = time.perf_counter() - t_all
+    stats1 = pmod.preempt_stats()
     preempted = 0
     for plan in h.plans[n_warm_plans:]:
         placed += sum(len(a) for a in plan.node_allocation.values())
         preempted += sum(len(a) for a in plan.node_preemptions.values())
+    hits = stats1["cache_hits"] - stats0["cache_hits"]
+    misses = stats1["cache_misses"] - stats0["cache_misses"]
+    arr = np.array(times)
     return {
         "rate": placed / wall,
         "placed": placed,
         "preempted": preempted,
-        "p99_ms": float(np.percentile(np.array(times), 99) * 1e3),
+        "p50_ms": float(np.percentile(arr, 50) * 1e3),
+        "p99_ms": float(np.percentile(arr, 99) * 1e3),
+        "select_s": stats1["select_s"] - stats0["select_s"],
+        "nodes_scanned": int(stats1["nodes_scanned"]
+                             - stats0["nodes_scanned"]),
+        "cache_hit_rate": hits / max(hits + misses, 1),
     }
 
 
@@ -1036,8 +1083,17 @@ def run_ladder(quick: bool = False) -> Dict:
     r4 = bench_preemption(n_nodes=200 if quick else 1000,
                           n_evals=3 if quick else 10)
     out["preemption_placements_per_sec"] = round(r4["rate"], 1)
+    out["preemption_placements_per_sec_off"] = round(r4["rate_off"], 1)
     out["preemption_preempted"] = r4["preempted"]
     out["preemption_p99_ms"] = round(r4["p99_ms"], 1)
+    # batched columnar victim selection vs the per-node reference
+    # path, same seeded scenario in-process (ISSUE 10): speedup is the
+    # accumulated preempt-stage (victim-selection) seconds ratio
+    out["preemption_speedup"] = round(r4["speedup"], 2)
+    out["preemption_p50_ms"] = round(r4["p50_ms"], 2)
+    out["preemption_nodes_scanned"] = r4["nodes_scanned"]
+    out["preemption_victim_cache_hit_rate"] = round(
+        r4["cache_hit_rate"], 4)
     # columnar reconcile engine on vs off over a rolling deployment
     # wave (ISSUE 6 satellite: 10k-alloc job, 3 rolling versions)
     # quick mode keeps 8 evals/version: the on-vs-off ratio is asserted

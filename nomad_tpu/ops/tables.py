@@ -810,6 +810,28 @@ class NodeTableCache:
             return self.device.fold(self._table,
                                     self._table.device_version)
 
+    def preempt_cache_len(self) -> int:
+        """Victim-set memo entries on the current table (the dict is
+        shared across delta clones, so this IS the live memo size) —
+        the governor's preemption.victim_cache_entries gauge."""
+        with self._lock:
+            t = self._table
+        return len(t.preempt_cache) if t is not None else 0
+
+    def clear_preempt_cache(self) -> dict:
+        """Reclaim for governor_preempt_cache_high: drop every victim
+        memo entry (each pins a live-alloc row list + victim allocs);
+        the next preemption round re-derives misses columnar."""
+        with self._lock:
+            t = self._table
+        if t is None:
+            return {"dropped": 0}
+        dropped = len(t.preempt_cache)
+        t.preempt_cache.clear()
+        from ..scheduler.preemption import PREEMPT_STATS
+        PREEMPT_STATS["cache_clears"] += 1
+        return {"dropped": dropped}
+
 
 class ProposedIndex:
     """Per-eval view of the job's proposed allocations: existing live

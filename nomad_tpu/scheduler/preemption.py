@@ -13,12 +13,70 @@ Node choice across candidates uses the logistic preemption score
 from __future__ import annotations
 
 import math
+import os
+import time
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..models import Allocation, ComparableResources
+from ..utils import stages
 
 MAX_PARALLEL_PENALTY = 50.0
 PRIORITY_DELTA = 10
+
+# -- batched columnar victim selection (ISSUE 10) ----------------------
+#
+# ServerConfig.preempt_* knobs land here via configure() (the
+# store.alloc_index.enabled idiom — the scheduler has no ServerConfig
+# in scope). NOMAD_TPU_COLUMNAR_PREEMPT=0 is the runtime kill switch:
+# it forces the per-node reference Preemptor for every round, exactly
+# like NOMAD_TPU_COLUMNAR_RECONCILE=0 reverts the reconcile engine.
+
+_COLUMNAR = True
+# per-node candidate cap for the dense [nodes, candidates] matrix; a
+# node with more eligible candidates than this takes the per-node
+# reference path (the matrix would pad every other node to its width)
+ROWS_MAX = 4096
+# victim-set memo bound (table.preempt_cache); crossing it clears the
+# memo — the governor's preemption.victim_cache_entries watermark
+# (governor_preempt_cache_high) reclaims earlier
+CACHE_MAX = 200_000
+
+# unlocked counters (the BUILD_STATS idiom: racy increments are
+# tolerated — these feed gauges and the bench artifact, not billing)
+PREEMPT_STATS: Dict[str, float] = {
+    "nodes_scanned": 0, "candidate_rows": 0,
+    "cache_hits": 0, "cache_misses": 0,
+    "invalidations": 0, "cache_clears": 0,
+    "columnar_nodes": 0, "fallback_nodes": 0,
+    "select_s": 0.0,
+}
+
+
+def configure(columnar: Optional[bool] = None,
+              rows_max: Optional[int] = None,
+              cache_max: Optional[int] = None) -> None:
+    """Install ServerConfig.preempt_* knobs (Server.__init__)."""
+    global _COLUMNAR, ROWS_MAX, CACHE_MAX
+    if columnar is not None:
+        _COLUMNAR = bool(columnar)
+    if rows_max is not None:
+        ROWS_MAX = int(rows_max)
+    if cache_max is not None:
+        CACHE_MAX = int(cache_max)
+
+
+def columnar_enabled() -> bool:
+    # same env grammar as reconcile_columnar.columnar_enabled — an
+    # operator flipping both kill switches must not need two spellings
+    return _COLUMNAR and os.environ.get(
+        "NOMAD_TPU_COLUMNAR_PREEMPT", "1").lower() \
+        not in ("0", "false", "no", "off")
+
+
+def preempt_stats() -> Dict[str, float]:
+    return dict(PREEMPT_STATS)
 
 
 def basic_resource_distance(ask: ComparableResources,
@@ -318,7 +376,6 @@ class PreemptionRound:
 
     def __init__(self, snapshot, table, mask, ask_vec, job, plan,
                  tg=None):
-        import numpy as np
         self.snapshot = snapshot
         self.table = table
         self.mask = mask
@@ -352,6 +409,15 @@ class PreemptionRound:
                 for r in combined_device_asks(tg))
         self._cache_sig = (job.priority, tuple(float(x) for x in ask_vec),
                           reserved, devs)
+        # batched victim selection handles the resource dimensions; a
+        # device or network-port/bandwidth ask keeps the per-node
+        # reference path — PreemptForDevice / PreemptForNetwork walk
+        # instance tables and port bitsets per alloc, exactly the rows
+        # reconcile_columnar.py also drops to Python for
+        mbits_need = float(ask_vec[3]) if len(ask_vec) > 3 else 0.0
+        self._columnar = (columnar_enabled() and not devs
+                          and not (reserved and reserved[1])
+                          and not mbits_need > 0)
         # computed state: known[i] -> score[i] (-1 = infeasible) and
         # victim lists; invalidation is *dirty-tracked* from the plan's
         # per-node entry counts instead of re-hashed per call
@@ -389,6 +455,8 @@ class PreemptionRound:
                 self._last_counts[nid] = counts
                 idx = id_to_idx.get(nid)
                 if idx is not None:
+                    if self._known[idx]:
+                        PREEMPT_STATS["invalidations"] += 1
                     self._known[idx] = False
         # global coupling: max_parallel penalties depend on the total
         # preempted count per group; invalidate nodes holding candidates
@@ -404,6 +472,8 @@ class PreemptionRound:
             self._last_mp_counts = mp_counts
             for idx, groups in self._mp_groups.items():
                 if groups & changed:
+                    if self._known[idx]:
+                        PREEMPT_STATS["invalidations"] += 1
                     self._known[idx] = False
 
     # -- per-node evaluation (exact one-shot semantics) ----------------
@@ -429,8 +499,6 @@ class PreemptionRound:
                                                   float]:
         from ..models.funcs import ScoreFitBinPack
 
-        import numpy as np
-
         # cross-eval fast path: an unchanged live-alloc row (identity —
         # rows are replaced copy-on-write) under the same priority/ask/
         # port/device signature yields the same victims; entries with
@@ -442,6 +510,7 @@ class PreemptionRound:
         if cacheable:
             hit = self.table.preempt_cache.get(key)
             if hit is not None and hit[0] is row:
+                PREEMPT_STATS["cache_hits"] += 1
                 _row, victims, score, logistic, freed = hit
                 self._logistic[i] = logistic
                 self._freed[i] = freed
@@ -470,8 +539,9 @@ class PreemptionRound:
             carries max_parallel (whose penalty couples to the running
             preemption counts of this eval)."""
             if cacheable and not mp:
-                if len(self.table.preempt_cache) > 200_000:
+                if len(self.table.preempt_cache) > CACHE_MAX:
                     self.table.preempt_cache.clear()
+                    PREEMPT_STATS["cache_clears"] += 1
                 self.table.preempt_cache[key] = (
                     row,
                     list(victims_out) if victims_out is not None else None,
@@ -543,7 +613,6 @@ class PreemptionRound:
         pscore = preemption_score(net_priority(victims))
         # resources the evictions free, in kernel dim order
         # (cpu, memory, disk, network mbits)
-        import numpy as np
         freed = np.zeros(4, np.float64)
         for v in victims:
             cr = v.comparable_resources()
@@ -557,13 +626,374 @@ class PreemptionRound:
         self._freed[i] = freed
         return memo(victims, (binpack + pscore) / 2.0, pscore, freed)
 
+    # -- batched columnar victim selection (the ISSUE 10 tentpole) -----
+    def _record(self, i: int, victims: Optional[List[Allocation]],
+                score: float) -> None:
+        self._known[i] = True
+        if victims:
+            self._scores[i] = score
+            self._victims[i] = victims
+        else:
+            self._scores[i] = -1.0
+            self._logistic[i] = 0.0
+            self._freed[i] = 0.0
+            self._victims.pop(i, None)
+
+    def _cache_lookup(self, i: int) -> bool:
+        """The cross-eval victim-memo fast path, hoisted out of
+        _evaluate_node so the batched selector only gathers columns
+        for true misses."""
+        if not self._cacheable(i):
+            return False
+        row = self.table.live_allocs[i]
+        hit = self.table.preempt_cache.get((id(row), self._cache_sig))
+        if hit is None or hit[0] is not row:
+            return False
+        PREEMPT_STATS["cache_hits"] += 1
+        _row, victims, score, logistic, freed = hit
+        self._logistic[i] = logistic
+        self._freed[i] = freed
+        self._mp_groups[i] = frozenset()
+        self._record(i, list(victims) if victims is not None else None,
+                     score)
+        return True
+
+    def _memoize(self, i: int, victims: Optional[List[Allocation]],
+                 score: float, logistic: float, freed,
+                 cacheable: bool, has_mp: bool) -> None:
+        """Cross-eval memo install, same contract as _evaluate_node's
+        memo closure: only nodes nothing eval-specific touches, and
+        only when no candidate carries max_parallel."""
+        if not cacheable or has_mp:
+            return
+        cache = self.table.preempt_cache
+        if len(cache) > CACHE_MAX:
+            cache.clear()
+            PREEMPT_STATS["cache_clears"] += 1
+        row = self.table.live_allocs[i]
+        cache[(id(row), self._cache_sig)] = (
+            row, list(victims) if victims is not None else None,
+            score, logistic,
+            freed if freed is not None else np.zeros(4, np.float64))
+
+    def _evaluate_pending(self, pending, used,
+                          current: List[Allocation]) -> None:
+        """Resolve every pending node's (victims, score) entry: memo
+        hits first, then ONE batched columnar pass over the misses
+        (per-node reference Preemptor when the round carries device/
+        port asks, the kill switch is set, or a node's candidate set
+        overflows the matrix cap)."""
+        t0 = time.perf_counter()
+        stopped_ids = {a.id for allocs in self.plan.node_update.values()
+                       for a in allocs}
+        stopped_ids |= {a.id for a in current}
+        misses: List[int] = []
+        for i in pending:
+            i = int(i)
+            if not self._cache_lookup(i):
+                misses.append(i)
+        PREEMPT_STATS["cache_misses"] += len(misses)
+        if misses:
+            if self._columnar:
+                overflow = self._evaluate_columnar(misses, used, current,
+                                                   stopped_ids)
+            else:
+                overflow = misses
+            PREEMPT_STATS["fallback_nodes"] += len(overflow)
+            for i in overflow:
+                victims, score = self._evaluate_node(
+                    i, used[i], current, stopped_ids)
+                self._record(i, victims, score)
+        n_scanned = len(pending)
+        PREEMPT_STATS["nodes_scanned"] += n_scanned
+        dt = time.perf_counter() - t0
+        PREEMPT_STATS["select_s"] += dt
+        if stages.enabled:
+            n_victims = 0
+            for i in pending:
+                v = self._victims.get(int(i))
+                if v:
+                    n_victims += len(v)
+            stages.add("preempt", dt, {"nodes_scanned": n_scanned,
+                                       "victims": n_victims})
+
+    def _evaluate_columnar(self, idxs: List[int], used,
+                           current: List[Allocation],
+                           stopped_ids: set) -> List[int]:
+        """Victim selection for all of `idxs` at once: one
+        struct-of-arrays gather over the nodes' candidate allocs (per-
+        alloc facts through state/alloc_index's memoized extractors),
+        then the whole reference pipeline — PRIORITY_DELTA filter,
+        greedy closest-distance selection (all nodes step in lockstep:
+        each round is one [nodes, candidates] distance matrix + argmin
+        instead of a Python loop per node), the superset drop via
+        stable two-key argsort + prefix cumulative sums, and the
+        binpack + logistic scoring — as vectorized float64 numpy whose
+        op order mirrors the Preemptor exactly (the 1k-seed parity
+        suite pins bit-identical victims and scores). Returns the node
+        indexes whose candidate sets overflow ROWS_MAX — those take
+        the per-node reference path."""
+        from ..state.alloc_index import alloc_max_parallel, alloc_usage_vec
+
+        t = self.table
+        plan = self.plan
+        snap = self.snapshot
+        ns, jid = self.job.namespace, self.job.id
+        jp = self.job.priority
+
+        # current preemption counts per group — static for this pass
+        # (set_preemptions is called once per reference evaluation too)
+        cur_counts: Dict[Tuple, int] = {}
+        for a in current:
+            k = (a.namespace, a.job_id, a.task_group)
+            cur_counts[k] = cur_counts.get(k, 0) + 1
+
+        P = len(idxs)
+        all_usage = np.zeros((P, 3), np.float64)
+        cand_allocs: List[List[Allocation]] = [[] for _ in range(P)]
+        cand_cols: List[List[Tuple]] = [[] for _ in range(P)]
+        cacheable = [False] * P
+        has_mp = [False] * P
+        overflow: List[int] = []
+        over_p = [False] * P
+        ids = t.ids
+        alloc_of = plan.node_allocation
+        for p, i in enumerate(idxs):
+            node_id = ids[i]
+            proposed = [a for a in snap.allocs_by_node(node_id)
+                        if not a.terminal_status()
+                        and a.id not in stopped_ids]
+            proposed.extend(alloc_of.get(node_id, []))
+            mp_groups = set()
+            al = cand_allocs[p]
+            cl = cand_cols[p]
+            cpu_sum = mem_sum = disk_sum = 0.0
+            for a in proposed:
+                u = alloc_usage_vec(a)
+                cpu_sum += u[0]
+                mem_sum += u[1]
+                disk_sum += u[2]
+                # the placing job's own allocs count against capacity
+                # but are never candidates (set_candidates' contract)
+                if a.job_id == jid and a.namespace == ns:
+                    continue
+                mp = alloc_max_parallel(a)
+                if mp > 0:
+                    mp_groups.add((a.namespace, a.job_id, a.task_group))
+                job = a.job
+                if job is None or jp - job.priority < PRIORITY_DELTA:
+                    continue
+                al.append(a)
+                cl.append((u[0], u[1], u[2], u[3], float(job.priority),
+                           float(mp),
+                           float(cur_counts.get(
+                               (a.namespace, a.job_id, a.task_group), 0))))
+            all_usage[p, 0] = cpu_sum
+            all_usage[p, 1] = mem_sum
+            all_usage[p, 2] = disk_sum
+            self._mp_groups[i] = frozenset(mp_groups)
+            cacheable[p] = self._cacheable(i)
+            has_mp[p] = bool(mp_groups)
+            if len(al) > ROWS_MAX:
+                overflow.append(i)
+                over_p[p] = True
+        PREEMPT_STATS["candidate_rows"] += sum(len(c) for c in cand_cols)
+        PREEMPT_STATS["columnar_nodes"] += P - len(overflow)
+
+        idx_arr = np.asarray(idxs, np.int64)
+        # same dtype walk as the reference res_fits check (float32 row
+        # + float32 ask against float32 capacity + 1e-6)
+        res_fits = np.all(used[idx_arr][:, :3]
+                          + np.asarray(self.ask_vec[:3])
+                          <= t.capacity[idx_arr][:, :3] + 1e-6, axis=1)
+        ask3 = np.asarray(self.ask_vec[:3], np.float64)
+        # capacity holds res - reserved exactly (int math at table
+        # build; float32 is exact below 2^24, true for MHz/MB scales)
+        cap3 = t.capacity[idx_arr][:, :3].astype(np.float64)
+        remaining0 = cap3 - all_usage
+
+        rows: List[int] = []           # p-indexes entering the matrix
+        for p, i in enumerate(idxs):
+            if over_p[p]:
+                continue
+            if res_fits[p] or not cand_cols[p]:
+                # fits on cpu/mem/disk (victims would be []), or no
+                # eligible candidates: the reference returns
+                # memo(None, 0.0) either way
+                self._memoize(i, None, 0.0, 0.0, None,
+                              cacheable[p], has_mp[p])
+                self._record(i, None, 0.0)
+            else:
+                rows.append(p)
+        if not rows:
+            return overflow
+
+        rows_arr = np.asarray(rows, np.int64)
+        counts = np.asarray([len(cand_cols[p]) for p in rows], np.int64)
+        C = int(counts.max())
+        M = len(rows)
+        flat = [v for p in rows for v in cand_cols[p]]
+        fa = np.asarray(flat, np.float64)               # [total, 7]
+        m_idx = np.repeat(np.arange(M), counts)
+        offs = np.concatenate(([0], np.cumsum(counts)[:-1]))
+        c_idx = np.arange(len(flat)) - np.repeat(offs, counts)
+
+        # ONE dense scatter for every column; the per-dim matrices are
+        # views (slicing numpy per dim would triple the call overhead
+        # the matrix exists to amortize)
+        dense7 = np.zeros((M, C, 7), np.float64)
+        dense7[m_idx, c_idx] = fa
+        validM = np.zeros((M, C), bool)
+        validM[m_idx, c_idx] = True
+        c3 = dense7[:, :, 0:3]          # cpu, mem, disk
+        c4 = dense7[:, :, 0:4]          # + mbits (the freed vector)
+        cprio = dense7[:, :, 4].copy()
+        cprio[~validM] = np.inf
+        cmp_ = dense7[:, :, 5]
+        cnp = dense7[:, :, 6]
+        # scoreForTaskGroup's crowding penalty is static per pass (the
+        # reference reads set_preemptions' counts, never its own picks)
+        penalty = np.where((cmp_ > 0) & (cnp >= cmp_),
+                          (cnp + 1.0 - cmp_) * MAX_PARALLEL_PENALTY, 0.0)
+
+        # -- greedy closest-distance selection, all nodes in lockstep --
+        needed = np.tile(ask3, (M, 1))
+        avail = remaining0[rows_arr].copy()
+        selected = np.zeros((M, C), bool)
+        order = np.full((M, C), C + 1, np.int64)
+        okM = np.zeros(M, bool)
+        alive = np.arange(M)
+        step = 0
+        while alive.size:
+            sub_valid = validM[alive] & ~selected[alive]
+            has = sub_valid.any(axis=1)
+            if not has.all():
+                alive = alive[has]      # exhausted, ask unmet: no fit
+                if not alive.size:
+                    break
+                sub_valid = validM[alive] & ~selected[alive]
+            # band = the lowest priority still unselected; the
+            # reference consumes each ascending group to exhaustion
+            prio_m = np.where(sub_valid, cprio[alive], np.inf)
+            band = prio_m.min(axis=1)
+            in_band = prio_m == band[:, None]
+            # basic_resource_distance with ask = the running `needed`
+            # (sum order mirrors the scalar: mem² + cpu², then disk²)
+            nd3 = needed[alive][:, None, :]             # [k, 1, 3]
+            pos = nd3 > 0.0
+            t3 = np.where(pos, (nd3 - c3[alive]) / np.where(pos, nd3, 1.0),
+                          0.0)
+            t3 = t3 * t3
+            dist = np.sqrt(t3[:, :, 1] + t3[:, :, 0] + t3[:, :, 2]) \
+                + penalty[alive]
+            dist = np.where(in_band, dist, np.inf)
+            # argmin keeps the first minimum — the scalar loop's strict
+            # `dist < best_dist` tie-break over proposed order
+            pick = dist.argmin(axis=1)
+            selected[alive, pick] = True
+            order[alive, pick] = step
+            pv3 = c3[alive, pick]                       # [k, 3]
+            avail[alive] += pv3
+            needed[alive] -= pv3
+            met = (avail[alive] >= ask3).all(axis=1)
+            okM[alive[met]] = True
+            alive = alive[~met]
+            step += 1
+
+        # -- superset drop + scoring for the feasible nodes ------------
+        F = np.nonzero(okM)[0]
+        fail = np.nonzero(~okM)[0]
+        for m in fail:
+            p = rows[int(m)]
+            i = idxs[p]
+            self._memoize(i, None, 0.0, 0.0, None, cacheable[p],
+                          has_mp[p])
+            self._record(i, None, 0.0)
+        if not F.size:
+            return overflow
+
+        # filterSuperset sorts by distance-to-ask DESC, stable over the
+        # selection order (Python's stable sorted + reverse=True):
+        # stable-argsort by selection order first, then stable-argsort
+        # the gathered negated distances
+        cF = c3[F]
+        posF = cF > 0.0
+        f3 = np.where(posF, (cF - ask3) / np.where(posF, cF, 1.0), 0.0)
+        f3 = f3 * f3
+        dfull = np.sqrt(f3[:, :, 1] + f3[:, :, 0] + f3[:, :, 2])
+        selF = selected[F]
+        ordF = np.where(selF, order[F], np.iinfo(np.int64).max)
+        k1 = np.argsort(ordF, axis=1, kind="stable")
+        negd1 = np.take_along_axis(np.where(selF, -dfull, np.inf), k1,
+                                   axis=1)
+        k2 = np.argsort(negd1, axis=1, kind="stable")
+        perm = np.take_along_axis(k1, k2, axis=1)
+        sel_s = np.take_along_axis(selF, perm, axis=1)
+
+        # prefix cumulative sums ARE the reference's sequential
+        # available.add walk (int-valued floats: exact either way)
+        sorted4 = np.where(sel_s[:, :, None],
+                           np.take_along_axis(c4[F], perm[:, :, None],
+                                              axis=1), 0.0)
+        cum4 = np.cumsum(sorted4, axis=1)
+        availF = remaining0[rows_arr][F]
+        met_pref = ((availF[:, None, :] + cum4[:, :, 0:3]
+                     >= ask3).all(axis=2) & sel_s)
+        nvict = selF.sum(axis=1)
+        any_met = met_pref.any(axis=1)
+        keep = np.where(any_met, met_pref.argmax(axis=1) + 1, nvict)
+
+        fr = np.arange(F.size)
+        freed4 = cum4[fr, keep - 1]
+
+        # ScoreFitBinPack over the post-eviction utilization + the ask
+        all3 = all_usage[rows_arr][F]
+        capF = cap3[rows_arr][F]
+        util_cpu = all3[:, 0] - freed4[:, 0] + ask3[0]
+        util_mem = all3[:, 1] - freed4[:, 1] + ask3[1]
+        node_cpu = capF[:, 0]
+        node_mem = capF[:, 1]
+        free_cpu = np.where(node_cpu != 0.0,
+                            1.0 - util_cpu / np.where(node_cpu != 0.0,
+                                                      node_cpu, 1.0), 0.0)
+        free_mem = np.where(node_mem != 0.0,
+                            1.0 - util_mem / np.where(node_mem != 0.0,
+                                                      node_mem, 1.0), 0.0)
+        total = np.power(10.0, free_cpu) + np.power(10.0, free_mem)
+        binpack = np.minimum(18.0, np.maximum(0.0, 20.0 - total)) / 18.0
+
+        # netPriority + the logistic preemption score over the KEPT set
+        pr_s = np.where(sel_s,
+                        np.take_along_axis(cprio[F], perm, axis=1), 0.0)
+        kept = (np.arange(C)[None, :] < keep[:, None]) & sel_s
+        mx = np.max(np.where(kept, pr_s, 0.0), axis=1)
+        tot = np.sum(np.where(kept, pr_s, 0.0), axis=1)
+        netp = np.where(mx != 0.0,
+                        mx + tot / np.where(mx != 0.0, mx, 1.0), 0.0)
+        pscore = 1.0 / (1.0 + np.exp(0.0048 * (netp - 2048.0)))
+        score = (binpack + pscore) / 2.0
+
+        perm_l = perm.tolist()
+        keep_l = keep.tolist()
+        for f, m in enumerate(F.tolist()):
+            p = rows[m]
+            i = idxs[p]
+            al = cand_allocs[p]
+            victims = [al[c] for c in perm_l[f][:keep_l[f]]]
+            lg = float(pscore[f])
+            fr4 = freed4[f]
+            self._logistic[i] = lg
+            self._freed[i] = fr4
+            self._memoize(i, victims, float(score[f]), lg, fr4,
+                          cacheable[p], has_mp[p])
+            self._record(i, victims, float(score[f]))
+        return overflow
+
     # -- entry ---------------------------------------------------------
     def find_placement(self, used) -> Optional[Tuple[int, List[Allocation],
                                                      float]]:
         """Best (node_idx, victims, score) for one failed instance, or
         None. `used` is the current proposed usage [N, D]."""
-        import numpy as np
-
         current = self._preempted_now()
         self._invalidate_dirty(current)
 
@@ -572,22 +1002,7 @@ class PreemptionRound:
         candidates = self.mask & ~fits
         pending = np.nonzero(candidates & ~self._known)[0]
         if len(pending):
-            stopped_ids = {a.id for allocs in self.plan.node_update.values()
-                           for a in allocs}
-            stopped_ids |= {a.id for a in current}
-            for i in pending:
-                i = int(i)
-                victims, score = self._evaluate_node(
-                    i, used[i], current, stopped_ids)
-                self._known[i] = True
-                if victims:
-                    self._scores[i] = score
-                    self._victims[i] = victims
-                else:
-                    self._scores[i] = -1.0
-                    self._logistic[i] = 0.0
-                    self._freed[i] = 0.0
-                    self._victims.pop(i, None)
+            self._evaluate_pending(pending, used, current)
         masked = np.where(candidates & self._known, self._scores, -1.0)
         best_i = int(np.argmax(masked))
         if masked[best_i] < 0:
@@ -601,8 +1016,6 @@ class PreemptionRound:
         (logistic preemption score, freed resources). `used` rows for
         those nodes should be reduced by `freed` before the kernel so
         fit and binpack reflect the post-eviction node."""
-        import numpy as np
-
         current = self._preempted_now()
         self._invalidate_dirty(current)
         fits = np.all(used + np.asarray(self.ask_vec)[None, :]
@@ -614,22 +1027,7 @@ class PreemptionRound:
             candidates |= self.mask & extra_candidates
         pending = np.nonzero(candidates & ~self._known)[0]
         if len(pending):
-            stopped_ids = {a.id for allocs in self.plan.node_update.values()
-                           for a in allocs}
-            stopped_ids |= {a.id for a in current}
-            for i in pending:
-                i = int(i)
-                victims, score = self._evaluate_node(
-                    i, used[i], current, stopped_ids)
-                self._known[i] = True
-                if victims:
-                    self._scores[i] = score
-                    self._victims[i] = victims
-                else:
-                    self._scores[i] = -1.0
-                    self._logistic[i] = 0.0
-                    self._freed[i] = 0.0
-                    self._victims.pop(i, None)
+            self._evaluate_pending(pending, used, current)
         ok = candidates & self._known & (self._scores >= 0)
         d = used.shape[1]
         pre_score = np.where(ok, self._logistic, 0.0).astype(np.float32)

@@ -213,6 +213,24 @@ class ServerConfig:
     # organic samples. Pays two XLA compiles at start, so off by
     # default; the CLI agent and the benches turn it on
     dispatch_calibration: bool = False
+    # batched columnar preemption (scheduler/preemption.py): victim
+    # selection for all candidate nodes runs as ONE struct-of-arrays
+    # pass + vectorized greedy instead of a per-node Python Preemptor.
+    # False restores the per-node reference path everywhere
+    # (NOMAD_TPU_COLUMNAR_PREEMPT=0 is the runtime kill switch)
+    preempt_columnar: bool = True
+    # candidate-matrix row cap: a node with more eligible candidate
+    # allocs than this takes the per-node reference path instead of
+    # padding every other node's matrix row to its width
+    preempt_rows_max: int = 4096
+    # victim-set memo bound (NodeTable.preempt_cache); crossing it
+    # clears the memo wholesale — the governor watermark below
+    # reclaims earlier and gradually
+    preempt_cache_max: int = 200_000
+    # watermark on live victim-memo entries (each pins a live-alloc
+    # row + its victim allocs); crossing it drops the memo via the
+    # governor reclaim (preemption.victim_cache_entries gauge)
+    governor_preempt_cache_high: int = 150_000
     # eval flight recorder (nomad_tpu/trace/): always-on per-eval span
     # tracing — enqueue -> gateway -> kernel -> group commit -> ack —
     # with a byte-bounded completed-trace ring, pinned tail exemplars,
@@ -239,6 +257,12 @@ class Server:
             self.config.reconcile_index_max_jobs
         self.store.alloc_index.delta_max = \
             self.config.reconcile_index_delta_max
+        # batched columnar preemption knobs (module-level, the
+        # store.alloc_index idiom — the scheduler has no ServerConfig)
+        from ..scheduler import preemption as _preemption
+        _preemption.configure(columnar=self.config.preempt_columnar,
+                              rows_max=self.config.preempt_rows_max,
+                              cache_max=self.config.preempt_cache_max)
         # RLock: FSM appliers can nest (e.g. a node-register unblocking a
         # blocked eval re-enters raft_apply on the same thread)
         self._raft_l = threading.RLock()
@@ -630,6 +654,27 @@ class Server:
                      WatermarkPolicy(
                          cfg.governor_reconcile_index_debt_high),
                      reclaim=lambda: self.store.alloc_index.fold())
+
+        # batched columnar preemption (scheduler/preemption.py, ISSUE
+        # 10): candidate-matrix volume, cross-eval victim-memo traffic,
+        # and dirty-row invalidations — all monotone, never drift
+        # suspects. The memo SIZE gauge carries the watermark: every
+        # entry pins a live-alloc row list plus its victim allocs, so
+        # a churning fleet must not let it grow to the hard
+        # preempt_cache_max clear-all; reads go through self.store
+        # (the table cache is replaced on snapshot restore)
+        from ..scheduler.preemption import PREEMPT_STATS as _ps
+        gov.register("preemption.candidate_rows",
+                     lambda: _ps["candidate_rows"], suspect=False)
+        gov.register("preemption.victim_cache_hits",
+                     lambda: _ps["cache_hits"], suspect=False)
+        gov.register("preemption.cache_invalidations",
+                     lambda: _ps["invalidations"], suspect=False)
+        gov.register("preemption.victim_cache_entries",
+                     lambda: self.store.table_cache.preempt_cache_len(),
+                     WatermarkPolicy(cfg.governor_preempt_cache_high),
+                     reclaim=lambda:
+                     self.store.table_cache.clear_preempt_cache())
 
         # adaptive micro-batch gateway (server/worker.py, ISSUE 7):
         # live window, mean lanes per device dispatch, and the trigger

@@ -54,6 +54,60 @@ CLIENT_FAILED_CODE = 3
 DESIRED_CODES = {ALLOC_DESIRED_RUN: 0, ALLOC_DESIRED_STOP: 1,
                  ALLOC_DESIRED_EVICT: 2}
 
+# -- per-alloc candidate facts (batched columnar preemption) -----------
+#
+# The preemption matrix gather (scheduler/preemption.py
+# _evaluate_columnar) reads one (cpu, mem, disk, mbits) usage row and
+# one migrate.max_parallel per candidate alloc. Both are pure functions
+# of pinned snapshot objects, so they memoize by identity exactly like
+# the node table's usage memo — the gather pays dict hits, not resource
+# graph walks, per candidate.
+
+_usage_vec = None
+
+_MP_MEMO: Dict[Tuple[int, str], Tuple[object, int]] = {}
+_MP_MEMO_MAX = 8192
+
+
+def alloc_usage_vec(a) -> Tuple[float, float, float, float]:
+    """(cpu_shares, memory_mb, disk_mb, mbits) of one alloc's
+    comparable resources — the ops/tables identity-memoized extraction
+    (fleets share flyweight resource rows, so this is ~always a dict
+    hit), surfaced through the state layer for columnar consumers."""
+    global _usage_vec
+    if _usage_vec is None:     # lazy: state must not import ops at load
+        from ..ops.tables import _alloc_usage
+        _usage_vec = _alloc_usage
+    return _usage_vec(a)
+
+
+def alloc_max_parallel(a) -> int:
+    """tg.migrate.max_parallel for one alloc (0 when absent), memoized
+    per (job identity, task group) — the task-group spec walk is
+    invariant for a pinned Job snapshot. Entries pin the Job and
+    re-verify identity on hit (the _ENGINE_CACHE idiom)."""
+    job = a.job
+    if job is None:
+        return 0
+    key = (id(job), a.task_group)
+    hit = _MP_MEMO.get(key)
+    if hit is not None and hit[0] is job:
+        return hit[1]
+    tg = job.lookup_task_group(a.task_group)
+    mp = 0
+    if tg is not None and tg.migrate is not None:
+        mp = tg.migrate.max_parallel
+    if len(_MP_MEMO) >= _MP_MEMO_MAX:
+        # FIFO eviction; tolerate concurrent-lane races like the
+        # ops/tables memos do
+        try:
+            _MP_MEMO.pop(next(iter(_MP_MEMO)), None)
+        except (StopIteration, RuntimeError):
+            pass
+    _MP_MEMO[key] = (job, mp)
+    return mp
+
+
 _INT_COLS = (
     ("client", np.int8), ("desired", np.int8), ("healthy", np.int8),
     ("tg_code", np.int32), ("name_idx", np.int32),

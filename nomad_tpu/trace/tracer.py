@@ -89,7 +89,8 @@ OWN_P99_EVERY = 32
 # root — deterministic (testable) without runtime stack bookkeeping
 STAGE_PARENTS: Dict[str, Optional[str]] = {
     "queue_wait": "eval", "gateway_wait": "sched_host",
-    "reconcile": "sched_host", "table_build": "sched_host",
+    "reconcile": "sched_host", "preempt": "sched_host",
+    "table_build": "sched_host",
     "h2d": "sched_host", "kernel": "sched_host", "d2h": "sched_host",
     "sched_host": "eval", "plan_verify": "eval", "plan_commit": "eval",
     "broker_ack": "eval", "restore": None, "wal_replay": None,
@@ -103,7 +104,7 @@ STAGE_PARENTS: Dict[str, Optional[str]] = {
 # double-count or mis-attribute them.
 AMBIENT_STAGES = frozenset({
     "restore", "wal_replay", "table_build", "h2d", "d2h",
-    "reconcile", "sched_host", "broker_ack",
+    "reconcile", "preempt", "sched_host", "broker_ack",
 })
 
 

@@ -16,6 +16,16 @@ stage of the pipeline a named accumulator:
                   AllocReconciler.compute + result staging (ISSUE 6:
                   this cost was previously invisible — it had to be
                   inferred as "the rest of the host share")
+    preempt       victim selection across candidate nodes: the memo
+                  sweep + batched columnar matrix pass (or per-node
+                  reference Preemptor runs) behind the kernel's
+                  pre_score/freed columns and the no-fit fallback
+                  (ISSUE 10: BENCH_r05's worst number — 354
+                  placements/s — was this phase, previously lumped
+                  into sched_host; reported from
+                  scheduler/preemption.py _evaluate_pending with
+                  nodes-scanned / victim-count attrs for the flight
+                  recorder)
     queue_wait    time the eval sat in the broker's READY queue before
                   a worker dequeued it (ISSUE 9: the enqueue->dequeue
                   leg of the flight recorder's span tree; idle time,
@@ -66,7 +76,7 @@ import threading
 from typing import Callable, Dict, Optional
 
 STAGES = ("restore", "wal_replay", "table_build", "h2d", "kernel",
-          "d2h", "reconcile", "queue_wait", "gateway_wait",
+          "d2h", "reconcile", "preempt", "queue_wait", "gateway_wait",
           "sched_host", "plan_verify", "plan_commit", "broker_ack")
 
 # superset accumulators: wholly contain other stages' time (sched_host

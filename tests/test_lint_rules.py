@@ -302,6 +302,8 @@ class ServerConfig:
     wal_orphan_fsync: bool = True
     trace_documented_bytes: int = 4096
     trace_orphan_bytes: int = 17
+    preempt_documented_rows: int = 4096
+    preempt_orphan_rows: int = 19
     other_knob: int = 1
 """
 
@@ -328,6 +330,7 @@ class TestSurfaceDrift:
                            "snapshot_documented_every and "
                            "wal_documented_fsync and "
                            "trace_documented_bytes and "
+                           "preempt_documented_rows and "
                            "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
@@ -349,6 +352,9 @@ class TestSurfaceDrift:
         # trace_* knobs joined the contract (ISSUE 9: flight-recorder
         # knobs must land in the STATUS.md knob table)
         tr_f = [f for f in out if "trace_orphan_bytes" in f.message]
+        # preempt_* knobs joined the contract (ISSUE 10: batched
+        # columnar preemption knobs must land in the STATUS.md table)
+        pr_f = [f for f in out if "preempt_orphan_rows" in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
@@ -358,6 +364,7 @@ class TestSurfaceDrift:
         assert len(sn_f) == 1
         assert len(wl_f) == 1
         assert len(tr_f) == 1
+        assert len(pr_f) == 1
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
                        for f in out)
@@ -372,6 +379,8 @@ class TestSurfaceDrift:
         assert not any("wal_documented_fsync" in f.message
                        for f in out)
         assert not any("trace_documented_bytes" in f.message
+                       for f in out)
+        assert not any("preempt_documented_rows" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -391,7 +400,9 @@ class TestSurfaceDrift:
                            "wal_documented_fsync, "
                            "wal_orphan_fsync, "
                            "trace_documented_bytes, "
-                           "trace_orphan_bytes")
+                           "trace_orphan_bytes, "
+                           "preempt_documented_rows, "
+                           "preempt_orphan_rows")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
