@@ -114,6 +114,9 @@ def main() -> None:
     # (ISSUE 7): record whether the env kill switch disabled it
     out["microbatch"] = ("off" if os.environ.get(
         "NOMAD_TPU_MICROBATCH", "1") in ("0", "off") else "on")
+    # retained telemetry collector engagement (ISSUE 11)
+    from nomad_tpu.telemetry import enabled as _telemetry_on
+    out["telemetry"] = "on" if _telemetry_on() else "off"
     quick = os.environ.get("NOMAD_TPU_BENCH_QUICK", "") not in ("", "0")
     try:
         platform = _init_backend()
@@ -213,6 +216,18 @@ def main() -> None:
         from nomad_tpu.ops.tables import BUILD_STATS
         out["table_build_stats"] = dict(BUILD_STATS)
         out["dispatch_cost_model"] = cost_model.snapshot()
+        # device economics (ISSUE 11): pad waste and per-arm dispatch
+        # seconds / fresh-compile counts over the whole run — the
+        # first-class instruments the real-TPU validation campaign
+        # reads (a pad_waste_ratio near 1.0 at small scale is the
+        # power-of-two bucketing's floor cost; the number that matters
+        # is the C2M-scale one)
+        from nomad_tpu.ops.select import device_stats_snapshot
+        dev = device_stats_snapshot()
+        out["pad_waste_ratio"] = dev["pad_waste_ratio"]
+        out["device_dispatch_s"] = dev["dispatch_s"]
+        out["device_dispatches"] = dev["dispatches"]
+        out["device_compiles"] = dev["compiles"]
         from nomad_tpu.analysis.sanitizer import traces
         out["lint_recompiles"] = traces.per_kernel()
         # group-commit applier + cross-eval engine reuse (ISSUE 4):

@@ -26,7 +26,7 @@ class ApiClient:
         self.region = region
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
-                 params: Optional[dict] = None) -> Any:
+                 params: Optional[dict] = None, raw: bool = False) -> Any:
         url = self.address + path
         if self.region:
             params = dict(params or {})
@@ -42,7 +42,11 @@ class ApiClient:
                                      headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=310) as resp:
-                return json.loads(resp.read() or "null")
+                payload = resp.read()
+                if raw:
+                    # non-JSON bodies (Prometheus text exposition)
+                    return payload.decode("utf-8", "replace")
+                return json.loads(payload or "null")
         except urllib.error.HTTPError as e:
             try:
                 msg = json.loads(e.read()).get("error", str(e))
@@ -338,8 +342,26 @@ class ApiClient:
     def agent_self(self) -> dict:
         return self._request("GET", "/v1/agent/self")
 
-    def metrics(self) -> dict:
+    def metrics(self, format: str = "") -> Any:
+        """InmemSink snapshot (JSON), or the Prometheus text
+        exposition when format='prometheus' (returned as str)."""
+        if format == "prometheus":
+            return self._request("GET", "/v1/metrics",
+                                 params={"format": "prometheus"},
+                                 raw=True)
         return self._request("GET", "/v1/metrics")
+
+    def telemetry(self, last: Optional[int] = None) -> dict:
+        """Retained telemetry history ring (ISSUE 11): chronological
+        series + derived rates from /v1/operator/telemetry."""
+        return self._request(
+            "GET", "/v1/operator/telemetry",
+            params={"n": str(last)} if last else None)
+
+    def flatness(self) -> dict:
+        """Live steady-state verdict: bench/soak.flatness_verdict run
+        over the in-process telemetry ring."""
+        return self._request("GET", "/v1/operator/flatness")
 
     def agent_profile(self, seconds: float = 1.0) -> dict:
         return self._request("GET", "/v1/agent/pprof/profile",

@@ -40,6 +40,19 @@ def _write_chunk(wfile, data: bytes) -> None:
     wfile.flush()
 
 
+class PlainText:
+    """A route payload served verbatim as text/plain instead of JSON
+    (the Prometheus exposition at /v1/metrics?format=prometheus)."""
+
+    __slots__ = ("text", "content_type")
+
+    def __init__(self, text: str,
+                 content_type: str = "text/plain; version=0.0.4; "
+                                     "charset=utf-8"):
+        self.text = text
+        self.content_type = content_type
+
+
 class HTTPApiServer:
     def __init__(self, server, host: str = "127.0.0.1", port: int = 4646,
                  alloc_dir_bases=None, region_peers=None):
@@ -68,9 +81,14 @@ class HTTPApiServer:
 
             def _respond(self, code: int, payload, index: Optional[int] = None,
                          headers: Optional[dict] = None):
-                body = json.dumps(payload).encode()
+                if isinstance(payload, PlainText):
+                    body = payload.text.encode()
+                    ctype = payload.content_type
+                else:
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 if index is not None:
                     self.send_header("X-Nomad-Index", str(index))
@@ -920,6 +938,32 @@ class HTTPApiServer:
             return tracer.status(
                 limit=limit, exemplars_only=exemplars_only), idx
 
+        # retained telemetry (ISSUE 11): the in-process history ring —
+        # chronological gauge/counter/stage/device series plus derived
+        # rates; ?n= limits to the most recent N samples. `nomad
+        # operator top` renders trends from this instead of a single
+        # snapshot
+        if path == "/v1/operator/telemetry" and method == "GET":
+            tel = getattr(s, "telemetry", None)
+            if tel is None:
+                return {"enabled": False}, idx
+            last = max(0, min(int(q.get("n", 0) or 0), 100000))
+            out = tel.status()
+            out.update(tel.history(last=last or None))
+            return out, idx
+
+        # live flatness verdict (ISSUE 11): bench/soak.flatness_verdict
+        # — the soak artifact's pass/fail math — run over the live
+        # telemetry ring, so an operator (or the validation campaign)
+        # reads steady-state health without a post-hoc harness
+        if path == "/v1/operator/flatness" and method == "GET":
+            tel = getattr(s, "telemetry", None)
+            if tel is None:
+                return {"enabled": False, "pass": None}, idx
+            out = tel.flatness()
+            out["enabled"] = True
+            return out, idx
+
         # operator autopilot configuration (nomad/operator_endpoint.go
         # AutopilotGetConfiguration / AutopilotSetConfiguration)
         if path == "/v1/operator/autopilot/configuration":
@@ -1026,6 +1070,11 @@ class HTTPApiServer:
 
         if path == "/v1/metrics" and method == "GET":
             from ..utils import metrics
+            # ?format=prometheus: text exposition (histogram buckets +
+            # counters + gauges) for a scrape config pointed straight
+            # at the agent (ISSUE 11)
+            if q.get("format", "") == "prometheus":
+                return PlainText(metrics.prometheus()), idx
             return metrics.snapshot(), idx
 
         if path == "/v1/agent/pprof/cmdline" and method == "GET":

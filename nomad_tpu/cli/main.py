@@ -1094,6 +1094,16 @@ def cmd_operator_debug(args) -> int:
     try_add("trace.json", c.trace)
     try_add("trace-chrome.json",
             lambda: c.trace({"format": "chrome"}))
+    # retained telemetry (ISSUE 11): the whole in-process history ring
+    # + the live flatness verdict + a Prometheus-format snapshot ride
+    # in the bundle ONE-SHOT — the interval poll below only adds
+    # samples taken during the capture window, but the ring carries
+    # the minutes BEFORE the operator ran this command, which is
+    # where the incident usually lives
+    try_add("telemetry.json", c.telemetry)
+    try_add("flatness.json", c.flatness)
+    try_add("metrics.prom",
+            lambda: c.metrics(format="prometheus").encode())
     try_add("scheduler-config.json", c.scheduler_config)
     try_add("nomad/jobs.json", c.list_jobs)
     try_add("nomad/nodes.json", c.list_nodes)
@@ -1239,6 +1249,159 @@ def cmd_operator_trace(args) -> int:
                 print(f"      {sp['t0_ms']:9.1f} +{sp['dur_ms']:8.2f}"
                       f"  {sp['name']:13s} [{sp.get('track', '')}]"
                       f"{extra}")
+    return 0
+
+
+def cmd_operator_top(args) -> int:
+    """Live rates and trends from the retained telemetry ring (ISSUE
+    11): evals/s and placements/s from counter deltas, the p99 trend
+    over history, recent per-stage latency shares, the device
+    economics the TPU validation campaign reads (pad waste, per-arm
+    dispatch seconds + compiles, kernel cache, mirror/HBM bytes, lane
+    occupancy), the live flatness verdict, and drift annotations from
+    the governor's event log — `/v1/metrics` shows a point in time,
+    this shows where the numbers are GOING."""
+    from statistics import median
+    c = _client(args)
+    try:
+        tel = c.telemetry(last=args.n)
+    except ApiError as e:
+        print(f"Error querying telemetry: {e}", file=sys.stderr)
+        return 1
+    if not tel.get("enabled", True) or "series" not in tel:
+        print("Telemetry collector disabled on this agent "
+              "(NOMAD_TPU_TELEMETRY=0 or telemetry_sample_interval_s=0)")
+        return 0
+    series = tel.get("series", {})
+    rates = tel.get("rates", {})
+
+    def tail_vals(d, name):
+        return [v for v in d.get(name, []) if v is not None]
+
+    def rate_now(name, k=5):
+        vals = tail_vals(rates, name)
+        return (sum(vals[-k:]) / len(vals[-k:])) if vals else 0.0
+
+    def rate_peak(name):
+        vals = tail_vals(rates, name)
+        return max(vals) if vals else 0.0
+
+    ring_kib = tel.get("ring_bytes", 0) / 1024.0
+    print(f"Telemetry     = {tel.get('samples', 0)} samples @ "
+          f"{tel.get('interval_s', 0)}s "
+          f"({tel.get('series_count', 0)} series, ring "
+          f"{ring_kib:.0f} KiB)")
+    print(f"Evals/s       = "
+          f"{rate_now('counter.nomad.worker.eval_processed'):.1f} now, "
+          f"{rate_peak('counter.nomad.worker.eval_processed'):.1f} peak")
+    print(f"Placements/s  = "
+          f"{rate_now('counter.nomad.plan.placements'):.1f} now, "
+          f"{rate_peak('counter.nomad.plan.placements'):.1f} peak")
+    p99s = tail_vals(series, "latency.p99_ms")
+    if p99s:
+        half = max(1, len(p99s) // 2)
+        first = median(p99s[:half]) or 0.0
+        last = median(p99s[len(p99s) - half:])
+        trend = (last / first) if first > 0 else 1.0
+        p50s = tail_vals(series, "latency.p50_ms")
+        print(f"Latency       = p50 {p50s[-1] if p50s else 0.0:.1f} ms, "
+              f"p99 {p99s[-1]:.1f} ms "
+              f"(trend {trend:.2f}x first->last half)")
+    rss = tail_vals(series, "process.rss_mb")
+    if rss:
+        print(f"RSS           = {rss[-1]:.1f} MB "
+              f"(start of window {rss[0]:.1f} MB)")
+    try:
+        flat = c.flatness()
+        if flat.get("enabled", flat.get("pass") is not None):
+            if flat.get("pass") is None:
+                verdict = f"n/a ({flat.get('reason', 'no verdict')})"
+            elif flat["pass"]:
+                verdict = "PASS"
+            else:
+                verdict = f"FAIL ({flat.get('reason', '?')})"
+            print(f"Flatness      = {verdict} "
+                  f"(p99 drift {flat.get('p99_drift_ratio', '?')}x, "
+                  f"rss {flat.get('rss_slope_mb_per_hour', '?')} MB/h "
+                  f"over {flat.get('windows_measured', 0)} windows)")
+    except ApiError:
+        pass
+
+    # recent per-stage share: p50 x reservoir occupancy approximates
+    # each stage's recent seconds (reservoirs hold the last 2048
+    # reports); superset/idle stages stay out of the denominator like
+    # stages.snapshot()
+    excluded = {"sched_host", "queue_wait"}
+    stage_rows = []
+    weights = {}
+    for name in series:
+        if name.startswith("stage.") and name.endswith(".p50_ms"):
+            stage = name[len("stage."):-len(".p50_ms")]
+            p50 = (tail_vals(series, name) or [0.0])[-1]
+            p99 = (tail_vals(series, f"stage.{stage}.p99_ms")
+                   or [0.0])[-1]
+            cnt = (tail_vals(series, f"stage_count.{stage}")
+                   or [0.0])[-1]
+            weights[stage] = (p50 * cnt, p50, p99, cnt)
+    denom = sum(w for s, (w, _p, _q, _c) in weights.items()
+                if s not in excluded) or 1.0
+    for stage in sorted(weights):
+        w, p50, p99, cnt = weights[stage]
+        share = 0.0 if stage in excluded else w / denom
+        stage_rows.append([stage, f"{p50:.2f}", f"{p99:.2f}",
+                           int(cnt), f"{share:.1%}"])
+    if stage_rows:
+        print()
+        _print_rows(stage_rows, ["Stage", "p50 ms", "p99 ms",
+                                 "Samples", "Recent share"])
+
+    # device economics (the validation campaign's instruments)
+    print()
+    print("Device economics:")
+    pw = tail_vals(series, "device.pad_waste_ratio")
+    if pw:
+        shipped = tail_vals(series, "device.pad_rows_shipped")
+        print(f"  pad waste ratio    = {pw[-1]:.4f} "
+              f"(rows shipped {shipped[-1] if shipped else 0:.0f})")
+    arms = sorted({n[len("device.dispatch_s."):]
+                   for n in series if n.startswith("device.dispatch_s.")})
+    for arm in arms:
+        s_ = (tail_vals(series, f"device.dispatch_s.{arm}") or [0.0])[-1]
+        d_ = (tail_vals(series, f"device.dispatches.{arm}") or [0.0])[-1]
+        c_ = (tail_vals(series, f"device.compiles.{arm}") or [0.0])[-1]
+        print(f"  {arm:18s} = {s_:.3f}s over {d_:.0f} dispatches "
+              f"({c_:.0f} fresh compiles)")
+    kc = tail_vals(series, "device.kernel_cache_entries")
+    if kc:
+        print(f"  kernel caches      = {kc[-1]:.0f} entries")
+    mb = tail_vals(series, "device.mirror_bytes")
+    if mb:
+        print(f"  device mirror      = {mb[-1] / 1024.0:.0f} KiB")
+    hbm = tail_vals(series, "device.hbm_bytes_in_use")
+    if hbm and hbm[-1] > 0:
+        print(f"  HBM in use         = {hbm[-1] / (1 << 20):.1f} MiB")
+    occ = tail_vals(series, "gateway.batch_occupancy")
+    if occ:
+        print(f"  lane occupancy     = {occ[-1]:.2f}")
+
+    # drift annotations: the governor's structured findings over the
+    # same window the trends cover
+    try:
+        gov = c.governor()
+    except ApiError:
+        gov = {}
+    drifts = [e for e in gov.get("events", [])
+              if e.get("kind") in ("drift", "backpressure", "reclaim")]
+    if drifts:
+        print()
+        print(f"Annotations ({len(drifts[-8:])}):")
+        for e in drifts[-8:]:
+            ts = time.strftime("%H:%M:%S",
+                               time.localtime(e.get("ts", 0)))
+            detail = {k: v for k, v in e.items()
+                      if k not in ("ts", "kind")}
+            print(f"  {ts}  {e.get('kind', ''):12s} "
+                  f"{json.dumps(detail, default=str)}")
     return 0
 
 
@@ -1762,6 +1925,13 @@ def build_parser() -> argparse.ArgumentParser:
     ogov = op.add_parser("governor",
                          help="steady-state governor gauges/watermarks")
     ogov.set_defaults(fn=cmd_operator_governor)
+    otop = op.add_parser("top",
+                         help="live rates/trends from the telemetry "
+                              "ring: evals/s, p99 trend, stage "
+                              "shares, device economics, flatness")
+    otop.add_argument("-n", type=int, default=120,
+                      help="history samples to read (default 120)")
+    otop.set_defaults(fn=cmd_operator_top)
     otrace = op.add_parser(
         "trace", help="eval flight recorder: span trees, tail "
                       "exemplars, stage percentiles")

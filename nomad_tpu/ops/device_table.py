@@ -300,6 +300,19 @@ class DeviceNodeTable:
     def log_len(self) -> int:
         return len(self.delta_log)
 
+    def device_bytes(self) -> int:
+        """Bytes the materialized mirror pins on device (capacity +
+        used + free_ports buffer sizes; 0 while lazy). Shape metadata
+        only — reading .nbytes never syncs the device."""
+        with self._l:
+            st = self._state
+        if st is None:
+            return 0
+        total = 0
+        for arr in (st.capacity, st.used, st.free_ports):
+            total += int(getattr(arr, "nbytes", 0))
+        return total
+
     def snapshot(self) -> dict:
         with self._l:
             return {"version": self.version, "epoch": self.epoch,

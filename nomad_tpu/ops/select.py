@@ -828,6 +828,9 @@ def pack_request(req: SelectRequest, n_pad: int):
             f"count={req.count} exceeds the scan cap of {MAX_SCAN_STEPS}; "
             f"split the placement batch")
     n = len(req.feasible)
+    # device economics (ISSUE 11): every pack ships n_pad rows for n
+    # live ones — the pad-waste ratio the validation campaign reads
+    _note_pack(n, n_pad)
 
     def pad1(a, fill=0.0, dtype=np.float32):
         out = np.full(n_pad, fill, dtype=dtype)
@@ -1325,6 +1328,10 @@ class DispatchCostModel:
             from ..trace import emit_kernel
             emit_kernel(arm, n_pad, seconds, lanes=lanes,
                         fresh=compiled)
+        # device economics (ISSUE 11): per-arm dispatch seconds and
+        # fresh-compile counts, exported via nomad.device.* gauges and
+        # the bench artifact — always on, like the recompile counter
+        _note_dispatch(arm, seconds, compiled)
         key = (arm, n_pad)
         if compiled:
             # this dispatch minted a new trace signature (_note_trace):
@@ -1437,6 +1444,85 @@ BATCHED_ARMS = ("chunked_batched", "kway_batched", "scan_batched")
 # process-wide: every SelectKernel (workers, gateways, benches) feeds
 # and reads the same measured numbers
 cost_model = DispatchCostModel()
+
+
+# -- device-economics accounting (ISSUE 11) ----------------------------
+# The north star's device economics — pad waste, per-arm dispatch time,
+# fresh compiles — were trapped inside pack_request/_note_trace/
+# DispatchCostModel and never exported. These counters are ALWAYS on
+# (the cost is two dict adds under a lock per pack/dispatch, next to
+# milliseconds of numpy work); the telemetry collector
+# (nomad_tpu/telemetry/) publishes them as `nomad.device.*` gauges and
+# the bench artifact records the per-round snapshot.
+import threading as _threading  # noqa: E402
+
+_DEVICE_L = _threading.Lock()
+DEVICE_STATS: Dict[str, float] = {
+    # Σ live rows vs Σ padded rows shipped: 1 - n/n_pad is the fraction
+    # of every dispatch's node axis spent scoring padding
+    "pad_n_sum": 0.0,
+    "pad_npad_sum": 0.0,
+    "packs": 0.0,
+}
+# per-arm accumulators: {arm: [dispatch_seconds_sum, dispatches,
+# fresh_compiles]} — compile walls are INCLUDED in seconds (they are
+# real wall clock the eval paid; the compile count alongside is what
+# attributes them)
+DEVICE_ARM_STATS: Dict[str, List[float]] = {}
+
+
+def _note_pack(n: int, n_pad: int) -> None:
+    with _DEVICE_L:
+        DEVICE_STATS["pad_n_sum"] += n
+        DEVICE_STATS["pad_npad_sum"] += n_pad
+        DEVICE_STATS["packs"] += 1
+
+
+def _note_dispatch(arm: str, seconds: float, compiled: bool) -> None:
+    with _DEVICE_L:
+        ent = DEVICE_ARM_STATS.get(arm)
+        if ent is None:
+            ent = DEVICE_ARM_STATS[arm] = [0.0, 0.0, 0.0]
+        ent[0] += seconds
+        ent[1] += 1
+        if compiled:
+            ent[2] += 1
+
+
+def device_stats_snapshot() -> Dict[str, object]:
+    """One read for the bench artifact and the telemetry collector:
+    pad-waste ratio plus per-arm dispatch seconds / dispatch counts /
+    fresh-compile counts."""
+    with _DEVICE_L:
+        n_sum = DEVICE_STATS["pad_n_sum"]
+        np_sum = DEVICE_STATS["pad_npad_sum"]
+        packs = DEVICE_STATS["packs"]
+        arms = {a: list(v) for a, v in DEVICE_ARM_STATS.items()}
+    return {
+        "pad_waste_ratio": round(1.0 - (n_sum / np_sum), 4)
+        if np_sum > 0 else 0.0,
+        "pad_rows_live": n_sum,
+        "pad_rows_shipped": np_sum,
+        "packs": packs,
+        "dispatch_s": {a: round(v[0], 4) for a, v in sorted(
+            arms.items())},
+        "dispatches": {a: int(v[1]) for a, v in sorted(arms.items())},
+        "compiles": {a: int(v[2]) for a, v in sorted(arms.items())},
+    }
+
+
+def device_hbm_bytes() -> float:
+    """Device HBM in use where the backend exposes it (jax
+    memory_stats; TPU/GPU runtimes report bytes_in_use, CPU returns
+    None/{}): 0.0 when unavailable. Host-side runtime introspection —
+    no device sync involved."""
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        return 0.0
+    if not stats:
+        return 0.0
+    return float(stats.get("bytes_in_use", 0.0))
 
 
 def calibrate_cost_model(n: int, count: int = 16, lanes: int = 2,

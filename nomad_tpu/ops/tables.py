@@ -800,6 +800,11 @@ class NodeTableCache:
     def device_delta_log_len(self) -> int:
         return self.device.log_len()
 
+    def device_mirror_bytes(self) -> int:
+        """Bytes the device-resident mirror holds (telemetry
+        `nomad.device.mirror_bytes`; 0 until materialized)."""
+        return self.device.device_bytes()
+
     def fold_device(self) -> dict:
         """Reclaim: replace the mirror's scatter history with one
         contiguous re-upload from the current host table (registered

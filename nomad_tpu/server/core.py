@@ -246,6 +246,17 @@ class ServerConfig:
     # promotion threshold as a percent of the governor-tracked
     # full-latency p99 (100 = promote anything at/above p99)
     trace_exemplar_threshold_pct: float = 100.0
+    # retained telemetry collector (nomad_tpu/telemetry/, ISSUE 11):
+    # background sampling cadence for the history ring behind
+    # /v1/operator/telemetry, /v1/operator/flatness, and `nomad
+    # operator top`. 0 disables the collector entirely (snapshot-only
+    # /v1/metrics, flatness route reports disabled);
+    # NOMAD_TPU_TELEMETRY=0 is the runtime kill switch
+    telemetry_sample_interval_s: float = 1.0
+    # history ring depth: slots per series (struct-of-arrays float64
+    # columns; with the 256-series cap the ring's hard byte ceiling is
+    # slots x 256 x 8 bytes — 1 MiB at the default 512)
+    telemetry_ring_slots: int = 512
 
 
 class Server:
@@ -319,12 +330,18 @@ class Server:
             exemplar_slots=self.config.trace_exemplar_slots,
             threshold_pct=self.config.trace_exemplar_threshold_pct)
         self._tracer_fns = None
+        # one gauge-snapshot closure serves BOTH the tracer's exemplar
+        # snapshots and the telemetry collector's per-slot sampling —
+        # the two consumers must never silently diverge on how gauge
+        # rows are read
+        gauge_snapshot_fn = None
         if self.governor is not None:
             gov = self.governor
+            gauge_snapshot_fn = lambda g=gov: {  # noqa: E731
+                r["name"]: r["value"] for r in g.registry.rows()}
             _flight.threshold_fn = \
                 lambda g=gov: g.latency_percentile_ms(99)
-            _flight.gauge_fn = lambda g=gov: {
-                r["name"]: r["value"] for r in g.registry.rows()}
+            _flight.gauge_fn = gauge_snapshot_fn
             # remembered so shutdown can detach THESE closures (and
             # only these — a newer server may have rebound them):
             # the module-global tracer outlives this server, and the
@@ -332,6 +349,30 @@ class Server:
             # graph (gauge closures reach broker/applier/store)
             self._tracer_fns = (_flight.threshold_fn, _flight.gauge_fn)
             gov.drift_hooks.append(self._auto_pin_exemplars)
+        # retained telemetry collector (ISSUE 11): history rings over
+        # governor gauges, counter rates, stage percentile reservoirs,
+        # device economics, and RSS — the instrument behind
+        # /v1/operator/telemetry, /v1/operator/flatness, and `nomad
+        # operator top`. Kill switch (env or interval=0) builds no
+        # collector: /v1/metrics degenerates to snapshot-only
+        from ..telemetry import TelemetryCollector
+        from ..telemetry import enabled as _telemetry_enabled
+        self.telemetry = None
+        if _telemetry_enabled() and \
+                self.config.telemetry_sample_interval_s > 0:
+            gov = self.governor
+            self.telemetry = TelemetryCollector(
+                interval_s=self.config.telemetry_sample_interval_s,
+                slots=self.config.telemetry_ring_slots,
+                gauges_fn=gauge_snapshot_fn,
+                latency_fn=(None if gov is None
+                            else gov.latency_percentile_ms),
+                stage_fn=_flight.stage_percentiles,
+                # device-mirror residency reads through self.store:
+                # the table cache is replaced on snapshot restore
+                extra_fn=lambda: {
+                    "device.mirror_bytes":
+                    self.store.table_cache.device_mirror_bytes()})
         self.workers: List[Worker] = []
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._hb_lock = threading.Lock()
@@ -468,6 +509,8 @@ class Server:
         self._volume_watcher.start()
         if self.governor is not None:
             self.governor.start()
+        if self.telemetry is not None:
+            self.telemetry.start()
         if self.config.dispatch_calibration:
             # seed the dispatch cost model at the restored table shape
             # BEFORE traffic: the solo and batched arms both carry
@@ -879,6 +922,8 @@ class Server:
                 self.persistence.save_cost_model()
             except Exception:   # pragma: no cover — best effort
                 LOG.exception("cost model save failed")
+        if self.telemetry is not None:
+            self.telemetry.stop()
         if self.governor is not None:
             self.governor.stop()
         # detach the flight recorder from this server's governor — but

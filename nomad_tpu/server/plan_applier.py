@@ -67,6 +67,18 @@ GROUP_STATS: Dict[str, int] = {
 }
 
 
+def _count_placements(result) -> int:
+    """Fresh placements in a verified plan result — the
+    `nomad.plan.placements` counter the telemetry ring rates. Plans
+    also carry in-place and attribute updates through node_allocation
+    (scheduler/generic.py append_alloc); those allocs are store copies
+    with a stamped create_index, while a NEW placement's is still 0
+    until the commit stamps it — counting everything would show
+    phantom placements/s during a rolling in-place update."""
+    return sum(1 for v in result.node_allocation.values()
+               for a in v if a.create_index == 0)
+
+
 def group_commit_enabled() -> bool:
     """The bisection escape hatch: NOMAD_TPU_PLAN_GROUP=0 forces the
     one-raft-entry-per-plan path regardless of plan_group_max."""
@@ -354,6 +366,9 @@ class PlanApplier:
                        group=1, demoted=bool(result.refresh_index))
         if payload is None:
             return result, None
+        from ..utils import metrics as _metrics
+        _metrics.incr_counter("nomad.plan.placements",
+                              _count_placements(result))
 
         # commit through the raft shim (FSM ApplyPlanResults)
         _c0 = _time.perf_counter() if stages.enabled else 0.0
@@ -426,6 +441,8 @@ class PlanApplier:
             entries.append((pending, result, payload, evals))
             if payload is not None:
                 accepted.append(result)
+                metrics.incr_counter("nomad.plan.placements",
+                                     _count_placements(result))
             metrics.incr_counter("nomad.plan.apply")
         metrics.measure_since("nomad.plan.evaluate", _t0)
         if stages.enabled:

@@ -875,6 +875,10 @@ class Worker:
                 # the ack closes the span tree: enqueue -> ... -> ack
                 trace.finish(tr, status="acked")
                 self.stats["processed"] += 1
+                # counter (not just the periodic total_processed
+                # gauge): the telemetry ring derives evals/s from
+                # slot-to-slot deltas of this
+                metrics.incr_counter("nomad.worker.eval_processed")
 
             if self._finish_q is not None:
                 # overlap the ack-side bookkeeping with the next

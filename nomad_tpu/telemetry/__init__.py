@@ -1,0 +1,11 @@
+"""Retained telemetry core (ISSUE 11): the sampling collector behind
+`/v1/operator/telemetry`, `/v1/operator/flatness`, and
+`nomad operator top` — history rings over governor gauges, counter
+rates, stage percentiles, device economics, and RSS. See collector.py
+for the design; `enabled()` is the NOMAD_TPU_TELEMETRY kill switch."""
+
+from .collector import (MAX_SERIES, TelemetryCollector,
+                        default_device_fn, enabled)
+
+__all__ = ["TelemetryCollector", "default_device_fn", "enabled",
+           "MAX_SERIES"]

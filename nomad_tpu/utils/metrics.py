@@ -1,14 +1,48 @@
 """In-memory telemetry registry (reference: armon/go-metrics as wired
 by command/agent/command.go setupTelemetry — counters, gauges, and
 timer samples with aggregate statistics, served by /v1/metrics in the
-InmemSink's shape).
+InmemSink's shape, plus PrometheusSink-style text exposition behind
+/v1/metrics?format=prometheus).
+
+ISSUE 11 parity fixes vs the pre-r15 registry:
+
+* `Timestamp` is interval-ANCHORED, not call time: the reference's
+  InmemSink aggregates into fixed intervals (DefaultInmemInterval) and
+  DisplayMetrics returns the interval's boundary timestamp, so two
+  scrapes inside one interval agree on the window they describe.
+* Empty-sample `Min` is explicit: `_Sample.min` is None until the
+  first ingest and the display layer states the no-samples case,
+  instead of carrying a float('inf') sentinel that snapshot() had to
+  special-case (and that would leak as literal Infinity through any
+  other reader of the raw sample).
+* Timer samples additionally feed fixed-bucket HISTOGRAMS (the
+  go-metrics PrometheusSink analog) whose consumer is the Prometheus
+  exposition: cumulative `<name>_bucket{le="..."}` rows a scraper
+  aggregates across instances. `Histogram.quantile()` documents the
+  exposition's resolution contract — the same linear interpolation
+  `histogram_quantile()` applies server-side, pinned against numpy
+  percentiles in tests/test_telemetry.py.
 """
 
 from __future__ import annotations
 
+import re
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+# the InmemSink aggregation interval (go-metrics DefaultInmemInterval
+# is 10s; command.go passes 10s): Timestamp anchors to multiples of it
+INTERVAL_S = 10.0
+
+# histogram bucket upper bounds in MILLISECONDS (timer samples are
+# ms): roughly log-spaced from sub-ms dispatches to multi-second
+# compile walls, + the implicit +Inf bucket. Chosen once, process-wide
+# — Prometheus histograms only aggregate across scrapes/instances when
+# the bounds agree.
+HIST_BUCKETS_MS: Tuple[float, ...] = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
 
 
 class _Sample:
@@ -17,16 +51,98 @@ class _Sample:
     def __init__(self):
         self.count = 0
         self.sum = 0.0
-        self.min = float("inf")
+        # None until the first ingest: "no samples yet" is a distinct
+        # state the display layer reports explicitly, not an inf
+        # sentinel for snapshot() to special-case
+        self.min: Optional[float] = None
         self.max = 0.0
         self.last = 0.0
 
     def add(self, v: float) -> None:
         self.count += 1
         self.sum += v
-        self.min = min(self.min, v)
+        self.min = v if self.min is None else min(self.min, v)
         self.max = max(self.max, v)
         self.last = v
+
+
+class Histogram:
+    """Fixed-bucket histogram over timer samples (ms). Buckets hold
+    NON-cumulative counts internally; the exposition and quantile
+    reads cumulate. Bounded by construction: len(bounds)+1 ints."""
+
+    __slots__ = ("bounds", "counts", "count", "sum")
+
+    def __init__(self, bounds: Tuple[float, ...] = HIST_BUCKETS_MS):
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)   # +1: the +Inf bucket
+        self.count = 0
+        self.sum = 0.0
+
+    def add(self, v: float) -> None:
+        # linear scan beats bisect at 16 buckets for the common small
+        # values, and this is the hot-path cost of histogram support
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[len(self.bounds)] += 1
+        self.count += 1
+        self.sum += v
+
+    def cumulative(self) -> List[int]:
+        out, acc = [], 0
+        for c in self.counts:
+            acc += c
+            out.append(acc)
+        return out
+
+    def quantile(self, q: float) -> float:
+        """Prometheus histogram_quantile math: find the bucket holding
+        rank q*count, linearly interpolate inside it (bucket start ->
+        bound). The +Inf bucket reports the largest finite bound, as
+        histogram_quantile does."""
+        if self.count == 0:
+            return 0.0
+        q = min(max(q, 0.0), 1.0)
+        rank = q * self.count
+        acc = 0
+        for i, c in enumerate(self.counts):
+            prev_acc = acc
+            acc += c
+            if acc >= rank and c > 0:
+                if i >= len(self.bounds):
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                return lo + (hi - lo) * ((rank - prev_acc) / c)
+        return self.bounds[-1]
+
+    def as_dict(self) -> dict:
+        return {"bounds": list(self.bounds),
+                "counts": list(self.counts),
+                "count": self.count, "sum": self.sum}
+
+
+def _interval_anchor(now: Optional[float] = None) -> float:
+    """The current interval's START boundary (epoch seconds): the
+    reference InmemSink keys aggregates by interval and reports the
+    boundary, so a scrape's Timestamp names the window, not the call."""
+    now = time.time() if now is None else now
+    return (now // INTERVAL_S) * INTERVAL_S
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(name: str) -> str:
+    """`nomad.worker.invoke_scheduler.service` ->
+    `nomad_worker_invoke_scheduler_service` (exposition charset)."""
+    out = _PROM_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
 
 
 class MetricsRegistry:
@@ -35,6 +151,7 @@ class MetricsRegistry:
         self._gauges: Dict[str, float] = {}
         self._counters: Dict[str, _Sample] = {}
         self._samples: Dict[str, _Sample] = {}
+        self._hists: Dict[str, Histogram] = {}
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._l:
@@ -47,29 +164,85 @@ class MetricsRegistry:
     def add_sample_ms(self, name: str, ms: float) -> None:
         with self._l:
             self._samples.setdefault(name, _Sample()).add(ms)
+            self._hists.setdefault(name, Histogram()).add(ms)
 
     def measure_since(self, name: str, start_monotonic: float) -> None:
         """go-metrics MeasureSince: record elapsed milliseconds."""
         self.add_sample_ms(name, (time.monotonic() - start_monotonic)
                            * 1000.0)
 
+    # -- raw reads (telemetry collector + tests) -----------------------
+    def counter_totals(self) -> Dict[str, float]:
+        """{name: cumulative sum} for every counter — the telemetry
+        collector samples these per slot and derives rates from slot
+        deltas."""
+        with self._l:
+            return {k: s.sum for k, s in self._counters.items()}
+
+    def histogram(self, name: str) -> Optional[Histogram]:
+        with self._l:
+            return self._hists.get(name)
+
     def snapshot(self) -> dict:
         """The /v1/metrics InmemSink display shape."""
         with self._l:
             def agg(d):
                 return [{"Name": k, "Count": s.count, "Sum": s.sum,
-                         "Min": (0.0 if s.count == 0 else s.min),
+                         # explicit empty-sample contract: a sample set
+                         # with no ingests reports Min 0.0 BECAUSE it
+                         # is empty (Count 0 says so), never an inf
+                         # sentinel escaping the aggregate
+                         "Min": (s.min if s.min is not None else 0.0),
                          "Max": s.max,
                          "Mean": (s.sum / s.count) if s.count else 0.0}
                         for k, s in sorted(d.items())]
             return {
-                "Timestamp": time.strftime("%Y-%m-%d %H:%M:%S +0000",
-                                           time.gmtime()),
+                # interval-anchored (reference InmemSink parity): two
+                # scrapes in the same interval carry the same stamp
+                "Timestamp": time.strftime(
+                    "%Y-%m-%d %H:%M:%S +0000",
+                    time.gmtime(_interval_anchor())),
                 "Gauges": [{"Name": k, "Value": v}
                            for k, v in sorted(self._gauges.items())],
                 "Counters": agg(self._counters),
                 "Samples": agg(self._samples),
             }
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition (format version 0.0.4): gauges as
+        `gauge`, counters as `<name>_total` `counter`, timer samples as
+        full `histogram` families (buckets + _sum + _count). Served at
+        /v1/metrics?format=prometheus; one scrape body, text/plain."""
+        with self._l:
+            gauges = sorted(self._gauges.items())
+            counters = sorted((k, s.sum) for k, s in
+                              self._counters.items())
+            # copy histogram state BY VALUE under the lock: reading
+            # cumulative()/sum/count off live objects after release
+            # could tear (a sample landing between the bucket read and
+            # the count read makes +Inf != _count, which breaks the
+            # Prometheus histogram invariant on that scrape)
+            hists = sorted(
+                (k, (h.bounds, h.cumulative(), h.sum, h.count))
+                for k, h in self._hists.items())
+        lines: List[str] = []
+        for name, value in gauges:
+            pn = prom_name(name)
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {value:.10g}")
+        for name, total in counters:
+            pn = prom_name(name) + "_total"
+            lines.append(f"# TYPE {pn} counter")
+            lines.append(f"{pn} {total:.10g}")
+        for name, (bounds, cum, hsum, hcount) in hists:
+            pn = prom_name(name)
+            lines.append(f"# TYPE {pn} histogram")
+            for bound, c in zip(bounds, cum):
+                lines.append(f'{pn}_bucket{{le="{bound:.10g}"}} {c}')
+            lines.append(f'{pn}_bucket{{le="+Inf"}} {cum[-1]}')
+            lines.append(f"{pn}_sum {hsum:.10g}")
+            lines.append(f"{pn}_count {hcount}")
+        return "\n".join(lines) + "\n"
 
 
 GLOBAL = MetricsRegistry()
@@ -89,3 +262,11 @@ def measure_since(name: str, start_monotonic: float) -> None:
 
 def snapshot() -> dict:
     return GLOBAL.snapshot()
+
+
+def prometheus() -> str:
+    return GLOBAL.prometheus()
+
+
+def counter_totals() -> Dict[str, float]:
+    return GLOBAL.counter_totals()

@@ -95,6 +95,19 @@ def test_bench_py_emits_json_line_on_cpu():
     # resident-table counters + measured dispatch costs ride along
     assert data["table_build_stats"]["delta_refreshes"] >= 0
     assert data["dispatch_cost_model"], "cost model never observed"
+    # device economics (ISSUE 11): pad waste and per-arm dispatch
+    # seconds / fresh-compile counts are first-class artifact keys —
+    # the validation campaign's instruments
+    assert data["telemetry"] == "on"
+    assert 0.0 <= data["pad_waste_ratio"] < 1.0
+    assert data["device_dispatch_s"], "no arm reported dispatch time"
+    assert all(v >= 0 for v in data["device_dispatch_s"].values())
+    assert any(v > 0 for v in data["device_dispatch_s"].values())
+    assert data["device_compiles"], "no arm reported compile counts"
+    assert sum(data["device_compiles"].values()) >= 1
+    assert set(data["device_compiles"]) == set(data["device_dispatch_s"])
+    assert all(data["device_dispatches"][a] >= data["device_compiles"][a]
+               for a in data["device_compiles"])
     # group-commit + engine-reuse attribution (ISSUE 4 satellite)
     assert data["plan_group_stats"]["groups"] > 0
     assert data["plan_group_mean_size"] >= 1.0

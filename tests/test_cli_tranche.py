@@ -104,6 +104,11 @@ def test_operator_debug_archive(cluster, tmp_path):
     into a tar.gz (command/operator_debug.go)."""
     import tarfile
     _s, addr = cluster
+    # the fixture's idle num_schedulers=0 server may not have emitted
+    # any metric yet (the stats ticker runs on a 1s cadence): seed one
+    # so the bundle's metrics.prom assertion below is deterministic
+    from nomad_tpu.utils import metrics as gm
+    gm.set_gauge("nomad.test.debug_probe", 1.0)
     out_path = str(tmp_path / "debug.tar.gz")
     rc, out = run(addr, "operator", "debug", "-duration", "1",
                   "-interval", "0.5", "-output", out_path)
@@ -115,13 +120,21 @@ def test_operator_debug_archive(cluster, tmp_path):
         expect = ["agent-self.json", "members.json", "raft-status.json",
                   "nomad/jobs.json", "nomad/nodes.json",
                   "pprof/threads.json", "index.json",
-                  "metrics/metrics_000.json", "metrics/metrics_001.json"]
+                  "metrics/metrics_000.json", "metrics/metrics_001.json",
+                  # retained telemetry (ISSUE 11): the history ring,
+                  # the live flatness verdict, and a Prometheus-format
+                  # snapshot ride in the bundle one-shot
+                  "telemetry.json", "flatness.json", "metrics.prom"]
         for n in expect:
             assert f"{base}/{n}" in names, (n, names)
         idx = json.load(tar.extractfile(f"{base}/index.json"))
         assert idx["captures"] >= len(expect)
         jobs = json.load(tar.extractfile(f"{base}/nomad/jobs.json"))
         assert any(j["ID"] == "smoke-job" for j in jobs)
+        tel = json.load(tar.extractfile(f"{base}/telemetry.json"))
+        assert tel.get("slots", 0) > 0 and "series" in tel
+        prom = tar.extractfile(f"{base}/metrics.prom").read().decode()
+        assert "# TYPE" in prom
 
 
 def test_job_run_check_index(cluster, tmp_path):

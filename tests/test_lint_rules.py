@@ -304,6 +304,8 @@ class ServerConfig:
     trace_orphan_bytes: int = 17
     preempt_documented_rows: int = 4096
     preempt_orphan_rows: int = 19
+    telemetry_documented_slots: int = 512
+    telemetry_orphan_slots: int = 21
     other_knob: int = 1
 """
 
@@ -331,6 +333,7 @@ class TestSurfaceDrift:
                            "wal_documented_fsync and "
                            "trace_documented_bytes and "
                            "preempt_documented_rows and "
+                           "telemetry_documented_slots and "
                            "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
@@ -355,6 +358,9 @@ class TestSurfaceDrift:
         # preempt_* knobs joined the contract (ISSUE 10: batched
         # columnar preemption knobs must land in the STATUS.md table)
         pr_f = [f for f in out if "preempt_orphan_rows" in f.message]
+        # telemetry_* knobs joined the contract (ISSUE 11: retained
+        # telemetry collector knobs must land in the STATUS.md table)
+        tm_f = [f for f in out if "telemetry_orphan_slots" in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
@@ -365,6 +371,7 @@ class TestSurfaceDrift:
         assert len(wl_f) == 1
         assert len(tr_f) == 1
         assert len(pr_f) == 1
+        assert len(tm_f) == 1
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
                        for f in out)
@@ -381,6 +388,8 @@ class TestSurfaceDrift:
         assert not any("trace_documented_bytes" in f.message
                        for f in out)
         assert not any("preempt_documented_rows" in f.message
+                       for f in out)
+        assert not any("telemetry_documented_slots" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -402,7 +411,9 @@ class TestSurfaceDrift:
                            "trace_documented_bytes, "
                            "trace_orphan_bytes, "
                            "preempt_documented_rows, "
-                           "preempt_orphan_rows")
+                           "preempt_orphan_rows, "
+                           "telemetry_documented_slots, "
+                           "telemetry_orphan_slots")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))

@@ -1,22 +1,35 @@
 """Telemetry: metrics registry, instrumentation, /v1/metrics,
 agent monitor stream, pprof analogs (reference: armon/go-metrics via
 setupTelemetry, worker.go:162-282 measure points, agent_endpoint.go
-monitor/pprof).
+monitor/pprof) — plus the ISSUE 11 retained-telemetry core: histogram
+buckets + Prometheus exposition round-trip, InmemSink parity
+(interval-anchored Timestamp, explicit empty-sample Min), the
+struct-of-arrays history ring's bounding, live flatness verdict
+parity with bench/soak.py, /v1/operator/telemetry + /v1/operator/
+flatness + ?format=prometheus surface, `operator top`, the
+NOMAD_TPU_TELEMETRY kill switch, and the paired collector-overhead
+smoke.
 """
 
+import calendar
 import json
 import logging
 import threading
 import time
 import urllib.request
 
+import numpy as np
 import pytest
 
 from nomad_tpu import mock
 from nomad_tpu.api import HTTPApiServer
 from nomad_tpu.api.client import ApiClient
 from nomad_tpu.server import Server, ServerConfig
-from nomad_tpu.utils.metrics import MetricsRegistry
+from nomad_tpu.telemetry import MAX_SERIES, TelemetryCollector
+from nomad_tpu.telemetry import collector as telemetry_collector
+from nomad_tpu.utils.metrics import (HIST_BUCKETS_MS, INTERVAL_S,
+                                     Histogram, MetricsRegistry,
+                                     prom_name)
 from nomad_tpu.utils.monitor import MonitorBuffer
 
 
@@ -45,6 +58,180 @@ def test_registry_counters_gauges_samples():
         and s["Mean"] == 20.0
 
 
+# -- ISSUE 11 satellite: InmemSink parity -------------------------------
+
+def test_timestamp_is_interval_anchored():
+    """The reference InmemSink aggregates into fixed intervals and
+    DisplayMetrics reports the interval boundary, not call time: two
+    scrapes inside one interval agree on their window."""
+    r = MetricsRegistry()
+    ts = r.snapshot()["Timestamp"]
+    epoch = calendar.timegm(
+        time.strptime(ts, "%Y-%m-%d %H:%M:%S +0000"))
+    assert epoch % int(INTERVAL_S) == 0
+    # anchored to the CURRENT interval (within one interval of now)
+    assert 0 <= time.time() - epoch < 2 * INTERVAL_S
+
+
+def test_empty_sample_min_explicit():
+    """A sample set with no ingests reports Min 0.0 because Count is
+    0 — never an inf sentinel leaking out of the raw aggregate."""
+    from nomad_tpu.utils.metrics import _Sample
+    s = _Sample()
+    assert s.min is None            # distinct no-samples state
+    r = MetricsRegistry()
+    with r._l:
+        r._samples["never"] = _Sample()
+    row = [x for x in r.snapshot()["Samples"] if x["Name"] == "never"][0]
+    assert row["Count"] == 0 and row["Min"] == 0.0 and row["Mean"] == 0.0
+    assert row["Min"] != float("inf")
+    s.add(5.0)
+    s.add(9.0)
+    assert s.min == 5.0
+
+
+# -- ISSUE 11: histogram buckets + quantile math ------------------------
+
+def test_histogram_quantiles_vs_numpy():
+    """Bucket-interpolated quantiles track numpy percentiles to within
+    the containing bucket's width (that is the histogram contract —
+    Prometheus histogram_quantile has exactly this resolution)."""
+    rng = np.random.RandomState(7)
+    vals = np.concatenate([rng.uniform(0.5, 40.0, 1500),
+                           rng.uniform(100.0, 900.0, 500)])
+    h = Histogram()
+    for v in vals:
+        h.add(float(v))
+    assert h.count == len(vals)
+    assert abs(h.sum - float(vals.sum())) < 1e-6
+    bounds = (0.0,) + HIST_BUCKETS_MS
+    for q in (10, 50, 90, 99):
+        est = h.quantile(q / 100.0)
+        ref = float(np.percentile(vals, q))
+        # tolerance: the width of the bucket holding the true quantile
+        i = next(k for k in range(1, len(bounds))
+                 if ref <= bounds[k])
+        width = bounds[i] - bounds[i - 1]
+        assert abs(est - ref) <= width, (q, est, ref, width)
+    # degenerate cases
+    assert Histogram().quantile(0.5) == 0.0
+    h2 = Histogram()
+    h2.add(50000.0)                 # beyond the last bound -> +Inf
+    assert h2.counts[-1] == 1
+    assert h2.quantile(0.99) == HIST_BUCKETS_MS[-1]
+
+
+def _parse_prometheus(text):
+    """Minimal exposition parser: {name_with_labels: value} + types."""
+    values, types = {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _h, _t, name, kind = line.split()
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        key, val = line.rsplit(" ", 1)
+        values[key] = float(val)
+    return values, types
+
+
+def test_prometheus_exposition_roundtrip():
+    """Render -> parse -> compare against the JSON snapshot: every
+    gauge/counter value survives, histogram buckets are cumulative and
+    monotone, _count/_sum agree with the sample aggregate."""
+    r = MetricsRegistry()
+    r.set_gauge("nomad.broker.total_ready", 7.0)
+    r.incr_counter("nomad.plan.apply", 3)
+    r.incr_counter("nomad.plan.apply", 2)
+    for v in (0.3, 4.0, 4.5, 80.0, 2000.0):
+        r.add_sample_ms("nomad.worker.invoke", v)
+    values, types = _parse_prometheus(r.prometheus())
+    snap = r.snapshot()
+    g = snap["Gauges"][0]
+    assert values[prom_name(g["Name"])] == g["Value"]
+    assert types[prom_name(g["Name"])] == "gauge"
+    c = snap["Counters"][0]
+    assert values[prom_name(c["Name"]) + "_total"] == c["Sum"] == 5.0
+    assert types[prom_name(c["Name"]) + "_total"] == "counter"
+    s = snap["Samples"][0]
+    pn = prom_name(s["Name"])
+    assert types[pn] == "histogram"
+    assert values[pn + "_count"] == s["Count"] == 5
+    assert values[pn + "_sum"] == pytest.approx(s["Sum"])
+    buckets = [(k, v) for k, v in values.items()
+               if k.startswith(pn + "_bucket")]
+    assert len(buckets) == len(HIST_BUCKETS_MS) + 1
+    cum = [v for _k, v in buckets]
+    assert cum == sorted(cum)           # cumulative => monotone
+    assert values[f'{pn}_bucket{{le="+Inf"}}'] == 5
+    # le="5" holds 0.3, 4.0, 4.5
+    assert values[f'{pn}_bucket{{le="5"}}'] == 3
+
+
+# -- ISSUE 11: history ring bounding ------------------------------------
+
+def test_ring_slots_and_bytes_bounded_under_churn():
+    """A gauge-name churn storm must not grow the ring: series are
+    capped at MAX_SERIES (drops counted), slots wrap (oldest
+    overwritten), and the byte ceiling is slots x series x 8."""
+    tick = {"n": 0}
+
+    def churny_gauges():
+        tick["n"] += 1
+        # 40 fresh names every sample: blows past MAX_SERIES fast
+        return {f"churn.{tick['n']}.{i}": float(i) for i in range(40)}
+
+    tc = TelemetryCollector(interval_s=1.0, slots=32,
+                            gauges_fn=churny_gauges, device_fn=None)
+    for _ in range(20):
+        tc.sample_once()
+    st = tc.status()
+    assert st["samples"] == 20
+    assert st["series_count"] <= MAX_SERIES
+    assert st["series_dropped"] > 0
+    assert st["ring_bytes"] <= (MAX_SERIES + 1) * 32 * 8
+    hist = tc.history()
+    assert len(hist["t"]) == 20         # under slot capacity: no wrap
+    for _ in range(20):
+        tc.sample_once()
+    hist = tc.history()
+    assert len(hist["t"]) == 32         # wrapped: ring depth, not 40
+    assert hist["samples"] == 40
+    # chronological after wrap
+    ts = hist["t"]
+    assert ts == sorted(ts)
+    # a series that stopped reporting reads None (NaN-cleared), not a
+    # stale wrapped-over value
+    first_series = "churn.1.0"
+    vals = hist["series"].get(first_series)
+    if vals is not None:
+        assert all(v is None for v in vals)
+
+
+def test_ring_history_limit_and_rates():
+    """`last` limits history; cumulative counter series expose derived
+    per-second rates (delta over dt), NaN where undefined."""
+    from nomad_tpu.utils import metrics as gm
+    name = f"test.ring.rate.{time.monotonic_ns()}"
+    tc = TelemetryCollector(interval_s=1.0, slots=64, device_fn=None)
+    for i in range(6):
+        gm.incr_counter(name, 10)
+        tc.sample_once(now=1000.0 + i)      # dt == 1s exactly
+    hist = tc.history(last=4)
+    assert len(hist["t"]) == 4
+    key = f"counter.{name}"
+    assert key in hist["series"]
+    rates = hist["rates"][key]
+    assert rates[-1] == pytest.approx(10.0)
+    full = tc.history()
+    assert full["rates"][key][0] is None    # no left neighbor
+    assert all(r == pytest.approx(10.0)
+               for r in full["rates"][key][1:])
+
+
 def test_monitor_buffer_levels_and_blocking():
     buf = MonitorBuffer()
     log = logging.getLogger("nomad_tpu.test-monitor")
@@ -68,6 +255,291 @@ def test_monitor_buffer_levels_and_blocking():
     log.warning("wake-up")
     t.join(timeout=5)
     assert any("wake-up" in ln for ln in got)
+
+
+# -- ISSUE 11: live flatness verdict parity -----------------------------
+
+def _scripted_collector(monkeypatch, p99s, rsss):
+    """A collector whose windows are fully scripted: latency_fn and
+    rss_mb return the given series step by step, one sample per
+    window, 1 minute apart."""
+    idx = {"i": -1}
+
+    def lat(pct):
+        return p99s[idx["i"]] if pct == 99 else p99s[idx["i"]] / 2
+
+    monkeypatch.setattr(telemetry_collector, "rss_mb",
+                        lambda: rsss[idx["i"]])
+    tc = TelemetryCollector(interval_s=60.0, slots=64,
+                            latency_fn=lat, device_fn=None)
+    for i in range(len(p99s)):
+        idx["i"] = i
+        tc.sample_once(now=1_000_000.0 + i * 60.0)
+    return tc
+
+
+def test_flatness_verdict_parity_with_soak(monkeypatch):
+    """/v1/operator/flatness reuses bench/soak.flatness_verdict: over
+    identical synthetic windows the live verdict and the soak
+    harness's verdict are the SAME dict (same drift ratios, slopes,
+    pass bit, reasons) — for a flat window set and a drifting one."""
+    from nomad_tpu.bench.soak import flatness_verdict
+
+    flat_p99 = [50.0, 52.0, 49.0, 51.0, 50.0, 52.0, 50.0, 51.0]
+    flat_rss = [500.0, 501.0, 500.5, 501.0, 500.8, 501.2, 500.9, 501.0]
+    drift_p99 = [50.0, 52.0, 60.0, 75.0, 90.0, 120.0, 150.0, 180.0]
+    drift_rss = [500.0, 520.0, 545.0, 570.0, 600.0, 625.0, 650.0, 680.0]
+
+    for p99s, rsss, want_pass in ((flat_p99, flat_rss, True),
+                                  (drift_p99, drift_rss, False)):
+        tc = _scripted_collector(monkeypatch, p99s, rsss)
+        windows = tc.windows()
+        # the collector's windows carry exactly the scripted series
+        assert [w["p99_ms"] for w in windows] == p99s
+        assert [w["rss_mb"] for w in windows] == rsss
+        live = tc.flatness()
+        ref = flatness_verdict(windows)
+        for k, v in ref.items():
+            assert live[k] == v, (k, live[k], v)
+        assert live["pass"] is want_pass
+        assert live["windows_measured"] == len(p99s)
+
+
+def test_flatness_route_matches_soak_verdict(monkeypatch):
+    """The HTTP route serves the same verdict the soak harness would
+    compute over the server collector's windows (background sampling
+    disabled: interval pinned high, samples driven by hand)."""
+    from nomad_tpu.bench.soak import flatness_verdict
+    server = Server(ServerConfig(num_schedulers=0,
+                                 telemetry_sample_interval_s=3600.0))
+    api = HTTPApiServer(server, port=0)
+    api.start()
+    try:
+        tc = server.telemetry
+        assert tc is not None
+        monkeypatch.setattr(telemetry_collector, "rss_mb", lambda: 512.0)
+        for i in range(6):
+            tc.sample_once(now=2_000_000.0 + i * 60.0)
+        ref = flatness_verdict(tc.windows())
+        c = ApiClient(f"http://127.0.0.1:{api.port}")
+        live = c.flatness()
+        assert live["enabled"] is True
+        for k, v in ref.items():
+            assert live[k] == v, (k, live[k], v)
+    finally:
+        api.shutdown()
+        server.shutdown()
+
+
+def test_flatness_insufficient_history_and_warmup_scaling(monkeypatch):
+    """The live verdict rescales the soak's 60s-window calibration to
+    the ring cadence: warmup exclusion covers ~60s of wall clock, and
+    until 120s of post-warmup history exists the verdict is pass=None
+    ('insufficient history') — a slope fit over seconds is noise, not
+    a steady-state failure."""
+    monkeypatch.setattr(telemetry_collector, "rss_mb", lambda: 100.0)
+    tc = TelemetryCollector(interval_s=1.0, slots=256,
+                            latency_fn=lambda p: 10.0, device_fn=None)
+    for i in range(10):
+        tc.sample_once(now=5_000_000.0 + i)
+    out = tc.flatness()
+    assert out["pass"] is None
+    assert "insufficient history" in out["reason"]
+    for i in range(10, 200):
+        tc.sample_once(now=5_000_000.0 + i)
+    out = tc.flatness()
+    # 1s cadence -> 60 warmup slots excluded (the soak's one 60s
+    # window), and 139s of flat post-warmup history => a real verdict
+    assert out["warmup_windows_excluded"] == 60
+    assert out["span_s"] >= 120.0
+    assert out["pass"] is True
+
+
+# -- ISSUE 11: kill switch ---------------------------------------------
+
+def test_telemetry_kill_switch(monkeypatch):
+    """NOMAD_TPU_TELEMETRY=0 degenerates to today's snapshot-only
+    behavior: no collector object on the server, telemetry/flatness
+    routes report disabled, /v1/metrics still serves both formats."""
+    monkeypatch.setenv("NOMAD_TPU_TELEMETRY", "0")
+    server = Server(ServerConfig(num_schedulers=0))
+    api = HTTPApiServer(server, port=0)
+    api.start()
+    try:
+        assert server.telemetry is None
+        c = ApiClient(f"http://127.0.0.1:{api.port}")
+        assert c.telemetry() == {"enabled": False}
+        flat = c.flatness()
+        assert flat["enabled"] is False and flat["pass"] is None
+        snap = c.metrics()
+        assert "Gauges" in snap
+        assert "# TYPE" in c.metrics(format="prometheus")
+    finally:
+        api.shutdown()
+        server.shutdown()
+    # interval=0 is the config-level equivalent
+    monkeypatch.delenv("NOMAD_TPU_TELEMETRY")
+    server2 = Server(ServerConfig(num_schedulers=0,
+                                  telemetry_sample_interval_s=0.0))
+    try:
+        assert server2.telemetry is None
+    finally:
+        server2.shutdown()
+
+
+# -- ISSUE 11: HTTP surface + operator top ------------------------------
+
+def test_telemetry_history_route_and_operator_top(monkeypatch):
+    """/v1/operator/telemetry serves the chronological ring (series +
+    derived rates, JSON-safe), and `nomad operator top` renders rates,
+    trends, device economics, and the flatness verdict from it."""
+    import contextlib
+    import io
+    from nomad_tpu.cli.main import main as cli_main
+    from nomad_tpu.utils import metrics as gm
+    server = Server(ServerConfig(num_schedulers=0,
+                                 telemetry_sample_interval_s=3600.0))
+    api = HTTPApiServer(server, port=0)
+    api.start()
+    try:
+        tc = server.telemetry
+        for i in range(5):
+            gm.incr_counter("nomad.worker.eval_processed", 5)
+            gm.incr_counter("nomad.plan.placements", 50)
+            tc.sample_once(now=3_000_000.0 + i)
+        c = ApiClient(f"http://127.0.0.1:{api.port}")
+        tel = c.telemetry(last=4)
+        assert len(tel["t"]) == 4
+        assert tel["samples"] == 5
+        assert "process.rss_mb" in tel["series"]
+        # governor gauges ride along under their registry names
+        assert "broker.ready" in tel["series"]
+        # the device.* family is sampled
+        assert "device.kernel_cache_entries" in tel["series"]
+        assert "device.mirror_bytes" in tel["series"]
+        assert "device.pad_waste_ratio" in tel["series"]
+        # counter series expose derived rates
+        key = "counter.nomad.worker.eval_processed"
+        assert key in tel["rates"]
+        assert tel["rates"][key][-1] == pytest.approx(5.0)
+        assert tel["rates"]["counter.nomad.plan.placements"][-1] == \
+            pytest.approx(50.0)
+        # JSON round-trip already proved NaN-cleanliness (urllib +
+        # json.loads with default parse_constant accepts NaN, but the
+        # cleaner turns gaps into None); spot-check types
+        for vals in tel["series"].values():
+            assert all(v is None or isinstance(v, (int, float))
+                       for v in vals)
+
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli_main(["-address", f"http://127.0.0.1:{api.port}",
+                           "operator", "top", "-n", "16"])
+        assert rc == 0
+        text = out.getvalue()
+        assert "Evals/s" in text
+        assert "Placements/s" in text
+        assert "Device economics" in text
+        assert "Flatness" in text
+    finally:
+        api.shutdown()
+        server.shutdown()
+
+
+def test_prometheus_route_reflects_registry():
+    """?format=prometheus on a live agent: text/plain exposition whose
+    gauge values match the JSON snapshot scraped back-to-back."""
+    from nomad_tpu.utils import metrics as gm
+    server = Server(ServerConfig(num_schedulers=0))
+    api = HTTPApiServer(server, port=0)
+    api.start()
+    gm.set_gauge("nomad.test.prom_probe", 41.5)
+    try:
+        url = f"http://127.0.0.1:{api.port}/v1/metrics?format=prometheus"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        values, types = _parse_prometheus(text)
+        assert values["nomad_test_prom_probe"] == 41.5
+        c = ApiClient(f"http://127.0.0.1:{api.port}")
+        snap = c.metrics()
+        # the probe gauge agrees across the two formats
+        probe = [g for g in snap["Gauges"]
+                 if g["Name"] == "nomad.test.prom_probe"]
+        assert probe and probe[0]["Value"] == 41.5
+        # every histogram family is structurally complete
+        for name, kind in types.items():
+            if kind == "histogram":
+                assert name + "_count" in values
+                assert name + "_sum" in values
+                assert f'{name}_bucket{{le="+Inf"}}' in values
+    finally:
+        api.shutdown()
+        server.shutdown()
+
+
+# -- ISSUE 11 acceptance: paired collector-overhead smoke ---------------
+
+def test_collector_overhead_within_5pct(monkeypatch):
+    """Collector-on e2e eval latency within 5% of collector-off at
+    bench quick scale (r13 paired methodology): modes alternate
+    eval-by-eval so workload non-stationarity hits both classes
+    identically; 'on' evals ALSO pay a full sample_once() every 4th
+    eval — at ~ms evals that is ~100x the production 1s cadence, so
+    the 5% bound here is a fortiori for the background thread.
+    Medians are outlier-robust; bounded retries absorb CI noise."""
+    from nomad_tpu.bench.ladder import _eval_for, _seed_nodes
+    from nomad_tpu.scheduler.harness import Harness
+    from nomad_tpu.utils import gcsafe
+
+    h = Harness()
+    _seed_nodes(h, 200, dcs=1)
+
+    tc = TelemetryCollector(interval_s=1.0, slots=128)
+
+    def mk_job(tag, i):
+        job = mock.job()
+        job.id = f"tovh-{tag}-{i}"
+        job.datacenters = ["dc1"]
+        tg = job.task_groups[0]
+        tg.count = 10
+        for t in tg.tasks:
+            t.resources.networks = []
+        tg.networks = []
+        return job
+
+    def run_paired(tag, n_pairs=24):
+        times = {True: [], False: []}
+        with gcsafe.safepoints():
+            for i in range(2 * n_pairs):
+                on = (i % 2 == 0)
+                job = mk_job(tag, i)
+                h.store.upsert_job(h.next_index(), job)
+                ev = _eval_for(job)
+                t0 = time.perf_counter()
+                h.process("service", ev)
+                if on and i % 8 == 0:
+                    tc.sample_once()
+                times[on].append(time.perf_counter() - t0)
+                gcsafe.safepoint()
+
+        def median(v):
+            v = sorted(v)
+            return v[len(v) // 2]
+
+        return median(times[True]), median(times[False])
+
+    run_paired("warm", n_pairs=2)           # compile + caches
+    on, off = run_paired("m0")
+    for attempt in range(2):
+        if on <= off / 0.95:
+            break
+        on2, off2 = run_paired(f"m{attempt + 1}")   # noise retry
+        on, off = min(on, on2), min(off, off2)
+    assert on <= off / 0.95, (
+        f"collector-on median {on * 1e3:.2f} ms/eval vs off "
+        f"{off * 1e3:.2f} ms/eval")
+    assert tc.status()["samples"] > 0
 
 
 @pytest.fixture
