@@ -156,6 +156,18 @@ def main() -> None:
         traceback.print_exc(file=sys.stderr)
         out["ladder_error"] = f"{type(e).__name__}: {e}"
 
+    # mesh-residency ladder (ISSUE 12): the same warm eval stream over
+    # the forced 8-device CPU mesh vs single-device, with the sharded
+    # resident table's H2D economics (zero full re-uploads steady
+    # state) recorded. Subprocess: the mesh needs 8 virtual devices
+    # configured before jax init, and this process already picked one.
+    try:
+        from nomad_tpu.bench.multichip import run_multichip_bench
+        out.update(run_multichip_bench(quick=quick))
+    except Exception as e:
+        traceback.print_exc(file=sys.stderr)
+        out["multichip_error"] = f"{type(e).__name__}: {e}"
+
     # ladder #5 — C2M at its real scale (BASELINE config #5): 50k nodes
     # pre-loaded with 2M running allocs (40k through the real scheduler
     # path, the rest via the replay loader), then batch + service evals

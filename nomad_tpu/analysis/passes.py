@@ -537,7 +537,7 @@ class SurfaceDriftRule(Rule):
     # table (operators find them there; the table is the contract)
     KNOB_PREFIXES = ("governor_", "plan_group_", "reconcile_",
                      "gateway_", "snapshot_", "wal_", "trace_",
-                     "preempt_", "telemetry_")
+                     "preempt_", "telemetry_", "mesh_")
 
     def __init__(self,
                  http_path: str = "nomad_tpu/api/http.py",

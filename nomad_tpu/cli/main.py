@@ -82,9 +82,14 @@ def cmd_agent(args) -> int:
         # accelerator plugin at interpreter startup, so the in-process
         # config update in force_cpu_platform is required
         # (utils/platform.py).
-        from ..utils.platform import force_cpu_platform, probe_accelerator
+        from ..utils.platform import (force_cpu_platform,
+                                      probe_accelerator,
+                                      requested_cpu_devices)
         if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-            force_cpu_platform(1)
+            # keep an operator-configured virtual device count (the
+            # mesh-routed CPU agent sets 8 via XLA_FLAGS) instead of
+            # clobbering it to 1
+            force_cpu_platform(requested_cpu_devices())
         elif probe_accelerator(timeout_s=60.0) is None:
             force_cpu_platform(1)
             print("    WARNING: TPU backend unavailable; scheduling on CPU")
@@ -1377,6 +1382,26 @@ def cmd_operator_top(args) -> int:
     mb = tail_vals(series, "device.mirror_bytes")
     if mb:
         print(f"  device mirror      = {mb[-1] / 1024.0:.0f} KiB")
+    # mesh block: sharded residency economics (present only when a
+    # mesh dispatcher exists — the device.mesh_* family)
+    md = tail_vals(series, "device.mesh_devices")
+    if md and md[-1] > 0:
+        rb = (tail_vals(series,
+                        "device.mesh_resident_bytes_per_device")
+              or [0.0])[-1]
+        ru = (tail_vals(series, "device.mesh_reshard_uploads")
+              or [0.0])[-1]
+        ds = (tail_vals(series, "device.mesh_delta_scatters")
+              or [0.0])[-1]
+        rh = (tail_vals(series, "device.mesh_resident_hits")
+              or [0.0])[-1]
+        sm = (tail_vals(series, "device.mesh_stale_misses")
+              or [0.0])[-1]
+        print(f"  mesh               = {md[-1]:.0f} devices, "
+              f"resident {rb / 1024.0:.0f} KiB/device")
+        print(f"  mesh traffic       = {ru:.0f} reshard uploads, "
+              f"{ds:.0f} delta scatters, {rh:.0f} resident hits "
+              f"({sm:.0f} stale misses)")
     hbm = tail_vals(series, "device.hbm_bytes_in_use")
     if hbm and hbm[-1] > 0:
         print(f"  HBM in use         = {hbm[-1] / (1 << 20):.1f} MiB")

@@ -58,6 +58,22 @@ TABLE_DELTA_ENV = "NOMAD_TPU_TABLE_DELTA"
 # contiguous transfer
 SPARSE_MAX_FRAC = 0.5
 DELTA_LOG_MAX = 256
+# widest delta worth journaling row indices for: a companion mirror
+# re-uploads contiguously past this anyway (SPARSE_MAX_FRAC), so wider
+# entries journal a None sentinel instead of pinning huge index arrays
+JOURNAL_ROWS_MAX = 16384
+
+# row-index journaling engages only once a companion mirror exists
+# (the mesh-sharded resident table registers itself on construction);
+# a single-chip deployment never pays the index-array memory — its
+# journal entries carry None sentinels, which any late-arriving
+# companion reads as a gap (one re-upload, then arrays flow)
+_ROW_JOURNAL = False
+
+
+def enable_row_journal() -> None:
+    global _ROW_JOURNAL
+    _ROW_JOURNAL = True
 
 
 def delta_enabled() -> bool:
@@ -116,7 +132,15 @@ class DeviceNodeTable:
         self.version = 0            # latest host table version (token)
         self.epoch = 0              # node-set generation
         self.delta_debt = 0         # rows scattered since last upload
-        self.delta_log: List[Tuple[int, int]] = []  # (version, rows)
+        # replay journal: (version, touched-row indices) per delta,
+        # recorded whether or not THIS mirror is materialized — a
+        # companion mirror on another device topology (the mesh-sharded
+        # resident table, parallel/sharded_table.py) catches its copy
+        # up by scatter-setting the union of journaled rows from the
+        # latest host table (`.set` with host values makes replay
+        # order-free and idempotent). Bounded ring: a companion that
+        # fell further behind than DELTA_LOG_MAX entries re-uploads.
+        self.delta_log: List[Tuple[int, np.ndarray]] = []
         self.stats: Dict[str, int] = {
             "uploads": 0, "scatters": 0, "folds": 0,
             "overlay_dispatches": 0, "stale_misses": 0,
@@ -143,6 +167,18 @@ class DeviceNodeTable:
         advances. Returns the new version token."""
         with self._l:
             self.version += 1
+            # journal the touched rows even while lazy: companion
+            # mirrors (the mesh-sharded resident table) replay them.
+            # Wide deltas journal a sentinel — replaying them would
+            # cost more than the contiguous re-upload they force — and
+            # without a registered companion no index arrays are built
+            self.delta_log.append(
+                (self.version,
+                 np.fromiter(rows, np.int32, len(rows))
+                 if _ROW_JOURNAL and len(rows) <= JOURNAL_ROWS_MAX
+                 else None))
+            if len(self.delta_log) > DELTA_LOG_MAX:
+                del self.delta_log[:len(self.delta_log) - DELTA_LOG_MAX]
             st = self._state
             if st is None:
                 return self.version
@@ -160,6 +196,23 @@ class DeviceNodeTable:
                                       st.free_ports)
             self._state = st
             return self.version
+
+    def deltas_since(self, version: int) -> Optional[List[Tuple[int,
+                                                                np.ndarray]]]:
+        """The journal entries bridging (version, self.version], or None
+        when the journal can't (caller re-uploads): the gap predates the
+        retained ring, a rebuild cleared the log, or a bridging entry
+        was too wide to journal (sentinel)."""
+        with self._l:
+            if version > self.version:
+                return None
+            if version == self.version:
+                return []
+            need = self.version - version
+            ent = [e for e in self.delta_log if e[0] > version]
+            if len(ent) != need or any(r is None for _v, r in ent):
+                return None
+            return ent
 
     def _scatter(self, st: DeviceTableState, table,
                  rows) -> DeviceTableState:
@@ -197,9 +250,6 @@ class DeviceNodeTable:
             # interesting signal is rows shipped vs a dense column
             stages.add("h2d", _time.perf_counter() - t0)
         self.delta_debt += m
-        self.delta_log.append((self.version, m))
-        if len(self.delta_log) > DELTA_LOG_MAX:
-            del self.delta_log[:len(self.delta_log) - DELTA_LOG_MAX]
         self.stats["scatters"] += 1
         del jax  # imported for the side effect of a clear failure mode
         return DeviceTableState(st.version, st.epoch, st.n, st.n_pad,
@@ -225,8 +275,10 @@ class DeviceNodeTable:
                               jax.device_put(ports))
         if stages.enabled:
             stages.add("h2d", _time.perf_counter() - t0)
+        # the journal (delta_log) survives uploads on purpose: it is
+        # the companion mirrors' replay record, not this mirror's
+        # scatter history — only a node-set rebuild invalidates it
         self.delta_debt = 0
-        self.delta_log.clear()
         self.stats["folds" if fold else "uploads"] += 1
         return st
 
@@ -241,7 +293,6 @@ class DeviceNodeTable:
             debt = self.delta_debt
             if self._state is None:
                 self.delta_debt = 0
-                self.delta_log.clear()
                 return {"folded": False, "reason": "not materialized"}
             # nomad-lint: allow[lock-discipline] upload must be atomic with the version token; jax dispatch is async (never blocks under _l)
             self._state = self._upload(table, epoch=self.epoch,
@@ -319,6 +370,37 @@ class DeviceNodeTable:
                     "materialized": self._state is not None,
                     "delta_debt": self.delta_debt,
                     "delta_log": len(self.delta_log), **self.stats}
+
+
+def resident_request_args(mirror, req, n_pad: int,
+                          metric_prefix: str) -> Optional[dict]:
+    """Resident replacements for a request's table-shaped kernel inputs
+    (capacity, used0, free_ports), shared by the single-device mirror
+    (SelectKernel._resident_args) and the mesh-sharded one
+    (ShardedSelect.resident_args) — ONE place owns the MVCC gate, the
+    overlay fallback, and the free_ports identity rule. `mirror` is
+    anything exposing arrays_for/overlay_used. Returns None for stale
+    tables, shape mismatches, or overlays too wide to scatter, counting
+    `<metric_prefix>_fallback` / `<metric_prefix>_dispatch`."""
+    t = req.table
+    if t is None or req.used_base_rows is None:
+        return None
+    from ..utils import metrics
+    state = mirror.arrays_for(t)
+    if state is None or state.n_pad != n_pad:
+        metrics.incr_counter(metric_prefix + "_fallback")
+        return None
+    used0 = mirror.overlay_used(state, req.used_base_rows,
+                                req.used_base_deltas)
+    if used0 is None:
+        metrics.incr_counter(metric_prefix + "_fallback")
+        return None
+    out = {"capacity": state.capacity, "used0": used0}
+    if req.free_ports is not None and \
+            req.free_ports is getattr(t, "free_ports", None):
+        out["free_ports"] = state.free_ports
+    metrics.incr_counter(metric_prefix + "_dispatch")
+    return out
 
 
 # jitted scatter kernels: compiled per (n_pad, row-bucket) shape — both

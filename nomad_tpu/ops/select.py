@@ -814,8 +814,59 @@ SCAN_BATCH_MAX = 256
 # bounded no matter how occupancy fluctuates per window
 GATEWAY_MAX_LANES = 16
 
-# process-wide sharded dispatcher (see SelectKernel._mesh_sharded)
+# process-wide sharded dispatcher (see get_shared_sharded)
+import threading as _sel_threading  # noqa: E402
+
 _SHARED_SHARDED = None
+_SHARED_SHARDED_LOCK = _sel_threading.Lock()
+
+
+def get_shared_sharded():
+    """The ONE process-wide ShardedSelect, created on first demand when
+    mesh routing is configured (NOMAD_TPU_MESH=1 forces it; auto
+    engages on multi-device accelerator backends), else None.
+    Process-wide because PlacementEngines (and their kernels) are
+    rebuilt per eval — the mesh and the mesh-resident node table
+    (parallel/sharded_table.py) must outlive them or the 'resident
+    across evals' property is fiction. The env gate is re-read per
+    call, so tests flipping NOMAD_TPU_MESH get the answer they asked
+    for while the dispatcher (and its resident state) persists."""
+    import os
+    want = os.environ.get("NOMAD_TPU_MESH", "auto")
+    if want in ("0", "off", "no"):
+        return None
+    try:
+        n_dev = len(jax.devices())
+    except Exception:
+        return None
+    force = want in ("1", "on", "force")
+    auto = (want == "auto" and n_dev > 1
+            and jax.default_backend() != "cpu")
+    if n_dev > 1 and (force or auto):
+        global _SHARED_SHARDED
+        # check-then-set under a lock: the cold-start prefetch thread
+        # (NodeTableCache.prefetch_device) races the first worker eval
+        # here, and a losing duplicate would pin a second resident
+        # column set across the mesh while splitting the stats
+        with _SHARED_SHARDED_LOCK:
+            if _SHARED_SHARDED is None:
+                from ..parallel.sharded import ShardedSelect, make_mesh
+                _SHARED_SHARDED = ShardedSelect(make_mesh())
+        return _SHARED_SHARDED
+    return None
+
+
+def mesh_stats_snapshot() -> Dict[str, object]:
+    """Mesh residency economics for the governor's mesh.* gauges, the
+    telemetry device.* family, and the bench artifact: device count,
+    resident bytes (total and per device), reshard uploads/bytes,
+    delta scatters, resident hits/stale misses, and the capacity-cache
+    fallback accounting. Empty dict until a mesh dispatcher exists —
+    readers treat absence as 'mesh off'."""
+    sh = _SHARED_SHARDED
+    if sh is None:
+        return {}
+    return sh.stats_snapshot()
 
 
 def pack_request(req: SelectRequest, n_pad: int):
@@ -1704,31 +1755,12 @@ class SelectKernel:
         axis instead of sampling it): when more than one device is
         visible on an accelerator backend — or NOMAD_TPU_MESH=1 forces
         it (tests/dryrun on the virtual CPU mesh) — dispatches route
-        through a jax.sharding.Mesh over all devices."""
+        through a jax.sharding.Mesh over all devices (the process-wide
+        instance; see get_shared_sharded)."""
         if self._mesh_tried:
             return self._sharded
         self._mesh_tried = True
-        import os
-        want = os.environ.get("NOMAD_TPU_MESH", "auto")
-        if want in ("0", "off", "no"):
-            return None
-        try:
-            n_dev = len(jax.devices())
-        except Exception:
-            return None
-        force = want in ("1", "on", "force")
-        auto = (want == "auto" and n_dev > 1
-                and jax.default_backend() != "cpu")
-        if n_dev > 1 and (force or auto):
-            # ONE process-wide ShardedSelect: PlacementEngines (and
-            # their kernels) are rebuilt per eval, so the mesh and the
-            # resident device-side capacity cache must outlive them or
-            # the 'resident across evals' property is fiction
-            global _SHARED_SHARDED
-            if _SHARED_SHARDED is None:
-                from ..parallel.sharded import ShardedSelect, make_mesh
-                _SHARED_SHARDED = ShardedSelect(make_mesh())
-            self._sharded = _SHARED_SHARDED
+        self._sharded = get_shared_sharded()
         return self._sharded
 
     # -- routing -------------------------------------------------------
@@ -1775,31 +1807,17 @@ class SelectKernel:
         and used0 is computed ON DEVICE as resident-used + the sparse
         per-eval plan overlay — no dense table column crosses the bus.
         Returns None (dense fallback) for stale tables, host-forced
-        dispatches, or overlays too wide to scatter."""
+        dispatches, or overlays too wide to scatter. Assembly shared
+        with the mesh path (device_table.resident_request_args)."""
         if dev is not None:
             return None                 # mirror lives on the default device
-        t = req.table
-        if t is None or req.used_base_rows is None:
-            return None
-        mirror = getattr(t, "device_mirror", None)
+        mirror = getattr(req.table, "device_mirror", None) \
+            if req.table is not None else None
         if mirror is None:
             return None
-        from ..utils import metrics
-        state = mirror.arrays_for(t)
-        if state is None or state.n_pad != n_pad:
-            metrics.incr_counter("nomad.select.resident_fallback")
-            return None
-        used0 = mirror.overlay_used(state, req.used_base_rows,
-                                    req.used_base_deltas)
-        if used0 is None:
-            metrics.incr_counter("nomad.select.resident_fallback")
-            return None
-        out = {"capacity": state.capacity, "used0": used0}
-        if req.free_ports is not None and \
-                req.free_ports is getattr(t, "free_ports", None):
-            out["free_ports"] = state.free_ports
-        metrics.incr_counter("nomad.select.resident_dispatch")
-        return out
+        from .device_table import resident_request_args
+        return resident_request_args(mirror, req, n_pad,
+                                     "nomad.select.resident")
 
     # -- entry ---------------------------------------------------------
     def select(self, req: SelectRequest) -> SelectResult:
@@ -1844,11 +1862,13 @@ class SelectKernel:
             if chunk_ok and req.count > 512 and n_pad_sh > KWAY_W:
                 # big batches keep the K-way kernel on the mesh: the
                 # same SPMD program, node axis sharded, top-k/gather
-                # collectives inserted by XLA
+                # collectives inserted by XLA; table-shaped columns
+                # come off the mesh-resident table when the request
+                # carries a live mirror token
                 args, _statics = pack_request(req, n_pad_sh)
                 cargs = sharded.place_chunked_args(
                     {k: args[k] for k in _CHUNKED_ARGS},
-                    capacity_src=req.capacity)
+                    capacity_src=req.capacity, req=req)
                 spread_alg = req.algorithm == "spread"
                 w = _kway_w(n_pad_sh)
                 with sharded.mesh:
@@ -1993,7 +2013,8 @@ class SelectKernel:
         spread_alg = reqs[0].algorithm == "spread"
         cargs, mesh_ctx = self._place_batched(
             cargs, sharded, reqs[0].capacity, n_pad,
-            sum(min(r.count, 2 * n) for r in reqs))
+            sum(min(r.count, 2 * n) for r in reqs),
+            table=reqs[0].table)
         w = _kway_w(n_pad)
         fresh = _note_trace("kway_batched", n_pad,
                             max_steps=_kway_steps(w),
@@ -2078,15 +2099,15 @@ class SelectKernel:
         return cargs
 
     def _place_batched(self, cargs: Dict, sharded, capacity_src,
-                       n_pad: int, est_steps: int):
+                       n_pad: int, est_steps: int, table=None):
         """Device placement for a stacked batch: mesh shardings when
         sharded (node axis split, lane axis replicated, capacity on the
-        resident cache), else the host/accel cost-model pick. Returns
-        (placed_cargs, mesh_context)."""
+        mesh-resident table / identity cache), else the host/accel
+        cost-model pick. Returns (placed_cargs, mesh_context)."""
         import contextlib
         if sharded is not None:
             placed = sharded.place_batched_chunked_args(
-                cargs, capacity_src=capacity_src)
+                cargs, capacity_src=capacity_src, table=table)
             return placed, sharded.mesh
         dev = self._pick_device(n_pad, est_steps)
         return self._place_args(cargs, dev), contextlib.nullcontext()
@@ -2153,7 +2174,8 @@ class SelectKernel:
         cargs = self._pad_and_stack(packs, _CHUNKED_ARGS)
         fn = _chunked_batched_jit(max_steps, spread_alg)
         cargs, mesh_ctx = self._place_batched(
-            cargs, sharded, reqs[0].capacity, n_pad, min(maxc, 2 * n_pad))
+            cargs, sharded, reqs[0].capacity, n_pad, min(maxc, 2 * n_pad),
+            table=reqs[0].table)
         fresh = _note_trace("chunked_batched", n_pad,
                             max_steps=max_steps, spread_alg=spread_alg,
                             lanes=len(cargs["k_valid"]))
@@ -2235,7 +2257,8 @@ class SelectKernel:
         cargs = self._pad_and_stack(packs, _SCAN_ARGS)
         fn = _scan_batched_jit(k, spread_alg, s_live, p_live)
         cargs, mesh_ctx = self._place_batched(
-            cargs, sharded, reqs[0].capacity, n_pad, k)
+            cargs, sharded, reqs[0].capacity, n_pad, k,
+            table=reqs[0].table)
         fresh = _note_trace("scan_batched", n_pad, k_steps=k,
                             s_live=s_live, p_live=p_live,
                             lanes=len(cargs["k_valid"]))

@@ -728,7 +728,11 @@ class NodeTableCache:
     def prefetch_device(self) -> None:
         """Materialize the device mirror for the current table (full
         H2D upload). Run on a background thread at cold start so the
-        upload overlaps WAL replay; a no-op when nothing is primed."""
+        upload overlaps WAL replay; a no-op when nothing is primed.
+        When mesh routing is configured, the mesh-resident table is
+        uploaded too — one SHARDED H2D per column (the shard-aware
+        build_from_columns landing), so the first eval after recovery
+        rides sharded residency instead of paying per-eval re-puts."""
         with self._lock:
             t = self._table
         if t is None:
@@ -737,6 +741,34 @@ class NodeTableCache:
             self.device.arrays_for(t)
         except Exception:       # pragma: no cover — defensive: a dead
             pass                # device falls back to dense shipping
+        try:
+            from .select import get_shared_sharded
+            sh = get_shared_sharded()
+            if sh is not None:
+                sh.resident.arrays_for(t)
+        except Exception:       # pragma: no cover — defensive: the
+            pass                # mesh path falls back to dense shipping
+
+    def fold_mesh(self) -> dict:
+        """Reclaim for the governor's mesh.reshard_debt watermark:
+        replace the mesh-resident table's scatter history with one
+        contiguous sharded re-upload from the current host table."""
+        from .select import _SHARED_SHARDED
+        sh = _SHARED_SHARDED
+        with self._lock:
+            t = self._table
+        if sh is None:
+            return {"folded": False, "reason": "no mesh"}
+        if t is None:
+            return {"folded": False, "reason": "no table"}
+        return sh.resident.fold(t, t.device_version)
+
+    def mesh_reshard_debt(self) -> int:
+        """Rows scattered into the mesh-resident table since its last
+        contiguous upload (0 when no mesh dispatcher exists)."""
+        from .select import _SHARED_SHARDED
+        sh = _SHARED_SHARDED
+        return sh.resident.debt() if sh is not None else 0
 
     def get(self, snapshot, build: bool = True) -> Optional[NodeTable]:
         from ..utils import stages

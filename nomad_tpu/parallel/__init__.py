@@ -1,3 +1,5 @@
 from .sharded import ShardedSelect, make_mesh
+from .sharded_table import ShardedDeviceNodeTable, resident_enabled
 
-__all__ = ["ShardedSelect", "make_mesh"]
+__all__ = ["ShardedSelect", "ShardedDeviceNodeTable", "make_mesh",
+           "resident_enabled"]

@@ -24,6 +24,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.select import (PACK_SHARD_KINDS, SelectRequest, _bucket_k,
                           _select_scan, pack_request, unpack_result)
+from .sharded_table import (ShardedDeviceNodeTable, pad_for_mesh,
+                            resident_enabled)
+
+# capacity-only fallback cache bound (tables WITHOUT a mirror token —
+# private builds, older snapshots): evict-oldest past this many entries
+CAPACITY_CACHE_MAX = 16
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -44,20 +50,20 @@ class ShardedSelect:
         self.node2_sharding = NamedSharding(mesh, P("nodes", None))
         self.code_sharding = NamedSharding(mesh, P(None, "nodes"))
         self.replicated = NamedSharding(mesh, P())
-        # resident device state: the node table's immutable capacity
-        # columns live sharded on the mesh across evals (keyed by the
-        # host array's identity — NodeTable versions share the array
-        # until a node-set rebuild), so steady-state evals ship only
-        # their per-eval columns
+        # mesh-resident node table (sharded_table.py): ALL hot columns
+        # — capacity, used, free_ports — live sharded across evals,
+        # advanced by the cache's delta journal; steady-state dispatches
+        # ship only per-request arrays (ask, feasible, pre_score, ...)
+        self.resident = ShardedDeviceNodeTable(mesh)
+        # capacity-only fallback for tables without a mirror token
+        # (keyed by the host array's identity — NodeTable versions
+        # share the capacity array until a node-set rebuild)
         self._resident: dict = {}
+        self.stats = {"capacity_evictions": 0}
 
     def pad_to_shards(self, n: int) -> int:
         """Pad N so it divides evenly over the mesh."""
-        shards = self.mesh.devices.size
-        per = -(-n // shards)
-        # keep lanes aligned for the VPU
-        per = max(8, per)
-        return per * shards
+        return pad_for_mesh(self.mesh, n)
 
     def _sharding_for(self, kind: str):
         return {"node": self.node_sharding, "node2": self.node2_sharding,
@@ -68,12 +74,18 @@ class ShardedSelect:
         """Full sharded dispatch of a SelectRequest: identical semantics
         to SelectKernel.select, with the node axis spread over the mesh.
         Packing is shared with the single-device path (pack_request);
-        only the device placement differs."""
+        only the device placement differs. When the request carries a
+        live mirror token, the table-shaped columns come off the
+        mesh-resident table instead of crossing the bus."""
         n_pad = self.pad_to_shards(len(req.feasible))
         k = _bucket_k(max(req.count, 1))
         args, statics = pack_request(req, n_pad)
+        resident = self.resident_args(req, n_pad)
         placed_args = {}
         for name, value in args.items():
+            if resident is not None and name in resident:
+                placed_args[name] = resident[name]
+                continue
             if name == "capacity":
                 placed_args[name] = self._resident_capacity(req.capacity,
                                                             value)
@@ -85,29 +97,79 @@ class ShardedSelect:
             _carry, outs = _select_scan(**placed_args, k_steps=k, **statics)
         return unpack_result(req, outs)
 
+    def resident_args(self, req: SelectRequest,
+                      n_pad: int) -> Optional[dict]:
+        """Mesh-resident replacements for the table-shaped inputs
+        (capacity, used0, free_ports) — the sharded analog of
+        SelectKernel._resident_args, sharing the same assembly
+        (device_table.resident_request_args): used0 computed ON the
+        mesh as resident-used + the sparse per-eval plan overlay, with
+        dense fallback for stale snapshots, shape mismatches, or
+        overlays too wide to scatter."""
+        if not resident_enabled():
+            return None
+        from ..ops.device_table import resident_request_args
+        return resident_request_args(self.resident, req, n_pad,
+                                     "nomad.select.mesh_resident")
+
     def _resident_capacity(self, src, padded):
         """Device-put the padded capacity once per (source array, pad)
-        and keep it sharded on the mesh across evals — the resident
-        node-table property (SURVEY §7.2 step 8). `src` is the host
-        NodeTable's capacity array whose identity keys the cache."""
+        and keep it sharded on the mesh across evals — the fallback for
+        tables without a mirror token (the full resident table serves
+        tokened requests). `src` is the host NodeTable's capacity array
+        whose identity keys the cache; eviction is oldest-first, never
+        a wholesale clear (dropping the hot table on churn re-uploads
+        it on the very next eval)."""
         key = (id(src), padded.shape[0])
         hit = self._resident.get(key)
         if hit is not None and hit[0] is src:
             return hit[1]
         arr = jax.device_put(padded, self.node2_sharding)
-        if len(self._resident) > 16:
-            self._resident.clear()
+        while len(self._resident) >= CAPACITY_CACHE_MAX:
+            # dicts preserve insertion order: drop the oldest entry
+            self._resident.pop(next(iter(self._resident)))
+            self.stats["capacity_evictions"] += 1
         self._resident[key] = (src, arr)
         return arr
 
+    def stats_snapshot(self) -> dict:
+        """One read for the governor gauges, the telemetry device.*
+        family, and the bench artifact (ops/select.mesh_stats_snapshot
+        fronts this for the process-wide instance)."""
+        ndev = int(self.mesh.devices.size)
+        total = self.resident.device_bytes()
+        out = {
+            "devices": ndev,
+            "resident_bytes": total,
+            "resident_bytes_per_device": total / max(ndev, 1),
+            "capacity_cache_entries": len(self._resident),
+            "capacity_cache_evictions": self.stats["capacity_evictions"],
+        }
+        out.update(self.resident.snapshot())
+        return out
+
+    def _resident_capacity_for_table(self, table, n_pad: int):
+        """The mesh-resident capacity column for a tokened table, or
+        None (caller falls back to the identity-keyed cache). Batched
+        lanes share one capacity array but carry per-lane used0, so
+        only capacity rides the full resident table here."""
+        if table is None or not resident_enabled():
+            return None
+        state = self.resident.arrays_for(table)
+        if state is None or state.n_pad != n_pad:
+            return None
+        return state.capacity
+
     def place_batched_chunked_args(self, cargs: dict,
-                                   capacity_src=None) -> dict:
+                                   capacity_src=None,
+                                   table=None) -> dict:
         """Shard the BATCHED K-way kernel's argument dict: per-lane
         arrays carry a leading batch axis (B, ...) that stays
         replicated while the node axis shards — the multi-eval batch
         (select_many) runs as one SPMD program over the mesh. Capacity
         is unstacked (all lanes share one table; that's the batching
-        precondition) and rides the cross-eval resident cache."""
+        precondition) and rides the mesh-resident table when a mirror
+        token is available, else the identity-keyed cache."""
         batched = {
             "node": NamedSharding(self.mesh, P(None, "nodes")),
             "node2": NamedSharding(self.mesh, P(None, "nodes", None)),
@@ -118,23 +180,38 @@ class ShardedSelect:
         placed = {}
         for name, value in cargs.items():
             if name == "capacity":
-                placed[name] = (self._resident_capacity(capacity_src,
-                                                        value)
-                                if capacity_src is not None
-                                else jax.device_put(
-                                    value, self.node2_sharding))
+                cap = self._resident_capacity_for_table(
+                    table, value.shape[0])
+                if cap is not None:
+                    placed[name] = cap
+                elif capacity_src is not None:
+                    placed[name] = self._resident_capacity(capacity_src,
+                                                           value)
+                else:
+                    placed[name] = jax.device_put(
+                        value, self.node2_sharding)
                 continue
             sharding = batched[PACK_SHARD_KINDS[name]]
             placed[name] = jax.device_put(np.asarray(value), sharding)
         return placed
 
     def place_chunked_args(self, cargs: dict,
-                           capacity_src=None) -> dict:
+                           capacity_src=None,
+                           req: Optional[SelectRequest] = None) -> dict:
         """Shard the K-way kernel's argument dict over the mesh (same
-        kind table as the scan). When capacity_src (the host table's
-        array) is given, capacity rides the cross-eval resident cache."""
+        kind table as the scan). When `req` carries a live mirror
+        token, the table-shaped columns (capacity, used0, free_ports)
+        come off the mesh-resident table; else capacity_src rides the
+        identity-keyed cache."""
+        resident = None
+        if req is not None:
+            resident = self.resident_args(req,
+                                          cargs["capacity"].shape[0])
         placed = {}
         for name, value in cargs.items():
+            if resident is not None and name in resident:
+                placed[name] = resident[name]
+                continue
             if name == "capacity" and capacity_src is not None:
                 placed[name] = self._resident_capacity(capacity_src,
                                                        value)

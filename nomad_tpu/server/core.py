@@ -257,6 +257,17 @@ class ServerConfig:
     # columns; with the 256-series cap the ring's hard byte ceiling is
     # slots x 256 x 8 bytes — 1 MiB at the default 512)
     telemetry_ring_slots: int = 512
+    # mesh-sharded resident node table (parallel/sharded_table.py):
+    # keep the hot columns sharded-resident across evals when mesh
+    # routing is active (NOMAD_TPU_MESH). Off falls back to the
+    # capacity-only per-eval upload path; NOMAD_TPU_MESH_RESIDENT=0 is
+    # the runtime kill switch and wins over this knob
+    mesh_resident: bool = True
+    # scattered-row debt on the mesh-resident table that triggers the
+    # fold-to-rebuild reclaim (one contiguous sharded re-upload
+    # replacing the scatter history) — the mesh analog of
+    # governor_table_delta_debt_high
+    mesh_reshard_debt_high: int = 500_000
 
 
 class Server:
@@ -274,6 +285,11 @@ class Server:
         _preemption.configure(columnar=self.config.preempt_columnar,
                               rows_max=self.config.preempt_rows_max,
                               cache_max=self.config.preempt_cache_max)
+        # mesh-sharded residency knob (module-level, same idiom — the
+        # process-wide ShardedSelect has no ServerConfig); the env kill
+        # switch NOMAD_TPU_MESH_RESIDENT wins inside resident_enabled()
+        from ..parallel import sharded_table as _sharded_table
+        _sharded_table.configure(resident=self.config.mesh_resident)
         # RLock: FSM appliers can nest (e.g. a node-register unblocking a
         # blocked eval re-enters raft_apply on the same thread)
         self._raft_l = threading.RLock()
@@ -649,6 +665,34 @@ class Server:
         gov.register("node_table.delta_refreshes",
                      lambda: BUILD_STATS["delta_refreshes"],
                      suspect=False)
+
+        # mesh-sharded resident node table (parallel/sharded_table.py):
+        # device count, sharded residency footprint, and the reshard /
+        # delta-scatter traffic split — `mesh.reshard_uploads` flat
+        # across a warm eval run IS the zero-reupload steady state the
+        # multichip bench asserts. All read through the process-wide
+        # snapshot (empty dict -> 0 while no mesh dispatcher exists).
+        # The scattered-row debt carries the watermark, with a
+        # contiguous sharded re-upload as the reclaim (the mesh analog
+        # of node_table.delta_debt's fold-to-rebuild)
+        from ..ops.select import mesh_stats_snapshot
+
+        def _mesh(key):
+            return lambda: float(mesh_stats_snapshot().get(key, 0) or 0)
+
+        gov.register("mesh.devices", _mesh("devices"), suspect=False)
+        gov.register("mesh.resident_bytes_per_device",
+                     _mesh("resident_bytes_per_device"))
+        gov.register("mesh.reshard_uploads", _mesh("reshard_uploads"),
+                     suspect=False)
+        gov.register("mesh.delta_scatters", _mesh("delta_scatters"),
+                     suspect=False)
+        gov.register("mesh.resident_hits", _mesh("resident_hits"),
+                     suspect=False)
+        gov.register("mesh.reshard_debt",
+                     lambda: self.store.table_cache.mesh_reshard_debt(),
+                     WatermarkPolicy(cfg.mesh_reshard_debt_high),
+                     reclaim=lambda: self.store.table_cache.fold_mesh())
 
         # backpressure escalation (ROADMAP open item): the delayed/
         # requeue heap depth — when admission deferral itself backs up,

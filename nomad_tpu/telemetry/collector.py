@@ -76,6 +76,19 @@ def default_device_fn() -> Dict[str, float]:
             out[f"device.dispatches.{arm}"] = d
         out["device.kernel_cache_entries"] = kernel_cache_entries()
         out["device.hbm_bytes_in_use"] = device_hbm_bytes()
+        # mesh-sharded residency economics: present only when a mesh
+        # dispatcher exists, so a single-chip run's series stay lean
+        from ..ops.select import mesh_stats_snapshot
+        ms = mesh_stats_snapshot()
+        if ms:
+            out["device.mesh_devices"] = ms["devices"]
+            out["device.mesh_resident_bytes_per_device"] = \
+                ms["resident_bytes_per_device"]
+            out["device.mesh_reshard_uploads"] = ms["reshard_uploads"]
+            out["device.mesh_reshard_bytes"] = ms["reshard_bytes"]
+            out["device.mesh_delta_scatters"] = ms["delta_scatters"]
+            out["device.mesh_resident_hits"] = ms["resident_hits"]
+            out["device.mesh_stale_misses"] = ms["stale_misses"]
     except Exception:       # pragma: no cover — defensive
         pass
     return out
@@ -93,7 +106,10 @@ class TelemetryCollector:
     # a monotone total.)
     RATE_PREFIXES = ("counter.", "device.dispatch_s.",
                      "device.compiles.", "device.dispatches.",
-                     "device.packs")
+                     "device.packs", "device.mesh_reshard_uploads",
+                     "device.mesh_reshard_bytes",
+                     "device.mesh_delta_scatters",
+                     "device.mesh_resident_hits")
 
     def __init__(self, interval_s: float = DEFAULT_INTERVAL_S,
                  slots: int = DEFAULT_SLOTS,

@@ -27,6 +27,17 @@ import sys
 from typing import Optional
 
 
+def requested_cpu_devices(default: int = 1) -> int:
+    """The virtual CPU device count the operator already configured via
+    XLA_FLAGS (xla_force_host_platform_device_count=N). Callers that
+    re-pin the platform defensively (the CLI agent) pass this instead
+    of a literal 1 so they don't clobber a multi-device setup — the
+    mesh-routed CPU agent (NOMAD_TPU_MESH=1) depends on it."""
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                  os.environ.get("XLA_FLAGS", ""))
+    return int(m.group(1)) if m else default
+
+
 def force_cpu_platform(n_devices: int = 1) -> None:
     """Point JAX at an n-device virtual CPU platform. Must run before the
     process initializes any backend; raises via assert_cpu_devices if you

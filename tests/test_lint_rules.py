@@ -306,6 +306,8 @@ class ServerConfig:
     preempt_orphan_rows: int = 19
     telemetry_documented_slots: int = 512
     telemetry_orphan_slots: int = 21
+    mesh_documented_resident: bool = True
+    mesh_orphan_debt_high: int = 23
     other_knob: int = 1
 """
 
@@ -334,6 +336,7 @@ class TestSurfaceDrift:
                            "trace_documented_bytes and "
                            "preempt_documented_rows and "
                            "telemetry_documented_slots and "
+                           "mesh_documented_resident and "
                            "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
@@ -361,6 +364,9 @@ class TestSurfaceDrift:
         # telemetry_* knobs joined the contract (ISSUE 11: retained
         # telemetry collector knobs must land in the STATUS.md table)
         tm_f = [f for f in out if "telemetry_orphan_slots" in f.message]
+        # mesh_* knobs joined the contract (ISSUE 12: sharded-residency
+        # knobs must land in the STATUS.md knob table)
+        me_f = [f for f in out if "mesh_orphan_debt_high" in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
@@ -372,6 +378,7 @@ class TestSurfaceDrift:
         assert len(tr_f) == 1
         assert len(pr_f) == 1
         assert len(tm_f) == 1
+        assert len(me_f) == 1
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
                        for f in out)
@@ -390,6 +397,8 @@ class TestSurfaceDrift:
         assert not any("preempt_documented_rows" in f.message
                        for f in out)
         assert not any("telemetry_documented_slots" in f.message
+                       for f in out)
+        assert not any("mesh_documented_resident" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -413,7 +422,9 @@ class TestSurfaceDrift:
                            "preempt_documented_rows, "
                            "preempt_orphan_rows, "
                            "telemetry_documented_slots, "
-                           "telemetry_orphan_slots")
+                           "telemetry_orphan_slots, "
+                           "mesh_documented_resident, "
+                           "mesh_orphan_debt_high")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))

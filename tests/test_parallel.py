@@ -269,3 +269,242 @@ def test_select_many_batched_on_mesh(monkeypatch):
             collections.Counter(s.node_idx.tolist())
         assert np.allclose(m.final_score, s.final_score,
                            rtol=1e-4, atol=1e-5)
+
+
+# -- mesh-sharded resident node table (ISSUE 12) -----------------------
+
+class _StubTable:
+    """The minimal surface ShardedDeviceNodeTable reads: columnar
+    node state plus the (mirror, version) token pair."""
+
+    def __init__(self, n, rng, d=4):
+        self.n = n
+        self.capacity = rng.uniform(100, 4000, (n, d)).astype(np.float32)
+        self.base_used = np.zeros((n, d), np.float32)
+        self.free_ports = np.full(n, 200.0, np.float32)
+        self.device_mirror = None
+        self.device_version = -1
+
+
+def _stub_with_mirror(n, rng):
+    from nomad_tpu.ops.device_table import DeviceNodeTable
+    t = _StubTable(n, rng)
+    t.device_mirror = DeviceNodeTable()
+    t.device_version = t.device_mirror.note_rebuild()
+    return t
+
+
+def _assert_sharded_parity(st, t, ctx):
+    nn = t.n
+    assert np.array_equal(np.asarray(st.used)[:nn], t.base_used), ctx
+    assert np.array_equal(np.asarray(st.free_ports)[:nn],
+                          t.free_ports), ctx
+    assert np.array_equal(np.asarray(st.capacity)[:nn], t.capacity), ctx
+
+
+def test_sharded_resident_delta_matches_rebuild_1k_seeds(mesh):
+    """1k-seed randomized delta≡rebuild parity: after any sequence of
+    journaled row deltas (sparse scatters, wide-delta re-uploads, empty
+    refreshes), the mesh-resident columns equal a fresh upload of the
+    host table bit for bit — replay is `.set` from host-latest values,
+    so divergence is a protocol bug, never float noise."""
+    from nomad_tpu.parallel.sharded_table import ShardedDeviceNodeTable
+    sh = ShardedDeviceNodeTable(mesh)
+    n = 48
+    for seed in range(1000):
+        rng = np.random.RandomState(10_000 + seed)
+        if seed % 97 == 0 or seed == 0:
+            # fresh table generation: forces the re-upload path too
+            t = _stub_with_mirror(n, rng)
+            st = sh.arrays_for(t)
+            _assert_sharded_parity(st, t, seed)
+            continue
+        kind = rng.randint(0, 10)
+        if kind == 0:
+            rows = set()                        # empty refresh
+        elif kind == 1:
+            rows = set(range(n))                # wide delta -> upload
+        else:
+            rows = set(rng.choice(
+                n, size=rng.randint(1, 9), replace=False).tolist())
+        if rows:
+            idx = np.fromiter(rows, np.int32, len(rows))
+            t.base_used[idx] += rng.uniform(
+                0, 50, (len(idx), 4)).astype(np.float32)
+            t.free_ports[idx] = np.maximum(t.free_ports[idx] - 1.0, 0.0)
+        t.device_version = t.device_mirror.note_delta(t, rows)
+        st = sh.arrays_for(t)
+        assert st is not None, seed
+        if seed % 7 == 0 or seed == 999:
+            _assert_sharded_parity(st, t, seed)
+    snap = sh.snapshot()
+    assert snap["delta_scatters"] > 0
+    assert snap["resident_hits"] > 0
+    assert snap["reshard_uploads"] >= 1
+
+
+def test_sharded_resident_stale_version_fallback(mesh):
+    """A snapshot older than the resident state must fall back to
+    dense shipping (None), never read newer columns — the same MVCC
+    rule the single-device mirror enforces."""
+    from nomad_tpu.parallel.sharded_table import ShardedDeviceNodeTable
+    sh = ShardedDeviceNodeTable(mesh)
+    rng = np.random.RandomState(3)
+    t = _stub_with_mirror(32, rng)
+    assert sh.arrays_for(t) is not None
+    old_token = t.device_version
+    t.base_used[0] += 1.0
+    t.device_version = t.device_mirror.note_delta(t, {0})
+    assert sh.arrays_for(t) is not None          # advance the mirror
+    stale = _StubTable(32, rng)
+    stale.__dict__.update({k: v for k, v in t.__dict__.items()})
+    stale.device_version = old_token
+    misses0 = sh.stats["stale_misses"]
+    assert sh.arrays_for(stale) is None
+    assert sh.stats["stale_misses"] == misses0 + 1
+
+
+def test_sharded_resident_journal_gap_reuploads(mesh):
+    """A journal gap (more deltas than the retained ring while this
+    mirror wasn't reading) pays ONE contiguous re-upload, then parity
+    holds again."""
+    from nomad_tpu.ops.device_table import DELTA_LOG_MAX
+    from nomad_tpu.parallel.sharded_table import ShardedDeviceNodeTable
+    sh = ShardedDeviceNodeTable(mesh)
+    rng = np.random.RandomState(5)
+    t = _stub_with_mirror(24, rng)
+    assert sh.arrays_for(t) is not None
+    for _ in range(DELTA_LOG_MAX + 4):
+        t.base_used[1] += 1.0
+        t.device_version = t.device_mirror.note_delta(t, {1})
+    ups0 = sh.stats["reshard_uploads"]
+    gaps0 = sh.stats["journal_gaps"]
+    st = sh.arrays_for(t)
+    assert st is not None
+    assert sh.stats["journal_gaps"] == gaps0 + 1
+    assert sh.stats["reshard_uploads"] == ups0 + 1
+    _assert_sharded_parity(st, t, "post gap")
+
+
+def test_sharded_resident_fold_reclaim(mesh):
+    """Fold-to-rebuild on the mesh: scattered-row debt is replaced by
+    one contiguous sharded re-upload; a stale table is refused."""
+    from nomad_tpu.parallel.sharded_table import ShardedDeviceNodeTable
+    sh = ShardedDeviceNodeTable(mesh)
+    rng = np.random.RandomState(7)
+    t = _stub_with_mirror(24, rng)
+    sh.arrays_for(t)
+    for _ in range(5):
+        t.base_used[2] += 1.0
+        t.device_version = t.device_mirror.note_delta(t, {2})
+        sh.arrays_for(t)
+    assert sh.debt() >= 5
+    old = _StubTable(24, rng)
+    old.__dict__.update({k: v for k, v in t.__dict__.items()})
+    old.device_version = t.device_version - 1
+    assert sh.fold(old, old.device_version)["folded"] is False
+    out = sh.fold(t, t.device_version)
+    assert out["folded"] is True and out["debt_cleared"] >= 5
+    assert sh.debt() == 0
+    assert sh.stats["folds"] == 1
+    _assert_sharded_parity(sh.arrays_for(t), t, "post fold")
+
+
+def test_sharded_capacity_cache_evicts_oldest(mesh):
+    """Satellite fix: the capacity-only fallback cache must evict its
+    OLDEST entry on overflow, not clear the whole resident set (which
+    dropped the hot table on churn)."""
+    from nomad_tpu.parallel.sharded import CAPACITY_CACHE_MAX
+    sharded = ShardedSelect(mesh)
+    n_pad = sharded.pad_to_shards(16)
+    srcs = [np.ones((16, 4), np.float32) * i
+            for i in range(CAPACITY_CACHE_MAX + 4)]
+    pads = [np.zeros((n_pad, 4), np.float32) for _ in srcs]
+    first_arr = sharded._resident_capacity(srcs[0], pads[0])
+    for src, pad in zip(srcs[1:], pads[1:]):
+        sharded._resident_capacity(src, pad)
+    assert len(sharded._resident) == CAPACITY_CACHE_MAX
+    assert sharded.stats["capacity_evictions"] == 4
+    # the oldest entries are gone, the newest survive
+    assert (id(srcs[0]), n_pad) not in sharded._resident
+    assert (id(srcs[-1]), n_pad) in sharded._resident
+    # a re-put of an evicted source repopulates (fresh upload)
+    again = sharded._resident_capacity(srcs[0], pads[0])
+    assert again is not first_arr
+
+
+def test_mesh_resident_zero_reupload_steady_state(monkeypatch):
+    """Acceptance: on the virtual 8-device mesh, a WARM eval run
+    performs zero full column re-uploads — every refresh rides the
+    delta journal (scatters counted, resident hits counted,
+    mesh.reshard_uploads flat)."""
+    monkeypatch.setenv("NOMAD_TPU_MESH", "1")
+    from nomad_tpu import mock
+    from nomad_tpu.models import (Evaluation, EVAL_STATUS_PENDING,
+                                  TRIGGER_JOB_REGISTER)
+    from nomad_tpu.ops.select import mesh_stats_snapshot
+    from nomad_tpu.scheduler.harness import Harness
+    from nomad_tpu.utils.ids import generate_uuid
+
+    h = Harness()
+    for i in range(24):
+        node = mock.node()
+        node.id = f"1e51a7b0-{i:04d}-4000-8000-0000000{i:05d}"
+        node.name = f"steady-{i}"
+        node.datacenter = "dc1"
+        node.compute_class()
+        h.store.upsert_node(h.next_index(), node)
+
+    def one_eval(i):
+        job = mock.job()
+        job.id = f"steady-svc-{i}"
+        job.datacenters = ["dc1"]
+        tg = job.task_groups[0]
+        tg.count = 3
+        for t in tg.tasks:
+            t.resources.networks = []
+        tg.networks = []
+        h.store.upsert_job(h.next_index(), job)
+        ev = Evaluation(id=generate_uuid(), namespace=job.namespace,
+                        priority=job.priority,
+                        triggered_by=TRIGGER_JOB_REGISTER,
+                        job_id=job.id, status=EVAL_STATUS_PENDING,
+                        type=job.type)
+        h.process("service", ev)
+
+    for i in range(3):                  # warm: compiles + cold upload
+        one_eval(100 + i)
+    s0 = mesh_stats_snapshot()
+    for i in range(5):                  # the steady-state window
+        one_eval(i)
+    s1 = mesh_stats_snapshot()
+    assert s1["reshard_uploads"] == s0["reshard_uploads"], (s0, s1)
+    assert s1["resident_hits"] > s0["resident_hits"], (s0, s1)
+    assert s1["delta_scatters"] >= s0["delta_scatters"]
+
+
+def test_mesh_prefetch_uploads_sharded_columns(monkeypatch):
+    """Cold start (shard-aware build_from_columns upload): priming the
+    cache then prefetch_device materializes the mesh-resident columns
+    — ONE sharded H2D per column — so the first eval after recovery
+    rides residency instead of a per-eval re-put."""
+    monkeypatch.setenv("NOMAD_TPU_MESH", "1")
+    from nomad_tpu import mock
+    from nomad_tpu.ops.select import get_shared_sharded, \
+        mesh_stats_snapshot
+    from nomad_tpu.scheduler.harness import Harness
+
+    h = Harness()
+    for i in range(12):
+        node = mock.node()
+        node.name = f"prefetch-{i}"
+        node.compute_class()
+        h.store.upsert_node(h.next_index(), node)
+    t = h.store.snapshot().node_table()
+    s0 = mesh_stats_snapshot()
+    h.store.table_cache.prefetch_device()
+    s1 = mesh_stats_snapshot()
+    assert s1["reshard_uploads"] == s0.get("reshard_uploads", 0) + 1
+    sh = get_shared_sharded()
+    st = sh.resident.arrays_for(t)       # current token: a hit, no I/O
+    _assert_sharded_parity(st, t, "prefetch")
