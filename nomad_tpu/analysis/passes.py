@@ -533,11 +533,17 @@ class SurfaceDriftRule(Rule):
     doc = ("routes need CLI/test references; governor/persistence "
            "knobs in STATUS.md")
 
-    # ServerConfig knob families that must appear in the STATUS.md knob
-    # table (operators find them there; the table is the contract)
+    # ServerConfig/ClientConfig knob families that must appear in the
+    # STATUS.md knob table (operators find them there; the table is
+    # the contract). stats_ covers BOTH config classes (ISSUE 13: the
+    # client sampler's knobs live on ClientConfig, the rollup
+    # staleness knob on ServerConfig).
     KNOB_PREFIXES = ("governor_", "plan_group_", "reconcile_",
                      "gateway_", "snapshot_", "wal_", "trace_",
-                     "preempt_", "telemetry_", "mesh_")
+                     "preempt_", "telemetry_", "mesh_", "stats_")
+
+    # which config dataclasses carry operator knobs
+    CONFIG_CLASSES = ("ServerConfig", "ClientConfig")
 
     def __init__(self,
                  http_path: str = "nomad_tpu/api/http.py",
@@ -546,11 +552,13 @@ class SurfaceDriftRule(Rule):
                  reference_files: Sequence[str] = (
                      "nomad_tpu/api/client.py",),
                  config_path: str = "nomad_tpu/server/core.py",
+                 client_config_path: str = "nomad_tpu/client/agent.py",
                  status_path: str = "STATUS.md"):
         self.http_path = http_path
         self.reference_dirs = tuple(reference_dirs)
         self.reference_files = tuple(reference_files)
         self.config_path = config_path
+        self.client_config_path = client_config_path
         self.status_path = status_path
 
     def finish(self, project: Project) -> Iterable[Finding]:
@@ -613,31 +621,36 @@ class SurfaceDriftRule(Rule):
                 pools.append(t)
         return pools
 
-    # -- governor knobs ------------------------------------------------
+    # -- operator knobs ------------------------------------------------
     def _check_knobs(self, project: Project) -> Iterable[Finding]:
-        ctx = project.contexts.get(self.config_path)
-        if ctx is None or ctx.tree is None:
-            return
         status = project.text(self.status_path) or ""
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.ClassDef) or \
-                    node.name != "ServerConfig":
+        seen_paths = set()
+        for path in (self.config_path, self.client_config_path):
+            if not path or path in seen_paths:
                 continue
-            for stmt in node.body:
-                target = None
-                if isinstance(stmt, ast.AnnAssign) and \
-                        isinstance(stmt.target, ast.Name):
-                    target = stmt.target.id
-                elif isinstance(stmt, ast.Assign) and \
-                        isinstance(stmt.targets[0], ast.Name):
-                    target = stmt.targets[0].id
-                if target and target.startswith(self.KNOB_PREFIXES) \
-                        and target not in status:
-                    yield ctx.finding(
-                        self.name, stmt,
-                        f"ServerConfig.{target} is not documented in "
-                        f"{self.status_path} — operators can't find "
-                        f"the knob")
+            seen_paths.add(path)
+            ctx = project.contexts.get(path)
+            if ctx is None or ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.ClassDef) or \
+                        node.name not in self.CONFIG_CLASSES:
+                    continue
+                for stmt in node.body:
+                    target = None
+                    if isinstance(stmt, ast.AnnAssign) and \
+                            isinstance(stmt.target, ast.Name):
+                        target = stmt.target.id
+                    elif isinstance(stmt, ast.Assign) and \
+                            isinstance(stmt.targets[0], ast.Name):
+                        target = stmt.targets[0].id
+                    if target and target.startswith(self.KNOB_PREFIXES) \
+                            and target not in status:
+                        yield ctx.finding(
+                            self.name, stmt,
+                            f"{node.name}.{target} is not documented "
+                            f"in {self.status_path} — operators can't "
+                            f"find the knob")
 
 
 def default_rules() -> List[Rule]:

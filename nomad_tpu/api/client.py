@@ -228,6 +228,30 @@ class ApiClient:
             "DELETE",
             f"/v1/client/allocation/{alloc_id}/exec/{session_id}")
 
+    def alloc_stats(self, alloc_id: str) -> dict:
+        """GET /v1/client/allocation/:alloc/stats — live task-level
+        AllocResourceUsage from the owning client's sampler
+        (client/alloc_endpoint.go Stats; ISSUE 13)."""
+        return self._request(
+            "GET", f"/v1/client/allocation/{alloc_id}/stats")
+
+    def client_host_stats(self, node_id: str = "",
+                          history: bool = False,
+                          last: Optional[int] = None) -> dict:
+        """GET /v1/client/stats — a node's HostStats, proxied by the
+        server to the owning client (stats_endpoint.go); node_id may
+        be omitted on a single-node cluster. history=True attaches the
+        client-side retained ring."""
+        params = {}
+        if node_id:
+            params["node_id"] = node_id
+        if history:
+            params["history"] = "true"
+            if last:
+                params["n"] = str(last)
+        return self._request("GET", "/v1/client/stats",
+                             params=params or None)
+
     def get_allocation(self, alloc_id: str) -> dict:
         return self._request("GET", f"/v1/allocation/{alloc_id}")
 

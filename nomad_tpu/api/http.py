@@ -281,11 +281,19 @@ class HTTPApiServer:
             else:
                 need(acl.allow_namespace_operation(ns, "read-fs"))
             return
+        if path == "/v1/client/stats":
+            # host stats are node-scoped reads (stats_endpoint.go
+            # aclObj.AllowNodeRead)
+            need(acl.allow_node_read())
+            return
         if path.startswith("/v1/client/allocation/"):
             # restart/signal are lifecycle control; exec is its own,
             # stronger capability (acl.NamespaceCapabilityAllocExec /
-            # AllocLifecycle)
-            if path.endswith(("/restart", "/signal")):
+            # AllocLifecycle); stats is a plain alloc read
+            # (alloc_endpoint.go Stats -> AllowNsOp ReadJob)
+            if path.endswith("/stats"):
+                need(acl.allow_namespace_operation(ns, "read-job"))
+            elif path.endswith(("/restart", "/signal")):
                 need(acl.allow_namespace_operation(ns, "alloc-lifecycle"))
             else:
                 need(acl.allow_namespace_operation(ns, "alloc-exec"))
@@ -1005,6 +1013,41 @@ class HTTPApiServer:
         if m and method == "GET":
             return self._client_fs(m.group(1), m.group(2), q, ns, idx)
 
+        # client host stats (ISSUE 13; command/agent/stats_endpoint.go
+        # — the server proxies to the owning client by node lookup,
+        # nomad/client_stats_endpoint.go). ?node_id= picks the node; a
+        # single-node cluster (the dev agent) defaults to it
+        if path == "/v1/client/stats" and method == "GET":
+            node = None
+            if q.get("node_id"):
+                node = self._find_node(q["node_id"])
+                if node is None:
+                    return None
+            else:
+                nodes = s.store.nodes()
+                if len(nodes) == 1:
+                    node = nodes[0]
+                else:
+                    raise ValueError(
+                        "node_id parameter required on a multi-node "
+                        "cluster")
+            args = {}
+            if q.get("history", "").lower() in ("1", "true"):
+                args = {"history": True,
+                        "n": max(0, int(q.get("n", 0) or 0))}
+            return self._forward_node(node.id, "ClientStats.Host",
+                                      args), idx
+
+        # per-alloc ResourceUsage (client/alloc_endpoint.go Stats):
+        # live task-level usage from the owning client's sampler
+        m = re.match(r"^/v1/client/allocation/([^/]+)/stats$", path)
+        if m and method == "GET":
+            alloc = self._alloc_in_ns(m.group(1), ns)
+            if alloc is None:
+                return None
+            return self._forward_client(alloc, "ClientStats.Alloc",
+                                        {}), idx
+
         # alloc exec sessions (client/alloc_endpoint.go:163): start
         # returns a session id; io round-trips stdin/stdout frames
         m = re.match(r"^/v1/client/allocation/([^/]+)/(restart|signal)$",
@@ -1183,17 +1226,17 @@ class HTTPApiServer:
             [a for a in self.server.store.allocs() if a.namespace == ns],
             alloc_prefix, "allocation")
 
-    def _forward_client(self, alloc, method: str, args: dict):
-        """Forward a logs/fs/exec request to the OWNING client's RPC
-        listener (nomad/client_fs_endpoint.go: servers proxy these to
-        the node; the client advertises its address on the Node
-        record). Connections are cached per address."""
-        node = self.server.store.node_by_id(alloc.node_id)
+    def _forward_node(self, node_id: str, method: str, args: dict):
+        """Forward a request to a client's RPC listener by NODE lookup
+        (nomad/client_fs_endpoint.go, client_stats_endpoint.go: the
+        client advertises its address on the Node record). Connections
+        are cached per node."""
+        node = self.server.store.node_by_id(node_id)
         addr = node.attributes.get("nomad.client.rpc") if node else None
         if not addr:
             raise KeyError(
-                f"alloc {alloc.id[:8]}'s node has no reachable client "
-                "RPC address")
+                f"node {node_id[:8]} has no reachable client RPC "
+                "address")
         from ..rpc.client import RpcClient
         cache = getattr(self, "_client_rpc_cache", None)
         if cache is None:
@@ -1201,7 +1244,7 @@ class HTTPApiServer:
         # keyed by node id: a restarted client re-advertises on a new
         # ephemeral port, and the stale connection must be closed and
         # replaced instead of accumulating per historical address
-        hit = cache.get(alloc.node_id)
+        hit = cache.get(node_id)
         if hit is None or hit[0] != addr:
             if hit is not None:
                 try:
@@ -1209,10 +1252,15 @@ class HTTPApiServer:
                 except Exception:
                     pass
             hit = (addr, RpcClient(addr, dial_timeout_s=2.0))
-            cache[alloc.node_id] = hit
+            cache[node_id] = hit
+        return hit[1].call(method, args, timeout_s=60.0)
+
+    def _forward_client(self, alloc, method: str, args: dict):
+        """Forward a logs/fs/exec/stats request to the client OWNING
+        the alloc (servers proxy these to the node)."""
         args = dict(args)
         args["alloc_id"] = alloc.id
-        return hit[1].call(method, args, timeout_s=60.0)
+        return self._forward_node(alloc.node_id, method, args)
 
     def _default_task(self, alloc, task: str) -> str:
         if task:

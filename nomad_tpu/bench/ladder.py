@@ -1061,6 +1061,71 @@ def bench_cold_start(n_nodes: int = 1000, seed_allocs: int = 30000,
         shutil.rmtree(base, ignore_errors=True)
 
 
+def bench_cluster_stats(n_clients: int = 4, n_allocs: int = 8) -> Dict:
+    """Fleet observability rollup (ISSUE 13): a real server + client
+    agents with the stats sampler on, a running job, and the folded
+    cluster economics — the artifact records nodes reporting and the
+    fleet used-vs-allocated ratios so a TPU soak's bin-packing truth
+    is a first-class number next to the device truth (pad_waste)."""
+    import time as _time
+
+    from ..client import Client, ClientConfig
+    from ..mock import fixtures as mock
+    from ..server import Server, ServerConfig
+
+    srv = Server(ServerConfig(num_schedulers=2, heartbeat_ttl_s=30.0,
+                              telemetry_sample_interval_s=3600.0))
+    srv.start()
+    clients = [Client(srv, ClientConfig(node_name=f"stats-{i}",
+                                        heartbeat_interval_s=0.2,
+                                        stats_sample_interval_s=0.1))
+               for i in range(n_clients)]
+    out: Dict = {}
+    try:
+        for c in clients:
+            c.start()
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = n_allocs
+        tg.networks = []
+        for t in tg.tasks:
+            t.resources.networks = []
+            t.driver = "mock_driver"
+            t.config = {"run_for": "10s"}
+        srv.register_job(job)
+        deadline = _time.time() + 30.0
+        while _time.time() < deadline:
+            allocs = srv.store.allocs_by_job(job.namespace, job.id)
+            if len(allocs) >= n_allocs and any(
+                    a.client_status == "running" for a in allocs):
+                break
+            _time.sleep(0.05)
+        # wait for every client's heartbeat to land a stats payload
+        deadline = _time.time() + 10.0
+        cs = srv.cluster_stats()
+        while _time.time() < deadline and \
+                cs["nodes_reporting"] < n_clients:
+            _time.sleep(0.1)
+            cs = srv.cluster_stats()
+        if srv.telemetry is not None:
+            # the cluster.* family lands in the retained ring too
+            srv.telemetry.sample_once()
+        out["cluster_nodes"] = int(cs["nodes_total"])
+        out["cluster_nodes_reporting"] = int(cs["nodes_reporting"])
+        out["cluster_stale_heartbeats"] = int(cs["stale_heartbeats"])
+        out["fleet_cpu_used_ratio"] = cs["fleet_cpu_used_ratio"]
+        out["fleet_mem_used_ratio"] = cs["fleet_mem_used_ratio"]
+        out["fleet_cpu_allocated_ratio"] = \
+            cs["fleet_cpu_allocated_ratio"]
+        out["fleet_mem_allocated_ratio"] = \
+            cs["fleet_mem_allocated_ratio"]
+    finally:
+        for c in clients:
+            c.shutdown()
+        srv.shutdown()
+    return out
+
+
 def run_ladder(quick: bool = False) -> Dict:
     """Run the full ladder; returns a flat dict of results."""
     out: Dict = {}
@@ -1111,4 +1176,9 @@ def run_ladder(quick: bool = False) -> Dict:
         n_nodes=300 if quick else 1000,
         seed_allocs=8000 if quick else 30000,
         n_jobs=6 if quick else 8))
+    # fleet observability rollup (ISSUE 13): real client agents with
+    # the stats sampler on; records the used-vs-allocated economics
+    out.update(bench_cluster_stats(
+        n_clients=2 if quick else 4,
+        n_allocs=4 if quick else 8))
     return out

@@ -547,6 +547,8 @@ def cmd_node_status(args) -> int:
     print(f"Resources   = cpu: {res['cpu']['cpu_shares']} MHz, "
           f"memory: {res['memory']['memory_mb']} MiB, "
           f"disk: {res['disk']['disk_mb']} MiB")
+    if getattr(args, "stats", False):
+        _render_host_stats(c, node["id"])
     allocs = c.node_allocations(node["id"])
     if allocs:
         print("\nAllocations")
@@ -555,6 +557,34 @@ def cmd_node_status(args) -> int:
               a["client_status"]] for a in allocs],
             ["ID", "Task Group", "Desired", "Status"])
     return 0
+
+
+def _render_host_stats(c: ApiClient, node_id: str) -> None:
+    """`node status -stats`: the node's live HostStats, proxied by the
+    server to the owning client (ISSUE 13)."""
+    try:
+        hs = c.client_host_stats(node_id)
+    except ApiError as e:
+        print(f"\nHost Resource Utilization\n  unavailable: {e}")
+        return
+    if not hs.get("enabled", True):
+        print("\nHost Resource Utilization\n  stats sampler disabled "
+              "on this node (NOMAD_TPU_CLIENT_STATS=0)")
+        return
+    mem = hs.get("Memory") or {}
+    disk = (hs.get("DiskStats") or [{}])[0]
+    cpu = (hs.get("CPU") or [{}])[0]
+    mib = 1024.0 * 1024.0
+    print("\nHost Resource Utilization")
+    print(f"  CPU     = {cpu.get('TotalPercent', 0.0):.1f}%")
+    print(f"  Memory  = {mem.get('Used', 0) / mib:.0f} MiB / "
+          f"{mem.get('Total', 0) / mib:.0f} MiB")
+    print(f"  Disk    = {disk.get('Used', 0) / mib:.0f} MiB / "
+          f"{disk.get('Size', 0) / mib:.0f} MiB "
+          f"({disk.get('UsedPercent', 0.0):.1f}%)")
+    print(f"  Uptime  = {hs.get('Uptime', 0.0):.0f} s; allocs "
+          f"running = {hs.get('AllocsRunning', 0)} "
+          f"(reporting usage = {hs.get('AllocsReporting', 0)})")
 
 
 def cmd_node_eligibility(args) -> int:
@@ -655,6 +685,30 @@ def cmd_alloc_status(args) -> int:
     for task, state in (a.get("task_states") or {}).items():
         print(f"\nTask \"{task}\" is \"{state['state']}\"" +
               (" (failed)" if state.get("failed") else ""))
+    if getattr(args, "stats", False):
+        # live task-level ResourceUsage from the owning client's
+        # sampler (ISSUE 13)
+        try:
+            st = c.alloc_stats(a["id"])
+        except ApiError as e:
+            print(f"\nResource Utilization\n  unavailable: {e}")
+            st = None
+        if st is not None and st.get("stats"):
+            usage = st["stats"]
+            mib = 1024.0 * 1024.0
+            rows = []
+            for task, tu in sorted((usage.get("Tasks") or {}).items()):
+                ru = tu.get("ResourceUsage") or {}
+                cpu = (ru.get("CpuStats") or {})
+                memst = (ru.get("MemoryStats") or {})
+                rows.append([task,
+                             f"{cpu.get('Percent', 0.0):.1f}%",
+                             f"{memst.get('RSS', 0) / mib:.1f} MiB"])
+            print("\nResource Utilization")
+            _print_rows(rows, ["Task", "CPU", "Memory (RSS)"])
+        elif st is not None:
+            print("\nResource Utilization\n  no live usage reported "
+                  "(sampler disabled or alloc not running)")
     metrics = a.get("metrics")
     if metrics and metrics.get("score_meta_data"):
         print("\nPlacement Metrics")
@@ -1111,7 +1165,18 @@ def cmd_operator_debug(args) -> int:
             lambda: c.metrics(format="prometheus").encode())
     try_add("scheduler-config.json", c.scheduler_config)
     try_add("nomad/jobs.json", c.list_jobs)
-    try_add("nomad/nodes.json", c.list_nodes)
+    # per-node live host stats (ISSUE 13): each reachable client's
+    # HostStats + its retained client-side ring ride the bundle, so a
+    # ticket carries the fleet's host truth, not just server state
+    try:
+        nodes = c.list_nodes()
+        add("nomad/nodes.json", nodes)
+        for n in nodes:
+            try_add(f"nomad/client-stats/{n['id'][:8]}.json",
+                    lambda nid=n["id"]: c.client_host_stats(
+                        nid, history=True))
+    except Exception as e:
+        add("nomad/nodes.json.error", {"error": str(e)})
     try_add("nomad/allocations.json", c.list_allocations)
     try_add("nomad/deployments.json", c.list_deployments)
     try_add("nomad/volumes.json", c.list_volumes)
@@ -1331,6 +1396,36 @@ def cmd_operator_top(args) -> int:
                   f"over {flat.get('windows_measured', 0)} windows)")
     except ApiError:
         pass
+
+    # cluster rollup (ISSUE 13): fleet economics folded from the
+    # clients' heartbeat host-stats payloads — allocated is what the
+    # scheduler bin-packed, used is what the hosts actually burned
+    nt = tail_vals(series, "cluster.nodes_total")
+    if nt:
+        def clast(name):
+            vals = tail_vals(series, f"cluster.{name}")
+            return vals[-1] if vals else 0.0
+        print()
+        print("Cluster:")
+        print(f"  nodes              = {clast('nodes_total'):.0f} total, "
+              f"{clast('nodes_ready'):.0f} ready, "
+              f"{clast('nodes_down'):.0f} down "
+              f"({clast('nodes_reporting'):.0f} reporting stats, "
+              f"{clast('stale_heartbeats'):.0f} stale)")
+        print(f"  fleet cpu          = "
+              f"{clast('fleet_cpu_allocated_ratio'):.1%} allocated, "
+              f"{clast('fleet_cpu_used_ratio'):.1%} used of "
+              f"{clast('fleet_cpu_capacity_mhz'):.0f} MHz")
+        print(f"  fleet memory       = "
+              f"{clast('fleet_mem_allocated_ratio'):.1%} allocated, "
+              f"{clast('fleet_mem_used_ratio'):.1%} used of "
+              f"{clast('fleet_mem_capacity_mb'):.0f} MiB")
+        if tail_vals(series, "cluster.node_cpu_pct_p50"):
+            print(f"  node utilization   = cpu p50 "
+                  f"{clast('node_cpu_pct_p50'):.1f}% / p99 "
+                  f"{clast('node_cpu_pct_p99'):.1f}%, mem p50 "
+                  f"{clast('node_mem_ratio_p50'):.1%} / p99 "
+                  f"{clast('node_mem_ratio_p99'):.1%}")
 
     # recent per-stage share: p50 x reservoir occupancy approximates
     # each stage's recent seconds (reservoirs hold the last 2048
@@ -1872,6 +1967,9 @@ def build_parser() -> argparse.ArgumentParser:
     node = sub.add_parser("node", help="node commands").add_subparsers(dest="sub")
     nstatus = node.add_parser("status")
     nstatus.add_argument("node_id", nargs="?")
+    nstatus.add_argument("-stats", action="store_true",
+                         help="include live host resource usage from "
+                              "the client's stats sampler")
     nstatus.set_defaults(fn=cmd_node_status)
     nelig = node.add_parser("eligibility")
     nelig.add_argument("node_id")
@@ -1891,6 +1989,8 @@ def build_parser() -> argparse.ArgumentParser:
     alloc = sub.add_parser("alloc").add_subparsers(dest="sub")
     astatus = alloc.add_parser("status")
     astatus.add_argument("alloc_id")
+    astatus.add_argument("-stats", action="store_true",
+                         help="include live task-level resource usage")
     astatus.set_defaults(fn=cmd_alloc_status)
     alogs = alloc.add_parser("logs")
     alogs.add_argument("alloc_id")

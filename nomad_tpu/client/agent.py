@@ -85,6 +85,13 @@ class ClientConfig:
     # host would pay three metadata-timeout round trips per agent
     # start; NOMAD_CLOUD_FINGERPRINT=1 or the agent config turns it on
     cloud_fingerprint: bool = False
+    # host/alloc stats sampler (client/stats.py, ISSUE 13): cadence of
+    # the /proc + driver-stats sample loop and the retained ring's
+    # depth per series. 0 disables the sampler entirely
+    # (NOMAD_TPU_CLIENT_STATS=0 is the runtime kill switch) — no ring,
+    # no stats heartbeat payload, stats routes report the node dark
+    stats_sample_interval_s: float = 1.0
+    stats_ring_slots: int = 128
 
 
 def fingerprint_accelerator_devices():
@@ -121,11 +128,17 @@ class TaskRunner:
                  attached: Optional[TaskHandle] = None,
                  node=None, alloc_dir=None, derive_vault=None,
                  vault=None, attached_vault_lease: Optional[dict] = None,
-                 volume_sources: Optional[Dict[str, str]] = None):
+                 volume_sources: Optional[Dict[str, str]] = None,
+                 stats_poll: bool = True):
         self.alloc = alloc
         self.task = task
         self.driver = driver
         self.on_update = on_update
+        # legacy per-task gauge poll: superseded by the client's
+        # HostStatsCollector pull (ISSUE 13) — only armed when no
+        # collector covers this task (kill switch / harness callers),
+        # so a node never pays BOTH a poll thread and the pull
+        self.stats_poll = stats_poll
         self.node = node
         self.alloc_dir = alloc_dir
         self.derive_vault = derive_vault
@@ -344,7 +357,11 @@ class TaskRunner:
     def _start_stats_poll(self, handle) -> None:
         """Task resource gauges while the task runs (task_runner.go
         :1297-1370 emitStats -> nomad.client.allocs.* gauges), fed by
-        the driver's executor stats when it has one."""
+        the driver's executor stats when it has one. Skipped when the
+        client's HostStatsCollector already pulls this driver's stats
+        (stats_poll=False): one reader per task, not two."""
+        if not self.stats_poll:
+            return
         stats_fn = getattr(self.driver, "stats", None)
         if stats_fn is None:
             return
@@ -479,6 +496,8 @@ class AllocRunner:
         self.node = node
         self.client = client              # alloc-watcher context
         self.task_runners: List[TaskRunner] = []
+        # the collector's pull supersedes per-task poll threads
+        self._stats_poll = getattr(client, "host_stats", None) is None
         self.client_status = ALLOC_CLIENT_PENDING
         self.deployment_status = alloc.deployment_status
         self._l = threading.Lock()
@@ -538,7 +557,8 @@ class AllocRunner:
                             vault=self.vault,
                             attached_vault_lease=(attached_leases or {})
                             .get(task.name),
-                            volume_sources=self.volume_sources)
+                            volume_sources=self.volume_sources,
+                            stats_poll=self._stats_poll)
             self.task_runners.append(tr)
         # previous-alloc watcher (client/allocwatcher): a replacement
         # with a sticky/migrating ephemeral disk waits for its
@@ -819,6 +839,19 @@ class Client:
             else:
                 self.node.attributes.update(fp)
         self.runners: Dict[str, AllocRunner] = {}
+        # host/alloc stats sampler (ISSUE 13): built here so tests can
+        # drive sample_once() before start(); the thread starts in
+        # start(). Kill switch (env or interval=0) builds nothing —
+        # the degenerate path is the pre-stats client
+        self.host_stats = None
+        from . import stats as client_stats
+        if client_stats.enabled() and \
+                self.config.stats_sample_interval_s > 0:
+            self.host_stats = client_stats.HostStatsCollector(
+                client=self,
+                interval_s=self.config.stats_sample_interval_s,
+                slots=self.config.stats_ring_slots,
+                alloc_dir=self.config.alloc_dir)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._seen_index = 0
@@ -925,6 +958,11 @@ class Client:
         if docker is not None and hasattr(docker, "start_reconciler"):
             # orphan-container sweep (drivers/docker/reconciler.go)
             docker.start_reconciler(lambda: set(self.runners))
+        if self.host_stats is not None:
+            # prime one sample synchronously so the first heartbeat
+            # already carries a stats payload, then background-sample
+            self.host_stats.sample_once()
+            self.host_stats.start()
         t1 = threading.Thread(target=self._heartbeat_loop, daemon=True)
         t2 = threading.Thread(target=self._watch_allocs, daemon=True)
         self._threads = [t1, t2]
@@ -1005,6 +1043,8 @@ class Client:
         tasks running and re-attaches after restart)."""
         self._stop.set()
         self.vault_renewer.stop()
+        if self.host_stats is not None:
+            self.host_stats.stop()
         if self.csi_manager is not None:
             self.csi_manager.shutdown()
         if kill_tasks:
@@ -1034,7 +1074,14 @@ class Client:
         interval = self.config.heartbeat_interval_s
         while not self._stop.is_set():
             try:
-                ttl = self.transport.heartbeat(self.node.id)
+                # the heartbeat doubles as the host-stats uplink: a
+                # compact summary (~8 floats) rides every beat so the
+                # server folds fleet economics without a scrape
+                # fan-out (node_endpoint.go UpdateStatus analog)
+                stats = self.host_stats.summary() \
+                    if self.host_stats is not None else None
+                ttl = self.transport.heartbeat(self.node.id,
+                                               stats=stats or None)
                 # renew at half the granted TTL (client/client.go heartbeats
                 # inside the server-granted TTL window, never beyond it)
                 interval = min(self.config.heartbeat_interval_s, ttl / 2.0)

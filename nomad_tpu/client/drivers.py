@@ -308,6 +308,31 @@ class RawExecDriver:
         threading.Thread(target=poll, daemon=True).start()
         return h
 
+    def stats(self, handle: TaskHandle) -> Dict[str, float]:
+        """Resource usage from /proc/<pid> (the unprivileged analog of
+        executor Stats(): raw_exec has no cgroup, so RSS comes from
+        statm and cpu from utime+stime). Feeds the client host-stats
+        sampler's per-alloc ResourceUsage (ISSUE 13)."""
+        proc = handle.proc
+        pid = proc.pid if proc is not None \
+            else getattr(handle, "_recovered_pid", None)
+        if not pid or handle.done():
+            return {}
+        import os
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                rss_pages = int(f.read().split()[1])
+            with open(f"/proc/{pid}/stat") as f:
+                fields = f.read().rsplit(")", 1)[1].split()
+            # fields after comm: index 11/12 are utime/stime in ticks
+            ticks = float(fields[11]) + float(fields[12])
+        except (OSError, IndexError, ValueError):
+            return {}
+        hz = os.sysconf("SC_CLK_TCK") or 100
+        page = os.sysconf("SC_PAGE_SIZE") or 4096
+        return {"memory_bytes": float(rss_pages * page),
+                "cpu_total_ns": ticks / hz * 1e9}
+
 
 class ExecDriver(RawExecDriver):
     """drivers/exec: fork/exec with cgroup resource limits and a

@@ -184,6 +184,40 @@ class ClientRpcService:
                 tr._force_restart = False
         return {"restarted": restarted}
 
+    # -- host/alloc stats (command/agent/stats_endpoint.go +
+    # client/alloc_endpoint.go Stats — ISSUE 13) -----------------------
+    def stats_host(self, args: Dict) -> Dict:
+        """This node's latest HostStats sample; reports the sampler
+        dark (enabled: False) under the kill switch instead of erroring
+        — a fleet-wide poller must distinguish 'off' from 'down'."""
+        hs = getattr(self.client, "host_stats", None)
+        if hs is None:
+            return {"enabled": False}
+        out = hs.host_stats()
+        out["enabled"] = True
+        if args.get("history"):
+            out["history"] = hs.history(
+                last=int(args.get("n", 0)) or None)
+        return out
+
+    def stats_alloc(self, args: Dict) -> Dict:
+        hs = getattr(self.client, "host_stats", None)
+        if hs is None:
+            return {"enabled": False, "stats": None}
+        stats = hs.alloc_stats(args["alloc_id"])
+        if stats is None:
+            # distinguish "not on this node" (a real routing error)
+            # from "running but no usage reported" (driver without a
+            # stats() hook, or the first sample hasn't landed): the
+            # latter answers cleanly with stats: None — the shape the
+            # CLI renders as "no live usage reported"
+            aid = args["alloc_id"]
+            if not any(rid.startswith(aid)
+                       for rid in self.client.runners):
+                raise KeyError(
+                    f"alloc {aid[:8]} not on this node")
+        return {"enabled": True, "stats": stats}
+
     # -- the method table ---------------------------------------------
     def rpc_methods(self) -> Dict:
         return {
@@ -196,4 +230,6 @@ class ClientRpcService:
             "ClientExec.Stop": self.exec_stop,
             "ClientAlloc.Signal": self.alloc_signal,
             "ClientAlloc.Restart": self.alloc_restart,
+            "ClientStats.Host": self.stats_host,
+            "ClientStats.Alloc": self.stats_alloc,
         }

@@ -53,7 +53,8 @@ def build_method_table(server) -> Dict[str, Any]:
         return {}
 
     def node_heartbeat(args):
-        return {"ttl_s": server.heartbeat(args["node_id"])}
+        return {"ttl_s": server.heartbeat(args["node_id"],
+                                          stats=args.get("stats"))}
 
     def node_update_alloc(args):
         allocs = [from_wire(Allocation, a) for a in args["allocs"]]

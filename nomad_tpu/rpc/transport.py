@@ -25,7 +25,10 @@ class ServerTransport:
     def update_node_status(self, node_id: str, status: str) -> None:
         raise NotImplementedError
 
-    def heartbeat(self, node_id: str) -> float:
+    def heartbeat(self, node_id: str,
+                  stats: Optional[dict] = None) -> float:
+        """TTL renewal; `stats` is the optional compact host-stats
+        summary the server folds into its cluster rollup (ISSUE 13)."""
         raise NotImplementedError
 
     def get_client_allocs(self, node_id: str, min_index: int,
@@ -84,8 +87,9 @@ class InProcTransport(ServerTransport):
     def update_node_status(self, node_id: str, status: str) -> None:
         self.server.update_node_status(node_id, status)
 
-    def heartbeat(self, node_id: str) -> float:
-        return self.server.heartbeat(node_id)
+    def heartbeat(self, node_id: str,
+                  stats: Optional[dict] = None) -> float:
+        return self.server.heartbeat(node_id, stats=stats)
 
     def get_client_allocs(self, node_id: str, min_index: int,
                           max_wait_s: float
@@ -135,9 +139,12 @@ class RemoteTransport(ServerTransport):
         self.rpc.call("Node.UpdateStatus",
                       {"node_id": node_id, "status": status})
 
-    def heartbeat(self, node_id: str) -> float:
-        return float(self.rpc.call("Node.Heartbeat",
-                                   {"node_id": node_id})["ttl_s"])
+    def heartbeat(self, node_id: str,
+                  stats: Optional[dict] = None) -> float:
+        args = {"node_id": node_id}
+        if stats:
+            args["stats"] = stats
+        return float(self.rpc.call("Node.Heartbeat", args)["ttl_s"])
 
     def get_client_allocs(self, node_id: str, min_index: int,
                           max_wait_s: float

@@ -23,6 +23,8 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from ..models import (
     Allocation, Evaluation, Job, Node,
     EVAL_STATUS_FAILED, EVAL_STATUS_PENDING,
@@ -36,6 +38,7 @@ from ..models.evaluation import (
     CORE_JOB_JOB_GC, CORE_JOB_NODE_GC, TRIGGER_SCHEDULED,
 )
 from ..state import StateStore
+from ..utils import metrics
 from ..utils.timetable import TimeTable
 from .blocked_evals import BlockedEvals
 from .deployment_watcher import (
@@ -89,6 +92,11 @@ class ServerConfig:
     # effect, so off by default; the CLI agent turns it on.
     gc_safepoints: bool = False
     heartbeat_ttl_s: float = 10.0
+    # cluster rollup staleness (ISSUE 13): a node whose heartbeat
+    # host-stats payload is older than this counts as a stale
+    # heartbeat in the cluster.* series and drops out of the fleet
+    # used-vs-allocated economics (its capacity still counts)
+    stats_stale_after_s: float = 30.0
     failed_eval_unblock_delay_s: float = 60.0
     dev_mode: bool = True
     data_dir: str = ""              # empty == in-memory only
@@ -384,14 +392,19 @@ class Server:
                 latency_fn=(None if gov is None
                             else gov.latency_percentile_ms),
                 stage_fn=_flight.stage_percentiles,
-                # device-mirror residency reads through self.store:
-                # the table cache is replaced on snapshot restore
-                extra_fn=lambda: {
-                    "device.mirror_bytes":
-                    self.store.table_cache.device_mirror_bytes()})
+                # device-mirror residency + the cluster.* rollup
+                # (ISSUE 13) read through self (the table cache is
+                # replaced on snapshot restore)
+                extra_fn=self._telemetry_extra)
         self.workers: List[Worker] = []
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
         self._hb_lock = threading.Lock()
+        # per-node host-stats payloads carried by heartbeats (ISSUE
+        # 13): node_id -> {payload..., received_at}; folded into the
+        # cluster.* rollup by cluster_stats(), pruned when the node
+        # record disappears
+        self._node_stats: Dict[str, dict] = {}
+        self._node_stats_l = threading.Lock()
         self._leader = False
         self._member_l = threading.Lock()   # join/leave RMW serialization
         # serializes enforced (-check-index) registrations: the CAS
@@ -2493,12 +2506,138 @@ class Server:
         LOG.warning("node %s missed heartbeat, marking down", node_id[:8])
         self.update_node_status(node_id, NODE_STATUS_DOWN)
 
-    def heartbeat(self, node_id: str) -> float:
-        """Client TTL renewal; returns the TTL."""
+    def heartbeat(self, node_id: str,
+                  stats: Optional[dict] = None) -> float:
+        """Client TTL renewal; returns the TTL. `stats` is the compact
+        host-stats summary the client sampler attaches (ISSUE 13) —
+        stashed per node for cluster_stats() to fold; O(1) per beat,
+        the rollup itself runs at telemetry cadence, not here."""
         node = self.store.node_by_id(node_id)
         if node is None:
             raise KeyError(f"node {node_id} not registered")
+        if stats:
+            with self._node_stats_l:
+                self._node_stats[node_id] = {
+                    **stats, "received_at": time.time()}
         if node.status != NODE_STATUS_READY:
             self.update_node_status(node_id, NODE_STATUS_READY)
         self.reset_heartbeat_timer(node_id)
         return self.config.heartbeat_ttl_s
+
+    # -- cluster rollup (ISSUE 13) -------------------------------------
+    def _telemetry_extra(self) -> Dict[str, float]:
+        """The telemetry collector's extra_fn: device-mirror residency
+        plus the cluster.* family, so fleet economics land in the
+        retained ring every sample."""
+        out: Dict[str, float] = {
+            "device.mirror_bytes":
+            self.store.table_cache.device_mirror_bytes()}
+        for k, v in self.cluster_stats().items():
+            out[f"cluster.{k}"] = v
+        return out
+
+    def cluster_stats(self) -> Dict[str, float]:
+        """Fold per-node heartbeat host-stats into fleet economics:
+        nodes up/down, capacity vs ALLOCATED (bin-packed, from the
+        resident columnar node table) vs actually USED (host truth,
+        from the heartbeat payloads), per-node utilization p50/p99,
+        stale-heartbeat count. Pure host reads — O(nodes) numpy sums;
+        also mirrors the family into the metrics registry so
+        /v1/metrics?format=prometheus exposes nomad.cluster.*."""
+        snap = self.store.snapshot()
+        nodes = snap.nodes()
+        now = time.time()
+        with self._node_stats_l:
+            # prune payloads for nodes the store no longer knows
+            known = {n.id for n in nodes}
+            for nid in list(self._node_stats):
+                if nid not in known:
+                    del self._node_stats[nid]
+            stats = dict(self._node_stats)
+        out: Dict[str, float] = {
+            "nodes_total": float(len(nodes)),
+            "nodes_ready": float(sum(1 for n in nodes if n.ready())),
+            "nodes_down": float(sum(
+                1 for n in nodes if n.status == NODE_STATUS_DOWN)),
+            "nodes_reporting": 0.0,
+            "stale_heartbeats": 0.0,
+        }
+        cap_cpu = cap_mem = 0.0
+        for n in nodes:
+            res = n.comparable_resources()
+            cap_cpu += res.cpu_shares
+            cap_mem += res.memory_mb
+        out["fleet_cpu_capacity_mhz"] = cap_cpu
+        out["fleet_mem_capacity_mb"] = cap_mem
+        # allocated: the resident node table's live-alloc usage sums
+        # (delta-maintained — no per-sample alloc scan). build=False:
+        # a rollup must never trigger a cold table build; before the
+        # first eval the allocated half reads 0 and catches up with
+        # the first scheduled table
+        alloc_cpu = alloc_mem = 0.0
+        table = snap.node_table(build=False)
+        if table is not None and table.n > 0:
+            alloc_cpu = float(table.base_used[:, 0].sum())
+            alloc_mem = float(table.base_used[:, 1].sum())
+        out["fleet_cpu_allocated_mhz"] = alloc_cpu
+        out["fleet_mem_allocated_mb"] = alloc_mem
+        out["fleet_cpu_allocated_ratio"] = \
+            round(alloc_cpu / cap_cpu, 4) if cap_cpu > 0 else 0.0
+        out["fleet_mem_allocated_ratio"] = \
+            round(alloc_mem / cap_mem, 4) if cap_mem > 0 else 0.0
+        # used: host truth from the heartbeat payloads — a node's
+        # host-level utilization FRACTION (cpu percent, mem
+        # used/total) scaled by its configured capacity, so both used
+        # sums stay commensurate with the capacity denominator and a
+        # host busier than its schedulable share can't push a fleet
+        # ratio past 1.0. Stale payloads drop out of the used sums
+        # (their capacity still counts: unreported usage is unknown,
+        # not 0)
+        used_cpu = used_mem = 0.0
+        cpu_pcts: List[float] = []
+        mem_ratios: List[float] = []
+        stale_after = self.config.stats_stale_after_s
+        by_id = {n.id: n for n in nodes}
+        for nid, st in stats.items():
+            if now - st.get("received_at", 0.0) > stale_after:
+                out["stale_heartbeats"] += 1.0
+                continue
+            node = by_id.get(nid)
+            if node is None:
+                continue
+            out["nodes_reporting"] += 1.0
+            res = node.comparable_resources()
+            pct = float(st.get("cpu_pct", 0.0))
+            used_cpu += pct / 100.0 * res.cpu_shares
+            cpu_pcts.append(pct)
+            total = float(st.get("mem_total_mb", 0.0))
+            if total > 0:
+                ratio = min(
+                    float(st.get("mem_used_mb", 0.0)) / total, 1.0)
+                used_mem += ratio * res.memory_mb
+                mem_ratios.append(ratio)
+        out["fleet_cpu_used_mhz"] = round(used_cpu, 1)
+        out["fleet_mem_used_mb"] = round(used_mem, 1)
+        out["fleet_cpu_used_ratio"] = \
+            round(used_cpu / cap_cpu, 4) if cap_cpu > 0 else 0.0
+        out["fleet_mem_used_ratio"] = \
+            round(used_mem / cap_mem, 4) if cap_mem > 0 else 0.0
+        if cpu_pcts:
+            arr = np.asarray(cpu_pcts)
+            out["node_cpu_pct_p50"] = round(
+                float(np.percentile(arr, 50)), 3)
+            out["node_cpu_pct_p99"] = round(
+                float(np.percentile(arr, 99)), 3)
+        if mem_ratios:
+            arr = np.asarray(mem_ratios)
+            out["node_mem_ratio_p50"] = round(
+                float(np.percentile(arr, 50)), 4)
+            out["node_mem_ratio_p99"] = round(
+                float(np.percentile(arr, 99)), 4)
+        for k in ("nodes_total", "nodes_ready", "nodes_down",
+                  "nodes_reporting", "stale_heartbeats",
+                  "fleet_cpu_used_ratio", "fleet_mem_used_ratio",
+                  "fleet_cpu_allocated_ratio",
+                  "fleet_mem_allocated_ratio"):
+            metrics.set_gauge(f"nomad.cluster.{k}", out[k])
+        return out

@@ -152,6 +152,20 @@ def test_bench_py_emits_json_line_on_cpu():
     assert data["mesh_delta_scatters"] >= 0
     assert data["mesh_reupload_bytes"] < \
         data["mesh_dense_bytes_per_dispatch_off"]
+    # cluster workload observability (ISSUE 13): real client agents
+    # with the stats sampler on ran a job inside the ladder; the
+    # artifact carries the fleet economics — nodes reporting host
+    # stats via heartbeat, memory genuinely used on the hosts, and
+    # the scheduler's allocated share from the resident node table
+    # (cpu used can honestly be ~0 on an idle CI host, so only its
+    # range is asserted)
+    assert data["cluster_nodes"] > 0
+    assert data["cluster_nodes_reporting"] == data["cluster_nodes"]
+    assert data["cluster_stale_heartbeats"] == 0
+    assert 0.0 <= data["fleet_cpu_used_ratio"] <= 1.0
+    assert 0.0 < data["fleet_mem_used_ratio"] < 1.0
+    assert data["fleet_cpu_allocated_ratio"] > 0.0
+    assert data["fleet_mem_allocated_ratio"] > 0.0
     # cold-start recovery (ISSUE 8): the columnar snapshot + primed
     # table + batched replay must beat the legacy object-snapshot
     # restore by >= 3x at the same scale (measured ~8x at quick scale;

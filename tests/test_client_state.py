@@ -223,7 +223,7 @@ def test_heartbeatstop_stops_marked_allocs():
     class FlakyTransport(InProcTransport):
         fail = False
 
-        def heartbeat(self, node_id):
+        def heartbeat(self, node_id, stats=None):
             if self.fail:
                 raise ConnectionError("servers unreachable")
             return 0.2    # tiny TTL so the test is fast

@@ -308,7 +308,18 @@ class ServerConfig:
     telemetry_orphan_slots: int = 21
     mesh_documented_resident: bool = True
     mesh_orphan_debt_high: int = 23
+    stats_documented_stale: float = 30.0
+    stats_orphan_stale: float = 31.0
     other_knob: int = 1
+"""
+
+# ClientConfig knobs joined the contract (ISSUE 13: the client stats
+# sampler's knobs live on ClientConfig, not ServerConfig)
+FIXTURE_CLIENT_CONFIG = """\
+class ClientConfig:
+    stats_documented_interval_s: float = 1.0
+    stats_orphan_slots: int = 128
+    poll_interval_s: float = 0.2
 """
 
 
@@ -317,12 +328,14 @@ class TestSurfaceDrift:
                    reference_dirs=("nomad_tpu/cli", "tests"),
                    reference_files=(),
                    config_path="nomad_tpu/server/core.py",
+                   client_config_path="nomad_tpu/client/agent.py",
                    status_path="STATUS.md")
 
     def files(self, cli_src, status):
         return {"nomad_tpu/api/http.py": FIXTURE_HTTP,
                 "nomad_tpu/cli/main.py": cli_src,
                 "nomad_tpu/server/core.py": FIXTURE_CONFIG,
+                "nomad_tpu/client/agent.py": FIXTURE_CLIENT_CONFIG,
                 "STATUS.md": status}
 
     def test_unreferenced_route_and_undocumented_knob(self):
@@ -337,6 +350,8 @@ class TestSurfaceDrift:
                            "preempt_documented_rows and "
                            "telemetry_documented_slots and "
                            "mesh_documented_resident and "
+                           "stats_documented_stale and "
+                           "stats_documented_interval_s and "
                            "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
@@ -367,6 +382,11 @@ class TestSurfaceDrift:
         # mesh_* knobs joined the contract (ISSUE 12: sharded-residency
         # knobs must land in the STATUS.md knob table)
         me_f = [f for f in out if "mesh_orphan_debt_high" in f.message]
+        # stats_* knobs joined the contract (ISSUE 13) — on BOTH
+        # config classes: the rollup knob on ServerConfig, the client
+        # sampler knobs on ClientConfig
+        ss_f = [f for f in out if "stats_orphan_stale" in f.message]
+        sc_f = [f for f in out if "stats_orphan_slots" in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
@@ -379,6 +399,9 @@ class TestSurfaceDrift:
         assert len(pr_f) == 1
         assert len(tm_f) == 1
         assert len(me_f) == 1
+        assert len(ss_f) == 1
+        assert len(sc_f) == 1
+        assert "ClientConfig.stats_orphan_slots" in sc_f[0].message
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
                        for f in out)
@@ -399,6 +422,10 @@ class TestSurfaceDrift:
         assert not any("telemetry_documented_slots" in f.message
                        for f in out)
         assert not any("mesh_documented_resident" in f.message
+                       for f in out)
+        assert not any("stats_documented_stale" in f.message
+                       for f in out)
+        assert not any("stats_documented_interval_s" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -424,7 +451,11 @@ class TestSurfaceDrift:
                            "telemetry_documented_slots, "
                            "telemetry_orphan_slots, "
                            "mesh_documented_resident, "
-                           "mesh_orphan_debt_high")
+                           "mesh_orphan_debt_high, "
+                           "stats_documented_stale, "
+                           "stats_orphan_stale, "
+                           "stats_documented_interval_s, "
+                           "stats_orphan_slots")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
