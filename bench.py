@@ -110,6 +110,10 @@ def main() -> None:
     # runtime sanitizer's kernel-boundary guards were armed
     from nomad_tpu.analysis.sanitizer import enabled as _sanitize_on
     out["sanitizer"] = "on" if _sanitize_on() else "off"
+    # runtime race sanitizer engagement (ISSUE 14): governed runs must
+    # record whether the lock shims were instrumenting the process
+    from nomad_tpu.analysis.race import enabled as _race_on
+    out["race"] = "on" if _race_on() else "off"
     # micro-batch gateway engagement must be attributable per round
     # (ISSUE 7): record whether the env kill switch disabled it
     out["microbatch"] = ("off" if os.environ.get(

@@ -1,25 +1,32 @@
-"""TPU-hygiene static analysis + runtime sanitizer.
+"""TPU-hygiene static analysis + runtime sanitizers.
 
 Static: `python -m nomad_tpu.analysis [paths]` / `nomad-tpu dev lint`
-runs five AST passes (engine.py, passes.py) enforcing the steady-state
-invariants — host-sync discipline, jit hygiene, dtype discipline,
-lock order/scope, surface drift — with inline
+runs seven AST passes (engine.py, passes.py, concurrency.py) enforcing
+the steady-state invariants — host-sync discipline, jit hygiene, dtype
+discipline, interprocedural lock order/scope, thread-shared state
+guarding, factory-only lock construction, surface drift — with inline
 `# nomad-lint: allow[rule]` suppressions and non-zero exit on
 findings.
 
 Runtime: `NOMAD_TPU_SANITIZE=1` (sanitizer.py) adds NaN/Inf and
 out-of-bounds-row guards at the placement and scatter-delta kernel
 boundaries, and the always-on trace-signature counter feeds the
-`nomad.lint.recompiles` governor gauge.
+`nomad.lint.recompiles` governor gauge. `NOMAD_TPU_RACE=1` (race.py,
+via the utils/locks.py factory) swaps every lock for instrumented
+shims: acquisition-order deadlock detection, hold/contention
+accounting behind the governor's `lock.*` gauges, and
+guarded-structure mutation checks.
 """
 
 from .engine import FileContext, Finding, Project, Rule, run
-from .passes import (DtypeRule, HostSyncRule, JitHygieneRule, LockRule,
+from .concurrency import LockRule, RawLockRule, SharedStateRule
+from .passes import (DtypeRule, HostSyncRule, JitHygieneRule,
                      SurfaceDriftRule, default_rules)
-from . import sanitizer
+from . import race, sanitizer
 
 __all__ = [
     "FileContext", "Finding", "Project", "Rule", "run",
     "HostSyncRule", "JitHygieneRule", "DtypeRule", "LockRule",
-    "SurfaceDriftRule", "default_rules", "sanitizer",
+    "SharedStateRule", "RawLockRule", "SurfaceDriftRule",
+    "default_rules", "race", "sanitizer",
 ]

@@ -1,0 +1,772 @@
+"""Concurrency lint passes: the static half of ISSUE 14.
+
+The original `lock-discipline` pass saw exactly one call deep, so a
+blocking call two helpers down — or a lock-order inversion routed
+through a helper — was invisible (the r16 `_SHARED_SHARDED` race and
+the r17 half-updated heartbeat sample both slipped through exactly
+this gap). Three passes now share a project-wide call graph:
+
+  lock-discipline  (interprocedural) `with <lock>` nesting edges PLUS
+                   edges discovered by chasing calls made under a held
+                   lock through the call graph (depth ``DEPTH``): a
+                   helper that acquires a lock, called while another
+                   is held, orders those locks. Cross-file cycle
+                   detection and blocking-call/dispatch-under-lock run
+                   on the expanded graph, with the offending call
+                   chain named in the finding.
+  shared-state     attributes mutated non-atomically BOTH from code
+                   reachable from a `threading.Thread` target and from
+                   request/eval paths must share a lock.
+                   `# nomad-lint: guarded-by[<lock attr>]` on the
+                   attribute's init line declares intent: every
+                   non-init mutation must then hold THAT lock. Plain
+                   rebinding (`self.x = v`) is a GIL-atomic publish
+                   and stays out of the heuristic; AugAssign,
+                   subscript stores, and mutator method calls are the
+                   read-modify-write shapes that race.
+  raw-lock         `threading.Lock/RLock/Condition()` may only be
+                   constructed in `utils/locks.py` (and the
+                   instrumentation itself) — the factory is what lets
+                   `NOMAD_TPU_RACE=1` swap in the runtime shims.
+
+All three report through ctx.finding(), so inline
+`# nomad-lint: allow[rule]` suppressions are honored uniformly.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import FileContext, Finding, Project, Rule, attr_chain, \
+    call_name
+
+# lock-name heuristics shared with the original pass
+_LOCK_SUFFIXES = ("_l", "_lock", "lock", "_cv", "_mu", "_mutex",
+                  "_watch", "_cond")
+
+# direct calls that block or dispatch while a lock is held
+_DISPATCH_CALLS = ("jax.device_put", "jax.device_get", "time.sleep")
+_DISPATCH_SUFFIXES = (".block_until_ready", ".select_many", ".result",
+                      ".urlopen")
+
+# call-graph chase depth from a lock-holding call site (tentpole:
+# "depth >= 3" — a helper chain of three frames is still seen)
+DEPTH = 4
+
+GUARDED_BY_RE = re.compile(
+    r"#\s*nomad-lint:\s*guarded-by\[([A-Za-z0-9_.]+)\]")
+_COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+def _is_lock_name(chain: str) -> bool:
+    last = chain.split(".")[-1]
+    return any(last == s or last.endswith(s) for s in _LOCK_SUFFIXES)
+
+
+def _is_dispatch_name(name: str) -> bool:
+    if name in _DISPATCH_CALLS:
+        return True
+    return any(name.endswith(s) for s in _DISPATCH_SUFFIXES)
+
+
+# ---------------------------------------------------------------------
+# function summaries + call graph
+
+class _FnInfo:
+    """One analyzed function/method: its lock structure and call
+    sites, enough for the cross-file passes to chase."""
+
+    __slots__ = ("path", "cls", "name", "node", "ctx", "acquires",
+                 "calls", "held_sites", "direct_dispatch")
+
+    def __init__(self, path: str, cls: Optional[str], name: str,
+                 node, ctx: FileContext):
+        self.path = path
+        self.cls = cls
+        self.name = name
+        self.node = node
+        self.ctx = ctx
+        self.acquires: Set[str] = set()       # lock ids `with`-taken
+        # (held lock ids at site, callee ref or dispatch name, node,
+        #  lock ids explicitly .release()d before this site — the
+        #  "release the cv around the dispatch" idiom is understood,
+        #  not suppressed)
+        self.held_sites: List[Tuple[Tuple[str, ...], object,
+                                    ast.AST, frozenset]] = []
+        self.calls: List[Tuple[object, ast.AST, frozenset]] = []
+        # (dispatch name, lock ids released before it) or None
+        self.direct_dispatch: Optional[Tuple[str, frozenset]] = None
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+def _lock_id(chain: str, cls: Optional[str], path: str) -> str:
+    attr = chain.split(".", 1)[1] if "." in chain else chain
+    owner = cls if cls is not None and chain.startswith("self.") \
+        else path
+    return f"{owner}.{attr}"
+
+
+def _callee_ref(name: str, cls: Optional[str]):
+    """Resolvable callee reference for a call-name, or None when the
+    target is too dynamic to chase. `self.foo()` resolves by class
+    name across files (matching the lock-id convention); bare `foo()`
+    resolves to a module-level function in the same file."""
+    if name.startswith("self.") and "." not in name[5:]:
+        if cls is not None:
+            return ("method", cls, name[5:])
+        return None
+    if "." not in name:
+        return ("func", name)
+    return None
+
+
+class _CallGraph:
+    """Project-wide index of function summaries, built by the rules'
+    check_file passes and queried in finish()."""
+
+    def __init__(self):
+        self.fns: List[_FnInfo] = []
+        self.by_method: Dict[Tuple[str, str], List[_FnInfo]] = {}
+        self.by_func: Dict[Tuple[str, str], List[_FnInfo]] = {}
+
+    def add(self, fn: _FnInfo, resolvable: bool = True) -> None:
+        """Nested defs register unresolvable (their bare name is a
+        local binding, not a module-level callee) but their own lock
+        nesting and dispatch sites still contribute findings."""
+        self.fns.append(fn)
+        if not resolvable:
+            return
+        if fn.cls is not None:
+            self.by_method.setdefault((fn.cls, fn.name), []).append(fn)
+        else:
+            self.by_func.setdefault((fn.path, fn.name), []).append(fn)
+
+    def resolve(self, caller: _FnInfo, ref) -> List[_FnInfo]:
+        if ref is None:
+            return []
+        if ref[0] == "method":
+            return self.by_method.get((ref[1], ref[2]), [])
+        return self.by_func.get((caller.path, ref[1]), [])
+
+    # -- transitive queries (depth-limited, memoized) ------------------
+    def reach_locks(self, fn: _FnInfo, depth: int = DEPTH,
+                    _memo=None) -> Dict[str, str]:
+        """{lock id acquired in fn or its callees within depth: call
+        chain that reaches it}."""
+        if _memo is None:
+            _memo = {}
+        key = (id(fn), depth)
+        if key in _memo:
+            return _memo[key]
+        out: Dict[str, str] = {lk: fn.qualname for lk in fn.acquires}
+        _memo[key] = out                     # cycle guard
+        if depth > 0:
+            for ref, _node, _released in fn.calls:
+                for callee in self.resolve(fn, ref):
+                    for lk, chain in self.reach_locks(
+                            callee, depth - 1, _memo).items():
+                        out.setdefault(lk, f"{fn.qualname} -> {chain}")
+        return out
+
+    def reach_dispatch(self, fn: _FnInfo, depth: int = DEPTH,
+                       _memo=None
+                       ) -> Optional[Tuple[str, str, frozenset]]:
+        """(dispatch call name, chain, lock ids released on the way)
+        when fn or a callee within depth performs a device dispatch /
+        blocking call. Released locks accumulate along the chain so a
+        caller can tell a genuine hold from the release-around-
+        dispatch idiom."""
+        if _memo is None:
+            _memo = {}
+        key = (id(fn), depth)
+        if key in _memo:
+            return _memo[key]
+        _memo[key] = None                    # cycle guard
+        if fn.direct_dispatch is not None:
+            name, released = fn.direct_dispatch
+            out = (name, fn.qualname, released)
+            _memo[key] = out
+            return out
+        if depth > 0:
+            for ref, _node, released in fn.calls:
+                for callee in self.resolve(fn, ref):
+                    hit = self.reach_dispatch(callee, depth - 1, _memo)
+                    if hit is not None:
+                        out = (hit[0], f"{fn.qualname} -> {hit[1]}",
+                               released | hit[2])
+                        _memo[key] = out
+                        return out
+        return None
+
+
+def _summarize_file(ctx: FileContext, graph: _CallGraph) -> None:
+    """Walk every top-level function / class method once, recording
+    lock structure and call sites into the graph."""
+    def walk_fn(fn_node, cls: Optional[str]) -> None:
+        info = _FnInfo(ctx.path, cls, fn_node.name, fn_node, ctx)
+        held: List[str] = []
+        released: Set[str] = set()      # explicit .release() so far
+
+        def visit(node) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn_node:
+                return                  # nested defs walk separately
+            if isinstance(node, ast.With):
+                # each item joins `held` BEFORE the next is examined:
+                # `with a, b:` orders a -> b exactly like nested withs
+                # (the one-statement inversion is the same deadlock)
+                count = 0
+                for item in node.items:
+                    chain = attr_chain(item.context_expr)
+                    if chain and _is_lock_name(chain):
+                        lk = _lock_id(chain, cls, ctx.path)
+                        info.acquires.add(lk)
+                        if held:
+                            info.held_sites.append(
+                                (tuple(held), ("lock", lk), node,
+                                 frozenset(released)))
+                        held.append(lk)
+                        count += 1
+                for child in node.body:
+                    visit(child)
+                for _ in range(count):
+                    held.pop()
+                return
+            if isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                base, _, last = name.rpartition(".")
+                if last == "release" and base and _is_lock_name(base):
+                    released.add(_lock_id(base, cls, ctx.path))
+                elif last == "acquire" and base and \
+                        _is_lock_name(base):
+                    released.discard(_lock_id(base, cls, ctx.path))
+                elif _is_dispatch_name(name):
+                    if info.direct_dispatch is None:
+                        info.direct_dispatch = (name,
+                                                frozenset(released))
+                    if held:
+                        info.held_sites.append(
+                            (tuple(held), ("dispatch", name), node,
+                             frozenset(released)))
+                else:
+                    ref = _callee_ref(name, cls)
+                    if ref is not None:
+                        info.calls.append((ref, node,
+                                           frozenset(released)))
+                        if held:
+                            info.held_sites.append(
+                                (tuple(held), ("call", ref, name),
+                                 node, frozenset(released)))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fn_node.body:
+            visit(stmt)
+        graph.add(info, resolvable=resolvable)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            parent = getattr(node, "_lint_parent", None)
+            if isinstance(parent, ast.ClassDef):
+                resolvable = True
+                walk_fn(node, parent.name)
+            elif isinstance(parent, ast.Module):
+                resolvable = True
+                walk_fn(node, None)
+            else:
+                # nested def (thread closures, local helpers): still
+                # analyzed for its own lock structure, but its bare
+                # name never resolves as a callee
+                resolvable = False
+                cls = ctx.enclosing_class(node)
+                walk_fn(node, cls.name if cls is not None else None)
+
+
+# ---------------------------------------------------------------------
+class LockRule(Rule):
+    """Pass 4 (interprocedural): lock order + lock scope. Builds the
+    lock-acquisition graph from `with <lock>:` nesting AND from calls
+    made under a held lock, chased through the project call graph
+    (depth DEPTH) — `with self._l: self._refresh()` where _refresh's
+    helper's helper acquires another lock or dispatches is now
+    visible. Lock identity = Class.attr (or module.attr), so `self._l`
+    across methods and files is one node. Flags cycles (the AB/BA
+    deadlock shape) and device dispatch / blocking waits reached while
+    a lock is held, naming the call chain."""
+
+    name = "lock-discipline"
+    doc = ("no lock cycles; no dispatch/blocking call under a lock "
+           "(interprocedural)")
+
+    def __init__(self, depth: int = DEPTH):
+        self.depth = depth
+        self._graph = _CallGraph()
+        self._summarized: Set[str] = set()
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path not in self._summarized:
+            self._summarized.add(ctx.path)
+            _summarize_file(ctx, self._graph)
+        return ()
+
+    def finish(self, project: Project) -> Iterable[Finding]:
+        # lock-order edges: (src, dst) -> (ctx, node) of first sighting
+        edges: Dict[str, Dict[str, Tuple[FileContext, ast.AST]]] = {}
+        lock_memo: dict = {}
+        dispatch_memo: dict = {}
+
+        def add_edge(src: str, dst: str, ctx: FileContext,
+                     node) -> None:
+            if src == dst:
+                return
+            dsts = edges.setdefault(src, {})
+            if dst not in dsts:
+                dsts[dst] = (ctx, node)
+
+        for fn in self._graph.fns:
+            for held, what, node, released in fn.held_sites:
+                still_held = [l for l in held if l not in released]
+                if what[0] == "lock":
+                    for outer in still_held:
+                        add_edge(outer, what[1], fn.ctx, node)
+                elif what[0] == "dispatch":
+                    if not still_held:
+                        continue        # release-around-dispatch
+                    yield fn.ctx.finding(
+                        self.name, node,
+                        f"`{what[1]}` under lock {still_held[-1]}: "
+                        f"device dispatch / blocking call while "
+                        f"holding a lock serializes every other "
+                        f"acquirer behind the device round trip")
+                else:                       # ("call", ref, name)
+                    _tag, ref, cname = what
+                    reported = False
+                    for callee in self._graph.resolve(fn, ref):
+                        hit = self._graph.reach_dispatch(
+                            callee, self.depth - 1, dispatch_memo)
+                        if hit is not None and not reported:
+                            gone = released | hit[2]
+                            live = [l for l in held if l not in gone]
+                            if live:
+                                reported = True
+                                yield fn.ctx.finding(
+                                    self.name, node,
+                                    f"`{cname}()` under lock "
+                                    f"{live[-1]} reaches `{hit[0]}` "
+                                    f"(via {hit[1]}): device dispatch"
+                                    f" / blocking call while holding "
+                                    f"a lock")
+                        for lk, chain in self._graph.reach_locks(
+                                callee, self.depth - 1,
+                                lock_memo).items():
+                            for outer in still_held:
+                                add_edge(outer, lk, fn.ctx, node)
+
+        yield from self._cycles(edges)
+
+    def _cycles(self, edges) -> Iterable[Finding]:
+        seen_cycles: Set[frozenset] = set()
+        for start in sorted(edges):
+            path: List[str] = []
+            on_path: Set[str] = set()
+            visited: Set[str] = set()
+
+            def dfs(node: str) -> Optional[List[str]]:
+                if node in on_path:
+                    return path[path.index(node):] + [node]
+                if node in visited:
+                    return None
+                visited.add(node)
+                on_path.add(node)
+                path.append(node)
+                for nxt in sorted(edges.get(node, {})):
+                    cyc = dfs(nxt)
+                    if cyc is not None:
+                        return cyc
+                path.pop()
+                on_path.discard(node)
+                return None
+
+            cyc = dfs(start)
+            if cyc is None:
+                continue
+            key = frozenset(cyc)
+            if key in seen_cycles:
+                continue
+            seen_cycles.add(key)
+            a, b = cyc[0], cyc[1]
+            ctx, node = edges[a][b]
+            yield ctx.finding(
+                self.name, node,
+                f"lock-order cycle: {' -> '.join(cyc)} — two threads "
+                f"taking these in opposite order deadlock")
+
+
+# ---------------------------------------------------------------------
+class SharedStateRule(Rule):
+    """Pass 6: shared mutable state. For every class that owns a
+    `threading.Thread` target, attributes mutated NON-ATOMICALLY both
+    from thread-reachable code and from other (request/eval) methods
+    must share a lock. `# nomad-lint: guarded-by[<lock attr>]` on the
+    attribute's initialization line declares the guarding lock; all
+    non-__init__ mutations must then hold it. Plain attribute
+    rebinding is a GIL-atomic publish and is exempt from the
+    heuristic pairing (but NOT from a declared guarded-by)."""
+
+    name = "shared-state"
+    doc = ("thread-shared attrs need a common lock; guarded-by[...] "
+           "declares and enforces intent")
+
+    # lifecycle methods whose mutations happen-before/after the thread
+    LIFECYCLE = ("__init__", "__post_init__")
+
+    MUTATOR_METHODS = {
+        "append", "extend", "insert", "remove", "clear", "update",
+        "setdefault", "popitem", "appendleft", "add", "discard",
+        "rotate",
+    }
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        guarded = self._guarded_decls(ctx)
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node, guarded)
+
+    # -- guarded-by declarations ---------------------------------------
+    @staticmethod
+    def _guarded_decls(ctx: FileContext) -> Dict[int, str]:
+        """{1-based line: lock attr} — a comment-only guarded-by line
+        covers the next line (same convention as allow[])."""
+        out: Dict[int, str] = {}
+        for i, raw in enumerate(ctx.lines, start=1):
+            m = GUARDED_BY_RE.search(raw)
+            if not m:
+                continue
+            lock = m.group(1)
+            if lock.startswith("self."):
+                lock = lock[5:]
+            out[i] = lock
+            if _COMMENT_ONLY_RE.match(raw):
+                out[i + 1] = lock
+        return out
+
+    # -- per-class analysis --------------------------------------------
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef,
+                     guarded_lines: Dict[int, str]
+                     ) -> Iterable[Finding]:
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        if not methods:
+            return
+        summaries = {name: self._summarize_method(node)
+                     for name, node in methods.items()}
+
+        thread_targets = set()
+        for s in summaries.values():
+            thread_targets |= s["thread_targets"]
+        guarded_attrs: Dict[str, str] = {}
+        lockish_attrs: Set[str] = set()
+        for s in summaries.values():
+            for attr, line in s["inits"]:
+                if line in guarded_lines:
+                    guarded_attrs[attr] = guarded_lines[line]
+            lockish_attrs |= s["lock_attrs"]
+
+        if not thread_targets and not guarded_attrs:
+            return
+
+        # thread-reachable closure over the intra-class call graph
+        reachable = set(t for t in thread_targets if t in methods)
+        frontier = list(reachable)
+        while frontier:
+            m = frontier.pop()
+            for callee in summaries[m]["calls"]:
+                if callee in methods and callee not in reachable:
+                    reachable.add(callee)
+                    frontier.append(callee)
+
+        entry_held = self._entry_held(methods, summaries,
+                                      thread_targets)
+
+        # collect mutation sites per attr with effective held sets
+        sites: Dict[str, List[dict]] = {}
+        for mname, s in summaries.items():
+            base = entry_held.get(mname, frozenset())
+            for attr, node, held, atomic in s["mutations"]:
+                sites.setdefault(attr, []).append({
+                    "method": mname, "node": node,
+                    "held": frozenset(held) | base,
+                    "atomic": atomic,
+                    "lifecycle": mname in self.LIFECYCLE,
+                })
+
+        # 1) declared guarded-by attrs: every non-lifecycle mutation
+        #    must hold the declared lock
+        for attr, lock in sorted(guarded_attrs.items()):
+            for site in sites.get(attr, []):
+                if site["lifecycle"]:
+                    continue
+                if lock not in site["held"]:
+                    held_txt = ", ".join(sorted(site["held"])) \
+                        or "no lock"
+                    yield ctx.finding(
+                        self.name, site["node"],
+                        f"{cls.name}.{attr} is declared guarded-by"
+                        f"[{lock}] but this mutation in "
+                        f"`{site['method']}` holds {held_txt}")
+
+        # 2) heuristic: undeclared attrs mutated non-atomically from
+        #    both sides of the thread boundary need a common lock
+        if not reachable:
+            return
+        for attr, slist in sorted(sites.items()):
+            if attr in guarded_attrs or attr in lockish_attrs \
+                    or _is_lock_name(attr):
+                continue
+            live = [s for s in slist
+                    if not s["lifecycle"] and not s["atomic"]]
+            th = [s for s in live if s["method"] in reachable]
+            rq = [s for s in live if s["method"] not in reachable]
+            if not th or not rq:
+                continue
+            common = frozenset.intersection(
+                *[s["held"] for s in live])
+            if common:
+                continue
+            worst = min(live, key=lambda s: len(s["held"]))
+            held_txt = ", ".join(sorted(worst["held"])) or "no lock"
+            yield ctx.finding(
+                self.name, worst["node"],
+                f"{cls.name}.{attr} is mutated from thread-reachable "
+                f"`{'/'.join(sorted({s['method'] for s in th}))}` and "
+                f"from `{'/'.join(sorted({s['method'] for s in rq}))}`"
+                f" with no common lock (this site holds {held_txt}) — "
+                f"take one lock on both sides or declare "
+                f"`# nomad-lint: guarded-by[<lock>]` on the attr's "
+                f"init line")
+
+    # -- method summaries ----------------------------------------------
+    def _summarize_method(self, fn) -> dict:
+        out = {"thread_targets": set(), "calls": set(),
+               "mutations": [],     # (attr, node, held set, atomic)
+               "inits": [],         # (attr, lineno) for Assign targets
+               "lock_attrs": set(),
+               "call_sites": []}    # (callee, held set)
+        held: List[str] = []
+
+        def self_attr(node) -> Optional[str]:
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                return node.attr
+            return None
+
+        def thread_target(call: ast.Call) -> None:
+            name = call_name(call) or ""
+            if not name.endswith("Thread") and \
+                    not name.endswith("Timer"):
+                return
+            # Thread's target and Timer's function arrive by keyword OR
+            # positionally (both sit at arg index 1, after group /
+            # interval) — every in-tree Timer passes its callback
+            # positionally
+            cands = [kw.value for kw in call.keywords
+                     if kw.arg in ("target", "function")]
+            if len(call.args) > 1:
+                cands.append(call.args[1])
+            for tgt in cands:
+                if isinstance(tgt, ast.Lambda):
+                    for sub in ast.walk(tgt.body):
+                        if isinstance(sub, ast.Call):
+                            cn = call_name(sub) or ""
+                            if cn.startswith("self."):
+                                out["thread_targets"].add(cn[5:])
+                    continue
+                chain = attr_chain(tgt) or ""
+                if chain.startswith("self."):
+                    out["thread_targets"].add(chain[5:])
+
+        def visit(node) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                return
+            if isinstance(node, ast.With):
+                locks = []
+                for item in node.items:
+                    chain = attr_chain(item.context_expr)
+                    if chain and chain.startswith("self.") and \
+                            _is_lock_name(chain):
+                        locks.append(chain[5:])
+                held.extend(locks)
+                for child in node.body:
+                    visit(child)
+                for _ in locks:
+                    held.pop()
+                return
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    attr = self_attr(t)
+                    if attr is not None:
+                        out["inits"].append((attr, node.lineno))
+                        out["mutations"].append(
+                            (attr, node, tuple(held), True))
+                        v = node.value
+                        if isinstance(v, ast.Call):
+                            vn = call_name(v) or ""
+                            if vn.split(".")[-1] in (
+                                    "Lock", "RLock", "Condition",
+                                    "make_lock", "make_rlock",
+                                    "make_condition"):
+                                out["lock_attrs"].add(attr)
+                    elif isinstance(t, ast.Subscript):
+                        attr = self_attr(t.value)
+                        if attr is not None:
+                            out["mutations"].append(
+                                (attr, node, tuple(held), False))
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                attr = self_attr(node.target)
+                if attr is not None:
+                    out["inits"].append((attr, node.lineno))
+                    out["mutations"].append(
+                        (attr, node, tuple(held), True))
+            elif isinstance(node, ast.AugAssign):
+                attr = self_attr(node.target)
+                if attr is not None:
+                    out["mutations"].append(
+                        (attr, node, tuple(held), False))
+                elif isinstance(node.target, ast.Subscript):
+                    attr = self_attr(node.target.value)
+                    if attr is not None:
+                        out["mutations"].append(
+                            (attr, node, tuple(held), False))
+            elif isinstance(node, (ast.Delete,)):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = self_attr(t.value)
+                        if attr is not None:
+                            out["mutations"].append(
+                                (attr, node, tuple(held), False))
+            elif isinstance(node, ast.Call):
+                thread_target(node)
+                name = call_name(node) or ""
+                if name.startswith("self."):
+                    rest = name[5:]
+                    parts = rest.split(".")
+                    if len(parts) == 1:
+                        out["calls"].add(parts[0])
+                        out["call_sites"].append(
+                            (parts[0], tuple(held)))
+                    elif len(parts) == 2 and \
+                            parts[1] in self.MUTATOR_METHODS:
+                        out["mutations"].append(
+                            (parts[0], node, tuple(held), False))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fn.body:
+            visit(stmt)
+        return out
+
+    # -- entry-held dataflow -------------------------------------------
+    def _entry_held(self, methods, summaries,
+                    thread_targets) -> Dict[str, frozenset]:
+        """Locks PROVABLY held on entry to each method: the
+        intersection over every intra-class call site of (locks held
+        at the site + the caller's own entry-held set). Public
+        methods and thread entries are outside entry points with
+        nothing held; private helpers called only under a lock
+        inherit it —
+        `with self._l: self._store()` credits _store's mutations."""
+        call_sites: Dict[str, List[Tuple[str, frozenset]]] = {}
+        for caller, s in summaries.items():
+            for callee, held in s["call_sites"]:
+                if callee in methods:
+                    call_sites.setdefault(callee, []).append(
+                        (caller, frozenset(held)))
+
+        entry: Dict[str, frozenset] = {}
+        all_locks = frozenset()
+        for s in summaries.values():
+            for _attr, _node, held, _atomic in s["mutations"]:
+                all_locks |= frozenset(held)
+            for _callee, held in s["call_sites"]:
+                all_locks |= frozenset(held)
+        for name in methods:
+            is_entry = (name in thread_targets
+                        or not name.startswith("_")
+                        or name not in call_sites)
+            entry[name] = frozenset() if is_entry else all_locks
+        for _ in range(len(methods) + 1):
+            changed = False
+            for name in methods:
+                if not entry[name]:
+                    continue
+                sites = call_sites.get(name, ())
+                new = frozenset.intersection(*[
+                    held | entry[caller] for caller, held in sites]) \
+                    if sites else frozenset()
+                new &= entry[name]
+                if new != entry[name]:
+                    entry[name] = new
+                    changed = True
+            if not changed:
+                break
+        return entry
+
+
+# ---------------------------------------------------------------------
+class RawLockRule(Rule):
+    """Pass 7: lock construction goes through the factory. A raw
+    `threading.Lock()` outside `utils/locks.py` is invisible to the
+    `NOMAD_TPU_RACE=1` shims — the whole runtime sanitizer hinges on
+    every mutex being born in one place."""
+
+    name = "raw-lock"
+    doc = "threading.Lock/RLock/Condition only via utils/locks.py"
+
+    FACTORY = "nomad_tpu/utils/locks.py"
+    ALLOWED = (FACTORY, "nomad_tpu/analysis/race.py")
+    PRIMITIVES = ("Lock", "RLock", "Condition")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path in self.ALLOWED or \
+                not ctx.path.startswith("nomad_tpu/"):
+            return
+        aliases = {"threading"}
+        direct: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "threading":
+                        aliases.add(a.asname or "threading")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "threading":
+                    for a in node.names:
+                        if a.name in self.PRIMITIVES:
+                            direct.add(a.asname or a.name)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            hit = None
+            if "." in name:
+                base, _, last = name.rpartition(".")
+                if base in aliases and last in self.PRIMITIVES:
+                    hit = last
+            elif name in direct:
+                hit = name
+            if hit:
+                factory = {"Lock": "make_lock", "RLock": "make_rlock",
+                           "Condition": "make_condition"}[hit]
+                yield ctx.finding(
+                    self.name, node,
+                    f"raw threading.{hit}() — construct through "
+                    f"utils/locks.{factory}() so NOMAD_TPU_RACE=1 "
+                    f"can instrument it")

@@ -12,6 +12,12 @@ verified mechanically (CHANGES.md, STATUS §2.6):
                    widths only from the bucketing helpers
   lock-discipline  lock-acquisition graph must be acyclic, and no lock
                    may be held across device dispatch / blocking waits
+                   (interprocedural — concurrency.py, call graph
+                   depth >= 3 sees through helpers)
+  shared-state     attrs mutated across the thread boundary need a
+                   common lock; guarded-by[...] declares intent
+  raw-lock         locks are born in utils/locks.py so the
+                   NOMAD_TPU_RACE=1 shims can instrument them
   surface-drift    every HTTP route needs a CLI/test reference; every
                    ServerConfig.governor_*/plan_group_* knob must
                    appear in STATUS.md
@@ -26,6 +32,7 @@ import ast
 import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
+from .concurrency import LockRule, RawLockRule, SharedStateRule
 from .engine import (FileContext, Finding, Project, Rule, attr_chain,
                      call_name, decorator_names)
 
@@ -345,182 +352,6 @@ class DtypeRule(Rule):
 
 
 # ---------------------------------------------------------------------
-_LOCK_SUFFIXES = ("_l", "_lock", "lock", "_cv", "_mu", "_mutex",
-                  "_watch")
-
-# direct calls that block or dispatch while a lock is held
-_DISPATCH_CALLS = ("jax.device_put", "jax.device_get", "time.sleep")
-_DISPATCH_SUFFIXES = (".block_until_ready", ".select_many", ".result",
-                      ".urlopen")
-
-
-def _is_lock_name(chain: str) -> bool:
-    last = chain.split(".")[-1]
-    return any(last == s or last.endswith(s) for s in _LOCK_SUFFIXES)
-
-
-class LockRule(Rule):
-    """Pass 4: lock order + lock scope. Builds the lock-acquisition
-    graph from `with <lock>:` nesting across every analyzed file
-    (lock identity = Class.attr, so `self._l` in two methods is one
-    node), flags cycles (the AB/BA deadlock shape `go vet` can't see
-    either — the race detector finds it at runtime, this finds it at
-    review time), and flags device dispatch or blocking waits issued
-    while a lock is held — directly, or one call deep into a method of
-    the same class (the depth that catches `with self._l:
-    self._upload()` where _upload does the device_put)."""
-
-    name = "lock-discipline"
-    doc = "no lock cycles; no dispatch/blocking call under a lock"
-
-    def __init__(self):
-        # lock graph accumulated across check_file calls; finish()
-        # reports cycles once per run
-        self._edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
-        self._edge_ctx: Dict[Tuple[str, str], FileContext] = {}
-
-    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
-        class_methods = self._index_methods(ctx)
-        for fn in ast.walk(ctx.tree):
-            if not isinstance(fn, (ast.FunctionDef,
-                                   ast.AsyncFunctionDef)):
-                continue
-            yield from self._walk_fn(ctx, fn, class_methods)
-
-    # -- per-function lock tracking -----------------------------------
-    def _walk_fn(self, ctx: FileContext, fn,
-                 class_methods) -> Iterable[Finding]:
-        cls = ctx.enclosing_class(fn)
-        held: List[str] = []
-
-        def lock_id(chain: str) -> str:
-            attr = chain.split(".", 1)[1] if "." in chain else chain
-            owner = cls.name if cls is not None and \
-                chain.startswith("self.") else ctx.path
-            return f"{owner}.{attr}"
-
-        def visit(node) -> Iterable[Finding]:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)) and node is not fn:
-                return      # nested defs tracked on their own walk
-            if isinstance(node, ast.With):
-                locks = []
-                for item in node.items:
-                    chain = attr_chain(item.context_expr)
-                    if chain and _is_lock_name(chain):
-                        locks.append(lock_id(chain))
-                for lk in locks:
-                    for outer in held:
-                        if outer != lk:
-                            self._edges.setdefault(outer, {})
-                            if lk not in self._edges[outer]:
-                                self._edges[outer][lk] = (ctx.path,
-                                                          node.lineno)
-                                self._edge_ctx[(outer, lk)] = ctx
-                    held.append(lk)
-                for child in node.body:
-                    yield from visit(child)
-                for _ in locks:
-                    held.pop()
-                return
-            if isinstance(node, ast.Call) and held:
-                yield from self._check_dispatch(ctx, node, held, cls,
-                                                class_methods)
-            for child in ast.iter_child_nodes(node):
-                yield from visit(child)
-
-        for stmt in fn.body:
-            yield from visit(stmt)
-
-    def _check_dispatch(self, ctx: FileContext, node: ast.Call,
-                        held: List[str], cls,
-                        class_methods) -> Iterable[Finding]:
-        name = call_name(node) or ""
-        if self._is_dispatch_name(name):
-            yield ctx.finding(
-                self.name, node,
-                f"`{name}` under lock {held[-1]}: device dispatch / "
-                f"blocking call while holding a lock serializes every "
-                f"other acquirer behind the device round trip")
-            return
-        # one level deep: self.method() whose body dispatches
-        if cls is not None and name.startswith("self.") and \
-                "." not in name[5:]:
-            callee = class_methods.get((cls.name, name[5:]))
-            if callee is not None:
-                site = self._first_dispatch_in(callee)
-                if site is not None:
-                    yield ctx.finding(
-                        self.name, node,
-                        f"`{name}()` under lock {held[-1]} reaches "
-                        f"`{site}` (inside `{callee.name}`): device "
-                        f"dispatch while holding a lock")
-
-    def _is_dispatch_name(self, name: str) -> bool:
-        if name in _DISPATCH_CALLS:
-            return True
-        return any(name.endswith(s) for s in _DISPATCH_SUFFIXES)
-
-    def _first_dispatch_in(self, fndef) -> Optional[str]:
-        for node in ast.walk(fndef):
-            if isinstance(node, ast.Call):
-                name = call_name(node) or ""
-                if self._is_dispatch_name(name):
-                    return name
-        return None
-
-    @staticmethod
-    def _index_methods(ctx: FileContext):
-        out = {}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.ClassDef):
-                for stmt in node.body:
-                    if isinstance(stmt, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)):
-                        out[(node.name, stmt.name)] = stmt
-        return out
-
-    # -- cycle detection ----------------------------------------------
-    def finish(self, project: Project) -> Iterable[Finding]:
-        seen_cycles: Set[frozenset] = set()
-        for start in sorted(self._edges):
-            path: List[str] = []
-            on_path: Set[str] = set()
-            visited: Set[str] = set()
-
-            def dfs(node: str) -> Optional[List[str]]:
-                if node in on_path:
-                    return path[path.index(node):] + [node]
-                if node in visited:
-                    return None
-                visited.add(node)
-                on_path.add(node)
-                path.append(node)
-                for nxt in sorted(self._edges.get(node, {})):
-                    cyc = dfs(nxt)
-                    if cyc is not None:
-                        return cyc
-                path.pop()
-                on_path.discard(node)
-                return None
-
-            cyc = dfs(start)
-            if cyc is None:
-                continue
-            key = frozenset(cyc)
-            if key in seen_cycles:
-                continue
-            seen_cycles.add(key)
-            a, b = cyc[0], cyc[1]
-            site_path, site_line = self._edges[a][b]
-            ctx = self._edge_ctx[(a, b)]
-            yield ctx.finding(
-                self.name, site_line,
-                f"lock-order cycle: {' -> '.join(cyc)} — two threads "
-                f"taking these in opposite order deadlock")
-
-
-# ---------------------------------------------------------------------
 class SurfaceDriftRule(Rule):
     """Pass 5: surface drift. The HTTP route table, the CLI, and
     STATUS.md drift apart silently as the surface grows (ROADMAP: CLI
@@ -540,7 +371,8 @@ class SurfaceDriftRule(Rule):
     # staleness knob on ServerConfig).
     KNOB_PREFIXES = ("governor_", "plan_group_", "reconcile_",
                      "gateway_", "snapshot_", "wal_", "trace_",
-                     "preempt_", "telemetry_", "mesh_", "stats_")
+                     "preempt_", "telemetry_", "mesh_", "stats_",
+                     "race_")
 
     # which config dataclasses carry operator knobs
     CONFIG_CLASSES = ("ServerConfig", "ClientConfig")
@@ -655,4 +487,4 @@ class SurfaceDriftRule(Rule):
 
 def default_rules() -> List[Rule]:
     return [HostSyncRule(), JitHygieneRule(), DtypeRule(), LockRule(),
-            SurfaceDriftRule()]
+            SharedStateRule(), RawLockRule(), SurfaceDriftRule()]

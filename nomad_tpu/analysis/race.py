@@ -1,0 +1,792 @@
+"""Runtime deadlock & race sanitizer (`NOMAD_TPU_RACE=1`).
+
+The static concurrency passes (analysis/concurrency.py) prove lock
+DISCIPLINE at review time; this module watches the lock TRAFFIC of a
+live process. When the env switch is armed, `utils/locks.py` — the one
+construction point the raw-lock lint rule enforces — hands out
+instrumented shims instead of raw `threading` primitives:
+
+  order graph     every first-time (held, acquired) lock pair becomes
+                  an edge in a process-global acquisition-order graph,
+                  keyed by CONSTRUCTION SITE (all instances born at
+                  eval_broker.py:97 are one node, the lockdep
+                  convention). A new edge that closes a cycle is a
+                  potential-deadlock finding carrying BOTH stacks: the
+                  one that just took the locks in this order and the
+                  recorded stack of the reversed edge.
+  hold/contention every acquire records wait-time when it contended;
+                  every release records hold-time. Holds beyond
+                  `race_lock_hold_warn_ms` keep a worst-K exemplar
+                  (stack at release — the code that sat on the lock),
+                  surfaced as `lock.*` governor gauges and the `locks`
+                  block of /v1/operator/governor.
+  guarded structs `guard(obj, lock, name)` wraps a dict/list so every
+                  mutating method checks the declaring lock is held by
+                  the current thread — a lock-free mutation of a
+                  structure the code PROMISED to guard is a finding
+                  with the mutating stack (the dynamic half of the
+                  static pass's `# nomad-lint: guarded-by[...]`).
+
+Findings are deliberately few in kind (lock-order cycle, self
+deadlock, unguarded mutation) and zero in a healthy tree: the race
+ratchet (tests/test_race_ratchet.py) replays the concurrency-heavy
+suites under `NOMAD_TPU_RACE=1` and asserts no unsuppressed finding
+survives. `NOMAD_TPU_RACE_REPORT=<path>` dumps findings + stats as
+JSON at interpreter exit so that subprocess ratchet can read them.
+
+This module uses raw `threading` primitives by design (it IS the
+instrumentation) and is allowlisted by the raw-lock rule.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+import weakref
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+ENV = "NOMAD_TPU_RACE"
+REPORT_ENV = "NOMAD_TPU_RACE_REPORT"
+
+STACK_LIMIT = 14        # frames kept per captured stack
+
+
+def enabled() -> bool:
+    """Read live (the sanitizer.enabled idiom) — but note the shims
+    only exist for locks CONSTRUCTED while this was true; flipping the
+    env mid-process instruments nothing retroactively. Delegates to
+    the factory's predicate so the two can never disagree."""
+    from ..utils.locks import _race_on
+    return _race_on()
+
+
+def _stack(skip: int = 2) -> str:
+    try:
+        frames = traceback.format_stack(sys._getframe(skip),
+                                        limit=STACK_LIMIT)
+    except ValueError:          # shallower than skip
+        frames = traceback.format_stack(limit=STACK_LIMIT)
+    return "".join(frames)
+
+
+# Known-benign lock-order cycles, keyed by frozenset of construction-
+# site names, each with a justification (audited like the static
+# passes' allow[] comments). Findings matching an entry are recorded
+# suppressed — the ratchet asserts on UNsuppressed findings only.
+SUPPRESSED_CYCLES: Dict[frozenset, str] = {
+}
+
+
+class RaceMonitor:
+    """Process-global bookkeeping behind the shims. Per-thread state
+    (the held-lock stack, the seen-edge cache) lives in a
+    threading.local so the steady-state acquire path never takes the
+    monitor's own lock; the global structures (order graph, findings,
+    exemplars) are touched only on first-time edges, warn-threshold
+    holds, and findings — all rare by construction."""
+
+    def __init__(self):
+        self._l = threading.Lock()
+        self._tls = threading.local()
+        # order graph: src name -> {dst name: (stack, thread name)}
+        self._graph: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        self._findings: List[dict] = []
+        self._finding_keys: set = set()
+        self._cycles_seen: set = set()
+        self._exemplars: List[dict] = []    # worst-K holds, desc
+        self._locks: "weakref.WeakSet" = weakref.WeakSet()
+        # counters folded in from GC'd locks (per-connection wlocks,
+        # per-drain lane locks, per-election tallies): the lock.*
+        # gauges are sums over live locks PLUS these, so they stay
+        # monotone when short-lived locks die — a delta-based rate
+        # over the telemetry ring must never go negative
+        self._dead_totals: Dict[str, dict] = {}
+        # lock-free __del__ inbox, drained on every lock registration
+        # (the churn that fills it also drains it) and on every gauge
+        # read; bounded as a backstop for a process that somehow stops
+        # constructing locks but keeps collecting them
+        self._dead_q: deque = deque(maxlen=65536)
+        self._report_hooked = False
+        # knobs (ServerConfig.race_* via configure(); defaults match)
+        self.hold_warn_ms: float = 50.0
+        self.exemplar_slots: int = 8
+        self.max_findings: int = 256
+        self.suppressed_cycles: Dict[frozenset, str] = \
+            dict(SUPPRESSED_CYCLES)
+
+    # -- configuration -------------------------------------------------
+    def configure(self, hold_warn_ms: Optional[float] = None,
+                  exemplar_slots: Optional[int] = None,
+                  max_findings: Optional[int] = None) -> None:
+        if hold_warn_ms is not None:
+            self.hold_warn_ms = float(hold_warn_ms)
+        if exemplar_slots is not None:
+            self.exemplar_slots = int(exemplar_slots)
+        if max_findings is not None:
+            self.max_findings = int(max_findings)
+
+    # -- per-thread state ----------------------------------------------
+    def _tl(self):
+        tl = self._tls
+        try:
+            tl.held
+        except AttributeError:
+            tl.held = []                # InstrumentedLock stack
+            tl.seen_edges = set()       # (src name, dst name) cache
+        return tl
+
+    def _note_edges(self, lock: "InstrumentedLock", ident: int,
+                    held: list, tls) -> None:
+        """Nested-acquire bookkeeping (held non-empty — the rarer
+        case, so the flat-acquire fast path in InstrumentedLock never
+        pays this call): prune entries a foreign thread released out
+        from under us, then record first-time order edges."""
+        stale = False
+        for l in held:
+            if l._owner != ident:
+                stale = True
+                break
+        if stale:
+            held[:] = [l for l in held if l._owner == ident]
+        seen = tls.seen_edges
+        for outer in held:
+            if outer is lock:
+                continue
+            pair = (outer.name, lock.name)
+            if pair in seen:
+                continue
+            seen.add(pair)
+            self._add_edge(pair, outer, lock)
+
+    # -- registration --------------------------------------------------
+    def register_lock(self, lock: "InstrumentedLock") -> None:
+        with self._l:
+            self._drain_dead()
+            self._locks.add(lock)
+        self.ensure_report_hook()
+
+    def fold_dead_lock(self, name: str, acquires: int, contended: int,
+                       wait_s: float, hold_s: float, max_hold_ms: float,
+                       hold_warns: int) -> None:
+        """Called from InstrumentedLock.__del__ — which GC can fire on
+        ANY thread at ANY allocation, including while THIS monitor's
+        lock is held by the same thread. So the __del__ path must be
+        lock-free: append to an atomic deque; readers drain it into
+        _dead_totals under the lock."""
+        self._dead_q.append((name, acquires, contended, wait_s,
+                             hold_s, max_hold_ms, hold_warns))
+
+    def _drain_dead(self) -> None:
+        """Fold queued dead-lock counters (caller holds self._l)."""
+        while True:
+            try:
+                (name, acquires, contended, wait_s, hold_s,
+                 max_hold_ms, hold_warns) = self._dead_q.popleft()
+            except IndexError:
+                return
+            row = self._dead_totals.setdefault(name, {
+                "instances": 0, "acquires": 0, "contended": 0,
+                "wait_ms": 0.0, "hold_ms": 0.0, "max_hold_ms": 0.0,
+                "hold_warns": 0})
+            row["instances"] += 1
+            row["acquires"] += acquires
+            row["contended"] += contended
+            row["wait_ms"] += wait_s * 1000.0
+            row["hold_ms"] += hold_s * 1000.0
+            row["max_hold_ms"] = max(row["max_hold_ms"], max_hold_ms)
+            row["hold_warns"] += hold_warns
+
+    def ensure_report_hook(self) -> None:
+        if self._report_hooked or not os.environ.get(REPORT_ENV):
+            return
+        with self._l:
+            if self._report_hooked:
+                return
+            self._report_hooked = True
+        atexit.register(self._write_report)
+
+    # -- acquire/release hooks (the condition sleep/wake path; the
+    # lock fast path inlines equivalent bookkeeping) -------------------
+    def on_acquired(self, lock: "InstrumentedLock",
+                    reacquire: bool = False) -> None:
+        tl = self._tl()
+        held = tl.held
+        if held:
+            self._note_edges(lock, threading.get_ident(), held, tl)
+        held.append(lock)
+
+    def on_released(self, lock: "InstrumentedLock",
+                    hold_s: float) -> None:
+        tl = self._tl()
+        try:
+            tl.held.remove(lock)
+        except ValueError:
+            pass                        # cross-thread release
+        hold_ms = hold_s * 1000.0
+        if hold_ms >= self.hold_warn_ms:
+            lock.hold_warns += 1
+            self._note_exemplar(lock, hold_ms)
+
+    def note_self_deadlock(self, lock: "InstrumentedLock") -> None:
+        """A non-reentrant lock re-acquired by its owner thread: the
+        raw primitive would hang here forever. Record the finding with
+        the stack BEFORE we block exactly like the raw lock would."""
+        self._finding({
+            "kind": "self-deadlock",
+            "lock": lock.name,
+            "thread": threading.current_thread().name,
+            "stack": _stack(3),
+        }, key=("self", lock.name))
+
+    def note_unguarded_mutation(self, name: str, lock_name: str,
+                                op: str) -> None:
+        self._finding({
+            "kind": "unguarded-mutation",
+            "structure": name,
+            "lock": lock_name,
+            "op": op,
+            "thread": threading.current_thread().name,
+            "stack": _stack(4),
+        }, key=("mut", name, op))
+
+    # -- order graph ---------------------------------------------------
+    def _add_edge(self, pair: Tuple[str, str],
+                  outer: "InstrumentedLock",
+                  inner: "InstrumentedLock") -> None:
+        src, dst = pair
+        stack = _stack(4)
+        tname = threading.current_thread().name
+        with self._l:
+            dsts = self._graph.setdefault(src, {})
+            if dst not in dsts:
+                dsts[dst] = (stack, tname)
+            cycle = self._find_cycle(dst, src)
+        if src == dst:
+            # same construction site, different instances, nested:
+            # peer locks with no global order — the classic
+            # unordered-neighbor deadlock
+            self._cycle_finding([src, dst], stack, tname,
+                                note="same-site peer instances nested")
+            return
+        if cycle is not None:
+            self._cycle_finding([src] + cycle, stack, tname)
+
+    def _find_cycle(self, start: str, goal: str
+                    ) -> Optional[List[str]]:
+        """Path start -> ... -> goal in the order graph (caller holds
+        self._l). Returns the node list or None."""
+        if start == goal:
+            return [start]
+        seen = {start}
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in self._graph.get(node, {}):
+                if nxt == goal:
+                    return path + [goal]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _cycle_finding(self, cycle: List[str], stack: str,
+                       tname: str, note: str = "") -> None:
+        key = frozenset(cycle)
+        with self._l:
+            if key in self._cycles_seen:
+                return
+            self._cycles_seen.add(key)
+            suppressed_why = self.suppressed_cycles.get(key)
+            other = {}
+            for a, b in zip(cycle[1:], cycle[2:] + cycle[:1]):
+                info = self._graph.get(a, {}).get(b)
+                if info is not None:
+                    other[f"{a} -> {b}"] = {"stack": info[0],
+                                            "thread": info[1]}
+        self._finding({
+            "kind": "lock-order-cycle",
+            "cycle": cycle,
+            "note": note,
+            "thread": tname,
+            "stack": stack,
+            "other_stacks": other,
+            "suppressed_why": suppressed_why,
+        }, key=("cycle", key), suppressed=suppressed_why is not None)
+
+    # -- findings / exemplars ------------------------------------------
+    def _finding(self, payload: dict, key=None,
+                 suppressed: bool = False) -> None:
+        payload.setdefault("t", time.time())
+        payload["suppressed"] = suppressed
+        with self._l:
+            if key is not None:
+                if key in self._finding_keys:
+                    return
+                self._finding_keys.add(key)
+            if len(self._findings) < self.max_findings:
+                self._findings.append(payload)
+
+    def _note_exemplar(self, lock: "InstrumentedLock",
+                       hold_ms: float) -> None:
+        ex = {"lock": lock.name, "hold_ms": round(hold_ms, 3),
+              "thread": threading.current_thread().name,
+              "t": time.time(), "stack": _stack(4)}
+        with self._l:
+            self._exemplars.append(ex)
+            self._exemplars.sort(key=lambda e: -e["hold_ms"])
+            del self._exemplars[self.exemplar_slots:]
+
+    # -- reads ---------------------------------------------------------
+    def findings(self, include_suppressed: bool = True) -> List[dict]:
+        with self._l:
+            out = list(self._findings)
+        if not include_suppressed:
+            out = [f for f in out if not f.get("suppressed")]
+        return out
+
+    def unsuppressed_count(self) -> int:
+        return len(self.findings(include_suppressed=False))
+
+    def tracked_locks(self) -> int:
+        with self._l:
+            return len(self._locks)
+
+    def edge_count(self) -> int:
+        with self._l:
+            return sum(len(d) for d in self._graph.values())
+
+    def _lock_rows(self) -> List[dict]:
+        with self._l:
+            self._drain_dead()
+            locks = list(self._locks)
+            dead = {name: dict(row)
+                    for name, row in self._dead_totals.items()}
+        agg: Dict[str, dict] = {}
+        for name, row in dead.items():
+            agg[name] = dict(row, name=name)
+        for lk in locks:
+            row = agg.setdefault(lk.name, {
+                "name": lk.name, "instances": 0, "acquires": 0,
+                "contended": 0, "wait_ms": 0.0, "hold_ms": 0.0,
+                "max_hold_ms": 0.0, "hold_warns": 0})
+            row["instances"] += 1
+            row["acquires"] += lk.acquires
+            row["contended"] += lk.contended
+            row["wait_ms"] += lk.wait_s * 1000.0
+            row["hold_ms"] += lk.hold_s * 1000.0
+            row["max_hold_ms"] = max(row["max_hold_ms"],
+                                     lk.max_hold_ms)
+            row["hold_warns"] += lk.hold_warns
+        rows = sorted(agg.values(),
+                      key=lambda r: (-r["contended"], -r["hold_ms"]))
+        for r in rows:
+            for k in ("wait_ms", "hold_ms", "max_hold_ms"):
+                r[k] = round(r[k], 3)
+        return rows
+
+    def contended_total(self) -> int:
+        with self._l:
+            self._drain_dead()
+            locks = list(self._locks)
+            dead = sum(r["contended"]
+                       for r in self._dead_totals.values())
+        return dead + sum(lk.contended for lk in locks)
+
+    def hold_warns_total(self) -> int:
+        with self._l:
+            self._drain_dead()
+            locks = list(self._locks)
+            dead = sum(r["hold_warns"]
+                       for r in self._dead_totals.values())
+        return dead + sum(lk.hold_warns for lk in locks)
+
+    def status_snapshot(self, top: int = 12,
+                        stacks: bool = False) -> dict:
+        """The `locks` block of /v1/operator/governor: aggregate
+        per-site stats (worst contention first), the worst-holder
+        exemplars, and finding counts. `stacks=True` (the exit-report
+        dump) keeps each exemplar's full release-site stack."""
+        if not enabled():
+            return {"enabled": False}
+        with self._l:
+            exemplars = [dict(e) for e in self._exemplars]
+        for e in exemplars:
+            # the operator surface gets only the top frame as the
+            # holder hint; the report dump keeps the whole stack
+            frames = [ln for ln in e.get("stack", "").splitlines()
+                      if ln.strip().startswith("File")]
+            e["holder"] = frames[-1].strip() if frames else ""
+            if not stacks:
+                e.pop("stack", None)
+        findings = self.findings()
+        return {
+            "enabled": True,
+            "tracked": self.tracked_locks(),
+            "order_edges": self.edge_count(),
+            "hold_warn_ms": self.hold_warn_ms,
+            "locks": self._lock_rows()[:top],
+            "worst_holders": exemplars,
+            "findings": len(findings),
+            "findings_unsuppressed": len(
+                [f for f in findings if not f.get("suppressed")]),
+        }
+
+    def reset(self) -> None:
+        with self._l:
+            self._graph.clear()
+            self._findings.clear()
+            self._finding_keys.clear()
+            self._cycles_seen.clear()
+            self._exemplars.clear()
+            self._dead_totals.clear()
+            self._dead_q.clear()
+        # per-thread caches: only this thread's is reachable; stale
+        # seen-edge caches in other threads just skip re-recording
+        tl = self._tl()
+        tl.held = []
+        tl.seen_edges = set()
+
+    # -- exit report ---------------------------------------------------
+    def _write_report(self) -> None:
+        path = os.environ.get(REPORT_ENV)
+        if not path:
+            return
+        try:
+            payload = {"findings": self.findings(),
+                       "stats": self.status_snapshot(top=50,
+                                                     stacks=True)}
+            with open(path, "w") as f:
+                json.dump(payload, f, indent=2, default=str)
+        except Exception:       # pragma: no cover — exit best effort
+            pass
+
+
+monitor = RaceMonitor()
+
+# hot-path binds: the shim's acquire/release run on every lock op in
+# the process, so attribute-chain lookups (time.perf_counter,
+# threading.get_ident, monitor._tls) are bound once here
+_perf = time.perf_counter
+_get_ident = threading.get_ident
+_TLS = monitor._tls
+
+
+def configure(hold_warn_ms: Optional[float] = None,
+              exemplar_slots: Optional[int] = None,
+              max_findings: Optional[int] = None) -> None:
+    """ServerConfig.race_* wiring (the preemption.configure idiom —
+    the shims are process-global, the server just tunes them)."""
+    monitor.configure(hold_warn_ms=hold_warn_ms,
+                      exemplar_slots=exemplar_slots,
+                      max_findings=max_findings)
+
+
+# ---------------------------------------------------------------------
+class InstrumentedLock:
+    """Drop-in for threading.Lock/RLock with order-graph, contention,
+    and hold-time bookkeeping. The fast path adds two perf_counter
+    reads and a thread-local list append per acquire/release pair —
+    the paired overhead smoke holds it under 5% e2e."""
+
+    __slots__ = ("_inner", "_rlock", "name", "_owner", "_depth",
+                 "_acq_t", "acquires", "contended", "wait_s",
+                 "hold_s", "max_hold_ms", "hold_warns", "__weakref__")
+
+    def __init__(self, name: str, rlock: bool = False):
+        self._inner = threading.RLock() if rlock else threading.Lock()
+        self._rlock = rlock
+        self.name = name
+        self._owner: Optional[int] = None
+        self._depth = 0
+        self._acq_t = 0.0
+        self.acquires = 0
+        self.contended = 0
+        self.wait_s = 0.0
+        self.hold_s = 0.0
+        self.max_hold_ms = 0.0
+        self.hold_warns = 0
+        monitor.register_lock(self)
+
+    # -- core protocol -------------------------------------------------
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        ident = _get_ident()
+        if self._owner == ident:
+            if self._rlock:
+                got = self._inner.acquire(blocking, timeout)
+                if got:
+                    self._depth += 1
+                return got
+            # a plain Lock BLOCKING-re-acquired by its owner: raw
+            # threading hangs here forever — record the finding, then
+            # behave exactly like the raw primitive. A non-blocking
+            # probe of an owned lock is legal polling
+            # (threading.Condition._is_owned) and stays silent
+            if blocking:
+                monitor.note_self_deadlock(self)
+        got = self._inner.acquire(False)
+        contended = False
+        if not got:
+            if not blocking:
+                self.contended += 1
+                return False
+            contended = True
+            t0 = _perf()
+            got = self._inner.acquire(True, timeout)
+            if not got:
+                self.contended += 1
+                return False
+        now = _perf()
+        self._owner = ident
+        self._depth = 1
+        self._acq_t = now
+        self.acquires += 1
+        if contended:
+            self.contended += 1
+            self.wait_s += now - t0
+        # inlined monitor bookkeeping — the flat acquire (nothing else
+        # held, the overwhelmingly common shape) pays only a
+        # thread-local read and a list append; see the overhead smoke
+        try:
+            held = _TLS.held
+        except AttributeError:
+            held = _TLS.held = []
+            _TLS.seen_edges = set()
+        if held:
+            monitor._note_edges(self, ident, held, _TLS)
+        held.append(self)
+        return True
+
+    __enter__ = acquire         # raw threading.Lock.__enter__ IS
+                                # acquire (returns True) — same here,
+                                # and it saves a call layer per `with`
+
+    def release(self) -> None:
+        if self._rlock and self._depth > 1:
+            self._depth -= 1
+            self._inner.release()
+            return
+        hold = _perf() - self._acq_t
+        self._depth = 0
+        self._owner = None
+        self.hold_s += hold
+        hold_ms = hold * 1000.0
+        if hold_ms > self.max_hold_ms:
+            self.max_hold_ms = hold_ms
+        try:
+            held = _TLS.held
+        except AttributeError:
+            held = _TLS.held = []
+            _TLS.seen_edges = set()
+        if held and held[-1] is self:
+            held.pop()
+        else:
+            try:
+                held.remove(self)
+            except ValueError:
+                pass                    # cross-thread release
+        if hold_ms >= monitor.hold_warn_ms:
+            self.hold_warns += 1
+            monitor._note_exemplar(self, hold_ms)
+        self._inner.release()
+
+    def __exit__(self, t, v, tb) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        inner_locked = getattr(self._inner, "locked", None)
+        if inner_locked is not None:
+            return inner_locked()
+        return self._owner is not None
+
+    def held_by_current(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    # -- condition-wait bookkeeping ------------------------------------
+    def _sleep_save(self) -> int:
+        """Called by InstrumentedCondition.wait with the lock held:
+        the inner Condition is about to fully release the inner lock,
+        so close out this hold episode and remember the recursion
+        depth for the wake."""
+        depth = self._depth
+        hold = time.perf_counter() - self._acq_t
+        self.hold_s += hold
+        hold_ms = hold * 1000.0
+        if hold_ms > self.max_hold_ms:
+            self.max_hold_ms = hold_ms
+        self._depth = 0
+        self._owner = None
+        monitor.on_released(self, hold)
+        return depth
+
+    def _wake_restore(self, depth: int) -> None:
+        self._owner = threading.get_ident()
+        self._depth = depth
+        self._acq_t = time.perf_counter()
+        monitor.on_acquired(self, reacquire=True)
+
+    def __del__(self):
+        # preserve the counters of a dying lock (per-connection /
+        # per-drain / per-election scopes) so the aggregate lock.*
+        # gauges stay monotone; best-effort at interpreter shutdown
+        try:
+            if self.acquires or self.contended or self.hold_warns:
+                monitor.fold_dead_lock(
+                    self.name, self.acquires, self.contended,
+                    self.wait_s, self.hold_s, self.max_hold_ms,
+                    self.hold_warns)
+        except Exception:       # pragma: no cover — shutdown races
+            pass
+
+    def __repr__(self) -> str:           # pragma: no cover — debug aid
+        kind = "rlock" if self._rlock else "lock"
+        return f"<Instrumented{kind} {self.name} owner={self._owner}>"
+
+
+class InstrumentedCondition:
+    """Drop-in for threading.Condition sharing an InstrumentedLock's
+    bookkeeping: wait() closes the hold episode (the lock is NOT held
+    while sleeping) and reopens it on wake, so hold-time gauges and
+    the order graph both see through the sleep."""
+
+    __slots__ = ("_ilock", "_cond", "__weakref__")
+
+    def __init__(self, lock: Optional[InstrumentedLock] = None,
+                 name: str = "condition"):
+        if lock is None:
+            lock = InstrumentedLock(name, rlock=True)
+        self._ilock = lock
+        self._cond = threading.Condition(lock._inner)
+
+    @property
+    def name(self) -> str:
+        return self._ilock.name
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        return self._ilock.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self._ilock.release()
+
+    def __enter__(self):
+        self._ilock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._ilock.release()
+
+    def held_by_current(self) -> bool:
+        return self._ilock.held_by_current()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if not self._ilock.held_by_current():
+            raise RuntimeError("cannot wait on un-acquired lock")
+        depth = self._ilock._sleep_save()
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._ilock._wake_restore(depth)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:           # pragma: no cover — debug aid
+        return f"<InstrumentedCondition {self._ilock.name}>"
+
+
+# ---------------------------------------------------------------------
+# guarded structures: the dynamic half of `guarded-by[...]`
+
+def _unwrap_lock(lock):
+    if isinstance(lock, InstrumentedCondition):
+        return lock._ilock
+    return lock
+
+
+class _GuardedMixin:
+    # plain attributes (not __slots__): dict/list subclasses carry a
+    # __dict__ anyway
+    def _g_init(self, lock, name):
+        self._g_lock = _unwrap_lock(lock)
+        self._g_name = name
+
+    def _g_check(self, op: str) -> None:
+        lk = getattr(self, "_g_lock", None)
+        if isinstance(lk, InstrumentedLock) and not lk.held_by_current():
+            monitor.note_unguarded_mutation(self._g_name, lk.name, op)
+
+
+def _guarding(op):
+    def wrap(method):
+        def checked(self, *a, **kw):
+            self._g_check(op)
+            return method(self, *a, **kw)
+        checked.__name__ = op
+        return checked
+    return wrap
+
+
+class GuardedDict(dict, _GuardedMixin):
+    __setitem__ = _guarding("__setitem__")(dict.__setitem__)
+    __delitem__ = _guarding("__delitem__")(dict.__delitem__)
+    pop = _guarding("pop")(dict.pop)
+    popitem = _guarding("popitem")(dict.popitem)
+    clear = _guarding("clear")(dict.clear)
+    update = _guarding("update")(dict.update)
+    setdefault = _guarding("setdefault")(dict.setdefault)
+
+
+class GuardedList(list, _GuardedMixin):
+    __setitem__ = _guarding("__setitem__")(list.__setitem__)
+    __delitem__ = _guarding("__delitem__")(list.__delitem__)
+    append = _guarding("append")(list.append)
+    extend = _guarding("extend")(list.extend)
+    insert = _guarding("insert")(list.insert)
+    pop = _guarding("pop")(list.pop)
+    remove = _guarding("remove")(list.remove)
+    clear = _guarding("clear")(list.clear)
+    sort = _guarding("sort")(list.sort)
+
+
+def guard(obj, lock, name: str):
+    """Register `obj` (dict or list) as guarded by `lock`. A no-op
+    passthrough when the sanitizer is off; when on, returns a checking
+    wrapper that records a finding on any mutation performed without
+    the lock held by the mutating thread."""
+    if not enabled():
+        return obj
+    monitor.ensure_report_hook()
+    if isinstance(obj, dict):
+        g = GuardedDict(obj)
+    elif isinstance(obj, list):
+        g = GuardedList(obj)
+    else:
+        return obj
+    g._g_init(lock, name)
+    return g

@@ -27,10 +27,10 @@ instead of a mystery p99.
 from __future__ import annotations
 
 import os
-import threading
 from typing import Dict, Tuple
 
 import numpy as np
+from ..utils.locks import make_lock
 
 ENV = "NOMAD_TPU_SANITIZE"
 
@@ -89,7 +89,7 @@ class TraceCounter:
     behind already-seen keys."""
 
     def __init__(self):
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._seen: Dict[str, set] = {}
         self._total = 0
 

@@ -1250,6 +1250,25 @@ def cmd_operator_governor(args) -> int:
             detail = {k: v for k, v in e.items()
                       if k not in ("ts", "kind")}
             print(f"  {ts}  {kind:12s} {json.dumps(detail, default=str)}")
+    # runtime race sanitizer (analysis/race.py, NOMAD_TPU_RACE=1):
+    # aggregate lock traffic + the worst-holder exemplars
+    locks = out.get("locks") or {}
+    if locks.get("enabled"):
+        print()
+        print(f"Lock traffic (NOMAD_TPU_RACE=1): "
+              f"{locks.get('tracked', 0)} tracked, "
+              f"{locks.get('order_edges', 0)} order edges, "
+              f"{locks.get('findings_unsuppressed', 0)} finding(s)")
+        rows = [[l["name"], l["instances"], l["acquires"],
+                 l["contended"], f"{l['max_hold_ms']:.1f}",
+                 l["hold_warns"]]
+                for l in locks.get("locks", [])[:8]]
+        if rows:
+            _print_rows(rows, ["Lock", "Inst", "Acquires",
+                               "Contended", "MaxHold(ms)", "Warns"])
+        for e in locks.get("worst_holders", [])[:4]:
+            print(f"  worst holder {e['lock']}: {e['hold_ms']:.1f} ms "
+                  f"in {e['thread']}  {e.get('holder', '')}")
     return 0
 
 

@@ -27,6 +27,7 @@ from ..models.resources import (NodeCpuResources, NodeDiskResources,
                                 NodeMemoryResources)
 from ..utils.ids import generate_uuid
 from .drivers import DRIVER_CATALOG, TaskHandle
+from ..utils.locks import make_lock
 
 LOG = logging.getLogger("nomad_tpu.client")
 
@@ -500,7 +501,7 @@ class AllocRunner:
         self._stats_poll = getattr(client, "host_stats", None) is None
         self.client_status = ALLOC_CLIENT_PENDING
         self.deployment_status = alloc.deployment_status
-        self._l = threading.Lock()
+        self._l = make_lock()
         self.destroyed = False
         # volume name -> host source path tasks mount from (filled by
         # _mount_volumes: CSI publish targets + host volume paths)
@@ -1025,6 +1026,7 @@ class Client:
                                  .derive_vault_token,
                                  vault=self.vault_renewer,
                                  client=self)
+            # nomad-lint: allow[shared-state] _restore_state runs in start() before the _watch_allocs thread exists — Thread.start() is the happens-before edge
             self.runners[aid] = runner
             runner.run(attached=attached, attached_leases=attached_leases)
 

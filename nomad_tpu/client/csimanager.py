@@ -15,6 +15,7 @@ import logging
 import os
 import threading
 from typing import Dict, Optional, Tuple
+from ..utils.locks import make_lock
 
 LOG = logging.getLogger("nomad_tpu.client.csi")
 
@@ -26,7 +27,7 @@ class CSIManager:
         # <mount_root>/per-alloc/<alloc>/<vol> (csimanager mountRoot)
         self.mount_root = mount_root
         self.plugins: Dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         # (plugin_id, volume_id) -> set of alloc ids staged against it
         self._stage_users: Dict[Tuple[str, str], set] = {}
         # per-volume locks held ACROSS the plugin RPC sequence: a
@@ -38,7 +39,7 @@ class CSIManager:
         with self._lock:
             lock = self._key_locks.get(key)
             if lock is None:
-                lock = self._key_locks[key] = threading.Lock()
+                lock = self._key_locks[key] = make_lock()
             return lock
 
     def register_plugin(self, plugin_id: str, plugin) -> None:

@@ -28,9 +28,9 @@ import ctypes
 import ctypes.util
 import os
 import signal
-import threading
 import time
 from typing import Dict, List, Optional, Tuple
+from ..utils.locks import make_lock
 
 CG_ROOT = "/sys/fs/cgroup"
 CG_PARENT = "nomad_tpu"
@@ -248,7 +248,7 @@ class IsolatedExecutor:
     child. Used by ExecDriver when available()."""
 
     _avail: Optional[bool] = None
-    _avail_lock = threading.Lock()
+    _avail_lock = make_lock()
 
     @classmethod
     def available(cls) -> bool:

@@ -20,6 +20,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.ids import generate_uuid
+from ..utils.locks import make_lock
 
 MAX_FRAME_BYTES = 64 * 1024
 MAX_FRAMES_PER_POLL = 16
@@ -157,7 +158,7 @@ class ExecSession:
             stderr=subprocess.PIPE)
         self._out = b""
         self._err = b""
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._readers = [
             threading.Thread(target=self._pump, args=("_out",
                              self._proc.stdout), daemon=True),
@@ -266,7 +267,7 @@ class TaskExecSession:
         self._out = b""
         self._exit: Optional[int] = None
         self._done = _threading.Event()
-        self._l = _threading.Lock()
+        self._l = make_lock()
 
         def run():
             try:
@@ -310,7 +311,7 @@ class ExecRegistry:
     IDLE_LIMIT_S = 300.0
 
     def __init__(self):
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._sessions: Dict[str, Tuple[object, float]] = {}
 
     def add(self, session) -> str:

@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..utils.locks import make_lock
 from ..models.services import (
     SERVICE_STATUS_CRITICAL,
     SERVICE_STATUS_PASSING,
@@ -82,7 +83,7 @@ class AllocServices:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._regs: Dict[str, ServiceRegistration] = {}
-        self._l = threading.Lock()
+        self._l = make_lock()
 
     # -- registration --------------------------------------------------
     def _build(self) -> List[ServiceRegistration]:

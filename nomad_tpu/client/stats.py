@@ -30,11 +30,11 @@ stats routes report the node dark, exactly the pre-r17 behavior.
 from __future__ import annotations
 
 import os
-import threading
 import time
 from typing import Dict, Optional, Tuple
 
 from ..utils import metrics
+from ..utils.locks import make_lock
 
 DEFAULT_INTERVAL_S = 1.0
 DEFAULT_SLOTS = 128
@@ -124,8 +124,17 @@ class HostStatsCollector:
                                        slots=slots,
                                        gauges_fn=self._collect,
                                        device_fn=None)
-        self._l = threading.Lock()
+        self._l = make_lock()
+        # the r17 race (heartbeat reading a half-updated sample) lived
+        # exactly here: _collect PUBLISHES these by atomic rebinding
+        # under _l and heartbeat/summary read them under _l. Declared
+        # statically (guarded-by) and registered with the runtime
+        # sanitizer at each publish — under NOMAD_TPU_RACE=1 an
+        # in-place mutation of an already-published snapshot is a
+        # finding with the mutating stack
+        # nomad-lint: guarded-by[_l]
         self._latest_host: Dict = {}
+        # nomad-lint: guarded-by[_l]
         self._latest_allocs: Dict[str, Dict] = {}
         # previous-sample anchors for percent derivations
         self._prev_cpu: Optional[Tuple[float, float]] = None
@@ -270,8 +279,15 @@ class HostStatsCollector:
             for aid, prev in self._latest_allocs.items():
                 if aid in runner_ids and aid not in latest:
                     latest[aid] = prev
-            self._latest_host = {"ts": now, **row}
-            self._latest_allocs = latest
+            # published snapshots are immutable once out (readers
+            # copy under _l): register each with the race sanitizer
+            # so an in-place mutation after publish is a finding
+            from ..analysis import race as _race
+            self._latest_host = _race.guard(
+                {"ts": now, **row}, self._l,
+                "HostStatsCollector._latest_host")
+            self._latest_allocs = _race.guard(
+                latest, self._l, "HostStatsCollector._latest_allocs")
         for k in ("host.cpu_pct", "host.mem_used_mb",
                   "host.disk_used_mb", "host.allocs_running"):
             if k in row:

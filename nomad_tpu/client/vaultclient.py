@@ -13,6 +13,7 @@ import logging
 import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
+from ..utils.locks import make_lock
 
 LOG = logging.getLogger("nomad_tpu.client.vault")
 
@@ -38,7 +39,7 @@ class VaultTokenRenewer:
         self.renew_fraction = renew_fraction
         self.tick_s = tick_s
         self._tracked: Dict[Tuple[str, str], dict] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._stop = threading.Event()
         self._wake = threading.Event()   # set on track() / stop()
         self._thread: Optional[threading.Thread] = None

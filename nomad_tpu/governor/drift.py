@@ -14,9 +14,9 @@ it without a clock.
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import Dict, List, Optional, Tuple
+from ..utils.locks import make_lock
 
 # direction a metric degrades in: p99/rss degrade upward, throughput
 # degrades downward
@@ -45,7 +45,7 @@ class RollingSeries:
 
     def __init__(self, maxlen: int = 60):
         self._q: deque = deque(maxlen=maxlen)
-        self._l = threading.Lock()
+        self._l = make_lock()
 
     def add(self, t: float, value: float) -> None:
         with self._l:
@@ -96,7 +96,7 @@ class DriftDetector:
         self._perf: Dict[str, Tuple[RollingSeries, str]] = {}
         # name -> series of structure sizes (suspects)
         self._structs: Dict[str, RollingSeries] = {}
-        self._l = threading.Lock()
+        self._l = make_lock()
 
     # -- feeding -------------------------------------------------------
     def observe_perf(self, name: str, t: float, value: float,

@@ -28,12 +28,13 @@ import gc
 import threading
 import time
 from collections import deque
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..utils import metrics
 from .drift import DEGRADES_DOWN, DEGRADES_UP, DriftDetector
 from .policy import STATUS_OVER, WatermarkPolicy
 from .registry import GaugeRegistry, Registration
+from ..utils.locks import make_lock
 
 EVENT_LOG_MAX = 256
 LATENCY_RESERVOIR = 2048
@@ -63,13 +64,13 @@ class Governor:
         self._drift_check_every = max(1, drift_check_every)
         self._bp = threading.Event()
         self._events: deque = deque(maxlen=EVENT_LOG_MAX)
-        self._events_l = threading.Lock()
+        self._events_l = make_lock()
         self._lat: deque = deque(maxlen=LATENCY_RESERVOIR)
         # full latency incl. broker queue wait — attribution/bench
         # percentiles only, never the backpressure gauge (see
         # observe_eval_latency)
         self._lat_full: deque = deque(maxlen=LATENCY_RESERVOIR)
-        self._lat_l = threading.Lock()
+        self._lat_l = make_lock()
         self._evals_observed = 0
         self._last_lat_t = 0.0          # monotonic of newest latency
         self._last_throughput_mark = (0, 0.0)  # (evals, monotonic)
@@ -83,6 +84,10 @@ class Governor:
         # the drift was detected ARE the capture worth keeping. Hooks
         # run on the sampler thread; exceptions are isolated.
         self.drift_hooks: List[Callable[[dict], None]] = []
+        # named extra sections merged into status() (e.g. the race
+        # sanitizer's `locks` block with worst-holder exemplars); a
+        # section that raises is dropped, not fatal
+        self.extra_status: Dict[str, Callable[[], object]] = {}
 
     # -- registration proxy -------------------------------------------
     def register(self, name: str,
@@ -274,7 +279,7 @@ class Governor:
         return self._bp.is_set()
 
     def status(self) -> dict:
-        return {
+        out = {
             "enabled": True,
             "running": self._thread is not None,
             "interval_s": self.interval_s,
@@ -286,3 +291,9 @@ class Governor:
             "gauges": self.registry.rows(),
             "events": self.events(),
         }
+        for key, fn in list(self.extra_status.items()):
+            try:
+                out[key] = fn()
+            except Exception:   # pragma: no cover — defensive
+                pass
+        return out

@@ -13,12 +13,12 @@ not blind the governor to the rest.
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 from ..utils import metrics
 from .policy import STATUS_OK, STATUS_OVER, WatermarkPolicy
+from ..utils.locks import make_lock
 
 LOG = logging.getLogger("nomad_tpu.governor")
 
@@ -63,7 +63,7 @@ class Registration:
 
 class GaugeRegistry:
     def __init__(self):
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._regs: Dict[str, Registration] = {}
 
     def register(self, name: str, gauge_fn: Callable[[], float],

@@ -45,11 +45,11 @@ hatch.
 from __future__ import annotations
 
 import os
-import threading
 import time as _time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+from ..utils.locks import make_lock
 
 TABLE_DELTA_ENV = "NOMAD_TPU_TABLE_DELTA"
 
@@ -127,7 +127,7 @@ class DeviceNodeTable:
     later materialization starts from the right table."""
 
     def __init__(self):
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._state: Optional[DeviceTableState] = None
         self.version = 0            # latest host table version (token)
         self.epoch = 0              # node-set generation
@@ -184,6 +184,7 @@ class DeviceNodeTable:
                 return self.version
             if rows:
                 try:
+                    # nomad-lint: allow[lock-discipline] scatter stays under _l to pair arrays with the version token; jax dispatch is async (never blocks)
                     st = self._scatter(st, table, rows)
                 except Exception:   # pragma: no cover — defensive:
                     # a failed device op must not poison scheduling;

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..utils.locks import make_lock
 
 # JIT shape-cache bound (governor accounting): every distinct
 # (steps, spreads, distinct, lane) shape bucket compiles and caches an
@@ -815,10 +816,9 @@ SCAN_BATCH_MAX = 256
 GATEWAY_MAX_LANES = 16
 
 # process-wide sharded dispatcher (see get_shared_sharded)
-import threading as _sel_threading  # noqa: E402
 
 _SHARED_SHARDED = None
-_SHARED_SHARDED_LOCK = _sel_threading.Lock()
+_SHARED_SHARDED_LOCK = make_lock()
 
 
 def get_shared_sharded():
@@ -1360,8 +1360,7 @@ class DispatchCostModel:
     PROBE_EVERY = 16
 
     def __init__(self):
-        import threading
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._stats: Dict[Tuple[str, int], List[float]] = {}
         self._probe = 0
 
@@ -1505,9 +1504,8 @@ cost_model = DispatchCostModel()
 # milliseconds of numpy work); the telemetry collector
 # (nomad_tpu/telemetry/) publishes them as `nomad.device.*` gauges and
 # the bench artifact records the per-round snapshot.
-import threading as _threading  # noqa: E402
 
-_DEVICE_L = _threading.Lock()
+_DEVICE_L = make_lock()
 DEVICE_STATS: Dict[str, float] = {
     # Σ live rows vs Σ padded rows shipped: 1 - n/n_pad is the fraction
     # of every dispatch's node axis spent scoring padding

@@ -25,6 +25,7 @@ from ..models import NetworkIndex
 from ..models.job import (CONSTRAINT_DISTINCT_HOSTS,
                           CONSTRAINT_DISTINCT_PROPERTY)
 from .targets import TargetColumns, constraint_mask
+from ..utils.locks import make_lock
 
 RES_DIMS = 4  # cpu_shares, memory_mb, disk_mb, network_mbits
 DIM_NAMES = ("cpu", "memory", "disk", "network")
@@ -691,10 +692,8 @@ class NodeTableCache:
     rebuild path for bisection."""
 
     def __init__(self):
-        import threading
-
         from .device_table import DeviceNodeTable
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._table: Optional[NodeTable] = None
         self._index = -1
         self.device = DeviceNodeTable()

@@ -44,11 +44,11 @@ per-eval upload path — the bisection escape hatch.
 from __future__ import annotations
 
 import os
-import threading
 from typing import Dict, Optional
 
 import numpy as np
 
+from ..utils.locks import make_lock
 from ..ops.device_table import (DeviceTableState, SPARSE_MAX_FRAC,
                                 _bucket_rows, _overlay_add, _scatter_set,
                                 enable_row_journal)
@@ -101,7 +101,7 @@ class ShardedDeviceNodeTable:
         self.node2_sharding = NamedSharding(mesh, P("nodes", None))
         self.replicated = NamedSharding(mesh, P())
         self._jax = jax
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._state: Optional[DeviceTableState] = None
         self._mirror = None         # the host cache's DeviceNodeTable
         self._version = -1

@@ -28,11 +28,11 @@ import logging
 import os
 import subprocess
 import sys
-import threading
 import time
 from typing import Dict, List, Optional
 
 from ..rpc.client import RpcClient, RpcError
+from ..utils.locks import make_lock
 from .base import (HANDSHAKE_COOKIE_KEY, HANDSHAKE_COOKIE_VALUE,
                    HANDSHAKE_PREFIX)
 
@@ -172,7 +172,7 @@ class ExternalCSIPlugin:
         self.name = plugin_name
         self.python = python
         self.env_extra = dict(env_extra or {})
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._proc: Optional[subprocess.Popen] = None
         self._rpc: Optional[RpcClient] = None
 

@@ -18,6 +18,7 @@ import time
 from typing import Dict, Optional
 
 from ..rpc.client import RpcClient, RpcError
+from ..utils.locks import make_lock
 from .base import (HANDSHAKE_COOKIE_KEY, HANDSHAKE_COOKIE_VALUE,
                    HANDSHAKE_PREFIX)
 
@@ -82,7 +83,7 @@ class ExternalDriver:
     def __init__(self, driver_name: str, python: str = sys.executable):
         self.name = driver_name
         self.python = python
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._proc: Optional[subprocess.Popen] = None
         self._rpc: Optional[RpcClient] = None
 

@@ -15,6 +15,7 @@ import threading
 from typing import Any, Dict, Optional
 
 from .codec import FrameCodec, RpcError
+from ..utils.locks import make_lock
 
 
 class _Pending:
@@ -33,7 +34,7 @@ class RpcClient:
         self.port = int(port)
         self.dial_timeout_s = dial_timeout_s
         self._seq = itertools.count(1)
-        self._lock = threading.Lock()          # connection + write lock
+        self._lock = make_lock()          # connection + write lock
         self._codec: Optional[FrameCodec] = None
         self._pending: Dict[int, _Pending] = {}
         self._closed = False

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional
 from ..models import Allocation, Node
 from ..utils.codec import from_wire, to_wire
 from .codec import FrameCodec
+from ..utils.locks import make_lock
 
 LOG = logging.getLogger("nomad_tpu.rpc")
 
@@ -189,7 +190,7 @@ class RpcServer:
     def _serve_conn(self, sock: socket.socket) -> None:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         codec = FrameCodec(sock)
-        wlock = threading.Lock()
+        wlock = make_lock()
         try:
             while True:
                 frame = codec.read_frame()

@@ -7,12 +7,12 @@ Plans/Evals for assertions.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, List, Optional
 
 from ..models import Evaluation, Plan, PlanResult
 from ..state import StateStore
 from .scheduler import new_scheduler
+from ..utils.locks import make_lock
 
 
 class RejectPlan:
@@ -51,7 +51,7 @@ class Harness:
         self.evals: List[Evaluation] = []
         self.create_evals: List[Evaluation] = []
         self.reblock_evals: List[Evaluation] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._next_index = 1000
 
     def _trim(self, lst: List) -> None:

@@ -16,7 +16,6 @@ placement (stack.go:321-411), this engine:
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -34,6 +33,7 @@ from ..ops import NodeTable, ProposedIndex, SelectKernel, SelectRequest
 from ..ops.select import TOP_K
 from ..ops.tables import DIM_NAMES
 from ..ops.targets import affinity_columns, constraint_mask
+from ..utils.locks import make_lock
 
 
 # -- cross-eval host-phase reuse (group-commit PR, tentpole part 2) ----
@@ -65,7 +65,7 @@ from ..ops.targets import affinity_columns, constraint_mask
 ENGINE_CACHE_MAX = 4096
 
 _ENGINE_CACHE: Dict[Tuple, "_EngineEntry"] = {}
-_ENGINE_CACHE_L = threading.Lock()
+_ENGINE_CACHE_L = make_lock()
 
 ENGINE_CACHE_STATS: Dict[str, int] = {
     "entry_hits": 0, "entry_misses": 0,

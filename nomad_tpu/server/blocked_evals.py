@@ -8,10 +8,10 @@ missed-unblock index check:316.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..models import Evaluation
+from ..utils.locks import make_lock
 
 UNBLOCK_CH_SIZE = 256
 
@@ -26,7 +26,7 @@ class _BlockedStats:
 class BlockedEvals:
     def __init__(self, enqueue_fn: Callable[[Evaluation], None]):
         """enqueue_fn pushes an unblocked eval back into the EvalBroker."""
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._enabled = False
         self._enqueue = enqueue_fn
         # eval id -> (eval, token-ignored)

@@ -52,6 +52,7 @@ from .periodic import PeriodicDispatch
 from .plan_applier import PlanApplier
 from .plan_queue import PlanQueue
 from .worker import Worker
+from ..utils.locks import make_lock, make_rlock
 
 CORE_JOB_PRIORITY = 200  # structs.go CoreJobPriority = 2 * JobMaxPriority
 
@@ -276,6 +277,19 @@ class ServerConfig:
     # replacing the scatter history) — the mesh analog of
     # governor_table_delta_debt_high
     mesh_reshard_debt_high: int = 500_000
+    # runtime deadlock & race sanitizer (analysis/race.py via the
+    # utils/locks.py factory, ISSUE 14): a lock held at/beyond this
+    # long keeps a worst-K exemplar (stack at release) in the `locks`
+    # block of /v1/operator/governor — the worst holders are exactly
+    # the sites that serialize the fleet under contention. The shims
+    # themselves only exist for locks constructed under
+    # NOMAD_TPU_RACE=1; these knobs tune the process-global monitor
+    race_lock_hold_warn_ms: float = 50.0
+    # worst-holder exemplar slots retained (sorted by hold time)
+    race_exemplar_slots: int = 8
+    # findings ring bound (lock-order cycles, self-deadlocks,
+    # unguarded mutations) — dedup by site keeps this small anyway
+    race_max_findings: int = 256
 
 
 class Server:
@@ -298,9 +312,16 @@ class Server:
         # switch NOMAD_TPU_MESH_RESIDENT wins inside resident_enabled()
         from ..parallel import sharded_table as _sharded_table
         _sharded_table.configure(resident=self.config.mesh_resident)
+        # runtime race sanitizer knobs (module-level, same idiom —
+        # the lock shims are process-global)
+        from ..analysis import race as _race
+        _race.configure(
+            hold_warn_ms=self.config.race_lock_hold_warn_ms,
+            exemplar_slots=self.config.race_exemplar_slots,
+            max_findings=self.config.race_max_findings)
         # RLock: FSM appliers can nest (e.g. a node-register unblocking a
         # blocked eval re-enters raft_apply on the same thread)
-        self._raft_l = threading.RLock()
+        self._raft_l = make_rlock()
         self._raft_index = 10
         self.eval_broker = EvalBroker()
         # backpressure escalation threshold lives on the broker even
@@ -398,18 +419,18 @@ class Server:
                 extra_fn=self._telemetry_extra)
         self.workers: List[Worker] = []
         self._heartbeat_timers: Dict[str, threading.Timer] = {}
-        self._hb_lock = threading.Lock()
+        self._hb_lock = make_lock()
         # per-node host-stats payloads carried by heartbeats (ISSUE
         # 13): node_id -> {payload..., received_at}; folded into the
         # cluster.* rollup by cluster_stats(), pruned when the node
         # record disappears
         self._node_stats: Dict[str, dict] = {}
-        self._node_stats_l = threading.Lock()
+        self._node_stats_l = make_lock()
         self._leader = False
-        self._member_l = threading.Lock()   # join/leave RMW serialization
+        self._member_l = make_lock()   # join/leave RMW serialization
         # serializes enforced (-check-index) registrations: the CAS
         # check and the apply must not interleave across HTTP threads
-        self._register_l = threading.Lock()
+        self._register_l = make_lock()
         self._acl_cache: Dict = {}      # (policies, index) -> compiled ACL
         self.raft = None                # multi-server consensus (raft.py)
         self.swim = None                # peer failure detection (swim.py)
@@ -803,6 +824,25 @@ class Server:
         from ..analysis.sanitizer import traces as lint_traces
         gov.register("lint.recompiles", lint_traces.count,
                      suspect=False)
+
+        # lock traffic (analysis/race.py, ISSUE 14): populated only
+        # when NOMAD_TPU_RACE=1 armed the shims — zeros otherwise.
+        # All monotone counters or bounded structures, never drift
+        # suspects. The worst-holder exemplars ride the `locks` block
+        # of /v1/operator/governor (extra_status below)
+        from ..analysis import race as _race_mod
+        gov.register("lock.tracked", _race_mod.monitor.tracked_locks,
+                     suspect=False)
+        gov.register("lock.order_edges", _race_mod.monitor.edge_count,
+                     suspect=False)
+        gov.register("lock.contended_acquires",
+                     _race_mod.monitor.contended_total, suspect=False)
+        gov.register("lock.hold_warnings",
+                     _race_mod.monitor.hold_warns_total, suspect=False)
+        gov.register("lock.findings",
+                     _race_mod.monitor.unsuppressed_count,
+                     suspect=False)
+        gov.extra_status["locks"] = _race_mod.monitor.status_snapshot
 
         # flight-recorder visibility (ISSUE 9): ring occupancy and the
         # exemplar count in /v1/operator/governor. suspect=False: both

@@ -61,9 +61,11 @@ class DeploymentsWatcher:
         if enabled and not self._enabled:
             self._enabled = True
             self._gen += 1
-            # deadlines restart on (re-)election: clear here, where no
-            # older-generation thread can repopulate after the clear
-            self._progress.clear()
+            # deadlines restart on (re-)election: REBIND instead of
+            # clear() — a stale-generation thread caught mid-tick past
+            # its _live check keeps mutating the old (now garbage)
+            # dict instead of repopulating the fresh one
+            self._progress = {}
             self._thread = threading.Thread(target=self._run,
                                             args=(self._gen,), daemon=True,
                                             name="deployment-watcher")

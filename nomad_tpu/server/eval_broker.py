@@ -15,6 +15,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..models import Evaluation, JOB_TYPE_CORE
 from ..utils.ids import generate_uuid
+from ..utils.locks import make_condition
 
 FAILED_QUEUE = "_failed"
 
@@ -94,7 +95,7 @@ class EvalBroker:
         self.initial_nack_delay_s = initial_nack_delay_s
         self.subsequent_nack_delay_s = subsequent_nack_delay_s
 
-        self._l = threading.Condition()
+        self._l = make_condition()
         self._enabled = False
         self._ready: Dict[str, _PQ] = {}               # queue -> heap
         self._unack: Dict[str, _Unack] = {}            # eval id -> unack

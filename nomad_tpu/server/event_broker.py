@@ -13,9 +13,9 @@ so a new subscriber can replay recent history from a given index.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
+from ..utils.locks import make_condition, make_lock
 
 TOPIC_JOB = "Job"
 TOPIC_EVAL = "Evaluation"
@@ -53,7 +53,7 @@ class Subscription:
                  max_queued: int = 1024):
         self._broker = broker
         self.topics = topics
-        self._cond = threading.Condition()
+        self._cond = make_condition()
         self._queue: List[Event] = []
         self._max = max_queued
         self.closed = False
@@ -114,7 +114,7 @@ class EventBroker:
 
     def __init__(self, size: int = 4096,
                  max_bytes: int = DEFAULT_MAX_BYTES):
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._buffer: List[Event] = []   # ring of recent events
         self._size = size
         self._max_bytes = max_bytes

@@ -17,6 +17,7 @@ import time
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+from ..utils.locks import make_lock
 
 LOG = logging.getLogger("nomad_tpu.event_sink")
 
@@ -192,7 +193,7 @@ class EventSinkManager:
 
     def __init__(self, server):
         self.server = server
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._enabled = False
         self._gen = 0               # retires stale watcher threads
         self._workers: Dict[str, _SinkWorker] = {}

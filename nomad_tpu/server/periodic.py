@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Tuple
 from ..models import Evaluation, Job, JOB_STATUS_DEAD, EVAL_STATUS_PENDING
 from ..models.evaluation import TRIGGER_PERIODIC_JOB
 from ..utils.cron import Cron, CronParseError
+from ..utils.locks import make_condition, make_lock
 
 LOG = logging.getLogger("nomad_tpu.periodic")
 
@@ -30,14 +31,14 @@ PERIODIC_LAUNCH_SUFFIX = "/periodic-"
 class PeriodicDispatch:
     def __init__(self, server):
         self.srv = server
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._tracked: Dict[Tuple[str, str], Job] = {}
         # heap entries carry a generation; re-adding a job bumps the
         # generation so stale entries are discarded on pop instead of
         # firing duplicate launches (periodic.go Add updates in place)
         self._gen: Dict[Tuple[str, str], int] = {}
         self._heap: List[Tuple[float, Tuple[str, str], int]] = []
-        self._wake = threading.Condition(self._lock)
+        self._wake = make_condition(self._lock)
         self._enabled = False
         self._thread: Optional[threading.Thread] = None
         self._stopped = False

@@ -25,6 +25,7 @@ from ..models.alloc import DesiredTransition
 from ..models.deployment import DeploymentStatusUpdate
 from ..models.node import DrainStrategy
 from ..utils.codec import from_wire, to_wire
+from ..utils.locks import make_lock
 
 # payload field -> model type (list-wrapped == repeated)
 SCHEMAS: Dict[str, Dict[str, Any]] = {
@@ -130,7 +131,7 @@ class RaftLog:
 
     def __init__(self, path: str):
         self.path = path
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._f: Optional[BinaryIO] = None
         self._good_offset: Optional[int] = None
         self._dirty = False      # flushed-but-not-fsynced bytes pending
@@ -278,14 +279,20 @@ class Persistence:
         os.makedirs(data_dir, exist_ok=True)
         self.log = RaftLog(os.path.join(data_dir, self.WAL))
         self._since_snapshot = 0
-        self._l = threading.Lock()
-        self._snap_l = threading.Lock()      # one snapshot writer
-        self._trigger_l = threading.Lock()
+        self._l = make_lock()
+        self._snap_l = make_lock()      # one snapshot writer
+        self._trigger_l = make_lock()
         self._snap_thread: Optional[threading.Thread] = None
         # absolute WAL mark of the newest PUBLISHED snapshot: a writer
         # whose capture is older must not replace it (a sync snapshot
         # racing a slow background writer), monotone under _snap_l
         self._published_mark = -1
+        # counters are += read-modify-writes from the applier (trigger
+        # path, under _trigger_l), the writer thread (under _snap_l),
+        # and boot restore — no shared lock between them, so they get
+        # their own
+        self._stats_l = make_lock()
+        # nomad-lint: guarded-by[_stats_l]
         self.stats: Dict[str, Any] = {
             "snapshots": 0, "background_snapshots": 0,
             "snapshot_skipped_inflight": 0, "last_snapshot_s": 0.0,
@@ -356,12 +363,14 @@ class Persistence:
                                        strict_map_key=False)
             # snapshot index tuples were listified by msgpack
             self.restored_extra = data.pop("extra", {}) or {}
-            self.stats["restore_format"] = int(data.get("format", 1))
+            with self._stats_l:
+                self.stats["restore_format"] = int(data.get("format", 1))
             store.restore(data)
             highest = store.latest_index()
         entries = self.log.replay()
         self.log.open()
-        self.stats["restore_s"] = _time.perf_counter() - t0
+        with self._stats_l:
+            self.stats["restore_s"] = _time.perf_counter() - t0
         if stages.enabled:
             stages.add("restore", self.stats["restore_s"])
         return highest, entries
@@ -400,7 +409,8 @@ class Persistence:
         with self._trigger_l:
             t = self._snap_thread
             if t is not None and t.is_alive():
-                self.stats["snapshot_skipped_inflight"] += 1
+                with self._stats_l:
+                    self.stats["snapshot_skipped_inflight"] += 1
                 return None
             snap = store.snapshot()
             extra = self.extra_provider() \
@@ -414,7 +424,8 @@ class Persistence:
                                  name="snapshot-writer")
             self._snap_thread = t
             t.start()
-            self.stats["background_snapshots"] += 1
+            with self._stats_l:
+                self.stats["background_snapshots"] += 1
             return t
 
     def snapshot(self, store) -> None:
@@ -463,11 +474,12 @@ class Persistence:
                 os.replace(tmp, self.snapshot_path)
                 self.log.truncate_prefix(wal_mark)
                 self._published_mark = wal_mark
-                self.stats["snapshots"] += 1
-                self.stats["last_snapshot_s"] = \
-                    _time.perf_counter() - t0
-                self.stats["last_snapshot_format"] = \
-                    int(data.get("format", 1))
+                with self._stats_l:
+                    self.stats["snapshots"] += 1
+                    self.stats["last_snapshot_s"] = \
+                        _time.perf_counter() - t0
+                    self.stats["last_snapshot_format"] = \
+                        int(data.get("format", 1))
                 try:
                     self.save_cost_model()
                 except OSError:     # pragma: no cover — best effort
@@ -478,4 +490,5 @@ class Persistence:
             import logging
             logging.getLogger("nomad_tpu.persistence").exception(
                 "snapshot write failed")
-            self.stats["snapshot_errors"] += 1
+            with self._stats_l:
+                self.stats["snapshot_errors"] += 1

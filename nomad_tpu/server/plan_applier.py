@@ -48,6 +48,7 @@ from ..models import (
 )
 from ..models.evaluation import TRIGGER_PREEMPTION
 from .plan_queue import PendingPlan, PlanQueue
+from ..utils.locks import make_lock
 
 PLAN_GROUP_ENV = "NOMAD_TPU_PLAN_GROUP"
 
@@ -110,7 +111,7 @@ class PlanApplier:
         # leave the overlay early; sibling in-flight plans may still
         # commit and must keep occupying capacity until applied
         self._failed_pending: set = set()
-        self._failed_l = threading.Lock()
+        self._failed_l = make_lock()
         # per-applier group accounting (the governor gauges read these;
         # GROUP_STATS above is the cross-server bench aggregate)
         self.stats: Dict[str, int] = {
@@ -122,7 +123,7 @@ class PlanApplier:
         self._group_bound: Optional[int] = None
         self._clean_groups = 0
         self._conflicts: deque = deque()
-        self._conflict_l = threading.Lock()
+        self._conflict_l = make_lock()
 
     def start(self) -> None:
         import queue as queue_mod

@@ -7,11 +7,11 @@ the worker blocks on; Dequeue:126 pops highest priority for the applier.
 from __future__ import annotations
 
 import heapq
-import threading
 from concurrent.futures import Future
 from typing import List, Optional, Tuple
 
 from ..models import Plan
+from ..utils.locks import make_condition
 
 
 class PendingPlan:
@@ -30,7 +30,7 @@ class PendingPlan:
 
 class PlanQueue:
     def __init__(self):
-        self._l = threading.Condition()
+        self._l = make_condition()
         self._enabled = False
         self._heap: List[Tuple[int, int, PendingPlan]] = []
         self._seq = 0

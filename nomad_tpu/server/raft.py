@@ -48,6 +48,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .persistence import decode_payload, encode_payload
+from ..utils.locks import make_condition, make_lock, make_rlock
 
 LOG = logging.getLogger("nomad_tpu.raft")
 
@@ -70,7 +71,7 @@ class RaftNode:
         self.cluster_size = len(self.peers) + 1
         self.data_dir = data_dir
 
-        self._lock = threading.RLock()
+        self._lock = make_rlock()
         self.role = FOLLOWER
         self.term = 0
         self.voted_for: Optional[str] = None
@@ -93,7 +94,7 @@ class RaftNode:
         # quorum commit tracking: an entry is committed once a majority
         # of match indexes cover it and it belongs to the current term
         self.commit_index = self.base_index
-        self._commit_cv = threading.Condition(self._lock)
+        self._commit_cv = make_condition(self._lock)
         self._repl_gen = 0            # invalidates stale repl threads
         self._repl_events: Dict[str, threading.Event] = {}
         self._snap_gen = 0            # invalidates an in-flight FSM batch
@@ -435,7 +436,7 @@ class RaftNode:
             term = self.term
             self._election_deadline = self._new_deadline()
         last_index, last_term = self.last_log()
-        tally_l = threading.Lock()
+        tally_l = make_lock()
         votes = [1]                       # self-vote
         higher_term = [0]
         outcome = threading.Event()       # majority reached or must step down

@@ -29,6 +29,7 @@ from typing import List, Optional
 
 from ..models import Evaluation, JOB_TYPE_CORE, Plan, PlanResult
 from ..scheduler import new_scheduler
+from ..utils.locks import make_condition, make_lock
 
 LOG = logging.getLogger("nomad_tpu.worker")
 
@@ -61,7 +62,7 @@ class BatchGateway:
     def __init__(self, kernel, lanes: int, lane_base: int = 0,
                  lane_total: Optional[int] = None):
         self._kernel = kernel
-        self._cv = threading.Condition()
+        self._cv = make_condition()
         self._active = lanes
         # cross-worker decorrelation for batched lanes: each worker's
         # gateway slices the node hash space at an offset so two
@@ -99,7 +100,6 @@ class BatchGateway:
                     remaining = self.window_s - (time.monotonic()
                                                  - self._open_t)
                     if remaining <= 0:
-                        # nomad-lint: allow[lock-discipline] _fire releases the cv around the kernel dispatch (see its body)
                         self._fire()
                         continue
                     self._cv.wait(remaining)
@@ -239,7 +239,7 @@ class MicroBatchGateway:
             from ..ops import SelectKernel
             kernel = SelectKernel()
         self._kernel = kernel
-        self._cv = threading.Condition()
+        self._cv = make_condition()
         self._waiting: List = []    # [[req, slot, arrival_t, decor]]
         self._inflight = 0
         self.min_batch = max(2, int(min_batch))
@@ -377,7 +377,6 @@ class MicroBatchGateway:
             worth = self._worth_waiting(req)
             if worth and len(self._waiting) >= self.min_batch and \
                     self._inflight < self.MAX_INFLIGHT:
-                # nomad-lint: allow[lock-discipline] _fire releases the cv around the kernel dispatch (see its body)
                 self._fire("occupancy")
             elif not worth or (self._inflight == 0
                                and not self._streaming()):
@@ -943,7 +942,7 @@ class Worker:
             # phase inflates ALL of them — lane threads PULL from the
             # drained batch instead of one-thread-per-eval
             lanes = min(MICRO_LANES, len(batch))
-            lock = threading.Lock()
+            lock = make_lock()
             it = iter(batch)
 
             def lane_run():

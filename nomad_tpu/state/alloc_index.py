@@ -34,11 +34,11 @@ writers only append deltas (applied under the cache lock).
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils.locks import make_lock
 from ..models import (
     ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
     ALLOC_CLIENT_PENDING, ALLOC_CLIENT_RUNNING,
@@ -274,7 +274,7 @@ class AllocIndexCache:
         self.max_jobs = max_jobs
         self.delta_max = delta_max
         self._entries: Dict[Tuple[str, str], _Entry] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self.stats = {"rebuilds": 0, "delta_syncs": 0, "delta_rows": 0,
                       "entry_drops": 0, "folds": 0}
 

@@ -15,7 +15,6 @@ HAMTs maintained in the same transaction.
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from dataclasses import dataclass, field, replace
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
@@ -33,6 +32,7 @@ from ..models import (
 from ..models.deployment import DeploymentStatusUpdate
 from ..utils.hamt import EditContext, Hamt  # noqa: F401 (substrate option)
 from ..utils.layermap import LayerMap
+from ..utils.locks import make_condition, make_rlock
 
 # Table substrate: LayerMap implements the same persistent-map
 # contract as Hamt (O(1) snapshots, transient edit sessions) on
@@ -436,8 +436,8 @@ class StateStore(StateSnapshot):
         self._store = self  # StateStore doubles as its own snapshot view
         # RLock: composite mutations re-enter (e.g. update_deployment_status
         # upserting the rolled-back job via upsert_job)
-        self._lock = threading.RLock()
-        self._watch = threading.Condition()
+        self._lock = make_rlock()
+        self._watch = make_condition()
         # bounded changelog feeding the resident NodeTable's delta path:
         # (index, kind, key) in index order; entries at or below
         # _change_floor may have been pruned

@@ -38,6 +38,7 @@ import numpy as np
 
 from ..governor.governor import rss_mb
 from ..utils import metrics
+from ..utils.locks import make_lock
 
 # hard series ceiling: a gauge-name churn storm (e.g. per-job counter
 # keys) must not grow the ring without bound — excess series are
@@ -126,7 +127,7 @@ class TelemetryCollector:
         self.stage_fn = stage_fn
         self.device_fn = device_fn
         self.extra_fn = extra_fn
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._t = np.full(self.slots, np.nan, dtype=np.float64)
         self._series: Dict[str, np.ndarray] = {}
         self._n = 0                     # total samples ever written

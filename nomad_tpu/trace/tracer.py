@@ -62,6 +62,7 @@ from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils import stages
+from ..utils.locks import make_lock
 
 TRACE_ENV = "NOMAD_TPU_TRACE"
 
@@ -220,7 +221,7 @@ class Tracer:
     def __init__(self, ring_bytes: int = DEFAULT_RING_BYTES,
                  exemplar_slots: int = DEFAULT_EXEMPLAR_SLOTS,
                  threshold_pct: float = DEFAULT_THRESHOLD_PCT):
-        self._l = threading.Lock()
+        self._l = make_lock()
         self.ring_bytes = int(ring_bytes)
         self.exemplar_slots = int(exemplar_slots)
         self.threshold_pct = float(threshold_pct)
@@ -249,7 +250,7 @@ class Tracer:
         self._own_p99 = 0.0
         self._own_since_p99 = 0
         self._stage_res: Dict[str, deque] = {}
-        self._stage_l = threading.Lock()
+        self._stage_l = make_lock()
         self.stats = {"traces": 0, "spans": 0, "dropped": 0,
                       "exemplar_promotions": 0, "exemplar_pins": 0}
 

@@ -21,10 +21,10 @@ agent actually runs.
 from __future__ import annotations
 
 import gc
-import threading
 import time
+from .locks import make_lock
 
-_lock = threading.Lock()
+_lock = make_lock()
 _participants = 0
 _was_enabled = True
 _last_collect = 0.0

@@ -1,0 +1,71 @@
+"""Lock factory: the ONE place nomad_tpu constructs its mutexes.
+
+Every `threading.Lock` / `RLock` / `Condition` in the package is born
+here (the `raw-lock` lint pass enforces it), so a single env switch —
+`NOMAD_TPU_RACE=1` — swaps the whole process onto the instrumented
+shims in `analysis/race.py`: acquisition-order graph with
+potential-deadlock detection, hold-time / contention accounting behind
+the governor's `lock.*` gauges, and guarded-structure mutation
+checks. With the switch off (the default) the factory returns the raw
+threading primitives — zero wrapping, zero overhead.
+
+Locks are named by CONSTRUCTION SITE (`eval_broker.py:97`) unless the
+caller passes an explicit name: every instance born at one site is a
+single node in the order graph, which is the lockdep convention — the
+discipline is per lock CLASS, not per instance.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Optional
+
+_RACE_ENV = "NOMAD_TPU_RACE"
+
+
+def _race_on() -> bool:
+    """THE switch predicate — analysis/race.enabled() delegates here
+    so the env name and falsy set live in exactly one place (the
+    factory and the monitor must never disagree about whether the
+    shims exist)."""
+    return os.environ.get(_RACE_ENV, "") not in ("", "0", "off", "no")
+
+
+def _site_name(depth: int = 2) -> str:
+    f = sys._getframe(depth)
+    return (f"{os.path.basename(f.f_code.co_filename)}"
+            f":{f.f_lineno}")
+
+
+def make_lock(name: Optional[str] = None):
+    """A mutex (threading.Lock contract)."""
+    if not _race_on():
+        return threading.Lock()
+    from ..analysis import race
+    return race.InstrumentedLock(name or _site_name(), rlock=False)
+
+
+def make_rlock(name: Optional[str] = None):
+    """A re-entrant mutex (threading.RLock contract)."""
+    if not _race_on():
+        return threading.RLock()
+    from ..analysis import race
+    return race.InstrumentedLock(name or _site_name(), rlock=True)
+
+
+def make_condition(lock=None, name: Optional[str] = None):
+    """A condition variable (threading.Condition contract), optionally
+    sharing a lock previously built by this factory — the raft idiom
+    `make_condition(self._lock)` keeps cv and mutex one bookkeeping
+    node."""
+    if not _race_on():
+        return threading.Condition(lock)
+    from ..analysis import race
+    if lock is None or isinstance(lock, race.InstrumentedLock):
+        return race.InstrumentedCondition(
+            lock=lock, name=name or _site_name())
+    # a raw lock slipped in (constructed before the switch flipped):
+    # stay uninstrumented rather than split the bookkeeping
+    return threading.Condition(lock)

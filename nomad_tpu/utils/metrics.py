@@ -27,9 +27,9 @@ ISSUE 11 parity fixes vs the pre-r15 registry:
 from __future__ import annotations
 
 import re
-import threading
 import time
 from typing import Dict, List, Optional, Tuple
+from .locks import make_lock
 
 # the InmemSink aggregation interval (go-metrics DefaultInmemInterval
 # is 10s; command.go passes 10s): Timestamp anchors to multiples of it
@@ -147,7 +147,7 @@ def prom_name(name: str) -> str:
 
 class MetricsRegistry:
     def __init__(self):
-        self._l = threading.Lock()
+        self._l = make_lock()
         self._gauges: Dict[str, float] = {}
         self._counters: Dict[str, _Sample] = {}
         self._samples: Dict[str, _Sample] = {}

@@ -7,9 +7,9 @@ from __future__ import annotations
 
 import collections
 import logging
-import threading
 import time
 from typing import List, Optional, Tuple
+from .locks import make_condition, make_lock
 
 _LEVELS = {"trace": 5, "debug": logging.DEBUG, "info": logging.INFO,
            "warn": logging.WARNING, "error": logging.ERROR}
@@ -24,7 +24,7 @@ class MonitorBuffer(logging.Handler):
             "%(asctime)s [%(levelname)s] %(name)s: %(message)s"))
         self._buf: collections.deque = collections.deque(maxlen=capacity)
         self._seq = 0
-        self._cond = threading.Condition()
+        self._cond = make_condition()
 
     def emit(self, record: logging.LogRecord) -> None:
         try:
@@ -54,7 +54,7 @@ class MonitorBuffer(logging.Handler):
 
 
 _buffer: Optional[MonitorBuffer] = None
-_lock = threading.Lock()
+_lock = make_lock()
 
 
 def get_buffer() -> MonitorBuffer:

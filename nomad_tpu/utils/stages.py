@@ -72,8 +72,8 @@ between rounds, not the absolute seconds.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, Optional
+from .locks import make_lock
 
 STAGES = ("restore", "wal_replay", "table_build", "h2d", "kernel",
           "d2h", "reconcile", "preempt", "queue_wait", "gateway_wait",
@@ -103,7 +103,7 @@ COLD_STAGES = frozenset({"restore", "wal_replay"})
 
 enabled = False
 
-_l = threading.Lock()
+_l = make_lock()
 _acc: Dict[str, list] = {s: [0.0, 0] for s in STAGES}
 
 # the flight recorder's tap (nomad_tpu/trace/ installs it at import):

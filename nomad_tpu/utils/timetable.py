@@ -9,16 +9,16 @@ GC decisions are pure functions of raft indexes.
 from __future__ import annotations
 
 import bisect
-import threading
 import time
 from typing import List, Tuple
+from .locks import make_lock
 
 
 class TimeTable:
     def __init__(self, granularity_s: float = 1.0, limit: int = 72 * 3600):
         self._granularity = granularity_s
         self._limit = limit           # max entries retained
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._times: List[float] = []
         self._indexes: List[int] = []
 

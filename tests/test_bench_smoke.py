@@ -99,6 +99,9 @@ def test_bench_py_emits_json_line_on_cpu():
     # seconds / fresh-compile counts are first-class artifact keys —
     # the validation campaign's instruments
     assert data["telemetry"] == "on"
+    # runtime race sanitizer attribution (ISSUE 14): governed runs
+    # must record whether the lock shims were instrumenting
+    assert data["race"] in ("on", "off")
     assert 0.0 <= data["pad_waste_ratio"] < 1.0
     assert data["device_dispatch_s"], "no arm reported dispatch time"
     assert all(v >= 0 for v in data["device_dispatch_s"].values())

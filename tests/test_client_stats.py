@@ -354,19 +354,28 @@ def test_client_stats_kill_switch(monkeypatch):
 # -- ISSUE 13 satellite: paired sampler-overhead smoke ------------------
 
 def test_stats_sampler_overhead_within_5pct():
-    """Stats-on e2e eval latency within 5% of stats-off at bench quick
-    scale (the r13/r15 paired methodology): modes alternate eval-by-
-    eval so workload non-stationarity hits both classes identically;
-    'on' evals ALSO pay a full host sample_once() every 8th eval — at
-    ~ms evals that is far denser than the production 1 s cadence, so
-    the 5% bound is a fortiori for the background thread. Medians are
-    outlier-robust; bounded retries absorb CI noise."""
+    """Two overhead bounds (the r13/r15 paired methodology, split):
+    (a) stats-on MODE keeps e2e eval latency within 5% of stats-off —
+    modes alternate eval-by-eval so workload non-stationarity hits
+    both classes identically, medians are outlier-robust, bounded
+    retries absorb CI noise; (b) a full host sample_once() (run every
+    8th eval so it's exercised under the live workload) stays under a
+    5% duty cycle at the production 1 s cadence — the bound the
+    background sampler thread actually imposes on the node."""
     from nomad_tpu.bench.ladder import _eval_for, _seed_nodes
     from nomad_tpu.scheduler.harness import Harness
     from nomad_tpu.utils import gcsafe
 
     h = Harness()
-    _seed_nodes(h, 200, dcs=1)
+    # capacity must survive the retry budget (the r16 test_trace fix):
+    # mock nodes hold 7 allocs each, so at the original 200 nodes
+    # (cap 1400) the 32-pair phases ran DRY mid-second-retry whenever
+    # full-suite load made the noise retries trigger — the
+    # measurement-phase evals then placed nothing and the medians were
+    # garbage. 256 nodes keep the same _pad_n bucket (256) and the
+    # 24-pair phases below fit the whole warm + three measured phases
+    # (40 + 3 x 480 = 1480) under the 1792 ceiling
+    _seed_nodes(h, 256, dcs=1)
     hs = HostStatsCollector(client=None, interval_s=1.0, slots=64)
 
     def mk_job(tag, i):
@@ -380,8 +389,9 @@ def test_stats_sampler_overhead_within_5pct():
         tg.networks = []
         return job
 
-    def run_paired(tag, n_pairs=32):
+    def run_paired(tag, n_pairs=24):
         times = {True: [], False: []}
+        sample_times = []
         with gcsafe.safepoints():
             for i in range(2 * n_pairs):
                 on = (i % 2 == 0)
@@ -390,28 +400,44 @@ def test_stats_sampler_overhead_within_5pct():
                 ev = _eval_for(job)
                 t0 = time.perf_counter()
                 h.process("service", ev)
+                t1 = time.perf_counter()
                 if on and i % 8 == 0:
                     hs.sample_once()
-                times[on].append(time.perf_counter() - t0)
+                    sample_times.append(time.perf_counter() - t1)
+                times[on].append(t1 - t0)
                 gcsafe.safepoint()
 
         def median(v):
             v = sorted(v)
             return v[len(v) // 2]
 
-        return median(times[True]), median(times[False])
+        # the sample is timed SEPARATELY from its host eval: in-eval
+        # timing compared the on-median (the ~67th percentile of the
+        # unsampled evals — the sampled ones occupy the top ranks)
+        # against a true 50th for off, a bias proportional to
+        # eval-time variance that full-suite heap state inflates past
+        # 5%. Mode overhead and sampler cost get their own bounds below
+        return (median(times[True]), median(times[False]),
+                median(sample_times) if sample_times else 0.0)
 
     run_paired("warm", n_pairs=2)           # compile + caches
-    on, off = run_paired("m0")
-    # three bounded noise retries with min-folding: the medians sit at
-    # ~2-3 ms/eval where shared-CI scheduler noise alone can exceed
-    # 5%, so a single measurement must never be the verdict
-    for attempt in range(3):
+    on, off, sample = run_paired("m0")
+    # two bounded noise retries with min-folding (the capacity budget
+    # above covers exactly warm + three measured phases): the medians
+    # sit at ~2-3 ms/eval where shared-CI scheduler noise alone can
+    # exceed 5%, so a single measurement must never be the verdict
+    for attempt in range(2):
         if on <= off / 0.95:
             break
-        on2, off2 = run_paired(f"m{attempt + 1}")   # noise retry
+        on2, off2, sample2 = run_paired(f"m{attempt + 1}")
         on, off = min(on, on2), min(off, off2)
+        sample = min(sample, sample2)
     assert on <= off / 0.95, (
         f"stats-on median {on * 1e3:.2f} ms/eval vs off "
         f"{off * 1e3:.2f} ms/eval")
+    # (b) the sampler itself: /proc reads + driver stats pulls must
+    # stay under a 5% duty cycle at the production cadence
+    assert sample <= 0.05 * 1.0, (
+        f"host sample_once median {sample * 1e3:.2f} ms exceeds a 5% "
+        f"duty cycle at the 1 s production interval")
     assert hs.status()["samples"] > 0

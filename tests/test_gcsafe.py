@@ -10,6 +10,8 @@ import gc
 import time
 import weakref
 
+import pytest
+
 from nomad_tpu.utils import gcsafe
 
 
@@ -74,7 +76,13 @@ def test_soak_heap_stays_bounded(monkeypatch):
         gcsafe._last_full_collect = 0.0
         gcsafe.safepoint()
         grown = len(gc.get_objects()) - baseline
-    assert i > 10, "soak loop barely ran"
+    if i <= 10:
+        # the loop is wall-clock-bound (2 s): on a loaded shared box
+        # the iterations collapse and the flatness verdict means
+        # nothing — skip instead of failing on scheduler starvation
+        # (the CHANGES.md r17 box flake)
+        pytest.skip(f"box under load: soak loop ran only {i} "
+                    f"iterations in its 2 s window")
     assert grown < 5000, f"tracked objects grew by {grown} over the soak"
 
 

@@ -7,7 +7,8 @@ import numpy as np
 import pytest
 
 from nomad_tpu.analysis import (DtypeRule, HostSyncRule, JitHygieneRule,
-                                LockRule, Project, SurfaceDriftRule,
+                                LockRule, Project, RawLockRule,
+                                SharedStateRule, SurfaceDriftRule,
                                 sanitizer)
 
 
@@ -270,6 +271,318 @@ class TestLockDiscipline:
         assert all("device" in f.message for f in out)
 
 
+# -- pass 4b: INTERPROCEDURAL lock discipline (ISSUE 14) ---------------
+
+# the cycle hides behind a helper chain TWO calls deep: f holds A and
+# calls h1 -> h2, where h2 takes B; g takes B then A directly. The
+# one-call-deep r8 pass could not see the A->B edge.
+DEEP_CYCLE = """\
+class T:
+    def f(self):
+        with self._a_lock:
+            self.h1()
+
+    def h1(self):
+        self.h2()
+
+    def h2(self):
+        with self._b_lock:
+            pass
+
+    def g(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+"""
+
+# same shape, but g orders consistently with the transitive edge
+DEEP_NO_CYCLE = DEEP_CYCLE.replace(
+    "    def g(self):\n"
+    "        with self._b_lock:\n"
+    "            with self._a_lock:\n",
+    "    def g(self):\n"
+    "        with self._a_lock:\n"
+    "            with self._b_lock:\n")
+
+DEEP_DISPATCH = """\
+import jax
+
+class D:
+    def entry(self):
+        with self._l:
+            self.h1()
+
+    def h1(self):
+        self.h2()
+
+    def h2(self):
+        return jax.device_put(1)
+"""
+
+# the release-around-dispatch idiom (the BatchGateway._fire shape):
+# helper explicitly releases the held cv before dispatching — the
+# pass must understand it, not demand a suppression
+RELEASE_AROUND = """\
+import jax
+
+class G:
+    def entry(self):
+        with self._cv:
+            self.fire()
+
+    def fire(self):
+        self._cv.release()
+        try:
+            out = jax.device_put(1)
+        finally:
+            self._cv.acquire()
+        return out
+"""
+
+
+class TestInterproceduralLock:
+    def test_cycle_through_two_deep_helper(self):
+        out = active(lint({HOT: DEEP_CYCLE}, [LockRule()]))
+        assert len(out) == 1
+        assert "T._a_lock" in out[0].message
+        assert "T._b_lock" in out[0].message
+        assert "deadlock" in out[0].message
+
+    def test_consistent_order_through_helper_clean(self):
+        assert not active(lint({HOT: DEEP_NO_CYCLE}, [LockRule()]))
+
+    def test_cross_file_cycle_through_helper(self):
+        f1 = ("class A:\n"
+              "    def f(self):\n"
+              "        with self._x_lock:\n"
+              "            self.take_y()\n")
+        f2 = ("class A:\n"
+              "    def take_y(self):\n"
+              "        with self._y_lock:\n"
+              "            pass\n"
+              "    def g(self):\n"
+              "        with self._y_lock:\n"
+              "            with self._x_lock:\n"
+              "                pass\n")
+        out = active(lint({"nomad_tpu/server/f1.py": f1,
+                           "nomad_tpu/server/f2.py": f2}, [LockRule()]))
+        assert len(out) == 1
+        assert "cycle" in out[0].message
+
+    def test_dispatch_through_two_deep_helper(self):
+        out = active(lint({HOT: DEEP_DISPATCH}, [LockRule()]))
+        assert len(out) == 1
+        assert "device_put" in out[0].message
+        assert "D.h1 -> D.h2" in out[0].message
+
+    def test_release_around_dispatch_is_understood(self):
+        assert not active(lint({HOT: RELEASE_AROUND}, [LockRule()]))
+
+    def test_suppression_honored_on_deep_site(self):
+        src = DEEP_DISPATCH.replace(
+            "            self.h1()",
+            "            # nomad-lint: allow[lock-discipline] ok\n"
+            "            self.h1()")
+        out = lint({HOT: src}, [LockRule()])
+        assert out and all(f.suppressed for f in out)
+
+
+# -- pass 6: shared-state ----------------------------------------------
+
+SHARED_BAD = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._l = threading.Lock()
+        self.samples = {}
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self.samples["cpu"] = 1.0
+
+    def handle_request(self):
+        self.samples["seen"] = 2.0
+"""
+
+SHARED_GOOD = SHARED_BAD.replace(
+    '            self.samples["cpu"] = 1.0',
+    '            with self._l:\n'
+    '                self.samples["cpu"] = 1.0').replace(
+    '        self.samples["seen"] = 2.0',
+    '        with self._l:\n'
+    '            self.samples["seen"] = 2.0')
+
+GUARDED_DECLARED = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._l = threading.Lock()
+        # nomad-lint: guarded-by[_l]
+        self.samples = {}
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._l:
+            self.samples["cpu"] = 1.0
+
+    def handle_request(self):
+        with self._l:
+            self.samples["seen"] = 2.0
+"""
+
+GUARDED_VIOLATED = GUARDED_DECLARED.replace(
+    "    def handle_request(self):\n"
+    "        with self._l:\n"
+    '            self.samples["seen"] = 2.0',
+    "    def handle_request(self):\n"
+    '        self.samples["seen"] = 2.0')
+
+# the helper-under-lock shape: the mutation lives in a private helper
+# whose every caller holds the lock — entry-held dataflow credits it
+HELPER_UNDER_LOCK = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._l = threading.Lock()
+        self.samples = {}
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            with self._l:
+                self._store()
+
+    def _store(self):
+        self.samples["cpu"] = 1.0
+
+    def handle_request(self):
+        with self._l:
+            self.samples["seen"] = 2.0
+"""
+
+
+class TestSharedState:
+    def test_unguarded_shared_attr_fires(self):
+        out = active(lint({HOT: SHARED_BAD}, [SharedStateRule()]))
+        assert len(out) == 1
+        assert "C.samples" in out[0].message
+        assert "no common lock" in out[0].message or \
+            "no lock" in out[0].message
+
+    def test_common_lock_clean(self):
+        assert not active(lint({HOT: SHARED_GOOD},
+                               [SharedStateRule()]))
+
+    def test_guarded_by_declaration_honored(self):
+        assert not active(lint({HOT: GUARDED_DECLARED},
+                               [SharedStateRule()]))
+
+    def test_guarded_by_violation_fires(self):
+        out = active(lint({HOT: GUARDED_VIOLATED},
+                          [SharedStateRule()]))
+        assert len(out) == 1
+        assert "guarded-by[_l]" in out[0].message
+        assert "handle_request" in out[0].message
+
+    def test_helper_under_lock_credited(self):
+        assert not active(lint({HOT: HELPER_UNDER_LOCK},
+                               [SharedStateRule()]))
+
+    def test_atomic_publish_exempt(self):
+        src = SHARED_BAD.replace(
+            '            self.samples["cpu"] = 1.0',
+            "            self.samples = {}").replace(
+            '        self.samples["seen"] = 2.0',
+            "        self.samples = {}")
+        assert not active(lint({HOT: src}, [SharedStateRule()]))
+
+    def test_init_mutations_exempt(self):
+        # __init__ runs before Thread.start: its subscript stores are
+        # not race sites
+        src = SHARED_BAD.replace(
+            "        self.samples = {}",
+            "        self.samples = {}\n"
+            '        self.samples["boot"] = 0.0')
+        out = active(lint({HOT: src}, [SharedStateRule()]))
+        assert len(out) == 1            # still just the _run/request pair
+
+    def test_suppression_honored(self):
+        src = SHARED_BAD.replace(
+            '            self.samples["cpu"] = 1.0',
+            "            # nomad-lint: allow[shared-state] benign\n"
+            '            self.samples["cpu"] = 1.0')
+        out = lint({HOT: src}, [SharedStateRule()])
+        assert out and all(f.suppressed for f in out)
+
+    def test_timer_positional_callback_detected(self):
+        # threading.Timer(5.0, self._run) passes its callback
+        # POSITIONALLY — the shape every in-tree Timer site uses, so
+        # the thread-target scan must not be keyword-only
+        src = SHARED_BAD.replace(
+            "threading.Thread(target=self._run, daemon=True)",
+            "threading.Timer(5.0, self._run)")
+        out = active(lint({HOT: src}, [SharedStateRule()]))
+        assert len(out) == 1
+        assert "C.samples" in out[0].message
+
+
+# -- pass 7: raw-lock --------------------------------------------------
+
+RAW_BAD = """\
+import threading
+import threading as _th
+from threading import Condition
+
+A = threading.Lock()
+B = _th.RLock()
+C = Condition()
+"""
+
+RAW_GOOD = """\
+from ..utils.locks import make_condition, make_lock, make_rlock
+
+A = make_lock()
+B = make_rlock()
+C = make_condition()
+"""
+
+
+class TestRawLock:
+    def test_raw_constructions_fire(self):
+        out = active(lint({"nomad_tpu/server/fixture.py": RAW_BAD},
+                          [RawLockRule()]))
+        assert len(out) == 3
+        assert all("utils/locks" in f.message for f in out)
+
+    def test_factory_clean(self):
+        assert not active(lint(
+            {"nomad_tpu/server/fixture.py": RAW_GOOD},
+            [RawLockRule()]))
+
+    def test_factory_and_race_modules_allowed(self):
+        for path in ("nomad_tpu/utils/locks.py",
+                     "nomad_tpu/analysis/race.py"):
+            assert not active(lint({path: RAW_BAD}, [RawLockRule()]))
+
+    def test_thread_event_untouched(self):
+        src = "import threading\nE = threading.Event()\n" \
+              "S = threading.Semaphore()\n"
+        assert not active(lint({"nomad_tpu/server/fixture.py": src},
+                               [RawLockRule()]))
+
+    def test_suppression_honored(self):
+        src = ("import threading\n"
+               "# nomad-lint: allow[raw-lock] bootstrap\n"
+               "A = threading.Lock()\n")
+        out = lint({"nomad_tpu/server/fixture.py": src},
+                   [RawLockRule()])
+        assert out and all(f.suppressed for f in out)
+
+
 # -- pass 5: surface drift ---------------------------------------------
 
 FIXTURE_HTTP = '''\
@@ -310,6 +623,8 @@ class ServerConfig:
     mesh_orphan_debt_high: int = 23
     stats_documented_stale: float = 30.0
     stats_orphan_stale: float = 31.0
+    race_documented_warn_ms: float = 50.0
+    race_orphan_warn_ms: float = 51.0
     other_knob: int = 1
 """
 
@@ -352,6 +667,7 @@ class TestSurfaceDrift:
                            "mesh_documented_resident and "
                            "stats_documented_stale and "
                            "stats_documented_interval_s and "
+                           "race_documented_warn_ms and "
                            "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
@@ -387,6 +703,9 @@ class TestSurfaceDrift:
         # sampler knobs on ClientConfig
         ss_f = [f for f in out if "stats_orphan_stale" in f.message]
         sc_f = [f for f in out if "stats_orphan_slots" in f.message]
+        # race_* knobs joined the contract (ISSUE 14: runtime race
+        # sanitizer knobs must land in the STATUS.md knob table)
+        ra_f = [f for f in out if "race_orphan_warn_ms" in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
@@ -401,6 +720,7 @@ class TestSurfaceDrift:
         assert len(me_f) == 1
         assert len(ss_f) == 1
         assert len(sc_f) == 1
+        assert len(ra_f) == 1
         assert "ClientConfig.stats_orphan_slots" in sc_f[0].message
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
@@ -426,6 +746,8 @@ class TestSurfaceDrift:
         assert not any("stats_documented_stale" in f.message
                        for f in out)
         assert not any("stats_documented_interval_s" in f.message
+                       for f in out)
+        assert not any("race_documented_warn_ms" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -455,7 +777,9 @@ class TestSurfaceDrift:
                            "stats_documented_stale, "
                            "stats_orphan_stale, "
                            "stats_documented_interval_s, "
-                           "stats_orphan_slots")
+                           "stats_orphan_slots, "
+                           "race_documented_warn_ms, "
+                           "race_orphan_warn_ms")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))

@@ -1,0 +1,69 @@
+"""The race ratchet (ISSUE 14, modeled on test_lint_clean.py): the
+concurrency-heavy suites — group commit, micro-batch gateway, client
+stats, flight recorder, mesh-sharded tables — replay in a subprocess
+with `NOMAD_TPU_RACE=1`, so every lock the servers/workers/brokers/
+collectors construct is an instrumented shim feeding the process-global
+acquisition-order graph and guarded-structure checks. The exit report
+(`NOMAD_TPU_RACE_REPORT`) must carry ZERO unsuppressed findings: no
+lock-order cycle, no self-deadlock, no lock-free mutation of a
+guarded structure. A PR that introduces one fails tier-1 here.
+
+The subprocess deselects the paired overhead smokes (`-k "not
+overhead"`): they assert <= 5% deltas that the instrumentation itself
+is allowed to consume, so running them shimmed measures the shims,
+not the regression they watch for."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SUITES = (
+    "tests/test_plan_group.py",
+    "tests/test_microbatch.py",
+    "tests/test_client_stats.py",
+    "tests/test_trace.py",
+    "tests/test_parallel.py",
+)
+
+
+def test_concurrency_suites_race_clean():
+    fd, report = tempfile.mkstemp(prefix="race_report_",
+                                  suffix=".json")
+    os.close(fd)
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               NOMAD_TPU_RACE="1",
+               NOMAD_TPU_RACE_REPORT=report)
+    try:
+        res = subprocess.run(
+            [sys.executable, "-m", "pytest", *SUITES, "-q",
+             "-m", "not slow", "-k", "not overhead",
+             "-p", "no:cacheprovider", "-p", "no:randomly"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=600)
+        assert res.returncode == 0, (
+            "suites failed under NOMAD_TPU_RACE=1:\n"
+            + res.stdout[-4000:] + res.stderr[-2000:])
+        with open(report) as f:
+            payload = json.load(f)
+    finally:
+        try:
+            os.unlink(report)
+        except OSError:
+            pass
+    unsuppressed = [f for f in payload["findings"]
+                    if not f.get("suppressed")]
+    assert not unsuppressed, (
+        "race sanitizer findings:\n"
+        + json.dumps(unsuppressed, indent=2, default=str)[:6000])
+    # the ratchet must never pass vacuously: the shims engaged (every
+    # server/broker/collector lock registered) and real lock nesting
+    # was observed
+    stats = payload["stats"]
+    assert stats.get("enabled"), stats
+    assert stats.get("tracked", 0) > 50, stats
+    assert stats.get("order_edges", 0) > 5, stats

@@ -481,19 +481,27 @@ def test_prometheus_route_reflects_registry():
 # -- ISSUE 11 acceptance: paired collector-overhead smoke ---------------
 
 def test_collector_overhead_within_5pct(monkeypatch):
-    """Collector-on e2e eval latency within 5% of collector-off at
-    bench quick scale (r13 paired methodology): modes alternate
-    eval-by-eval so workload non-stationarity hits both classes
-    identically; 'on' evals ALSO pay a full sample_once() every 4th
-    eval — at ~ms evals that is ~100x the production 1s cadence, so
-    the 5% bound here is a fortiori for the background thread.
-    Medians are outlier-robust; bounded retries absorb CI noise."""
+    """Two overhead bounds (r13 paired methodology, split): (a)
+    collector-on MODE keeps e2e eval latency within 5% of
+    collector-off — modes alternate eval-by-eval so workload
+    non-stationarity hits both classes identically, medians are
+    outlier-robust, bounded retries absorb CI noise; (b) a full
+    sample_once() (run every 4th on-eval so it's exercised under the
+    live workload) stays under a 5% duty cycle at the production 1 s
+    cadence — the bound the background sampler thread actually
+    imposes."""
     from nomad_tpu.bench.ladder import _eval_for, _seed_nodes
     from nomad_tpu.scheduler.harness import Harness
     from nomad_tpu.utils import gcsafe
 
     h = Harness()
-    _seed_nodes(h, 200, dcs=1)
+    # capacity must survive the retry budget (the r16 test_trace fix,
+    # same arithmetic): mock nodes hold 7 allocs each and warm + three
+    # measured phases place up to 1480 — 200 nodes (cap 1400) run dry
+    # mid-second-retry exactly when full-suite load makes the retries
+    # trigger. 256 keeps the same _pad_n bucket (256) so the measured
+    # kernel shape is unchanged while the ceiling rises to 1792
+    _seed_nodes(h, 256, dcs=1)
 
     tc = TelemetryCollector(interval_s=1.0, slots=128)
 
@@ -510,6 +518,7 @@ def test_collector_overhead_within_5pct(monkeypatch):
 
     def run_paired(tag, n_pairs=24):
         times = {True: [], False: []}
+        sample_times = []
         with gcsafe.safepoints():
             for i in range(2 * n_pairs):
                 on = (i % 2 == 0)
@@ -518,27 +527,43 @@ def test_collector_overhead_within_5pct(monkeypatch):
                 ev = _eval_for(job)
                 t0 = time.perf_counter()
                 h.process("service", ev)
+                t1 = time.perf_counter()
                 if on and i % 8 == 0:
                     tc.sample_once()
-                times[on].append(time.perf_counter() - t0)
+                    sample_times.append(time.perf_counter() - t1)
+                times[on].append(t1 - t0)
                 gcsafe.safepoint()
 
         def median(v):
             v = sorted(v)
             return v[len(v) // 2]
 
-        return median(times[True]), median(times[False])
+        # the sample is timed SEPARATELY from its host eval: in-eval
+        # timing compared the on-median (the ~67th percentile of the
+        # 18 unsampled evals, the 6 sampled ones occupying the top
+        # ranks) against the off-median (a true 50th) — a bias
+        # proportional to eval-time variance, which full-suite heap
+        # state inflates past 5%. Mode overhead and sampling cost get
+        # their own bounds below
+        return (median(times[True]), median(times[False]),
+                median(sample_times) if sample_times else 0.0)
 
     run_paired("warm", n_pairs=2)           # compile + caches
-    on, off = run_paired("m0")
+    on, off, sample = run_paired("m0")
     for attempt in range(2):
         if on <= off / 0.95:
             break
-        on2, off2 = run_paired(f"m{attempt + 1}")   # noise retry
+        on2, off2, sample2 = run_paired(f"m{attempt + 1}")  # noise retry
         on, off = min(on, on2), min(off, off2)
+        sample = min(sample, sample2)
     assert on <= off / 0.95, (
         f"collector-on median {on * 1e3:.2f} ms/eval vs off "
         f"{off * 1e3:.2f} ms/eval")
+    # (b) the sample itself: registry + reservoir + ring writes must
+    # stay under a 5% duty cycle at the production cadence
+    assert sample <= 0.05 * 1.0, (
+        f"sample_once median {sample * 1e3:.2f} ms exceeds a 5% duty "
+        f"cycle at the 1 s production interval")
     assert tc.status()["samples"] > 0
 
 
