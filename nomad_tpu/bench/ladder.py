@@ -1126,6 +1126,41 @@ def bench_cluster_stats(n_clients: int = 4, n_allocs: int = 8) -> Dict:
     return out
 
 
+def bench_scenario_matrix(quick: bool = True,
+                          write: bool = False) -> Dict:
+    """Scenario matrix under chaos (ISSUE 15): seeded workloads +
+    injected faults + invariant checks against a real in-process
+    server per cell (nomad_tpu/chaos/). Quick mode runs the three
+    fastest cells — including the two acceptance-critical ones (a
+    worker killed mid-commit, a corrupted WAL tail) — the full bench
+    runs every single-process cell and writes the CHAOS_rNN.json
+    artifact next to the bench's own."""
+    from ..chaos.matrix import run_matrix, write_artifact
+    names = (["batch_backfill", "drain_storm", "blocked_herd"]
+             if quick else None)
+    result = run_matrix(names=names, quick=quick)
+    if write:
+        write_artifact(result)
+    s = result["summary"]
+    by_name = {c["name"]: c for c in result["cells"]}
+    out: Dict = {
+        "chaos_cells": s["cells"],
+        "chaos_cells_passed": s["passed"],
+        "chaos_invariants_checked": s["invariants_checked"],
+        "chaos_invariants_failed": s["invariants_failed"],
+        "chaos_race_findings": s["race_findings"],
+        "chaos_race": result["race"],
+    }
+    # the two acceptance cells get first-class pass/fail keys: no
+    # lost/duplicated alloc across a worker kill mid-commit and
+    # across a WAL-tail-corruption recovery
+    if "batch_backfill" in by_name:
+        out["chaos_worker_kill_pass"] = by_name["batch_backfill"]["pass"]
+    if "drain_storm" in by_name:
+        out["chaos_wal_corruption_pass"] = by_name["drain_storm"]["pass"]
+    return out
+
+
 def run_ladder(quick: bool = False) -> Dict:
     """Run the full ladder; returns a flat dict of results."""
     out: Dict = {}
@@ -1181,4 +1216,8 @@ def run_ladder(quick: bool = False) -> Dict:
     out.update(bench_cluster_stats(
         n_clients=2 if quick else 4,
         n_allocs=4 if quick else 8))
+    # scenario matrix under chaos (ISSUE 15): quick runs the three
+    # fastest cells (incl. worker-kill + WAL-corruption); the full
+    # bench runs every single-process cell and emits CHAOS_rNN.json
+    out.update(bench_scenario_matrix(quick=quick, write=not quick))
     return out

@@ -1,0 +1,268 @@
+"""Deterministic fault injection for the scenario matrix (ISSUE 15).
+
+Jepsen-style chaos needs two halves: a WORKLOAD (chaos/scenarios.py)
+and a NEMESIS. This module is the nemesis — a seeded schedule of
+injections delivered through explicit hook points compiled into the
+production code:
+
+  worker.plan_committed   EvalLane.submit_plan, after the plan future
+                          resolved (plan IS committed) and BEFORE the
+                          worker acks the eval — raising here is a
+                          worker dying mid-commit; the broker's nack
+                          path redelivers the eval and the retry must
+                          reconcile, not double-place
+  swim.probe              SwimDetector._ping/_indirect_ping — a truthy
+                          interposer verdict fails the probe, so a
+                          victim set partitions away at the SWIM layer
+                          while its process stays healthy
+  server.heartbeat        Server.heartbeat — a truthy verdict drops
+                          the beat in transit (the client believes it
+                          beat; the TTL timer and the stale-stats
+                          clock both keep running)
+
+plus two direct actions that need no hook: `corrupt_wal_tail` (flip a
+byte range at the end of raft.log between a shutdown and a reboot) and
+`FaultInjector.force_governor_reclaim` (drive a registered reclaim
+callback mid-wave — the governor-pressure fault).
+
+Cost discipline: the hook points guard on the module-level `ACTIVE`
+bool, so production traffic pays one attribute read + branch per hook
+site and the interposer dictionary is consulted only while an injector
+is installed. This module imports nothing from the server tree —
+server/worker/swim import IT, the matrix imports them.
+
+Every injection is recorded on the injector (`injector.events`) with a
+monotonic timestamp, so a cell's artifact section carries the exact
+fault schedule its invariants were judged under.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from ..utils.locks import make_lock
+
+LOG = logging.getLogger("nomad_tpu.chaos")
+
+# fast-path gate read by the production hook sites; flipped only by
+# FaultInjector.install/uninstall below
+ACTIVE = False
+
+_INSTALL_L = make_lock()
+_INJECTOR: Optional["FaultInjector"] = None
+
+# ServerConfig wiring (the race.configure idiom): Server.__init__
+# pushes its chaos_* knobs here so cells that don't pin their own get
+# the operator-configured defaults
+DEFAULTS = {
+    "seed": 0,
+    "visibility_bound_s": 15.0,
+}
+
+
+def configure(seed: Optional[int] = None,
+              visibility_bound_s: Optional[float] = None) -> None:
+    """Install ServerConfig.chaos_* knob values as module defaults."""
+    if seed is not None:
+        DEFAULTS["seed"] = int(seed)
+    if visibility_bound_s is not None:
+        DEFAULTS["visibility_bound_s"] = float(visibility_bound_s)
+
+
+class WorkerKilled(Exception):
+    """Raised at the worker.plan_committed hook: the worker 'dies'
+    after its plan committed but before it acked the eval. The
+    process_eval exception path nacks, the broker redelivers, and the
+    retried eval's reconcile must find the committed placements."""
+
+
+def fire(point: str, **kw):
+    """Called from the production hook sites (guarded on ACTIVE).
+    Returns the installed injector's verdict for `point`, or None when
+    no interposer covers it. An interposer may raise (worker kill)."""
+    inj = _INJECTOR
+    if inj is None:
+        return None
+    fn = inj._interposers.get(point)
+    if fn is None:
+        return None
+    return fn(**kw)
+
+
+class FaultInjector:
+    """One cell's seeded nemesis. Use as a context manager:
+
+        with FaultInjector(seed=7) as inj:
+            inj.kill_worker_on_commit(nth=2)
+            ... drive the workload ...
+
+    Only one injector is installed at a time (cells are sequential);
+    installing a second raises."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self.seed = DEFAULTS["seed"] if seed is None else int(seed)
+        self.rng = random.Random(0xFA117 ^ (self.seed * 2654435761))
+        self.events: List[dict] = []
+        self._l = make_lock()
+        self._interposers: Dict[str, Callable] = {}
+        # worker-kill arm state
+        self._kill_at: Optional[int] = None
+        self._commits_seen = 0
+        self.killed_evals: List[str] = []
+        # partition arm state
+        self._victims: Set[str] = set()
+        # heartbeat arm state
+        self._hb_victims: Optional[Set[str]] = None   # None == all
+        self._hb_drop_prob = 0.0
+        self.dropped_beats = 0
+
+    # -- lifecycle -----------------------------------------------------
+    def install(self) -> "FaultInjector":
+        global ACTIVE, _INJECTOR
+        with _INSTALL_L:
+            if _INJECTOR is not None and _INJECTOR is not self:
+                raise RuntimeError("a FaultInjector is already installed")
+            _INJECTOR = self
+            ACTIVE = True
+        return self
+
+    def uninstall(self) -> None:
+        global ACTIVE, _INJECTOR
+        with _INSTALL_L:
+            if _INJECTOR is self:
+                _INJECTOR = None
+                ACTIVE = False
+
+    def __enter__(self) -> "FaultInjector":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def record(self, kind: str, **detail) -> None:
+        with self._l:
+            self.events.append({"kind": kind, "t": time.monotonic(),
+                                **detail})
+
+    # -- worker kill mid-commit ----------------------------------------
+    def kill_worker_on_commit(self, nth: int = 1) -> None:
+        """Arm: the nth plan commit observed after arming kills its
+        worker (raises WorkerKilled between commit and ack)."""
+        self._kill_at = max(1, int(nth))
+        self._commits_seen = 0
+        self._interposers["worker.plan_committed"] = self._on_commit
+        self.record("arm", fault="worker_kill", nth=self._kill_at)
+
+    def _on_commit(self, eval_id: str = "", placements: int = 0):
+        with self._l:
+            self._commits_seen += 1
+            due = (self._kill_at is not None
+                   and self._commits_seen == self._kill_at)
+            if due:
+                self._kill_at = None        # one-shot
+                self.killed_evals.append(eval_id)
+        if due:
+            self.record("worker_kill", eval_id=eval_id,
+                        placements=placements)
+            raise WorkerKilled(
+                f"chaos: worker killed mid-commit (eval {eval_id[:8]}, "
+                f"{placements} placements committed, ack withheld)")
+        return None
+
+    # -- SWIM partition ------------------------------------------------
+    def partition(self, victims) -> None:
+        """Probes (direct and indirect) to any victim address fail
+        until heal_partition(). The victim processes stay healthy —
+        this is the network's fault, not theirs."""
+        self._victims = set(victims)
+        self._interposers["swim.probe"] = self._on_probe
+        self.record("partition", victims=sorted(self._victims))
+
+    def heal_partition(self) -> None:
+        healed = sorted(self._victims)
+        self._victims = set()
+        self.record("heal_partition", victims=healed)
+
+    def _on_probe(self, target: str = "", **_kw):
+        if target in self._victims:
+            self.record("probe_dropped", target=target)
+            return True     # truthy == fail the probe
+        return None
+
+    # -- heartbeat delay/drop ------------------------------------------
+    def drop_heartbeats(self, node_ids=None, prob: float = 1.0) -> None:
+        """Beats from the given nodes (all when None) are dropped in
+        transit with probability `prob` (seeded RNG — deterministic
+        per injector seed). Trips the TTL -> node-down path and ages
+        the heartbeat stats payloads into `stale_heartbeats`."""
+        self._hb_victims = None if node_ids is None else set(node_ids)
+        self._hb_drop_prob = float(prob)
+        self._interposers["server.heartbeat"] = self._on_heartbeat
+        self.record("arm", fault="heartbeat_drop",
+                    nodes=(sorted(n[:8] for n in self._hb_victims)
+                           if self._hb_victims is not None else "all"),
+                    prob=prob)
+
+    def allow_heartbeats(self) -> None:
+        self._hb_victims = set()
+        self._hb_drop_prob = 0.0
+        self.record("heal", fault="heartbeat_drop")
+
+    def _on_heartbeat(self, node_id: str = "", **_kw):
+        victims = self._hb_victims
+        if victims is not None and node_id not in victims:
+            return None
+        if self._hb_drop_prob >= 1.0 or \
+                self.rng.random() < self._hb_drop_prob:
+            with self._l:
+                self.dropped_beats += 1
+            return True     # truthy == drop the beat
+        return None
+
+    # -- governor pressure ---------------------------------------------
+    def force_governor_reclaim(self, server, structure: str = "") -> List[dict]:
+        """Drive registered reclaim callbacks NOW (watermark and rate
+        limit bypassed) — the mid-wave memory-pressure fault. With
+        `structure` empty every reclaimable registration fires; the
+        reclaims are the same closures the real watermarks run, so a
+        cell proves the workload survives reclamation at the worst
+        moment, not just at idle."""
+        gov = getattr(server, "governor", None)
+        if gov is None:
+            self.record("governor_reclaim", skipped="no governor")
+            return []
+        fired = gov.force_reclaim(structure or None)
+        self.record("governor_reclaim", structure=structure or "all",
+                    fired=[f["structure"] for f in fired])
+        return fired
+
+
+def corrupt_wal_tail(data_dir: str, span: int = 48,
+                     seed: Optional[int] = None) -> dict:
+    """Flip every byte in the last `span` bytes of the WAL (XOR with a
+    seeded byte stream, guaranteed non-identity) — the torn/corrupt
+    tail a crash or bad disk leaves. Run between a shutdown and a
+    reboot; RaftLog.replay treats the first undecodable frame as the
+    end of history, so the committed prefix must fully recover and the
+    lost tail is what the scheduler re-derives from intent."""
+    path = os.path.join(data_dir, "raft.log")
+    size = os.path.getsize(path)
+    span = min(int(span), size)
+    if span <= 0:
+        return {"path": path, "corrupted_bytes": 0, "wal_bytes": size}
+    rng = random.Random(0xBADF ^ ((seed or 0) * 2654435761))
+    with open(path, "r+b") as f:
+        f.seek(size - span)
+        tail = bytearray(f.read(span))
+        for i in range(len(tail)):
+            tail[i] ^= rng.randint(1, 255)
+        f.seek(size - span)
+        f.write(tail)
+        f.flush()
+        os.fsync(f.fileno())
+    LOG.warning("chaos: corrupted %d WAL tail bytes of %s", span, path)
+    return {"path": path, "corrupted_bytes": span, "wal_bytes": size}

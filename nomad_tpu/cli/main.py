@@ -1163,6 +1163,17 @@ def cmd_operator_debug(args) -> int:
     try_add("flatness.json", c.flatness)
     try_add("metrics.prom",
             lambda: c.metrics(format="prometheus").encode())
+    # latest chaos artifact (ISSUE 15): when an operator has run
+    # `nomad dev chaos` on this machine, the newest CHAOS_rNN.json
+    # rides in the bundle as chaos.json — a support ticket carries the
+    # invariant verdicts the cluster last proved, not just its gauges
+    from ..chaos.matrix import latest_artifact
+    chaos_path = latest_artifact(".")
+    if chaos_path is not None:
+        def _read_chaos(p=chaos_path):
+            with open(p, "rb") as f:
+                return f.read()
+        try_add("chaos.json", _read_chaos)
     try_add("scheduler-config.json", c.scheduler_config)
     try_add("nomad/jobs.json", c.list_jobs)
     # per-node live host stats (ISSUE 13): each reachable client's
@@ -1845,6 +1856,30 @@ def cmd_dev_lint(args) -> int:
     return lint_main(argv)
 
 
+def cmd_dev_chaos(args) -> int:
+    """`nomad dev chaos [-cell NAME]` — the scenario matrix +
+    fault-injection harness (nomad_tpu/chaos/, ISSUE 15): every cell
+    is a seeded workload + fault schedule + invariant checks +
+    flatness verdict against a real in-process server; the run emits
+    a CHAOS_rNN.json artifact and exits non-zero when a cell fails.
+    Local tooling: no agent connection involved."""
+    from ..chaos.__main__ import main as chaos_main
+    argv = []
+    if args.cell:
+        argv += ["-cell", args.cell]
+    if args.full:
+        argv.append("-full")
+    if args.seed is not None:
+        argv += ["-seed", str(args.seed)]
+    if args.list_cells:
+        argv.append("-list")
+    if args.output:
+        argv += ["-output", args.output]
+    if args.no_artifact:
+        argv.append("-no-artifact")
+    return chaos_main(argv)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="nomad-tpu",
                                 description="TPU-native workload orchestrator")
@@ -2201,6 +2236,22 @@ def build_parser() -> argparse.ArgumentParser:
     dlint.add_argument("-show-suppressed", action="store_true",
                        dest="show_suppressed")
     dlint.set_defaults(fn=cmd_dev_lint)
+    dchaos = dev.add_parser("chaos",
+                            help="scenario matrix + fault injection "
+                                 "(nomad_tpu/chaos)")
+    dchaos.add_argument("-cell", default="",
+                        help="comma-separated cell names (default: "
+                             "all quick cells)")
+    dchaos.add_argument("-full", action="store_true",
+                        help="full-scale cells instead of quick")
+    dchaos.add_argument("-seed", type=int, default=None)
+    dchaos.add_argument("-list", action="store_true",
+                        dest="list_cells")
+    dchaos.add_argument("-output", default="",
+                        help="artifact path (default CHAOS_rNN.json)")
+    dchaos.add_argument("-no-artifact", action="store_true",
+                        dest="no_artifact")
+    dchaos.set_defaults(fn=cmd_dev_chaos)
 
     acl = sub.add_parser("acl", help="ACL policies and tokens")
     acl_sub = acl.add_subparsers(dest="acl_cmd", required=True)

@@ -278,6 +278,14 @@ class Governor:
     def backpressure(self) -> bool:
         return self._bp.is_set()
 
+    def force_reclaim(self, name: Optional[str] = None) -> List[dict]:
+        """Drive registered reclaims immediately (chaos
+        governor-pressure fault, ISSUE 15): every reclaimable
+        structure when `name` is None. The reclaim events land in the
+        governor event ring like watermark-driven ones, tagged
+        forced=True."""
+        return self.registry.force_reclaim(name, on_event=self.emit)
+
     def status(self) -> dict:
         out = {
             "enabled": True,

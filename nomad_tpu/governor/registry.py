@@ -94,6 +94,37 @@ class GaugeRegistry:
             regs = list(self._regs.values())
         return [r.as_dict() for r in sorted(regs, key=lambda r: r.name)]
 
+    def force_reclaim(self, name: Optional[str] = None,
+                      on_event: Optional[Callable[[dict], None]] = None
+                      ) -> List[dict]:
+        """Run registered reclaim callbacks NOW, watermark state and
+        rate limit bypassed — the chaos governor-pressure fault
+        (ISSUE 15) and operator tooling. `name=None` fires every
+        reclaimable registration; returns one event dict per reclaim
+        that ran (same shape the watermark path emits)."""
+        with self._l:
+            regs = [r for r in self._regs.values()
+                    if r.reclaim is not None
+                    and (name is None or r.name == name)]
+        fired: List[dict] = []
+        for reg in regs:
+            try:
+                detail = reg.reclaim()
+                reg.reclaims += 1
+                reg.last_reclaim_t = time.monotonic()
+                metrics.incr_counter(
+                    f"nomad.governor.reclaim.{reg.name}")
+                ev = {"kind": "reclaim", "structure": reg.name,
+                      "value": reg.value, "forced": True,
+                      "detail": detail}
+                fired.append(ev)
+                if on_event is not None:
+                    on_event(ev)
+            except Exception:
+                reg.errors += 1
+                LOG.exception("forced reclaim %s failed", reg.name)
+        return fired
+
     # -- sampling ------------------------------------------------------
     def sample(self, now: Optional[float] = None,
                on_event: Optional[Callable[[dict], None]] = None

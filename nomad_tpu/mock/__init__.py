@@ -11,3 +11,4 @@ from .fixtures import (
     evaluation,
     deployment,
 )
+from .seeded import seeded_mock_ids

@@ -290,6 +290,16 @@ class ServerConfig:
     # findings ring bound (lock-order cycles, self-deadlocks,
     # unguarded mutations) — dedup by site keeps this small anyway
     race_max_findings: int = 256
+    # scenario matrix + fault injection (nomad_tpu/chaos/, ISSUE 15):
+    # default seed for injected fault schedules when a chaos cell
+    # doesn't pin its own (0 = the matrix derives one per cell); the
+    # hook points themselves cost one module-bool read per site and
+    # are inert until a cell installs a FaultInjector
+    chaos_seed: int = 0
+    # bound within which cluster.nodes_down / stale_heartbeats must
+    # reflect an injected failure — the failure-visibility invariant's
+    # deadline (chaos/invariants.py)
+    chaos_visibility_bound_s: float = 15.0
 
 
 class Server:
@@ -319,6 +329,12 @@ class Server:
             hold_warn_ms=self.config.race_lock_hold_warn_ms,
             exemplar_slots=self.config.race_exemplar_slots,
             max_findings=self.config.race_max_findings)
+        # chaos fault-injection knobs (module-level, same idiom — the
+        # injector hook points are process-global; ISSUE 15)
+        from ..chaos import faults as _chaos_faults
+        _chaos_faults.configure(
+            seed=self.config.chaos_seed,
+            visibility_bound_s=self.config.chaos_visibility_bound_s)
         # RLock: FSM appliers can nest (e.g. a node-register unblocking a
         # blocked eval re-enters raft_apply on the same thread)
         self._raft_l = make_rlock()
@@ -2555,6 +2571,14 @@ class Server:
         node = self.store.node_by_id(node_id)
         if node is None:
             raise KeyError(f"node {node_id} not registered")
+        from ..chaos import faults as chaos_faults
+        if chaos_faults.ACTIVE and \
+                chaos_faults.fire("server.heartbeat", node_id=node_id):
+            # chaos hook (ISSUE 15): the beat is dropped in transit —
+            # the client believes it renewed, but the TTL timer keeps
+            # running toward node-down and the stale-stats clock ages
+            # the last payload toward `stale_heartbeats`
+            return self.config.heartbeat_ttl_s
         if stats:
             with self._node_stats_l:
                 self._node_stats[node_id] = {

@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..chaos import faults as chaos_faults
+
 LOG = logging.getLogger("nomad_tpu.swim")
 
 PROBE_INTERVAL_S = 0.5
@@ -76,6 +78,13 @@ class SwimDetector:
         return [m for m in members if m != raft.self_addr]
 
     def _ping(self, addr: str) -> bool:
+        if chaos_faults.ACTIVE and \
+                chaos_faults.fire("swim.probe", target=addr,
+                                  via=""):
+            # chaos hook (ISSUE 15): an installed partition fault
+            # fails probes to its victim set — the network is down,
+            # the victim process is not
+            return False
         from ..rpc.client import RpcClient
         try:
             c = RpcClient(addr, dial_timeout_s=self.probe_timeout_s)
@@ -89,6 +98,11 @@ class SwimDetector:
             return False
 
     def _indirect_ping(self, via: str, target: str) -> bool:
+        if chaos_faults.ACTIVE and \
+                chaos_faults.fire("swim.probe", target=target, via=via):
+            # a partitioned victim is unreachable via relays too: the
+            # ping-req's last hop crosses the same cut
+            return False
         from ..rpc.client import RpcClient
         try:
             c = RpcClient(via, dial_timeout_s=self.probe_timeout_s)

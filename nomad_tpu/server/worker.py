@@ -24,6 +24,7 @@ import threading
 import time
 
 from .. import trace
+from ..chaos import faults as chaos_faults
 from ..utils import gcsafe
 from typing import List, Optional
 
@@ -584,6 +585,16 @@ class EvalLane:
         plan._trace = trace.current()
         future = self.server.plan_queue.enqueue(plan)
         result: PlanResult = future.result(timeout=30)
+        if chaos_faults.ACTIVE:
+            # chaos hook (ISSUE 15): the plan IS committed at this
+            # point but the eval is not acked — an armed worker-kill
+            # fault raises here, modeling a scheduler worker dying
+            # mid-commit. The broker's nack path redelivers the eval
+            # and the retry's reconcile must see these placements
+            chaos_faults.fire(
+                "worker.plan_committed", eval_id=self.eval.id,
+                placements=sum(len(a) for a in
+                               plan.node_allocation.values()))
         metrics.measure_since("nomad.worker.submit_plan", t0)
         # if some placements were rejected, wait for the refresh index so
         # the next attempt sees why (worker.go:318-340)
@@ -886,8 +897,15 @@ class Worker:
                 self._finish_q.put(_finish)
             else:
                 _finish()
-        except Exception:
-            LOG.exception("worker %d: eval %s failed", self.id, ev.id)
+        except Exception as e:
+            if isinstance(e, chaos_faults.WorkerKilled):
+                # an INJECTED kill (chaos cell), not a scheduler bug:
+                # the nack below is exactly the redelivery the cell's
+                # no-double-commit invariant exercises
+                LOG.warning("worker %d: %s", self.id, e)
+            else:
+                LOG.exception("worker %d: eval %s failed", self.id,
+                              ev.id)
             self.stats["failed"] += 1
             try:
                 self.server.eval_broker.nack(ev.id, token)

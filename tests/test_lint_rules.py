@@ -625,6 +625,8 @@ class ServerConfig:
     stats_orphan_stale: float = 31.0
     race_documented_warn_ms: float = 50.0
     race_orphan_warn_ms: float = 51.0
+    chaos_documented_seed: int = 0
+    chaos_orphan_seed: int = 7
     other_knob: int = 1
 """
 
@@ -668,6 +670,7 @@ class TestSurfaceDrift:
                            "stats_documented_stale and "
                            "stats_documented_interval_s and "
                            "race_documented_warn_ms and "
+                           "chaos_documented_seed and "
                            "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
@@ -706,6 +709,9 @@ class TestSurfaceDrift:
         # race_* knobs joined the contract (ISSUE 14: runtime race
         # sanitizer knobs must land in the STATUS.md knob table)
         ra_f = [f for f in out if "race_orphan_warn_ms" in f.message]
+        # chaos_* knobs joined the contract (ISSUE 15: scenario-matrix
+        # fault-injection knobs must land in the STATUS.md knob table)
+        ch_f = [f for f in out if "chaos_orphan_seed" in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
@@ -721,6 +727,7 @@ class TestSurfaceDrift:
         assert len(ss_f) == 1
         assert len(sc_f) == 1
         assert len(ra_f) == 1
+        assert len(ch_f) == 1
         assert "ClientConfig.stats_orphan_slots" in sc_f[0].message
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
@@ -748,6 +755,8 @@ class TestSurfaceDrift:
         assert not any("stats_documented_interval_s" in f.message
                        for f in out)
         assert not any("race_documented_warn_ms" in f.message
+                       for f in out)
+        assert not any("chaos_documented_seed" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -779,7 +788,9 @@ class TestSurfaceDrift:
                            "stats_documented_interval_s, "
                            "stats_orphan_slots, "
                            "race_documented_warn_ms, "
-                           "race_orphan_warn_ms")
+                           "race_orphan_warn_ms, "
+                           "chaos_documented_seed, "
+                           "chaos_orphan_seed")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
