@@ -635,6 +635,17 @@ class HTTPApiServer:
                     "Leader": raft.leader_addr if raft else "",
                     "ClusterSize": raft.cluster_size if raft else 1}, idx
 
+        if path == "/v1/agent/members" and method == "GET":
+            # scheduler-plane member view (ISSUE 16): the voter set
+            # annotated with raft role, applied index, fence lag and
+            # per-follower leased evals — the data `nomad server
+            # members` renders and `operator debug` bundles
+            raft = getattr(s, "raft", None)
+            return {"Members": store.server_members(),
+                    "Leader": raft.leader_addr if raft else "",
+                    "ClusterSize": raft.cluster_size if raft else 1,
+                    "SchedulerPlane": s.scheduler_plane_status()}, idx
+
         # durable event sinks (nomad/stream/sink.go CRUD)
         if path == "/v1/event/sinks" and method == "GET":
             return [sk.stub() for sk in store.event_sinks()], idx

@@ -1126,6 +1126,189 @@ def bench_cluster_stats(n_clients: int = 4, n_allocs: int = 8) -> Dict:
     return out
 
 
+def bench_multiserver(n_nodes: int = 100, n_jobs: int = 32,
+                      count: int = 6, waves: int = 3,
+                      rtt_ms: float = 80.0) -> Dict:
+    """Distributed scheduler plane (ISSUE 16): a real 3-server raft
+    ring where followers dequeue evals from the leader's broker over
+    RPC, schedule against their fenced local snapshots, and stream
+    plans back through Plan.Submit into the leader's group-commit
+    applier. The control arm is the SAME ring with
+    NOMAD_TPU_FOLLOWER_SCHED=0 — only the leader schedules, i.e.
+    single-server scheduling as every pre-r20 cluster ran it.
+
+    The ring is geo-stretched: the fault injector's wire_latency arm
+    stretches every AppendEntries round trip by `rtt_ms` in BOTH arms,
+    standing in for real inter-server network distance on a loopback
+    CI box. That is the regime the plane exists for — the control
+    arm's single worker already hides commit latency behind its own
+    depth-limited pipeline (r7), so on a co-located loopback ring the
+    two arms mostly measure Python overhead. Once the commit RTT
+    exceeds per-eval CPU, the control arm goes latency-bound while the
+    plane keeps a cluster-wide window of plans in flight and the r9
+    applier amortizes them into shared group commits (watch
+    multiserver groups < plans). Placement rate is the best of
+    `waves` identical deployment waves per arm — wave 0 pays JIT and
+    cache warmup, and on a 1-core CI box any wave can lose the host
+    to a neighbour, so per-wave best-of is the stable statistic.
+
+    Per-server num_schedulers=1 in both arms: the plane's claim is
+    that it turns the standby servers' otherwise-idle worker pools
+    into schedulers, so the arms differ only in whether those pools
+    may dequeue remotely (follower_max_remote=4)."""
+    import os
+
+    from ..chaos.faults import FaultInjector
+    from ..mock import fixtures as mock
+    from ..rpc import RpcServer
+    from ..server import Server, ServerConfig
+
+    def make_job(i: int) -> object:
+        job = mock.job()
+        job.id = f"msvc-{i}"
+        job.datacenters = ["dc1"]
+        tg = job.task_groups[0]
+        tg.count = count
+        tg.networks = []
+        for t in tg.tasks:
+            t.resources.networks = []
+            t.resources.cpu = 50
+            t.resources.memory_mb = 32
+        return job
+
+    def pause(servers, p: bool) -> None:
+        for s in servers:
+            for w in s.workers:
+                w.set_pause(p)
+            if s.follower_sched is not None:
+                s.follower_sched.set_pause(p)
+
+    def wait(pred, timeout_s: float) -> bool:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.01)
+        return False
+
+    def run_arm(follower_on: bool) -> Dict:
+        prev = os.environ.get("NOMAD_TPU_FOLLOWER_SCHED")
+        os.environ["NOMAD_TPU_FOLLOWER_SCHED"] = \
+            "1" if follower_on else "0"
+        inj = FaultInjector(seed=0xB16).install()
+        if rtt_ms > 0:
+            inj.wire_latency(rtt_ms / 1000.0)
+        servers, rpcs = [], []
+        try:
+            for _ in range(3):
+                s = Server(ServerConfig(
+                    num_schedulers=1, heartbeat_ttl_s=3600.0,
+                    telemetry_sample_interval_s=0,
+                    governor_interval_s=3600.0,
+                    follower_max_remote=4))
+                r = RpcServer(s, port=0)
+                servers.append(s)
+                rpcs.append(r)
+            addrs = [r.addr for r in rpcs]
+            for s, r in zip(servers, rpcs):
+                s.attach_raft(r, addrs)
+                r.start()
+                s.start()
+            assert wait(lambda: sum(
+                s.raft.is_leader() for s in servers) == 1, 30.0), \
+                "multiserver ring never elected a leader"
+            lead = next(s for s in servers if s.raft.is_leader())
+            pause(servers, True)
+            time.sleep(1.0)     # park in-flight dequeues
+            # pipelined node seeding: one raft entry per node, wait
+            # only the last waiter (a sync register per node would pay
+            # the stretched RTT n_nodes times)
+            last_waiter = None
+            for i in range(n_nodes):
+                node = mock.node()
+                node.name = f"mnode-{i}"
+                node.datacenter = "dc1"
+                node.compute_class()
+                _idx, w = lead.raft_apply_async(
+                    "node_register", dict(node=node))
+                if w is not None:
+                    last_waiter = w
+            if last_waiter is not None:
+                last_waiter()
+            # warm wave outside the timed window: JIT compiles, device
+            # table upload, select-kernel caches
+            warm = [make_job(10 ** 6 + k) for k in range(2)]
+            for j in warm:
+                lead.register_job(j)
+            pause(servers, False)
+            assert wait(lambda: all(
+                len(lead.store.allocs_by_job("default", j.id)) == count
+                for j in warm), 120.0), "multiserver warm wave stuck"
+            best_rate = 0.0
+            placed_ok = True
+            for wave in range(waves):
+                pause(servers, True)
+                time.sleep(1.0)
+                jobs = [make_job(wave * 1000 + i)
+                        for i in range(n_jobs)]
+                for j in jobs:
+                    lead.register_job(j)
+                t0 = time.perf_counter()
+                pause(servers, False)
+                placed_ok = wait(lambda: all(
+                    len(lead.store.allocs_by_job("default", j.id))
+                    == count for j in jobs), 180.0) and placed_ok
+                wall = time.perf_counter() - t0
+                placed = sum(
+                    len(lead.store.allocs_by_job("default", j.id))
+                    for j in jobs)
+                best_rate = max(best_rate, placed / wall)
+            leases = dict(lead.eval_leases.snapshot_stats())
+            fence = max((s.follower_sched.fence_wait_p99_ms()
+                         for s in servers
+                         if s.follower_sched is not None),
+                        default=0.0)
+            applier = dict(lead.plan_applier.stats)
+            return {"rate": best_rate, "ok": placed_ok,
+                    "leases": leases, "fence_p99_ms": fence,
+                    "groups": applier.get("groups", 0),
+                    "plans": applier.get("plans", 0)}
+        finally:
+            inj.uninstall()
+            for s, r in zip(servers, rpcs):
+                r.shutdown()
+                s.shutdown()
+            if prev is None:
+                os.environ.pop("NOMAD_TPU_FOLLOWER_SCHED", None)
+            else:
+                os.environ["NOMAD_TPU_FOLLOWER_SCHED"] = prev
+
+    on = run_arm(True)
+    off = run_arm(False)
+    # structural engagement fence (same spirit as the broker-batches
+    # assert above): the plane must actually have scheduled remotely,
+    # else the headline ratio is two copies of the control arm
+    assert on["leases"].get("remote_plans", 0) > 0, (
+        f"follower plane never submitted a remote plan: {on}")
+    assert on["ok"] and off["ok"], (
+        f"multiserver wave never fully placed: on={on} off={off}")
+    return {
+        "multiserver_placements_per_sec": round(on["rate"], 1),
+        "multiserver_placements_per_sec_off": round(off["rate"], 1),
+        "multiserver_speedup": round(
+            on["rate"] / max(off["rate"], 1e-9), 2),
+        "multiserver_fence_wait_p99_ms": round(
+            on["fence_p99_ms"], 2),
+        "multiserver_remote_demotions": int(
+            on["leases"].get("remote_demotions", 0)),
+        "multiserver_remote_dequeues": int(
+            on["leases"].get("remote_dequeues", 0)),
+        "multiserver_plan_groups": int(on["groups"]),
+        "multiserver_plans": int(on["plans"]),
+        "multiserver_rtt_ms": rtt_ms,
+    }
+
+
 def bench_scenario_matrix(quick: bool = True,
                           write: bool = False) -> Dict:
     """Scenario matrix under chaos (ISSUE 15): seeded workloads +
@@ -1216,6 +1399,11 @@ def run_ladder(quick: bool = False) -> Dict:
     out.update(bench_cluster_stats(
         n_clients=2 if quick else 4,
         n_allocs=4 if quick else 8))
+    # distributed scheduler plane over a geo-stretched 3-server ring
+    # (ISSUE 16): follower scheduling on vs the leader-only control
+    out.update(bench_multiserver(
+        n_jobs=24 if quick else 32,
+        waves=2 if quick else 3))
     # scenario matrix under chaos (ISSUE 15): quick runs the three
     # fastest cells (incl. worker-kill + WAL-corruption); the full
     # bench runs every single-process cell and emits CHAOS_rNN.json

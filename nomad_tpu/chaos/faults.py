@@ -19,6 +19,21 @@ production code:
                           the beat in transit (the client believes it
                           beat; the TTL timer and the stale-stats
                           clock both keep running)
+  raft.replicate          RaftNode._replicate_peer, before the round
+                          trip — a truthy verdict drops the whole
+                          AppendEntries exchange on the LEADER side,
+                          so the victim's log/store lags while its
+                          process stays healthy (the follower
+                          snapshot-fence fault, ISSUE 16)
+  raft.election           RaftNode._ticker, at an expired election
+                          deadline — a truthy verdict resets the
+                          deadline instead of campaigning, so a
+                          replication-lagged victim stays a lagging
+                          follower instead of deposing the leader
+  plan.group_commit       PlanApplier.apply_group, after the group's
+                          raft entry is appended but before any
+                          submitter future resolves — the observation
+                          point for killing a leader mid-group-commit
 
 plus two direct actions that need no hook: `corrupt_wal_tail` (flip a
 byte range at the end of raft.log between a shutdown and a reboot) and
@@ -119,6 +134,21 @@ class FaultInjector:
         self._hb_victims: Optional[Set[str]] = None   # None == all
         self._hb_drop_prob = 0.0
         self.dropped_beats = 0
+        # replication-lag arm state (ISSUE 16)
+        self._repl_victims: Set[str] = set()
+        self.dropped_replications = 0
+        # wire-latency arm state (ISSUE 16): per-round-trip delay on
+        # the leader's replication pumps, modelling inter-server RTT
+        self._wire_rtt_s = 0.0
+        # group-commit trip arm state (ISSUE 16): the cell's MAIN
+        # thread waits on this and performs the leader kill itself —
+        # killing from inside the hook would deadlock the shutdown
+        # join against the very committer thread the hook runs on
+        import threading as _threading
+        self.group_commit_tripped = _threading.Event()
+        self._trip_at: Optional[int] = None
+        self._groups_seen = 0
+        self.tripped_group_index = 0
 
     # -- lifecycle -----------------------------------------------------
     def install(self) -> "FaultInjector":
@@ -221,6 +251,78 @@ class FaultInjector:
             with self._l:
                 self.dropped_beats += 1
             return True     # truthy == drop the beat
+        return None
+
+    # -- replication lag (ISSUE 16) ------------------------------------
+    def lag_replication(self, victims) -> None:
+        """AppendEntries round trips from the leader to any victim
+        address are dropped until heal_replication() — the victim's
+        raft log (and MVCC store) falls behind while its process, RPC
+        listener, and SWIM probes all stay healthy. The same arming
+        suppresses the victims' election timeouts: a lagging follower
+        must stay a follower, not bump the term and depose the leader
+        whose lag the cell is measuring."""
+        self._repl_victims = set(victims)
+        self._interposers["raft.replicate"] = self._on_replicate
+        self._interposers["raft.election"] = self._on_election
+        self.record("replication_lag", victims=sorted(self._repl_victims))
+
+    def wire_latency(self, rtt_s: float) -> None:
+        """Arm: every AppendEntries round trip from the leader is
+        stretched by `rtt_s` before dispatch — a stand-in for real
+        inter-server network distance on the commit path. Unlike
+        lag_replication nothing is dropped: commit latency rises
+        uniformly. The multiserver bench arms this identically in both
+        arms so a loopback ring exercises the LAN-ring latencies the
+        follower plane exists to hide."""
+        self._wire_rtt_s = float(rtt_s)
+        self._interposers["raft.replicate"] = self._on_replicate
+        self.record("wire_latency", rtt_s=rtt_s)
+
+    def heal_replication(self) -> None:
+        healed = sorted(self._repl_victims)
+        self._repl_victims = set()
+        self.record("heal_replication", victims=healed)
+
+    def _on_replicate(self, target: str = "", **_kw):
+        if self._wire_rtt_s > 0.0:
+            time.sleep(self._wire_rtt_s)
+        if target in self._repl_victims:
+            with self._l:
+                self.dropped_replications += 1
+            return True     # truthy == drop the round trip
+        return None
+
+    def _on_election(self, addr: str = "", **_kw):
+        if addr in self._repl_victims:
+            self.record("election_suppressed", addr=addr)
+            return True     # truthy == reset deadline, don't campaign
+        return None
+
+    # -- leader kill mid-group-commit (ISSUE 16) -----------------------
+    def trip_on_group_commit(self, nth: int = 1) -> None:
+        """Arm: the nth plan-group commit observed after arming sets
+        `group_commit_tripped` (and records the group's raft index).
+        The hook itself only OBSERVES — the cell's main thread waits on
+        the event and kills the leader from outside, because a kill
+        from the committer/applier thread would join against itself."""
+        self._trip_at = max(1, int(nth))
+        self._groups_seen = 0
+        self.group_commit_tripped.clear()
+        self._interposers["plan.group_commit"] = self._on_group_commit
+        self.record("arm", fault="group_commit_trip", nth=self._trip_at)
+
+    def _on_group_commit(self, index: int = 0, plans: int = 0):
+        with self._l:
+            self._groups_seen += 1
+            due = (self._trip_at is not None
+                   and self._groups_seen == self._trip_at)
+            if due:
+                self._trip_at = None            # one-shot
+                self.tripped_group_index = index
+        if due:
+            self.record("group_commit_trip", index=index, plans=plans)
+            self.group_commit_tripped.set()
         return None
 
     # -- governor pressure ---------------------------------------------

@@ -23,6 +23,14 @@ one):
   swim_partition         (cluster cell, excluded from quick sets) a
                          3-server raft cluster with one follower
                          partitioned at the SWIM layer
+  follower_fence         (cluster) the distributed scheduler plane
+                         (ISSUE 16) under replication lag: the sole
+                         scheduling follower's fence blocks, stale
+                         plans demote at leader verify, heal recovers
+  leader_failover_commit (cluster) leader killed the instant a remote
+                         plan's group-commit entry is dispatched; the
+                         new leader restores the broker and the
+                         intent settles exactly once
 
 Workload generators draw every mock id through the promoted
 `mock.seeded_mock_ids` context (r17's fix for unreproducible "seeded"
@@ -602,6 +610,258 @@ def _run_swim_partition(cell: Cell) -> None:
                                 alive))
 
 
+# -- cluster-cell helpers (ISSUE 16) ----------------------------------
+
+def _mk_ring(cell: Cell, n: int = 3, **cfg):
+    """An n-server raft ring with the distributed scheduler plane on:
+    no local workers anywhere (num_schedulers=0), so every placement
+    must flow follower-dequeue -> local schedule -> Plan.Submit ->
+    leader group-commit. Returns (servers, rpcs, addrs)."""
+    from ..rpc import RpcServer
+    servers, rpcs = [], []
+    for _ in range(n):
+        srv = cell.server(start=False, num_schedulers=0,
+                          heartbeat_ttl_s=300.0,
+                          dead_server_cleanup_s=0.0, **cfg)
+        rpc = RpcServer(srv, port=0)
+        servers.append(srv)
+        rpcs.append(rpc)
+        cell.track(rpc)
+    addrs = [r.addr for r in rpcs]
+    for srv, rpc in zip(servers, rpcs):
+        srv.attach_raft(rpc, addrs)
+        rpc.start()
+        srv.start()
+    return servers, rpcs, addrs
+
+
+def _ring_leader(servers):
+    live = [s for s in servers
+            if not getattr(s, "_shutdown", False)
+            and s.raft.is_leader()]
+    return live[0] if len(live) == 1 else None
+
+
+def _ring_formed(cell: Cell, servers) -> bool:
+    return cell.wait_for(
+        lambda: _ring_leader(servers) is not None
+        and len(_ring_leader(servers).store.server_members() or [])
+        == len(servers), timeout_s=30)
+
+
+def _applied_index(srv) -> int:
+    return srv.raft._handle_status({})["applied_index"]
+
+
+def _extra_nodes(cell: Cell, n: int, salt: int, dc: str):
+    """A LATER batch of seeded nodes with ids disjoint from every
+    other batch: _mk_nodes replays the cell seed's id stream from the
+    start, so calling it twice re-issues the same node ids — which
+    re-registers (mutates) existing nodes instead of adding capacity."""
+    from ..mock import fixtures as mock
+    from ..mock import seeded_mock_ids
+    out = []
+    with seeded_mock_ids(cell.seed ^ salt):
+        for i in range(n):
+            node = mock.node()
+            node.name = f"cnode-{dc}-{salt:x}-{i}"
+            node.datacenter = dc
+            node.compute_class()
+            out.append(node)
+    return out
+
+
+# -- cell 8 (cluster): follower scheduling over a lagging fence -------
+
+def _run_follower_fence(cell: Cell) -> None:
+    """The snapshot-fence contract under replication lag. One follower
+    (the victim) is the ONLY scheduler in the ring; its local MVCC
+    store is the snapshot every plan is built on. Three phases:
+    healthy baseline; replication lagged so a new eval's fence blocks
+    (and unblocks on heal with a passing verify); and two evals
+    admitted BEFORE the lag planned against the frozen snapshot, whose
+    conflicting placements the leader's group-commit verify must
+    demote — never commit — with full recovery after the heal."""
+    servers, rpcs, addrs = _mk_ring(
+        cell, follower_fence_timeout_s=8.0, follower_max_remote=2)
+    cell.check(invariants.check("cluster_formed",
+                                _ring_formed(cell, servers)))
+    lead = _ring_leader(servers)
+    followers = [s for s in servers if s is not lead]
+    victim, other = followers[0], followers[1]
+    victim_addr = victim.raft.self_addr
+    # the victim must be the sole scheduler: park the other follower's
+    # remote workers and let any in-flight remote dequeue poll drain
+    other.follower_sched.set_pause(True)
+    time.sleep(3.0)
+
+    nodes = _mk_nodes(cell, 8)
+    _register_nodes(lead, nodes)
+
+    # phase 1: healthy baseline through the remote plane
+    job_a = _svc_job(cell, "chaos-fence-a", 8, cpu=300)
+    with cell.window():
+        if not _settle(cell, lead, job_a):
+            cell.check(invariants.check("fence_baseline_settled",
+                                        False, job=job_a.id))
+    cell.check(invariants.check(
+        "placements_flowed_remote",
+        lead.eval_leases.stats["remote_plans"] >= 1,
+        **lead.eval_leases.snapshot_stats()))
+
+    # phase 2: lag the victim's replication; a NEW eval's
+    # modify_index now sits past the victim's applied index, so its
+    # fence must BLOCK (lease held, nothing placed), then pass verify
+    # once the heal lets the store catch up
+    cell.injector.lag_replication({victim_addr})
+    job_d = _svc_job(cell, "chaos-fence-d", 4, cpu=300)
+    lead.register_job(job_d)
+    leased = cell.wait_for(
+        lambda: lead.eval_leases.outstanding() >= 1, timeout_s=10)
+    blocked = len(_live(lead.store, job_d)) == 0
+    cell.check(invariants.check("fence_blocked_while_lagged",
+                                leased and blocked, leased=leased,
+                                placed_while_lagged=not blocked))
+    time.sleep(0.8)          # hold the fence long enough to measure
+    cell.injector.heal_replication()
+    with cell.window():
+        t0 = time.perf_counter()
+        ok = cell.wait_for(
+            lambda: len({a.name for a in _live(lead.store, job_d)})
+            >= 4, timeout_s=20)
+        cell.note_latency(time.perf_counter() - t0,
+                          placements=4 if ok else 0)
+    cell.check(invariants.check("fence_released_on_heal", ok))
+    cell.check(invariants.check(
+        "fence_wait_observed",
+        victim.follower_sched.fence_wait_p99_ms() >= 50.0,
+        fence_wait_p99_ms=victim.follower_sched.fence_wait_p99_ms()))
+
+    # phase 3: stale-plan demotion. Two evals are admitted while the
+    # victim's workers are parked, the victim catches up PAST both,
+    # then replication lags — both fences pass against the frozen
+    # snapshot, both plans are built blind to each other on an
+    # exactly-8-slot capacity domain (2 nodes x 4 asks), and the
+    # leader's verify must demote the conflicting placements
+    dc2 = _extra_nodes(cell, 2, 0x9E37, "dc2")
+    _register_nodes(lead, dc2)
+    victim.follower_sched.set_pause(True)
+    time.sleep(3.0)
+    job_c = _svc_job(cell, "chaos-fence-c", 4, cpu=900, dcs=1)
+    job_b = _svc_job(cell, "chaos-fence-b", 8, cpu=900, dcs=1)
+    for j in (job_c, job_b):
+        j.datacenters = ["dc2"]
+        j.canonicalize()
+        lead.register_job(j)
+    caught_up = cell.wait_for(
+        lambda: _applied_index(victim) >= _applied_index(lead),
+        timeout_s=15)
+    cell.check(invariants.check("victim_caught_up_before_lag",
+                                caught_up))
+    cell.injector.lag_replication({victim_addr})
+    victim.follower_sched.set_pause(False)
+    demoted = cell.wait_for(
+        lambda: lead.eval_leases.stats["remote_demotions"] >= 1,
+        timeout_s=20, interval_s=0.02)
+    cell.injector.heal_replication()
+    cell.check(invariants.check(
+        "stale_plan_demoted_not_committed", demoted,
+        remote_demotions=lead.eval_leases.stats["remote_demotions"]))
+    # post-heal recovery: extra capacity wakes whatever the demotion
+    # reblocked; every slot must settle exactly once
+    more = _extra_nodes(cell, 2, 0x51ED, "dc2")
+    _register_nodes(lead, more)
+    jobs = [job_a, job_d, job_c, job_b]
+    with cell.window():
+        t0 = time.perf_counter()
+        ok = cell.wait_for(
+            lambda: all(
+                len({a.name for a in _live(lead.store, j)})
+                >= sum(tg.count for tg in j.task_groups)
+                for j in jobs), timeout_s=40)
+        cell.note_latency(time.perf_counter() - t0,
+                          placements=12 if ok else 0)
+    cell.check(invariants.check("recovered_after_heal", ok))
+    cell.check(invariants.alloc_intent(lead.store, _intent(jobs)))
+    # the last remote ack races the settle; drain before the check
+    cell.wait_for(lambda: lead.eval_broker.stats.as_dict()["unacked"]
+                  == 0, timeout_s=10)
+    cell.check(invariants.blocked_evals_drained(lead))
+    cell.metrics["remote_demotions"] = \
+        lead.eval_leases.stats["remote_demotions"]
+    cell.metrics["fence_wait_p99_ms"] = \
+        victim.follower_sched.fence_wait_p99_ms()
+
+
+# -- cell 9 (cluster): leader killed mid-group-commit -----------------
+
+def _run_leader_failover_commit(cell: Cell) -> None:
+    """Leadership transfer at the worst instant: the leader dies right
+    after dispatching a remote plan's group-commit raft entry —
+    before the quorum ack, before the eval ack, with the follower's
+    Plan.Submit RPC still in flight. Both races must converge: if the
+    entry reached a majority the new leader carries the placements and
+    the restored eval's replan is a no-op; if it was lost, the replan
+    places from scratch. Either way the intent holds with no lost or
+    duplicated alloc, and no plan commits twice."""
+    servers, rpcs, addrs = _mk_ring(cell, follower_max_remote=2)
+    cell.check(invariants.check("cluster_formed",
+                                _ring_formed(cell, servers)))
+    lead = _ring_leader(servers)
+    nodes = _mk_nodes(cell, 12)
+    _register_nodes(lead, nodes)
+
+    job_a = _svc_job(cell, "chaos-failover-a", 8, cpu=300)
+    with cell.window():
+        if not _settle(cell, lead, job_a):
+            cell.check(invariants.check("failover_baseline_settled",
+                                        False, job=job_a.id))
+
+    # arm the tripwire, then drive one more remote plan through the
+    # applier; the hook fires on the applier thread the instant the
+    # group's raft entry is dispatched, and THIS thread does the kill
+    cell.injector.trip_on_group_commit(nth=1)
+    job_b = _svc_job(cell, "chaos-failover-b", 8, cpu=300)
+    t0 = time.perf_counter()
+    lead.register_job(job_b)
+    tripped = cell.injector.group_commit_tripped.wait(timeout=25)
+    cell.check(invariants.check(
+        "group_commit_tripped", tripped,
+        tripped_index=cell.injector.tripped_group_index))
+    old = lead
+    old_rpc = rpcs[servers.index(old)]
+    old_rpc.shutdown()
+    old.shutdown()
+    cell.release(old_rpc)
+    cell.injector.record("leader_killed", addr=old.raft.self_addr,
+                         at_group_index=cell.injector.
+                         tripped_group_index)
+
+    survivors = [s for s in servers if s is not old]
+    elected = cell.wait_for(
+        lambda: _ring_leader(survivors) is not None, timeout_s=30)
+    cell.check(invariants.check("new_leader_elected", elected))
+    new_lead = _ring_leader(survivors)
+    with cell.window():
+        ok = cell.wait_for(
+            lambda: len({a.name
+                         for a in _live(new_lead.store, job_b)})
+            >= 8, timeout_s=60)
+        cell.note_latency(time.perf_counter() - t0,
+                          placements=8 if ok else 0)
+    cell.check(invariants.check("workload_settled_after_failover",
+                                ok))
+    cell.check(invariants.alloc_intent(new_lead.store,
+                                       _intent([job_a, job_b])))
+    # did the in-flight entry survive the kill? Both outcomes are
+    # legal; record which race this run exercised
+    cell.metrics["tripped_group_index"] = \
+        cell.injector.tripped_group_index
+    cell.metrics["inflight_entry_survived"] = int(
+        _applied_index(new_lead) >= cell.injector.tripped_group_index
+        > 0)
+
+
 SCENARIOS: Dict[str, Scenario] = {s.name: s for s in [
     Scenario(
         name="system_fanout",
@@ -653,4 +913,20 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in [
                     "the SWIM layer while its process stays up — "
                     "detection, removal, quorum writes, heal",
         run=_run_swim_partition, quick=False, cluster=True),
+    Scenario(
+        name="follower_fence",
+        title="Follower scheduling over a lagging snapshot fence",
+        description="3-server ring, one follower is the sole "
+                    "scheduler; its replication lags — new evals "
+                    "fence-block until heal, stale plans are demoted "
+                    "by leader verify, never committed",
+        run=_run_follower_fence, quick=False, cluster=True),
+    Scenario(
+        name="leader_failover_commit",
+        title="Leader killed mid-group-commit",
+        description="the leader dies the instant a remote plan's "
+                    "group raft entry is dispatched; the new leader "
+                    "restores the broker from the store and the "
+                    "intent settles with no lost or duplicated alloc",
+        run=_run_leader_failover_commit, quick=False, cluster=True),
 ]}

@@ -1143,6 +1143,10 @@ def cmd_operator_debug(args) -> int:
     try_add("agent-self.json", c.agent_self)
     try_add("members.json",
             lambda: c._request("GET", "/v1/operator/members"))
+    # scheduler-plane view (ISSUE 16): per-member role/applied/fence
+    # lag + the leader's eval-lease counters ride in the bundle
+    try_add("scheduler-plane.json",
+            lambda: c._request("GET", "/v1/agent/members"))
     try_add("raft-status.json",
             lambda: c._request("GET", "/v1/operator/raft/configuration"))
     try_add("autopilot.json", c.autopilot_config)
@@ -1722,16 +1726,44 @@ def cmd_event_sink_deregister(args) -> int:
 
 
 def cmd_server_members(args) -> int:
-    """`nomad server members` (command/server_members.go shape)."""
+    """`nomad server members` (command/server_members.go shape) plus
+    the scheduler-plane columns (ISSUE 16): per-member raft role,
+    applied index, fence lag behind the leader's log, and how many
+    broker evals the leader has leased to each follower."""
     c = _client(args)
-    out = c._request("GET", "/v1/operator/members")
+    try:
+        out = c._request("GET", "/v1/agent/members")
+    except ApiError:
+        out = c._request("GET", "/v1/operator/members")
+    plane = out.get("SchedulerPlane") or {}
+    members = {m["addr"]: m for m in plane.get("members") or []}
     leader = out.get("Leader", "")
-    rows = [[m, "leader" if m == leader else "follower"]
-            for m in out.get("Members", [])]
+    rows = []
+    for addr in out.get("Members", []):
+        m = members.get(addr)
+        if m is None:
+            rows.append([addr,
+                         "leader" if addr == leader else "follower",
+                         "-", "-", "-"])
+            continue
+        rows.append([addr, str(m.get("role")),
+                     "-" if m.get("applied_index") is None
+                     else str(m["applied_index"]),
+                     "-" if m.get("fence_lag") is None
+                     else str(m["fence_lag"]),
+                     str(m.get("leased_evals", 0))])
     if not rows:
         print("single-server (dev) agent; no cluster membership")
         return 0
-    _print_rows(rows, ["Address", "Role"])
+    _print_rows(rows, ["Address", "Role", "Applied", "FenceLag",
+                       "LeasedEvals"])
+    leases = plane.get("leases") or {}
+    print(f"\nScheduler plane: "
+          f"{'on' if plane.get('enabled') else 'off'}"
+          f"  remote_dequeues={leases.get('remote_dequeues', 0)}"
+          f"  remote_plans={leases.get('remote_plans', 0)}"
+          f"  remote_demotions={leases.get('remote_demotions', 0)}"
+          f"  leases_outstanding={leases.get('outstanding', 0)}")
     return 0
 
 

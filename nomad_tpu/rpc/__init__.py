@@ -10,10 +10,11 @@ connection (each request is served by its own handler thread; responses
 are written under a lock and matched by seq client-side).
 """
 
-from .codec import FrameCodec, RpcError
+from .codec import FrameCodec, RpcError, RpcRefused
 from .server import RpcServer
 from .client import RpcClient
 from .transport import (ServerTransport, InProcTransport, RemoteTransport)
 
-__all__ = ["FrameCodec", "RpcError", "RpcServer", "RpcClient",
-           "ServerTransport", "InProcTransport", "RemoteTransport"]
+__all__ = ["FrameCodec", "RpcError", "RpcRefused", "RpcServer",
+           "RpcClient", "ServerTransport", "InProcTransport",
+           "RemoteTransport"]

@@ -23,6 +23,18 @@ class RpcError(Exception):
     """Server-side error surfaced to the caller."""
 
 
+class RpcRefused(RuntimeError):
+    """Expected refusal a handler raises on purpose (e.g. a stopped
+    raft node rejecting AppendEntries from a still-live leader during
+    staggered shutdown, or a deposed leader refusing a forwarded
+    write). The dispatcher surfaces it to the caller like any error
+    but logs it at debug — it is a protocol outcome, not a server
+    fault, and must not produce tracebacks on clean teardown or
+    leadership movement. Subclasses RuntimeError so callers guarding
+    raft writes with `except RuntimeError` treat a refusal exactly
+    like the equivalent in-process raise."""
+
+
 def _default_backend():
     # the native C++ codec (nomad_tpu/native/codec.cpp) when it builds
     # and self-checks; python-msgpack otherwise — both speak standard

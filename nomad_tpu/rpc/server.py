@@ -22,7 +22,7 @@ from typing import Any, Dict, List, Optional
 
 from ..models import Allocation, Node
 from ..utils.codec import from_wire, to_wire
-from .codec import FrameCodec
+from .codec import FrameCodec, RpcRefused
 from ..utils.locks import make_lock
 
 LOG = logging.getLogger("nomad_tpu.rpc")
@@ -219,6 +219,12 @@ class RpcServer:
                     result = self.raft.forward_rpc(method, args or {})
                 else:
                     result = fn(args or {})
+            except RpcRefused as e:
+                # deliberate refusal (stopped raft node, fenced
+                # leader): still an error to the caller, but expected
+                # during staggered teardown — debug, not a traceback
+                LOG.debug("rpc %s refused: %s", method, e)
+                err = f"{type(e).__name__}: {e}"
             except Exception as e:          # surfaced to the caller
                 LOG.exception("rpc %s failed", method)
                 err = f"{type(e).__name__}: {e}"

@@ -300,6 +300,25 @@ class ServerConfig:
     # reflect an injected failure — the failure-visibility invariant's
     # deadline (chaos/invariants.py)
     chaos_visibility_bound_s: float = 15.0
+    # distributed scheduler plane (server/follower_sched.py, ISSUE 16):
+    # when clustered, followers run worker pools against their LOCAL
+    # replicated store, dequeuing evals from the leader's broker over
+    # RPC and submitting plans back for leader-only verify/commit.
+    # Off = leader schedules alone (the pre-plane topology);
+    # NOMAD_TPU_FOLLOWER_SCHED=0 is the runtime kill switch
+    follower_sched: bool = True
+    # leader-side lease on a remotely dequeued eval: a dead follower's
+    # evals return to READY after this long (with zero re-enqueue
+    # delay — the follower failed, not the eval), instead of waiting
+    # out the broker's full 60 s unack timer
+    follower_lease_s: float = 30.0
+    # follower-side snapshot fence budget: how long a follower worker
+    # waits for local raft catch-up to reach the eval's modify index
+    # before NACKing it back (a lagging replica must not schedule from
+    # the past, and must not silently drop the eval either)
+    follower_fence_timeout_s: float = 5.0
+    # remote worker pool size per follower
+    follower_max_remote: int = 2
 
 
 class Server:
@@ -347,6 +366,13 @@ class Server:
         self.blocked_evals = BlockedEvals(self._unblock_enqueue)
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.plan_queue, self)
+        # distributed scheduler plane (ISSUE 16): the lease table is
+        # the leader-side half (remote-dequeue leases + cluster_sched
+        # counters, empty on non-leaders); the follower half is built
+        # in attach_raft — dev-mode servers never construct one
+        from .follower_sched import EvalLeaseTable
+        self.eval_leases = EvalLeaseTable(self)
+        self.follower_sched = None
         self.time_table = TimeTable()
         self.periodic = PeriodicDispatch(self)
         self.deployments_watcher = DeploymentsWatcher(self)
@@ -545,6 +571,13 @@ class Server:
         # member probes, not just the leader's replication threads
         from .swim import SwimDetector
         self.swim = SwimDetector(self)
+        # distributed scheduler plane (ISSUE 16): the remote-dequeue
+        # verb surface rides the same RPC transport raft does, and the
+        # follower worker pool is built here — started by start(),
+        # inert whenever this server is (or becomes) the leader
+        from .follower_sched import FollowerScheduler, rpc_handlers
+        rpc_server.methods.update(rpc_handlers(self))
+        self.follower_sched = FollowerScheduler(self)
 
     def start(self) -> None:
         if self.raft is None:
@@ -553,6 +586,8 @@ class Server:
             self.raft.start()
             if self.swim is not None:
                 self.swim.start()
+            if self.follower_sched is not None:
+                self.follower_sched.start()
         self.plan_applier.start()
         for i in range(self.config.num_schedulers):
             w = Worker(self, list(self.config.enabled_schedulers)
@@ -869,6 +904,30 @@ class Server:
         gov.register("trace.exemplars", _flight.exemplar_count,
                      suspect=False)
 
+        # distributed scheduler plane (server/follower_sched.py, ISSUE
+        # 16). Leader-side reads come from the lease table (remote
+        # dequeue/demotion counters, leases outstanding — the bounded
+        # in-flight remote set carries no watermark: the lease sweeper
+        # IS its reclaim); the fence-wait p99 reads the FOLLOWER-side
+        # reservoir through self.follower_sched, which attach_raft may
+        # build after these lambdas are registered — hence the getattr
+        leases = self.eval_leases
+        gov.register("cluster_sched.remote_dequeues",
+                     lambda: leases.stats["remote_dequeues"],
+                     suspect=False)
+        gov.register("cluster_sched.remote_demotions",
+                     lambda: leases.stats["remote_demotions"],
+                     suspect=False)
+        gov.register("cluster_sched.leases_outstanding",
+                     leases.outstanding)
+        gov.register("cluster_sched.lease_expiries",
+                     lambda: leases.stats["expired"], suspect=False)
+        gov.register("cluster_sched.fence_wait_p99_ms",
+                     lambda: (self.follower_sched.fence_wait_p99_ms()
+                              if self.follower_sched is not None
+                              else 0.0),
+                     unit="ms", suspect=False)
+
         # admission control: the broker sheds fresh enqueues while any
         # pressure gauge is over
         self.eval_broker.pressure_fn = gov.backpressure
@@ -951,6 +1010,11 @@ class Server:
         if rep is not None:
             rep.stop()
             self._replication = None
+        # remote-dequeue leases are leader state: the broker flush
+        # below cancels every unack they covered, and the NEW leader
+        # re-enqueues non-terminal evals from the store — stale leases
+        # here would only nack evals we no longer own
+        self.eval_leases.flush()
         self.eval_broker.set_enabled(False)
         self.blocked_evals.set_enabled(False)
         self.plan_queue.set_enabled(False)
@@ -962,6 +1026,59 @@ class Server:
             for t in self._heartbeat_timers.values():
                 t.cancel()
             self._heartbeat_timers.clear()
+
+    def scheduler_plane_status(self) -> dict:
+        """Per-member scheduler-plane status for `nomad server
+        members`, /v1/agent/members, and `operator debug` (ISSUE 16
+        satellite): raft role + applied index per member, fence lag
+        (the leader's last log index minus the member's applied index
+        — exactly the gap a follower's snapshot fence would wait out),
+        leased evals per follower from the leader's lease table, and
+        this server's own plane counters."""
+        raft = self.raft
+        status = {
+            "enabled": bool(self.config.follower_sched),
+            "leases": self.eval_leases.snapshot_stats(),
+            "follower": (self.follower_sched.snapshot_stats()
+                         if self.follower_sched is not None else None),
+            "members": [],
+        }
+        if raft is None:
+            return status
+        leased = self.eval_leases.by_follower()
+        rows = {raft.self_addr: raft._handle_status({})}
+        from ..rpc.client import RpcClient
+        for addr in (self.store.server_members() or []):
+            if addr in rows:
+                continue
+            try:
+                c = RpcClient(addr, dial_timeout_s=0.5)
+                try:
+                    rows[addr] = c.call("Raft.Status", {}, timeout_s=1.0)
+                finally:
+                    c.close()
+            except Exception:
+                rows[addr] = None
+        leader_last = 0
+        for st in rows.values():
+            if st and st.get("role") == "leader":
+                leader_last = int(st.get("last_log_index") or 0)
+        for addr in sorted(rows):
+            st = rows[addr]
+            if st is None:
+                status["members"].append(
+                    {"addr": addr, "role": "unreachable",
+                     "applied_index": None, "fence_lag": None,
+                     "leased_evals": leased.get(addr, 0)})
+                continue
+            applied = int(st.get("applied_index") or 0)
+            status["members"].append(
+                {"addr": addr, "role": st.get("role"),
+                 "applied_index": applied,
+                 "fence_lag": (max(0, leader_last - applied)
+                               if leader_last else 0),
+                 "leased_evals": leased.get(addr, 0)})
+        return status
 
     def apply_replicated(self, index: int, msg_type: str,
                          enc_payload: dict) -> None:
@@ -1025,6 +1142,14 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        # scheduler plane FIRST (ISSUE 16 satellite: clean multi-server
+        # teardown): follower dequeue loops and the lease sweeper talk
+        # to REMOTE transports — detach them before any local subsystem
+        # starts dying, so no loop is mid-RPC against a peer that this
+        # process's teardown (or a concurrent peer's) already killed
+        if self.follower_sched is not None:
+            self.follower_sched.stop()
+        self.eval_leases.stop()
         if self.persistence is not None:
             try:
                 # a background snapshot writer racing teardown could
@@ -2047,7 +2172,8 @@ class Server:
         Returns True when the member was removed."""
         raft = self.raft
         if raft is None or not raft.is_leader():
-            raise RuntimeError("not the leader")
+            from ..rpc.codec import RpcRefused
+            raise RpcRefused("not the leader")
         if addr == raft.self_addr:
             return False
         members = self.store.server_members() or \

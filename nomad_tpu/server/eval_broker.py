@@ -436,7 +436,13 @@ class EvalBroker:
             finally:
                 self._requeue.pop(token, None)
 
-    def nack(self, eval_id: str, token: str) -> None:
+    def nack(self, eval_id: str, token: str,
+             delay_s: Optional[float] = None) -> None:
+        """Return an outstanding eval to READY. `delay_s` overrides the
+        delivery-count backoff: the scheduler plane's lease sweeper
+        (ISSUE 16) passes 0.0 when a remote FOLLOWER died holding the
+        eval — the eval did nothing wrong and should redeliver
+        immediately, not serve the failed-attempt penalty."""
         with self._l:
             self._requeue.pop(token, None)
             unack = self._unack.get(eval_id)
@@ -450,7 +456,8 @@ class EvalBroker:
                 self._enqueue_locked(unack.eval, FAILED_QUEUE)
             else:
                 ev = unack.eval
-                ev.wait_s = self._nack_reenqueue_delay(dequeues)
+                ev.wait_s = (self._nack_reenqueue_delay(dequeues)
+                             if delay_s is None else delay_s)
                 if ev.wait_s > 0:
                     self._process_waiting(ev)
                 else:

@@ -42,6 +42,7 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from .. import trace
+from ..chaos import faults as chaos_faults
 from ..models import (
     Allocation, AllocsFit, Evaluation, Plan, PlanResult,
     EVAL_STATUS_PENDING,
@@ -375,7 +376,21 @@ class PlanApplier:
         _c0 = _time.perf_counter() if stages.enabled else 0.0
         index, waiter = self.server.raft_apply_async(
             "plan_results", payload)
+        if chaos_faults.ACTIVE:
+            # same dispatched-not-yet-quorum window as the group path
+            # below — the failover cell must trip even when the queue
+            # was idle and the plan committed as a singleton
+            chaos_faults.fire("plan.group_commit", index=index,
+                              plans=1)
         result.alloc_index = index
+        if result.refresh_index:
+            # partial commit: the accepted slots land at THIS index,
+            # above the verify snapshot — the retry's refresh fence
+            # must cover them or a remote worker (whose local store
+            # lags the leader's) replans from a snapshot that predates
+            # the partial commit and re-places slots that already
+            # exist (plan_apply.go applyPlan RefreshIndex = max)
+            result.refresh_index = max(result.refresh_index, index)
         if waiter is not None:
             # apply-at-commit: the store won't show this plan until the
             # committer's waiter resolves — overlay it for the next
@@ -459,6 +474,15 @@ class PlanApplier:
         _c0 = _time.perf_counter() if stages.enabled else 0.0
         index, waiter = self.server.raft_apply_async(
             "plan_group_results", dict(groups=payloads))
+        if chaos_faults.ACTIVE:
+            # chaos hook (ISSUE 16 leader_failover_commit cell): the
+            # group's entry is in the leader's log and replicating, but
+            # no submitter future has resolved — the exact window where
+            # a dying leader must not double-commit (the entry either
+            # reaches quorum and survives into the new term, or it
+            # never happened; the workers' nack/redelivery covers both)
+            chaos_faults.fire("plan.group_commit", index=index,
+                              plans=len(payloads))
         for _pending, result, payload, _evs in entries:
             if payload is not None:
                 result.alloc_index = index

@@ -11,6 +11,7 @@ from concurrent.futures import Future
 from typing import List, Optional, Tuple
 
 from ..models import Plan
+from ..rpc.codec import RpcRefused
 from ..utils.locks import make_condition
 
 
@@ -34,6 +35,11 @@ class PlanQueue:
         self._enabled = False
         self._heap: List[Tuple[int, int, PendingPlan]] = []
         self._seq = 0
+        # scheduler-plane accounting (ISSUE 16): remote plans arrive
+        # through Plan.Submit and mix with local ones in this heap —
+        # the split shows whether the cluster plane is actually feeding
+        # the applier or the leader is scheduling alone
+        self.stats = {"enqueued": 0, "enqueued_remote": 0}
 
     def set_enabled(self, enabled: bool) -> None:
         with self._l:
@@ -41,17 +47,23 @@ class PlanQueue:
             if not enabled:
                 for _, _, pending in self._heap:
                     pending.future.set_exception(
-                        RuntimeError("plan queue is disabled"))
+                        RpcRefused("plan queue is disabled"))
                 self._heap.clear()
             self._l.notify_all()
 
-    def enqueue(self, plan: Plan) -> Future:
+    def enqueue(self, plan: Plan, remote: bool = False) -> Future:
         with self._l:
             if not self._enabled:
-                raise RuntimeError("plan queue is disabled")
+                # stepdown refusal: the submitting worker nacks and the
+                # new leader's rebuilt broker redelivers — protocol,
+                # not a scheduler fault
+                raise RpcRefused("plan queue is disabled")
             pending = PendingPlan(plan)
             self._seq += 1
             heapq.heappush(self._heap, (-plan.priority, self._seq, pending))
+            self.stats["enqueued"] += 1
+            if remote:
+                self.stats["enqueued_remote"] += 1
             self._l.notify_all()
             return pending.future
 

@@ -48,6 +48,8 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .persistence import decode_payload, encode_payload
+from ..chaos import faults as chaos_faults
+from ..rpc.codec import RpcRefused
 from ..utils.locks import make_condition, make_lock, make_rlock
 
 LOG = logging.getLogger("nomad_tpu.raft")
@@ -293,12 +295,16 @@ class RaftNode:
         with self._commit_cv:
             while self.commit_index < index:
                 if self._stop.is_set():
-                    raise RuntimeError("raft node stopped")
+                    raise RpcRefused("raft node stopped")
                 if self.role != LEADER:
-                    raise RuntimeError(
+                    # stepdown mid-wait: a protocol outcome — the
+                    # caller must treat the write as not durable and
+                    # retry through the new leader; RpcRefused keeps
+                    # forwarded writes traceback-free in the dispatcher
+                    raise RpcRefused(
                         f"leadership lost before commit of {index}")
                 if term is not None and self.term != term:
-                    raise RuntimeError(
+                    raise RpcRefused(
                         f"term moved ({term} -> {self.term}) before "
                         f"commit of {index}")
                 remaining = deadline - time.monotonic()
@@ -330,7 +336,7 @@ class RaftNode:
                         f"{self._term_of(index)})")
             while self.server._raft_index < index:
                 if self._stop.is_set():
-                    raise RuntimeError("raft node stopped")
+                    raise RpcRefused("raft node stopped")
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise RuntimeError(
@@ -342,7 +348,7 @@ class RaftNode:
                       timeout_s: float = 15.0) -> int:
         leader = self.leader_addr
         if not leader:
-            raise RuntimeError("no cluster leader")
+            raise RpcRefused("no cluster leader")
         res = self._client(leader).call(
             "Raft.Forward",
             {"msg_type": msg_type,
@@ -353,7 +359,7 @@ class RaftNode:
     def forward_rpc(self, method: str, args: dict, timeout_s: float = 30.0):
         leader = self.leader_addr
         if not leader:
-            raise RuntimeError("no cluster leader")
+            raise RpcRefused("no cluster leader")
         return self._client(leader).call(method, args, timeout_s=timeout_s)
 
     # -- role transitions ----------------------------------------------
@@ -422,6 +428,15 @@ class RaftNode:
                     continue        # inert: never campaign
             if role != LEADER and \
                     time.monotonic() > self._election_deadline:
+                if chaos_faults.ACTIVE and chaos_faults.fire(
+                        "raft.election", addr=self.self_addr):
+                    # chaos hook (ISSUE 16 follower_fence cell): a
+                    # replication-lagged victim must STAY a lagging
+                    # follower — without this its missed heartbeats
+                    # would trigger a campaign, bump the term, and
+                    # depose the very leader the cell is measuring
+                    self._election_deadline = self._new_deadline()
+                    continue
                 self._run_election()
 
     def _run_election(self) -> None:
@@ -499,6 +514,17 @@ class RaftNode:
     def _replicate_peer(self, peer: str) -> bool:
         """One AppendEntries (or snapshot) round trip. Returns True if
         the peer still has a backlog and the caller should continue."""
+        if chaos_faults.ACTIVE and chaos_faults.fire("raft.replicate",
+                                                     target=peer):
+            # chaos hook (ISSUE 16): an armed replication-lag fault
+            # drops this round trip on the LEADER side — the victim's
+            # log (and store) falls behind while its process stays
+            # healthy, which is exactly the state the follower snapshot
+            # fence exists to handle. Interposing the victim's
+            # AppendEntries handler instead would either hot-loop the
+            # pump (rejection => immediate resend) or corrupt the
+            # leader's match-index accounting (fake success)
+            return False
         with self._lock:
             if self.role != LEADER:
                 return False
@@ -674,10 +700,13 @@ class RaftNode:
             # a stopped raft node must refuse RPCs: established
             # connections outlive the listener, and answering
             # AppendEntries after shutdown makes a "dead" server look
-            # alive to the leader's contact clock (and to autopilot)
+            # alive to the leader's contact clock (and to autopilot).
+            # RpcRefused keeps the refusal an error on the caller's
+            # side without tripping the dispatcher's traceback logging
+            # — staggered ring teardown is a clean path (ISSUE 16)
             def handler(args):
                 if self._stop.is_set():
-                    raise RuntimeError("raft node stopped")
+                    raise RpcRefused("raft node stopped")
                 return fn(args)
             return handler
 
@@ -807,7 +836,10 @@ class RaftNode:
 
     def _handle_forward(self, args: dict) -> dict:
         if not self.is_leader():
-            raise RuntimeError("not the leader")
+            # protocol refusal, not a fault: the forwarder rehomes to
+            # the new leader (or its caller nacks and the eval is
+            # redelivered) — no traceback for a routine stepdown
+            raise RpcRefused("not the leader")
         payload = decode_payload(args["msg_type"], args["payload"])
         index = self.server.raft_apply(args["msg_type"], payload)
         return {"index": index}

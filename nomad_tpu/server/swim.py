@@ -27,6 +27,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..chaos import faults as chaos_faults
+from ..rpc.codec import RpcRefused
 
 LOG = logging.getLogger("nomad_tpu.swim")
 
@@ -76,6 +77,14 @@ class SwimDetector:
         members = self.server.store.server_members() or \
             [raft.self_addr] + list(raft.peers)
         return [m for m in members if m != raft.self_addr]
+
+    def live_members(self) -> List[str]:
+        """Members not currently under a FAILED verdict — the
+        scheduler plane's re-homing directory (ISSUE 16): a follower
+        hunting for the new leader skips peers this detector already
+        condemned instead of eating their dial timeouts."""
+        return [m for m in self._members()
+                if self.states.get(m, {}).get("state") != STATE_FAILED]
 
     def _ping(self, addr: str) -> bool:
         if chaos_faults.ACTIVE and \
@@ -133,6 +142,11 @@ class SwimDetector:
         while not self._stop.wait(self.probe_interval_s):
             try:
                 self._tick()
+            except RpcRefused as e:
+                # a suspect/dead raft write hit a raft node that has
+                # already stopped (staggered teardown, mid-transfer
+                # fencing) — a protocol refusal, not a probe fault
+                LOG.debug("swim tick refused: %s", e)
             except Exception:       # pragma: no cover — keep probing
                 LOG.exception("swim tick failed")
 

@@ -29,6 +29,7 @@ from ..utils import gcsafe
 from typing import List, Optional
 
 from ..models import Evaluation, JOB_TYPE_CORE, Plan, PlanResult
+from ..rpc.codec import RpcError, RpcRefused
 from ..scheduler import new_scheduler
 from ..utils.locks import make_condition, make_lock
 
@@ -625,11 +626,20 @@ class Worker:
         self.id = wid
         self.batch_size = max(1, getattr(server.config,
                                          "eval_batch_size", 1))
+        # pluggable eval source/sink (ISSUE 16): local workers drain
+        # the in-process broker; FollowerWorker swaps in a RemoteBroker
+        # that reaches the leader's broker over RPC
+        self.broker = server.eval_broker
+        # snapshot-fence budget: how long to wait for the local store
+        # to reach the eval's modify index before nacking. Local
+        # workers share the store that took the write (RAFT_SYNC_LIMIT
+        # is generous); followers shrink this to follower_fence_timeout_s
+        self.fence_timeout_s = RAFT_SYNC_LIMIT
         self._stop = threading.Event()
         self._paused = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.stats = {"processed": 0, "failed": 0, "batches": 0,
-                      "pipelined_finishes": 0}
+                      "pipelined_finishes": 0, "fence_timeouts": 0}
         # pipelined dispatch: eval N's terminal bookkeeping (broker
         # ack + latency accounting) runs on a finisher thread while
         # this thread dequeues eval N+1 and starts its host phase —
@@ -720,7 +730,7 @@ class Worker:
             # NOTE: workers never consume the failed queue — the leader's
             # reaper turns those into delayed follow-up evals
             # (leader.go reapFailedEvaluations:766 / Server._reap_failed_evals)
-            ev, token = self.server.eval_broker.dequeue(
+            ev, token = self.broker.dequeue(
                 self.schedulers, DEQUEUE_TIMEOUT_S)
             if ev is None:
                 continue
@@ -731,7 +741,7 @@ class Worker:
                 # (eval_broker.go:329 Dequeue; the queue depth IS the
                 # batching opportunity)
                 while len(batch) < batch_size:
-                    ev2, tok2 = self.server.eval_broker.dequeue(
+                    ev2, tok2 = self.broker.dequeue(
                         self.schedulers, timeout_s=0)
                     if ev2 is None:
                         break
@@ -769,11 +779,20 @@ class Worker:
             return None
         return getattr(self.server, "gateway", None)
 
+    def _make_lane(self, ev: Evaluation, token: str) -> "EvalLane":
+        """Planner-lane factory seam: FollowerWorker returns a
+        RemoteEvalLane whose plans travel over Plan.Submit."""
+        return EvalLane(self.server, ev, token)
+
+    def _note_fence(self, seconds: float) -> None:
+        """Fence-wait observation hook (FollowerWorker feeds the
+        cluster_sched.fence_wait_p99_ms reservoir through this)."""
+
     # -- single eval ---------------------------------------------------
     def process_eval(self, ev: Evaluation, token: str,
                      dispatch=None, lat_scale: int = 1) -> None:
         from ..utils import metrics
-        lane = EvalLane(self.server, ev, token)
+        lane = self._make_lane(ev, token)
         if dispatch is None and ev.type != JOB_TYPE_CORE:
             # continuous micro-batching (ISSUE 7): every eval's kernel
             # dispatches flow through the server-wide gateway, where
@@ -806,11 +825,20 @@ class Worker:
                            getattr(ev, "queue_wait_s", 0.0) or 0.0)
         try:
             with trace.use(tr):
-                # wait for the state store to catch up to the eval
+                # the snapshot fence (ISSUE 16 names it): wait for the
+                # LOCAL state store to catch up to the eval's modify
+                # index. Free on the leader; on a follower this is
+                # replication lag made visible — surfaced as the
+                # fence_wait stage so the stage report separates it
+                # from sched_host
                 t0 = time.monotonic()
                 snap = self.server.store.snapshot_min_index(
-                    ev.modify_index, timeout_s=RAFT_SYNC_LIMIT)
+                    ev.modify_index, timeout_s=self.fence_timeout_s)
+                fence_dt = time.monotonic() - t0
                 metrics.measure_since("nomad.worker.wait_for_index", t0)
+                if stages.enabled and ev.type != JOB_TYPE_CORE:
+                    stages.add("fence_wait", fence_dt)
+                self._note_fence(fence_dt)
                 lane.snapshot_index = snap.latest_index()
                 if self.pipeline and ev.type != JOB_TYPE_CORE:
                     # pipelined dispatch: refresh the resident table
@@ -878,7 +906,7 @@ class Worker:
                                              queue_wait_s=q_wait)
                 a0 = time.perf_counter() if stages.enabled else 0.0
                 with trace.use(tr):
-                    self.server.eval_broker.ack(ev.id, token)
+                    self.broker.ack(ev.id, token)
                     if stages.enabled:
                         stages.add("broker_ack",
                                    time.perf_counter() - a0)
@@ -903,12 +931,28 @@ class Worker:
                 # the nack below is exactly the redelivery the cell's
                 # no-double-commit invariant exercises
                 LOG.warning("worker %d: %s", self.id, e)
+            elif isinstance(e, TimeoutError):
+                # snapshot fence expired: the local store never reached
+                # the eval's modify index (a lagging follower, or a
+                # leader mid-restore). NACK — never drop — so the eval
+                # redelivers to a scheduler whose store caught up
+                self.stats["fence_timeouts"] += 1
+                LOG.debug("worker %d: eval %s fence timed out; nacked",
+                          self.id, ev.id)
+            elif isinstance(e, (ConnectionError, RpcError,
+                                RpcRefused)):
+                # the transport under this eval died mid-flight (a
+                # killed leader during failover, a server shutting
+                # down): expected during leadership transfer — nack
+                # and let the new leader's restored broker redeliver
+                LOG.debug("worker %d: eval %s lost its transport (%s);"
+                          " nacked", self.id, ev.id, e)
             else:
                 LOG.exception("worker %d: eval %s failed", self.id,
                               ev.id)
             self.stats["failed"] += 1
             try:
-                self.server.eval_broker.nack(ev.id, token)
+                self.broker.nack(ev.id, token)
             except Exception:
                 pass
             trace.finish(tr, status="failed")

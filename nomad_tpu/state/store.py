@@ -256,6 +256,22 @@ class StateSnapshot:
     def allocs_by_deployment(self, deployment_id: str) -> List[Allocation]:
         return [a for a in self.allocs() if a.deployment_id == deployment_id]
 
+    def scheduler_parity_manifest(self) -> Dict[str, List[str]]:
+        """Canonical view of scheduling OUTCOMES for cross-cluster
+        parity checks (ISSUE 16): per job, the sorted list of live
+        alloc names. Node choice and alloc ids are timing- and
+        decorrelation-dependent and legitimately differ between
+        equivalent clusters; the name set (job × task group × index)
+        is what the scheduler promised and must match exactly —
+        3-server distributed scheduling must land the same manifest
+        as a single server given the same workload."""
+        out: Dict[str, List[str]] = {}
+        for a in self.allocs():
+            if a.terminal_status():
+                continue
+            out.setdefault(f"{a.namespace}/{a.job_id}", []).append(a.name)
+        return {k: sorted(v) for k, v in out.items()}
+
     def _by_index(self, index_table: str, key, target: str) -> List:
         ids = self._root.table(index_table).get(key)
         if not ids:

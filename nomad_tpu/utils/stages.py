@@ -76,8 +76,9 @@ from typing import Callable, Dict, Optional
 from .locks import make_lock
 
 STAGES = ("restore", "wal_replay", "table_build", "h2d", "kernel",
-          "d2h", "reconcile", "preempt", "queue_wait", "gateway_wait",
-          "sched_host", "plan_verify", "plan_commit", "broker_ack")
+          "d2h", "reconcile", "preempt", "queue_wait", "fence_wait",
+          "gateway_wait", "sched_host", "plan_verify", "plan_commit",
+          "broker_ack")
 
 # superset accumulators: wholly contain other stages' time (sched_host
 # wraps reconcile + table_build + h2d + kernel + d2h per dispatch), so
@@ -91,8 +92,12 @@ SHARE_SUPERSETS = frozenset({"sched_host"})
 # queue_wait is dead time on the broker heap, not attributable work: a
 # paused-worker burst would let it dwarf every real stage and wreck
 # the cross-round share ratios, so it too stays out of the denominator
-# (its own share is still reported against it, like the supersets)
-SHARE_EXCLUDED = SHARE_SUPERSETS | frozenset({"queue_wait"})
+# (its own share is still reported against it, like the supersets).
+# fence_wait (ISSUE 16) is the same kind of dead time — replication
+# lag observed at the snapshot fence, ~0 on a leader and bounded by
+# follower_fence_timeout_s on a lagging follower
+SHARE_EXCLUDED = SHARE_SUPERSETS | frozenset({"queue_wait",
+                                              "fence_wait"})
 
 # cold-start stages dilute steady-state shares when a run cold-boots
 # mid-round (ISSUE 9 satellite): snapshot() reports `steady_share`

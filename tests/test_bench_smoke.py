@@ -214,6 +214,35 @@ def test_bench_py_emits_json_line_on_cpu():
     assert data["chaos_wal_corruption_pass"] is True, data
     assert data["chaos_race"] in ("on", "off")
     assert data["chaos_race_findings"] == 0
+    # distributed scheduler plane (ISSUE 16): the 3-server ladder
+    # scenario ran both arms on a geo-stretched ring (wire_latency
+    # armed identically in both) and the follower plane must clear
+    # 2x the leader-only control arm; structural engagement —
+    # followers actually dequeued and planned remotely, and the
+    # applier amortized remote plans into groups — rides the artifact
+    assert data["multiserver_placements_per_sec"] > 0
+    assert data["multiserver_placements_per_sec_off"] > 0
+    assert data["multiserver_speedup"] >= 2.0, data
+    assert data["multiserver_fence_wait_p99_ms"] >= 0.0
+    assert data["multiserver_remote_demotions"] >= 0
+    assert data["multiserver_remote_dequeues"] > 0
+    assert data["multiserver_plans"] > 0
+    assert 0 < data["multiserver_plan_groups"] <= data["multiserver_plans"]
+    assert data["multiserver_rtt_ms"] > 0
+
+
+def test_chaos_list_shows_scheduler_plane_cells():
+    """`nomad dev chaos -list` must advertise the two ISSUE 16 cells
+    alongside the rest of the matrix."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "nomad_tpu.cli.main", "dev", "chaos",
+         "-list"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=repo)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "leader_failover_commit" in out.stdout, out.stdout
+    assert "follower_fence" in out.stdout, out.stdout
 
 
 def test_c2m_seed_path_at_toy_scale():

@@ -1,0 +1,427 @@
+"""Distributed scheduler plane (ISSUE 16): followers dequeue evals
+from the leader's broker over RPC, schedule against fenced local MVCC
+snapshots, and stream plans back through Plan.Submit into the leader's
+group-commit applier, which verifies local and remote plans against
+one snapshot and demotes stale ones.
+
+Covered here:
+  - remote flow end to end on a real 3-server ring (remote dequeues,
+    remote plans, broker drains, full placement)
+  - the scheduler-plane status surface behind `nomad server members`
+    and /v1/agent/members (roles, applied index, fence lag, leases)
+  - the snapshot fence: a replication-lagged follower BLOCKS (then
+    schedules once healed, its plans passing leader verify), and a
+    fence timeout NACKS the eval back to the broker instead of
+    dropping it (fence_timeouts stat, redelivery after heal)
+  - scheduler parity: the 3-server plane must land the exact same
+    per-job alloc-name manifest as a single dev-mode server given the
+    same seeded workload (quick: a handful of seeds; slow: 200)
+  - the two ISSUE 16 chaos cells (slow): leader killed mid-group-
+    commit, and the lagging-follower fence cell
+
+The ring fixture also asserts CLEAN teardown: no ERROR-level log
+records (tracebacks) may be produced by the plane across the module —
+staggered shutdown must ride the RpcRefused / quiet-nack paths, not
+LOG.exception. SWIM SUSPECT chatter is WARNING-level and allowed.
+"""
+
+import logging
+import os
+import random
+import time
+
+import pytest
+
+from nomad_tpu.mock import fixtures as mf
+from nomad_tpu.rpc import RpcServer
+from nomad_tpu.rpc.codec import RpcError
+from nomad_tpu.server import Server, ServerConfig
+
+
+def _wait(pred, timeout=30.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _node(name, dc="dc1"):
+    node = mf.node()
+    node.name = name
+    node.datacenter = dc
+    node.compute_class()
+    return node
+
+
+def _job(job_id, count=2, cpu=100):
+    job = mf.job()
+    job.id = job_id
+    job.datacenters = ["dc1"]
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.networks = []
+    for t in tg.tasks:
+        t.resources.networks = []
+        t.resources.cpu = cpu
+        t.resources.memory_mb = 32
+    return job
+
+
+def _live_names(store, job_id, ns="default"):
+    return sorted(a.name for a in store.allocs_by_job(ns, job_id)
+                  if not a.terminal_status())
+
+
+class _ErrorTrap(logging.Handler):
+    """Collects ERROR+ records for the teardown-cleanliness assert."""
+
+    def __init__(self):
+        super().__init__(level=logging.ERROR)
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(self.format(record))
+
+
+class Ring:
+    def __init__(self):
+        self.servers = []
+        self.rpcs = []
+        for _ in range(3):
+            s = Server(ServerConfig(num_schedulers=1,
+                                    heartbeat_ttl_s=300.0,
+                                    telemetry_sample_interval_s=0,
+                                    governor_interval_s=3600.0,
+                                    dead_server_cleanup_s=0.0,
+                                    follower_max_remote=2))
+            r = RpcServer(s, port=0)
+            self.servers.append(s)
+            self.rpcs.append(r)
+        addrs = [r.addr for r in self.rpcs]
+        for s, r in zip(self.servers, self.rpcs):
+            s.attach_raft(r, addrs)
+            r.start()
+            s.start()
+        assert _wait(lambda: sum(
+            s.raft.is_leader() for s in self.servers) == 1), \
+            "ring never elected a leader"
+        assert _wait(lambda: len(
+            self.leader().store.server_members()) == 3), \
+            "membership never converged"
+
+    def leader(self):
+        # tolerate a mid-run election (1-core CI can starve heartbeats
+        # long enough to trigger one): wait for the new leader
+        assert _wait(lambda: any(
+            s.raft.is_leader() for s in self.servers), 15.0), \
+            "ring has no leader"
+        return next(s for s in self.servers if s.raft.is_leader())
+
+    def register(self, job):
+        """Register through the current leader, rehoming on a
+        leadership move — what any real client does."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                self.leader().register_job(job)
+                return
+            except (RuntimeError, RpcError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+
+    def followers(self):
+        return [s for s in self.servers if not s.raft.is_leader()]
+
+    def pause(self, paused):
+        for s in self.servers:
+            for w in s.workers:
+                w.set_pause(paused)
+            if s.follower_sched is not None:
+                s.follower_sched.set_pause(paused)
+        if paused:
+            # parked remote dequeues poll-bound out before the next
+            # wave registers, so no worker holds a pre-pause lease
+            time.sleep(1.2)
+
+    def settle(self, jobs, timeout=60.0):
+        # re-resolve the leader inside the predicate: a mid-settle
+        # election must not pin reads to the deposed server
+        if _wait(lambda: all(
+                len(_live_names(self.leader().store, j.id)) ==
+                j.task_groups[0].count for j in jobs), timeout):
+            return
+        lead = self.leader()
+        lines = ["workload never fully placed"]
+        for j in jobs:
+            lines.append(f"  job {j.id} count {j.task_groups[0].count} "
+                         f"live {_live_names(lead.store, j.id)}")
+        lines.append(f"  broker {lead.eval_broker.stats.as_dict()}")
+        lines.append(f"  leases {lead.eval_leases.snapshot_stats()}")
+        for e in lead.store.evals():
+            if e.job_id in {j.id for j in jobs}:
+                lines.append(f"  eval {e.id[:8]} {e.job_id} {e.status} "
+                             f"{e.triggered_by}")
+        raise AssertionError("\n".join(lines))
+
+    def teardown(self):
+        for s, r in zip(self.servers, self.rpcs):
+            try:
+                r.shutdown()
+                s.shutdown()
+            except Exception:
+                pass
+
+
+@pytest.fixture(scope="module")
+def trap():
+    handler = _ErrorTrap()
+    logging.getLogger("nomad_tpu").addHandler(handler)
+    try:
+        yield handler
+    finally:
+        logging.getLogger("nomad_tpu").removeHandler(handler)
+        assert not handler.records, (
+            "scheduler plane produced ERROR-level records "
+            "(teardown must be traceback-clean):\n"
+            + "\n".join(handler.records[:10]))
+
+
+@pytest.fixture(scope="module")
+def ring(trap):
+    prev = os.environ.get("NOMAD_TPU_FOLLOWER_SCHED")
+    os.environ["NOMAD_TPU_FOLLOWER_SCHED"] = "1"
+    r = Ring()
+    lead = r.leader()
+    for i in range(8):
+        lead.register_node(_node(f"fsn-{i}"))
+    try:
+        yield r
+    finally:
+        r.teardown()
+        # teardown noise surfaces via the module-scoped trap above
+        if prev is None:
+            os.environ.pop("NOMAD_TPU_FOLLOWER_SCHED", None)
+        else:
+            os.environ["NOMAD_TPU_FOLLOWER_SCHED"] = prev
+
+
+def test_remote_flow_places_and_drains(ring):
+    lead = ring.leader()
+    base = dict(lead.eval_leases.snapshot_stats())
+    ring.pause(True)
+    jobs = [_job(f"flow-{i}", count=2, cpu=50) for i in range(6)]
+    for j in jobs:
+        ring.register(j)
+    ring.pause(False)
+    ring.settle(jobs)
+    stats = lead.eval_leases.snapshot_stats()
+    assert stats["remote_dequeues"] > base["remote_dequeues"], \
+        "followers never dequeued remotely"
+    assert stats["remote_plans"] > base["remote_plans"], \
+        "followers never submitted a plan"
+    # every lease returns: the broker drains to zero unacked
+    assert _wait(lambda: lead.eval_broker.stats.as_dict()["unacked"]
+                 == 0, 15.0), "broker never drained"
+    assert _wait(lambda: lead.eval_leases.outstanding() == 0, 15.0), \
+        "leases never released"
+
+
+def test_scheduler_plane_status_members(ring):
+    lead = ring.leader()
+    status = lead.scheduler_plane_status()
+    assert status["enabled"] is True
+    rows = status["members"]
+    assert len(rows) == 3
+    roles = sorted(r["role"] for r in rows)
+    assert roles == ["follower", "follower", "leader"]
+    for r in rows:
+        assert isinstance(r["applied_index"], int)
+        assert isinstance(r["fence_lag"], int)
+        assert r["leased_evals"] >= 0
+    # a follower reports its own plane counters too
+    fol = ring.followers()[0]
+    fstat = fol.scheduler_plane_status()
+    assert fstat["follower"] is not None
+    assert "fence_wait_p99_ms" in fstat["follower"]
+
+
+def test_fence_blocks_lagged_follower_then_heals(ring):
+    from nomad_tpu.chaos.faults import FaultInjector
+    lead = ring.leader()
+    victim = ring.followers()[0]
+    other = ring.followers()[1]
+    vaddr = victim.raft.self_addr
+    # only the victim may schedule: leader + other follower paused
+    for w in lead.workers:
+        w.set_pause(True)
+    other.follower_sched.set_pause(True)
+    time.sleep(1.2)     # their parked dequeues poll-bound out
+    try:
+        with FaultInjector(seed=3) as inj:
+            inj.lag_replication({vaddr})
+            job = _job("fence-heal", count=2)
+            ring.register(job)
+            # the victim dequeues but its snapshot fence cannot pass:
+            # nothing places while the lag holds
+            assert _wait(lambda: lead.eval_leases.outstanding() >= 1,
+                         10.0), "victim never leased the eval"
+            time.sleep(0.6)
+            assert _live_names(lead.store, job.id) == [], \
+                "fence let a lagging snapshot schedule"
+            inj.heal_replication()
+            ring.settle([job], timeout=30.0)
+        # the plan came from the victim and passed leader verify
+        assert victim.follower_sched.snapshot_stats()[
+            "remote_plans"] >= 1
+    finally:
+        for w in lead.workers:
+            w.set_pause(False)
+        other.follower_sched.set_pause(False)
+
+
+def test_fence_timeout_nacks_not_drops(ring):
+    from nomad_tpu.chaos.faults import FaultInjector
+    lead = ring.leader()
+    victim = ring.followers()[0]
+    other = ring.followers()[1]
+    vaddr = victim.raft.self_addr
+    for w in lead.workers:
+        w.set_pause(True)
+    other.follower_sched.set_pause(True)
+    time.sleep(1.2)
+    saved = [w.fence_timeout_s for w in victim.follower_sched.workers]
+    for w in victim.follower_sched.workers:
+        w.fence_timeout_s = 0.3
+    base_timeouts = sum(w.stats["fence_timeouts"]
+                        for w in victim.follower_sched.workers)
+    try:
+        with FaultInjector(seed=4) as inj:
+            inj.lag_replication({vaddr})
+            job = _job("fence-timeout", count=2)
+            ring.register(job)
+            # the fence times out and the eval is NACKED back to the
+            # broker — counted, not dropped
+            assert _wait(lambda: sum(
+                w.stats["fence_timeouts"]
+                for w in victim.follower_sched.workers)
+                > base_timeouts, 15.0), "fence timeout never fired"
+            inj.heal_replication()
+            # the nacked eval is redelivered and lands post-heal
+            ring.settle([job], timeout=30.0)
+    finally:
+        for w, s in zip(victim.follower_sched.workers, saved):
+            w.fence_timeout_s = s
+        for w in lead.workers:
+            w.set_pause(False)
+        other.follower_sched.set_pause(False)
+
+
+# -- scheduler parity: 3-server plane vs single dev server ------------
+
+def _seeded_jobs(seed, prefix):
+    rng = random.Random(0x5EED ^ seed)
+    jobs = []
+    for i in range(rng.randint(2, 4)):
+        jobs.append(_job(f"{prefix}-{i}",
+                         count=rng.randint(1, 3),
+                         cpu=rng.choice([50, 100])))
+    return jobs
+
+
+def _manifest(store, prefix):
+    return {k: v for k, v in store.scheduler_parity_manifest().items()
+            if k.startswith(f"default/{prefix}")}
+
+
+def _run_parity(ring, single, seeds, tag):
+    for seed in seeds:
+        prefix = f"par{tag}-{seed}"
+        jobs = _seeded_jobs(seed, prefix)
+        for j in jobs:
+            ring.register(j)
+        for j in _seeded_jobs(seed, prefix):
+            single.register_job(j)
+        ring.settle(jobs)
+        assert _wait(lambda: all(
+            len(_live_names(single.store, j.id)) ==
+            j.task_groups[0].count for j in jobs), 60.0), \
+            f"single-server arm stuck on seed {seed}"
+        got = _manifest(ring.leader().store, prefix)
+        want = _manifest(single.store, prefix)
+        assert got == want, (
+            f"parity diverged on seed {seed}:\n"
+            f"  plane : {got}\n  single: {want}")
+
+
+@pytest.fixture(scope="module")
+def single():
+    srv = Server(ServerConfig(num_schedulers=1,
+                              heartbeat_ttl_s=300.0,
+                              telemetry_sample_interval_s=0,
+                              governor_interval_s=3600.0))
+    srv.start()
+    for i in range(100):
+        srv.register_node(_node(f"psn-{i}"))
+    try:
+        yield srv
+    finally:
+        srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def parity_nodes(ring):
+    lead = ring.leader()
+    for i in range(100):
+        lead.register_node(_node(f"prn-{i}"))
+    return ring
+
+
+def test_parity_quick(parity_nodes, single):
+    _run_parity(parity_nodes, single, range(5), "q")
+
+
+@pytest.mark.slow
+def test_parity_200_seeds(parity_nodes, single):
+    _run_parity(parity_nodes, single, range(5, 205), "s")
+
+
+# -- the ISSUE 16 chaos cells (slow: each builds its own ring) --------
+
+@pytest.mark.slow
+def test_chaos_cell_leader_failover_commit(trap):
+    from nomad_tpu.chaos.matrix import run_cell
+    from nomad_tpu.chaos.scenarios import SCENARIOS
+    base = len(trap.records)
+    cell = run_cell(SCENARIOS["leader_failover_commit"], quick=True)
+    # a killed leader mid-commit legitimately logs; the teardown trap
+    # judges the plane's OWN ring, not a chaos cell's murdered one
+    del trap.records[base:]
+    assert cell["pass"], cell.get("invariants_failed") or cell
+    by_name = {c["name"]: c for c in cell["invariants"]}
+    assert by_name["group_commit_tripped"]["pass"]
+    assert by_name["new_leader_elected"]["pass"]
+    assert by_name["workload_settled_after_failover"]["pass"]
+    assert by_name["no_lost_or_duplicated_alloc"]["pass"]
+    # both races are legal; the run must record which one it was
+    assert cell["tripped_group_index"] > 0
+    assert cell["inflight_entry_survived"] in (0, 1)
+
+
+@pytest.mark.slow
+def test_chaos_cell_follower_fence(trap):
+    from nomad_tpu.chaos.matrix import run_cell
+    from nomad_tpu.chaos.scenarios import SCENARIOS
+    base = len(trap.records)
+    cell = run_cell(SCENARIOS["follower_fence"], quick=True)
+    del trap.records[base:]
+    assert cell["pass"], cell.get("invariants_failed") or cell
+    by_name = {c["name"]: c for c in cell["invariants"]}
+    assert by_name["fence_blocked_while_lagged"]["pass"]
+    assert by_name["stale_plan_demoted_not_committed"]["pass"]
+    assert by_name["recovered_after_heal"]["pass"]
+    assert by_name["no_lost_or_duplicated_alloc"]["pass"]
+    assert cell["remote_demotions"] >= 1
+    assert cell["fence_wait_p99_ms"] >= 50.0

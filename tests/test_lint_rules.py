@@ -627,6 +627,8 @@ class ServerConfig:
     race_orphan_warn_ms: float = 51.0
     chaos_documented_seed: int = 0
     chaos_orphan_seed: int = 7
+    follower_documented_lease_s: float = 15.0
+    follower_orphan_lease_s: float = 16.0
     other_knob: int = 1
 """
 
@@ -671,6 +673,7 @@ class TestSurfaceDrift:
                            "stats_documented_interval_s and "
                            "race_documented_warn_ms and "
                            "chaos_documented_seed and "
+                           "follower_documented_lease_s and "
                            "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
@@ -712,6 +715,10 @@ class TestSurfaceDrift:
         # chaos_* knobs joined the contract (ISSUE 15: scenario-matrix
         # fault-injection knobs must land in the STATUS.md knob table)
         ch_f = [f for f in out if "chaos_orphan_seed" in f.message]
+        # follower_* knobs joined the contract (ISSUE 16: distributed
+        # scheduler plane knobs must land in the STATUS.md knob table)
+        fo_f = [f for f in out if "follower_orphan_lease_s"
+                in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
@@ -728,6 +735,7 @@ class TestSurfaceDrift:
         assert len(sc_f) == 1
         assert len(ra_f) == 1
         assert len(ch_f) == 1
+        assert len(fo_f) == 1
         assert "ClientConfig.stats_orphan_slots" in sc_f[0].message
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
@@ -757,6 +765,8 @@ class TestSurfaceDrift:
         assert not any("race_documented_warn_ms" in f.message
                        for f in out)
         assert not any("chaos_documented_seed" in f.message
+                       for f in out)
+        assert not any("follower_documented_lease_s" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -790,7 +800,9 @@ class TestSurfaceDrift:
                            "race_documented_warn_ms, "
                            "race_orphan_warn_ms, "
                            "chaos_documented_seed, "
-                           "chaos_orphan_seed")
+                           "chaos_orphan_seed, "
+                           "follower_documented_lease_s, "
+                           "follower_orphan_lease_s")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))

@@ -27,6 +27,7 @@ SUITES = (
     "tests/test_client_stats.py",
     "tests/test_trace.py",
     "tests/test_parallel.py",
+    "tests/test_follower_sched.py",
 )
 
 
