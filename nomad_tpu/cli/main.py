@@ -1511,6 +1511,17 @@ def cmd_operator_top(args) -> int:
     mb = tail_vals(series, "device.mirror_bytes")
     if mb:
         print(f"  device mirror      = {mb[-1] / 1024.0:.0f} KiB")
+    # compiled feasibility economics (feas.* gauges, ISSUE 17)
+    fi = tail_vals(series, "feas.intern_values")
+    if fi:
+        fm = (tail_vals(series, "feas.mask_cache_entries") or [0.0])[-1]
+        fh = (tail_vals(series, "feas.mask_cache_hit_rate")
+              or [0.0])[-1]
+        fr = (tail_vals(series, "feas.recompiles") or [0.0])[-1]
+        print(f"  feasibility        = {fi[-1]:.0f} interned values, "
+              f"{fm:.0f} cached masks")
+        print(f"  feas mask cache    = {fh:.1%} hit rate "
+              f"({fr:.0f} recompiles)")
     # mesh block: sharded residency economics (present only when a
     # mesh dispatcher exists — the device.mesh_* family)
     md = tail_vals(series, "device.mesh_devices")

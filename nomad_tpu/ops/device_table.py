@@ -118,6 +118,124 @@ class DeviceTableState:
         self.free_ports = free_ports
 
 
+FEAS_ENTRIES_MAX = 64
+
+
+class FeasMaskStore:
+    """Device-resident combined feasibility masks (ISSUE 17).
+
+    One per mirror, keyed by the stack's feasibility cache key. Entries
+    are versioned by the node-attr index (ids_epoch, version) — the
+    authority on WHICH nodes the mask covers and WHEN it was last
+    correct — not by the mirror's own version, which advances on alloc
+    deltas that don't touch feasibility. `put` uploads the full padded
+    mask on first sight / epoch change and row-scatters on incremental
+    attr updates; `resident` hands the array to the dispatch only when
+    the request's token still names the entry exactly."""
+
+    def __init__(self):
+        self._l = make_lock()
+        # feas_key -> {"arr", "n", "n_pad", "epoch", "version"}
+        self._entries: Dict[object, dict] = {}
+        self.stats: Dict[str, int] = {
+            "uploads": 0, "scatters": 0, "hits": 0, "stale": 0,
+        }
+
+    def peek(self, key) -> Optional[Tuple[int, int]]:
+        """(ids_epoch, version) of the resident entry, or None. The
+        compiler uses this to journal only the rows changed since."""
+        with self._l:
+            e = self._entries.get(key)
+            return None if e is None else (e["epoch"], e["version"])
+
+    def put(self, key, mask: np.ndarray, epoch: int, version: int,
+            rows) -> Optional[Tuple]:
+        """Park `mask` (table-space bool[n]) on device and return the
+        token (key, epoch, version, n) a request attaches to dispatch
+        against it, or None if the upload failed. `rows` — table rows
+        changed since this entry's previous version within the same
+        epoch — selects the jitted row-scatter patch over the full
+        upload; None forces the upload."""
+        n = len(mask)
+        n_pad = _pad_n(n)
+        tok = (key, epoch, version, n)
+        # snapshot the decision inputs under the lock; the device work
+        # (upload or jitted scatter) runs OUTSIDE it — parking a mask
+        # must not serialize concurrent readers behind a dispatch
+        with self._l:
+            e = self._entries.get(key)
+            if e is not None and e["epoch"] == epoch \
+                    and e["version"] == version and e["n"] == n:
+                return tok  # already current
+            patchable = (
+                e is not None and e["epoch"] == epoch
+                and e["n"] == n and rows is not None
+                and len(rows) <= n * SPARSE_MAX_FRAC)
+            base = e["arr"] if patchable else None
+            base_ver = e["version"] if patchable else None
+        kind = "none"
+        try:
+            if patchable and len(rows) == 0:
+                # version advanced but no row's verdict context
+                # changed: stamp the entry, no device work
+                arr = base
+            elif patchable:
+                idx = np.fromiter(rows, np.int32, len(rows))
+                b = _bucket_rows(len(idx))
+                if b > len(idx):
+                    # pad with a repeat of the first row: duplicate
+                    # `.set` indices land the same value, harmless
+                    idx = np.concatenate(
+                        [idx, np.full(b - len(idx), idx[0],
+                                      np.int32)])
+                arr = _feas_scatter(base, idx, mask[idx].astype(bool))
+                kind = "scatters"
+            else:
+                padded = np.zeros(n_pad, bool)
+                padded[:n] = mask
+                import jax
+                arr = jax.device_put(padded)
+                kind = "uploads"
+        except Exception:
+            return None
+        with self._l:
+            if patchable:
+                # a concurrent put moved the entry while we patched its
+                # snapshot: our base is stale, drop this park (the next
+                # eval re-parks from its own fresher mask)
+                e2 = self._entries.get(key)
+                if e2 is None or e2["version"] != base_ver \
+                        or e2["epoch"] != epoch:
+                    return None
+            if kind != "none":
+                self.stats[kind] += 1
+            self._entries[key] = {"arr": arr, "n": n, "n_pad": n_pad,
+                                  "epoch": epoch, "version": version}
+            while len(self._entries) > FEAS_ENTRIES_MAX:
+                self._entries.pop(next(iter(self._entries)))
+            return tok
+
+    def resident(self, token, n_pad: int):
+        """The device array for `token`, or None when the entry moved
+        on (or the kernel's padding disagrees) — caller falls back to
+        packing the host mask."""
+        if token is None:
+            return None
+        key, epoch, version, n = token
+        with self._l:
+            e = self._entries.get(key)
+            if e is None or e["epoch"] != epoch \
+                    or e["version"] != version or e["n_pad"] != n_pad:
+                self.stats["stale"] += 1
+                return None
+            self.stats["hits"] += 1
+            return e["arr"]
+
+    def snapshot(self) -> dict:
+        with self._l:
+            return {"entries": len(self._entries), **self.stats}
+
+
 class DeviceNodeTable:
     """The device-resident mirror one NodeTableCache owns.
 
@@ -145,6 +263,11 @@ class DeviceNodeTable:
             "uploads": 0, "scatters": 0, "folds": 0,
             "overlay_dispatches": 0, "stale_misses": 0,
         }
+        # device-resident compiled feasibility masks (ISSUE 17): keyed
+        # by the stack's feas cache key, versioned by the attr index —
+        # deliberately NOT by this mirror's version/epoch, because node
+        # attribute changes and alloc deltas advance independently
+        self.feas = FeasMaskStore()
 
     # -- cache-side bookkeeping (called under the cache's lock) --------
     def note_rebuild(self) -> int:
@@ -400,6 +523,13 @@ def resident_request_args(mirror, req, n_pad: int,
     if req.free_ports is not None and \
             req.free_ports is getattr(t, "free_ports", None):
         out["free_ports"] = state.free_ports
+    feas = getattr(mirror, "feas", None)
+    tok = getattr(req, "feas_token", None)
+    if feas is not None and tok is not None:
+        arr = feas.resident(tok, n_pad)
+        if arr is not None:
+            out["feasible"] = arr
+            metrics.incr_counter(metric_prefix + "_feas_resident")
     metrics.incr_counter(metric_prefix + "_dispatch")
     return out
 
@@ -434,3 +564,11 @@ def _overlay_add(used, idx, vals):
     def fn(u, i, v):
         return u.at[i].add(v)
     return _jit("overlay_add", fn)(used, idx, vals)
+
+
+def _feas_scatter(mask, idx, vals):
+    from ..analysis.sanitizer import traces
+    traces.note("feas_scatter", (tuple(mask.shape), len(idx)))
+    def fn(m, i, v):
+        return m.at[i].set(v)
+    return _jit("feas_scatter", fn)(mask, idx, vals)

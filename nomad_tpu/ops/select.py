@@ -150,6 +150,11 @@ class SelectRequest:
     table: Optional[object] = None
     used_base_rows: Optional[np.ndarray] = None   # i32[M]
     used_base_deltas: Optional[np.ndarray] = None  # f32[M,D]
+    # device-resident combined feasibility mask (ISSUE 17): token into
+    # the mirror's FeasMaskStore, set by the stack only when `feasible`
+    # reaches the dispatch unmutated (no CSI/preferred residue). Any
+    # path that swaps `feasible` must clear it.
+    feas_token: Optional[Tuple] = None
 
 
 @dataclasses.dataclass
@@ -1706,6 +1711,8 @@ def partition_lanes(reqs, lane_base: int, total: int, cache):
             continue
         originals[i] = req.feasible
         req.feasible = slice_mask
+        # the sliced mask no longer matches the device-resident copy
+        req.feas_token = None
     return originals, cache
 
 
@@ -1847,6 +1854,7 @@ class SelectKernel:
             return None
         feas = req.feasible
         req.feasible = slice_mask
+        req.feas_token = None
         return feas
 
     def _select(self, req: SelectRequest) -> SelectResult:

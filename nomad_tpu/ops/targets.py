@@ -186,6 +186,113 @@ def constraint_mask(cols: TargetColumns, ltarget: str, rtarget: str,
     return np.zeros(n, dtype=bool)
 
 
+# -- scalar twins (state/node_attr_index.py + scheduler/feasible_compiler)
+#
+# The compiled feasibility engine evaluates each operand once per
+# DISTINCT interned value and broadcasts through code columns, and
+# patches single rows on node update. Both paths call these scalar
+# twins, so compiled masks match constraint_mask bit for bit by
+# construction — there is exactly one implementation of the operand
+# semantics per row.
+
+def node_target_value(node, target: str):
+    """(value, found) for ONE node — the scalar twin of
+    TargetColumns.resolve. Values are raw (not str-coerced), exactly
+    like the column path."""
+    if not target.startswith("${"):
+        return target, True
+    if target == "${node.unique.id}":
+        return node.id, True
+    if target == "${node.datacenter}":
+        return node.datacenter, True
+    if target == "${node.unique.name}":
+        return node.name, True
+    if target == "${node.class}":
+        return node.node_class, True
+    if target.startswith("${attr."):
+        v = node.attributes.get(target[len("${attr."):].removesuffix("}"))
+        return (v, True) if v is not None else (None, False)
+    if target.startswith("${meta."):
+        v = node.meta.get(target[len("${meta."):].removesuffix("}"))
+        return (v, True) if v is not None else (None, False)
+    # unknown interpolation: nothing found (reference returns nil, false)
+    return None, False
+
+
+def constraint_verdict(operand: str, rtarget: str, lval, lfound: bool,
+                       rval, rfound: bool) -> bool:
+    """One row of constraint_mask: does (lval, rval) satisfy the
+    operand? `rtarget` is the RAW constraint rtarget string — the
+    reference passes it verbatim (not the resolved value) to the
+    version/semver/regexp/set_contains comparators, and this twin
+    preserves that quirk."""
+    if operand in (CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY):
+        return True
+    if operand in ("=", "==", "is"):
+        return bool(lfound and rfound and lval == rval)
+    if operand in ("!=", "not"):
+        return (lval if lfound else None) != (rval if rfound else None)
+    if operand in ("<", "<=", ">", ">="):
+        if not (lfound and rfound):
+            return False
+        if not isinstance(lval, str) or not isinstance(rval, str):
+            return False
+        return ((operand == "<" and lval < rval) or
+                (operand == "<=" and lval <= rval) or
+                (operand == ">" and lval > rval) or
+                (operand == ">=" and lval >= rval))
+    if operand == CONSTRAINT_IS_SET:
+        return bool(lfound)
+    if operand == CONSTRAINT_IS_NOT_SET:
+        return not lfound
+    if operand == CONSTRAINT_VERSION:
+        return bool(lfound and rfound and version_matches(lval, rtarget))
+    if operand == CONSTRAINT_SEMVER:
+        return bool(lfound and rfound
+                    and version_matches(lval, rtarget, strict_semver=True))
+    if operand == CONSTRAINT_REGEX:
+        pat = _regex(rtarget)
+        if pat is None:
+            return False
+        return bool(lfound and rfound and pat.search(lval) is not None)
+    if operand in (CONSTRAINT_SET_CONTAINS, CONSTRAINT_SET_CONTAINS_ALL):
+        return bool(lfound and rfound
+                    and _check_set_contains_all(lval, rtarget))
+    if operand == CONSTRAINT_SET_CONTAINS_ANY:
+        return bool(lfound and rfound
+                    and _check_set_contains_any(lval, rtarget))
+    return False
+
+
+def driver_ok(node, driver: str) -> bool:
+    """One row of NodeTable.driver_mask (DriverChecker,
+    feasible.go:398)."""
+    info = node.drivers.get(driver)
+    if info is not None:
+        return bool(info.detected and info.healthy)
+    return node.attributes.get(f"driver.{driver}", "") not in ("", "0",
+                                                               "false")
+
+
+def host_volume_value(node, source: str):
+    """Interned access-mode value of one host volume on one node:
+    None (absent), "ro", or "rw" — the only facts
+    NodeTable.host_volume_mask reads per row."""
+    vol = node.host_volumes.get(source)
+    if vol is None:
+        return None
+    return "ro" if vol.get("read_only", False) else "rw"
+
+
+def host_volume_ok(value, ro_strict: bool) -> bool:
+    """One (volume request, node) cell of host_volume_mask: `value` is
+    host_volume_value's result, `ro_strict` is
+    `req.read_only is False` (the reference's exact identity check)."""
+    if value is None:
+        return False
+    return not (ro_strict and value == "ro")
+
+
 def affinity_columns(cols: TargetColumns, affinities: List) -> Tuple[np.ndarray, float]:
     """(weighted_match_sum: f32[N], sum_abs_weights) for NodeAffinityIterator
     (rank.go:637-668): score = sum(weight * matches) / sum(|weight|)."""

@@ -1,0 +1,425 @@
+"""Compiled feasibility engine over interned attribute columns
+(ISSUE 17 tentpole).
+
+A task group's combined constraint tree compiles ONCE per static key
+(the content-addressed key stack.py already builds) into a predicate
+program: one op per driver check, per constraint, and one for the
+host-volume set. Each op evaluates per UNIQUE value in the attribute's
+intern table (state/node_attr_index.py) and broadcasts to bool[N] by
+one np.take over the code column — O(distinct) Python, O(N) numpy,
+zero per-node attribute walks. Programs and their result masks cache
+by (static key, node epoch): a steady-state eval returns the cached
+check list untouched, and a node UPDATE re-evaluates one row per
+check through the index's mask journal (copy-on-write — older tables
+may still hold the previous arrays) instead of rebuilding bool[N].
+
+Bit-parity with the scalar reference is by construction: every unique
+value/pair verdict calls ops/targets.constraint_verdict — the same
+scalar twin a reference row evaluates — so compiled masks equal
+ops/targets.constraint_mask exactly (pinned by the 1k-seed suite in
+tests/test_feasible_columnar.py).
+
+Fallbacks (always to the existing scalar path, never an error):
+engine disabled (`NOMAD_TPU_COLUMNAR_FEAS=0` or
+ServerConfig.feas_columnar=false), detached snapshots, a snapshot
+older than the synced columns, table/index row mismatch, or an
+overflowed intern table (per-op fallback).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.job import (
+    CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY,
+    CONSTRAINT_IS_NOT_SET, CONSTRAINT_IS_SET, CONSTRAINT_REGEX,
+    CONSTRAINT_SEMVER, CONSTRAINT_SET_CONTAINS,
+    CONSTRAINT_SET_CONTAINS_ALL, CONSTRAINT_SET_CONTAINS_ANY,
+    CONSTRAINT_VERSION,
+)
+from ..ops.targets import (
+    constraint_mask, constraint_verdict, driver_ok, host_volume_ok,
+    host_volume_value, node_target_value,
+)
+from ..state import node_attr_index as nai
+
+ENV = "NOMAD_TPU_COLUMNAR_FEAS"
+
+_CFG = {"enabled": True, "mask_cache_max": 256}
+
+STATS: Dict[str, int] = {
+    "mask_hits": 0,       # cached checks returned untouched
+    "mask_patches": 0,    # journal replay: rows re-evaluated in place
+    "mask_builds": 0,     # full bool[N] builds from code columns
+    "recompiles": 0,      # predicate programs compiled
+    "fallbacks": 0,       # compiled path declined, scalar path ran
+    "rows_patched": 0,
+}
+
+# predicate programs by static key (shared across jobs with identical
+# constraint sets, like the engine cache) — FIFO bounded
+_PROGRAMS: Dict[Tuple, List[Tuple]] = {}
+_PROGRAMS_MAX = 512
+
+# operands whose row verdict reads BOTH resolved values
+_PAIR_OPS = ("=", "==", "is", "!=", "not", "<", "<=", ">", ">=")
+# operands that compare the lvalue against the RAW rtarget string but
+# still require the rtarget to resolve (reference semantics)
+_RLUT_OPS = (CONSTRAINT_VERSION, CONSTRAINT_SEMVER, CONSTRAINT_REGEX,
+             CONSTRAINT_SET_CONTAINS, CONSTRAINT_SET_CONTAINS_ALL,
+             CONSTRAINT_SET_CONTAINS_ANY)
+
+
+def configure(enabled: Optional[bool] = None,
+              intern_max_values: Optional[int] = None,
+              mask_cache_max: Optional[int] = None) -> None:
+    """Server boot wiring for the ServerConfig.feas_* knobs."""
+    if enabled is not None:
+        _CFG["enabled"] = bool(enabled)
+    if intern_max_values is not None:
+        nai.INTERN_MAX_VALUES = int(intern_max_values)
+    if mask_cache_max is not None:
+        _CFG["mask_cache_max"] = int(mask_cache_max)
+
+
+def enabled() -> bool:
+    env = os.environ.get(ENV)
+    if env is not None:
+        return env not in ("0", "off", "no", "false")
+    return _CFG["enabled"]
+
+
+def stats() -> Dict[str, int]:
+    return dict(STATS)
+
+
+def hit_rate() -> float:
+    """Steady-state effectiveness: journal patches count as hits —
+    they do O(changes) work, not O(N)."""
+    h = STATS["mask_hits"] + STATS["mask_patches"]
+    return h / max(h + STATS["mask_builds"], 1)
+
+
+def reset_stats() -> None:
+    for k in STATS:
+        STATS[k] = 0
+
+
+# -- program compilation ----------------------------------------------
+
+def _program_for(key: Tuple, tg, cons: List) -> List[Tuple]:
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    prog = []
+    for task in tg.tasks:
+        if task.driver:
+            prog.append(("driver", task.driver,
+                         f"missing drivers \"{task.driver}\""))
+    for c in cons:
+        if c.operand in (CONSTRAINT_DISTINCT_HOSTS,
+                         CONSTRAINT_DISTINCT_PROPERTY):
+            continue
+        prog.append(("cons", c.ltarget, c.rtarget, c.operand, str(c)))
+    if tg.volumes:
+        vols = tuple(
+            (req.source, getattr(req, "read_only", False) is False)
+            for req in tg.volumes.values()
+            if getattr(req, "type", "host") == "host")
+        prog.append(("vol", vols, "missing compatible host volumes"))
+    while len(_PROGRAMS) >= _PROGRAMS_MAX:
+        _PROGRAMS.pop(next(iter(_PROGRAMS)))
+    _PROGRAMS[key] = prog
+    STATS["recompiles"] += 1
+    return prog
+
+
+# -- target resolution over the index ---------------------------------
+
+_COLUMN_TARGETS = ("${node.unique.id}", "${node.datacenter}",
+                   "${node.unique.name}", "${node.class}")
+
+
+def _resolve(idx, target: str):
+    """('const', value) | ('col', AttrColumn) | ('none',) — the column
+    analog of TargetColumns.resolve's three outcomes."""
+    if not target.startswith("${"):
+        return ("const", target)
+    if target in _COLUMN_TARGETS or target.startswith("${attr.") \
+            or target.startswith("${meta."):
+        return ("col", idx.column(target))
+    return ("none",)
+
+
+def _lut_for(col, op_key: Tuple, verdict_fn) -> np.ndarray:
+    """Verdict LUT over one column's intern table + a trailing missing
+    slot (codes are -1 for missing, and -1 indexes the LAST element).
+    Append-only intern tables mean the LUT only ever EXTENDS."""
+    lut = col.luts.get(op_key)
+    want = len(col.values) + 1
+    if lut is None or len(lut) != want:
+        prior = 0 if lut is None else len(lut) - 1
+        out = np.empty(want, dtype=bool)
+        if prior:
+            out[:prior] = lut[:prior]
+        for c in range(prior, want - 1):
+            out[c] = verdict_fn(col.values[c], True)
+        out[-1] = verdict_fn(None, False)
+        col.luts[op_key] = out
+        lut = out
+    return lut
+
+
+def _found_mask(spec, n: int) -> np.ndarray:
+    kind = spec[0]
+    if kind == "const":
+        return np.ones(n, dtype=bool)
+    if kind == "col":
+        return spec[1].codes[:n] != -1
+    return np.zeros(n, dtype=bool)
+
+
+def _value_at(spec, code: int):
+    """(value, found) for one decoded code of a resolve spec."""
+    if spec[0] == "const":
+        return spec[1], True
+    if spec[0] == "none" or code < 0:
+        return None, False
+    return spec[1].values[code], True
+
+
+def _cons_mask(idx, table, ltarget: str, rtarget: str,
+               operand: str) -> np.ndarray:
+    """One constraint as bool[n] in INDEX row space."""
+    n = idx.n
+    lspec = _resolve(idx, ltarget)
+    rspec = _resolve(idx, rtarget) if rtarget else ("none",)
+    if (lspec[0] == "col" and lspec[1].overflow) or \
+            (rspec[0] == "col" and rspec[1].overflow):
+        # intern table overflowed: reference path for this op, mapped
+        # back to index rows via the inverse permutation by the caller
+        return None
+
+    if operand == CONSTRAINT_IS_SET:
+        return _found_mask(lspec, n)
+    if operand == CONSTRAINT_IS_NOT_SET:
+        return ~_found_mask(lspec, n)
+
+    if operand in _RLUT_OPS:
+        # verdict depends on (lvalue, lfound) and the RAW rtarget only;
+        # the resolved rvalue is unused but rfound still gates
+        def vf(v, found):
+            return constraint_verdict(operand, rtarget, v, found,
+                                      None, True)
+        if lspec[0] == "col":
+            col = lspec[1]
+            lut = _lut_for(col, (operand, rtarget), vf)
+            base = lut[col.codes[:n]]
+        elif lspec[0] == "const":
+            base = np.full(n, vf(lspec[1], True), dtype=bool)
+        else:
+            base = np.full(n, vf(None, False), dtype=bool)
+        return base & _found_mask(rspec, n)
+
+    # pair operands (=, !=, <... and anything unknown -> all-False):
+    # verdict per unique (lcode, rcode) pair, broadcast by np.take
+    if lspec[0] != "col" and rspec[0] != "col":
+        lv, lf = _value_at(lspec, -1)
+        rv, rf = _value_at(rspec, -1)
+        v = constraint_verdict(operand, rtarget, lv, lf, rv, rf)
+        return np.full(n, v, dtype=bool)
+    if lspec[0] == "col" and rspec[0] != "col":
+        col = lspec[1]
+        rv, rf = _value_at(rspec, -1)
+        lut = _lut_for(col, (operand, rtarget, "l", rv, rf),
+                       lambda v, found: constraint_verdict(
+                           operand, rtarget, v, found, rv, rf))
+        return lut[col.codes[:n]]
+    if lspec[0] != "col" and rspec[0] == "col":
+        col = rspec[1]
+        lv, lf = _value_at(lspec, -1)
+        lut = _lut_for(col, (operand, rtarget, "r", lv, lf),
+                       lambda v, found: constraint_verdict(
+                           operand, rtarget, lv, lf, v, found))
+        return lut[col.codes[:n]]
+    # both sides are columns: unique-pair path
+    lcol, rcol = lspec[1], rspec[1]
+    width = len(rcol.values) + 2
+    pair = ((lcol.codes[:n].astype(np.int64) + 1) * width
+            + (rcol.codes[:n].astype(np.int64) + 1))
+    uniq, inverse = np.unique(pair, return_inverse=True)
+    verdicts = np.empty(len(uniq), dtype=bool)
+    for j, p in enumerate(uniq):
+        lc = int(p) // width - 1
+        rc = int(p) % width - 1
+        lv, lf = _value_at(lspec, lc)
+        rv, rf = _value_at(rspec, rc)
+        verdicts[j] = constraint_verdict(operand, rtarget, lv, lf,
+                                         rv, rf)
+    return verdicts[inverse]
+
+
+def _vol_mask(idx, vols: Tuple) -> np.ndarray:
+    n = idx.n
+    out = np.ones(n, dtype=bool)
+    for source, ro_strict in vols:
+        col = idx.column(("vol", source))
+        if col.overflow:        # can't happen (2 values) — defensive
+            return None
+        lut = _lut_for(col, ("vol", ro_strict),
+                       lambda v, found: host_volume_ok(
+                           v if found else None, ro_strict))
+        out &= lut[col.codes[:n]]
+    return out
+
+
+def _op_mask(idx, table, perm, op) -> np.ndarray:
+    """One program op as bool[N] in TABLE row space."""
+    kind = op[0]
+    if kind == "driver":
+        col = idx.column(("driver", op[1]))
+        m = col.codes[:idx.n] != -1
+    elif kind == "vol":
+        m = _vol_mask(idx, op[1])
+        if m is None:           # defensive: scalar twin over the table
+            return np.fromiter(
+                (all(host_volume_ok(host_volume_value(node, s), ro)
+                     for s, ro in op[1]) for node in table.nodes),
+                dtype=bool, count=table.n)
+    else:
+        m = _cons_mask(idx, table, op[1], op[2], op[3])
+        if m is None:
+            # intern table overflowed: the reference path already
+            # works in table space
+            return constraint_mask(table.cols, op[1], op[2], op[3])
+    return m[perm]
+
+
+def _op_row(node, op) -> bool:
+    """One program op for ONE node — the journal-replay scalar twin."""
+    kind = op[0]
+    if kind == "driver":
+        return driver_ok(node, op[1])
+    if kind == "vol":
+        return all(host_volume_ok(host_volume_value(node, source),
+                                  ro_strict)
+                   for source, ro_strict in op[1])
+    _k, ltarget, rtarget, operand, _r = op
+    lv, lf = node_target_value(node, ltarget)
+    rv, rf = node_target_value(node, rtarget) if rtarget \
+        else (None, False)
+    return constraint_verdict(operand, rtarget, lv, lf, rv, rf)
+
+
+# -- entry points ------------------------------------------------------
+
+def static_checks(snapshot, table, tg, cons: List,
+                  key: Tuple) -> Optional[List[Tuple[str, np.ndarray]]]:
+    """The compiled twin of PlacementEngine._static_checks (drivers,
+    constraints, host volumes — device asks stay on the host path and
+    are appended by the caller). Returns the ordered (reason, bool[N])
+    list in TABLE row space, or None to fall back scalar. The caller
+    must copy before appending."""
+    if not enabled():
+        return None
+    store = getattr(snapshot, "_store", None)
+    if store is None:
+        return None
+    cache = getattr(store, "attr_index", None)
+    if cache is None or not cache.enabled:
+        return None
+    if cache.needs_build():
+        cache.build_install(snapshot)
+    with cache.lock:
+        idx = cache.synced(snapshot)
+        if idx is None:
+            STATS["fallbacks"] += 1
+            return None
+        entry = idx.mask_cache.get(key)
+        if entry is not None and entry["epoch"] == idx.ids_epoch:
+            if entry["version"] == idx.version:
+                STATS["mask_hits"] += 1
+                return entry["checks"]
+            rows = idx.rows_since(entry["version"])
+            if rows is not None:
+                perm, inv = idx.perm_for(table.ids)
+                if perm is not None:
+                    checks = _patch(idx, entry, rows, inv)
+                    entry["checks"] = checks
+                    entry["version"] = idx.version
+                    STATS["mask_patches"] += 1
+                    STATS["rows_patched"] += len(rows)
+                    return checks
+        perm, _inv = idx.perm_for(table.ids)
+        if perm is None:
+            STATS["fallbacks"] += 1
+            return None
+        prog = _program_for(key, tg, cons)
+        checks = [(op[-1], _op_mask(idx, table, perm, op))
+                  for op in prog]
+        STATS["mask_builds"] += 1
+        while len(idx.mask_cache) >= _CFG["mask_cache_max"]:
+            idx.mask_cache.pop(next(iter(idx.mask_cache)))
+        idx.mask_cache[key] = {"epoch": idx.ids_epoch,
+                               "version": idx.version,
+                               "prog": prog, "checks": checks}
+        return checks
+
+
+def _patch(idx, entry: dict, rows: List[int],
+           inv: np.ndarray) -> List[Tuple[str, np.ndarray]]:
+    """Journal replay: re-evaluate the changed rows per check,
+    copy-on-write (readers of older table versions may still hold the
+    previous arrays mid-AND)."""
+    t_rows = [int(inv[r]) for r in rows]
+    out = []
+    for (reason, mask), op in zip(entry["checks"], entry["prog"]):
+        m = mask.copy()
+        for r, tr in zip(rows, t_rows):
+            m[tr] = _op_row(idx.nodes[r], op)
+        out.append((reason, m))
+    return out
+
+
+# -- device residency (ISSUE 17 part 3) --------------------------------
+
+def push_combined(mirror, feas_key: Tuple, mask: np.ndarray, snapshot,
+                  static_key: Tuple) -> Optional[Tuple]:
+    """Push one COMBINED feasibility mask beside the mirror's resident
+    columns (ops/device_table.py FeasMaskStore). Row-patches through
+    the index's mask journal when the node-id set is unchanged — a
+    node update re-ships one bool row, not N. Returns the residency
+    token select_batch hands to the kernel dispatch, or None."""
+    if mirror is None or not enabled():
+        return None
+    store = getattr(snapshot, "_store", None)
+    if store is None:
+        return None
+    cache = getattr(store, "attr_index", None)
+    if cache is None:
+        return None
+    feas = getattr(mirror, "feas", None)
+    if feas is None:
+        return None
+    with cache.lock:
+        idx = cache._idx
+        if idx is None or idx.version != snapshot.index("nodes"):
+            return None
+        ent = idx.mask_cache.get(static_key)
+        if ent is None or ent["epoch"] != idx.ids_epoch \
+                or ent["version"] != idx.version:
+            return None
+        prev = feas.peek(feas_key)
+        rows = None
+        if prev is not None and prev[0] == idx.ids_epoch \
+                and prev[1] < idx.version:
+            changed = idx.rows_since(prev[1])
+            p = idx._perm    # set by the static_checks call this eval
+            if changed is not None and p is not None \
+                    and p[0] == idx.ids_epoch:
+                rows = [int(p[2][r]) for r in changed]
+        return feas.put(feas_key, mask, idx.ids_epoch, idx.version,
+                        rows)

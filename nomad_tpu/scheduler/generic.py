@@ -394,6 +394,8 @@ class GenericScheduler:
         engine._dc_key = None       # private table: no cross-eval cache
         engine._net_cache = {}
         engine._dev_cache = {}
+        engine._feas_tokens = {}
+        engine._feas_push_s = 0.0
         mask, _counts = engine.feasibility(tg)
         return bool(mask[0])
 

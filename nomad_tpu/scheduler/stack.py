@@ -199,6 +199,10 @@ class PlacementEngine:
         # until set_nodes (set_node_list paths stay uncached — private
         # tables don't outlive the eval anyway)
         self._dc_key: Optional[Tuple] = None
+        # device-resident feasibility tokens by feas_key (ISSUE 17):
+        # set when push_combined parks a combined mask on the mirror
+        self._feas_tokens: Dict[Tuple, Tuple] = {}
+        self._feas_push_s = 0.0
         # per-eval NetworkIndex cache: shared across select_batch calls so
         # port offers stay consistent between task groups of one plan
         self._net_cache: Dict[str, NetworkIndex] = {}
@@ -303,31 +307,47 @@ class PlacementEngine:
                        ) -> List[Tuple[str, np.ndarray]]:
         """Ordered (reason, bool[N]) columns for drivers, constraints and
         host volumes — cached on the table version (cross-eval), since
-        they depend only on node attributes."""
+        they depend only on node attributes. Store-served tables route
+        through the compiled feasibility engine
+        (scheduler/feasible_compiler.py): interned code columns + per-
+        unique-value predicate programs, masks cached across table
+        rebuilds and row-patched on node update. Any decline (engine
+        off, detached snapshot, overflowed interns) falls back to the
+        scalar reference below — same masks, bit for bit."""
         t = self.table
         if key is None:
             key = self._static_key(tg)
         hit = t.mask_cache.get(key)
         if hit is not None:
             return hit
-        checks: List[Tuple[str, np.ndarray]] = []
-        # drivers (DriverChecker)
-        for task in tg.tasks:
-            if task.driver:
-                checks.append((f"missing drivers \"{task.driver}\"",
-                               t.driver_mask(task.driver)))
-        # constraints (job + group + tasks)
-        for c in self._combined_constraints(tg):
-            if c.operand in (CONSTRAINT_DISTINCT_HOSTS,
-                             CONSTRAINT_DISTINCT_PROPERTY):
-                continue
-            checks.append((str(c), constraint_mask(t.cols, c.ltarget,
-                                                   c.rtarget, c.operand)))
-        # host volumes
-        if tg.volumes:
-            checks.append(("missing compatible host volumes",
-                           t.host_volume_mask(tg.volumes)))
-        # devices: capability mask (DeviceChecker, feasible.go:1138)
+        checks: Optional[List[Tuple[str, np.ndarray]]] = None
+        if self._dc_key is not None:
+            from . import feasible_compiler
+            compiled = feasible_compiler.static_checks(
+                self.snapshot, t, tg, self._combined_constraints(tg), key)
+            if compiled is not None:
+                checks = list(compiled)   # the compiler owns its list
+        if checks is None:
+            checks = []
+            # drivers (DriverChecker)
+            for task in tg.tasks:
+                if task.driver:
+                    checks.append((f"missing drivers \"{task.driver}\"",
+                                   t.driver_mask(task.driver)))
+            # constraints (job + group + tasks)
+            for c in self._combined_constraints(tg):
+                if c.operand in (CONSTRAINT_DISTINCT_HOSTS,
+                                 CONSTRAINT_DISTINCT_PROPERTY):
+                    continue
+                checks.append((str(c),
+                               constraint_mask(t.cols, c.ltarget,
+                                               c.rtarget, c.operand)))
+            # host volumes
+            if tg.volumes:
+                checks.append(("missing compatible host volumes",
+                               t.host_volume_mask(tg.volumes)))
+        # devices: capability mask (DeviceChecker, feasible.go:1138) —
+        # non-tensor residue, host path on BOTH arms
         from .devices import combined_device_asks, static_device_mask
         asks = combined_device_asks(tg)
         if asks:
@@ -344,6 +364,25 @@ class PlacementEngine:
         (static key, datacenters) — many evals for the same job skip
         the whole masking pass, not just the column builds. Callers
         must copy before mutating (select_batch does)."""
+        from ..utils import stages
+        if not stages.enabled:
+            return self._feasibility(tg)
+        t0 = time.perf_counter()
+        out = self._feasibility(tg)
+        dt = time.perf_counter() - t0
+        # the device park inside _feasibility is upload traffic, not
+        # mask production — report it under h2d like the other
+        # host-to-device transfers so the feasibility stage stays the
+        # mask-build attribution the bench compares across arms
+        push = self._feas_push_s
+        self._feas_push_s = 0.0
+        stages.add("feasibility", max(dt - push, 0.0))
+        if push > 0.0:
+            stages.add("h2d", push)
+        return out
+
+    def _feasibility(self, tg: TaskGroup) -> Tuple[np.ndarray,
+                                                   Dict[str, int]]:
         key = (id(self.job), self.job.version, tg.name)
         cached = self._mask_cache.get(key)
         if cached is not None:
@@ -372,6 +411,20 @@ class PlacementEngine:
         out = (mask, counts)
         if feas_key is not None:
             t.mask_cache[feas_key] = out
+            # device residency (ISSUE 17 part 3): park the combined
+            # mask beside the mirror's resident columns; select_batch
+            # hands the returned token to the kernel dispatch when the
+            # mask reaches it unmutated (CSI/preferred/penalty residue
+            # stays a host-shipped dense column)
+            if t.device_mirror is not None:
+                from . import feasible_compiler
+                t1 = time.perf_counter()
+                tok = feasible_compiler.push_combined(
+                    t.device_mirror, feas_key, mask, self.snapshot,
+                    ent.static_key)
+                self._feas_push_s = time.perf_counter() - t1
+                if tok is not None:
+                    self._feas_tokens[feas_key] = tok
         self._mask_cache[key] = out
         return out
 
@@ -621,6 +674,16 @@ class PlacementEngine:
             table_ref = t
             used_rows, used_deltas = proposed.used_sparse()
 
+        # device-resident feasibility (ISSUE 17): the mask reaches the
+        # dispatch unmutated only when no transient residue (CSI
+        # claims, preferred-node restriction) touched it — then the
+        # parked device copy substitutes for the dense bool column
+        feas_token = None
+        if self._dc_key is not None and not csi_reqs \
+                and not options.preferred_nodes:
+            feas_token = self._feas_tokens.get(
+                ("feasibility", ent.static_key, self._dc_key))
+
         req = SelectRequest(
             ask=ent.group_ask,
             count=count,
@@ -650,6 +713,7 @@ class PlacementEngine:
             table=table_ref,
             used_base_rows=used_rows,
             used_base_deltas=used_deltas,
+            feas_token=feas_token,
         )
         res = self.dispatch(req)
         elapsed = time.monotonic_ns() - start

@@ -240,6 +240,25 @@ class ServerConfig:
     # row + its victim allocs); crossing it drops the memo via the
     # governor reclaim (preemption.victim_cache_entries gauge)
     governor_preempt_cache_high: int = 150_000
+    # compiled feasibility engine (scheduler/feasible_compiler.py +
+    # state/node_attr_index.py, ISSUE 17): constraint trees compile to
+    # predicate programs over interned node-attribute columns; False
+    # restores the per-node scalar checks everywhere
+    # (NOMAD_TPU_COLUMNAR_FEAS=0 is the runtime kill switch)
+    feas_columnar: bool = True
+    # distinct-value cap per interned attribute column: a column
+    # exceeding it (near-unique values — ids, addresses) flags
+    # overflow and its constraints take the scalar path, keeping
+    # verdict LUTs small
+    feas_intern_max_values: int = 4096
+    # compiled-program/mask cache bound (FIFO past it); the governor
+    # watermark below reclaims masks earlier and keeps intern tables
+    feas_mask_cache_max: int = 256
+    # watermark on live mask-cache entries (each pins bool[N] rows per
+    # static check); crossing it drops cached masks via the governor
+    # reclaim but KEEPS the intern tables — the next eval rebuilds
+    # masks from columns, not columns from nodes
+    governor_feas_mask_cache_high: int = 192
     # eval flight recorder (nomad_tpu/trace/): always-on per-eval span
     # tracing — enqueue -> gateway -> kernel -> group commit -> ack —
     # with a byte-bounded completed-trace ring, pinned tail exemplars,
@@ -336,6 +355,14 @@ class Server:
         _preemption.configure(columnar=self.config.preempt_columnar,
                               rows_max=self.config.preempt_rows_max,
                               cache_max=self.config.preempt_cache_max)
+        # compiled feasibility knobs (module-level, same idiom); the
+        # env kill switch NOMAD_TPU_COLUMNAR_FEAS wins inside enabled()
+        from ..scheduler import feasible_compiler as _feas
+        _feas.configure(
+            enabled=self.config.feas_columnar,
+            intern_max_values=self.config.feas_intern_max_values,
+            mask_cache_max=self.config.feas_mask_cache_max)
+        self.store.attr_index.enabled = self.config.feas_columnar
         # mesh-sharded residency knob (module-level, same idiom — the
         # process-wide ShardedSelect has no ServerConfig); the env kill
         # switch NOMAD_TPU_MESH_RESIDENT wins inside resident_enabled()
@@ -847,6 +874,31 @@ class Server:
                      WatermarkPolicy(cfg.governor_preempt_cache_high),
                      reclaim=lambda:
                      self.store.table_cache.clear_preempt_cache())
+
+        # compiled feasibility engine (scheduler/feasible_compiler.py,
+        # ISSUE 17): intern-table volume, cached mask count, and the
+        # steady-state hit rate. The mask-entry gauge carries the
+        # watermark: each entry pins bool[N] rows per static check, so
+        # the reclaim drops MASKS only — intern tables survive (the
+        # next eval rebuilds masks from columns in one np.take, not
+        # columns from an O(N) node walk). Reads go through
+        # self.store.attr_index (replaced on snapshot restore); the
+        # hit rate and recompile count are module-level like the
+        # preemption stats
+        from ..scheduler import feasible_compiler as _feas_mod
+        gov.register("feas.intern_values",
+                     lambda: self.store.attr_index.gauge_stats()
+                     ["intern_values"], suspect=False)
+        gov.register("feas.mask_cache_entries",
+                     lambda: self.store.attr_index.gauge_stats()
+                     ["mask_cache_entries"],
+                     WatermarkPolicy(cfg.governor_feas_mask_cache_high),
+                     reclaim=lambda: self.store.attr_index.drop_masks())
+        gov.register("feas.mask_cache_hit_rate", _feas_mod.hit_rate,
+                     unit="ratio", suspect=False)
+        gov.register("feas.recompiles",
+                     lambda: _feas_mod.stats()["recompiles"],
+                     suspect=False)
 
         # adaptive micro-batch gateway (server/worker.py, ISSUE 7):
         # live window, mean lanes per device dispatch, and the trigger

@@ -1,0 +1,378 @@
+"""Write-through interned node-attribute column store (ISSUE 17).
+
+The constraint/feasibility path was the last O(N)-Python wall: every
+node-set rebuild re-resolved each constraint target with a per-node
+Python loop (ops/targets.py TargetColumns.resolve) and threw the
+columns away with the table. This module keeps ONE resident set of
+dictionary-encoded attribute columns on the StateStore — unique value
+-> i32 code, the r12 dedup-pool trick applied to node attrs/meta/
+class/datacenter — advanced incrementally by node register/update/
+deregister through the store's mutation path, exactly like
+state/alloc_index.py: O(changes) per advance, never O(nodes).
+
+Layout and lifecycle:
+
+  - rows are swap-delete dense (the JobAllocColumns idiom), one row
+    per store node; `ids_epoch` bumps ONLY when the node-id set
+    changes (register/deregister), so a pure attribute update keeps
+    every row number — and therefore every cached mask — valid;
+  - columns are built lazily, one O(N) pass the FIRST time a
+    constraint target is evaluated, then maintained per changed row.
+    Synthetic targets (driver health, host-volume access mode) are
+    just more columns, keyed by tuples so they can't collide with
+    real `${...}` target strings;
+  - intern tables are APPEND-ONLY: a value's code never changes, so
+    per-(operand, rtarget) verdict LUTs in the compiler
+    (scheduler/feasible_compiler.py) extend monotonically instead of
+    recomputing;
+  - every node write appends a (raft index, op, payload) delta under
+    the store lock; the next read applies pending deltas up to its
+    snapshot's node-table index. Updates within one ids_epoch land in
+    `row_log` — the mask journal the compiler (and the device-mirror
+    mask store) replays to re-evaluate ONE row per changed node
+    instead of rebuilding bool[N];
+  - a column whose intern table outgrows `INTERN_MAX_VALUES`
+    (ServerConfig.feas_intern_max_values) is flagged `overflow` and
+    its operands fall back to the scalar reference path.
+
+Concurrency contract: unlike the per-job alloc index, this index is
+GLOBAL — concurrent evals of different jobs read it simultaneously.
+All sync, column builds, and compiler mask work therefore run under
+`cache.lock`; writers take store lock -> cache lock (note_*), readers
+take cache lock alone, and the first-build install takes store lock
+-> cache lock like AllocIndexCache.get — one consistent order, no
+inversion.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.locks import make_lock
+from ..ops.targets import (
+    driver_ok, host_volume_value, node_target_value,
+)
+
+# columns whose intern table outgrows this fall back to the scalar
+# reference path (feasible_compiler re-checks per eval); poked by
+# feasible_compiler.configure from ServerConfig.feas_intern_max_values
+INTERN_MAX_VALUES = 4096
+
+# update events journaled per ids_epoch before the oldest half is
+# dropped; masks older than the retained window rebuild dense
+ROW_LOG_MAX = 4096
+
+_MISSING = object()
+
+_SERIAL = [0]
+
+
+def _column_entry(node, key):
+    """The interned value of one (node, column) cell, or _MISSING.
+    String keys are constraint targets; tuple keys are the synthetic
+    driver/host-volume columns."""
+    if isinstance(key, tuple):
+        kind, name = key
+        if kind == "driver":
+            return "1" if driver_ok(node, name) else _MISSING
+        v = host_volume_value(node, name)
+        return v if v is not None else _MISSING
+    v, found = node_target_value(node, key)
+    return v if found else _MISSING
+
+
+class AttrColumn:
+    """One interned code column: `values` is the append-only intern
+    table, `codes[row]` its i32 code per index row (-1 == missing).
+    `luts` holds the compiler's per-(operand, rtarget) verdict tables,
+    cached here so they survive mask-cache reclaims and extend in
+    place as values are interned."""
+
+    __slots__ = ("values", "code_of", "codes", "overflow", "luts")
+
+    def __init__(self, cap: int):
+        self.values: List = []
+        self.code_of: Dict = {}
+        self.codes = np.full(cap, -1, dtype=np.int32)
+        self.overflow = False
+        self.luts: Dict[Tuple, np.ndarray] = {}
+
+    def intern(self, v) -> int:
+        try:
+            c = self.code_of.get(v)
+        except TypeError:           # unhashable attribute value
+            self.overflow = True
+            return -1
+        if c is None:
+            if len(self.values) >= INTERN_MAX_VALUES:
+                self.overflow = True
+                return -1
+            c = len(self.values)
+            self.values.append(v)
+            self.code_of[v] = c
+        return c
+
+    def set_row(self, row: int, node, key) -> None:
+        v = _column_entry(node, key)
+        self.codes[row] = -1 if v is _MISSING else self.intern(v)
+
+
+class NodeAttrIndex:
+    """The resident column set. All mutation happens under the owning
+    NodeAttrIndexCache's lock."""
+
+    def __init__(self, nodes: List, version: int):
+        self.nodes: List = list(nodes)
+        self.ids: List[str] = [n.id for n in self.nodes]
+        self.row_of: Dict[str, int] = {nid: i
+                                       for i, nid in enumerate(self.ids)}
+        self.n = len(self.nodes)
+        self.cap = max(self.n, 8)
+        self.version = version        # node-table raft index synced to
+        self.ids_epoch = 0            # bumps on register/deregister only
+        self.columns: Dict[object, AttrColumn] = {}
+        # mask journal: (raft index, index row) per in-place update in
+        # the CURRENT ids_epoch; events with index > row_log_floor are
+        # all retained
+        self.row_log: List[Tuple[int, int]] = []
+        self.row_log_floor = version
+        _SERIAL[0] += 1
+        self.serial = _SERIAL[0]
+        # compiled-mask cache, owned by scheduler/feasible_compiler
+        # (living here so a store swap drops it naturally)
+        self.mask_cache: Dict[Tuple, dict] = {}
+        self.stats = {"column_builds": 0, "delta_syncs": 0,
+                      "delta_rows": 0, "row_events": 0, "epoch_bumps": 0}
+        self._perm: Optional[Tuple] = None
+
+    # -- columns -------------------------------------------------------
+    def column(self, key) -> AttrColumn:
+        """The interned column for one target, built lazily (ONE O(N)
+        pass, then incremental forever)."""
+        col = self.columns.get(key)
+        if col is None:
+            col = AttrColumn(self.cap)
+            for i, node in enumerate(self.nodes):
+                col.set_row(i, node, key)
+            self.columns[key] = col
+            self.stats["column_builds"] += 1
+        return col
+
+    def intern_values(self) -> int:
+        return sum(len(c.values) for c in self.columns.values())
+
+    # -- row maintenance -----------------------------------------------
+    def _grow(self) -> None:
+        self.cap *= 2
+        for col in self.columns.values():
+            codes = np.full(self.cap, -1, dtype=np.int32)
+            codes[:self.n] = col.codes[:self.n]
+            col.codes = codes
+
+    def _bump_epoch(self, index: int) -> None:
+        self.ids_epoch += 1
+        self.row_log.clear()
+        self.row_log_floor = index
+        self._perm = None
+        self.stats["epoch_bumps"] += 1
+
+    def apply_upsert(self, index: int, node) -> None:
+        r = self.row_of.get(node.id)
+        if r is None:
+            if self.n == self.cap:
+                self._grow()
+            r = self.n
+            self.n += 1
+            self.ids.append(node.id)
+            self.nodes.append(node)
+            self.row_of[node.id] = r
+            for key, col in self.columns.items():
+                col.set_row(r, node, key)
+            self._bump_epoch(index)
+            return
+        self.nodes[r] = node
+        for key, col in self.columns.items():
+            col.set_row(r, node, key)
+        self.row_log.append((index, r))
+        self.stats["row_events"] += 1
+        if len(self.row_log) > ROW_LOG_MAX:
+            drop = len(self.row_log) // 2
+            self.row_log_floor = self.row_log[drop - 1][0]
+            del self.row_log[:drop]
+
+    def apply_delete(self, index: int, node_id: str) -> None:
+        r = self.row_of.pop(node_id, None)
+        if r is None:
+            return
+        last = self.n - 1
+        if r != last:
+            for col in self.columns.values():
+                col.codes[r] = col.codes[last]
+            self.ids[r] = self.ids[last]
+            self.nodes[r] = self.nodes[last]
+            self.row_of[self.ids[r]] = r
+        self.ids.pop()
+        self.nodes.pop()
+        self.n = last
+        self._bump_epoch(index)
+
+    # -- mask journal --------------------------------------------------
+    def rows_since(self, version: int) -> Optional[List[int]]:
+        """Index rows updated since `version` within the current
+        ids_epoch, or None when the journal no longer reaches back
+        (caller rebuilds dense)."""
+        if version < self.row_log_floor:
+            return None
+        return sorted({r for (i, r) in self.row_log if i > version})
+
+    # -- table alignment -----------------------------------------------
+    def perm_for(self, table_ids: List[str]):
+        """(perm, inv) aligning this index with a store-served
+        NodeTable: perm[table_row] = index_row, inv[index_row] =
+        table_row. Tables are ALL store nodes sorted by id, so one perm
+        per ids_epoch serves every table generation — a pure attribute
+        update rebuilds the table but not the permutation. Returns
+        (None, None) on any mismatch (caller falls back scalar)."""
+        p = self._perm
+        if p is not None and p[0] == self.ids_epoch \
+                and len(p[1]) == len(table_ids):
+            return p[1], p[2]
+        if len(table_ids) != self.n:
+            return None, None
+        row_of = self.row_of
+        try:
+            perm = np.fromiter((row_of[i] for i in table_ids),
+                               dtype=np.int64, count=self.n)
+        except KeyError:
+            return None, None
+        inv = np.empty(self.n, dtype=np.int64)
+        inv[perm] = np.arange(self.n, dtype=np.int64)
+        self._perm = (self.ids_epoch, perm, inv)
+        return perm, inv
+
+
+class NodeAttrIndexCache:
+    """One per StateStore (`store.attr_index`): write-through deltas
+    from the node mutation path, lazy first build, and the lock every
+    compiled-mask read runs under."""
+
+    def __init__(self, enabled: bool = True, delta_max: int = 8192):
+        self.enabled = enabled
+        self.delta_max = delta_max
+        self.lock = make_lock()
+        self._idx: Optional[NodeAttrIndex] = None
+        self._deltas: List[Tuple[int, str, object]] = []
+        self.stats = {"builds": 0, "drops": 0, "folds": 0,
+                      "stale_reads": 0}
+
+    # -- write-through (called under the store lock) -------------------
+    def note_upsert(self, index: int, node) -> None:
+        if self._idx is None:
+            # unlocked early-out (the AllocIndexCache idiom): install
+            # happens under the store lock too, so a registration storm
+            # before the first columnar read pays zero mutex round-trips
+            return
+        self._note(index, "up", node)
+
+    def note_delete(self, index: int, node_id: str) -> None:
+        if self._idx is None:
+            return
+        self._note(index, "del", node_id)
+
+    def _note(self, index: int, op: str, payload) -> None:
+        with self.lock:
+            if self._idx is None:
+                return
+            if len(self._deltas) >= self.delta_max:
+                # nobody is reading: stop hoarding, rebuild on next read
+                self._idx = None
+                self._deltas.clear()
+                self.stats["drops"] += 1
+                return
+            self._deltas.append((index, op, payload))
+
+    # -- build / sync --------------------------------------------------
+    def build_install(self, snapshot) -> None:
+        """First columnar read: build the (column-less) row index from
+        the snapshot and install it iff the live store still sits at
+        the snapshot's node index — checked under the store lock, the
+        same close-the-race install AllocIndexCache.get does."""
+        store = getattr(snapshot, "_store", None)
+        if store is None or not self.enabled:
+            return
+        target = snapshot.index("nodes")
+        idx = NodeAttrIndex(snapshot.nodes(), target)
+        with store._lock:
+            if store.index("nodes") != target:
+                self.stats["stale_reads"] += 1
+                return
+            with self.lock:
+                if self._idx is None:
+                    self._idx = idx
+                    self._deltas.clear()
+                    self.stats["builds"] += 1
+
+    def synced(self, snapshot) -> Optional[NodeAttrIndex]:
+        """The index advanced to `snapshot`'s node index, or None when
+        unavailable (disabled / not built / snapshot older than the
+        synced arrays). CALLER HOLDS self.lock, and keeps holding it
+        for every read of the returned index — the global-index analog
+        of the alloc index's one-reader-per-job contract."""
+        idx = self._idx
+        if idx is None or not self.enabled:
+            return None
+        target = snapshot.index("nodes")
+        if idx.version > target:
+            self.stats["stale_reads"] += 1
+            return None
+        d = self._deltas
+        i = 0
+        while i < len(d) and d[i][0] <= target:
+            i += 1
+        if i:
+            for index, op, payload in d[:i]:
+                if op == "del":
+                    idx.apply_delete(index, payload)
+                else:
+                    idx.apply_upsert(index, payload)
+            del d[:i]
+            idx.stats["delta_syncs"] += 1
+            idx.stats["delta_rows"] += i
+        idx.version = target
+        return idx
+
+    def needs_build(self) -> bool:
+        return self.enabled and self._idx is None
+
+    # -- accounting (governor gauges) ----------------------------------
+    def gauge_stats(self) -> dict:
+        with self.lock:
+            idx = self._idx
+            out = dict(self.stats)
+            out["debt"] = len(self._deltas)
+            if idx is None:
+                out.update(intern_values=0, columns=0,
+                           mask_cache_entries=0, rows=0)
+            else:
+                out.update(intern_values=idx.intern_values(),
+                           columns=len(idx.columns),
+                           mask_cache_entries=len(idx.mask_cache),
+                           rows=idx.n, ids_epoch=idx.ids_epoch,
+                           **{f"idx_{k}": v
+                              for k, v in idx.stats.items()})
+            return out
+
+    def drop_masks(self) -> dict:
+        """Governor reclaim: drop cached masks, KEEP the intern tables
+        and code columns — the next eval rebuilds bool[N] from codes
+        (one np.take per check), not the attribute walks."""
+        with self.lock:
+            idx = self._idx
+            if idx is None:
+                return {"masks_dropped": 0}
+            dropped = len(idx.mask_cache)
+            idx.mask_cache.clear()
+            self.stats["folds"] += 1
+        return {"masks_dropped": dropped}

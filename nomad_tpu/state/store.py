@@ -467,6 +467,11 @@ class StateStore(StateSnapshot):
         # every alloc mutation below
         from .alloc_index import AllocIndexCache
         self.alloc_index = AllocIndexCache()
+        # interned node-attribute columns (state/node_attr_index.py):
+        # the feasibility compiler's resident code columns, advanced
+        # write-through by every node mutation below
+        from .node_attr_index import NodeAttrIndexCache
+        self.attr_index = NodeAttrIndexCache()
         # decoded alloc columns left behind by a columnar restore for
         # the resident table's vectorized cold build (pop_cold_columns)
         self._cold_columns = None
@@ -640,6 +645,7 @@ class StateStore(StateSnapshot):
             root = root.with_table("nodes", root.table("nodes").set(node.id, node))
             root = root.with_index("nodes", index)
             self._log_change(index, "node", node.id)
+            self.attr_index.note_upsert(index, node)
             self._publish(root)
 
     def delete_node(self, index: int, node_ids: List[str]) -> None:
@@ -651,6 +657,7 @@ class StateStore(StateSnapshot):
             root = root.with_table("nodes", t).with_index("nodes", index)
             for nid in node_ids:
                 self._log_change(index, "node", nid)
+                self.attr_index.note_delete(index, nid)
             self._publish(root)
 
     def update_node_status(self, index: int, node_id: str, status: str,
@@ -689,6 +696,7 @@ class StateStore(StateSnapshot):
         root = root.with_table("nodes", root.table("nodes").set(node_id, node))
         root = root.with_index("nodes", index)
         self._log_change(index, "node", node_id)
+        self.attr_index.note_upsert(index, node)
         self._publish(root)
 
     # -- jobs ----------------------------------------------------------
@@ -2011,6 +2019,10 @@ class StateStore(StateSnapshot):
             self.alloc_index = AllocIndexCache(
                 max_jobs=old_ai.max_jobs, delta_max=old_ai.delta_max,
                 enabled=old_ai.enabled)
+            from .node_attr_index import NodeAttrIndexCache
+            self.attr_index = NodeAttrIndexCache(
+                enabled=self.attr_index.enabled,
+                delta_max=self.attr_index.delta_max)
             root = _Root(_Table(), _Table()).edit()
             if nodes:
                 root = root.with_table(

@@ -75,10 +75,10 @@ from __future__ import annotations
 from typing import Callable, Dict, Optional
 from .locks import make_lock
 
-STAGES = ("restore", "wal_replay", "table_build", "h2d", "kernel",
-          "d2h", "reconcile", "preempt", "queue_wait", "fence_wait",
-          "gateway_wait", "sched_host", "plan_verify", "plan_commit",
-          "broker_ack")
+STAGES = ("restore", "wal_replay", "table_build", "feasibility", "h2d",
+          "kernel", "d2h", "reconcile", "preempt", "queue_wait",
+          "fence_wait", "gateway_wait", "sched_host", "plan_verify",
+          "plan_commit", "broker_ack")
 
 # superset accumulators: wholly contain other stages' time (sched_host
 # wraps reconcile + table_build + h2d + kernel + d2h per dispatch), so

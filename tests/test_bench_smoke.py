@@ -60,8 +60,11 @@ def test_bench_py_emits_json_line_on_cpu():
     # with the cold-start stages excluded from the denominator)
     # preempt joined in ISSUE 10 (batched columnar victim selection:
     # the phase behind BENCH_r05's worst number is now attributable)
-    for stage in ("restore", "wal_replay", "table_build", "h2d",
-                  "kernel", "d2h", "reconcile", "preempt", "queue_wait",
+    # feasibility joined in ISSUE 17 (compiled columnar feasibility:
+    # mask production attributed separately from the h2d push)
+    for stage in ("restore", "wal_replay", "table_build", "feasibility",
+                  "h2d", "kernel", "d2h", "reconcile", "preempt",
+                  "queue_wait",
                   "gateway_wait", "sched_host", "plan_verify",
                   "plan_commit", "broker_ack"):
         assert stage in bd, f"missing stage {stage}: {bd}"
@@ -229,6 +232,20 @@ def test_bench_py_emits_json_line_on_cpu():
     assert data["multiserver_plans"] > 0
     assert 0 < data["multiserver_plan_groups"] <= data["multiserver_plans"]
     assert data["multiserver_rtt_ms"] > 0
+    # compiled feasibility engine (ISSUE 17): the ladder ran the
+    # constraint-heavy cell with NOMAD_TPU_COLUMNAR_FEAS on and off
+    # in-process; the compiled path must clear 3x the scalar attribute
+    # walk at quick scale, the warm window must pay ZERO column
+    # rebuilds (incremental intern maintenance only), and the mask
+    # cache must serve >90% of evals from cache/journal patches
+    assert data["feas_mask_build_ms"] > 0
+    assert data["feas_mask_build_ms_off"] > 0
+    assert data["feas_speedup"] >= 3.0, data
+    assert data["feas_intern_values"] > 0
+    assert data["feas_mask_cache_hit_rate"] > 0.9, data
+    assert data["feas_column_rebuilds"] == 0, data
+    assert data["feas_rows_patched"] > 0
+    assert bd["feasibility"]["calls"] > 0
 
 
 def test_chaos_list_shows_scheduler_plane_cells():

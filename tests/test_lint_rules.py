@@ -629,6 +629,8 @@ class ServerConfig:
     chaos_orphan_seed: int = 7
     follower_documented_lease_s: float = 15.0
     follower_orphan_lease_s: float = 16.0
+    feas_documented_cache_max: int = 256
+    feas_orphan_cache_max: int = 257
     other_knob: int = 1
 """
 
@@ -674,6 +676,7 @@ class TestSurfaceDrift:
                            "race_documented_warn_ms and "
                            "chaos_documented_seed and "
                            "follower_documented_lease_s and "
+                           "feas_documented_cache_max and "
                            "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
@@ -719,6 +722,9 @@ class TestSurfaceDrift:
         # scheduler plane knobs must land in the STATUS.md knob table)
         fo_f = [f for f in out if "follower_orphan_lease_s"
                 in f.message]
+        # feas_* knobs joined the contract (ISSUE 17: compiled
+        # feasibility knobs must land in the STATUS.md knob table)
+        fe_f = [f for f in out if "feas_orphan_cache_max" in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
@@ -736,6 +742,7 @@ class TestSurfaceDrift:
         assert len(ra_f) == 1
         assert len(ch_f) == 1
         assert len(fo_f) == 1
+        assert len(fe_f) == 1
         assert "ClientConfig.stats_orphan_slots" in sc_f[0].message
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
@@ -767,6 +774,8 @@ class TestSurfaceDrift:
         assert not any("chaos_documented_seed" in f.message
                        for f in out)
         assert not any("follower_documented_lease_s" in f.message
+                       for f in out)
+        assert not any("feas_documented_cache_max" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -802,7 +811,9 @@ class TestSurfaceDrift:
                            "chaos_documented_seed, "
                            "chaos_orphan_seed, "
                            "follower_documented_lease_s, "
-                           "follower_orphan_lease_s")
+                           "follower_orphan_lease_s, "
+                           "feas_documented_cache_max, "
+                           "feas_orphan_cache_max")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))

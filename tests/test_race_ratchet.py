@@ -28,6 +28,7 @@ SUITES = (
     "tests/test_trace.py",
     "tests/test_parallel.py",
     "tests/test_follower_sched.py",
+    "tests/test_feasible_columnar.py",
 )
 
 
