@@ -118,6 +118,9 @@ def main() -> None:
     # (ISSUE 7): record whether the env kill switch disabled it
     out["microbatch"] = ("off" if os.environ.get(
         "NOMAD_TPU_MICROBATCH", "1") in ("0", "off") else "on")
+    # write-side ingest gateway engagement (ISSUE 19), same discipline
+    from nomad_tpu.server.ingest import ingest_batch_enabled
+    out["ingest"] = "on" if ingest_batch_enabled() else "off"
     # retained telemetry collector engagement (ISSUE 11)
     from nomad_tpu.telemetry import enabled as _telemetry_on
     out["telemetry"] = "on" if _telemetry_on() else "off"
@@ -255,6 +258,12 @@ def main() -> None:
             GROUP_STATS["plans"] / max(GROUP_STATS["groups"], 1), 2)
         out["plan_group_conflict_retries"] = \
             GROUP_STATS["conflict_retries"]
+        # write-side ingest coalescing over the whole run (ISSUE 19):
+        # the cross-server aggregate behind the bench_ingest cell
+        from nomad_tpu.server.ingest import INGEST_STATS
+        out["ingest_stats"] = dict(INGEST_STATS)
+        out["ingest_mean_batch"] = round(
+            INGEST_STATS["writes"] / max(INGEST_STATS["batches"], 1), 2)
         from nomad_tpu.scheduler.stack import engine_cache_stats
         ec = engine_cache_stats()
         out["engine_reuse"] = ec

@@ -372,7 +372,8 @@ class SurfaceDriftRule(Rule):
     KNOB_PREFIXES = ("governor_", "plan_group_", "reconcile_",
                      "gateway_", "snapshot_", "wal_", "trace_",
                      "preempt_", "telemetry_", "mesh_", "stats_",
-                     "race_", "chaos_", "follower_", "feas_")
+                     "race_", "chaos_", "follower_", "feas_",
+                     "ingest_")
 
     # which config dataclasses carry operator knobs
     CONFIG_CLASSES = ("ServerConfig", "ClientConfig")

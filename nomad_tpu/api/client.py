@@ -66,6 +66,16 @@ class ApiClient:
             body["JobModifyIndex"] = int(check_index)
         return self._request("PUT", "/v1/jobs", body)
 
+    def register_jobs_bulk(self, specs: list) -> list:
+        """Bulk register (ISSUE 19): PUT /v1/jobs with an array body —
+        the agent coalesces the whole batch into one raft entry.
+        Each element may be a job spec dict or an {"Job": spec}
+        envelope; returns one result per input in order, either
+        {"EvalID", "JobModifyIndex"} or {"Error"}."""
+        body = [s if isinstance(s, dict) and ("Job" in s or "job" in s)
+                else {"Job": s} for s in specs]
+        return self._request("PUT", "/v1/jobs", body)
+
     def list_jobs(self, prefix: str = "") -> list:
         return self._request("GET", "/v1/jobs",
                              params={"prefix": prefix} if prefix else None)

@@ -179,8 +179,18 @@ class HTTPApiServer:
                         wait_s = parse_duration_s(q.get("wait", "5m"), 300.0)
                         api.server.store.block_min_index(
                             int(q["index"]), timeout_s=min(wait_s, 300.0))
-                    result = api.route(method, url.path, q, self._body
-                                       if method in ("PUT", "POST") else None,
+                    body_fn = None
+                    if method in ("PUT", "POST"):
+                        handler = self
+
+                        def body_fn():
+                            return handler._body()
+                        # decode-free size signal for the write-path
+                        # admission hook: shed happens on the header,
+                        # never after the JSON is already materialized
+                        body_fn.hint_bytes = int(
+                            self.headers.get("Content-Length") or 0)
+                    result = api.route(method, url.path, q, body_fn,
                                        token=token)
                     if result is None:
                         self._error(404, "not found")
@@ -522,6 +532,62 @@ class HTTPApiServer:
                 return {"ok": True}, store.latest_index()
         return None
 
+    def _admit_write(self, body_fn=None) -> None:
+        """The single write-path admission hook (ISSUE 19 satellite):
+        every eval-creating write — register, bulk register, dispatch,
+        evaluate, periodic force — funnels through here instead of
+        copy-pasting the broker valve per route. Order matters: the
+        ingest gateway's queue watermark sheds FIRST, before the body
+        is decoded (the hint rides Content-Length via
+        body_fn.hint_bytes), then the broker's delayed-heap valve runs.
+        Both raise AdmissionOverloadError -> 429 + Retry-After."""
+        s = self.server
+        ing = getattr(s, "ingest", None)
+        if ing is not None:
+            ing.check_admission(
+                int(getattr(body_fn, "hint_bytes", 0) or 0))
+        s.eval_broker.check_register_admission()
+
+    def _register_jobs_bulk(self, items: list) -> list:
+        """Array-body `PUT /v1/jobs`: each element is the same
+        envelope the single register takes ({"Job": ...} / {"job": ...}
+        / bare spec / HCL string). Specs decode through the dedup pool
+        (a storm of near-identical jobs materializes shared subtrees
+        once), then the whole admitted run parks on the ingest gateway
+        as one batch. A bad item fails ONLY its own slot; EnforceIndex
+        CAS is a per-job serialization concern the coalesced path
+        cannot honor, so those items error individually."""
+        from ..state.columnar import WirePool, from_wire_pooled
+        pool = WirePool()
+        jobs = []               # parallel to items: Job | Exception
+        for data in items:
+            try:
+                if not isinstance(data, dict):
+                    raise ValueError(
+                        "bulk register items must be objects")
+                if data.get("EnforceIndex"):
+                    raise ValueError(
+                        "EnforceIndex is not supported in bulk "
+                        "register; submit CAS registers individually")
+                spec = data.get("Job", data.get("job", data))
+                jobs.append(from_wire_pooled(Job, spec, pool)
+                            if isinstance(spec, dict)
+                            else parse_job(spec))
+            except (ValueError, KeyError, TypeError) as e:
+                jobs.append(e)
+        results = iter(self.server.register_jobs_bulk(
+            [j for j in jobs if not isinstance(j, Exception)]))
+        out = []
+        for j in jobs:
+            r = j if isinstance(j, Exception) else next(results)
+            if isinstance(r, Exception):
+                out.append({"Error": str(r)})
+            else:
+                out.append({"EvalID": r.id if r is not None else "",
+                            "JobModifyIndex": j.job_modify_index
+                            or j.modify_index})
+        return out
+
     def _route_main(self, method: str, path: str, q: dict, body_fn,
                     ns: str, idx: int, acl=None):
         s = self.server
@@ -535,11 +601,18 @@ class HTTPApiServer:
                 return jobs, idx
             if method in ("PUT", "POST"):
                 # backpressure escalation: refuse NEW work at the edge
-                # while the broker's delayed/requeue heap is over its
-                # watermark (429 + Retry-After); internal requeues and
+                # while the ingest queue or the broker's delayed heap
+                # is over watermark (429 + Retry-After) — before the
+                # body is decoded; internal requeues and
                 # already-admitted evals are never refused
-                s.eval_broker.check_register_admission()
+                self._admit_write(body_fn)
                 data = body_fn()
+                if isinstance(data, list):
+                    # array body = bulk register (ISSUE 19): the whole
+                    # batch parks on the ingest gateway and lands as
+                    # one raft entry; per-item results in order
+                    return self._register_jobs_bulk(data), \
+                        store.latest_index()
                 spec = data.get("Job", data.get("job", data))
                 job = from_wire(Job, spec) if isinstance(spec, dict) \
                     else parse_job(spec)
@@ -597,7 +670,7 @@ class HTTPApiServer:
             if sub == "dispatch" and method in ("PUT", "POST"):
                 # same edge valve as job register: parameterized
                 # dispatch is the designed high-volume eval creator
-                s.eval_broker.check_register_admission()
+                self._admit_write(body_fn)
                 import base64 as _b64
                 data = body_fn()
                 payload = data.get("Payload") or data.get("payload") or ""
@@ -609,7 +682,7 @@ class HTTPApiServer:
                         "EvalID": ev.id}, store.latest_index()
             if sub == "evaluate" and method in ("PUT", "POST"):
                 # force a fresh evaluation (job_endpoint.go Evaluate)
-                s.eval_broker.check_register_admission()
+                self._admit_write(body_fn)
                 ev = s.evaluate_job(ns, job_id)
                 return {"EvalID": ev.id}, store.latest_index()
             if sub == "scaling-events":
@@ -619,7 +692,7 @@ class HTTPApiServer:
         m = re.match(r"^/v1/job/([^/]+)/periodic/force$", path)
         if m and method in ("PUT", "POST"):
             # launch a periodic job's child NOW (periodic_endpoint.go)
-            s.eval_broker.check_register_admission()
+            self._admit_write(body_fn)
             ev = s.periodic.force_run(ns, m.group(1))
             if ev is None:
                 return {"EvalID": "", "Skipped": True}, \

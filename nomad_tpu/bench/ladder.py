@@ -1457,6 +1457,181 @@ def bench_multiserver(n_nodes: int = 100, n_jobs: int = 32,
     }
 
 
+def bench_ingest(n_nodes: int = 100, n_writers: int = 12,
+                 regs_per_writer: int = 16,
+                 updates_per_writer: int = 16,
+                 warm_jobs: int = 4, warm_count: int = 4) -> Dict:
+    """Columnar admission path (ISSUE 19): a register storm + client
+    status flood from `n_writers` concurrent submitters, mixed with
+    the service reads those registers trigger (the workers keep
+    scheduling the storm's jobs while it runs). The batched arm runs
+    the IngestGateway; the control arm is the SAME storm with
+    `NOMAD_TPU_INGEST_BATCH=0` in-process — one raft entry, one store
+    transaction, one event flush per write, as every pre-r22 server
+    ingested. Registers go through the bulk array-body path in chunks
+    (the designed storm client); status updates push one group per
+    call so coalescing across submitters is the gateway's doing, not
+    the workload's. Both arms run with a DURABLE WAL (wal_fsync, the
+    r12 group-fsync discipline): the per-write cost a real server
+    pays is the durability boundary, and amortizing it is precisely
+    what write group-commit exists for — the control arm fsyncs once
+    per raft entry, the batched arm once per coalesced batch. Keys:
+    writes/s on vs off + speedup, the full write p99 each submitter
+    saw, mean coalesced group size, shed count, and placements/s of
+    the concurrent service reads (the not-regressing guard)."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from ..mock import fixtures as mock
+    from ..models import Allocation
+    from ..server import Server, ServerConfig
+    from ..server.ingest import INGEST_ENV
+    from ..utils.codec import from_wire, to_wire
+
+    def make_job(tag: str, i: int, count: int) -> object:
+        job = mock.job()
+        job.id = f"ing-{tag}-{i}"
+        job.datacenters = ["dc1"]
+        tg = job.task_groups[0]
+        tg.count = count
+        tg.networks = []
+        for t in tg.tasks:
+            t.resources.networks = []
+            t.resources.cpu = 20
+            t.resources.memory_mb = 16
+        return job
+
+    def wait(pred, timeout_s: float) -> bool:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.01)
+        return False
+
+    def run_arm(batch_on: bool) -> Dict:
+        prev = os.environ.get(INGEST_ENV)
+        os.environ[INGEST_ENV] = "1" if batch_on else "0"
+        data_dir = tempfile.mkdtemp(prefix="nomad-tpu-bench-ingest-")
+        srv = Server(ServerConfig(
+            num_schedulers=2, heartbeat_ttl_s=3600.0,
+            telemetry_sample_interval_s=0,
+            governor_interval_s=3600.0,
+            data_dir=data_dir, wal_fsync=True,
+            snapshot_every=1 << 20))
+        try:
+            srv.start()
+            for i in range(n_nodes):
+                node = mock.node()
+                node.name = f"ingnode-{i}"
+                node.datacenter = "dc1"
+                node.compute_class()
+                srv.raft_apply("node_register", dict(node=node))
+            # warm wave: real placed allocs for the status flood to
+            # target, plus JIT/cache warmup outside the timed window
+            warm = [make_job("warm", i, warm_count)
+                    for i in range(warm_jobs)]
+            for j in warm:
+                srv.register_job(j)
+            assert wait(lambda: all(
+                len(srv.store.allocs_by_job("default", j.id))
+                == warm_count for j in warm), 60.0), \
+                "ingest warm wave stuck"
+            warm_allocs = [a for j in warm
+                           for a in srv.store.allocs_by_job(
+                               "default", j.id)]
+            # update payloads prepared OUTSIDE the timed window: the
+            # client-side copy a real agent would push
+            updates = []
+            for k in range(n_writers * updates_per_writer):
+                a = warm_allocs[k % len(warm_allocs)]
+                cp = from_wire(Allocation, to_wire(a))
+                cp.client_status = "running"
+                updates.append([cp])
+            storm = [[make_job("storm", w * regs_per_writer + i, 1)
+                      for i in range(regs_per_writer)]
+                     for w in range(n_writers)]
+
+            def writer(w: int) -> None:
+                regs, chunk = storm[w], 8
+                ups = updates[w * updates_per_writer:
+                              (w + 1) * updates_per_writer]
+                ri = ui = 0
+                while ri < len(regs) or ui < len(ups):
+                    if ri < len(regs):
+                        res = srv.register_jobs_bulk(
+                            regs[ri:ri + chunk])
+                        for r in res:
+                            if isinstance(r, Exception):
+                                raise r
+                        ri += chunk
+                    if ui < len(ups):
+                        srv.update_alloc_status_from_client(ups[ui])
+                        ui += 1
+
+            threads = [threading.Thread(target=writer, args=(w,),
+                                        daemon=True)
+                       for w in range(n_writers)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            write_wall = time.perf_counter() - t0
+            all_storm = [j for regs in storm for j in regs]
+            placed_ok = wait(lambda: all(
+                len(srv.store.allocs_by_job("default", j.id)) == 1
+                for j in all_storm), 120.0)
+            place_wall = time.perf_counter() - t0
+            placed = sum(len(srv.store.allocs_by_job("default", j.id))
+                         for j in all_storm)
+            writes = n_writers * (regs_per_writer + updates_per_writer)
+            ing = srv.ingest
+            return {
+                "writes_per_sec": writes / write_wall,
+                "placements_per_sec": placed / place_wall,
+                "ok": placed_ok,
+                "p99_ms": ing.write_p99_ms() if ing else 0.0,
+                "group_mean": ing.mean_batch_size() if ing else 0.0,
+                "shed": int(ing.stats["shed"]) if ing else 0,
+                "coalesced": int(ing.stats["coalesced_writes"])
+                if ing else 0,
+            }
+        finally:
+            srv.shutdown()
+            shutil.rmtree(data_dir, ignore_errors=True)
+            if prev is None:
+                os.environ.pop(INGEST_ENV, None)
+            else:
+                os.environ[INGEST_ENV] = prev
+
+    on = run_arm(True)
+    off = run_arm(False)
+    # structural engagement fence: the gateway must actually have
+    # coalesced concurrent writes, else the headline ratio compares
+    # two copies of the sequential path
+    assert on["group_mean"] > 1.0, (
+        f"ingest gateway never coalesced a batch: {on}")
+    assert on["ok"] and off["ok"], (
+        f"ingest storm never fully placed: on={on} off={off}")
+    return {
+        "ingest_writes_per_sec": round(on["writes_per_sec"], 1),
+        "ingest_writes_per_sec_off": round(off["writes_per_sec"], 1),
+        "ingest_speedup": round(
+            on["writes_per_sec"] / max(off["writes_per_sec"], 1e-9), 2),
+        "ingest_write_p99_ms": round(on["p99_ms"], 2),
+        "ingest_group_mean_size": round(on["group_mean"], 2),
+        "ingest_coalesced_writes": int(on["coalesced"]),
+        "ingest_shed": int(on["shed"]),
+        "ingest_read_placements_per_sec": round(
+            on["placements_per_sec"], 1),
+        "ingest_read_placements_per_sec_off": round(
+            off["placements_per_sec"], 1),
+    }
+
+
 def bench_scenario_matrix(quick: bool = True,
                           write: bool = False) -> Dict:
     """Scenario matrix under chaos (ISSUE 15): seeded workloads +
@@ -1560,6 +1735,11 @@ def run_ladder(quick: bool = False) -> Dict:
     out.update(bench_multiserver(
         n_jobs=24 if quick else 32,
         waves=2 if quick else 3))
+    # columnar admission path (ISSUE 19): batched write ingest on vs
+    # the one-entry-per-write control, same in-process storm
+    out.update(bench_ingest(
+        regs_per_writer=16 if quick else 32,
+        updates_per_writer=16 if quick else 32))
     # scenario matrix under chaos (ISSUE 15): quick runs the three
     # fastest cells (incl. worker-kill + WAL-corruption); the full
     # bench runs every single-process cell and emits CHAOS_rNN.json

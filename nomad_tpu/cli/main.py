@@ -1461,6 +1461,28 @@ def cmd_operator_top(args) -> int:
                   f"{clast('node_mem_ratio_p50'):.1%} / p99 "
                   f"{clast('node_mem_ratio_p99'):.1%}")
 
+    # write ingest block (ISSUE 19): the admission path's economics —
+    # coalescing, shed, and the full write latency each submitter saw
+    # (gauges land in the ring via the governor snapshot; the rates
+    # come from the nomad.ingest.* counter deltas)
+    if tail_vals(series, "ingest.batch_size"):
+        def ilast(name):
+            vals = tail_vals(series, f"ingest.{name}")
+            return vals[-1] if vals else 0.0
+        print()
+        print("Write ingest:")
+        print(f"  writes/s           = "
+              f"{rate_now('counter.nomad.ingest.writes'):.1f} now, "
+              f"{rate_peak('counter.nomad.ingest.writes'):.1f} peak "
+              f"({rate_now('counter.nomad.ingest.batches'):.1f} "
+              f"batches/s)")
+        print(f"  write p99          = {ilast('write_p99_ms'):.2f} ms "
+              f"(mean batch {ilast('batch_size'):.2f})")
+        print(f"  coalesced          = {ilast('coalesced_writes'):.0f} "
+              f"writes shared a raft entry, {ilast('shed'):.0f} shed")
+        print(f"  queue              = {ilast('queue_depth'):.0f} deep, "
+              f"window {ilast('window_us'):.0f} us")
+
     # recent per-stage share: p50 x reservoir occupancy approximates
     # each stage's recent seconds (reservoirs hold the last 2048
     # reports); superset/idle stages stay out of the denominator like

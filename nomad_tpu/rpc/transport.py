@@ -39,6 +39,15 @@ class ServerTransport:
     def update_alloc_status(self, allocs: List[Allocation]) -> None:
         raise NotImplementedError
 
+    def update_alloc_status_batch(
+            self, groups: List[List[Allocation]]) -> None:
+        """Push N update groups in ONE verb (Node.UpdateAllocBatch,
+        ISSUE 19): each group keeps its own eval derivation, all of
+        them coalesce into one raft entry server-side. Default bridges
+        to per-group pushes so custom transports keep working."""
+        for g in groups:
+            self.update_alloc_status(g)
+
     def derive_vault_token(self, alloc_id: str, tasks) -> dict:
         raise NotImplementedError
 
@@ -103,6 +112,10 @@ class InProcTransport(ServerTransport):
     def update_alloc_status(self, allocs: List[Allocation]) -> None:
         self.server.update_alloc_status_from_client(allocs)
 
+    def update_alloc_status_batch(
+            self, groups: List[List[Allocation]]) -> None:
+        self.server.update_alloc_status_from_client_batch(groups)
+
     def derive_vault_token(self, alloc_id: str, tasks) -> dict:
         return self.server.derive_vault_token(alloc_id, list(tasks))
 
@@ -160,6 +173,12 @@ class RemoteTransport(ServerTransport):
     def update_alloc_status(self, allocs: List[Allocation]) -> None:
         self.rpc.call("Node.UpdateAlloc",
                       {"allocs": [to_wire(a) for a in allocs]})
+
+    def update_alloc_status_batch(
+            self, groups: List[List[Allocation]]) -> None:
+        self.rpc.call("Node.UpdateAllocBatch",
+                      {"updates": [[to_wire(a) for a in g]
+                                   for g in groups]})
 
     def derive_vault_token(self, alloc_id: str, tasks) -> dict:
         return self.rpc.call("Node.DeriveVaultToken",

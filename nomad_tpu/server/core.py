@@ -338,6 +338,26 @@ class ServerConfig:
     follower_fence_timeout_s: float = 5.0
     # remote worker pool size per follower
     follower_max_remote: int = 2
+    # batched write ingest (server/ingest.py, ISSUE 19): job registers,
+    # client alloc-status updates and desired-transition writes that
+    # arrive while a raft apply is in flight park and land as ONE
+    # `ingest_batch` entry / store transaction / event flush. Entries
+    # per batch cap:
+    ingest_batch_max: int = 64
+    # coalescing window (microseconds) a lone streaming write waits for
+    # companions; governor reclaim halves it under queue pressure, a
+    # clean streak re-widens it. <0 disables the gateway entirely (the
+    # one-entry-per-write path); NOMAD_TPU_INGEST_BATCH=0 is the
+    # runtime kill switch
+    ingest_window_us: float = 200.0
+    # queued-write depth at which check_admission sheds new writes with
+    # 429/Retry-After BEFORE body decode (the byte watermark derives
+    # from this: depth x 64 KiB)
+    ingest_queue_high: int = 256
+    # governor watermark on ingest.queue_depth that fires the
+    # shrink_window reclaim (distinct from the shed watermark above —
+    # the governor reclaims well before the edge starts refusing)
+    governor_ingest_queue_high: int = 64
 
 
 class Server:
@@ -423,6 +443,18 @@ class Server:
                 min_batch=self.config.gateway_min_batch,
                 depth_fn=lambda: self.eval_broker.stats.total_ready,
                 depth_high=self.config.governor_gateway_depth_high)
+        # batched write ingest (ISSUE 19): the write-side twin of the
+        # gateway above — same no-object degeneration under window<0
+        # or the env kill switch, so every write takes the unchanged
+        # one-raft-entry-per-object path
+        self.ingest = None
+        from .ingest import IngestGateway, ingest_batch_enabled
+        if self.config.ingest_window_us >= 0 and ingest_batch_enabled():
+            self.ingest = IngestGateway(
+                self,
+                batch_max=self.config.ingest_batch_max,
+                window_us=self.config.ingest_window_us,
+                queue_high=self.config.ingest_queue_high)
         self.governor = None
         if self.config.governor_enabled:
             from ..governor import Governor
@@ -616,6 +648,8 @@ class Server:
             if self.follower_sched is not None:
                 self.follower_sched.start()
         self.plan_applier.start()
+        if self.ingest is not None:
+            self.ingest.start()
         for i in range(self.config.num_schedulers):
             w = Worker(self, list(self.config.enabled_schedulers)
                        + [JOB_TYPE_CORE], wid=i)
@@ -917,6 +951,32 @@ class Server:
             gov.register("gateway.deadline_dispatches",
                          lambda: gw.stats["deadline_dispatches"],
                          suspect=False)
+
+        # batched write ingest (server/ingest.py, ISSUE 19): queue
+        # depth carries the watermark whose reclaim HALVES the window
+        # (a deep queue means the committer is saturated — waiting for
+        # companions only adds latency; the drain trigger already
+        # self-clocks batch formation). The shed/coalesced counters
+        # are monotone, never drift suspects
+        if self.ingest is not None:
+            ing = self.ingest
+            gov.register("ingest.queue_depth", ing.queue_depth,
+                         WatermarkPolicy(cfg.governor_ingest_queue_high,
+                                         pressure=True),
+                         reclaim=ing.shrink_window)
+            gov.register("ingest.queue_bytes", ing.queue_bytes,
+                         suspect=False)
+            gov.register("ingest.window_us", ing.window_us, unit="us",
+                         suspect=False)
+            gov.register("ingest.batch_size", ing.mean_batch_size,
+                         suspect=False)
+            gov.register("ingest.coalesced_writes",
+                         lambda: ing.stats["coalesced_writes"],
+                         suspect=False)
+            gov.register("ingest.shed", lambda: ing.stats["shed"],
+                         suspect=False)
+            gov.register("ingest.write_p99_ms", ing.write_p99_ms,
+                         unit="ms", suspect=False)
 
         # recompile visibility (analysis/sanitizer.py): distinct
         # compiled trace signatures across every kernel arm — a
@@ -1238,6 +1298,8 @@ class Server:
         for w in self.workers:
             w.stop()
         self.plan_applier.stop()
+        if self.ingest is not None:
+            self.ingest.stop()
         self.eval_broker.set_enabled(False)
         self.blocked_evals.set_enabled(False)
         self.plan_queue.set_enabled(False)
@@ -1568,6 +1630,11 @@ class Server:
         self.store.reconcile_job_status(index, job.namespace, job.id)
         self.periodic.add(self.store.job_by_id(job.namespace, job.id) or job)
         for ev in p.get("evals", []):
+            if not ev.job_modify_index:
+                # ingest-embedded eval (ISSUE 19): the register and its
+                # eval share one entry, so the fence is stamped at
+                # apply time — deterministic on WAL replay too
+                ev.job_modify_index = index
             self.store.upsert_evals(index, [ev])
             self.enqueue_eval(ev)
 
@@ -1675,6 +1742,75 @@ class Server:
         self.store.upsert_plan_group_results(index, p["groups"])
         for g in p["groups"]:
             self._reconcile_job_statuses(index, g)
+
+    def _apply_ingest_batch(self, index: int, p: dict) -> None:
+        """One committed entry carrying a whole ingest GROUP (ISSUE 19,
+        server/ingest.py): coalesced registers / client alloc updates /
+        desired transitions land in submission order, with each
+        consecutive same-kind run collapsed to ONE store transaction
+        (upsert_jobs_batch / update_allocs_from_client_batch). Per-kind
+        side effects run per entry exactly as the singleton appliers
+        would, so the final state is sequential-equivalent by
+        construction."""
+        entries = p["entries"]
+        i = 0
+        while i < len(entries):
+            kind = entries[i]["kind"]
+            j = i
+            while j < len(entries) and entries[j]["kind"] == kind:
+                j += 1
+            run = entries[i:j]
+            if kind == "job_register":
+                self._ingest_apply_registers(index, run)
+            elif kind == "alloc_client_update":
+                self._ingest_apply_client_updates(index, run)
+            else:
+                for e in run:
+                    self._apply_alloc_desired_transition(index, e)
+            i = j
+
+    def _ingest_apply_registers(self, index: int, run: List[dict]) -> None:
+        # one store transaction for the run's jobs (in order, so a
+        # same-job re-register in one batch still sees the version
+        # bump), then the singleton applier's side-effect tail per job
+        self.store.upsert_jobs_batch(index, [e["job"] for e in run])
+        evals: List[Evaluation] = []
+        for e in run:
+            job: Job = e["job"]
+            self.blocked_evals.untrack(job.namespace, job.id)
+            self.store.reconcile_job_status(index, job.namespace, job.id)
+            self.periodic.add(
+                self.store.job_by_id(job.namespace, job.id) or job)
+            for ev in e.get("evals", []):
+                if not ev.job_modify_index:
+                    ev.job_modify_index = index
+                evals.append(ev)
+        if evals:
+            self.store.upsert_evals_batch([(index, evals)])
+            for ev in evals:
+                self.enqueue_eval(ev)
+
+    def _ingest_apply_client_updates(self, index: int,
+                                     run: List[dict]) -> None:
+        # the r12 WAL-replay batch promoted to the live path: one store
+        # transaction for the alloc merges, then each entry's
+        # unblock/eval/status side effects in submission order
+        self.store.update_allocs_from_client_batch(
+            [(index, e["allocs"]) for e in run])
+        for e in run:
+            for stub in e["allocs"]:
+                alloc = self.store.alloc_by_id(stub.id)
+                if alloc is None or not alloc.client_terminal_status():
+                    continue
+                node = self.store.node_by_id(alloc.node_id)
+                if node is not None:
+                    self.blocked_evals.unblock(node.computed_class,
+                                               index)
+            for ev in e.get("evals", []):
+                self.store.upsert_evals(index, [ev])
+                self.enqueue_eval(ev)
+            self._reconcile_job_statuses(index,
+                                         {"allocs_placed": e["allocs"]})
 
     def _apply_scheduler_config(self, index: int, p: dict) -> None:
         self.store.set_scheduler_config(index, p["config"])
@@ -1844,6 +1980,15 @@ class Server:
         if job.multiregion is not None and \
                 job.region in ("", "global"):
             return self._multiregion_register(job, triggered_by)
+        self._validate_register(job)
+        return self._commit_register(job, triggered_by)
+
+    def _validate_register(self, job: Job) -> None:
+        """Post-canonicalize admission checks for one register —
+        namespace existence, connect/expose hooks, implied constraints,
+        spec validation. Raises ValueError; runs in the SUBMITTER's
+        thread so a bad job in a bulk batch fails only its own slot,
+        before anything is parked on the gateway."""
         # the requested namespace must exist (job_endpoint.go Register:
         # "non-existent namespace"); "default" exists implicitly
         if self.store.namespace_by_name(job.namespace) is None:
@@ -1866,17 +2011,97 @@ class Server:
         errs = errs + connect_validate(job) + job.validate()
         if errs:
             raise ValueError("; ".join(errs))
+
+    def _commit_register(self, job: Job,
+                         triggered_by: str) -> Optional[Evaluation]:
+        """Land one fully validated register. Through the ingest
+        gateway (ISSUE 19) the job and its eval ride ONE coalesced
+        entry — the eval's job-modify fence is stamped at apply time so
+        WAL replay stays deterministic; without a gateway the unchanged
+        two-entry path runs."""
+        ev = None
+        if not (job.is_periodic() or job.is_parameterized()):
+            ev = Evaluation(
+                namespace=job.namespace, priority=job.priority,
+                type=job.type, triggered_by=triggered_by, job_id=job.id,
+                status=EVAL_STATUS_PENDING)
+        if self.ingest is not None:
+            index = self.ingest.submit(
+                "job_register",
+                dict(job=job, evals=[ev] if ev is not None else []))
+            if ev is None:
+                return None
+            ev.job_modify_index = index
+            ev.modify_index = index
+            return ev
         index = self.raft_apply("job_register", dict(job=job, evals=[]))
-        if job.is_periodic() or job.is_parameterized():
+        if ev is None:
             return None
-        ev = Evaluation(
-            namespace=job.namespace, priority=job.priority, type=job.type,
-            triggered_by=triggered_by, job_id=job.id,
-            status=EVAL_STATUS_PENDING)
         ev.job_modify_index = index
         ev.modify_index = index
         self.raft_apply("eval_update", dict(evals=[ev]))
         return ev
+
+    def register_jobs_bulk(self, jobs: List[Job],
+                           triggered_by: str = TRIGGER_JOB_REGISTER
+                           ) -> List:
+        """Array-body bulk register (ISSUE 19, `PUT /v1/jobs` with a
+        list): validate each job in the caller's thread, park every
+        admitted one on the gateway, then gather — one raft entry /
+        store transaction for the whole admitted run. Returns one
+        result PER INPUT in order: an Evaluation (or None for
+        periodic/parameterized jobs) on success, the Exception
+        otherwise — a validation failure fails ONLY its own slot, a
+        batch-commit failure fails every parked slot."""
+        if self.ingest is None:
+            out = []
+            for job in jobs:
+                try:
+                    out.append(self.register_job(job, triggered_by))
+                except Exception as e:
+                    out.append(e)
+            return out
+        slots = []              # (future | None, ev | result, err | None)
+        for job in jobs:
+            try:
+                job.canonicalize()
+                if job.multiregion is not None and \
+                        job.region in ("", "global"):
+                    # multiregion fans out over federation peers —
+                    # inherently per-job, never coalesced
+                    slots.append((None, self._multiregion_register(
+                        job, triggered_by), None))
+                    continue
+                self._validate_register(job)
+                ev = None
+                if not (job.is_periodic() or job.is_parameterized()):
+                    ev = Evaluation(
+                        namespace=job.namespace, priority=job.priority,
+                        type=job.type, triggered_by=triggered_by,
+                        job_id=job.id, status=EVAL_STATUS_PENDING)
+                fut = self.ingest.submit_async(
+                    "job_register",
+                    dict(job=job, evals=[ev] if ev is not None else []))
+                slots.append((fut, ev, None))
+            except Exception as e:
+                slots.append((None, None, e))
+        out = []
+        for fut, ev, err in slots:
+            if err is not None:
+                out.append(err)
+                continue
+            if fut is None:
+                out.append(ev)      # multiregion result, already final
+                continue
+            try:
+                index = fut.result()
+                if ev is not None:
+                    ev.job_modify_index = index
+                    ev.modify_index = index
+                out.append(ev)
+            except Exception as e:
+                out.append(e)
+        return out
 
     def deregister_job_global(self, namespace: str, job_id: str,
                               purge: bool = False):
@@ -2007,11 +2232,13 @@ class Server:
             type=job.type if job else "service",
             triggered_by="alloc-stop", job_id=alloc.job_id,
             status=EVAL_STATUS_PENDING)
-        self.raft_apply(
-            "alloc_desired_transition",
-            dict(alloc_ids=[alloc_id],
-                 transition=DesiredTransition(migrate=True),
-                 evals=[ev]))
+        payload = dict(alloc_ids=[alloc_id],
+                       transition=DesiredTransition(migrate=True),
+                       evals=[ev])
+        if self.ingest is not None:
+            self.ingest.submit("alloc_desired_transition", payload)
+        else:
+            self.raft_apply("alloc_desired_transition", payload)
         return ev
 
     def dispatch_job(self, namespace: str, job_id: str,
@@ -2365,6 +2592,43 @@ class Server:
     def update_alloc_status_from_client(self, allocs: List[Allocation]) -> None:
         """Node.UpdateAlloc: client pushes task states; failed allocs
         trigger alloc-failure evals (node_endpoint.go:1065)."""
+        evals = self._client_update_evals(allocs)
+        payload = dict(allocs=allocs, evals=evals)
+        if self.ingest is not None:
+            self.ingest.submit("alloc_client_update", payload)
+        else:
+            self.raft_apply("alloc_client_update", payload)
+        self._revoke_terminal_accessors(allocs)
+
+    def update_alloc_status_from_client_batch(
+            self, groups: List[List[Allocation]]) -> None:
+        """Node.UpdateAllocBatch (ISSUE 19): N clients' update pushes
+        in one verb. Each group keeps its own gateway entry (its evals
+        are derived from pre-batch state exactly as N concurrent
+        Node.UpdateAlloc calls would be), but all of them park together
+        and land as one coalesced raft entry / store transaction."""
+        if self.ingest is None:
+            for g in groups:
+                self.update_alloc_status_from_client(g)
+            return
+        futures = []
+        for g in groups:
+            evals = self._client_update_evals(g)
+            futures.append(self.ingest.submit_async(
+                "alloc_client_update", dict(allocs=g, evals=evals)))
+        err = None
+        for f in futures:
+            try:
+                f.result()
+            except Exception as e:
+                err = e
+        for g in groups:
+            self._revoke_terminal_accessors(g)
+        if err is not None:
+            raise err
+
+    def _client_update_evals(self, allocs: List[Allocation]
+                             ) -> List[Evaluation]:
         evals = []
         seen = set()
         for stub in allocs:
@@ -2380,7 +2644,9 @@ class Server:
                         namespace=existing.namespace, priority=job.priority,
                         type=job.type, triggered_by="alloc-failure",
                         job_id=existing.job_id, status=EVAL_STATUS_PENDING))
-        self.raft_apply("alloc_client_update", dict(allocs=allocs, evals=evals))
+        return evals
+
+    def _revoke_terminal_accessors(self, allocs: List[Allocation]) -> None:
         # revoke vault leases of allocs the client just reported
         # terminal (node_endpoint.go UpdateAlloc -> revokeVaultAccessors);
         # the reaper pass also catches these within its tick

@@ -233,6 +233,9 @@ def events_from_apply(msg_type: str, payload: dict, index: int) -> List[Event]:
     if msg_type == "job_register":
         job = payload["job"]
         add(TOPIC_JOB, "JobRegistered", job.id, job.namespace, job)
+        # ingest-embedded register evals (ISSUE 19) ride the same entry
+        for ev in payload.get("evals", []):
+            add(TOPIC_EVAL, "EvaluationUpdated", ev.id, ev.namespace, ev)
     elif msg_type == "job_deregister":
         add(TOPIC_JOB, "JobDeregistered", payload["job_id"],
             payload["namespace"])
@@ -262,6 +265,12 @@ def events_from_apply(msg_type: str, payload: dict, index: int) -> List[Event]:
     elif msg_type == "alloc_desired_transition":
         for aid in payload.get("alloc_ids", []):
             add(TOPIC_ALLOC, "AllocationUpdateDesiredStatus", aid)
+    elif msg_type == "ingest_batch":
+        # one coalesced write entry, one flush: every sub-entry's
+        # events publish together under its own kind (ISSUE 19, the
+        # plan_group_results recursion pointed at the write front)
+        for e in payload.get("entries", []):
+            out.extend(events_from_apply(e.get("kind", ""), e, index))
     elif msg_type == "plan_group_results":
         # one committed entry, one flush: every group member's events
         # publish together (the per-plan event flush was part of the

@@ -48,6 +48,11 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     # group-commit applier: one entry carrying N plan_results payloads
     # (encode/decode recurse per group member — see below)
     "plan_group_results": {},
+    # batched write ingest (ISSUE 19): one entry carrying N kind-tagged
+    # sub-payloads (job_register / alloc_client_update /
+    # alloc_desired_transition); encode/decode recurse per entry by its
+    # "kind" key — see below
+    "ingest_batch": {},
     "scheduler_config": {"config": SchedulerConfiguration},
     "deployment_status_update": {"update": DeploymentStatusUpdate,
                                  "job": Job, "evals": [Evaluation]},
@@ -103,6 +108,11 @@ def encode_payload(msg_type: str, payload: dict) -> dict:
     if msg_type == "plan_group_results":
         return {"groups": [encode_payload("plan_results", g)
                            for g in payload.get("groups", [])]}
+    if msg_type == "ingest_batch":
+        # each sub-entry encodes under its own kind's schema; the
+        # "kind" tag itself is a plain string and rides through
+        return {"entries": [encode_payload(e.get("kind", ""), e)
+                            for e in payload.get("entries", [])]}
     out = {}
     for k, v in payload.items():
         out[k] = to_wire(v)
@@ -113,6 +123,9 @@ def decode_payload(msg_type: str, data: dict) -> dict:
     if msg_type == "plan_group_results":
         return {"groups": [decode_payload("plan_results", g)
                            for g in data.get("groups", [])]}
+    if msg_type == "ingest_batch":
+        return {"entries": [decode_payload(e.get("kind", ""), e)
+                            for e in data.get("entries", [])]}
     schema = SCHEMAS.get(msg_type, {})
     out: dict = {}
     for k, v in data.items():

@@ -69,6 +69,16 @@ GROUP_STATS: Dict[str, int] = {
 }
 
 
+def fail_futures(pairs, exc: Exception) -> None:
+    """Fail every unresolved future in a demux pair list — the shared
+    abort tail of the group-commit planes (r9 plan groups, r19 ingest
+    batches): whatever already resolved keeps its result, everything
+    still parked sees the error."""
+    for future, _r in pairs:
+        if not future.done():
+            future.set_exception(exc)
+
+
 def _count_placements(result) -> int:
     """Fresh placements in a verified plan result — the
     `nomad.plan.placements` counter the telemetry ring rates. Plans
@@ -162,10 +172,7 @@ class PlanApplier:
                 if item is None:
                     continue
                 pairs, _w, _gi = item
-                for future, _r in pairs:
-                    if not future.done():
-                        future.set_exception(
-                            RuntimeError("plan applier stopped"))
+                fail_futures(pairs, RuntimeError("plan applier stopped"))
 
     # -- group sizing / governor hooks ---------------------------------
     def effective_group_bound(self) -> int:
@@ -282,10 +289,7 @@ class PlanApplier:
                 except Exception:
                     continue
             if not placed:
-                for future, _r in item[0]:
-                    if not future.done():
-                        future.set_exception(
-                            RuntimeError("plan applier stopped"))
+                fail_futures(item[0], RuntimeError("plan applier stopped"))
 
     def _commit_loop(self) -> None:
         from ..utils import stages
@@ -327,9 +331,7 @@ class PlanApplier:
                 with self._failed_l:
                     if group_index:
                         self._failed_pending.add(group_index)
-                for future, _result in pairs:
-                    if not future.done():
-                        future.set_exception(e)
+                fail_futures(pairs, e)
 
     # -- the core ------------------------------------------------------
     def apply(self, plan: Plan):

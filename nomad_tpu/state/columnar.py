@@ -39,7 +39,10 @@ place on live objects.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Tuple, get_type_hints
+import types
+import typing
+from typing import (Any, Dict, List, Optional, Tuple, get_args, get_origin,
+                    get_type_hints)
 
 import numpy as np
 
@@ -220,6 +223,83 @@ def decode_table(cls: type, enc: Optional[dict]) -> DecodedTable:
             d[nm] = factory() if factory is not None else default
         append(o)
     return DecodedTable(objs, columns, codes_out, pools_out)
+
+
+# -- bulk ingest decode (ISSUE 19) ----------------------------------
+
+class WirePool:
+    """Content-keyed decode memo for bulk write bodies: N identical
+    nested stanzas across one request batch materialize as ONE shared
+    instance instead of N (the snapshot pool idea, applied at
+    admission). Safe only for leaf stanza types the write path never
+    mutates per row after decode — canonicalize on a shared,
+    content-identical instance is deterministic and converges, but
+    row-specific mutation targets (constraint lists `_implied_constraints`
+    appends to, client-mutated `task_states`) must never pool."""
+
+    __slots__ = ("memo", "hits", "misses")
+
+    def __init__(self):
+        self.memo: Dict[Any, Any] = {}
+        self.hits = 0
+        self.misses = 0
+
+
+# leaf dataclass types whose decoded instances may be shared across the
+# rows of one bulk decode; resolved lazily to dodge the models import
+# cycle (models import utils.codec which columnar sits beside)
+_POOL_LEAFS: Optional[tuple] = None
+
+
+def _pool_leafs() -> tuple:
+    global _POOL_LEAFS
+    if _POOL_LEAFS is None:
+        from ..models.resources import Resources
+        _POOL_LEAFS = (Resources,)
+    return _POOL_LEAFS
+
+
+def from_wire_pooled(cls: Any, data: Any, pool: WirePool) -> Any:
+    """`from_wire` twin for bulk ingest: same dispatch, but whitelisted
+    leaf dataclasses memoize by content key so a thousand-job register
+    body with one resources shape pays ONE materialization."""
+    if data is None:
+        return None
+    if isinstance(data, dict) and len(data) == 1 and "__b64__" in data:
+        return from_wire(cls, data)
+    if isinstance(cls, type) and dataclasses.is_dataclass(cls):
+        if not isinstance(data, dict):
+            return from_wire(cls, data)
+        if cls in _pool_leafs():
+            key = (cls, _freeze(data))
+            hit = pool.memo.get(key)
+            if hit is not None:
+                pool.hits += 1
+                return hit
+            obj = from_wire(cls, data)
+            pool.memo[key] = obj
+            pool.misses += 1
+            return obj
+        # interior dataclass: recurse per field so nested leaves pool
+        hints = _hints(cls)
+        names = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: from_wire_pooled(hints.get(k, Any), v, pool)
+                  for k, v in data.items() if k in names}
+        return cls(**kwargs)
+    origin = get_origin(cls)
+    if origin in (typing.Union, types.UnionType):
+        args = [a for a in get_args(cls) if a is not type(None)]
+        return from_wire_pooled(args[0], data, pool) if args else data
+    if origin in (list, tuple, set, frozenset) and isinstance(data, list):
+        args = get_args(cls)
+        elem = args[0] if args else Any
+        seq = [from_wire_pooled(elem, v, pool) for v in data]
+        return seq if origin is list else origin(seq)
+    if origin is dict and isinstance(data, dict):
+        args = get_args(cls)
+        vt = args[1] if len(args) == 2 else Any
+        return {k: from_wire_pooled(vt, v, pool) for k, v in data.items()}
+    return from_wire(cls, data)
 
 
 class ColdAllocColumns:

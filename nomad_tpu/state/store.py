@@ -702,41 +702,57 @@ class StateStore(StateSnapshot):
     # -- jobs ----------------------------------------------------------
     def upsert_job(self, index: int, job: Job) -> None:
         with self._lock:
-            root = self._root.edit()
-            key = job.namespaced_id()
-            existing = root.table("jobs").get(key)
-            if existing is not None:
-                job.create_index = existing.create_index
-                job.job_modify_index = index
-                if existing.specchanged(job):
-                    job.version = existing.version + 1
-                else:
-                    job.version = existing.version
-            else:
-                job.create_index = index
-                job.job_modify_index = index
-                job.version = 0
-            job.modify_index = index
-            if job.status == "":
-                job.status = JOB_STATUS_PENDING
-            root = root.with_table("jobs", root.table("jobs").set(key, job))
-            # version history (pruned to JOB_TRACKED_VERSIONS)
-            versions = root.table("job_versions").get(key) or _Table()
-            versions = versions.set(job.version, job)
-            if len(versions) > JOB_TRACKED_VERSIONS:
-                oldest = min(versions.keys())
-                versions = versions.delete(oldest)
-            root = root.with_table("job_versions",
-                                   root.table("job_versions").set(key, versions))
-            root = self._ensure_job_summary(root, index, job)
-            root = self._sync_scaling_policies(root, index, job)
-            if job.parent_id:
-                root = self._bump_parent_children(
-                    root, index, (job.namespace, job.parent_id),
-                    existing.status if existing is not None else None,
-                    job.status)
-            root = root.with_index("jobs", index)
+            root = self._upsert_job_root(self._root.edit(), index, job)
             self._publish(root)
+
+    def upsert_jobs_batch(self, index: int, jobs: List[Job]) -> None:
+        """Batched register ingest (ISSUE 19): one committed `ingest_batch`
+        entry's job registers on ONE edit root with ONE publish, applied
+        in submission order — state-equivalent to sequential upsert_job
+        calls at the same index (same-job re-registers on one root still
+        see each other's version bumps)."""
+        if not jobs:
+            return
+        with self._lock:
+            root = self._root.edit()
+            for job in jobs:
+                root = self._upsert_job_root(root, index, job)
+            self._publish(root)
+
+    def _upsert_job_root(self, root: _Root, index: int, job: Job) -> _Root:
+        key = job.namespaced_id()
+        existing = root.table("jobs").get(key)
+        if existing is not None:
+            job.create_index = existing.create_index
+            job.job_modify_index = index
+            if existing.specchanged(job):
+                job.version = existing.version + 1
+            else:
+                job.version = existing.version
+        else:
+            job.create_index = index
+            job.job_modify_index = index
+            job.version = 0
+        job.modify_index = index
+        if job.status == "":
+            job.status = JOB_STATUS_PENDING
+        root = root.with_table("jobs", root.table("jobs").set(key, job))
+        # version history (pruned to JOB_TRACKED_VERSIONS)
+        versions = root.table("job_versions").get(key) or _Table()
+        versions = versions.set(job.version, job)
+        if len(versions) > JOB_TRACKED_VERSIONS:
+            oldest = min(versions.keys())
+            versions = versions.delete(oldest)
+        root = root.with_table("job_versions",
+                               root.table("job_versions").set(key, versions))
+        root = self._ensure_job_summary(root, index, job)
+        root = self._sync_scaling_policies(root, index, job)
+        if job.parent_id:
+            root = self._bump_parent_children(
+                root, index, (job.namespace, job.parent_id),
+                existing.status if existing is not None else None,
+                job.status)
+        return root.with_index("jobs", index)
 
     def _sync_scaling_policies(self, root: _Root, index: int,
                                job: Job) -> _Root:
@@ -1152,11 +1168,13 @@ class StateStore(StateSnapshot):
 
     def update_allocs_from_client_batch(
             self, items: List[Tuple[int, List[Allocation]]]) -> None:
-        """Batched WAL replay (ISSUE 8): N `alloc_client_update`
-        entries' writes on ONE edit root with ONE publish, each entry
-        stamped with its own index — state-equivalent to sequential
-        update_allocs_from_client calls (the mutation sequence is
-        identical; only the layer pushes and watcher wakes collapse)."""
+        """Batched `alloc_client_update` writes on ONE edit root with
+        ONE publish, each entry stamped with its own index —
+        state-equivalent to sequential update_allocs_from_client calls
+        (the mutation sequence is identical; only the layer pushes and
+        watcher wakes collapse). Born as WAL replay (ISSUE 8), now also
+        the live ingest path (ISSUE 19): a coalesced `ingest_batch` run
+        of client updates lands through here as one store transaction."""
         if not items:
             return
         with self._lock:

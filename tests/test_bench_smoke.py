@@ -246,6 +246,21 @@ def test_bench_py_emits_json_line_on_cpu():
     assert data["feas_column_rebuilds"] == 0, data
     assert data["feas_rows_patched"] > 0
     assert bd["feasibility"]["calls"] > 0
+    # columnar admission path (ISSUE 19): the ladder ran the write
+    # storm with the ingest gateway on and off in-process against a
+    # durable WAL; the group-applied arm must clear 2x the
+    # entry-per-write control arm, genuinely coalesce (mean group
+    # size > 1), and the service-read side must not regress to zero
+    assert data["ingest"] == "on"
+    assert data["ingest_writes_per_sec"] > 0
+    assert data["ingest_writes_per_sec_off"] > 0
+    assert data["ingest_speedup"] >= 2.0, data
+    assert data["ingest_write_p99_ms"] > 0
+    assert data["ingest_group_mean_size"] > 1.0, data
+    assert data["ingest_coalesced_writes"] > 0
+    assert data["ingest_shed"] >= 0
+    assert data["ingest_read_placements_per_sec"] > 0
+    assert data["ingest_read_placements_per_sec_off"] > 0
 
 
 def test_chaos_list_shows_scheduler_plane_cells():

@@ -631,6 +631,8 @@ class ServerConfig:
     follower_orphan_lease_s: float = 16.0
     feas_documented_cache_max: int = 256
     feas_orphan_cache_max: int = 257
+    ingest_documented_window_us: float = 200.0
+    ingest_orphan_window_us: float = 201.0
     other_knob: int = 1
 """
 
@@ -677,6 +679,7 @@ class TestSurfaceDrift:
                            "chaos_documented_seed and "
                            "follower_documented_lease_s and "
                            "feas_documented_cache_max and "
+                           "ingest_documented_window_us and "
                            "reconcile_documented_max are here")
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))
         route_f = [f for f in out if "route" in f.message]
@@ -725,6 +728,10 @@ class TestSurfaceDrift:
         # feas_* knobs joined the contract (ISSUE 17: compiled
         # feasibility knobs must land in the STATUS.md knob table)
         fe_f = [f for f in out if "feas_orphan_cache_max" in f.message]
+        # ingest_* knobs joined the contract (ISSUE 19: write-ingest
+        # gateway knobs must land in the STATUS.md knob table)
+        ig_f = [f for f in out if "ingest_orphan_window_us"
+                in f.message]
         assert len(route_f) == 1        # /frob never referenced
         assert "/frob" in route_f[0].message
         assert len(knob_f) == 1
@@ -743,6 +750,7 @@ class TestSurfaceDrift:
         assert len(ch_f) == 1
         assert len(fo_f) == 1
         assert len(fe_f) == 1
+        assert len(ig_f) == 1
         assert "ClientConfig.stats_orphan_slots" in sc_f[0].message
         # documented knobs and referenced routes are quiet
         assert not any("governor_documented_high" in f.message
@@ -776,6 +784,8 @@ class TestSurfaceDrift:
         assert not any("follower_documented_lease_s" in f.message
                        for f in out)
         assert not any("feas_documented_cache_max" in f.message
+                       for f in out)
+        assert not any("ingest_documented_window_us" in f.message
                        for f in out)
         assert not any("/v1/widgets" in f.message for f in out)
 
@@ -813,7 +823,9 @@ class TestSurfaceDrift:
                            "follower_documented_lease_s, "
                            "follower_orphan_lease_s, "
                            "feas_documented_cache_max, "
-                           "feas_orphan_cache_max")
+                           "feas_orphan_cache_max, "
+                           "ingest_documented_window_us, "
+                           "ingest_orphan_window_us")
         files["tests/test_widget.py"] = \
             'resp = c.get(f"/v1/widget/{wid}/frob")\n'
         out = active(lint(files, [SurfaceDriftRule(**self.RULE_KW)]))

@@ -29,6 +29,7 @@ SUITES = (
     "tests/test_parallel.py",
     "tests/test_follower_sched.py",
     "tests/test_feasible_columnar.py",
+    "tests/test_ingest.py",
 )
 
 
@@ -43,7 +44,13 @@ def test_concurrency_suites_race_clean():
     try:
         res = subprocess.run(
             [sys.executable, "-m", "pytest", *SUITES, "-q",
-             "-m", "not slow", "-k", "not overhead",
+             # the ingest 1k-seed parity sweep re-runs ~35-50s of pure
+             # state comparison the shims can't learn from — the
+             # deterministic trigger/stop/HTTP ingest tests carry the
+             # gateway's lock traffic; keep the ratchet under tier-1's
+             # wall clock
+             "-m", "not slow",
+             "-k", "not overhead and not randomized_ingest",
              "-p", "no:cacheprovider", "-p", "no:randomly"],
             cwd=REPO, env=env, capture_output=True, text=True,
             timeout=600)
