@@ -635,6 +635,166 @@ def _feasibility_run(n_nodes: int, n_rounds: int) -> Dict:
     }
 
 
+def bench_feas_residue(n_nodes: int = 5000, n_rounds: int = 20) -> Dict:
+    """Ladder cell (ISSUE 20): spread/distinct/CSI-heavy service jobs,
+    residue-compiled feasibility on vs NOMAD_TPU_FEAS_RESIDUE=0
+    in-process (both arms keep the compiled engine on — this cell
+    measures the RESIDUE layer, not ISSUE 17's mask compile). Each
+    timed round updates ONE node (full table rebuild, which drops the
+    per-table attr_codes cache) and registers a fresh CSI job with two
+    spreads and a distinct_property constraint, so the off-arm pays
+    the O(N) Python dictionary re-encode per spread attribute per eval
+    while the on-arm derives codes from the write-through interned
+    columns; spread_score_speedup is the accumulated input-build
+    seconds ratio. The CSI topology subset mutates the combined mask
+    every eval: the on-arm must keep the device token alive via sparse
+    residue scatters (survival rate ~1, warm mask uploads ~0)."""
+    import os
+
+    prev_r = os.environ.get("NOMAD_TPU_FEAS_RESIDUE")
+    prev_c = os.environ.get("NOMAD_TPU_COLUMNAR_FEAS")
+    try:
+        os.environ["NOMAD_TPU_COLUMNAR_FEAS"] = "1"
+        os.environ["NOMAD_TPU_FEAS_RESIDUE"] = "1"
+        on = _feas_residue_run(n_nodes, n_rounds)
+        os.environ["NOMAD_TPU_FEAS_RESIDUE"] = "0"
+        off = _feas_residue_run(n_nodes, n_rounds)
+    finally:
+        for var, prev in (("NOMAD_TPU_FEAS_RESIDUE", prev_r),
+                          ("NOMAD_TPU_COLUMNAR_FEAS", prev_c)):
+            if prev is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prev
+    decided = on["token_survivals"] + on["token_invalidations"]
+    return {
+        "feas_resident_token_survival_rate": round(
+            on["token_survivals"] / max(decided, 1), 4),
+        "feas_residue_rows": on["residue_rows"],
+        "feas_residue_scatters": on["residue_scatters"],
+        # warm-window full mask re-uploads on the on-arm: the token
+        # survives CSI residue, so this must stay ~0
+        "feas_warm_mask_uploads": on["warm_uploads"],
+        "spread_build_ms": round(on["build_ms"], 3),
+        "spread_build_ms_off": round(off["build_ms"], 3),
+        "spread_score_speedup": round(
+            off["build_s"] / on["build_s"]
+            if on["build_s"] > 0 else 0.0, 2),
+        "spread_score_evals": on["spread_score_evals"],
+    }
+
+
+def _feas_residue_run(n_nodes: int, n_rounds: int) -> Dict:
+    import copy
+
+    from ..mock import fixtures as mock
+    from ..models import Constraint, Spread, SpreadTarget
+    from ..models.csi import ACCESS_MULTI_NODE_MULTI_WRITER, CSIVolume
+    from ..models.job import VolumeRequest
+    from ..ops import spread as spread_ops
+    from ..scheduler import feasible_compiler as fc
+    from ..scheduler.harness import Harness
+    from ..utils import gcsafe
+
+    h = Harness()
+    nodes = []
+    for i in range(n_nodes):
+        node = mock.node()
+        node.name = f"node-{i}"
+        node.datacenter = f"dc{(i % 4) + 1}"
+        node.meta["rack"] = f"r{i % 16}"
+        node.meta["tier"] = f"t{i % 8}"
+        node.attributes["csi.plugin.p1"] = "1"
+        node.compute_class()
+        nodes.append(node)
+        h.store.upsert_node(h.next_index(), node)
+
+    # multi-writer volume whose topology admits 3 of 4 nodes: every
+    # eval mutates the combined mask (the residue diff the on-arm
+    # ships as a sparse scatter) without ever exhausting claims
+    vol = CSIVolume(id="data-vol", plugin_id="p1",
+                    access_mode=ACCESS_MULTI_NODE_MULTI_WRITER,
+                    topology_node_ids=[n.id for i, n in enumerate(nodes)
+                                       if i % 4 != 3])
+    h.store.upsert_csi_volumes(h.next_index(), [vol])
+
+    def make_job(i: int):
+        job = mock.job()
+        job.id = f"residue-{i}"
+        job.datacenters = ["dc1", "dc2", "dc3", "dc4"]
+        job.spreads = [Spread(
+            attribute="${node.datacenter}", weight=70,
+            spread_target=[SpreadTarget(value="dc1", percent=40),
+                           SpreadTarget(value="dc2", percent=30)])]
+        tg = job.task_groups[0]
+        tg.count = 2
+        for t in tg.tasks:
+            t.resources.networks = []
+            t.resources.cpu = 20
+            t.resources.memory_mb = 32
+        tg.networks = []
+        # host-balancing spread over the full node axis plus a
+        # low-cardinality tier: each attribute the off-arm re-encodes
+        # O(N) in Python per rebuilt table, the on-arm reads off the
+        # interned columns — the spread set mirrors a real placement
+        # policy (dc targets, rack balance, host anti-affinity)
+        tg.spreads = [Spread(attribute="${meta.rack}", weight=30),
+                      Spread(attribute="${node.unique.name}", weight=10),
+                      Spread(attribute="${meta.tier}", weight=20)]
+        tg.constraints.append(Constraint(
+            ltarget="${meta.rack}", rtarget="8",
+            operand="distinct_property"))
+        tg.volumes = {"vol": VolumeRequest(
+            name="vol", type="csi", source="data-vol")}
+        return job
+
+    # warm throwaway evals: engine compile, first mask park, device
+    # scatter traces, and the feas token the timed rounds dispatch on
+    for i in (10**6, 10**6 + 1):
+        w = make_job(i)
+        h.store.upsert_job(h.next_index(), w)
+        h.process("service", _eval_for(w))
+        node = copy.deepcopy(h.store.node_by_id(nodes[0].id))
+        node.meta["canary"] = f"w{i}"
+        h.store.upsert_node(h.next_index(), node)
+
+    fc.reset_stats()
+    spread_ops.reset_stats()
+    feas_store = h.store.table_cache.device.feas
+    up0 = feas_store.stats["uploads"]
+    t0 = time.perf_counter()
+    with gcsafe.safepoints():
+        for r in range(n_rounds):
+            # one benign node meta write per round: a full table
+            # rebuild that drops the per-table attr_codes cache — the
+            # off-arm re-encodes every spread attribute O(N) in Python
+            node = copy.deepcopy(
+                h.store.node_by_id(nodes[r % n_nodes].id))
+            node.meta["canary"] = f"c{r}"
+            h.store.upsert_node(h.next_index(), node)
+            job = make_job(r)
+            h.store.upsert_job(h.next_index(), job)
+            h.process("service", _eval_for(job))
+            gcsafe.safepoint()
+    wall_s = time.perf_counter() - t0
+    st = fc.stats()
+    sp = spread_ops.stats()
+    on_arm = fc.residue_enabled()
+    build_s = sp["vector_s"] if on_arm else sp["scalar_s"]
+    builds = sp["vector_builds"] if on_arm else sp["scalar_builds"]
+    return {
+        "token_survivals": st["token_survivals"],
+        "token_invalidations": st["token_invalidations"],
+        "residue_rows": st["residue_rows"],
+        "residue_scatters": feas_store.stats["residue_scatters"],
+        "warm_uploads": feas_store.stats["uploads"] - up0,
+        "spread_score_evals": sp["spread_score_evals"],
+        "build_s": build_s,
+        "build_ms": build_s * 1e3 / max(builds, 1),
+        "wall_s": wall_s,
+    }
+
+
 def seed_c2m_allocs(h, nodes, seed_allocs: int,
                     sched_allocs: int = 40000) -> Dict:
     """Load the C2M substrate: `sched_allocs` go through the REAL
@@ -1706,6 +1866,14 @@ def run_ladder(quick: bool = False) -> Dict:
     # warm window must run entirely on the mask patch path (zero
     # column rebuilds, hit rate ~1)
     out.update(bench_feasibility(
+        n_nodes=512 if quick else 5000,
+        n_rounds=8 if quick else 20))
+    # residue layer atop the compiled engine (ISSUE 20): CSI/spread/
+    # distinct-heavy rounds where the device mask token must outlive
+    # per-eval mask mutations via sparse residue scatters, and
+    # spread/distinct scoring inputs build vectorized off the interned
+    # columns vs the O(N) Python re-encode
+    out.update(bench_feas_residue(
         n_nodes=512 if quick else 5000,
         n_rounds=8 if quick else 20))
     # columnar reconcile engine on vs off over a rolling deployment

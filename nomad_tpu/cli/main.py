@@ -1544,6 +1544,20 @@ def cmd_operator_top(args) -> int:
               f"{fm:.0f} cached masks")
         print(f"  feas mask cache    = {fh:.1%} hit rate "
               f"({fr:.0f} recompiles)")
+        # residue economics (ISSUE 20): device-resident tokens that
+        # outlived CSI/preferred-node mutations vs dense re-uploads,
+        # plus accumulated scatter debt and vectorized scoring builds
+        ts_ = (tail_vals(series, "feas.token_survivals") or [0.0])[-1]
+        ti_ = (tail_vals(series, "feas.token_invalidations")
+               or [0.0])[-1]
+        rr_ = (tail_vals(series, "feas.residue_rows") or [0.0])[-1]
+        se_ = (tail_vals(series, "feas.spread_score_evals")
+               or [0.0])[-1]
+        if ts_ or ti_ or rr_ or se_:
+            print(f"  feas residue       = {ts_:.0f} token survivals, "
+                  f"{ti_:.0f} invalidations")
+            print(f"  residue debt       = {rr_:.0f} scatter rows, "
+                  f"{se_:.0f} vector spread evals")
     # mesh block: sharded residency economics (present only when a
     # mesh dispatcher exists — the device.mesh_* family)
     md = tail_vals(series, "device.mesh_devices")

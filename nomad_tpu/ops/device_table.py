@@ -137,8 +137,13 @@ class FeasMaskStore:
         self._l = make_lock()
         # feas_key -> {"arr", "n", "n_pad", "epoch", "version"}
         self._entries: Dict[object, dict] = {}
+        # rows scattered atop parked masks by per-eval residue
+        # (ISSUE 20) since the last fold/reset — the governor's
+        # feas.residue_rows watermark; fold() zeroes it
+        self.residue_debt = 0
         self.stats: Dict[str, int] = {
             "uploads": 0, "scatters": 0, "hits": 0, "stale": 0,
+            "residue_scatters": 0, "residue_rows": 0, "folds": 0,
         }
 
     def peek(self, key) -> Optional[Tuple[int, int]]:
@@ -231,9 +236,57 @@ class FeasMaskStore:
             self.stats["hits"] += 1
             return e["arr"]
 
+    def apply_residue(self, arr, rows: np.ndarray, vals: np.ndarray):
+        """Reproduce the host mask's residue mutations (CSI claims,
+        quota caps, preferred-node restriction) on the parked device
+        mask with ONE jitted row-scatter — per-eval, never stored, so
+        the resident entry itself stays the pre-residue combined mask
+        and the token keeps surviving. Returns the scattered array or
+        None (caller falls back to packing the host mask)."""
+        m = len(rows)
+        if m == 0:
+            return arr
+        try:
+            idx = np.asarray(rows, dtype=np.int32)
+            v = np.asarray(vals, dtype=bool)
+            b = _bucket_rows(m)
+            if b > m:
+                # pad with a repeat of the first row: duplicate `.set`
+                # indices land the same value, harmless
+                idx = np.concatenate(
+                    [idx, np.full(b - m, idx[0], np.int32)])
+                v = np.concatenate([v, np.full(b - m, v[0], bool)])
+            out = _feas_scatter(arr, idx, v)
+        except Exception:
+            return None
+        with self._l:
+            self.stats["residue_scatters"] += 1
+            self.stats["residue_rows"] += m
+            self.residue_debt += m
+        return out
+
+    def fold(self) -> dict:
+        """Governor reclaim (governor_feas_residue_high): drop the
+        parked entries and zero the residue debt — the next eval
+        re-parks a fresh combined mask instead of compounding scatter
+        work atop a long-lived base."""
+        with self._l:
+            dropped = len(self._entries)
+            self._entries.clear()
+            debt = self.residue_debt
+            self.residue_debt = 0
+            self.stats["folds"] += 1
+        return {"feas_entries_dropped": dropped,
+                "residue_debt_cleared": debt}
+
+    def debt(self) -> int:
+        with self._l:
+            return self.residue_debt
+
     def snapshot(self) -> dict:
         with self._l:
-            return {"entries": len(self._entries), **self.stats}
+            return {"entries": len(self._entries),
+                    "residue_debt": self.residue_debt, **self.stats}
 
 
 class DeviceNodeTable:
@@ -528,8 +581,17 @@ def resident_request_args(mirror, req, n_pad: int,
     if feas is not None and tok is not None:
         arr = feas.resident(tok, n_pad)
         if arr is not None:
-            out["feasible"] = arr
-            metrics.incr_counter(metric_prefix + "_feas_resident")
+            res = getattr(req, "feas_residue", None)
+            if res is not None and len(res[0]):
+                # ISSUE 20: the token survived residue mutations —
+                # re-apply them on device as one sparse scatter
+                # instead of re-uploading the combined mask
+                arr = feas.apply_residue(arr, res[0], res[1])
+                if arr is not None:
+                    metrics.incr_counter(metric_prefix + "_feas_residue")
+            if arr is not None:
+                out["feasible"] = arr
+                metrics.incr_counter(metric_prefix + "_feas_resident")
     metrics.incr_counter(metric_prefix + "_dispatch")
     return out
 

@@ -155,6 +155,12 @@ class SelectRequest:
     # reaches the dispatch unmutated (no CSI/preferred residue). Any
     # path that swaps `feasible` must clear it.
     feas_token: Optional[Tuple] = None
+    # sparse residue atop the parked mask (ISSUE 20): (rows i32[M],
+    # vals bool[M]) reproducing the host mask's CSI-claim/quota/
+    # preferred-node mutations on device via one jitted scatter, so
+    # the token survives residue instead of forcing a dense re-upload.
+    # Only meaningful beside feas_token; cleared with it.
+    feas_residue: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
 
 @dataclasses.dataclass
@@ -1713,6 +1719,7 @@ def partition_lanes(reqs, lane_base: int, total: int, cache):
         req.feasible = slice_mask
         # the sliced mask no longer matches the device-resident copy
         req.feas_token = None
+        req.feas_residue = None
     return originals, cache
 
 
@@ -1855,6 +1862,7 @@ class SelectKernel:
         feas = req.feasible
         req.feasible = slice_mask
         req.feas_token = None
+        req.feas_residue = None
         return feas
 
     def _select(self, req: SelectRequest) -> SelectResult:

@@ -891,6 +891,12 @@ class ProposedIndex:
         self.tg_count: Dict[str, np.ndarray] = {}
         # job's proposed allocs grouped by node idx (for property counts)
         self.job_allocs_by_node: Dict[int, List] = {}
+        # flat (node row, task group) per proposed alloc, in count
+        # order — the scatter-ready form the vectorized property
+        # counts read (ops/spread.property_counts_vec, ISSUE 20)
+        self._prop_rows: List[int] = []
+        self._prop_tgs: List[str] = []
+        self._prop_arrays: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
         stopped_ids = set()
         if plan is not None:
@@ -950,6 +956,21 @@ class ProposedIndex:
             self.tg_count[tg] = arr
         arr[i] += 1
         self.job_allocs_by_node.setdefault(i, []).append(alloc)
+        self._prop_rows.append(i)
+        self._prop_tgs.append(tg)
+
+    def prop_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(rows i32[M], tgs str[M]) per proposed alloc — materialized
+        once per eval (construction is the only mutator)."""
+        hit = self._prop_arrays
+        if hit is None:
+            m = len(self._prop_rows)
+            rows = (np.asarray(self._prop_rows, dtype=np.int32)
+                    if m else np.zeros(0, dtype=np.int32))
+            tgs = (np.asarray(self._prop_tgs)
+                   if m else np.zeros(0, dtype="U1"))
+            hit = self._prop_arrays = (rows, tgs)
+        return hit
 
     def used(self) -> np.ndarray:
         """f32[N,3] effective usage: live + plan overlay."""
@@ -980,11 +1001,18 @@ class ProposedIndex:
         tg_name restricts to one task group). Index C is the
         missing-attribute bucket."""
         c = len(values)
-        counts = np.zeros(c + 1, dtype=np.float32)
-        present = np.zeros(c + 1, dtype=bool)
         # ride the table's cached dictionary encoding — a cols.resolve
         # here would re-scan all N nodes per spread per eval
         tcodes, tvals = self.table.attr_codes(attribute)
+        if tvals is values:
+            from .spread import enabled as _residue_on, \
+                property_counts_vec
+            if _residue_on():
+                # one gather + np.add.at over the proposed rows'
+                # codes replaces the per-alloc Python walk (ISSUE 20)
+                return property_counts_vec(self, tcodes, c, tg_name)
+        counts = np.zeros(c + 1, dtype=np.float32)
+        present = np.zeros(c + 1, dtype=bool)
         missing = len(tvals)
         if tvals is values:
             remap = None

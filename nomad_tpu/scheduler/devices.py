@@ -164,27 +164,32 @@ def total_affinity_weight(asks: List[RequestedDevice]) -> float:
                for req in asks for a in req.affinities)
 
 
+def node_device_ok(node: Node, asks: List[RequestedDevice]) -> bool:
+    """One node's DeviceChecker verdict: every ask has a satisfying
+    group with enough healthy instances. The scalar row twin the
+    flagged-row check (feasible_compiler.device_rows_check) evaluates
+    over device-reporting rows only."""
+    groups = node.node_resources.devices
+    for req in asks:
+        ok = False
+        for g in groups:
+            if not group_satisfies(g, req):
+                continue
+            healthy = sum(1 for inst in g.instances if inst.healthy)
+            if healthy >= req.count:
+                ok = True
+                break
+        if not ok:
+            return False
+    return True
+
+
 def static_device_mask(nodes: List[Node],
                        asks: List[RequestedDevice]) -> np.ndarray:
     """DeviceChecker capability mask: every ask has a satisfying group
     with enough healthy instances (usage-independent, cacheable)."""
-    n = len(nodes)
-    mask = np.ones(n, dtype=bool)
-    for i, node in enumerate(nodes):
-        groups = node.node_resources.devices
-        for req in asks:
-            ok = False
-            for g in groups:
-                if not group_satisfies(g, req):
-                    continue
-                healthy = sum(1 for inst in g.instances if inst.healthy)
-                if healthy >= req.count:
-                    ok = True
-                    break
-            if not ok:
-                mask[i] = False
-                break
-    return mask
+    return np.fromiter((node_device_ok(node, asks) for node in nodes),
+                       dtype=bool, count=len(nodes))
 
 
 def free_instance_counts(node: Node, allocs) -> Dict[Tuple, int]:

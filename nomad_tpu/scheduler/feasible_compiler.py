@@ -47,8 +47,13 @@ from ..ops.targets import (
 from ..state import node_attr_index as nai
 
 ENV = "NOMAD_TPU_COLUMNAR_FEAS"
+# residue kill switch (ISSUE 20): gates the sparse residue transport
+# (token survives CSI/quota/preferred mutations via a per-eval device
+# scatter), the flagged-row device check, and the vectorized spread/
+# distinct input builds in ops/spread.py
+ENV_RESIDUE = "NOMAD_TPU_FEAS_RESIDUE"
 
-_CFG = {"enabled": True, "mask_cache_max": 256}
+_CFG = {"enabled": True, "mask_cache_max": 256, "residue": True}
 
 STATS: Dict[str, int] = {
     "mask_hits": 0,       # cached checks returned untouched
@@ -57,6 +62,12 @@ STATS: Dict[str, int] = {
     "recompiles": 0,      # predicate programs compiled
     "fallbacks": 0,       # compiled path declined, scalar path ran
     "rows_patched": 0,
+    # residue transport (ISSUE 20)
+    "token_survivals": 0,     # token kept through residue mutations
+    "token_invalidations": 0, # residue too wide / switch off: dense path
+    "residue_rows": 0,        # mask rows carried as per-eval scatter
+    "device_flagged_rows": 0, # rows the flagged-row device check walked
+    "device_checks": 0,       # flagged-row device masks built
 }
 
 # predicate programs by static key (shared across jobs with identical
@@ -75,7 +86,8 @@ _RLUT_OPS = (CONSTRAINT_VERSION, CONSTRAINT_SEMVER, CONSTRAINT_REGEX,
 
 def configure(enabled: Optional[bool] = None,
               intern_max_values: Optional[int] = None,
-              mask_cache_max: Optional[int] = None) -> None:
+              mask_cache_max: Optional[int] = None,
+              residue: Optional[bool] = None) -> None:
     """Server boot wiring for the ServerConfig.feas_* knobs."""
     if enabled is not None:
         _CFG["enabled"] = bool(enabled)
@@ -83,6 +95,8 @@ def configure(enabled: Optional[bool] = None,
         nai.INTERN_MAX_VALUES = int(intern_max_values)
     if mask_cache_max is not None:
         _CFG["mask_cache_max"] = int(mask_cache_max)
+    if residue is not None:
+        _CFG["residue"] = bool(residue)
 
 
 def enabled() -> bool:
@@ -90,6 +104,13 @@ def enabled() -> bool:
     if env is not None:
         return env not in ("0", "off", "no", "false")
     return _CFG["enabled"]
+
+
+def residue_enabled() -> bool:
+    env = os.environ.get(ENV_RESIDUE)
+    if env is not None:
+        return env not in ("0", "off", "no", "false")
+    return _CFG["residue"]
 
 
 def stats() -> Dict[str, int]:
@@ -423,3 +444,46 @@ def push_combined(mirror, feas_key: Tuple, mask: np.ndarray, snapshot,
                 rows = [int(p[2][r]) for r in changed]
         return feas.put(feas_key, mask, idx.ids_epoch, idx.version,
                         rows)
+
+
+# -- flagged-row device inventory (ISSUE 20) ---------------------------
+
+def device_rows_check(snapshot, table, asks) -> Optional[np.ndarray]:
+    """The device capability mask as a flagged-row column: device
+    inventory is a write-through synthetic column (("dev", "") in
+    state/node_attr_index.py), so only rows whose nodes actually
+    REPORT devices drop to the scalar group_satisfies walk — the rest
+    are False by construction (a deviceless node can never satisfy a
+    non-empty ask). Replaces the O(N)-per-table-rebuild walk in
+    devices.static_device_mask with O(flagged). Returns None to fall
+    back to the dense walk (engine/residue off, detached snapshot,
+    unsynced index)."""
+    if not asks or not enabled() or not residue_enabled():
+        return None
+    store = getattr(snapshot, "_store", None)
+    if store is None:
+        return None
+    cache = getattr(store, "attr_index", None)
+    if cache is None or not cache.enabled:
+        return None
+    if cache.needs_build():
+        cache.build_install(snapshot)
+    from .devices import node_device_ok
+    with cache.lock:
+        idx = cache.synced(snapshot)
+        if idx is None:
+            STATS["fallbacks"] += 1
+            return None
+        perm, _inv = idx.perm_for(table.ids)
+        if perm is None:
+            STATS["fallbacks"] += 1
+            return None
+        col = idx.column(("dev", ""))
+        flagged = (col.codes[:idx.n] != -1)[perm]
+    mask = np.zeros(table.n, dtype=bool)
+    rows = np.flatnonzero(flagged)
+    for r in rows:
+        mask[r] = node_device_ok(table.nodes[int(r)], asks)
+    STATS["device_flagged_rows"] += int(rows.size)
+    STATS["device_checks"] += 1
+    return mask

@@ -30,6 +30,7 @@ from ..models.constraints import (CONSTRAINT_DISTINCT_HOSTS,
 from ..models.resources import (AllocatedCpuResources,
                                 AllocatedMemoryResources)
 from ..ops import NodeTable, ProposedIndex, SelectKernel, SelectRequest
+from ..ops import spread as spread_ops
 from ..ops.select import TOP_K
 from ..ops.tables import DIM_NAMES
 from ..ops.targets import affinity_columns, constraint_mask
@@ -347,12 +348,20 @@ class PlacementEngine:
                 checks.append(("missing compatible host volumes",
                                t.host_volume_mask(tg.volumes)))
         # devices: capability mask (DeviceChecker, feasible.go:1138) —
-        # non-tensor residue, host path on BOTH arms
+        # compiled as a flagged-row column when residue compilation is
+        # on (ISSUE 20): only device-reporting rows run the scalar
+        # group walk; deviceless rows are False by construction
         from .devices import combined_device_asks, static_device_mask
         asks = combined_device_asks(tg)
         if asks:
-            checks.append(("missing devices",
-                           static_device_mask(t.nodes, asks)))
+            dm = None
+            if self._dc_key is not None:
+                from . import feasible_compiler
+                dm = feasible_compiler.device_rows_check(
+                    self.snapshot, t, asks)
+            if dm is None:
+                dm = static_device_mask(t.nodes, asks)
+            checks.append(("missing devices", dm))
         t.mask_cache[key] = checks
         return checks
 
@@ -396,6 +405,17 @@ class PlacementEngine:
             if hit is not None:
                 ENGINE_CACHE_STATS["mask_hits"] += 1
                 self._mask_cache[key] = hit
+                # recover the device-residency token too (ISSUE 20):
+                # tokens live per-eval, but the parked mask outlives
+                # the eval — push_combined early-returns the current
+                # token without device work when the entry is fresh
+                if t.device_mirror is not None:
+                    from . import feasible_compiler
+                    tok = feasible_compiler.push_combined(
+                        t.device_mirror, feas_key, hit[0], self.snapshot,
+                        ent.static_key)
+                    if tok is not None:
+                        self._feas_tokens[feas_key] = tok
                 return hit
             ENGINE_CACHE_STATS["mask_misses"] += 1
         else:
@@ -463,7 +483,15 @@ class PlacementEngine:
         sum_w = float(sum(s.weight for s in spreads))
         total_count = tg.count
         for s in spreads:
-            codes, values = self.table.attr_codes(s.attribute)
+            # the encoding comes off the write-through interned columns
+            # when residue compilation is on (ISSUE 20): a table
+            # rebuild no longer costs an O(N) Python re-encode per
+            # spread attribute
+            if spread_ops.enabled():
+                codes, values = spread_ops.attr_codes_fast(
+                    self.table, s.attribute, self.snapshot)
+            else:
+                codes, values = self.table.attr_codes(s.attribute)
             counts, present = proposed.property_counts(s.attribute, values)
             c = len(values)
             desired = np.full(c + 1, -1.0, dtype=np.float32)
@@ -497,7 +525,11 @@ class PlacementEngine:
                  if c.operand == CONSTRAINT_DISTINCT_PROPERTY]
                 + [(c, tg.name) for c in tg.constraints
                    if c.operand == CONSTRAINT_DISTINCT_PROPERTY]):
-            codes, values = self.table.attr_codes(c.ltarget)
+            if spread_ops.enabled():
+                codes, values = spread_ops.attr_codes_fast(
+                    self.table, c.ltarget, self.snapshot)
+            else:
+                codes, values = self.table.attr_codes(c.ltarget)
             counts, _present = proposed.property_counts(
                 c.ltarget, values, tg_name=scope_tg)
             try:
@@ -536,6 +568,9 @@ class PlacementEngine:
         start = time.monotonic_ns()
         ent = self._engine_entry(tg)
         mask, filtered_counts = self.feasibility(tg)
+        # the cached combined mask — the residue diff below compares
+        # the mutated copy against it to keep the device token alive
+        base_mask = mask
         mask = mask.copy()
         filtered_counts = dict(filtered_counts)
 
@@ -554,10 +589,12 @@ class PlacementEngine:
                 mask[:] = False
             else:
                 if vol.topology_node_ids:
-                    topo = set(vol.topology_node_ids)
-                    topo_mask = np.fromiter(
-                        (nid in topo for nid in t.ids),
-                        dtype=bool, count=t.n)
+                    # O(|topology|) id lookups, not an O(N) id scan
+                    topo_mask = np.zeros(t.n, dtype=bool)
+                    for nid in vol.topology_node_ids:
+                        row = t.id_to_idx.get(nid)
+                        if row is not None:
+                            topo_mask[row] = True
                     mask &= topo_mask
                 # the node must run the volume's plugin (fingerprinted
                 # as csi.plugin.<id> by the client's csimanager;
@@ -570,9 +607,15 @@ class PlacementEngine:
                 cache_key = ("csi_plugin_attr", attr)
                 plug_mask = t.mask_cache.get(cache_key)
                 if plug_mask is None:
-                    plug_mask = np.fromiter(
-                        (n.attributes.get(attr) is not None
-                         for n in t.nodes), dtype=bool, count=t.n)
+                    if spread_ops.enabled():
+                        # presence off the write-through interned
+                        # column (ISSUE 20): survives table rebuilds
+                        plug_mask = spread_ops.attr_present_mask(
+                            t, "${attr." + attr + "}", self.snapshot)
+                    if plug_mask is None:
+                        plug_mask = np.fromiter(
+                            (n.attributes.get(attr) is not None
+                             for n in t.nodes), dtype=bool, count=t.n)
                     t.mask_cache[cache_key] = plug_mask
                 mask &= plug_mask
             newly = before - int(mask.sum())
@@ -600,16 +643,20 @@ class PlacementEngine:
 
         options = options or SelectOptions()
         if options.preferred_nodes:
-            preferred_ids = {n.id for n in options.preferred_nodes}
-            pref_mask = np.fromiter((nid in preferred_ids for nid in t.ids),
-                                    dtype=bool, count=t.n)
+            pref_mask = np.zeros(t.n, dtype=bool)
+            for n in options.preferred_nodes:
+                row = t.id_to_idx.get(n.id)
+                if row is not None:
+                    pref_mask[row] = True
             mask &= pref_mask
 
         penalty = None
         if options.penalty_node_ids:
-            penalty = np.fromiter(
-                (nid in options.penalty_node_ids for nid in t.ids),
-                dtype=bool, count=t.n)
+            penalty = np.zeros(t.n, dtype=bool)
+            for nid in options.penalty_node_ids:
+                row = t.id_to_idx.get(nid)
+                if row is not None:
+                    penalty[row] = True
 
         # affinities: job + group + tasks (rank.go NodeAffinityIterator)
         affinities = list(self.job.affinities) + list(tg.affinities)
@@ -633,8 +680,25 @@ class PlacementEngine:
                 t.nodes, dev_asks,
                 lambda nid: self._proposed_allocs_on(nid, proposed.plan))
 
+        t_build = time.perf_counter()
         spreads, sum_spread_w = self._spread_inputs(tg, proposed)
         distinct_props = self._distinct_prop_inputs(tg, proposed)
+        distinct_hosts = self._has_distinct_hosts(tg)
+        if spreads or distinct_props:
+            # per-arm build-time attribution: bench_feas_residue's
+            # spread_score_speedup is the scalar/vector ratio of these
+            spread_ops.note_build(time.perf_counter() - t_build)
+        if count == 1 and (distinct_hosts or distinct_props) \
+                and spread_ops.enabled() \
+                and spread_ops.distinct_uncontended(
+                    mask, proposed.job_count, distinct_props):
+            # plan-time distinct fold (ISSUE 20): a single placement
+            # can't self-collide, and no proposed alloc contends on
+            # any feasible node — the kernel gates can never fire, so
+            # drop the per-step distinct state from the request
+            distinct_hosts = False
+            distinct_props = []
+            spread_ops.STATS["distinct_folds"] += 1
 
         used_arr = proposed.used()
         pre_score = None
@@ -674,15 +738,38 @@ class PlacementEngine:
             table_ref = t
             used_rows, used_deltas = proposed.used_sparse()
 
-        # device-resident feasibility (ISSUE 17): the mask reaches the
-        # dispatch unmutated only when no transient residue (CSI
-        # claims, preferred-node restriction) touched it — then the
-        # parked device copy substitutes for the dense bool column
+        # device-resident feasibility (ISSUE 17 + 20): with residue
+        # compilation on, the parked device copy substitutes for the
+        # dense bool column even when transient residue (CSI claims,
+        # quota caps, preferred-node restriction) mutated the mask —
+        # the mutations ship as a sparse (rows, vals) scatter applied
+        # on device per eval, so the token survives. Off-switch
+        # (NOMAD_TPU_FEAS_RESIDUE=0) restores the ISSUE 17 gate: any
+        # residue forces the dense host mask.
         feas_token = None
-        if self._dc_key is not None and not csi_reqs \
-                and not options.preferred_nodes:
-            feas_token = self._feas_tokens.get(
+        feas_residue = None
+        if self._dc_key is not None:
+            tok = self._feas_tokens.get(
                 ("feasibility", ent.static_key, self._dc_key))
+            if tok is not None:
+                from . import feasible_compiler as _fc
+                touched = bool(csi_reqs) or bool(options.preferred_nodes)
+                if not touched:
+                    feas_token = tok
+                elif _fc.residue_enabled():
+                    from ..ops.device_table import SPARSE_MAX_FRAC
+                    diff = np.flatnonzero(mask != base_mask)
+                    if diff.size <= t.n * SPARSE_MAX_FRAC:
+                        feas_token = tok
+                        if diff.size:
+                            feas_residue = (diff.astype(np.int32),
+                                            mask[diff])
+                        _fc.STATS["token_survivals"] += 1
+                        _fc.STATS["residue_rows"] += int(diff.size)
+                    else:
+                        _fc.STATS["token_invalidations"] += 1
+                else:
+                    _fc.STATS["token_invalidations"] += 1
 
         req = SelectRequest(
             ask=ent.group_ask,
@@ -693,7 +780,7 @@ class PlacementEngine:
             desired_count=float(max(tg.count, 1)),
             tg_collisions=proposed.tg_counts(tg.name),
             job_count=proposed.job_count,
-            distinct_hosts=self._has_distinct_hosts(tg),
+            distinct_hosts=distinct_hosts,
             scan_exclusive=bool(reserved_ports),
             penalty=penalty,
             affinity=aff_col,
@@ -714,6 +801,7 @@ class PlacementEngine:
             used_base_rows=used_rows,
             used_base_deltas=used_deltas,
             feas_token=feas_token,
+            feas_residue=feas_residue,
         )
         res = self.dispatch(req)
         elapsed = time.monotonic_ns() - start

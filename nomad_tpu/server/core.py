@@ -259,6 +259,19 @@ class ServerConfig:
     # reclaim but KEEPS the intern tables — the next eval rebuilds
     # masks from columns, not columns from nodes
     governor_feas_mask_cache_high: int = 192
+    # residue-compiled feasibility (ISSUE 20): CSI-claim/quota/
+    # preferred-node residue rides the device-resident mask as a
+    # sparse per-eval scatter (the FeasMaskStore token survives
+    # residue mutations), device inventory checks only flagged rows,
+    # and spread/distinct scoring inputs build vectorized over the
+    # interned columns; False restores the dense re-upload + per-node
+    # walks (NOMAD_TPU_FEAS_RESIDUE=0 is the runtime kill switch)
+    feas_residue: bool = True
+    # watermark on accumulated residue-scatter rows atop the parked
+    # device masks; crossing it folds the FeasMaskStore (drops parked
+    # entries) so the next eval re-parks a fresh combined mask instead
+    # of compounding per-eval scatter debt
+    governor_feas_residue_high: int = 262_144
     # eval flight recorder (nomad_tpu/trace/): always-on per-eval span
     # tracing — enqueue -> gateway -> kernel -> group commit -> ack —
     # with a byte-bounded completed-trace ring, pinned tail exemplars,
@@ -381,7 +394,8 @@ class Server:
         _feas.configure(
             enabled=self.config.feas_columnar,
             intern_max_values=self.config.feas_intern_max_values,
-            mask_cache_max=self.config.feas_mask_cache_max)
+            mask_cache_max=self.config.feas_mask_cache_max,
+            residue=self.config.feas_residue)
         self.store.attr_index.enabled = self.config.feas_columnar
         # mesh-sharded residency knob (module-level, same idiom — the
         # process-wide ShardedSelect has no ServerConfig); the env kill
@@ -932,6 +946,33 @@ class Server:
                      unit="ratio", suspect=False)
         gov.register("feas.recompiles",
                      lambda: _feas_mod.stats()["recompiles"],
+                     suspect=False)
+
+        # residue-compiled feasibility (ISSUE 20): token survival vs
+        # invalidation counts how often the device-resident combined
+        # mask outlives a CSI/preferred-node mutation (survival = the
+        # eval shipped a sparse residue scatter instead of a dense
+        # re-upload). The residue-rows gauge carries the watermark:
+        # accumulated scatter rows atop parked masks are debt, and the
+        # reclaim FOLDS the FeasMaskStore — parked entries drop, the
+        # next eval re-parks a fresh combined mask (fold is safe
+        # mid-wave: residue is applied per-eval on a copy, never
+        # stored). spread_score_evals counts vectorized scoring-input
+        # builds (ops/spread.py)
+        from ..ops import spread as _spread_mod
+        gov.register("feas.token_survivals",
+                     lambda: _feas_mod.stats()["token_survivals"],
+                     suspect=False)
+        gov.register("feas.token_invalidations",
+                     lambda: _feas_mod.stats()["token_invalidations"],
+                     suspect=False)
+        gov.register("feas.residue_rows",
+                     lambda: self.store.table_cache.device.feas.debt(),
+                     WatermarkPolicy(cfg.governor_feas_residue_high),
+                     reclaim=lambda:
+                     self.store.table_cache.device.feas.fold())
+        gov.register("feas.spread_score_evals",
+                     lambda: _spread_mod.stats()["spread_score_evals"],
                      suspect=False)
 
         # adaptive micro-batch gateway (server/worker.py, ISSUE 7):

@@ -72,11 +72,19 @@ _SERIAL = [0]
 def _column_entry(node, key):
     """The interned value of one (node, column) cell, or _MISSING.
     String keys are constraint targets; tuple keys are the synthetic
-    driver/host-volume columns."""
+    driver/device-inventory/host-volume columns."""
     if isinstance(key, tuple):
         kind, name = key
         if kind == "driver":
             return "1" if driver_ok(node, name) else _MISSING
+        if kind == "dev":
+            # device-inventory flag (ISSUE 20): present iff the node
+            # reports ANY device group — deviceless rows are False for
+            # every non-empty ask, so the compiler's flagged-row check
+            # (feasible_compiler.device_rows_check) only walks these
+            res = getattr(node, "node_resources", None)
+            devs = getattr(res, "devices", None) if res else None
+            return "1" if devs else _MISSING
         v = host_volume_value(node, name)
         return v if v is not None else _MISSING
     v, found = node_target_value(node, key)

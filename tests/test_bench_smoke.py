@@ -246,6 +246,20 @@ def test_bench_py_emits_json_line_on_cpu():
     assert data["feas_column_rebuilds"] == 0, data
     assert data["feas_rows_patched"] > 0
     assert bd["feasibility"]["calls"] > 0
+    # residue-compiled feasibility (ISSUE 20): the ladder ran the
+    # CSI/spread/distinct cell with NOMAD_TPU_FEAS_RESIDUE on and off
+    # in-process; the device mask token must survive every per-eval
+    # CSI mask mutation as a sparse residue scatter (zero warm full
+    # re-uploads), and the vectorized spread/distinct input builds
+    # must clear 2x the scalar walk + O(N) re-encode at quick scale
+    assert data["feas_resident_token_survival_rate"] >= 0.9, data
+    assert data["feas_residue_scatters"] > 0
+    assert data["feas_residue_rows"] > 0
+    assert data["feas_warm_mask_uploads"] == 0, data
+    assert data["spread_build_ms"] > 0
+    assert data["spread_build_ms_off"] > 0
+    assert data["spread_score_speedup"] >= 2.0, data
+    assert data["spread_score_evals"] > 0
     # columnar admission path (ISSUE 19): the ladder ran the write
     # storm with the ingest gateway on and off in-process against a
     # durable WAL; the group-applied arm must clear 2x the

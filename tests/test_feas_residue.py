@@ -1,0 +1,322 @@
+"""Residue-compiled feasibility + vectorized spread/distinct scoring
+(ISSUE 20): the vectorized input builds must be BIT-IDENTICAL to their
+scalar twins (1k-seed randomized parity), the device mask token must
+survive CSI/preferred-node residue mutations as a sparse scatter, and
+NOMAD_TPU_FEAS_RESIDUE=0 must degenerate to the scalar paths with
+identical placements."""
+
+import copy
+import os
+
+import numpy as np
+
+from nomad_tpu import mock
+from nomad_tpu.models import Constraint, Evaluation, Spread, SpreadTarget
+from nomad_tpu.models.csi import (ACCESS_MULTI_NODE_MULTI_WRITER,
+                                  ACCESS_SINGLE_NODE_WRITER, CSIVolume)
+from nomad_tpu.models.job import VolumeRequest
+from nomad_tpu.ops import spread as spread_ops
+from nomad_tpu.ops.tables import ProposedIndex
+from nomad_tpu.scheduler import feasible_compiler as fc
+from nomad_tpu.scheduler.harness import Harness
+from nomad_tpu.utils.ids import generate_uuid
+
+RACKS = [f"r{i}" for i in range(7)]
+TIERS = ["gold", "silver", "bronze"]
+ATTRS = ("${meta.rack}", "${meta.tier}", "${node.datacenter}",
+         "${node.class}")
+
+
+def _eval_for(job):
+    from nomad_tpu.models import EVAL_STATUS_PENDING, TRIGGER_JOB_REGISTER
+    return Evaluation(
+        id=generate_uuid(), namespace=job.namespace, priority=job.priority,
+        triggered_by=TRIGGER_JOB_REGISTER, job_id=job.id,
+        status=EVAL_STATUS_PENDING, type=job.type)
+
+
+class _residue(object):
+    """Force the residue switch for a block, restoring the ambient
+    environment on exit (both arms must be explicit — an inherited
+    kill switch must not silently change which path a parity arm
+    runs)."""
+
+    def __init__(self, on: bool):
+        self.on = on
+
+    def __enter__(self):
+        self.prev = os.environ.get(fc.ENV_RESIDUE)
+        os.environ[fc.ENV_RESIDUE] = "1" if self.on else "0"
+        return self
+
+    def __exit__(self, *exc):
+        if self.prev is None:
+            os.environ.pop(fc.ENV_RESIDUE, None)
+        else:
+            os.environ[fc.ENV_RESIDUE] = self.prev
+        return False
+
+
+def _fleet(n=48, seed=7):
+    rng = np.random.default_rng(seed)
+    h = Harness()
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        # deterministic ids: row order and argmax tie-breaks depend on
+        # them, and the on/off arms must see the SAME fleet
+        node.id = f"00000000-0000-4000-8000-{i:012d}"
+        node.name = f"node-{i}"
+        node.datacenter = f"dc{(i % 3) + 1}"
+        node.meta["rack"] = RACKS[int(rng.integers(len(RACKS)))]
+        # some nodes miss the tier attribute entirely: the missing
+        # bucket must round-trip the vectorized encode too
+        if rng.random() > 0.2:
+            node.meta["tier"] = TIERS[int(rng.integers(len(TIERS)))]
+        node.attributes["csi.plugin.p1"] = "1"
+        node.compute_class()
+        nodes.append(node)
+        h.store.upsert_node(h.next_index(), node)
+    return h, nodes
+
+
+# -- 1k-seed parity: dictionary encoding off the interned columns ------
+
+def test_attr_codes_vec_parity_1k_seeds():
+    """_interned_codes must reproduce NodeTable.attr_codes'
+    first-encounter-order numbering EXACTLY — codes array and values
+    list — across randomized attribute churn (mutations, deletions,
+    new values) on every interned target."""
+    h, nodes = _fleet()
+    rng = np.random.default_rng(123)
+    checked = 0
+    for round_ in range(250):
+        # mutate one node: rotate its rack, maybe drop/restore tier
+        node = copy.deepcopy(
+            h.store.node_by_id(nodes[int(rng.integers(len(nodes)))].id))
+        node.meta["rack"] = RACKS[int(rng.integers(len(RACKS)))]
+        if rng.random() < 0.3:
+            node.meta.pop("tier", None)
+        else:
+            node.meta["tier"] = TIERS[int(rng.integers(len(TIERS)))]
+        h.store.upsert_node(h.next_index(), node)
+        snap = h.store.snapshot()
+        t = snap.node_table()
+        for attr in ATTRS:
+            built = spread_ops._interned_codes(t, attr, snap)
+            assert built is not None, attr
+            vcodes, vvalues = built
+            t._attr_codes_cache.pop(attr, None)
+            scodes, svalues = t.attr_codes(attr)
+            assert vvalues == svalues, (attr, round_)
+            assert np.array_equal(vcodes, scodes), (attr, round_)
+            checked += 1
+    assert checked == 1000
+
+
+def test_property_counts_vec_parity_1k_seeds():
+    """property_counts_vec (one gather + np.add.at) must match the
+    per-alloc scalar walk bit-for-bit over randomized proposed-alloc
+    sets — counts AND present, with and without a task-group scope,
+    including allocs on missing-attribute nodes."""
+    h, _nodes = _fleet()
+    snap = h.store.snapshot()
+    t = snap.node_table()
+    job = mock.job()
+    rng = np.random.default_rng(42)
+
+    class _Alloc:
+        def __init__(self, tg):
+            self.task_group = tg
+
+    for seed in range(1000):
+        pi = ProposedIndex(t, job, [])
+        m = int(rng.integers(0, 12))
+        for _ in range(m):
+            pi._count(int(rng.integers(t.n)),
+                      _Alloc("web" if rng.random() < 0.6 else "db"))
+        attr = ATTRS[int(rng.integers(len(ATTRS)))]
+        tg_name = [None, "web", "db"][int(rng.integers(3))]
+        _codes, values = t.attr_codes(attr)
+        with _residue(False):
+            s_counts, s_present = pi.property_counts(attr, values, tg_name)
+        with _residue(True):
+            v_counts, v_present = pi.property_counts(attr, values, tg_name)
+        assert v_counts.dtype == s_counts.dtype, seed
+        assert np.array_equal(v_counts, s_counts), (seed, attr, tg_name)
+        assert np.array_equal(v_present, s_present), (seed, attr, tg_name)
+
+
+# -- end-to-end on/off parity with CSI churn ---------------------------
+
+def _spread_job(i, source=None, count=2, distinct=True):
+    job = mock.job()
+    job.id = f"sp-{i}"
+    job.datacenters = ["dc1", "dc2", "dc3"]
+    job.spreads = [Spread(
+        attribute="${node.datacenter}", weight=70,
+        spread_target=[SpreadTarget(value="dc1", percent=50),
+                       SpreadTarget(value="dc2", percent=30)])]
+    tg = job.task_groups[0]
+    tg.count = count
+    for task in tg.tasks:
+        task.resources.networks = []
+        task.resources.cpu = 20
+        task.resources.memory_mb = 32
+    tg.networks = []
+    tg.spreads = [Spread(attribute="${meta.rack}", weight=30)]
+    if distinct:
+        tg.constraints.append(Constraint(
+            ltarget="${meta.rack}", rtarget="4",
+            operand="distinct_property"))
+    if source is not None:
+        tg.volumes = {"vol": VolumeRequest(
+            name="vol", type="csi", source=source)}
+    return job
+
+
+def _run_wave(residue_on: bool):
+    """The parity scenario: spreads + distinct_property + CSI volumes
+    with claim churn, a single-writer volume exhausting its write cap
+    mid-wave, and a node mutation between evals. Returns the placement
+    trace (job -> sorted node names)."""
+    with _residue(residue_on):
+        h, nodes = _fleet(n=24, seed=11)
+        vols = [
+            CSIVolume(id="multi-vol", plugin_id="p1",
+                      access_mode=ACCESS_MULTI_NODE_MULTI_WRITER,
+                      topology_node_ids=[n.id for j, n in enumerate(nodes)
+                                         if j % 4 != 3]),
+            CSIVolume(id="solo-vol", plugin_id="p1",
+                      access_mode=ACCESS_SINGLE_NODE_WRITER),
+        ]
+        h.store.upsert_csi_volumes(h.next_index(), vols)
+        trace = {}
+        by_name = {n.id: n.name for n in nodes}
+        for r in range(10):
+            if r == 4:
+                # claim churn mid-wave: release every claim on the
+                # multi-writer volume so later rounds see fresh state
+                v = h.store.csi_volume("default", "multi-vol")
+                for aid in list(v.write_allocs):
+                    h.store.csi_volume_release(
+                        h.next_index(), "default", "multi-vol", aid)
+            node = copy.deepcopy(h.store.node_by_id(nodes[r % 24].id))
+            node.meta["canary"] = f"c{r}"
+            h.store.upsert_node(h.next_index(), node)
+            # rounds 6+ hit the exhausted single-writer volume: the
+            # write cap clamps the batch mid-wave (round 6 claims the
+            # single slot, later rounds place zero)
+            src = "solo-vol" if r >= 6 else "multi-vol"
+            job = _spread_job(r, source=src)
+            h.store.upsert_job(h.next_index(), job)
+            h.process("service", _eval_for(job))
+            placed = h.store.allocs_by_job("default", job.id)
+            trace[job.id] = sorted(by_name[a.node_id] for a in placed)
+        return trace
+
+
+def test_end_to_end_on_off_parity_with_csi_churn():
+    on = _run_wave(True)
+    off = _run_wave(False)
+    assert on == off
+    # the wave genuinely exercised the cap: the first solo-vol round
+    # placed exactly the one write slot, the later ones none
+    assert len(on["sp-6"]) == 1
+    assert on["sp-7"] == [] and on["sp-8"] == [] and on["sp-9"] == []
+
+
+def test_distinct_fold_single_placement_parity():
+    """count==1 with distinct_hosts/distinct_property and no
+    contending proposed alloc folds the kernel state to a plan-time
+    verdict — same placements, distinct_folds counted."""
+    results = {}
+    for arm in (True, False):
+        with _residue(arm):
+            h, nodes = _fleet(n=16, seed=3)
+            spread_ops.reset_stats()
+            job = _spread_job(0, count=1)
+            job.constraints.append(Constraint(operand="distinct_hosts"))
+            h.store.upsert_job(h.next_index(), job)
+            h.process("service", _eval_for(job))
+            placed = h.store.allocs_by_job("default", job.id)
+            by_name = {n.id: n.name for n in nodes}
+            results[arm] = sorted(by_name[a.node_id] for a in placed)
+            if arm:
+                assert spread_ops.STATS["distinct_folds"] > 0
+    assert results[True] == results[False]
+    assert len(results[True]) == 1
+
+
+# -- token survival through real store mutations -----------------------
+
+def test_token_survives_csi_residue():
+    """A CSI job's per-eval mask mutation must ride the parked device
+    mask as a sparse residue scatter — token kept, zero re-uploads —
+    and a residue fold mid-stream must only cost a re-park, never a
+    wrong verdict."""
+    with _residue(True):
+        h, nodes = _fleet(n=24, seed=5)
+        vol = CSIVolume(id="data-vol", plugin_id="p1",
+                        access_mode=ACCESS_MULTI_NODE_MULTI_WRITER,
+                        topology_node_ids=[n.id for j, n in
+                                           enumerate(nodes) if j % 3])
+        h.store.upsert_csi_volumes(h.next_index(), [vol])
+        # warm: compile, park the combined mask, establish the token
+        for i in (100, 101):
+            w = _spread_job(i, source="data-vol")
+            h.store.upsert_job(h.next_index(), w)
+            h.process("service", _eval_for(w))
+        fc.reset_stats()
+        feas = h.store.table_cache.device.feas
+        up0 = feas.stats["uploads"]
+        rs0 = feas.stats["residue_scatters"]
+        for r in range(4):
+            job = _spread_job(r, source="data-vol")
+            h.store.upsert_job(h.next_index(), job)
+            h.process("service", _eval_for(job))
+            assert h.store.allocs_by_job("default", job.id)
+        st = fc.stats()
+        assert st["token_survivals"] >= 4, st
+        assert st["token_invalidations"] == 0, st
+        assert st["residue_rows"] > 0, st
+        if feas.snapshot()["entries"]:
+            # masks actually parked on a device: survival must have
+            # shipped scatters, not re-uploads
+            assert feas.stats["residue_scatters"] > rs0
+            assert feas.stats["uploads"] == up0
+            assert feas.debt() > 0
+            # governor reclaim mid-stream: fold drops parked entries
+            # and zeroes the debt; the next eval re-parks and places
+            # identically
+            dropped = feas.fold()
+            assert dropped["residue_debt_cleared"] > 0
+            assert feas.debt() == 0
+        job = _spread_job(99, source="data-vol")
+        h.store.upsert_job(h.next_index(), job)
+        h.process("service", _eval_for(job))
+        assert h.store.allocs_by_job("default", job.id)
+
+
+def test_kill_switch_degenerates_to_scalar():
+    """NOMAD_TPU_FEAS_RESIDUE=0: no token ever survives a residue
+    mutation (dense path), every spread input builds scalar, and the
+    vectorized counters stay at zero."""
+    with _residue(False):
+        assert not fc.residue_enabled()
+        assert not spread_ops.enabled()
+        h, nodes = _fleet(n=16, seed=9)
+        vol = CSIVolume(id="data-vol", plugin_id="p1",
+                        access_mode=ACCESS_MULTI_NODE_MULTI_WRITER)
+        h.store.upsert_csi_volumes(h.next_index(), [vol])
+        fc.reset_stats()
+        spread_ops.reset_stats()
+        for r in range(3):
+            job = _spread_job(r, source="data-vol")
+            h.store.upsert_job(h.next_index(), job)
+            h.process("service", _eval_for(job))
+            assert h.store.allocs_by_job("default", job.id)
+        assert fc.stats()["token_survivals"] == 0
+        assert spread_ops.STATS["vector_builds"] == 0
+        assert spread_ops.STATS["scalar_builds"] > 0
+        assert spread_ops.STATS["spread_score_evals"] == 0
